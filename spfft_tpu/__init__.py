@@ -1,0 +1,61 @@
+"""spfft_tpu — TPU-native sparse 3D FFT framework.
+
+A from-scratch rebuild of the capabilities of SpFFT (reference mounted at
+/root/reference; see SURVEY.md) on JAX/XLA: 3D FFTs of sparse frequency-domain data
+(z-stick pencil decomposition in frequency space, slab decomposition in real space),
+C2C and R2C transforms with hermitian-symmetry completion, centered indexing, single
+and double precision, local and mesh-distributed execution with ICI all-to-all
+exchanges, grids, batched multi-transforms, and a C/C++/Fortran shim.
+"""
+from .errors import (  # noqa: F401
+    AllocationError,
+    DuplicateIndicesError,
+    ErrorCode,
+    FFTWError,
+    GenericError,
+    GPUAllocationError,
+    GPUCopyError,
+    GPUError,
+    GPUFFTError,
+    GPUInvalidDevicePointerError,
+    GPUInvalidValueError,
+    GPULaunchError,
+    GPUNoDeviceError,
+    GPUPrecedingError,
+    GPUSupportError,
+    HostExecutionError,
+    InvalidIndicesError,
+    InvalidParameterError,
+    MPIError,
+    MPIParameterMismatchError,
+    MPISupportError,
+    OverflowError_,
+)
+from .grid import Grid  # noqa: F401
+from .indices import create_spherical_cutoff_triplets  # noqa: F401
+from .transform import Transform, TransformFloat  # noqa: F401
+from .types import (  # noqa: F401
+    ExchangeType,
+    ExecType,
+    IndexFormat,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+    SPFFT_EXCH_BUFFERED,
+    SPFFT_EXCH_BUFFERED_FLOAT,
+    SPFFT_EXCH_COMPACT_BUFFERED,
+    SPFFT_EXCH_COMPACT_BUFFERED_FLOAT,
+    SPFFT_EXCH_DEFAULT,
+    SPFFT_EXCH_UNBUFFERED,
+    SPFFT_EXEC_ASYNCHRONOUS,
+    SPFFT_EXEC_SYNCHRONOUS,
+    SPFFT_FULL_SCALING,
+    SPFFT_INDEX_TRIPLETS,
+    SPFFT_NO_SCALING,
+    SPFFT_PU_GPU,
+    SPFFT_PU_HOST,
+    SPFFT_TRANS_C2C,
+    SPFFT_TRANS_R2C,
+)
+
+__version__ = "0.1.0"

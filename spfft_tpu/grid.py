@@ -1,0 +1,147 @@
+"""The ``Grid`` public API object.
+
+Parity with the reference ``spfft::Grid`` (reference: include/spfft/grid.hpp:49-205):
+a Grid declares maximum transform extents and stick counts up front and hands out
+Transforms that must fit inside it. In the reference this exists to pre-allocate and
+share scratch buffers (reference: src/spfft/grid_internal.cpp:48-229); under XLA,
+buffers are managed by the runtime, so the Grid's remaining jobs are capacity
+validation (kept, for API parity) and pinning the processing unit / device (and, for
+distributed grids, the mesh) that its transforms execute on.
+"""
+from __future__ import annotations
+
+import jax
+
+from .errors import InvalidParameterError, OverflowError_
+from .types import ExchangeType, ProcessingUnit
+
+
+def device_for_processing_unit(processing_unit: ProcessingUnit):
+    """Resolve a ProcessingUnit to a JAX device.
+
+    HOST always maps to a CPU device. GPU (the accelerator slot — TPU in this build)
+    maps to the default backend's first device, falling back to CPU when no
+    accelerator is attached (so tests run anywhere).
+    """
+    pu = ProcessingUnit(processing_unit)
+    if pu == ProcessingUnit.HOST:
+        return jax.local_devices(backend="cpu")[0]
+    return jax.devices()[0]
+
+
+class Grid:
+    """Capacity envelope + device binding for transforms.
+
+    Reference ctor: include/spfft/grid.hpp:65-66 (local),
+    :89-91 (distributed adds max_local_z_length, comm, exchange_type).
+    """
+
+    def __init__(
+        self,
+        max_dim_x: int,
+        max_dim_y: int,
+        max_dim_z: int,
+        max_num_local_z_columns: int,
+        processing_unit: ProcessingUnit = ProcessingUnit.HOST,
+        max_num_threads: int = -1,
+        *,
+        max_local_z_length: int | None = None,
+        mesh=None,
+        exchange_type: ExchangeType = ExchangeType.DEFAULT,
+    ):
+        if min(max_dim_x, max_dim_y, max_dim_z) < 1:
+            raise InvalidParameterError("grid dimensions must be positive")
+        if max_num_local_z_columns < 0:
+            raise InvalidParameterError("max_num_local_z_columns must be non-negative")
+        if max_dim_x * max_dim_y * max_dim_z >= 2**62:
+            raise OverflowError_("grid too large")
+        self._max_dim_x = int(max_dim_x)
+        self._max_dim_y = int(max_dim_y)
+        self._max_dim_z = int(max_dim_z)
+        self._max_num_local_z_columns = int(max_num_local_z_columns)
+        self._max_local_z_length = int(
+            max_dim_z if max_local_z_length is None else max_local_z_length
+        )
+        self._processing_unit = ProcessingUnit(processing_unit)
+        self._max_num_threads = max_num_threads
+        self._mesh = mesh
+        self._exchange_type = ExchangeType(exchange_type)
+        self._device = device_for_processing_unit(self._processing_unit)
+
+    # -- accessors, parity with include/spfft/grid.hpp:147-199 --
+    @property
+    def max_dim_x(self) -> int:
+        return self._max_dim_x
+
+    @property
+    def max_dim_y(self) -> int:
+        return self._max_dim_y
+
+    @property
+    def max_dim_z(self) -> int:
+        return self._max_dim_z
+
+    @property
+    def max_num_local_z_columns(self) -> int:
+        return self._max_num_local_z_columns
+
+    @property
+    def max_local_z_length(self) -> int:
+        return self._max_local_z_length
+
+    @property
+    def processing_unit(self) -> ProcessingUnit:
+        return self._processing_unit
+
+    @property
+    def max_num_threads(self) -> int:
+        return self._max_num_threads
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def exchange_type(self) -> ExchangeType:
+        return self._exchange_type
+
+    @property
+    def num_shards(self) -> int:
+        return 1 if self._mesh is None else int(self._mesh.devices.size)
+
+    def create_transform(
+        self,
+        processing_unit,
+        transform_type,
+        dim_x,
+        dim_y,
+        dim_z,
+        num_local_elements=None,
+        indices=None,
+        *,
+        local_z_length: int | None = None,
+        dtype=None,
+    ):
+        """Create a transform bound to this grid.
+
+        Reference: include/spfft/grid.hpp:138-141 / transform ctor checks in
+        src/spfft/transform_internal.cpp:45-137 (capacity validation against the grid).
+        """
+        from .transform import Transform
+
+        return Transform(
+            processing_unit=processing_unit,
+            transform_type=transform_type,
+            dim_x=dim_x,
+            dim_y=dim_y,
+            dim_z=dim_z,
+            num_local_elements=num_local_elements,
+            indices=indices,
+            local_z_length=local_z_length,
+            grid=self,
+            dtype=dtype,
+        )

@@ -1,0 +1,3 @@
+"""Device-side building blocks of the transform pipelines."""
+
+from . import compression, symmetry  # noqa: F401

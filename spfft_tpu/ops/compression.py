@@ -1,0 +1,39 @@
+"""Sparse value <-> dense z-stick packing.
+
+The analogue of the reference's compression component
+(reference: src/compression/compression_host.hpp:50-92 and the CUDA kernels in
+src/compression/gpu_kernels/compression_kernels.cu:40-130): *decompress* scatters the
+caller's packed sparse values into a zeroed dense stick array, *compress* gathers them
+back out with optional 1/(NxNyNz) scaling fused in.
+
+Index arrays are static device constants (uploaded once at plan creation, like
+CompressionGPU does, reference: src/compression/compression_gpu.hpp:54-57); the
+scatter/gather itself is a single XLA op that fuses with neighbouring stages.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def decompress(values, value_indices, num_sticks: int, dim_z: int):
+    """Scatter packed values into a zeroed (num_sticks, dim_z) stick array.
+
+    Zero-fill first is semantically load-bearing: slots without a caller value must be
+    zero (reference zero-fills before scattering,
+    src/compression/compression_host.hpp:76-92).
+    """
+    flat = jnp.zeros(num_sticks * dim_z, dtype=values.dtype)
+    flat = flat.at[value_indices].set(values, mode="drop", unique_indices=True)
+    return flat.reshape(num_sticks, dim_z)
+
+
+def compress(sticks, value_indices, scale: float | None = None):
+    """Gather packed values out of the stick array, optionally scaling.
+
+    Reference: src/compression/compression_host.hpp:50-74 (compress with optional
+    scaling fused into the gather loop).
+    """
+    values = sticks.reshape(-1).at[value_indices].get(mode="promise_in_bounds")
+    if scale is not None and scale != 1.0:
+        values = values * jnp.asarray(scale, dtype=sticks.real.dtype)
+    return values

@@ -1,0 +1,78 @@
+"""Hermitian symmetry completion for R2C transforms.
+
+For R2C the caller only supplies non-redundant frequencies (x restricted to
+[0, Nx/2]); the omitted mirror values must be reconstructed before the backward
+transform. Two completions exist, exactly as in the reference
+(reference: src/symmetry/symmetry_host.hpp:40-97, docs/source/details.rst:31-40):
+
+* *stick symmetry*: the z-column at (x=0, y=0) is self-mirrored along z.
+* *plane symmetry*: the x=0 plane is mirrored along y (applied after the z transform,
+  where the mirror relation is a plain pointwise conjugate in the space-z coordinate).
+
+Both use the reference's nonzero-guarded two-pass discipline ("data may be conjugated
+twice, but this way symmetry is applied independent of positive or negative
+frequencies provided", src/symmetry/symmetry_host.hpp:49-50 / :74-75): an entry is only
+written where its mirror source is nonzero, lower half first, then upper half reading
+possibly-updated values.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _mirror(a, axis: int):
+    """m[..., j, ...] = a[..., (n-j) % n, ...] along ``axis``."""
+    n = a.shape[axis]
+    idx = (-np.arange(n)) % n
+    return jnp.take(a, jnp.asarray(idx), axis=axis)
+
+
+def hermitian_fill_1d(a, axis: int):
+    """Two-pass nonzero-guarded hermitian completion along ``axis``.
+
+    Pass 1 writes targets [ceil(n/2), n-1] from sources in the lower half; pass 2
+    writes targets [1, ceil(n/2)-1] from the (possibly updated) upper half. Index 0 is
+    its own mirror and is never written. Matches the sequential in-place semantics of
+    StickSymmetryHost / PlaneSymmetryHost (reference: src/symmetry/symmetry_host.hpp:47-90).
+    """
+    n = a.shape[axis]
+    if n <= 1:
+        return a
+    shape = [1] * a.ndim
+    shape[axis] = n
+    j = jnp.arange(n).reshape(shape)
+    upper_targets = j >= (n - n // 2)  # ceil(n/2) .. n-1 (incl. Nyquist for even n)
+    lower_targets = (j >= 1) & (j < (n - n // 2))
+
+    m = _mirror(a, axis)
+    a = jnp.where(upper_targets & (m != 0), jnp.conj(m), a)
+    m = _mirror(a, axis)
+    a = jnp.where(lower_targets & (m != 0), jnp.conj(m), a)
+    return a
+
+
+def apply_stick_symmetry(sticks, zero_stick_id: int | None):
+    """Complete the (0,0) z-stick along z, in the frequency domain before the z-FFT.
+
+    ``sticks`` is (num_sticks, dim_z); ``zero_stick_id`` is the row holding xy key 0,
+    or None if the transform has no (0,0) stick.
+    Reference call site: src/execution/execution_host.cpp backward_z stage.
+    """
+    if zero_stick_id is None:
+        return sticks
+    row = hermitian_fill_1d(sticks[zero_stick_id], axis=0)
+    return sticks.at[zero_stick_id].set(row)
+
+
+def apply_plane_symmetry(grid):
+    """Complete the x=0 plane along y, after the z transform.
+
+    ``grid`` is (dim_z_local, dim_y, dim_x_freq) with z in space domain, x/y in
+    frequency domain. After the z-FFT the 3D hermitian relation restricted to x=0
+    reduces to ``g(z, -y, 0) = conj(g(z, y, 0))`` pointwise in z, which is what the
+    reference exploits by applying plane symmetry post-exchange
+    (reference: src/execution/execution_host.cpp backward_xy stage).
+    """
+    plane = hermitian_fill_1d(grid[:, :, 0], axis=1)
+    return grid.at[:, :, 0].set(plane)

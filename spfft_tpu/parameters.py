@@ -1,0 +1,89 @@
+"""Transform metadata ("the plan").
+
+The analogue of the reference's ``Parameters`` object
+(reference: src/parameters/parameters.hpp:48-156, src/parameters/parameters.cpp:43-180):
+converts user index triplets into the internal z-stick layout, derives all static
+shapes, and (in the distributed case) the per-shard stick/plane bookkeeping.
+
+Everything here is host-side numpy computed once at Transform creation; the resulting
+index arrays become device-resident constants closed over by the jitted pipelines
+(static shapes are what XLA needs — the reference freezes the same quantities at plan
+creation time).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from . import indices as _indices
+from .errors import InvalidParameterError, MPIParameterMismatchError
+from .types import TransformType
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalParameters:
+    """Metadata for a single-device transform."""
+
+    transform_type: TransformType
+    dim_x: int
+    dim_y: int
+    dim_z: int
+    num_values: int
+    # Flat slot of each packed caller value inside the stick array (stick*dim_z + z).
+    value_indices: np.ndarray
+    # Sorted unique xy keys (x*dim_y + y); position == stick id.
+    stick_xy_indices: np.ndarray
+
+    @property
+    def dim_x_freq(self) -> int:
+        """Frequency-domain x extent (hermitian-reduced for R2C)."""
+        if self.transform_type == TransformType.R2C:
+            return self.dim_x // 2 + 1
+        return self.dim_x
+
+    @property
+    def num_sticks(self) -> int:
+        return int(self.stick_xy_indices.size)
+
+    @property
+    def stick_x(self) -> np.ndarray:
+        return self.stick_xy_indices // self.dim_y
+
+    @property
+    def stick_y(self) -> np.ndarray:
+        return self.stick_xy_indices % self.dim_y
+
+    @property
+    def total_size(self) -> int:
+        return self.dim_x * self.dim_y * self.dim_z
+
+
+def make_local_parameters(
+    transform_type: TransformType,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    indices: np.ndarray | Sequence[int],
+) -> LocalParameters:
+    """Build local transform metadata from index triplets.
+
+    Parity with the reference's local Parameters constructor
+    (reference: src/parameters/parameters.cpp:143-180).
+    """
+    if dim_x <= 0 or dim_y <= 0 or dim_z <= 0:
+        raise InvalidParameterError("transform dimensions must be positive")
+    hermitian = transform_type == TransformType.R2C
+    value_indices, stick_xy = _indices.convert_index_triplets(
+        hermitian, dim_x, dim_y, dim_z, indices
+    )
+    return LocalParameters(
+        transform_type=TransformType(transform_type),
+        dim_x=int(dim_x),
+        dim_y=int(dim_y),
+        dim_z=int(dim_z),
+        num_values=int(value_indices.size),
+        value_indices=value_indices,
+        stick_xy_indices=stick_xy,
+    )

@@ -1,0 +1,314 @@
+"""The ``Transform`` public API object.
+
+Parity with the reference ``spfft::Transform`` (reference: include/spfft/transform.hpp:56-318):
+a shape-specialized FFT plan created either from a Grid or standalone, exposing
+``forward`` / ``backward`` and the full accessor surface. The reference's
+double/float split (``Transform`` vs ``TransformFloat``) becomes a ``dtype``
+argument; ``TransformFloat`` is provided as a thin alias for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .errors import InvalidParameterError
+from .execution import LocalExecution
+from .grid import Grid, device_for_processing_unit
+from .parameters import make_local_parameters
+from .types import ExecType, IndexFormat, ProcessingUnit, ScalingType, TransformType
+
+
+class Transform:
+    """A sparse 3D FFT plan.
+
+    Create standalone (reference grid-less ctor, include/spfft/transform.hpp:76-105)
+    or via :meth:`Grid.create_transform`.
+
+    ``backward(values)`` maps packed sparse frequency values to the dense space-domain
+    slab (shape ``(dim_z, dim_y, dim_x)``, addressing parity with
+    reference docs/source/details.rst:21-27); ``forward(space, scaling)`` maps back,
+    optionally scaling by 1/(NxNyNz) (reference: docs/source/details.rst:42-44).
+    """
+
+    def __init__(
+        self,
+        processing_unit,
+        transform_type,
+        dim_x,
+        dim_y,
+        dim_z,
+        num_local_elements=None,
+        indices=None,
+        *,
+        local_z_length=None,
+        index_format: IndexFormat = IndexFormat.TRIPLETS,
+        grid: Grid | None = None,
+        dtype=None,
+    ):
+        if IndexFormat(index_format) != IndexFormat.TRIPLETS:
+            raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
+        if indices is None:
+            raise InvalidParameterError("index triplets are required")
+        indices = np.asarray(indices)
+        if num_local_elements is not None:
+            flat = indices.reshape(-1)
+            if flat.size < 3 * num_local_elements:
+                raise InvalidParameterError("fewer indices than num_local_elements")
+            indices = flat[: 3 * int(num_local_elements)]
+
+        self._processing_unit = ProcessingUnit(processing_unit)
+        self._grid = grid
+        self._exec_mode = ExecType.SYNCHRONOUS
+        self._params = make_local_parameters(
+            TransformType(transform_type), dim_x, dim_y, dim_z, indices
+        )
+
+        if grid is not None:
+            # Capacity validation, parity with src/spfft/transform_internal.cpp:45-137.
+            p = self._params
+            if (
+                p.dim_x > grid.max_dim_x
+                or p.dim_y > grid.max_dim_y
+                or p.dim_z > grid.max_dim_z
+            ):
+                raise InvalidParameterError("transform dimensions exceed grid maxima")
+            if p.num_sticks > grid.max_num_local_z_columns:
+                raise InvalidParameterError("more z-columns than grid maximum")
+            if not (ProcessingUnit(processing_unit) & grid.processing_unit):
+                raise InvalidParameterError(
+                    "transform processing unit not covered by grid"
+                )
+
+        if dtype is None:
+            dtype = np.float64 if jax.config.read("jax_enable_x64") else np.float32
+        self._real_dtype = np.dtype(dtype)
+        if self._real_dtype not in (np.dtype(np.float32), np.dtype(np.float64)):
+            raise InvalidParameterError("dtype must be float32 or float64")
+
+        device = device_for_processing_unit(self._processing_unit)
+        self._exec = LocalExecution(self._params, self._real_dtype, device=device)
+        self._space_data = None
+
+    # ---- transforms -----------------------------------------------------------
+
+    def backward(self, values, output_location: ProcessingUnit | None = None):
+        """Frequency -> space. Returns the (dim_z, dim_y, dim_x) space-domain array
+        (complex for C2C, real for R2C).
+
+        Reference: include/spfft/transform.hpp:286-298. The result is also retained
+        (device-resident) for :meth:`space_domain_data` / input-less :meth:`forward`,
+        mirroring the reference's internal space-domain buffer.
+        """
+        if output_location is not None:
+            _validate_pu(output_location)
+        values = np.asarray(values)
+        if values.size != self._params.num_values:
+            raise InvalidParameterError(
+                f"expected {self._params.num_values} frequency values, got {values.size}"
+            )
+        values = values.reshape(self._params.num_values)
+        out = self._exec.backward(values)
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            jax.block_until_ready(out)
+        self._space_data = out  # (re, im) device pair for C2C, real device array for R2C
+        return self._combine_space(out)
+
+    def backward_pair(self, values_re, values_im):
+        """Device-side backward: (re, im) freq pair in, device-resident space out
+        ((re, im) pair for C2C, real array for R2C). No host transfers."""
+        out = self._exec.backward_pair(values_re, values_im)
+        self._space_data = out
+        return out
+
+    def forward(
+        self,
+        space=None,
+        scaling: ScalingType = ScalingType.NONE,
+        input_location: ProcessingUnit | None = None,
+    ):
+        """Space -> frequency. Returns the packed (num_local_elements,) complex values.
+
+        Reference: include/spfft/transform.hpp:259-283. ``space=None`` reads the
+        retained space-domain buffer (the reference's pointer-free overload reading
+        ``space_domain_data``).
+        """
+        from .execution import as_pair, from_pair
+
+        if input_location is not None:
+            _validate_pu(input_location)
+        p = self._params
+        if space is None:
+            if self._space_data is None:
+                raise InvalidParameterError(
+                    "no space domain data: run backward first or pass an array"
+                )
+            if self._is_r2c:
+                pair = self._exec.forward_pair(self._space_data, None, ScalingType(scaling))
+            else:
+                re, im = self._space_data
+                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+        else:
+            space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
+            if self._is_r2c:
+                space_re = self._exec.put(
+                    np.ascontiguousarray(space.real, dtype=self._real_dtype)
+                )
+                self._space_data = space_re
+                pair = self._exec.forward_pair(space_re, None, ScalingType(scaling))
+            else:
+                re, im = as_pair(space, self._real_dtype)
+                re, im = self._exec.put(re), self._exec.put(im)
+                self._space_data = (re, im)
+                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            jax.block_until_ready(pair)
+        return from_pair(pair)
+
+    def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
+        """Device-side forward over the retained space buffer; returns the (re, im)
+        freq pair without host transfers."""
+        if self._space_data is None:
+            raise InvalidParameterError("no space domain data: run backward first")
+        if self._is_r2c:
+            return self._exec.forward_pair(self._space_data, None, ScalingType(scaling))
+        re, im = self._space_data
+        return self._exec.forward_pair(re, im, ScalingType(scaling))
+
+    @property
+    def _is_r2c(self) -> bool:
+        return self._params.transform_type == TransformType.R2C
+
+    def _combine_space(self, out):
+        from .execution import from_pair
+
+        if self._is_r2c:
+            return np.asarray(out)
+        return from_pair(out)
+
+    def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
+        """The most recent space-domain result (reference: transform.hpp:245)."""
+        if self._space_data is None:
+            raise InvalidParameterError("no space domain data available yet")
+        return self._combine_space(self._space_data)
+
+    def clone(self) -> "Transform":
+        """Create an independent transform with identical layout.
+
+        Reference: include/spfft/transform.hpp:133 (clone deep-copies the grid so the
+        clone never shares buffers; here plans are already independent).
+        """
+        p = self._params
+        triplets = _storage_triplets(p)
+        return Transform(
+            self._processing_unit,
+            p.transform_type,
+            p.dim_x,
+            p.dim_y,
+            p.dim_z,
+            indices=triplets,
+            grid=self._grid,
+            dtype=self._real_dtype,
+        )
+
+    # ---- accessors, parity with include/spfft/transform.hpp:147-245 -----------
+
+    @property
+    def transform_type(self) -> TransformType:
+        return self._params.transform_type
+
+    @property
+    def dim_x(self) -> int:
+        return self._params.dim_x
+
+    @property
+    def dim_y(self) -> int:
+        return self._params.dim_y
+
+    @property
+    def dim_z(self) -> int:
+        return self._params.dim_z
+
+    @property
+    def local_z_length(self) -> int:
+        return self._params.dim_z
+
+    @property
+    def local_z_offset(self) -> int:
+        return 0
+
+    @property
+    def local_slice_size(self) -> int:
+        return self.dim_x * self.dim_y * self.local_z_length
+
+    @property
+    def num_local_elements(self) -> int:
+        return self._params.num_values
+
+    @property
+    def num_global_elements(self) -> int:
+        return self._params.num_values
+
+    @property
+    def global_size(self) -> int:
+        return self._params.total_size
+
+    @property
+    def processing_unit(self) -> ProcessingUnit:
+        return self._processing_unit
+
+    @property
+    def device_id(self) -> int:
+        return getattr(self._exec.device, "id", 0)
+
+    @property
+    def num_threads(self) -> int:
+        return 1
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._real_dtype
+
+    @property
+    def grid(self) -> Grid | None:
+        return self._grid
+
+    def execution_mode(self) -> ExecType:
+        return self._exec_mode
+
+    def set_execution_mode(self, mode: ExecType) -> None:
+        """Reference: include/spfft/transform.hpp:225 — ASYNCHRONOUS skips the
+        blocking wait after dispatch (JAX dispatch is naturally async)."""
+        self._exec_mode = ExecType(mode)
+
+    def synchronize(self) -> None:
+        if self._space_data is not None:
+            jax.block_until_ready(self._space_data)
+
+
+def _validate_pu(pu) -> None:
+    try:
+        ProcessingUnit(pu)
+    except ValueError as e:
+        raise InvalidParameterError(f"invalid processing unit: {pu!r}") from e
+
+
+def _storage_triplets(p) -> np.ndarray:
+    """Reconstruct storage-order index triplets from plan metadata (for clone)."""
+    stick_of_value = p.value_indices // p.dim_z
+    z = p.value_indices % p.dim_z
+    x = p.stick_x[stick_of_value]
+    y = p.stick_y[stick_of_value]
+    return np.stack([x, y, z], axis=1).astype(np.int32)
+
+
+class TransformFloat(Transform):
+    """Single-precision transform, parity alias.
+
+    Reference: include/spfft/transform_float.hpp (separate class gated behind
+    SPFFT_SINGLE_PRECISION; here just ``dtype=float32``).
+    """
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("dtype", np.float32)
+        super().__init__(*args, **kwargs)

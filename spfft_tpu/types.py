@@ -1,0 +1,99 @@
+"""Public enum surface of spfft_tpu.
+
+Mirrors the reference C enum surface (reference: include/spfft/types.h:33-117) so that
+callers of the original library find the same vocabulary, while documenting how each
+value maps onto the TPU execution model.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ExchangeType(enum.IntEnum):
+    """Slab<->pencil exchange strategy.
+
+    Reference: include/spfft/types.h:33-62 (SpfftExchangeType).
+
+    On TPU all inter-chip exchanges lower to an equal-split ``lax.all_to_all`` over the
+    ICI mesh axis, which corresponds to the reference's BUFFERED (padded-block) wire
+    discipline. COMPACT_BUFFERED and UNBUFFERED are accepted and mapped onto the same
+    padded exchange (pad -> all_to_all -> slice); the ``*_FLOAT`` variants halve wire
+    bytes by converting the exchanged payload to single precision (complex64) on the
+    wire, exactly like the reference's float exchange
+    (reference: src/gpu_util/complex_conversion.cuh:37-56).
+    """
+
+    DEFAULT = 0
+    BUFFERED = 1
+    BUFFERED_FLOAT = 2
+    COMPACT_BUFFERED = 3
+    COMPACT_BUFFERED_FLOAT = 4
+    UNBUFFERED = 5
+
+
+class ProcessingUnit(enum.IntFlag):
+    """Where a transform executes. Reference: include/spfft/types.h:67-76.
+
+    HOST selects the CPU backend (JAX on CPU devices), GPU selects the accelerator
+    backend (the TPU in this build — the enum name is kept for API parity).
+    """
+
+    HOST = 1
+    GPU = 2
+    # Alias making intent explicit in new code.
+    TPU = 2
+
+
+class IndexFormat(enum.IntEnum):
+    """Sparse frequency index format. Reference: include/spfft/types.h:78-83."""
+
+    TRIPLETS = 0
+
+
+class TransformType(enum.IntEnum):
+    """C2C or R2C. Reference: include/spfft/types.h:85-95."""
+
+    C2C = 0
+    R2C = 1
+
+
+class ScalingType(enum.IntEnum):
+    """Forward-transform scaling. Reference: include/spfft/types.h:97-106."""
+
+    NONE = 0
+    FULL = 1
+
+
+class ExecType(enum.IntEnum):
+    """Synchronous vs asynchronous execution. Reference: include/spfft/types.h:108-117.
+
+    JAX dispatch is asynchronous by default; SYNCHRONOUS blocks on the result before
+    returning (``block_until_ready``), ASYNCHRONOUS returns as soon as the computation
+    is enqueued.
+    """
+
+    SYNCHRONOUS = 0
+    ASYNCHRONOUS = 1
+
+
+# C-compatible aliases (same spelling as the reference C enum constants).
+SPFFT_EXCH_DEFAULT = ExchangeType.DEFAULT
+SPFFT_EXCH_BUFFERED = ExchangeType.BUFFERED
+SPFFT_EXCH_BUFFERED_FLOAT = ExchangeType.BUFFERED_FLOAT
+SPFFT_EXCH_COMPACT_BUFFERED = ExchangeType.COMPACT_BUFFERED
+SPFFT_EXCH_COMPACT_BUFFERED_FLOAT = ExchangeType.COMPACT_BUFFERED_FLOAT
+SPFFT_EXCH_UNBUFFERED = ExchangeType.UNBUFFERED
+
+SPFFT_PU_HOST = ProcessingUnit.HOST
+SPFFT_PU_GPU = ProcessingUnit.GPU
+
+SPFFT_INDEX_TRIPLETS = IndexFormat.TRIPLETS
+
+SPFFT_TRANS_C2C = TransformType.C2C
+SPFFT_TRANS_R2C = TransformType.R2C
+
+SPFFT_NO_SCALING = ScalingType.NONE
+SPFFT_FULL_SCALING = ScalingType.FULL
+
+SPFFT_EXEC_SYNCHRONOUS = ExecType.SYNCHRONOUS
+SPFFT_EXEC_ASYNCHRONOUS = ExecType.ASYNCHRONOUS

@@ -1,0 +1,64 @@
+"""Index conversion unit tests (reference parity: src/compression/indices.hpp:49-186)."""
+import numpy as np
+import pytest
+
+from spfft_tpu import DuplicateIndicesError, InvalidIndicesError, InvalidParameterError
+from spfft_tpu.indices import convert_index_triplets, stick_xy_to_xy, to_storage_index
+
+
+def test_storage_index_wraps_negative():
+    assert to_storage_index(10, np.asarray(-1)) == 9
+    assert to_storage_index(10, np.asarray(3)) == 3
+
+
+def test_value_indices_stick_layout():
+    # two sticks: (0,0) and (1,2) in a 4x4x4 grid; sticks sorted by x*dimY+y
+    triplets = [(1, 2, 0), (0, 0, 1), (1, 2, 3), (0, 0, 0)]
+    vi, sticks = convert_index_triplets(False, 4, 4, 4, np.asarray(triplets))
+    assert list(sticks) == [0, 1 * 4 + 2]
+    # values: stick_id * dimZ + z
+    assert list(vi) == [1 * 4 + 0, 0 * 4 + 1, 1 * 4 + 3, 0 * 4 + 0]
+
+
+def test_centered_autodetect_and_wrap():
+    vi, sticks = convert_index_triplets(False, 4, 4, 4, np.asarray([(-1, 2, -1)]))
+    assert list(sticks) == [3 * 4 + 2]
+    assert list(vi) == [3]
+
+
+def test_bounds_noncentered():
+    convert_index_triplets(False, 4, 4, 4, np.asarray([(3, 3, 3)]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(False, 4, 4, 4, np.asarray([(4, 0, 0)]))
+
+
+def test_bounds_centered():
+    # centered: allowed x in [-1, 2] for dim 4
+    convert_index_triplets(False, 4, 4, 4, np.asarray([(2, -1, 0)]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(False, 4, 4, 4, np.asarray([(3, -1, 0)]))
+
+
+def test_hermitian_x_bounds():
+    convert_index_triplets(True, 4, 4, 4, np.asarray([(2, 0, 0)]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(True, 4, 4, 4, np.asarray([(3, 0, 0)]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(True, 4, 4, 4, np.asarray([(-1, 0, 0)]))
+
+
+def test_duplicate_triplets_rejected():
+    with pytest.raises(DuplicateIndicesError):
+        convert_index_triplets(False, 4, 4, 4, np.asarray([(1, 1, 1), (1, 1, 1)]))
+
+
+def test_too_many_values_rejected():
+    trip = np.zeros((9, 3), dtype=np.int64)
+    with pytest.raises(InvalidParameterError):
+        convert_index_triplets(False, 2, 2, 2, trip)
+
+
+def test_stick_xy_split():
+    x, y = stick_xy_to_xy(np.asarray([0, 6]), 4)
+    assert list(x) == [0, 1]
+    assert list(y) == [0, 2]

@@ -1,0 +1,145 @@
+"""Local R2C/C2R transform tests.
+
+Covers hermitian-symmetry completion: full half-spectrum round trips, omission of
+redundant x=0-plane sticks and (0,0)-stick entries (reference:
+docs/source/details.rst:31-40), and sparse stick subsets against a hermitian-extension
+oracle.
+"""
+import numpy as np
+import pytest
+
+from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+from utils import assert_close, random_sparse_triplets, storage
+
+DIMS = [(4, 4, 4), (6, 5, 4), (11, 12, 13), (16, 16, 16)]
+
+
+def full_half_triplets(dx, dy, dz):
+    xs = np.arange(dx // 2 + 1)
+    g = np.stack(np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1)
+    return g.reshape(-1, 3)
+
+
+def nonredundant_triplets(dx, dy, dz):
+    """Half spectrum minus the redundant parts: for x=0 keep only y in [0, dy//2];
+    for (x=0, y=0) keep only z in [0, dz//2]."""
+    out = []
+    for x in range(dx // 2 + 1):
+        for y in range(dy):
+            if x == 0 and y > dy // 2:
+                continue
+            for z in range(dz):
+                if x == 0 and y == 0 and z > dz // 2:
+                    continue
+                out.append((x, y, z))
+    return np.asarray(out)
+
+
+def make(dims, triplets, dtype=np.float64):
+    return Transform(
+        ProcessingUnit.HOST,
+        TransformType.R2C,
+        dims[0],
+        dims[1],
+        dims[2],
+        indices=triplets,
+        dtype=dtype,
+    )
+
+
+@pytest.mark.parametrize("dims", DIMS)
+def test_r2c_roundtrip_full_half_spectrum(dims):
+    rng = np.random.default_rng(21)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    t = make(dims, full_half_triplets(dx, dy, dz))
+    values = t.forward(r, scaling=ScalingType.FULL)
+    out = np.asarray(t.backward(values))
+    assert out.dtype == np.float64
+    assert_close(out, r)
+    # run twice (zeroing check)
+    assert_close(np.asarray(t.backward(values)), r)
+
+
+@pytest.mark.parametrize("dims", DIMS)
+def test_r2c_redundant_values_omitted(dims):
+    """Only non-redundant frequencies provided; symmetry completion must reconstruct
+    the full real field."""
+    rng = np.random.default_rng(22)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+
+    trip = nonredundant_triplets(dx, dy, dz)
+    xs, ys, zs = trip[:, 0], trip[:, 1], trip[:, 2]
+    values = freq[zs, ys, xs]
+
+    t = make(dims, trip)
+    out = np.asarray(t.backward(values))
+    assert_close(out, r)
+
+
+@pytest.mark.parametrize("dims", DIMS)
+def test_r2c_forward_vs_oracle(dims):
+    rng = np.random.default_rng(23)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6, hermitian=True)
+    xs, ys, zs = (storage(trip[:, i], d) for i, d in ((0, dx), (1, dy), (2, dz)))
+
+    t = make(dims, trip)
+    out = np.asarray(t.forward(r))
+    expected = np.fft.fftn(r)[zs, ys, xs]
+    assert_close(out, expected)
+
+
+@pytest.mark.parametrize("dims", DIMS)
+def test_r2c_sparse_backward_vs_hermitian_extension_oracle(dims):
+    """Backward of a sparse hermitian stick subset == dense inverse DFT of the
+    hermitian-closed masked spectrum."""
+    rng = np.random.default_rng(24)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    full = np.fft.fftn(r)
+
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5, hermitian=True)
+    # Hermitian completion is only defined for the x=0 plane (reference:
+    # docs/source/details.rst:37-40); on the x-Nyquist plane (even dx) a stick's
+    # mirror (hx, -y) must be supplied by the caller. Drop unpaired Nyquist sticks.
+    if dx % 2 == 0:
+        hx = dx // 2
+        stick_set = {(int(t[0]), int(t[1]) % dy) for t in trip}
+        keep = [
+            i
+            for i, t in enumerate(trip)
+            if t[0] != hx or (hx, (-int(t[1])) % dy) in stick_set
+        ]
+        trip = trip[keep]
+    xs, ys, zs = (
+        np.asarray(storage(trip[:, i], d)) for i, d in ((0, dx), (1, dy), (2, dz))
+    )
+    values = full[zs, ys, xs]
+
+    # hermitian-closed masked spectrum
+    dense = np.zeros((dz, dy, dx), dtype=np.complex128)
+    dense[zs, ys, xs] = values
+    dense[(-zs) % dz, (-ys) % dy, (-xs) % dx] = np.conj(values)
+    expected = np.fft.ifftn(dense) * (dx * dy * dz)
+    assert np.abs(expected.imag).max() < 1e-9
+    expected = expected.real
+
+    t = make(dims, trip)
+    out = np.asarray(t.backward(values))
+    assert_close(out, expected)
+
+
+def test_r2c_float32():
+    rng = np.random.default_rng(25)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx)).astype(np.float32)
+    t = make(dims, full_half_triplets(dx, dy, dz), dtype=np.float32)
+    values = t.forward(r, scaling=ScalingType.FULL)
+    out = np.asarray(t.backward(values))
+    assert out.dtype == np.float32
+    assert_close(out, r, dtype=np.float32)

@@ -1,0 +1,98 @@
+"""Shared test utilities.
+
+Mirrors the reference test strategy (SURVEY.md §4): a dense FFT oracle
+(np.fft here, FFTW there — reference: tests/test_util/test_transform.hpp:41-46),
+seeded random sparse stick sets every process can derive identically
+(reference: tests/test_util/generate_indices.hpp:39-100), and element-wise
+comparison at 1e-6 for double precision
+(reference: tests/test_util/test_check_values.hpp:46-78).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def storage(idx, dim):
+    idx = np.asarray(idx)
+    return np.where(idx < 0, idx + dim, idx)
+
+
+def random_sparse_triplets(
+    rng: np.random.Generator,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    stick_fraction: float = 0.5,
+    z_fill: float = 1.0,
+    centered: bool = False,
+    hermitian: bool = False,
+) -> np.ndarray:
+    """Random z-stick set: a random subset of xy columns, each with a random subset of
+    z entries (whole sticks by default, like the reference's generator)."""
+    xs = np.arange(dim_x // 2 + 1) if hermitian else np.arange(dim_x)
+    ys = np.arange(dim_y)
+    keys = np.stack(np.meshgrid(xs, ys, indexing="ij"), axis=-1).reshape(-1, 2)
+    n_sticks = max(1, int(len(keys) * stick_fraction))
+    chosen = keys[rng.choice(len(keys), size=n_sticks, replace=False)]
+    triplets = []
+    for x, y in chosen:
+        zs = np.arange(dim_z)
+        if z_fill < 1.0:
+            zs = np.sort(rng.choice(dim_z, size=max(1, int(dim_z * z_fill)), replace=False))
+        for z in zs:
+            triplets.append((x, y, z))
+    triplets = np.asarray(triplets, dtype=np.int64)
+    if centered:
+        triplets = center_triplets(triplets, dim_x, dim_y, dim_z, hermitian)
+    return triplets
+
+
+def center_triplets(triplets, dim_x, dim_y, dim_z, hermitian=False):
+    """Shift storage indices into the centered (negative-frequency) convention
+    (reference: tests/test_util/generate_indices.hpp:87)."""
+    t = np.array(triplets, dtype=np.int64)
+    if not hermitian:
+        t[:, 0] = np.where(t[:, 0] > dim_x // 2, t[:, 0] - dim_x, t[:, 0])
+    t[:, 1] = np.where(t[:, 1] > dim_y // 2, t[:, 1] - dim_y, t[:, 1])
+    t[:, 2] = np.where(t[:, 2] > dim_z // 2, t[:, 2] - dim_z, t[:, 2])
+    return t
+
+
+def dense_from_values(triplets, values, dim_x, dim_y, dim_z, dim_x_freq=None):
+    """Scatter packed values into a dense (Z, Y, Xf) frequency grid at storage coords."""
+    t = np.asarray(triplets).reshape(-1, 3)
+    xs = storage(t[:, 0], dim_x)
+    ys = storage(t[:, 1], dim_y)
+    zs = storage(t[:, 2], dim_z)
+    dense = np.zeros((dim_z, dim_y, dim_x_freq or dim_x), dtype=np.complex128)
+    dense[zs, ys, xs] = values
+    return dense
+
+
+def oracle_backward_c2c(triplets, values, dim_x, dim_y, dim_z):
+    """Unnormalized inverse DFT of the sparse data (the reference's dense FFTW oracle,
+    backward direction)."""
+    dense = dense_from_values(triplets, values, dim_x, dim_y, dim_z)
+    return np.fft.ifftn(dense) * (dim_x * dim_y * dim_z)
+
+
+def oracle_forward_c2c(triplets, space, scale=1.0):
+    """Forward DFT sampled at the sparse storage coords."""
+    dim_z, dim_y, dim_x = space.shape
+    freq = np.fft.fftn(space)
+    t = np.asarray(triplets).reshape(-1, 3)
+    xs = storage(t[:, 0], dim_x)
+    ys = storage(t[:, 1], dim_y)
+    zs = storage(t[:, 2], dim_z)
+    return freq[zs, ys, xs] * scale
+
+
+def assert_close(actual, expected, dtype=np.float64):
+    actual = np.asarray(actual)
+    expected = np.asarray(expected)
+    scale = max(1.0, float(np.abs(expected).max()) if expected.size else 1.0)
+    # Reference bar: ASSERT_NEAR(..., 1e-6) element-wise in double precision
+    # (tests/test_util/test_check_values.hpp:46-78); f32 gets a proportionally
+    # looser bar.
+    atol = 1e-6 * scale if np.dtype(dtype) == np.float64 else 1e-3 * scale
+    np.testing.assert_allclose(actual, expected, rtol=0, atol=atol)
