@@ -1,0 +1,93 @@
+"""Driver benchmark: prints ONE JSON line.
+
+Headline config: 256^3 C2C sparse 3D FFT, ~15% spherical frequency cutoff
+(BASELINE.json config 2 scaled to the driver's 256^3 metric), forward+backward
+wall-clock on the attached accelerator, reported as GFLOP/s using the standard
+5*N*log2(N) per-3D-transform flop model.
+
+Timing note: on the tunneled TPU platform ``block_until_ready`` does not wait for
+execution, so the loop chains R dependent roundtrips (forward output feeds the next
+backward — exact because FULL scaling makes the pair an identity) and forces
+completion with a scalar host fetch, dividing by R.
+
+vs_baseline compares against a dense np.fft (pocketfft) 3D FFT pair on the same grid
+measured in the same process — the sparse-accelerator-vs-dense-host-FFT comparison
+that motivates SpFFT, since the reference repo publishes no numbers (BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+CHAIN = 10
+
+
+def main():
+    import jax
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+
+    dim = 256
+    rng = np.random.default_rng(0)
+    triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)  # ~15% nnz
+    n = len(triplets)
+
+    t = sp.Transform(
+        ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+        indices=triplets, dtype=np.float32,
+    )
+    ex = t._exec
+    scale = 1.0 / dim**3
+
+    def roundtrip(re, im):
+        space_re, space_im = ex._backward_impl(re, im)
+        return ex._forward_impl(space_re, space_im, scale=scale)
+
+    step = jax.jit(roundtrip)
+
+    re = ex.put(rng.standard_normal(n).astype(np.float32))
+    im = ex.put(rng.standard_normal(n).astype(np.float32))
+
+    # warmup / compile
+    wre, wim = step(re, im)
+    float(wre[0])
+
+    t0 = time.perf_counter()
+    cre, cim = re, im
+    for _ in range(CHAIN):
+        cre, cim = step(cre, cim)
+    float(cre[0])  # forces the whole chain to complete
+    best = (time.perf_counter() - t0) / CHAIN
+
+    # chain correctness guard: FULL-scaled roundtrip is the identity
+    err = float(np.abs(np.asarray(cre[:64]) - np.asarray(re[:64])).max())
+    assert err < 1e-2, f"roundtrip chain diverged: {err}"
+
+    ntot = dim**3
+    flops = 2 * 5.0 * ntot * np.log2(ntot)  # fwd + bwd
+    gflops = flops / best / 1e9
+
+    # dense host FFT pair on the same grid (numpy pocketfft)
+    dense = (
+        rng.standard_normal((dim, dim, dim)) + 1j * rng.standard_normal((dim, dim, dim))
+    ).astype(np.complex64)
+    t0 = time.perf_counter()
+    np.fft.fftn(np.fft.ifftn(dense))
+    dense_time = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "c2c_256_sparse15pct_fwd_bwd_gflops",
+                "value": round(gflops, 2),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(dense_time / best, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

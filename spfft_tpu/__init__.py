@@ -31,8 +31,11 @@ from .errors import (  # noqa: F401
     MPISupportError,
     OverflowError_,
 )
+from .distributed import DistributedTransform  # noqa: F401
 from .grid import Grid  # noqa: F401
 from .indices import create_spherical_cutoff_triplets  # noqa: F401
+from .parallel import make_fft_mesh  # noqa: F401
+from .parameters import distribute_triplets  # noqa: F401
 from .transform import Transform, TransformFloat  # noqa: F401
 from .types import (  # noqa: F401
     ExchangeType,

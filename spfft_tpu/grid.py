@@ -123,14 +123,32 @@ class Grid:
         num_local_elements=None,
         indices=None,
         *,
-        local_z_length: int | None = None,
+        local_z_length=None,
         dtype=None,
     ):
         """Create a transform bound to this grid.
 
         Reference: include/spfft/grid.hpp:138-141 / transform ctor checks in
         src/spfft/transform_internal.cpp:45-137 (capacity validation against the grid).
+        Grids built with a mesh hand out distributed transforms (the reference's MPI
+        Grid ctor, include/spfft/grid.hpp:89-91).
         """
+        if self._mesh is not None:
+            from .distributed import DistributedTransform
+
+            return DistributedTransform(
+                processing_unit,
+                transform_type,
+                dim_x,
+                dim_y,
+                dim_z,
+                indices,
+                mesh=self._mesh,
+                local_z_lengths=local_z_length,
+                exchange_type=self._exchange_type,
+                grid=self,
+                dtype=dtype,
+            )
         from .transform import Transform
 
         return Transform(

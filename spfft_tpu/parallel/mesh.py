@@ -1,0 +1,23 @@
+"""Mesh helpers.
+
+The distributed transform runs over a 1-D mesh axis named ``"fft"`` — the analogue of
+the reference's MPI communicator (reference: src/mpi_util/mpi_communicator_handle.hpp).
+On a TPU pod slice the axis should ride ICI; on multi-host CPU it rides DCN. Callers
+with a larger model mesh can carve an ``"fft"`` sub-axis out of it and pass that.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+FFT_AXIS = "fft"
+
+
+def make_fft_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """Build a 1-D mesh over ``num_devices`` devices (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (FFT_AXIS,))

@@ -23,6 +23,82 @@ from .types import TransformType
 
 
 @dataclasses.dataclass(frozen=True)
+class DistributedParameters:
+    """Metadata for a mesh-distributed transform.
+
+    The analogue of the reference's MPI ``Parameters`` constructor
+    (reference: src/parameters/parameters.cpp:43-140): per-shard stick sets, slab
+    lengths/offsets, global stick tables (the reference allgathers these via
+    point-to-point exchange, src/compression/indices.hpp:58-102 — here the single
+    controller simply concatenates), plus the padded-uniform ("BUFFERED") exchange
+    geometry. All arrays are host numpy; sharded ones are stacked over axis 0.
+    """
+
+    transform_type: TransformType
+    dim_x: int
+    dim_y: int
+    dim_z: int
+    num_shards: int
+
+    # -- per-shard (axis 0 == shard) --
+    num_values_per_shard: np.ndarray  # (P,)
+    num_sticks_per_shard: np.ndarray  # (P,)
+    value_indices: np.ndarray  # (P, V_max) int32, padded with OOB sentinel
+    local_z_lengths: np.ndarray  # (P,)
+    z_offsets: np.ndarray  # (P,)
+
+    # -- global stick tables, identical on every shard --
+    stick_x_all: np.ndarray  # (P, S_max) int32, padded with dim_x_freq (OOB -> drop)
+    stick_y_all: np.ndarray  # (P, S_max) int32, padded with 0
+    stick_xy_per_shard: tuple  # tuple of per-shard unpadded xy key arrays
+
+    # -- zero-stick ownership (R2C stick symmetry) --
+    zero_stick_shard: int  # -1 if no (0,0) stick exists
+    zero_stick_row: int
+
+    @property
+    def dim_x_freq(self) -> int:
+        if self.transform_type == TransformType.R2C:
+            return self.dim_x // 2 + 1
+        return self.dim_x
+
+    @property
+    def max_num_sticks(self) -> int:
+        return int(self.stick_x_all.shape[1])
+
+    @property
+    def max_num_values(self) -> int:
+        return int(self.value_indices.shape[1])
+
+    @property
+    def max_local_z_length(self) -> int:
+        return int(self.local_z_lengths.max()) if self.num_shards else 0
+
+    @property
+    def total_size(self) -> int:
+        return self.dim_x * self.dim_y * self.dim_z
+
+    def pack_z_map(self) -> np.ndarray:
+        """(P * L_max,) map from packed exchange-plane slot to global z index, with
+        out-of-range sentinel (dim_z) on padding slots (take -> fill 0)."""
+        L = self.max_local_z_length
+        out = np.full(self.num_shards * L, self.dim_z, dtype=np.int32)
+        for r in range(self.num_shards):
+            l, o = int(self.local_z_lengths[r]), int(self.z_offsets[r])
+            out[r * L : r * L + l] = np.arange(o, o + l)
+        return out
+
+    def unpack_z_map(self) -> np.ndarray:
+        """(dim_z,) map from global z index to packed exchange-plane slot."""
+        L = self.max_local_z_length
+        out = np.zeros(self.dim_z, dtype=np.int32)
+        for r in range(self.num_shards):
+            l, o = int(self.local_z_lengths[r]), int(self.z_offsets[r])
+            out[o : o + l] = r * L + np.arange(l)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class LocalParameters:
     """Metadata for a single-device transform."""
 
@@ -58,6 +134,127 @@ class LocalParameters:
     @property
     def total_size(self) -> int:
         return self.dim_x * self.dim_y * self.dim_z
+
+
+def make_distributed_parameters(
+    transform_type: TransformType,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    indices_per_shard: Sequence[np.ndarray],
+    local_z_lengths: Sequence[int] | None = None,
+) -> DistributedParameters:
+    """Build distributed metadata from per-shard index triplets.
+
+    ``indices_per_shard[r]`` are the triplets whose values shard r owns (whole
+    z-sticks per shard, validated). ``local_z_lengths`` gives the slab split; default
+    is the balanced split ceil/floor split of dim_z (the reference leaves the split to
+    the caller; SIRIUS-style callers use near-uniform slabs).
+
+    Performs the reference's collective validation steps single-controller-side:
+    cross-shard stick duplicate detection (reference: src/compression/indices.hpp:105-117)
+    and global count checks (reference: src/parameters/parameters.cpp:93-109).
+    """
+    if dim_x <= 0 or dim_y <= 0 or dim_z <= 0:
+        raise InvalidParameterError("transform dimensions must be positive")
+    num_shards = len(indices_per_shard)
+    if num_shards < 1:
+        raise InvalidParameterError("need at least one shard")
+
+    hermitian = TransformType(transform_type) == TransformType.R2C
+    per_shard = [
+        _indices.convert_index_triplets(hermitian, dim_x, dim_y, dim_z, trip)
+        for trip in indices_per_shard
+    ]
+    stick_xy_per_shard = tuple(sticks for _, sticks in per_shard)
+    _indices.check_stick_duplicates(stick_xy_per_shard)
+
+    if local_z_lengths is None:
+        base, rem = divmod(dim_z, num_shards)
+        local_z_lengths = np.asarray(
+            [base + (1 if r < rem else 0) for r in range(num_shards)], dtype=np.int64
+        )
+    else:
+        local_z_lengths = np.asarray(local_z_lengths, dtype=np.int64)
+        if local_z_lengths.size != num_shards:
+            raise MPIParameterMismatchError("one local_z_length per shard required")
+        if local_z_lengths.sum() != dim_z or (local_z_lengths < 0).any():
+            raise MPIParameterMismatchError("local_z_lengths must partition dim_z")
+    z_offsets = np.concatenate([[0], np.cumsum(local_z_lengths)[:-1]])
+
+    num_values = np.asarray([vi.size for vi, _ in per_shard], dtype=np.int64)
+    num_sticks = np.asarray([s.size for _, s in per_shard], dtype=np.int64)
+    s_max = max(1, int(num_sticks.max()))
+    v_max = max(1, int(num_values.max()))
+
+    dim_x_freq = dim_x // 2 + 1 if hermitian else dim_x
+    oob_value = s_max * dim_z  # past the padded stick array -> dropped/filled
+    value_indices = np.full((num_shards, v_max), oob_value, dtype=np.int32)
+    stick_x_all = np.full((num_shards, s_max), dim_x_freq, dtype=np.int32)
+    stick_y_all = np.zeros((num_shards, s_max), dtype=np.int32)
+    zero_stick_shard, zero_stick_row = -1, 0
+    for r, (vi, sticks) in enumerate(per_shard):
+        value_indices[r, : vi.size] = vi
+        stick_x_all[r, : sticks.size] = sticks // dim_y
+        stick_y_all[r, : sticks.size] = sticks % dim_y
+        if sticks.size and int(sticks[0]) == 0:
+            zero_stick_shard, zero_stick_row = r, 0
+
+    return DistributedParameters(
+        transform_type=TransformType(transform_type),
+        dim_x=int(dim_x),
+        dim_y=int(dim_y),
+        dim_z=int(dim_z),
+        num_shards=num_shards,
+        num_values_per_shard=num_values,
+        num_sticks_per_shard=num_sticks,
+        value_indices=value_indices,
+        local_z_lengths=local_z_lengths,
+        z_offsets=z_offsets,
+        stick_x_all=stick_x_all,
+        stick_y_all=stick_y_all,
+        stick_xy_per_shard=stick_xy_per_shard,
+        zero_stick_shard=zero_stick_shard,
+        zero_stick_row=zero_stick_row,
+    )
+
+
+def distribute_triplets(
+    triplets: np.ndarray,
+    num_shards: int,
+    dim_y: int,
+    weights: Sequence[float] | None = None,
+) -> list[np.ndarray]:
+    """Partition global triplets into per-shard lists, keeping z-sticks whole
+    (the hard constraint, reference: docs/source/details.rst:50-53) and balancing
+    value counts across shards (optionally by weight, mirroring the reference tests'
+    ``zStickDistribution`` weight vectors, tests/test_util/generate_indices.hpp:39-100).
+    """
+    t = np.asarray(triplets).reshape(-1, 3)
+    if num_shards < 1:
+        raise InvalidParameterError("num_shards must be >= 1")
+    # Group values by stick (x, y) identity in *caller* index space (sign-sensitive
+    # keys map to the same storage stick after conversion).
+    keys = t[:, 0] * (4 * dim_y) + t[:, 1]  # sign-safe composite key
+    uniq, inverse, counts = np.unique(keys, return_inverse=True, return_counts=True)
+    order = np.argsort(-counts)  # largest sticks first
+    if weights is None:
+        weights = np.ones(num_shards)
+    weights = np.asarray(weights, dtype=np.float64)
+    if weights.size != num_shards or (weights < 0).any() or weights.sum() == 0:
+        raise InvalidParameterError("invalid shard weights")
+    load = np.zeros(num_shards)
+    stick_shard = np.zeros(uniq.size, dtype=np.int64)
+    for s in order:
+        # zero-weight shards receive nothing (reference parity: a zero entry in the
+        # zStickDistribution weight vector draws no sticks,
+        # tests/test_util/generate_indices.hpp:39-100)
+        ratio = np.where(weights > 0, load / np.maximum(weights, 1e-300), np.inf)
+        r = int(np.argmin(ratio))
+        stick_shard[s] = r
+        load[r] += counts[s]
+    value_shard = stick_shard[inverse]
+    return [t[value_shard == r] for r in range(num_shards)]
 
 
 def make_local_parameters(

@@ -3,19 +3,16 @@
 Forces JAX onto a virtual 8-device CPU platform so distributed (mesh) paths are
 exercised without TPU pod hardware — the analogue of the reference testing its MPI
 paths under plain ``mpirun -n 2`` on a single CI VM
-(reference: .github/workflows/ci.yml:80-84). Must run before jax is imported.
+(reference: .github/workflows/ci.yml:80-84).
+
+jax is already imported at interpreter startup in this environment (a site .pth
+hook), so the platform is selected via jax.config (valid until first backend use)
+rather than env vars.
 """
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 # Double precision is the reference's default precision; tests compare against the
 # dense oracle at the reference's 1e-6 bar (tests/test_util/test_check_values.hpp:46-78).
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,297 @@
+"""Distributed transform tests on the virtual 8-device CPU mesh.
+
+Parity with reference tests/mpi_tests/test_transform.cpp: exchange-type sweep,
+distribution edge cases (uniform, all-sticks-on-one-shard, sticks on one shard with
+planes on another), centered indexing, R2C, run-twice zeroing, and the float-wire
+exchange for f64.
+"""
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    Grid,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
+from spfft_tpu.parameters import distribute_triplets
+from utils import (
+    assert_close,
+    oracle_backward_c2c,
+    oracle_forward_c2c,
+    random_sparse_triplets,
+    storage,
+)
+
+
+def make_mesh(n):
+    return sp.make_fft_mesh(n)
+
+
+def split_values(triplets_per_shard, full_triplets, full_values):
+    """Look up each shard's values from a global (triplet -> value) map."""
+    lut = {tuple(t): v for t, v in zip(map(tuple, full_triplets), full_values)}
+    return [np.asarray([lut[tuple(t)] for t in trip]) for trip in triplets_per_shard]
+
+
+@pytest.mark.parametrize("num_shards", [2, 4, 8])
+@pytest.mark.parametrize(
+    "exchange",
+    [ExchangeType.BUFFERED, ExchangeType.COMPACT_BUFFERED, ExchangeType.UNBUFFERED],
+)
+def test_distributed_c2c_backward_forward(num_shards, exchange):
+    rng = np.random.default_rng(42)
+    dims = (12, 11, 13)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+    per_shard = distribute_triplets(triplets, num_shards, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_mesh(num_shards),
+        exchange_type=exchange,
+    )
+    out = t.backward(values_per_shard)
+    expected = oracle_backward_c2c(triplets, values, dx, dy, dz)
+    assert_close(out, expected)
+
+    # run twice (zeroing check, reference: tests/test_util/test_transform.hpp:129-131)
+    assert_close(t.backward(values_per_shard), expected)
+
+    # forward roundtrip with scaling
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(values_per_shard):
+        assert_close(back[r], vals)
+
+
+def test_all_sticks_on_one_shard():
+    """Edge case from reference tests/mpi_tests/test_transform.cpp:38-127."""
+    rng = np.random.default_rng(1)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.4)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+    per_shard = [triplets] + [np.zeros((0, 3), dtype=np.int64)] * 3
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, per_shard, mesh=make_mesh(4)
+    )
+    out = t.backward([values] + [np.zeros(0)] * 3)
+    assert_close(out, oracle_backward_c2c(triplets, values, dx, dy, dz))
+
+
+def test_sticks_on_one_planes_on_other():
+    """Sticks on shard 0, all xy-planes on shard 1 (zero-length slabs elsewhere)."""
+    rng = np.random.default_rng(2)
+    dims = (6, 6, 6)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+    per_shard = [triplets, np.zeros((0, 3), dtype=np.int64)]
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_mesh(2),
+        local_z_lengths=[0, dz],
+    )
+    out = t.backward([values, np.zeros(0)])
+    assert_close(out, oracle_backward_c2c(triplets, values, dx, dy, dz))
+    assert t.local_z_length(0) == 0 and t.local_z_length(1) == dz
+    assert t.local_z_offset(1) == 0 + 0  # offset after zero-length slab
+
+
+def test_uneven_plane_distribution():
+    rng = np.random.default_rng(3)
+    dims = (8, 8, 13)  # 13 planes over 4 shards -> 4,3,3,3
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(triplets, 4, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, per_shard, mesh=make_mesh(4)
+    )
+    assert [t.local_z_length(r) for r in range(4)] == [4, 3, 3, 3]
+    out = t.backward(values_per_shard)
+    assert_close(out, oracle_backward_c2c(triplets, values, dx, dy, dz))
+
+    space = rng.standard_normal((dz, dy, dx)) + 1j * rng.standard_normal((dz, dy, dx))
+    got = t.forward(space)
+    for r, trip in enumerate(per_shard):
+        assert_close(got[r], oracle_forward_c2c(trip, space))
+
+
+def test_distributed_centered_indices():
+    rng = np.random.default_rng(4)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5, centered=True)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(triplets, 4, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, per_shard, mesh=make_mesh(4)
+    )
+    out = t.backward(values_per_shard)
+    assert_close(out, oracle_backward_c2c(triplets, values, dx, dy, dz))
+
+
+def test_distributed_r2c():
+    rng = np.random.default_rng(5)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+
+    # full half-spectrum split over shards
+    xs = np.arange(dx // 2 + 1)
+    trip = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    per_shard = distribute_triplets(trip, 4, dy)
+    values_per_shard = [
+        freq[t_[:, 2], t_[:, 1], t_[:, 0]] for t_ in per_shard
+    ]
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz, per_shard, mesh=make_mesh(4)
+    )
+    out = t.backward(values_per_shard)
+    assert out.dtype == np.float64
+    assert_close(out, r)
+
+    back = t.forward(scaling=ScalingType.FULL)
+    for r_, vals in enumerate(values_per_shard):
+        assert_close(back[r_], vals)
+
+
+def test_distributed_r2c_redundant_omitted():
+    """Non-redundant input only; stick+plane symmetry must complete across shards,
+    including when the (0,0) stick sits on a nonzero shard."""
+    rng = np.random.default_rng(6)
+    dims = (6, 6, 6)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+
+    out_triplets = []
+    for x in range(dx // 2 + 1):
+        for y in range(dy):
+            if x == 0 and y > dy // 2:
+                continue
+            for z in range(dz):
+                if x == 0 and y == 0 and z > dz // 2:
+                    continue
+                out_triplets.append((x, y, z))
+    trip = np.asarray(out_triplets)
+
+    # put the (0,0) stick deliberately on shard 1
+    zero_stick = trip[(trip[:, 0] == 0) & (trip[:, 1] == 0)]
+    rest = trip[~((trip[:, 0] == 0) & (trip[:, 1] == 0))]
+    rest_split = distribute_triplets(rest, 2, dy)
+    per_shard = [rest_split[0], np.concatenate([rest_split[1], zero_stick])]
+    values_per_shard = [freq[t_[:, 2], t_[:, 1], t_[:, 0]] for t_ in per_shard]
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz, per_shard, mesh=make_mesh(2)
+    )
+    out = t.backward(values_per_shard)
+    assert_close(out, r)
+
+
+def test_float_wire_exchange():
+    """BUFFERED_FLOAT: f64 transform with complex64 wire payload — slight accuracy
+    loss allowed (reference: include/spfft/types.h:42-47)."""
+    rng = np.random.default_rng(7)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(triplets, 4, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_mesh(4),
+        exchange_type=ExchangeType.BUFFERED_FLOAT,
+    )
+    out = t.backward(values_per_shard)
+    expected = oracle_backward_c2c(triplets, values, dx, dy, dz)
+    scale = np.abs(expected).max()
+    np.testing.assert_allclose(out, expected, rtol=0, atol=1e-4 * scale)
+
+
+def test_grid_with_mesh_creates_distributed():
+    rng = np.random.default_rng(8)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.3)
+    mesh = make_mesh(4)
+    grid = Grid(dx, dy, dz, 64, ProcessingUnit.HOST, mesh=mesh)
+    t = grid.create_transform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=triplets
+    )
+    assert isinstance(t, DistributedTransform)
+    assert t.num_shards == 4
+    assert t.num_global_elements == len(triplets)
+
+
+def test_duplicate_stick_across_shards_rejected():
+    from spfft_tpu import DuplicateIndicesError
+
+    per_shard = [np.asarray([(1, 1, 0)]), np.asarray([(1, 1, 1)])]
+    with pytest.raises(DuplicateIndicesError):
+        DistributedTransform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            4,
+            4,
+            4,
+            per_shard,
+            mesh=make_mesh(2),
+        )
+
+
+def test_mesh_size_mismatch_rejected():
+    from spfft_tpu import MPIParameterMismatchError
+
+    per_shard = [np.asarray([(0, 0, 0)])]
+    with pytest.raises(MPIParameterMismatchError):
+        DistributedTransform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            4,
+            4,
+            4,
+            per_shard,
+            mesh=make_mesh(2),
+        )
