@@ -39,11 +39,10 @@ def main():
         indices=triplets, dtype=np.float32,
     )
     ex = t._exec
-    scale = 1.0 / dim**3
 
     def roundtrip(re, im):
-        space_re, space_im = ex._backward_impl(re, im)
-        return ex._forward_impl(space_re, space_im, scale=scale)
+        space_re, space_im = ex.backward_pair(re, im)
+        return ex.forward_pair(space_re, space_im, ScalingType.FULL)
 
     step = jax.jit(roundtrip)
 

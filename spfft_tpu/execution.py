@@ -53,7 +53,29 @@ def from_pair(pair):
     return re + 1j * im
 
 
-class LocalExecution:
+class ExecutionBase:
+    """Shared boundary state/helpers for the single-device engines (this XLA engine
+    and execution_mxu.MxuLocalExecution)."""
+
+    def __init__(self, params: LocalParameters, real_dtype, device=None):
+        self.params = params
+        self.real_dtype = np.dtype(real_dtype)
+        self.complex_dtype = _complex_dtype(real_dtype)
+        self.device = device
+        # Sorted stick keys => a (0,0) stick, if present, is always row 0.
+        self._zero_stick_id = (
+            0 if (params.num_sticks > 0 and int(params.stick_xy_indices[0]) == 0) else None
+        )
+
+    @property
+    def is_r2c(self) -> bool:
+        return self.params.transform_type == TransformType.R2C
+
+    def put(self, array):
+        return jax.device_put(array, self.device)
+
+
+class LocalExecution(ExecutionBase):
     """Single-device execution engine for one transform plan.
 
     Holds index constants and the two jitted pipelines. Separate compiled variants
@@ -62,11 +84,7 @@ class LocalExecution:
     """
 
     def __init__(self, params: LocalParameters, real_dtype=np.float64, device=None):
-        self.params = params
-        self.real_dtype = np.dtype(real_dtype)
-        self.complex_dtype = _complex_dtype(real_dtype)
-        self.device = device
-
+        super().__init__(params, real_dtype, device)
         p = params
         # Index constants stay as numpy: jit embeds them as program constants,
         # avoiding any host<->device traffic at call time (the analogue of
@@ -74,10 +92,6 @@ class LocalExecution:
         self._value_indices = np.asarray(p.value_indices, dtype=np.int32)
         self._stick_x = np.asarray(p.stick_x, dtype=np.int32)
         self._stick_y = np.asarray(p.stick_y, dtype=np.int32)
-        # Sorted stick keys => a (0,0) stick, if present, is always row 0.
-        self._zero_stick_id = (
-            0 if (p.num_sticks > 0 and int(p.stick_xy_indices[0]) == 0) else None
-        )
 
         self._backward = jax.jit(self._backward_impl)
         self._forward = {
@@ -86,10 +100,6 @@ class LocalExecution:
                 functools.partial(self._forward_impl, scale=1.0 / p.total_size)
             ),
         }
-
-    @property
-    def is_r2c(self) -> bool:
-        return self.params.transform_type == TransformType.R2C
 
     # ---- pipelines (traced; complex internal, real pairs at the boundary) -----
 
@@ -155,9 +165,6 @@ class LocalExecution:
         return self._forward[ScalingType(scaling)](space_re, space_im)
 
     # ---- host-facing entry points ---------------------------------------------
-
-    def put(self, array):
-        return jax.device_put(array, self.device)
 
     def backward(self, values):
         """freq (num_values,) complex -> space (dim_z, dim_y, dim_x)."""

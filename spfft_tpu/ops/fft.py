@@ -1,0 +1,100 @@
+"""DFT stages for the MXU engine.
+
+The reference computes its 1D FFT batches with FFTW/cuFFT plans
+(reference: src/fft/transform_1d_host.hpp:50-235, src/fft/transform_1d_gpu.hpp,
+src/fft/transform_2d_gpu.hpp). On TPU the systolic array (MXU) turns a batched
+length-N DFT into a single (batch, N) @ (N, N) matmul — O(N^2) flops instead of
+O(N log N), but at 1-2 orders of magnitude higher flop rate than XLA's generic FFT,
+a net win for the N <= ~1024 extents plane-wave grids use. Two further MXU-only
+tricks this module exploits:
+
+* **permutation folding**: any static permutation / padding of the input axis can be
+  folded into the DFT matrix rows for free (the ``row_perm``/``num_rows`` hook on
+  :func:`c2c_matrix` — the designed fusion point for the distributed exchange unpack,
+  the analogue of the reference's unpack kernels,
+  reference: src/transpose/gpu_kernels/buffered_kernels.cu),
+* **scale folding**: the forward 1/(NxNyNz) scaling rides the matrix constants
+  (reference applies it in the compress loop, src/compression/compression_host.hpp:63).
+
+Complex data is carried as (re, im) pairs of real arrays; each complex DFT is 4 real
+matmuls (R2C/C2R: 2), issued with HIGHEST precision so f32 accuracy stays ~1e-6
+(TPU default matmul precision is bf16, ~2e-3 — not acceptable here).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def c2c_matrix(n: int, sign: int, scale: float = 1.0, row_perm=None, num_rows=None):
+    """(rows, n) DFT matrix W[j, k] = scale * exp(sign * 2i pi p(j) k / n).
+
+    ``row_perm`` (optional) maps matrix row j to logical input index p(j); entries
+    < 0 produce zero rows (padding slots). This is the permutation-folding hook.
+    """
+    if row_perm is None:
+        row_perm = np.arange(n)
+    row_perm = np.asarray(row_perm, dtype=np.int64)
+    if num_rows is not None and num_rows != row_perm.size:
+        if num_rows < row_perm.size:
+            raise ValueError("num_rows smaller than row_perm")
+        row_perm = np.concatenate(
+            [row_perm, np.full(num_rows - row_perm.size, -1, dtype=np.int64)]
+        )
+    k = np.arange(n)
+    w = scale * np.exp(sign * 2j * np.pi * np.outer(row_perm, k) / n)
+    w[row_perm < 0] = 0.0
+    return w
+
+
+def r2c_matrices(n: int, scale: float = 1.0):
+    """Real matrix pair (A, B) for the forward R2C x-stage: F = f@A + i f@B,
+    F[k] = scale * sum_l f[l] exp(-2i pi k l / n), k in [0, n//2]."""
+    nf = n // 2 + 1
+    l, k = np.arange(n), np.arange(nf)
+    theta = 2 * np.pi * np.outer(l, k) / n
+    return scale * np.cos(theta), -scale * np.sin(theta)
+
+
+def c2r_matrices(n: int, scale: float = 1.0):
+    """Real matrix pair (A, B) for the backward C2R x-stage:
+    f = Fr@A - Fi@B, the unnormalized inverse of the half spectrum with hermitian
+    weights c_k (1 for k=0 and the even-n Nyquist bin, else 2)."""
+    nf = n // 2 + 1
+    k, l = np.arange(nf), np.arange(n)
+    c = np.full(nf, 2.0)
+    c[0] = 1.0
+    if n % 2 == 0:
+        c[-1] = 1.0
+    theta = 2 * np.pi * np.outer(k, l) / n
+    return scale * (c[:, None] * np.cos(theta)), scale * (c[:, None] * np.sin(theta))
+
+
+def complex_matmul(xr, xi, wr, wi, spec: str):
+    """(xr + i xi) contracted with (wr + i wi) via einsum ``spec``; 4 real matmuls."""
+    yr = jnp.einsum(spec, xr, wr, precision=_PRECISION) - jnp.einsum(
+        spec, xi, wi, precision=_PRECISION
+    )
+    yi = jnp.einsum(spec, xr, wi, precision=_PRECISION) + jnp.einsum(
+        spec, xi, wr, precision=_PRECISION
+    )
+    return yr, yi
+
+
+def real_in_matmul(x, wr, wi, spec: str):
+    """Real input x contracted with complex matrix: 2 real matmuls."""
+    return (
+        jnp.einsum(spec, x, wr, precision=_PRECISION),
+        jnp.einsum(spec, x, wi, precision=_PRECISION),
+    )
+
+
+def real_out_matmul(xr, xi, a, b, spec: str):
+    """Real output xr@A - xi@B (the C2R stage): 2 real matmuls."""
+    return jnp.einsum(spec, xr, a, precision=_PRECISION) - jnp.einsum(
+        spec, xi, b, precision=_PRECISION
+    )

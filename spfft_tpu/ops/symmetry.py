@@ -52,6 +52,26 @@ def hermitian_fill_1d(a, axis: int):
     return a
 
 
+def hermitian_fill_1d_pair(re, im, axis: int):
+    """Pair-form (re, im) variant of :func:`hermitian_fill_1d` for engines that keep
+    complex data as two real arrays (conj == negate imag; nonzero == either part)."""
+    n = re.shape[axis]
+    if n <= 1:
+        return re, im
+    shape = [1] * re.ndim
+    shape[axis] = n
+    j = jnp.arange(n).reshape(shape)
+    upper_targets = j >= (n - n // 2)
+    lower_targets = (j >= 1) & (j < (n - n // 2))
+
+    for targets in (upper_targets, lower_targets):
+        mre, mim = _mirror(re, axis), _mirror(im, axis)
+        write = targets & ((mre != 0) | (mim != 0))
+        re = jnp.where(write, mre, re)
+        im = jnp.where(write, -mim, im)
+    return re, im
+
+
 def apply_stick_symmetry(sticks, zero_stick_id: int | None):
     """Complete the (0,0) z-stick along z, in the frequency domain before the z-FFT.
 

@@ -45,6 +45,7 @@ class Transform:
         index_format: IndexFormat = IndexFormat.TRIPLETS,
         grid: Grid | None = None,
         dtype=None,
+        engine: str = "auto",
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -87,7 +88,22 @@ class Transform:
             raise InvalidParameterError("dtype must be float32 or float64")
 
         device = device_for_processing_unit(self._processing_unit)
-        self._exec = LocalExecution(self._params, self._real_dtype, device=device)
+        # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
+        # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
+        # execution.py) wins on CPU where pocketfft is the fast path.
+        if engine == "auto":
+            engine = "xla" if device.platform == "cpu" else "mxu"
+        if engine == "mxu":
+            from .execution_mxu import MxuLocalExecution
+
+            self._exec = MxuLocalExecution(self._params, self._real_dtype, device=device)
+            self._native_transposed = True
+        elif engine == "xla":
+            self._exec = LocalExecution(self._params, self._real_dtype, device=device)
+            self._native_transposed = False
+        else:
+            raise InvalidParameterError(f"unknown engine {engine!r}")
+        self._engine = engine
         self._space_data = None
 
     # ---- transforms -----------------------------------------------------------
@@ -100,6 +116,8 @@ class Transform:
         (device-resident) for :meth:`space_domain_data` / input-less :meth:`forward`,
         mirroring the reference's internal space-domain buffer.
         """
+        from .execution import as_pair
+
         if output_location is not None:
             _validate_pu(output_location)
         values = np.asarray(values)
@@ -108,15 +126,24 @@ class Transform:
                 f"expected {self._params.num_values} frequency values, got {values.size}"
             )
         values = values.reshape(self._params.num_values)
-        out = self._exec.backward(values)
+        re, im = as_pair(values, self._real_dtype)
+        out = self._exec.backward_pair(self._exec.put(re), self._exec.put(im))
         if self._exec_mode == ExecType.SYNCHRONOUS:
             jax.block_until_ready(out)
-        self._space_data = out  # (re, im) device pair for C2C, real device array for R2C
+        self._space_data = out  # engine-native layout; pair for C2C, real for R2C
         return self._combine_space(out)
 
     def backward_pair(self, values_re, values_im):
         """Device-side backward: (re, im) freq pair in, device-resident space out
-        ((re, im) pair for C2C, real array for R2C). No host transfers."""
+        ((re, im) pair for C2C, real array for R2C). No host transfers.
+
+        The space array uses the *engine-native* axis order given by
+        :attr:`space_domain_layout` — ``(Z, Y, X)`` for the XLA engine, ``(Y, X, Z)``
+        for the MXU engine. This mirrors the reference, whose GPU backend likewise
+        keeps device-resident space data in a transposed layout while host-facing
+        calls translate (reference: docs/source/details.rst:55-59). Host-facing
+        :meth:`backward` / :meth:`space_domain_data` always return ``(Z, Y, X)``.
+        """
         out = self._exec.backward_pair(values_re, values_im)
         self._space_data = out
         return out
@@ -150,6 +177,8 @@ class Transform:
                 pair = self._exec.forward_pair(re, im, ScalingType(scaling))
         else:
             space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
+            if self._native_transposed:
+                space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
             if self._is_r2c:
                 space_re = self._exec.put(
                     np.ascontiguousarray(space.real, dtype=self._real_dtype)
@@ -176,15 +205,23 @@ class Transform:
         return self._exec.forward_pair(re, im, ScalingType(scaling))
 
     @property
+    def space_domain_layout(self) -> str:
+        """Axis order of *device-side* space-domain arrays (backward_pair output /
+        forward_pair retained input): ``"zyx"`` or ``"yxz"`` (MXU engine).
+        Host-facing methods always use ``(dim_z, dim_y, dim_x)``."""
+        return "yxz" if self._native_transposed else "zyx"
+
+    @property
     def _is_r2c(self) -> bool:
         return self._params.transform_type == TransformType.R2C
 
     def _combine_space(self, out):
         from .execution import from_pair
 
-        if self._is_r2c:
-            return np.asarray(out)
-        return from_pair(out)
+        arr = np.asarray(out) if self._is_r2c else from_pair(out)
+        if self._native_transposed:
+            arr = arr.transpose(2, 0, 1)  # native (Y,X,Z) -> public (Z,Y,X)
+        return arr
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
         """The most recent space-domain result (reference: transform.hpp:245)."""
@@ -209,6 +246,7 @@ class Transform:
             indices=triplets,
             grid=self._grid,
             dtype=self._real_dtype,
+            engine=self._engine,
         )
 
     # ---- accessors, parity with include/spfft/transform.hpp:147-245 -----------

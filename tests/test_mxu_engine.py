@@ -1,0 +1,176 @@
+"""MXU engine correctness vs the dense oracle (runs on CPU; same math as TPU)."""
+import numpy as np
+import pytest
+
+from spfft_tpu.execution_mxu import MxuLocalExecution
+from spfft_tpu.ops.lanecopy import CopyPlan, build_compress_plan, build_decompress_plan
+from spfft_tpu.parameters import make_local_parameters
+from spfft_tpu.types import ScalingType, TransformType
+from utils import assert_close, oracle_backward_c2c, oracle_forward_c2c, random_sparse_triplets
+
+DIMS = [(4, 5, 6), (11, 12, 13), (16, 16, 16)]
+
+
+def sorted_triplets(trip, dims):
+    """Stick-major, z-ascending caller order (the lanecopy fast path)."""
+    dx, dy, dz = dims
+    t = np.asarray(trip)
+    xs = np.where(t[:, 0] < 0, t[:, 0] + dx, t[:, 0])
+    ys = np.where(t[:, 1] < 0, t[:, 1] + dy, t[:, 1])
+    zs = np.where(t[:, 2] < 0, t[:, 2] + dz, t[:, 2])
+    return t[np.lexsort((zs, xs * dy + ys))]
+
+
+@pytest.mark.parametrize("dims", DIMS)
+@pytest.mark.parametrize("order", ["sorted", "random"])
+def test_mxu_c2c_backward_forward(dims, order):
+    rng = np.random.default_rng(31)
+    dx, dy, dz = dims
+    # whole sticks for the sorted fast path (<=2 affine runs per block); ragged
+    # z-fill + shuffle for the general fallback path
+    if order == "sorted":
+        trip = sorted_triplets(random_sparse_triplets(rng, dx, dy, dz, 0.5, 1.0), dims)
+    else:
+        trip = random_sparse_triplets(rng, dx, dy, dz, 0.5, 0.8)
+        rng.shuffle(trip)
+    params = make_local_parameters(TransformType.C2C, dx, dy, dz, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float64)
+    if order == "sorted":
+        assert ex._decompress_plan is not None, "sorted order must hit the fast path"
+
+    n = params.num_values
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    out = ex.backward(values)  # host API returns (Z, Y, X)
+    expected = oracle_backward_c2c(trip, values, dx, dy, dz)
+    assert_close(out, expected)
+    assert_close(ex.backward(values), expected)  # run twice
+
+    space = rng.standard_normal((dz, dy, dx)) + 1j * rng.standard_normal((dz, dy, dx))
+    got = ex.forward(space)
+    assert_close(got[0] + 1j * got[1], oracle_forward_c2c(trip, space))
+    got = ex.forward(space, ScalingType.FULL)
+    assert_close(
+        got[0] + 1j * got[1], oracle_forward_c2c(trip, space, scale=1.0 / (dx * dy * dz))
+    )
+
+
+@pytest.mark.parametrize("dims", DIMS)
+def test_mxu_r2c_roundtrip(dims):
+    rng = np.random.default_rng(32)
+    dx, dy, dz = dims
+    xs = np.arange(dx // 2 + 1)
+    trip = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    params = make_local_parameters(TransformType.R2C, dx, dy, dz, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float64)
+
+    r = rng.standard_normal((dz, dy, dx))
+    vre, vim = ex.forward(r, ScalingType.FULL)
+    out = ex.backward(np.asarray(vre) + 1j * np.asarray(vim))
+    assert out.dtype == np.float64
+    assert_close(out, r)
+
+
+def test_mxu_r2c_redundant_omitted():
+    rng = np.random.default_rng(33)
+    dx, dy, dz = 6, 6, 6
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+    trip = []
+    for x in range(dx // 2 + 1):
+        for y in range(dy):
+            if x == 0 and y > dy // 2:
+                continue
+            for z in range(dz):
+                if x == 0 and y == 0 and z > dz // 2:
+                    continue
+                trip.append((x, y, z))
+    trip = np.asarray(trip)
+    params = make_local_parameters(TransformType.R2C, dx, dy, dz, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float64)
+    values = freq[trip[:, 2], trip[:, 1], trip[:, 0]]
+    assert_close(ex.backward(values), r)
+
+
+def test_mxu_f32_precision():
+    """HIGHEST-precision matmul DFT must hold ~1e-5 relative in f32."""
+    rng = np.random.default_rng(34)
+    dims = (32, 32, 32)
+    dx, dy, dz = dims
+    trip = sorted_triplets(random_sparse_triplets(rng, dx, dy, dz, 0.5), dims)
+    params = make_local_parameters(TransformType.C2C, dx, dy, dz, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float32)
+    n = params.num_values
+    values = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(np.complex64)
+    out = ex.backward(values)
+    expected = oracle_backward_c2c(trip, values, dx, dy, dz)
+    scale = np.abs(expected).max()
+    np.testing.assert_allclose(out, expected, rtol=0, atol=3e-5 * scale)
+
+
+# ---- lanecopy unit tests -------------------------------------------------------
+
+
+def test_copyplan_identity_and_holes():
+    rng = np.random.default_rng(35)
+    n = 1000
+    # dst = src shifted by 7 with holes every 13th slot
+    src_of_dst = np.arange(n) - 7
+    src_of_dst[src_of_dst < 0] = -1
+    src_of_dst[::13] = -1
+    plan = CopyPlan.build(src_of_dst, n)
+    assert plan is not None
+    vals = rng.standard_normal(n)
+    import jax.numpy as jnp
+
+    out = np.asarray(plan.apply(jnp.asarray(vals))).reshape(-1)[: n]
+    want = np.where(src_of_dst >= 0, vals[np.maximum(src_of_dst, 0)], 0.0)
+    np.testing.assert_allclose(out, want, atol=0)
+
+
+def test_copyplan_fragmented_returns_none():
+    rng = np.random.default_rng(36)
+    n = 512
+    src_of_dst = rng.permutation(n)  # fully random: ~128 runs per block
+    assert CopyPlan.build(src_of_dst, n) is None
+
+
+def test_copyplan_round_trip_through_plans():
+    """decompress plan then compress plan reproduces the packed values."""
+    rng = np.random.default_rng(37)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    trip = sorted_triplets(random_sparse_triplets(rng, dx, dy, dz, 0.6, 1.0), dims)
+    params = make_local_parameters(TransformType.C2C, dx, dy, dz, trip)
+    n, S = params.num_values, params.num_sticks
+    dplan = build_decompress_plan(params.value_indices, S * dz, n)
+    cplan = build_compress_plan(params.value_indices, S * dz)
+    assert dplan is not None and cplan is not None
+    import jax.numpy as jnp
+
+    vals = rng.standard_normal(n)
+    slots = np.asarray(dplan.apply(jnp.asarray(vals))).reshape(-1)[: S * dz]
+    back = np.asarray(cplan.apply(jnp.asarray(slots))).reshape(-1)[:n]
+    np.testing.assert_allclose(back, vals, atol=0)
+
+
+def test_transform_engine_mxu_parity():
+    """Transform(engine='mxu') matches engine='xla' through the public API."""
+    from spfft_tpu import ProcessingUnit, Transform
+
+    rng = np.random.default_rng(38)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5, centered=True)
+    n = len(trip)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+    tm = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip, engine="mxu")
+    tx = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip, engine="xla")
+    assert_close(tm.backward(values), tx.backward(values))
+    assert_close(tm.forward(scaling=ScalingType.FULL), tx.forward(scaling=ScalingType.FULL))
+    assert_close(tm.space_domain_data(), tx.space_domain_data())
+    c = tm.clone()
+    assert c._engine == "mxu"
+    assert_close(c.backward(values), tx.backward(values))
