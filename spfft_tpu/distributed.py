@@ -12,6 +12,7 @@ import numpy as np
 
 import jax
 
+from . import timing
 from .errors import InvalidParameterError
 from .grid import Grid
 from .parallel.execution import DistributedExecution
@@ -103,12 +104,17 @@ class DistributedTransform:
         ``values``: list of per-shard complex arrays (lengths must match
         ``num_local_elements_per_shard``).
         """
-        pair = self._exec.pad_values(values)
-        out = self._exec.backward_pair(*pair)
-        if self._exec_mode == ExecType.SYNCHRONOUS:
-            jax.block_until_ready(out)
-        self._space_data = out
-        return self._exec.unpad_space(out)
+        with timing.scoped("backward"):
+            with timing.scoped("input staging"):
+                pair = self._exec.pad_values(values)
+            with timing.scoped("dispatch"):
+                out = self._exec.backward_pair(*pair)
+            if self._exec_mode == ExecType.SYNCHRONOUS:
+                with timing.scoped("wait"):
+                    jax.block_until_ready(out)
+            self._space_data = out
+            with timing.scoped("output staging"):
+                return self._exec.unpad_space(out)
 
     def backward_pair(self, values_re, values_im):
         """Device-side backward on sharded (P, V_max) pairs; no host transfers."""
@@ -123,22 +129,27 @@ class DistributedTransform:
         input_location: ProcessingUnit | None = None,
     ):
         """Space -> per-shard packed freq values (list of complex arrays)."""
-        if space is None:
-            if self._space_data is None:
-                raise InvalidParameterError(
-                    "no space domain data: run backward first or pass an array"
-                )
-            if self._exec.is_r2c:
-                re, im = self._space_data, None
+        with timing.scoped("forward"):
+            if space is None:
+                if self._space_data is None:
+                    raise InvalidParameterError(
+                        "no space domain data: run backward first or pass an array"
+                    )
+                if self._exec.is_r2c:
+                    re, im = self._space_data, None
+                else:
+                    re, im = self._space_data
             else:
-                re, im = self._space_data
-        else:
-            re, im = self._exec.pad_space(np.asarray(space))
-            self._space_data = re if self._exec.is_r2c else (re, im)
-        pair = self._exec.forward_pair(re, im, ScalingType(scaling))
-        if self._exec_mode == ExecType.SYNCHRONOUS:
-            jax.block_until_ready(pair)
-        return self._exec.unpad_values(pair)
+                with timing.scoped("input staging"):
+                    re, im = self._exec.pad_space(np.asarray(space))
+                    self._space_data = re if self._exec.is_r2c else (re, im)
+            with timing.scoped("dispatch"):
+                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            if self._exec_mode == ExecType.SYNCHRONOUS:
+                with timing.scoped("wait"):
+                    jax.block_until_ready(pair)
+            with timing.scoped("output staging"):
+                return self._exec.unpad_values(pair)
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained sharded space buffer."""

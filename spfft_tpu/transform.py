@@ -12,6 +12,7 @@ import numpy as np
 
 import jax
 
+from . import timing
 from .errors import InvalidParameterError
 from .execution import LocalExecution
 from .grid import Grid, device_for_processing_unit
@@ -93,16 +94,21 @@ class Transform:
         # execution.py) wins on CPU where pocketfft is the fast path.
         if engine == "auto":
             engine = "xla" if device.platform == "cpu" else "mxu"
-        if engine == "mxu":
-            from .execution_mxu import MxuLocalExecution
+        # Plan-creation timing scope, parity with the reference's "Execution init"
+        # (reference: src/execution/execution_host.cpp:56).
+        with timing.scoped("Execution init"):
+            if engine == "mxu":
+                from .execution_mxu import MxuLocalExecution
 
-            self._exec = MxuLocalExecution(self._params, self._real_dtype, device=device)
-            self._native_transposed = True
-        elif engine == "xla":
-            self._exec = LocalExecution(self._params, self._real_dtype, device=device)
-            self._native_transposed = False
-        else:
-            raise InvalidParameterError(f"unknown engine {engine!r}")
+                self._exec = MxuLocalExecution(
+                    self._params, self._real_dtype, device=device
+                )
+                self._native_transposed = True
+            elif engine == "xla":
+                self._exec = LocalExecution(self._params, self._real_dtype, device=device)
+                self._native_transposed = False
+            else:
+                raise InvalidParameterError(f"unknown engine {engine!r}")
         self._engine = engine
         self._space_data = None
 
@@ -125,13 +131,22 @@ class Transform:
             raise InvalidParameterError(
                 f"expected {self._params.num_values} frequency values, got {values.size}"
             )
-        values = values.reshape(self._params.num_values)
-        re, im = as_pair(values, self._real_dtype)
-        out = self._exec.backward_pair(self._exec.put(re), self._exec.put(im))
-        if self._exec_mode == ExecType.SYNCHRONOUS:
-            jax.block_until_ready(out)
-        self._space_data = out  # engine-native layout; pair for C2C, real for R2C
-        return self._combine_space(out)
+        # Timing scopes mirror the reference's top-level "backward" plus the
+        # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
+        # stage-level attribution lives in profiler traces — see timing module doc).
+        with timing.scoped("backward"):
+            values = values.reshape(self._params.num_values)
+            with timing.scoped("input staging"):
+                re, im = as_pair(values, self._real_dtype)
+                re, im = self._exec.put(re), self._exec.put(im)
+            with timing.scoped("dispatch"):
+                out = self._exec.backward_pair(re, im)
+            if self._exec_mode == ExecType.SYNCHRONOUS:
+                with timing.scoped("wait"):
+                    jax.block_until_ready(out)
+            self._space_data = out  # engine-native layout; pair for C2C, real for R2C
+            with timing.scoped("output staging"):
+                return self._combine_space(out)
 
     def backward_pair(self, values_re, values_im):
         """Device-side backward: (re, im) freq pair in, device-resident space out
@@ -165,34 +180,42 @@ class Transform:
         if input_location is not None:
             _validate_pu(input_location)
         p = self._params
-        if space is None:
-            if self._space_data is None:
-                raise InvalidParameterError(
-                    "no space domain data: run backward first or pass an array"
-                )
-            if self._is_r2c:
-                pair = self._exec.forward_pair(self._space_data, None, ScalingType(scaling))
+        with timing.scoped("forward"):
+            if space is None:
+                if self._space_data is None:
+                    raise InvalidParameterError(
+                        "no space domain data: run backward first or pass an array"
+                    )
+                with timing.scoped("dispatch"):
+                    if self._is_r2c:
+                        pair = self._exec.forward_pair(
+                            self._space_data, None, ScalingType(scaling)
+                        )
+                    else:
+                        re, im = self._space_data
+                        pair = self._exec.forward_pair(re, im, ScalingType(scaling))
             else:
-                re, im = self._space_data
-                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
-        else:
-            space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
-            if self._native_transposed:
-                space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
-            if self._is_r2c:
-                space_re = self._exec.put(
-                    np.ascontiguousarray(space.real, dtype=self._real_dtype)
-                )
-                self._space_data = space_re
-                pair = self._exec.forward_pair(space_re, None, ScalingType(scaling))
-            else:
-                re, im = as_pair(space, self._real_dtype)
-                re, im = self._exec.put(re), self._exec.put(im)
-                self._space_data = (re, im)
-                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
-        if self._exec_mode == ExecType.SYNCHRONOUS:
-            jax.block_until_ready(pair)
-        return from_pair(pair)
+                with timing.scoped("input staging"):
+                    space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
+                    if self._native_transposed:
+                        space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
+                    if self._is_r2c:
+                        re = self._exec.put(
+                            np.ascontiguousarray(space.real, dtype=self._real_dtype)
+                        )
+                        im = None
+                        self._space_data = re
+                    else:
+                        re, im = as_pair(space, self._real_dtype)
+                        re, im = self._exec.put(re), self._exec.put(im)
+                        self._space_data = (re, im)
+                with timing.scoped("dispatch"):
+                    pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            if self._exec_mode == ExecType.SYNCHRONOUS:
+                with timing.scoped("wait"):
+                    jax.block_until_ready(pair)
+            with timing.scoped("output staging"):
+                return from_pair(pair)
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained space buffer; returns the (re, im)

@@ -1,0 +1,157 @@
+"""Timing subsystem tests.
+
+The reference has no tests for rt_graph; these cover the rebuilt tree semantics
+(nesting, statistics, JSON schema) plus the integration hook points in Transform
+(the "backward"/"forward"/"Execution init" scopes the reference tags in
+src/spfft/transform_internal.cpp:153,255 and src/execution/execution_host.cpp:56).
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import timing
+from spfft_tpu.timing import Timer
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_timer():
+    timing.disable()
+    timing.clear()
+    yield
+    timing.disable()
+    timing.clear()
+
+
+def test_nested_tree_structure():
+    t = Timer()
+    with t.scoped("outer"):
+        with t.scoped("inner"):
+            pass
+        with t.scoped("inner"):
+            pass
+        with t.scoped("other"):
+            pass
+    with t.scoped("outer"):
+        pass
+    res = t.process()
+    assert [s.label for s in res.sub] == ["outer"]
+    outer = res.sub[0]
+    assert outer.count == 2
+    assert [s.label for s in outer.sub] == ["inner", "other"]
+    assert outer.sub[0].count == 2
+    assert outer.sub[1].count == 1
+
+
+def test_statistics():
+    t = Timer()
+    node = t._root.child("x")
+    node.timings = [1.0, 2.0, 3.0, 4.0]
+    res = t.process().sub[0]
+    assert res.count == 4
+    assert res.total == pytest.approx(10.0)
+    assert res.mean == pytest.approx(2.5)
+    assert res.median == pytest.approx(2.5)
+    assert res.min == 1.0 and res.max == 4.0
+    assert res.lower_quartile == pytest.approx(1.75)
+    assert res.upper_quartile == pytest.approx(3.25)
+    assert res.percentage == pytest.approx(100.0)
+
+
+def test_parent_percentage():
+    t = Timer()
+    parent = t._root.child("p")
+    parent.timings = [10.0]
+    child = parent.child("c")
+    child.timings = [4.0]
+    res = t.process()
+    assert res.sub[0].sub[0].parent_percentage == pytest.approx(40.0)
+
+
+def test_mismatched_stop_raises():
+    t = Timer()
+    t.start("a")
+    with pytest.raises(RuntimeError):
+        t.stop("b")
+    t.stop("a")
+    with pytest.raises(RuntimeError):
+        t.stop("a")
+
+
+def test_timing_measures_wall_clock():
+    t = Timer()
+    with t.scoped("sleep"):
+        time.sleep(0.01)
+    res = t.process().sub[0]
+    assert res.total >= 0.009
+
+
+def test_json_roundtrip():
+    t = Timer()
+    with t.scoped("a"):
+        with t.scoped("b"):
+            pass
+    data = json.loads(t.process().json())
+    assert data["sub"][0]["label"] == "a"
+    assert data["sub"][0]["sub"][0]["label"] == "b"
+    for key in (
+        "count", "total", "mean", "median", "min", "max",
+        "lower_quartile", "upper_quartile", "percentage", "parent_percentage",
+    ):
+        assert key in data["sub"][0]
+
+
+def test_global_disabled_is_noop():
+    assert not timing.is_enabled()
+    with timing.scoped("ignored"):
+        pass
+    assert timing.process().sub == []
+
+
+def test_transform_hooks():
+    timing.enable()
+    dim = 8
+    triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, 1.0)
+    t = sp.Transform(
+        sp.ProcessingUnit.HOST, sp.TransformType.C2C, dim, dim, dim, indices=triplets
+    )
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
+    space = t.backward(vals)
+    t.forward(space, sp.ScalingType.FULL)
+
+    res = timing.process()
+    labels = [s.label for s in res.sub]
+    assert "Execution init" in labels
+    assert "backward" in labels
+    assert "forward" in labels
+    bwd = res.find("backward")
+    sub_labels = [s.label for s in bwd.sub]
+    assert "input staging" in sub_labels
+    assert "dispatch" in sub_labels
+    assert "wait" in sub_labels
+    # Printable without raising.
+    assert "backward" in str(res)
+
+
+def test_distributed_hooks():
+    timing.enable()
+    dim = 8
+    mesh = sp.make_fft_mesh(4)
+    triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.9)
+    t = sp.DistributedTransform(
+        sp.ProcessingUnit.GPU, sp.TransformType.C2C, dim, dim, dim, triplets, mesh=mesh
+    )
+    rng = np.random.default_rng(1)
+    values = [
+        rng.standard_normal(t.num_local_elements(r))
+        + 1j * rng.standard_normal(t.num_local_elements(r))
+        for r in range(t.num_shards)
+    ]
+    space = t.backward(values)
+    t.forward(space, sp.ScalingType.FULL)
+    res = timing.process()
+    assert res.find("backward") is not None
+    assert res.find("forward") is not None
