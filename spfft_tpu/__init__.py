@@ -35,6 +35,10 @@ from . import timing  # noqa: F401
 from .distributed import DistributedTransform  # noqa: F401
 from .grid import Grid  # noqa: F401
 from .indices import create_spherical_cutoff_triplets  # noqa: F401
+from .multi_transform import (  # noqa: F401
+    multi_transform_backward,
+    multi_transform_forward,
+)
 from .parallel import make_fft_mesh  # noqa: F401
 from .parameters import distribute_triplets  # noqa: F401
 from .transform import Transform, TransformFloat  # noqa: F401

@@ -105,16 +105,22 @@ class DistributedTransform:
         ``num_local_elements_per_shard``).
         """
         with timing.scoped("backward"):
-            with timing.scoped("input staging"):
-                pair = self._exec.pad_values(values)
-            with timing.scoped("dispatch"):
-                out = self._exec.backward_pair(*pair)
+            out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
                     jax.block_until_ready(out)
-            self._space_data = out
             with timing.scoped("output staging"):
-                return self._exec.unpad_space(out)
+                return self._finalize_backward(out)
+
+    def _dispatch_backward(self, values):
+        """Stage per-shard inputs and enqueue the backward pipeline without
+        waiting (split-phase hook used by multi-transform pipelining)."""
+        with timing.scoped("input staging"):
+            pair = self._exec.pad_values(values)
+        with timing.scoped("dispatch"):
+            out = self._exec.backward_pair(*pair)
+        self._space_data = out
+        return out
 
     def backward_pair(self, values_re, values_im):
         """Device-side backward on sharded (P, V_max) pairs; no host transfers."""
@@ -130,26 +136,31 @@ class DistributedTransform:
     ):
         """Space -> per-shard packed freq values (list of complex arrays)."""
         with timing.scoped("forward"):
-            if space is None:
-                if self._space_data is None:
-                    raise InvalidParameterError(
-                        "no space domain data: run backward first or pass an array"
-                    )
-                if self._exec.is_r2c:
-                    re, im = self._space_data, None
-                else:
-                    re, im = self._space_data
-            else:
-                with timing.scoped("input staging"):
-                    re, im = self._exec.pad_space(np.asarray(space))
-                    self._space_data = re if self._exec.is_r2c else (re, im)
-            with timing.scoped("dispatch"):
-                pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
                     jax.block_until_ready(pair)
             with timing.scoped("output staging"):
-                return self._exec.unpad_values(pair)
+                return self._finalize_forward(pair)
+
+    def _dispatch_forward(self, space, scaling):
+        """Stage the space-domain input (or reuse the retained slabs) and enqueue
+        the forward pipeline without waiting."""
+        if space is None:
+            if self._space_data is None:
+                raise InvalidParameterError(
+                    "no space domain data: run backward first or pass an array"
+                )
+            if self._exec.is_r2c:
+                re, im = self._space_data, None
+            else:
+                re, im = self._space_data
+        else:
+            with timing.scoped("input staging"):
+                re, im = self._exec.pad_space(np.asarray(space))
+                self._space_data = re if self._exec.is_r2c else (re, im)
+        with timing.scoped("dispatch"):
+            return self._exec.forward_pair(re, im, ScalingType(scaling))
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained sharded space buffer."""
@@ -159,6 +170,14 @@ class DistributedTransform:
             return self._exec.forward_pair(self._space_data, None, ScalingType(scaling))
         re, im = self._space_data
         return self._exec.forward_pair(re, im, ScalingType(scaling))
+
+    def _finalize_backward(self, out):
+        """Host-side completion of a dispatched backward (fetch + unpad)."""
+        return self._exec.unpad_space(out)
+
+    def _finalize_forward(self, pair):
+        """Host-side completion of a dispatched forward (fetch + unpad)."""
+        return self._exec.unpad_values(pair)
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
         """Global trimmed space-domain array of the most recent result."""

@@ -14,7 +14,7 @@ import jax
 
 from . import timing
 from .errors import InvalidParameterError
-from .execution import LocalExecution
+from .execution import LocalExecution, as_pair, from_pair
 from .grid import Grid, device_for_processing_unit
 from .parameters import make_local_parameters
 from .types import ExecType, IndexFormat, ProcessingUnit, ScalingType, TransformType
@@ -122,31 +122,40 @@ class Transform:
         (device-resident) for :meth:`space_domain_data` / input-less :meth:`forward`,
         mirroring the reference's internal space-domain buffer.
         """
-        from .execution import as_pair
 
         if output_location is not None:
             _validate_pu(output_location)
+        # Timing scopes mirror the reference's top-level "backward" plus the
+        # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
+        # stage-level attribution lives in profiler traces — see timing module doc).
+        with timing.scoped("backward"):
+            out = self._dispatch_backward(values)
+            if self._exec_mode == ExecType.SYNCHRONOUS:
+                with timing.scoped("wait"):
+                    jax.block_until_ready(out)
+            with timing.scoped("output staging"):
+                return self._finalize_backward(out)
+
+    def _dispatch_backward(self, values):
+        """Stage inputs and enqueue the backward pipeline; returns the
+        device-resident result without waiting. The host-level analogue of the
+        reference's split-phase backward_z/exchange/xy dispatch used by
+        multi-transform pipelining (reference: src/spfft/transform_internal.hpp,
+        multi_transform_internal.hpp:113-176)."""
+
         values = np.asarray(values)
         if values.size != self._params.num_values:
             raise InvalidParameterError(
                 f"expected {self._params.num_values} frequency values, got {values.size}"
             )
-        # Timing scopes mirror the reference's top-level "backward" plus the
-        # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
-        # stage-level attribution lives in profiler traces — see timing module doc).
-        with timing.scoped("backward"):
-            values = values.reshape(self._params.num_values)
-            with timing.scoped("input staging"):
-                re, im = as_pair(values, self._real_dtype)
-                re, im = self._exec.put(re), self._exec.put(im)
-            with timing.scoped("dispatch"):
-                out = self._exec.backward_pair(re, im)
-            if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"):
-                    jax.block_until_ready(out)
-            self._space_data = out  # engine-native layout; pair for C2C, real for R2C
-            with timing.scoped("output staging"):
-                return self._combine_space(out)
+        values = values.reshape(self._params.num_values)
+        with timing.scoped("input staging"):
+            re, im = as_pair(values, self._real_dtype)
+            re, im = self._exec.put(re), self._exec.put(im)
+        with timing.scoped("dispatch"):
+            out = self._exec.backward_pair(re, im)
+        self._space_data = out  # engine-native layout; pair for C2C, real for R2C
+        return out
 
     def backward_pair(self, values_re, values_im):
         """Device-side backward: (re, im) freq pair in, device-resident space out
@@ -175,47 +184,49 @@ class Transform:
         retained space-domain buffer (the reference's pointer-free overload reading
         ``space_domain_data``).
         """
-        from .execution import as_pair, from_pair
 
         if input_location is not None:
             _validate_pu(input_location)
-        p = self._params
         with timing.scoped("forward"):
-            if space is None:
-                if self._space_data is None:
-                    raise InvalidParameterError(
-                        "no space domain data: run backward first or pass an array"
-                    )
-                with timing.scoped("dispatch"):
-                    if self._is_r2c:
-                        pair = self._exec.forward_pair(
-                            self._space_data, None, ScalingType(scaling)
-                        )
-                    else:
-                        re, im = self._space_data
-                        pair = self._exec.forward_pair(re, im, ScalingType(scaling))
-            else:
-                with timing.scoped("input staging"):
-                    space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
-                    if self._native_transposed:
-                        space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
-                    if self._is_r2c:
-                        re = self._exec.put(
-                            np.ascontiguousarray(space.real, dtype=self._real_dtype)
-                        )
-                        im = None
-                        self._space_data = re
-                    else:
-                        re, im = as_pair(space, self._real_dtype)
-                        re, im = self._exec.put(re), self._exec.put(im)
-                        self._space_data = (re, im)
-                with timing.scoped("dispatch"):
-                    pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
                     jax.block_until_ready(pair)
             with timing.scoped("output staging"):
-                return from_pair(pair)
+                return self._finalize_forward(pair)
+
+    def _dispatch_forward(self, space, scaling):
+        """Stage the space-domain input (or reuse the retained buffer) and enqueue
+        the forward pipeline; returns the device-resident (re, im) pair without
+        waiting (split-phase counterpart of :meth:`_dispatch_backward`)."""
+
+        p = self._params
+        if space is None:
+            if self._space_data is None:
+                raise InvalidParameterError(
+                    "no space domain data: run backward first or pass an array"
+                )
+            if self._is_r2c:
+                re, im = self._space_data, None
+            else:
+                re, im = self._space_data
+        else:
+            with timing.scoped("input staging"):
+                space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
+                if self._native_transposed:
+                    space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
+                if self._is_r2c:
+                    re = self._exec.put(
+                        np.ascontiguousarray(space.real, dtype=self._real_dtype)
+                    )
+                    im = None
+                    self._space_data = re
+                else:
+                    re, im = as_pair(space, self._real_dtype)
+                    re, im = self._exec.put(re), self._exec.put(im)
+                    self._space_data = (re, im)
+        with timing.scoped("dispatch"):
+            return self._exec.forward_pair(re, im, ScalingType(scaling))
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained space buffer; returns the (re, im)
@@ -226,6 +237,15 @@ class Transform:
             return self._exec.forward_pair(self._space_data, None, ScalingType(scaling))
         re, im = self._space_data
         return self._exec.forward_pair(re, im, ScalingType(scaling))
+
+    def _finalize_backward(self, out):
+        """Host-side completion of a dispatched backward (fetch + relayout)."""
+        return self._combine_space(out)
+
+    def _finalize_forward(self, pair):
+        """Host-side completion of a dispatched forward (fetch + recombine)."""
+
+        return from_pair(pair)
 
     @property
     def space_domain_layout(self) -> str:
@@ -239,7 +259,6 @@ class Transform:
         return self._params.transform_type == TransformType.R2C
 
     def _combine_space(self, out):
-        from .execution import from_pair
 
         arr = np.asarray(out) if self._is_r2c else from_pair(out)
         if self._native_transposed:
