@@ -1,0 +1,263 @@
+"""Benchmark CLI — the rebuilt harness of the reference's ``benchmark`` program
+(reference: tests/programs/benchmark.cpp).
+
+Same flag surface (`-d X Y Z -r repeats -o out.json -s sparsity -t c2c|r2c
+-e exchange -p cpu|gpu -m numTransforms`), same stick-generation model
+(x in [0, dimXFreq*sparsity), full y column set, x==0 limited to dimYFreq for R2C,
+contiguous even distribution over shards — reference: benchmark.cpp:177-205), warm-up
+run then a timed backward+forward loop (reference: benchmark.cpp:63-96), and a JSON
+report bundling parameters, measured results, and the nested timing tree
+(reference: benchmark.cpp:283-307).
+
+Additions forced by TPU semantics: on the tunneled TPU platform
+``block_until_ready`` does not wait for execution, so wall-clock is measured by
+chaining R *dependent* roundtrips (forward output feeds the next backward) and
+fetching a scalar at the end; with FULL scaling the chain is an identity so results
+stay bounded. ``--shards N`` runs the mesh-distributed path (the reference's MPI
+ranks), on real devices or a virtual CPU mesh.
+
+Usage examples:
+  python programs/benchmark.py -d 128 128 128 -r 20 -s 0.3 -t c2c -e compact -p cpu -o out.json
+  python programs/benchmark.py -d 256 256 256 -r 10 -p gpu --shards 4 -e buffered -o out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+EXCHANGE_NAMES = {
+    "buffered": "BUFFERED",
+    "bufferedFloat": "BUFFERED_FLOAT",
+    "compact": "COMPACT_BUFFERED",
+    "compactFloat": "COMPACT_BUFFERED_FLOAT",
+    "unbuffered": "UNBUFFERED",
+}
+
+
+def create_benchmark_triplets(dim_x, dim_y, dim_z, sparsity, r2c):
+    """The reference benchmark's stick set (reference: benchmark.cpp:177-205):
+    all (x, y) with x < dimXFreq*sparsity; for R2C, the x==0 sticks cover only
+    y < dimYFreq (hermitian non-redundant half)."""
+    dim_x_freq = dim_x // 2 + 1 if r2c else dim_x
+    dim_y_freq = dim_y // 2 + 1 if r2c else dim_y
+    xs = np.arange(int(np.ceil(dim_x_freq * sparsity)) or 1, dtype=np.int32)
+    xy = np.concatenate(
+        [
+            np.stack(
+                [
+                    np.full(dim_y_freq if x == 0 else dim_y, x, dtype=np.int32),
+                    np.arange(dim_y_freq if x == 0 else dim_y, dtype=np.int32),
+                ],
+                axis=1,
+            )
+            for x in xs
+        ]
+    )
+    zs = np.arange(dim_z, dtype=np.int32)
+    trips = np.empty((len(xy), dim_z, 3), dtype=np.int32)
+    trips[:, :, 0] = xy[:, None, 0]
+    trips[:, :, 1] = xy[:, None, 1]
+    trips[:, :, 2] = zs[None, :]
+    return trips.reshape(-1, 3), len(xy)
+
+
+def split_contiguous(triplets, num_sticks, num_shards, dim_z):
+    """Even contiguous stick distribution over shards (reference: benchmark.cpp:190-205)."""
+    per = [
+        num_sticks // num_shards + (1 if r < num_sticks % num_shards else 0)
+        for r in range(num_shards)
+    ]
+    out, pos = [], 0
+    for n in per:
+        out.append(triplets[pos * dim_z : (pos + n) * dim_z])
+        pos += n
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="sparse 3D FFT benchmark")
+    ap.add_argument("-d", nargs=3, type=int, required=True, metavar=("X", "Y", "Z"))
+    ap.add_argument("-r", type=int, required=True, help="number of repeats")
+    ap.add_argument("-o", type=str, required=True, help="output JSON file")
+    ap.add_argument("-m", type=int, default=1, help="multiple transform number")
+    ap.add_argument("-s", type=float, default=1.0, help="sparsity")
+    ap.add_argument("-t", choices=["c2c", "r2c"], default="c2c")
+    ap.add_argument(
+        "-e",
+        choices=sorted(EXCHANGE_NAMES) + ["all"],
+        default="buffered",
+        help="exchange type (distributed runs)",
+    )
+    ap.add_argument("-p", choices=["cpu", "gpu", "gpu-gpu"], required=True)
+    ap.add_argument("--shards", type=int, default=1, help="mesh size (1 = local)")
+    ap.add_argument(
+        "--precision", choices=["single", "double"], default=None,
+        help="default: double on cpu, single on accelerators",
+    )
+    args = ap.parse_args(argv)
+
+    import os
+
+    import jax
+
+    if args.precision == "double" or (args.precision is None and args.p == "cpu"):
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
+    # Virtual CPU mesh for distributed runs on a single host (the reference's
+    # ``mpirun -n N`` on one CI VM): size the CPU platform before first backend use.
+    if args.shards > 1 and (args.p == "cpu" or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        jax.config.update("jax_num_cpu_devices", args.shards)
+
+    import spfft_tpu as sp
+    from spfft_tpu import timing
+    from spfft_tpu.execution import as_pair
+    from spfft_tpu.types import ExchangeType, ProcessingUnit, ScalingType, TransformType
+
+    timing.enable()
+
+    dim_x, dim_y, dim_z = args.d
+    r2c = args.t == "r2c"
+    ttype = TransformType.R2C if r2c else TransformType.C2C
+    pu = ProcessingUnit.HOST if args.p == "cpu" else ProcessingUnit.GPU
+    # "-e all" sweeps every exchange variant over the same plan geometry, like the
+    # reference benchmark; local runs have no exchange so it degenerates to one run.
+    if args.shards > 1:
+        exchange_sweep = sorted(EXCHANGE_NAMES) if args.e == "all" else [args.e]
+    else:
+        exchange_sweep = [args.e if args.e != "all" else "buffered"]
+
+    triplets, num_sticks = create_benchmark_triplets(
+        dim_x, dim_y, dim_z, args.s, r2c
+    )
+    rng = np.random.default_rng(42)
+
+    def build_transforms(exchange_name):
+        exchange = ExchangeType[EXCHANGE_NAMES[exchange_name]]
+        with timing.scoped("Grid + Transform init"):
+            if args.shards > 1:
+                mesh = sp.make_fft_mesh(args.shards)
+                per_shard = split_contiguous(triplets, num_sticks, args.shards, dim_z)
+                return [
+                    sp.DistributedTransform(
+                        pu, ttype, dim_x, dim_y, dim_z, [t.copy() for t in per_shard],
+                        mesh=mesh, exchange_type=exchange, dtype=dtype,
+                    )
+                    for _ in range(args.m)
+                ]
+            return [
+                sp.Transform(pu, ttype, dim_x, dim_y, dim_z, indices=triplets, dtype=dtype)
+                for _ in range(args.m)
+            ]
+
+    def make_values(t):
+        if r2c:  # hermitian-consistent inputs: derive from a real field
+            space = rng.standard_normal((dim_z, dim_y, dim_x))
+            return t.forward(space, ScalingType.NONE)
+        if args.shards > 1:
+            return [
+                rng.standard_normal(t.num_local_elements(r))
+                + 1j * rng.standard_normal(t.num_local_elements(r))
+                for r in range(t.num_shards)
+            ]
+        n = t.num_local_elements
+        return rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+    def fence(pairs):
+        """Force completion of every chain with scalar fetches (axon TPU:
+        block_until_ready does not wait)."""
+        for p in pairs:
+            _ = float(np.asarray(p[0]).ravel()[0])
+
+    def measure(exchange_name):
+        transforms = build_transforms(exchange_name)
+        values = [make_values(t) for t in transforms]
+
+        # Warm-up (compilation; reference: benchmark.cpp:63-70).
+        with timing.scoped("warmup"):
+            sp.multi_transform_backward(transforms, values)
+            sp.multi_transform_forward(transforms, None, ScalingType.FULL)
+
+        # Timed loop (reference: benchmark.cpp:84-96). Chained dependent roundtrips
+        # so platforms with fire-and-forget dispatch are timed correctly.
+        ex = [t._exec for t in transforms]
+        freq_pairs = []
+        for t, v in zip(transforms, values):
+            if args.shards > 1:
+                freq_pairs.append(t._exec.pad_values(v))
+            else:
+                re, im = as_pair(v, dtype)
+                freq_pairs.append((t._exec.put(re), t._exec.put(im)))
+
+        def roundtrip_chain(pairs):
+            outs = []
+            for e, (re, im) in zip(ex, pairs):
+                space = e.backward_pair(re, im)
+                if r2c:
+                    outs.append(e.forward_pair(space, None, ScalingType.FULL))
+                else:
+                    sre, sim = space
+                    outs.append(e.forward_pair(sre, sim, ScalingType.FULL))
+            return outs
+
+        jitted = jax.jit(roundtrip_chain) if args.shards == 1 else roundtrip_chain
+
+        # Warm the exact timed path too (compiles the fused roundtrip chain).
+        with timing.scoped("warmup chain"):
+            fence(jitted(freq_pairs))
+
+        with timing.scoped("benchmark loop"):
+            start = time.perf_counter()
+            pairs = freq_pairs
+            for _ in range(args.r):
+                with timing.scoped("roundtrip"):
+                    pairs = jitted(pairs)
+            fence(pairs)
+            elapsed = time.perf_counter() - start
+
+        pair_seconds = elapsed / (args.r * args.m)
+        n_total = dim_x * dim_y * dim_z
+        # Standard 5 N log2(N) flop model per 3D transform; x2 for fwd+bwd pair.
+        flops = 2 * 5.0 * n_total * np.log2(n_total)
+        return {
+            "wall_s_total": elapsed,
+            "wall_s_per_transform_pair": pair_seconds,
+            "gflops_per_pair": flops / pair_seconds / 1e9,
+        }
+
+    results = {name: measure(name) for name in exchange_sweep}
+
+    report = {
+        "parameters": {
+            "dim_x": dim_x, "dim_y": dim_y, "dim_z": dim_z,
+            "sparsity": args.s,
+            "num_z_sticks": num_sticks,
+            "num_elements": int(len(triplets)),
+            "transform_type": args.t,
+            "processing_unit": args.p,
+            "exchange": exchange_sweep if len(exchange_sweep) > 1 else exchange_sweep[0],
+            "precision": "double" if dtype == np.float64 else "single",
+            "num_transforms": args.m,
+            "repeats": args.r,
+            "shards": args.shards,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+        },
+        "results": results[exchange_sweep[0]] if len(exchange_sweep) == 1 else results,
+        "timings": timing.process().to_dict(),
+    }
+    Path(args.o).write_text(json.dumps(report, indent=2))
+    print(json.dumps({k: report[k] for k in ("parameters", "results")}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

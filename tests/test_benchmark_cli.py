@@ -1,0 +1,75 @@
+"""Benchmark harness smoke tests (reference: tests/programs/benchmark.cpp).
+
+Checks the CLI produces a complete JSON report for local, R2C, multi-transform and
+distributed runs on tiny grids, and that the stick-generation model matches the
+reference's (x-slab cutoff, x==0 limited to the hermitian half for R2C).
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_spec = importlib.util.spec_from_file_location(
+    "benchmark", Path(__file__).resolve().parent.parent / "programs" / "benchmark.py"
+)
+benchmark = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(benchmark)
+
+
+def test_stick_model_c2c():
+    trips, n_sticks = benchmark.create_benchmark_triplets(8, 8, 8, 0.5, r2c=False)
+    # x < ceil(8 * 0.5) = 4, all 8 y values, all 8 z values
+    assert n_sticks == 4 * 8
+    assert len(trips) == n_sticks * 8
+    assert trips[:, 0].max() == 3
+    assert set(map(tuple, np.unique(trips[:, :2], axis=0))) == {
+        (x, y) for x in range(4) for y in range(8)
+    }
+
+
+def test_stick_model_r2c_x0_half():
+    trips, n_sticks = benchmark.create_benchmark_triplets(8, 8, 8, 1.0, r2c=True)
+    # dimXFreq = 5; x==0 sticks cover only y < dimYFreq = 5 (hermitian half)
+    x0_y = np.unique(trips[trips[:, 0] == 0][:, 1])
+    assert list(x0_y) == [0, 1, 2, 3, 4]
+    x1_y = np.unique(trips[trips[:, 0] == 1][:, 1])
+    assert len(x1_y) == 8
+    assert n_sticks == 5 + 4 * 8
+
+
+def test_split_contiguous_even():
+    trips, n_sticks = benchmark.create_benchmark_triplets(4, 4, 4, 1.0, r2c=False)
+    parts = benchmark.split_contiguous(trips, n_sticks, 3, 4)
+    assert sum(len(p) for p in parts) == len(trips)
+    sizes = [len(p) // 4 for p in parts]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def _run_cli(tmp_path, extra):
+    out = tmp_path / "report.json"
+    argv = ["-d", "8", "8", "8", "-r", "2", "-o", str(out)] + extra
+    benchmark.main(argv)
+    report = json.loads(out.read_text())
+    assert set(report) == {"parameters", "results", "timings"}
+    assert report["results"]["wall_s_per_transform_pair"] > 0
+    assert report["results"]["gflops_per_pair"] > 0
+    assert report["timings"]["sub"], "timing tree must not be empty"
+    return report
+
+
+def test_cli_local_c2c(tmp_path):
+    r = _run_cli(tmp_path, ["-p", "cpu", "-s", "0.5"])
+    assert r["parameters"]["transform_type"] == "c2c"
+
+
+def test_cli_local_r2c_multi(tmp_path):
+    r = _run_cli(tmp_path, ["-p", "cpu", "-t", "r2c", "-m", "2"])
+    assert r["parameters"]["num_transforms"] == 2
+
+
+def test_cli_distributed(tmp_path):
+    r = _run_cli(tmp_path, ["-p", "gpu", "--shards", "4", "-e", "bufferedFloat"])
+    assert r["parameters"]["shards"] == 4
+    assert r["parameters"]["exchange"] == "bufferedFloat"
