@@ -1,0 +1,47 @@
+/*
+ * spfft_tpu native API — C Grid interface.
+ *
+ * Opaque-handle mirror of the C++ Grid (reference: include/spfft/grid.h).
+ * Every function returns an SpfftError; out-parameters carry results.
+ */
+#ifndef SPFFT_TPU_GRID_H
+#define SPFFT_TPU_GRID_H
+
+#include <spfft/errors.h>
+#include <spfft/types.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* SpfftGrid;
+
+SpfftError spfft_grid_create(SpfftGrid* grid, int maxDimX, int maxDimY, int maxDimZ,
+                             int maxNumLocalZColumns,
+                             SpfftProcessingUnitType processingUnit, int maxNumThreads);
+
+SpfftError spfft_grid_destroy(SpfftGrid grid);
+
+SpfftError spfft_grid_max_dim_x(SpfftGrid grid, int* dimX);
+SpfftError spfft_grid_max_dim_y(SpfftGrid grid, int* dimY);
+SpfftError spfft_grid_max_dim_z(SpfftGrid grid, int* dimZ);
+SpfftError spfft_grid_max_num_local_z_columns(SpfftGrid grid, int* maxNumLocalZColumns);
+SpfftError spfft_grid_max_local_z_length(SpfftGrid grid, int* maxLocalZLength);
+SpfftError spfft_grid_processing_unit(SpfftGrid grid,
+                                      SpfftProcessingUnitType* processingUnit);
+SpfftError spfft_grid_device_id(SpfftGrid grid, int* deviceId);
+SpfftError spfft_grid_num_threads(SpfftGrid grid, int* numThreads);
+
+/* Single-precision grid — same capacity object (see grid.hpp). */
+typedef void* SpfftFloatGrid;
+
+SpfftError spfft_float_grid_create(SpfftFloatGrid* grid, int maxDimX, int maxDimY,
+                                   int maxDimZ, int maxNumLocalZColumns,
+                                   SpfftProcessingUnitType processingUnit,
+                                   int maxNumThreads);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SPFFT_TPU_GRID_H */

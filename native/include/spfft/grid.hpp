@@ -1,0 +1,78 @@
+/*
+ * spfft_tpu native API — C++ Grid class.
+ *
+ * Source-compatible with the reference spfft::Grid (reference:
+ * include/spfft/grid.hpp:49-205). A Grid declares maximum transform
+ * dimensions and hands out Transform plans; on the XLA backend buffer reuse
+ * is realized through donated/aliased device buffers rather than shared host
+ * arrays, so the Grid is pure capacity metadata plus a shared runtime handle.
+ */
+#ifndef SPFFT_TPU_GRID_HPP
+#define SPFFT_TPU_GRID_HPP
+
+#include <spfft/transform.hpp>
+#include <spfft/types.h>
+
+#include <memory>
+
+namespace spfft {
+
+class Grid;
+
+namespace detail {
+struct GridState;
+const std::shared_ptr<GridState>& grid_state(const Grid& grid);
+} // namespace detail
+
+class Grid {
+public:
+  /* Local grid (reference: grid.hpp:65-66). */
+  Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+       SpfftProcessingUnitType processing_unit, int max_num_threads);
+
+  /* Copy creates independent capacity (reference copy ctor allocates fresh
+   * buffers, grid.hpp "copy = fresh buffers"). */
+  Grid(const Grid&);
+  Grid(Grid&&) noexcept;
+  Grid& operator=(const Grid&);
+  Grid& operator=(Grid&&) noexcept;
+  ~Grid();
+
+  /* Create a double-precision transform bound to this grid
+   * (reference: grid.hpp:138-141). */
+  Transform create_transform(SpfftProcessingUnitType processing_unit,
+                             SpfftTransformType transform_type, int dim_x, int dim_y,
+                             int dim_z, int local_z_length, int num_local_elements,
+                             SpfftIndexFormatType index_format, const int* indices) const;
+
+  /* Single-precision variant (reference: GridFloat::create_transform). */
+  TransformFloat create_transform_float(SpfftProcessingUnitType processing_unit,
+                                        SpfftTransformType transform_type, int dim_x,
+                                        int dim_y, int dim_z, int local_z_length,
+                                        int num_local_elements,
+                                        SpfftIndexFormatType index_format,
+                                        const int* indices) const;
+
+  int max_dim_x() const;
+  int max_dim_y() const;
+  int max_dim_z() const;
+  int max_num_local_z_columns() const;
+  int max_local_z_length() const;
+  SpfftProcessingUnitType processing_unit() const;
+  int device_id() const;
+  int max_num_threads() const;
+
+private:
+  friend const std::shared_ptr<detail::GridState>& detail::grid_state(const Grid&);
+
+  std::shared_ptr<detail::GridState> state_;
+};
+
+/* Precision lives on the Transform in this build; GridFloat is the same
+ * capacity object (reference keeps two classes only because its buffers are
+ * typed). */
+typedef Grid GridFloat;
+
+} // namespace spfft
+
+#endif // SPFFT_TPU_GRID_HPP

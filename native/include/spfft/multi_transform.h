@@ -1,0 +1,40 @@
+/*
+ * spfft_tpu native API — C multi-transform interface.
+ *
+ * Batched execution of independent transforms with pipelined dispatch
+ * (reference: include/spfft/multi_transform.h).
+ */
+#ifndef SPFFT_TPU_MULTI_TRANSFORM_H
+#define SPFFT_TPU_MULTI_TRANSFORM_H
+
+#include <spfft/errors.h>
+#include <spfft/transform.h>
+#include <spfft/types.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+SpfftError spfft_multi_transform_backward(int numTransforms, SpfftTransform* transforms,
+                                          const double* const* input,
+                                          const SpfftProcessingUnitType* outputLocations);
+
+SpfftError spfft_multi_transform_forward(int numTransforms, SpfftTransform* transforms,
+                                         const SpfftProcessingUnitType* inputLocations,
+                                         double* const* output,
+                                         const SpfftScalingType* scalingTypes);
+
+SpfftError spfft_float_multi_transform_backward(
+    int numTransforms, SpfftFloatTransform* transforms, const float* const* input,
+    const SpfftProcessingUnitType* outputLocations);
+
+SpfftError spfft_float_multi_transform_forward(
+    int numTransforms, SpfftFloatTransform* transforms,
+    const SpfftProcessingUnitType* inputLocations, float* const* output,
+    const SpfftScalingType* scalingTypes);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SPFFT_TPU_MULTI_TRANSFORM_H */

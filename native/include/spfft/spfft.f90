@@ -1,0 +1,283 @@
+!> spfft_tpu native API — Fortran 2003 ISO-C interface module.
+!>
+!> bind(C) declarations for the C API in spfft/*.h, so Fortran plane-wave DFT
+!> codes call the TPU build the way they call the reference library
+!> (reference: include/spfft/spfft.f90 plays the same role for the C API).
+!> Handles are type(c_ptr); every function returns an SpfftError integer.
+!>
+!> Build note: compile this file into the application (the reference ships it
+!> the same way); link against libspfft_tpu.
+
+module spfft
+  use iso_c_binding
+  implicit none
+
+  ! --- SpfftError (spfft/errors.h) ---
+  integer(c_int), parameter :: SPFFT_SUCCESS = 0
+  integer(c_int), parameter :: SPFFT_UNKNOWN_ERROR = 1
+  integer(c_int), parameter :: SPFFT_INVALID_HANDLE_ERROR = 2
+  integer(c_int), parameter :: SPFFT_OVERFLOW_ERROR = 3
+  integer(c_int), parameter :: SPFFT_ALLOCATION_ERROR = 4
+  integer(c_int), parameter :: SPFFT_INVALID_PARAMETER_ERROR = 5
+  integer(c_int), parameter :: SPFFT_DUPLICATE_INDICES_ERROR = 6
+  integer(c_int), parameter :: SPFFT_INVALID_INDICES_ERROR = 7
+  integer(c_int), parameter :: SPFFT_MPI_SUPPORT_ERROR = 8
+  integer(c_int), parameter :: SPFFT_MPI_ERROR = 9
+  integer(c_int), parameter :: SPFFT_MPI_PARAMETER_MISMATCH_ERROR = 10
+  integer(c_int), parameter :: SPFFT_HOST_EXECUTION_ERROR = 11
+  integer(c_int), parameter :: SPFFT_FFTW_ERROR = 12
+  integer(c_int), parameter :: SPFFT_GPU_ERROR = 13
+
+  ! --- SpfftExchangeType (spfft/types.h) ---
+  integer(c_int), parameter :: SPFFT_EXCH_DEFAULT = 0
+  integer(c_int), parameter :: SPFFT_EXCH_BUFFERED = 1
+  integer(c_int), parameter :: SPFFT_EXCH_BUFFERED_FLOAT = 2
+  integer(c_int), parameter :: SPFFT_EXCH_COMPACT_BUFFERED = 3
+  integer(c_int), parameter :: SPFFT_EXCH_COMPACT_BUFFERED_FLOAT = 4
+  integer(c_int), parameter :: SPFFT_EXCH_UNBUFFERED = 5
+
+  ! --- SpfftProcessingUnitType ---
+  integer(c_int), parameter :: SPFFT_PU_HOST = 1
+  integer(c_int), parameter :: SPFFT_PU_GPU = 2
+
+  ! --- SpfftIndexFormatType ---
+  integer(c_int), parameter :: SPFFT_INDEX_TRIPLETS = 0
+
+  ! --- SpfftTransformType ---
+  integer(c_int), parameter :: SPFFT_TRANS_C2C = 0
+  integer(c_int), parameter :: SPFFT_TRANS_R2C = 1
+
+  ! --- SpfftScalingType ---
+  integer(c_int), parameter :: SPFFT_NO_SCALING = 0
+  integer(c_int), parameter :: SPFFT_FULL_SCALING = 1
+
+  ! --- SpfftExecType ---
+  integer(c_int), parameter :: SPFFT_EXEC_SYNCHRONOUS = 0
+  integer(c_int), parameter :: SPFFT_EXEC_ASYNCHRONOUS = 1
+
+  interface
+
+    ! ---- grid --------------------------------------------------------------
+
+    integer(c_int) function spfft_grid_create(grid, maxDimX, maxDimY, maxDimZ, &
+        maxNumLocalZColumns, processingUnit, maxNumThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: grid
+      integer(c_int), value :: maxDimX, maxDimY, maxDimZ
+      integer(c_int), value :: maxNumLocalZColumns, processingUnit, maxNumThreads
+    end function
+
+    integer(c_int) function spfft_grid_destroy(grid) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+    end function
+
+    integer(c_int) function spfft_grid_max_dim_x(grid, dimX) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimX
+    end function
+
+    integer(c_int) function spfft_grid_max_dim_y(grid, dimY) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimY
+    end function
+
+    integer(c_int) function spfft_grid_max_dim_z(grid, dimZ) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimZ
+    end function
+
+    integer(c_int) function spfft_grid_max_num_local_z_columns(grid, numCols) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: numCols
+    end function
+
+    integer(c_int) function spfft_grid_processing_unit(grid, processingUnit) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: processingUnit
+    end function
+
+    ! ---- transform (double) -------------------------------------------------
+
+    integer(c_int) function spfft_transform_create_independent(transform, &
+        maxNumThreads, processingUnit, transformType, dimX, dimY, dimZ, &
+        numLocalElements, indexFormat, indices) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      integer(c_int), value :: maxNumThreads, processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
+    integer(c_int) function spfft_transform_create(transform, grid, processingUnit, &
+        transformType, dimX, dimY, dimZ, localZLength, numLocalElements, &
+        indexFormat, indices) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      type(c_ptr), value :: grid
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, localZLength
+      integer(c_int), value :: numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
+    integer(c_int) function spfft_transform_destroy(transform) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+    end function
+
+    integer(c_int) function spfft_transform_clone(transform, newTransform) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      type(c_ptr), intent(out) :: newTransform
+    end function
+
+    integer(c_int) function spfft_transform_backward(transform, input, &
+        outputLocation) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_double), dimension(*), intent(in) :: input
+      integer(c_int), value :: outputLocation
+    end function
+
+    integer(c_int) function spfft_transform_forward(transform, inputLocation, &
+        output, scaling) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: inputLocation
+      real(c_double), dimension(*), intent(out) :: output
+      integer(c_int), value :: scaling
+    end function
+
+    integer(c_int) function spfft_transform_get_space_domain(transform, &
+        dataLocation, dataPtr) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: dataLocation
+      type(c_ptr), intent(out) :: dataPtr
+    end function
+
+    integer(c_int) function spfft_transform_dim_x(transform, dimX) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimX
+    end function
+
+    integer(c_int) function spfft_transform_dim_y(transform, dimY) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimY
+    end function
+
+    integer(c_int) function spfft_transform_dim_z(transform, dimZ) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimZ
+    end function
+
+    integer(c_int) function spfft_transform_local_z_length(transform, len) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: len
+    end function
+
+    integer(c_int) function spfft_transform_local_z_offset(transform, off) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: off
+    end function
+
+    integer(c_int) function spfft_transform_num_local_elements(transform, n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_transform_num_global_elements(transform, n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_transform_global_size(transform, n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_transform_set_execution_mode(transform, mode) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: mode
+    end function
+
+    ! ---- transform (float) --------------------------------------------------
+
+    integer(c_int) function spfft_float_transform_create_independent(transform, &
+        maxNumThreads, processingUnit, transformType, dimX, dimY, dimZ, &
+        numLocalElements, indexFormat, indices) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      integer(c_int), value :: maxNumThreads, processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
+    integer(c_int) function spfft_float_transform_destroy(transform) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+    end function
+
+    integer(c_int) function spfft_float_transform_backward(transform, input, &
+        outputLocation) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_float), dimension(*), intent(in) :: input
+      integer(c_int), value :: outputLocation
+    end function
+
+    integer(c_int) function spfft_float_transform_forward(transform, &
+        inputLocation, output, scaling) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: inputLocation
+      real(c_float), dimension(*), intent(out) :: output
+      integer(c_int), value :: scaling
+    end function
+
+    integer(c_int) function spfft_float_transform_get_space_domain(transform, &
+        dataLocation, dataPtr) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: dataLocation
+      type(c_ptr), intent(out) :: dataPtr
+    end function
+
+    ! ---- multi-transform ----------------------------------------------------
+
+    integer(c_int) function spfft_multi_transform_backward(numTransforms, &
+        transforms, input, outputLocations) bind(C)
+      use iso_c_binding
+      integer(c_int), value :: numTransforms
+      type(c_ptr), dimension(*), intent(in) :: transforms
+      type(c_ptr), dimension(*), intent(in) :: input
+      integer(c_int), dimension(*), intent(in) :: outputLocations
+    end function
+
+    integer(c_int) function spfft_multi_transform_forward(numTransforms, &
+        transforms, inputLocations, output, scalingTypes) bind(C)
+      use iso_c_binding
+      integer(c_int), value :: numTransforms
+      type(c_ptr), dimension(*), intent(in) :: transforms
+      integer(c_int), dimension(*), intent(in) :: inputLocations
+      type(c_ptr), dimension(*), intent(in) :: output
+      integer(c_int), dimension(*), intent(in) :: scalingTypes
+    end function
+
+  end interface
+end module spfft

@@ -1,0 +1,11 @@
+/* spfft_tpu native API — umbrella C header (reference: include/spfft/spfft.h). */
+#ifndef SPFFT_TPU_SPFFT_H
+#define SPFFT_TPU_SPFFT_H
+
+#include <spfft/errors.h>
+#include <spfft/grid.h>
+#include <spfft/multi_transform.h>
+#include <spfft/transform.h>
+#include <spfft/types.h>
+
+#endif /* SPFFT_TPU_SPFFT_H */

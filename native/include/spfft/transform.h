@@ -1,0 +1,125 @@
+/*
+ * spfft_tpu native API — C Transform interface.
+ *
+ * Opaque-handle mirror of the C++ Transform/TransformFloat (reference:
+ * include/spfft/transform.h, transform_float.h). Handles are created either
+ * grid-less or from an SpfftGrid; all functions return SpfftError.
+ */
+#ifndef SPFFT_TPU_TRANSFORM_H
+#define SPFFT_TPU_TRANSFORM_H
+
+#include <spfft/errors.h>
+#include <spfft/grid.h>
+#include <spfft/types.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* SpfftTransform;
+
+/* Grid-less creation (reference v1.0 feature). */
+SpfftError spfft_transform_create_independent(
+    SpfftTransform* transform, int maxNumThreads,
+    SpfftProcessingUnitType processingUnit, SpfftTransformType transformType, int dimX,
+    int dimY, int dimZ, int numLocalElements, SpfftIndexFormatType indexFormat,
+    const int* indices);
+
+/* Creation bound to a grid (reference: include/spfft/transform.h
+ * spfft_transform_create). */
+SpfftError spfft_transform_create(SpfftTransform* transform, SpfftGrid grid,
+                                  SpfftProcessingUnitType processingUnit,
+                                  SpfftTransformType transformType, int dimX, int dimY,
+                                  int dimZ, int localZLength, int numLocalElements,
+                                  SpfftIndexFormatType indexFormat, const int* indices);
+
+SpfftError spfft_transform_destroy(SpfftTransform transform);
+SpfftError spfft_transform_clone(SpfftTransform transform, SpfftTransform* newTransform);
+
+SpfftError spfft_transform_backward(SpfftTransform transform, const double* input,
+                                    SpfftProcessingUnitType outputLocation);
+SpfftError spfft_transform_forward(SpfftTransform transform,
+                                   SpfftProcessingUnitType inputLocation, double* output,
+                                   SpfftScalingType scaling);
+SpfftError spfft_transform_forward_ptr(SpfftTransform transform, const double* input,
+                                       double* output, SpfftScalingType scaling);
+SpfftError spfft_transform_get_space_domain(SpfftTransform transform,
+                                            SpfftProcessingUnitType dataLocation,
+                                            double** data);
+
+SpfftError spfft_transform_type(SpfftTransform transform, SpfftTransformType* type);
+SpfftError spfft_transform_dim_x(SpfftTransform transform, int* dimX);
+SpfftError spfft_transform_dim_y(SpfftTransform transform, int* dimY);
+SpfftError spfft_transform_dim_z(SpfftTransform transform, int* dimZ);
+SpfftError spfft_transform_local_z_length(SpfftTransform transform, int* localZLength);
+SpfftError spfft_transform_local_z_offset(SpfftTransform transform, int* offset);
+SpfftError spfft_transform_local_slice_size(SpfftTransform transform, int* size);
+SpfftError spfft_transform_num_local_elements(SpfftTransform transform, int* numLocalElements);
+SpfftError spfft_transform_num_global_elements(SpfftTransform transform,
+                                               long long int* numGlobalElements);
+SpfftError spfft_transform_global_size(SpfftTransform transform, long long int* globalSize);
+SpfftError spfft_transform_processing_unit(SpfftTransform transform,
+                                           SpfftProcessingUnitType* processingUnit);
+SpfftError spfft_transform_device_id(SpfftTransform transform, int* deviceId);
+SpfftError spfft_transform_num_threads(SpfftTransform transform, int* numThreads);
+SpfftError spfft_transform_execution_mode(SpfftTransform transform, SpfftExecType* mode);
+SpfftError spfft_transform_set_execution_mode(SpfftTransform transform, SpfftExecType mode);
+
+/* ---- single precision ---------------------------------------------------- */
+
+typedef void* SpfftFloatTransform;
+
+SpfftError spfft_float_transform_create_independent(
+    SpfftFloatTransform* transform, int maxNumThreads,
+    SpfftProcessingUnitType processingUnit, SpfftTransformType transformType, int dimX,
+    int dimY, int dimZ, int numLocalElements, SpfftIndexFormatType indexFormat,
+    const int* indices);
+
+SpfftError spfft_float_transform_create(SpfftFloatTransform* transform, SpfftFloatGrid grid,
+                                        SpfftProcessingUnitType processingUnit,
+                                        SpfftTransformType transformType, int dimX,
+                                        int dimY, int dimZ, int localZLength,
+                                        int numLocalElements,
+                                        SpfftIndexFormatType indexFormat,
+                                        const int* indices);
+
+SpfftError spfft_float_transform_destroy(SpfftFloatTransform transform);
+SpfftError spfft_float_transform_clone(SpfftFloatTransform transform,
+                                       SpfftFloatTransform* newTransform);
+
+SpfftError spfft_float_transform_backward(SpfftFloatTransform transform,
+                                          const float* input,
+                                          SpfftProcessingUnitType outputLocation);
+SpfftError spfft_float_transform_forward(SpfftFloatTransform transform,
+                                         SpfftProcessingUnitType inputLocation,
+                                         float* output, SpfftScalingType scaling);
+SpfftError spfft_float_transform_forward_ptr(SpfftFloatTransform transform,
+                                             const float* input, float* output,
+                                             SpfftScalingType scaling);
+SpfftError spfft_float_transform_get_space_domain(SpfftFloatTransform transform,
+                                                  SpfftProcessingUnitType dataLocation,
+                                                  float** data);
+
+SpfftError spfft_float_transform_type(SpfftFloatTransform transform,
+                                      SpfftTransformType* type);
+SpfftError spfft_float_transform_dim_x(SpfftFloatTransform transform, int* dimX);
+SpfftError spfft_float_transform_dim_y(SpfftFloatTransform transform, int* dimY);
+SpfftError spfft_float_transform_dim_z(SpfftFloatTransform transform, int* dimZ);
+SpfftError spfft_float_transform_local_z_length(SpfftFloatTransform transform,
+                                                int* localZLength);
+SpfftError spfft_float_transform_local_z_offset(SpfftFloatTransform transform,
+                                                int* offset);
+SpfftError spfft_float_transform_num_local_elements(SpfftFloatTransform transform,
+                                                    int* numLocalElements);
+SpfftError spfft_float_transform_processing_unit(SpfftFloatTransform transform,
+                                                 SpfftProcessingUnitType* processingUnit);
+SpfftError spfft_float_transform_execution_mode(SpfftFloatTransform transform,
+                                                SpfftExecType* mode);
+SpfftError spfft_float_transform_set_execution_mode(SpfftFloatTransform transform,
+                                                    SpfftExecType mode);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SPFFT_TPU_TRANSFORM_H */

@@ -1,0 +1,133 @@
+/*
+ * spfft_tpu native API — C++ Transform classes.
+ *
+ * Source-compatible with the reference spfft::Transform /
+ * spfft::TransformFloat (reference: include/spfft/transform.hpp:56-318,
+ * transform_float.hpp). The plan object is backed by the XLA compute core:
+ * construction compiles shape-specialized device programs; backward/forward
+ * dispatch them and marshal host buffers across the runtime boundary.
+ *
+ * Usage mirrors the reference: construct, fill space_domain_data() or pass a
+ * frequency-value array to backward(), read results, forward() back.
+ */
+#ifndef SPFFT_TPU_TRANSFORM_HPP
+#define SPFFT_TPU_TRANSFORM_HPP
+
+#include <spfft/errors.h>
+#include <spfft/types.h>
+
+#include <memory>
+
+namespace spfft {
+
+class Grid;
+
+class Transform;
+class TransformFloat;
+
+namespace detail {
+struct Plan;
+std::shared_ptr<Plan> make_plan(const Grid* grid, bool double_precision,
+                                SpfftProcessingUnitType pu, SpfftTransformType tt,
+                                int dim_x, int dim_y, int dim_z, int local_z_length,
+                                int num_local_elements, SpfftIndexFormatType fmt,
+                                const int* indices);
+Plan* plan_of(Transform& t);
+Plan* plan_of(TransformFloat& t);
+} // namespace detail
+
+/* Double-precision sparse 3D FFT plan. */
+class Transform {
+public:
+  /* Grid-less constructor (reference v1.0 feature, transform.hpp:76-105). */
+  Transform(SpfftProcessingUnitType processing_unit, SpfftTransformType transform_type,
+            int dim_x, int dim_y, int dim_z, int num_local_elements,
+            SpfftIndexFormatType index_format, const int* indices);
+
+  /* Independent plan with identical layout (reference: transform.hpp:133). */
+  Transform clone() const;
+
+  /* Frequency -> space. Result lands in space_domain_data(). */
+  void backward(const double* input, SpfftProcessingUnitType output_location);
+
+  /* Space -> frequency, reading space_domain_data(). */
+  void forward(SpfftProcessingUnitType input_location, double* output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+
+  /* Pointer-to-pointer overload: space input supplied directly. */
+  void forward(const double* input, double* output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+
+  /* Writable (dimZ, dimY, dimX) slab; complex-interleaved for C2C, real for
+   * R2C. Valid until the next transform call (reference: transform.hpp:245). */
+  double* space_domain_data(SpfftProcessingUnitType data_location);
+
+  SpfftTransformType type() const;
+  int dim_x() const;
+  int dim_y() const;
+  int dim_z() const;
+  int local_z_length() const;
+  int local_z_offset() const;
+  long long local_slice_size() const;
+  long long num_local_elements() const;
+  long long num_global_elements() const;
+  long long global_size() const;
+  SpfftProcessingUnitType processing_unit() const;
+  int device_id() const;
+  int num_threads() const;
+  SpfftExecType execution_mode() const;
+  void set_execution_mode(SpfftExecType mode);
+
+private:
+  friend class Grid;
+  friend detail::Plan* detail::plan_of(Transform&);
+  explicit Transform(std::shared_ptr<detail::Plan> plan) : plan_(std::move(plan)) {}
+
+  std::shared_ptr<detail::Plan> plan_;
+};
+
+/* Single-precision plan (reference: include/spfft/transform_float.hpp; on TPU
+ * f32 is the native precision, so this is the fast path). */
+class TransformFloat {
+public:
+  TransformFloat(SpfftProcessingUnitType processing_unit,
+                 SpfftTransformType transform_type, int dim_x, int dim_y, int dim_z,
+                 int num_local_elements, SpfftIndexFormatType index_format,
+                 const int* indices);
+
+  TransformFloat clone() const;
+
+  void backward(const float* input, SpfftProcessingUnitType output_location);
+  void forward(SpfftProcessingUnitType input_location, float* output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+  void forward(const float* input, float* output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+  float* space_domain_data(SpfftProcessingUnitType data_location);
+
+  SpfftTransformType type() const;
+  int dim_x() const;
+  int dim_y() const;
+  int dim_z() const;
+  int local_z_length() const;
+  int local_z_offset() const;
+  long long local_slice_size() const;
+  long long num_local_elements() const;
+  long long num_global_elements() const;
+  long long global_size() const;
+  SpfftProcessingUnitType processing_unit() const;
+  int device_id() const;
+  int num_threads() const;
+  SpfftExecType execution_mode() const;
+  void set_execution_mode(SpfftExecType mode);
+
+private:
+  friend class Grid;
+  friend detail::Plan* detail::plan_of(TransformFloat&);
+  explicit TransformFloat(std::shared_ptr<detail::Plan> plan) : plan_(std::move(plan)) {}
+
+  std::shared_ptr<detail::Plan> plan_;
+};
+
+} // namespace spfft
+
+#endif // SPFFT_TPU_TRANSFORM_HPP

@@ -1,0 +1,50 @@
+/*
+ * spfft_tpu native API — public enum surface.
+ *
+ * ABI-compatible with the reference SpFFT C enums (reference:
+ * include/spfft/types.h:33-117) so existing callers recompile unchanged.
+ * Semantics on the TPU build:
+ *  - exchange types all lower to an equal-split ICI all-to-all (the reference's
+ *    BUFFERED discipline); COMPACT/UNBUFFERED map to pad -> all_to_all -> slice.
+ *  - SPFFT_PU_GPU selects the accelerator (TPU) backend.
+ */
+#ifndef SPFFT_TPU_TYPES_H
+#define SPFFT_TPU_TYPES_H
+
+enum SpfftExchangeType {
+  SPFFT_EXCH_DEFAULT = 0,
+  /* Equal-sized message blocks; the native ICI all-to-all discipline. */
+  SPFFT_EXCH_BUFFERED = 1,
+  /* Same, single-precision wire payload (half the ICI bytes). */
+  SPFFT_EXCH_BUFFERED_FLOAT = 2,
+  /* Exact per-rank block sizes; realized as pad + all-to-all + slice. */
+  SPFFT_EXCH_COMPACT_BUFFERED = 3,
+  SPFFT_EXCH_COMPACT_BUFFERED_FLOAT = 4,
+  /* Zero-copy datatype exchange in the reference; same mapping here. */
+  SPFFT_EXCH_UNBUFFERED = 5
+};
+
+/* Bitmask: a Grid may hold capacity for both units at once. */
+enum SpfftProcessingUnitType {
+  SPFFT_PU_HOST = 1,
+  SPFFT_PU_GPU = 2 /* the TPU in this build; name kept for source parity */
+};
+
+enum SpfftIndexFormatType { SPFFT_INDEX_TRIPLETS = 0 };
+
+enum SpfftTransformType { SPFFT_TRANS_C2C = 0, SPFFT_TRANS_R2C = 1 };
+
+enum SpfftScalingType { SPFFT_NO_SCALING = 0, SPFFT_FULL_SCALING = 1 };
+
+enum SpfftExecType { SPFFT_EXEC_SYNCHRONOUS = 0, SPFFT_EXEC_ASYNCHRONOUS = 1 };
+
+#ifndef __cplusplus
+typedef enum SpfftExchangeType SpfftExchangeType;
+typedef enum SpfftProcessingUnitType SpfftProcessingUnitType;
+typedef enum SpfftIndexFormatType SpfftIndexFormatType;
+typedef enum SpfftTransformType SpfftTransformType;
+typedef enum SpfftScalingType SpfftScalingType;
+typedef enum SpfftExecType SpfftExecType;
+#endif
+
+#endif /* SPFFT_TPU_TYPES_H */

@@ -1,0 +1,340 @@
+/*
+ * spfft_tpu native API — extern-C handle functions.
+ *
+ * Same discipline as the reference C API (reference: src/spfft/transform.cpp:178+,
+ * grid.cpp): handles are heap-allocated C++ objects behind void*, every entry
+ * point is try/catch translating GenericError -> error_code and anything else
+ * -> SPFFT_UNKNOWN_ERROR.
+ */
+#include <spfft/spfft.h>
+#include <spfft/spfft.hpp>
+
+#include <new>
+#include <vector>
+
+namespace {
+
+template <typename Fn> SpfftError guarded(Fn&& fn) {
+  try {
+    fn();
+  } catch (const spfft::GenericError& e) {
+    return e.error_code();
+  } catch (...) {
+    return SPFFT_UNKNOWN_ERROR;
+  }
+  return SPFFT_SUCCESS;
+}
+
+spfft::Grid* as_grid(SpfftGrid h) { return static_cast<spfft::Grid*>(h); }
+spfft::Transform* as_transform(SpfftTransform h) {
+  return static_cast<spfft::Transform*>(h);
+}
+spfft::TransformFloat* as_float_transform(SpfftFloatTransform h) {
+  return static_cast<spfft::TransformFloat*>(h);
+}
+
+} // namespace
+
+extern "C" {
+
+/* ---- grid ----------------------------------------------------------------- */
+
+SpfftError spfft_grid_create(SpfftGrid* grid, int maxDimX, int maxDimY, int maxDimZ,
+                             int maxNumLocalZColumns,
+                             SpfftProcessingUnitType processingUnit, int maxNumThreads) {
+  if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *grid = new spfft::Grid(maxDimX, maxDimY, maxDimZ, maxNumLocalZColumns,
+                            processingUnit, maxNumThreads);
+  });
+}
+
+SpfftError spfft_float_grid_create(SpfftFloatGrid* grid, int maxDimX, int maxDimY,
+                                   int maxDimZ, int maxNumLocalZColumns,
+                                   SpfftProcessingUnitType processingUnit,
+                                   int maxNumThreads) {
+  return spfft_grid_create(grid, maxDimX, maxDimY, maxDimZ, maxNumLocalZColumns,
+                           processingUnit, maxNumThreads);
+}
+
+SpfftError spfft_grid_destroy(SpfftGrid grid) {
+  if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { delete as_grid(grid); });
+}
+
+#define SPFFT_TPU_GRID_GETTER(FN, OUT_T, METHOD)                                         \
+  SpfftError FN(SpfftGrid grid, OUT_T* out) {                                            \
+    if (grid == nullptr || out == nullptr) return SPFFT_INVALID_HANDLE_ERROR;            \
+    return guarded([&] { *out = as_grid(grid)->METHOD(); });                             \
+  }
+
+SPFFT_TPU_GRID_GETTER(spfft_grid_max_dim_x, int, max_dim_x)
+SPFFT_TPU_GRID_GETTER(spfft_grid_max_dim_y, int, max_dim_y)
+SPFFT_TPU_GRID_GETTER(spfft_grid_max_dim_z, int, max_dim_z)
+SPFFT_TPU_GRID_GETTER(spfft_grid_max_num_local_z_columns, int, max_num_local_z_columns)
+SPFFT_TPU_GRID_GETTER(spfft_grid_max_local_z_length, int, max_local_z_length)
+SPFFT_TPU_GRID_GETTER(spfft_grid_processing_unit, SpfftProcessingUnitType,
+                      processing_unit)
+SPFFT_TPU_GRID_GETTER(spfft_grid_device_id, int, device_id)
+SPFFT_TPU_GRID_GETTER(spfft_grid_num_threads, int, max_num_threads)
+
+#undef SPFFT_TPU_GRID_GETTER
+
+/* ---- transform (double) --------------------------------------------------- */
+
+SpfftError spfft_transform_create_independent(
+    SpfftTransform* transform, int /*maxNumThreads*/,
+    SpfftProcessingUnitType processingUnit, SpfftTransformType transformType, int dimX,
+    int dimY, int dimZ, int numLocalElements, SpfftIndexFormatType indexFormat,
+    const int* indices) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *transform = new spfft::Transform(processingUnit, transformType, dimX, dimY, dimZ,
+                                      numLocalElements, indexFormat, indices);
+  });
+}
+
+SpfftError spfft_transform_create(SpfftTransform* transform, SpfftGrid grid,
+                                  SpfftProcessingUnitType processingUnit,
+                                  SpfftTransformType transformType, int dimX, int dimY,
+                                  int dimZ, int localZLength, int numLocalElements,
+                                  SpfftIndexFormatType indexFormat, const int* indices) {
+  if (transform == nullptr || grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *transform = new spfft::Transform(as_grid(grid)->create_transform(
+        processingUnit, transformType, dimX, dimY, dimZ, localZLength,
+        numLocalElements, indexFormat, indices));
+  });
+}
+
+SpfftError spfft_transform_destroy(SpfftTransform transform) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { delete as_transform(transform); });
+}
+
+SpfftError spfft_transform_clone(SpfftTransform transform, SpfftTransform* newTransform) {
+  if (transform == nullptr || newTransform == nullptr)
+    return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { *newTransform = new spfft::Transform(as_transform(transform)->clone()); });
+}
+
+SpfftError spfft_transform_backward(SpfftTransform transform, const double* input,
+                                    SpfftProcessingUnitType outputLocation) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_transform(transform)->backward(input, outputLocation); });
+}
+
+SpfftError spfft_transform_forward(SpfftTransform transform,
+                                   SpfftProcessingUnitType inputLocation, double* output,
+                                   SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { as_transform(transform)->forward(inputLocation, output, scaling); });
+}
+
+SpfftError spfft_transform_forward_ptr(SpfftTransform transform, const double* input,
+                                       double* output, SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_transform(transform)->forward(input, output, scaling); });
+}
+
+SpfftError spfft_transform_get_space_domain(SpfftTransform transform,
+                                            SpfftProcessingUnitType dataLocation,
+                                            double** data) {
+  if (transform == nullptr || data == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { *data = as_transform(transform)->space_domain_data(dataLocation); });
+}
+
+#define SPFFT_TPU_TRANSFORM_GETTER(FN, OUT_T, METHOD)                                    \
+  SpfftError FN(SpfftTransform transform, OUT_T* out) {                                  \
+    if (transform == nullptr || out == nullptr) return SPFFT_INVALID_HANDLE_ERROR;       \
+    return guarded([&] { *out = static_cast<OUT_T>(as_transform(transform)->METHOD()); });\
+  }
+
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_type, SpfftTransformType, type)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_dim_x, int, dim_x)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_dim_y, int, dim_y)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_dim_z, int, dim_z)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_local_z_length, int, local_z_length)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_local_z_offset, int, local_z_offset)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_local_slice_size, int, local_slice_size)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_num_local_elements, int, num_local_elements)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_num_global_elements, long long int,
+                           num_global_elements)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_global_size, long long int, global_size)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_processing_unit, SpfftProcessingUnitType,
+                           processing_unit)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_device_id, int, device_id)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_num_threads, int, num_threads)
+SPFFT_TPU_TRANSFORM_GETTER(spfft_transform_execution_mode, SpfftExecType, execution_mode)
+
+#undef SPFFT_TPU_TRANSFORM_GETTER
+
+SpfftError spfft_transform_set_execution_mode(SpfftTransform transform,
+                                              SpfftExecType mode) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_transform(transform)->set_execution_mode(mode); });
+}
+
+/* ---- transform (float) ---------------------------------------------------- */
+
+SpfftError spfft_float_transform_create_independent(
+    SpfftFloatTransform* transform, int /*maxNumThreads*/,
+    SpfftProcessingUnitType processingUnit, SpfftTransformType transformType, int dimX,
+    int dimY, int dimZ, int numLocalElements, SpfftIndexFormatType indexFormat,
+    const int* indices) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *transform = new spfft::TransformFloat(processingUnit, transformType, dimX, dimY,
+                                           dimZ, numLocalElements, indexFormat, indices);
+  });
+}
+
+SpfftError spfft_float_transform_create(SpfftFloatTransform* transform,
+                                        SpfftFloatGrid grid,
+                                        SpfftProcessingUnitType processingUnit,
+                                        SpfftTransformType transformType, int dimX,
+                                        int dimY, int dimZ, int localZLength,
+                                        int numLocalElements,
+                                        SpfftIndexFormatType indexFormat,
+                                        const int* indices) {
+  if (transform == nullptr || grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *transform = new spfft::TransformFloat(as_grid(grid)->create_transform_float(
+        processingUnit, transformType, dimX, dimY, dimZ, localZLength,
+        numLocalElements, indexFormat, indices));
+  });
+}
+
+SpfftError spfft_float_transform_destroy(SpfftFloatTransform transform) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { delete as_float_transform(transform); });
+}
+
+SpfftError spfft_float_transform_clone(SpfftFloatTransform transform,
+                                       SpfftFloatTransform* newTransform) {
+  if (transform == nullptr || newTransform == nullptr)
+    return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *newTransform = new spfft::TransformFloat(as_float_transform(transform)->clone());
+  });
+}
+
+SpfftError spfft_float_transform_backward(SpfftFloatTransform transform,
+                                          const float* input,
+                                          SpfftProcessingUnitType outputLocation) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { as_float_transform(transform)->backward(input, outputLocation); });
+}
+
+SpfftError spfft_float_transform_forward(SpfftFloatTransform transform,
+                                         SpfftProcessingUnitType inputLocation,
+                                         float* output, SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { as_float_transform(transform)->forward(inputLocation, output, scaling); });
+}
+
+SpfftError spfft_float_transform_forward_ptr(SpfftFloatTransform transform,
+                                             const float* input, float* output,
+                                             SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { as_float_transform(transform)->forward(input, output, scaling); });
+}
+
+SpfftError spfft_float_transform_get_space_domain(SpfftFloatTransform transform,
+                                                  SpfftProcessingUnitType dataLocation,
+                                                  float** data) {
+  if (transform == nullptr || data == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded(
+      [&] { *data = as_float_transform(transform)->space_domain_data(dataLocation); });
+}
+
+#define SPFFT_TPU_FLOAT_GETTER(FN, OUT_T, METHOD)                                        \
+  SpfftError FN(SpfftFloatTransform transform, OUT_T* out) {                             \
+    if (transform == nullptr || out == nullptr) return SPFFT_INVALID_HANDLE_ERROR;       \
+    return guarded(                                                                      \
+        [&] { *out = static_cast<OUT_T>(as_float_transform(transform)->METHOD()); });    \
+  }
+
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_type, SpfftTransformType, type)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_dim_x, int, dim_x)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_dim_y, int, dim_y)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_dim_z, int, dim_z)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_local_z_length, int, local_z_length)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_local_z_offset, int, local_z_offset)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_num_local_elements, int, num_local_elements)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_processing_unit, SpfftProcessingUnitType,
+                       processing_unit)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_execution_mode, SpfftExecType,
+                       execution_mode)
+
+#undef SPFFT_TPU_FLOAT_GETTER
+
+SpfftError spfft_float_transform_set_execution_mode(SpfftFloatTransform transform,
+                                                    SpfftExecType mode) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_float_transform(transform)->set_execution_mode(mode); });
+}
+
+/* ---- multi-transform ------------------------------------------------------ */
+
+SpfftError spfft_multi_transform_backward(int numTransforms, SpfftTransform* transforms,
+                                          const double* const* input,
+                                          const SpfftProcessingUnitType* outputLocations) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::Transform> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i) objs.push_back(*as_transform(transforms[i]));
+    spfft::multi_transform_backward(numTransforms, objs.data(), input, outputLocations);
+  });
+}
+
+SpfftError spfft_multi_transform_forward(int numTransforms, SpfftTransform* transforms,
+                                         const SpfftProcessingUnitType* inputLocations,
+                                         double* const* output,
+                                         const SpfftScalingType* scalingTypes) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::Transform> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i) objs.push_back(*as_transform(transforms[i]));
+    spfft::multi_transform_forward(numTransforms, objs.data(), inputLocations, output,
+                                   scalingTypes);
+  });
+}
+
+SpfftError spfft_float_multi_transform_backward(
+    int numTransforms, SpfftFloatTransform* transforms, const float* const* input,
+    const SpfftProcessingUnitType* outputLocations) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::TransformFloat> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i)
+      objs.push_back(*as_float_transform(transforms[i]));
+    spfft::multi_transform_backward(numTransforms, objs.data(), input, outputLocations);
+  });
+}
+
+SpfftError spfft_float_multi_transform_forward(
+    int numTransforms, SpfftFloatTransform* transforms,
+    const SpfftProcessingUnitType* inputLocations, float* const* output,
+    const SpfftScalingType* scalingTypes) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::TransformFloat> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i)
+      objs.push_back(*as_float_transform(transforms[i]));
+    spfft::multi_transform_forward(numTransforms, objs.data(), inputLocations, output,
+                                   scalingTypes);
+  });
+}
+
+} /* extern "C" */

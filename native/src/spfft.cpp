@@ -1,0 +1,453 @@
+/*
+ * spfft_tpu native API — C++ classes and C interface core.
+ *
+ * Structure mirrors the reference's public layer (reference:
+ * src/spfft/transform.cpp, grid.cpp, multi_transform.cpp): thin C++ classes
+ * over a shared plan object, and extern-C handle functions (capi_c.cpp) that
+ * catch GenericError and return its error code. The plan drives the XLA
+ * compute core through the bridge (see bridge.hpp) and owns the host-side
+ * space-domain buffer, which gives space_domain_data() the same
+ * write-then-forward semantics as the reference (reference:
+ * include/spfft/transform.hpp:245, examples/example.cpp usage).
+ */
+#include "bridge.hpp"
+
+#include <spfft/spfft.hpp>
+
+#include <cstring>
+#include <memory>
+#include <vector>
+
+namespace spfft {
+namespace detail {
+
+namespace br = spfft::bridge;
+
+struct Plan {
+  br::Ref py;            /* the compute-core plan object */
+  bool dbl = true;       /* double precision? */
+  long long num_values = 0;
+  long long space_reals = 0; /* reals in the space-domain slab */
+  std::vector<unsigned char> space; /* host space-domain buffer */
+
+  /* Immutable layout metadata, fetched once at plan creation so getters never
+   * re-enter the embedded runtime. */
+  struct Meta {
+    int dim_x = 0, dim_y = 0, dim_z = 0;
+    int local_z_length = 0, local_z_offset = 0;
+    int device_id = 0, num_threads = 1;
+    long long local_slice_size = 0, num_global_elements = 0, global_size = 0;
+    int transform_type = 0, processing_unit = 0;
+  } meta;
+
+  std::size_t elem_bytes() const { return dbl ? sizeof(double) : sizeof(float); }
+
+  long long get(const char* name) const {
+    br::Gil gil;
+    br::Ref r = br::call("transform_get", Py_BuildValue("(Os)", py.get(), name));
+    return br::as_longlong(r.get());
+  }
+
+  void backward(const void* input) {
+    br::Gil gil;
+    br::Ref in =
+        br::view_ro(input, static_cast<std::size_t>(2 * num_values) * elem_bytes());
+    br::Ref out = br::view_rw(space.data(), space.size());
+    br::call("transform_backward",
+             Py_BuildValue("(OOO)", py.get(), in.get(), out.get()));
+  }
+
+  void forward(const void* space_input, void* output, int scaling) {
+    br::Gil gil;
+    br::Ref in = br::view_ro(space_input,
+                             static_cast<std::size_t>(space_reals) * elem_bytes());
+    br::Ref out =
+        br::view_rw(output, static_cast<std::size_t>(2 * num_values) * elem_bytes());
+    br::call("transform_forward",
+             Py_BuildValue("(OOOi)", py.get(), in.get(), out.get(), scaling));
+  }
+
+  void set_execution_mode(int mode) {
+    br::Gil gil;
+    br::call("transform_set_execution_mode", Py_BuildValue("(Oi)", py.get(), mode));
+  }
+};
+
+struct GridState {
+  br::Ref py;
+};
+
+const std::shared_ptr<GridState>& grid_state(const Grid& grid) { return grid.state_; }
+
+Plan* plan_of(Transform& t) { return t.plan_.get(); }
+Plan* plan_of(TransformFloat& t) { return t.plan_.get(); }
+
+namespace {
+
+void finish_plan(const std::shared_ptr<Plan>& plan) {
+  Plan::Meta& m = plan->meta;
+  m.dim_x = static_cast<int>(plan->get("dim_x"));
+  m.dim_y = static_cast<int>(plan->get("dim_y"));
+  m.dim_z = static_cast<int>(plan->get("dim_z"));
+  m.local_z_length = static_cast<int>(plan->get("local_z_length"));
+  m.local_z_offset = static_cast<int>(plan->get("local_z_offset"));
+  m.device_id = static_cast<int>(plan->get("device_id"));
+  m.num_threads = static_cast<int>(plan->get("num_threads"));
+  m.local_slice_size = plan->get("local_slice_size");
+  m.num_global_elements = plan->get("num_global_elements");
+  m.global_size = plan->get("global_size");
+  m.transform_type = static_cast<int>(plan->get("transform_type"));
+  m.processing_unit = static_cast<int>(plan->get("processing_unit"));
+  plan->num_values = plan->get("num_local_elements");
+  bool r2c = m.transform_type == SPFFT_TRANS_R2C;
+  plan->space_reals = r2c ? m.local_slice_size : 2 * m.local_slice_size;
+  plan->space.assign(static_cast<std::size_t>(plan->space_reals) * plan->elem_bytes(),
+                     0);
+}
+
+} // namespace
+
+std::shared_ptr<Plan> make_plan(const Grid* grid, bool double_precision,
+                                SpfftProcessingUnitType pu, SpfftTransformType tt,
+                                int dim_x, int dim_y, int dim_z, int local_z_length,
+                                int num_local_elements, SpfftIndexFormatType fmt,
+                                const int* indices) {
+  if (fmt != SPFFT_INDEX_TRIPLETS) {
+    throw InvalidParameterError("spfft_tpu: only SPFFT_INDEX_TRIPLETS is supported");
+  }
+  if (num_local_elements < 0 || (num_local_elements > 0 && indices == nullptr)) {
+    throw InvalidParameterError("spfft_tpu: invalid index array");
+  }
+  auto plan = std::make_shared<Plan>();
+  plan->dbl = double_precision;
+  {
+    br::Gil gil;
+    br::Ref idx = br::view_ro(
+        indices, static_cast<std::size_t>(3 * num_local_elements) * sizeof(int));
+    if (grid != nullptr) {
+      plan->py = br::call(
+          "transform_create_from_grid",
+          Py_BuildValue("(OiiiiiiiOi)", grid_state(*grid)->py.get(),
+                        static_cast<int>(pu), static_cast<int>(tt), dim_x, dim_y,
+                        dim_z, local_z_length, num_local_elements, idx.get(),
+                        double_precision ? 1 : 0));
+    } else {
+      plan->py = br::call(
+          "transform_create",
+          Py_BuildValue("(iiiiiiOi)", static_cast<int>(pu), static_cast<int>(tt),
+                        dim_x, dim_y, dim_z, num_local_elements, idx.get(),
+                        double_precision ? 1 : 0));
+    }
+  }
+  finish_plan(plan);
+  return plan;
+}
+
+namespace {
+
+std::shared_ptr<Plan> clone_plan(const std::shared_ptr<Plan>& plan) {
+  auto out = std::make_shared<Plan>();
+  out->dbl = plan->dbl;
+  {
+    br::Gil gil;
+    out->py = br::call("transform_clone", Py_BuildValue("(O)", plan->py.get()));
+  }
+  finish_plan(out);
+  return out;
+}
+
+long long grid_attr(const std::shared_ptr<GridState>& state, const char* name) {
+  br::Gil gil;
+  br::Ref r = br::call("grid_get", Py_BuildValue("(Os)", state->py.get(), name));
+  return br::as_longlong(r.get());
+}
+
+} // namespace
+} // namespace detail
+
+/* ---- Grid ----------------------------------------------------------------- */
+
+Grid::Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+           SpfftProcessingUnitType processing_unit, int max_num_threads)
+    : state_(std::make_shared<detail::GridState>()) {
+  bridge::Gil gil;
+  state_->py = bridge::call(
+      "grid_create",
+      Py_BuildValue("(iiiiii)", max_dim_x, max_dim_y, max_dim_z,
+                    max_num_local_z_columns, static_cast<int>(processing_unit),
+                    max_num_threads));
+}
+
+Grid::Grid(const Grid& other) : state_(std::make_shared<detail::GridState>()) {
+  /* Fresh capacity: re-create from the other grid's parameters (the XLA
+   * backend holds no shared host buffers, so metadata equality suffices —
+   * matches the reference's fresh-buffer copy, grid_internal.cpp:233-262). */
+  bridge::Gil gil;
+  state_->py = bridge::call(
+      "grid_create",
+      Py_BuildValue("(iiiiii)", other.max_dim_x(), other.max_dim_y(),
+                    other.max_dim_z(), other.max_num_local_z_columns(),
+                    static_cast<int>(other.processing_unit()),
+                    other.max_num_threads()));
+}
+
+Grid::Grid(Grid&&) noexcept = default;
+Grid& Grid::operator=(Grid&&) noexcept = default;
+
+/* bridge::Ref acquires the GIL in its own destructor, so default teardown is
+ * safe from any thread. */
+Grid::~Grid() = default;
+
+Grid& Grid::operator=(const Grid& other) {
+  if (this != &other) {
+    Grid tmp(other);
+    state_ = std::move(tmp.state_);
+  }
+  return *this;
+}
+
+int Grid::max_dim_x() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_dim_x"));
+}
+int Grid::max_dim_y() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_dim_y"));
+}
+int Grid::max_dim_z() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_dim_z"));
+}
+int Grid::max_num_local_z_columns() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_num_local_z_columns"));
+}
+int Grid::max_local_z_length() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_local_z_length"));
+}
+SpfftProcessingUnitType Grid::processing_unit() const {
+  return static_cast<SpfftProcessingUnitType>(
+      detail::grid_attr(state_, "processing_unit"));
+}
+int Grid::device_id() const {
+  return static_cast<int>(detail::grid_attr(state_, "device_id"));
+}
+int Grid::max_num_threads() const {
+  return static_cast<int>(detail::grid_attr(state_, "max_num_threads"));
+}
+
+Transform Grid::create_transform(SpfftProcessingUnitType processing_unit,
+                                 SpfftTransformType transform_type, int dim_x, int dim_y,
+                                 int dim_z, int local_z_length, int num_local_elements,
+                                 SpfftIndexFormatType index_format,
+                                 const int* indices) const {
+  return Transform(detail::make_plan(this, true, processing_unit, transform_type, dim_x,
+                                     dim_y, dim_z, local_z_length, num_local_elements,
+                                     index_format, indices));
+}
+
+TransformFloat Grid::create_transform_float(SpfftProcessingUnitType processing_unit,
+                                            SpfftTransformType transform_type, int dim_x,
+                                            int dim_y, int dim_z, int local_z_length,
+                                            int num_local_elements,
+                                            SpfftIndexFormatType index_format,
+                                            const int* indices) const {
+  return TransformFloat(detail::make_plan(this, false, processing_unit, transform_type,
+                                          dim_x, dim_y, dim_z, local_z_length,
+                                          num_local_elements, index_format, indices));
+}
+
+/* ---- Transform (double) --------------------------------------------------- */
+
+Transform::Transform(SpfftProcessingUnitType processing_unit,
+                     SpfftTransformType transform_type, int dim_x, int dim_y, int dim_z,
+                     int num_local_elements, SpfftIndexFormatType index_format,
+                     const int* indices)
+    : plan_(detail::make_plan(nullptr, true, processing_unit, transform_type, dim_x,
+                              dim_y, dim_z, 0, num_local_elements, index_format,
+                              indices)) {}
+
+Transform Transform::clone() const { return Transform(detail::clone_plan(plan_)); }
+
+void Transform::backward(const double* input, SpfftProcessingUnitType) {
+  plan_->backward(input);
+}
+
+void Transform::forward(SpfftProcessingUnitType, double* output,
+                        SpfftScalingType scaling) {
+  plan_->forward(plan_->space.data(), output, static_cast<int>(scaling));
+}
+
+void Transform::forward(const double* input, double* output, SpfftScalingType scaling) {
+  plan_->forward(input, output, static_cast<int>(scaling));
+}
+
+double* Transform::space_domain_data(SpfftProcessingUnitType) {
+  return reinterpret_cast<double*>(plan_->space.data());
+}
+
+SpfftTransformType Transform::type() const {
+  return static_cast<SpfftTransformType>(plan_->meta.transform_type);
+}
+int Transform::dim_x() const { return plan_->meta.dim_x; }
+int Transform::dim_y() const { return plan_->meta.dim_y; }
+int Transform::dim_z() const { return plan_->meta.dim_z; }
+int Transform::local_z_length() const { return plan_->meta.local_z_length; }
+int Transform::local_z_offset() const { return plan_->meta.local_z_offset; }
+long long Transform::local_slice_size() const { return plan_->meta.local_slice_size; }
+long long Transform::num_local_elements() const { return plan_->num_values; }
+long long Transform::num_global_elements() const {
+  return plan_->meta.num_global_elements;
+}
+long long Transform::global_size() const { return plan_->meta.global_size; }
+SpfftProcessingUnitType Transform::processing_unit() const {
+  return static_cast<SpfftProcessingUnitType>(plan_->meta.processing_unit);
+}
+int Transform::device_id() const { return plan_->meta.device_id; }
+int Transform::num_threads() const { return plan_->meta.num_threads; }
+SpfftExecType Transform::execution_mode() const {
+  return static_cast<SpfftExecType>(plan_->get("execution_mode"));
+}
+void Transform::set_execution_mode(SpfftExecType mode) {
+  plan_->set_execution_mode(static_cast<int>(mode));
+}
+
+/* ---- TransformFloat ------------------------------------------------------- */
+
+TransformFloat::TransformFloat(SpfftProcessingUnitType processing_unit,
+                               SpfftTransformType transform_type, int dim_x, int dim_y,
+                               int dim_z, int num_local_elements,
+                               SpfftIndexFormatType index_format, const int* indices)
+    : plan_(detail::make_plan(nullptr, false, processing_unit, transform_type, dim_x,
+                              dim_y, dim_z, 0, num_local_elements, index_format,
+                              indices)) {}
+
+TransformFloat TransformFloat::clone() const {
+  return TransformFloat(detail::clone_plan(plan_));
+}
+
+void TransformFloat::backward(const float* input, SpfftProcessingUnitType) {
+  plan_->backward(input);
+}
+
+void TransformFloat::forward(SpfftProcessingUnitType, float* output,
+                             SpfftScalingType scaling) {
+  plan_->forward(plan_->space.data(), output, static_cast<int>(scaling));
+}
+
+void TransformFloat::forward(const float* input, float* output,
+                             SpfftScalingType scaling) {
+  plan_->forward(input, output, static_cast<int>(scaling));
+}
+
+float* TransformFloat::space_domain_data(SpfftProcessingUnitType) {
+  return reinterpret_cast<float*>(plan_->space.data());
+}
+
+SpfftTransformType TransformFloat::type() const {
+  return static_cast<SpfftTransformType>(plan_->meta.transform_type);
+}
+int TransformFloat::dim_x() const { return plan_->meta.dim_x; }
+int TransformFloat::dim_y() const { return plan_->meta.dim_y; }
+int TransformFloat::dim_z() const { return plan_->meta.dim_z; }
+int TransformFloat::local_z_length() const { return plan_->meta.local_z_length; }
+int TransformFloat::local_z_offset() const { return plan_->meta.local_z_offset; }
+long long TransformFloat::local_slice_size() const {
+  return plan_->meta.local_slice_size;
+}
+long long TransformFloat::num_local_elements() const { return plan_->num_values; }
+long long TransformFloat::num_global_elements() const {
+  return plan_->meta.num_global_elements;
+}
+long long TransformFloat::global_size() const { return plan_->meta.global_size; }
+SpfftProcessingUnitType TransformFloat::processing_unit() const {
+  return static_cast<SpfftProcessingUnitType>(plan_->meta.processing_unit);
+}
+int TransformFloat::device_id() const { return plan_->meta.device_id; }
+int TransformFloat::num_threads() const { return plan_->meta.num_threads; }
+SpfftExecType TransformFloat::execution_mode() const {
+  return static_cast<SpfftExecType>(plan_->get("execution_mode"));
+}
+void TransformFloat::set_execution_mode(SpfftExecType mode) {
+  plan_->set_execution_mode(static_cast<int>(mode));
+}
+
+/* ---- multi-transform ------------------------------------------------------ */
+
+namespace {
+
+template <typename TransformT>
+void multi_backward_impl(int n, TransformT* transforms, const void* const* input) {
+  bridge::Gil gil;
+  bridge::Ref transform_list(bridge::checked(PyList_New(n)));
+  bridge::Ref inputs(bridge::checked(PyList_New(n)));
+  bridge::Ref outputs(bridge::checked(PyList_New(n)));
+  for (int i = 0; i < n; ++i) {
+    detail::Plan* p = detail::plan_of(transforms[i]);
+    Py_INCREF(p->py.get());
+    PyList_SET_ITEM(transform_list.get(), i, p->py.get());
+    bridge::Ref in = bridge::view_ro(
+        input[i], static_cast<std::size_t>(2 * p->num_values) * p->elem_bytes());
+    PyList_SET_ITEM(inputs.get(), i, in.release());
+    bridge::Ref out = bridge::view_rw(p->space.data(), p->space.size());
+    PyList_SET_ITEM(outputs.get(), i, out.release());
+  }
+  bridge::call("multi_backward", Py_BuildValue("(OOO)", transform_list.get(),
+                                               inputs.get(), outputs.get()));
+}
+
+template <typename TransformT>
+void multi_forward_impl(int n, TransformT* transforms, void* const* output,
+                        const SpfftScalingType* scaling_types) {
+  bridge::Gil gil;
+  bridge::Ref transform_list(bridge::checked(PyList_New(n)));
+  bridge::Ref spaces(bridge::checked(PyList_New(n)));
+  bridge::Ref outputs(bridge::checked(PyList_New(n)));
+  bridge::Ref scalings(bridge::checked(PyList_New(n)));
+  for (int i = 0; i < n; ++i) {
+    detail::Plan* p = detail::plan_of(transforms[i]);
+    Py_INCREF(p->py.get());
+    PyList_SET_ITEM(transform_list.get(), i, p->py.get());
+    bridge::Ref space = bridge::view_ro(p->space.data(), p->space.size());
+    PyList_SET_ITEM(spaces.get(), i, space.release());
+    bridge::Ref out = bridge::view_rw(
+        output[i], static_cast<std::size_t>(2 * p->num_values) * p->elem_bytes());
+    PyList_SET_ITEM(outputs.get(), i, out.release());
+    PyList_SET_ITEM(scalings.get(), i,
+                    bridge::checked(PyLong_FromLong(
+                        scaling_types ? static_cast<long>(scaling_types[i]) : 0)));
+  }
+  bridge::call("multi_forward", Py_BuildValue("(OOOO)", transform_list.get(),
+                                              spaces.get(), outputs.get(),
+                                              scalings.get()));
+}
+
+} // namespace
+
+void multi_transform_backward(int num_transforms, Transform* transforms,
+                              const double* const* input,
+                              const SpfftProcessingUnitType*) {
+  multi_backward_impl(num_transforms, transforms,
+                      reinterpret_cast<const void* const*>(input));
+}
+
+void multi_transform_forward(int num_transforms, Transform* transforms,
+                             const SpfftProcessingUnitType*, double* const* output,
+                             const SpfftScalingType* scaling_types) {
+  multi_forward_impl(num_transforms, transforms,
+                     reinterpret_cast<void* const*>(const_cast<double**>(output)),
+                     scaling_types);
+}
+
+void multi_transform_backward(int num_transforms, TransformFloat* transforms,
+                              const float* const* input,
+                              const SpfftProcessingUnitType*) {
+  multi_backward_impl(num_transforms, transforms,
+                      reinterpret_cast<const void* const*>(input));
+}
+
+void multi_transform_forward(int num_transforms, TransformFloat* transforms,
+                             const SpfftProcessingUnitType*, float* const* output,
+                             const SpfftScalingType* scaling_types) {
+  multi_forward_impl(num_transforms, transforms,
+                     reinterpret_cast<void* const*>(const_cast<float**>(output)),
+                     scaling_types);
+}
+
+} // namespace spfft

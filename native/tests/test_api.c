@@ -1,0 +1,191 @@
+/*
+ * C-linkage end-to-end test of the spfft_tpu native API.
+ *
+ * Exercises the same flow as the reference example (reference:
+ * examples/example.c): build index triplets, create grid + transform,
+ * backward into the space domain, read space_domain_data, forward back with
+ * scaling, verify the round trip. Also checks the float API, clone,
+ * multi-transform and error-code behavior.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <spfft/spfft.h>
+
+#define CHECK(expr)                                                                      \
+  do {                                                                                   \
+    SpfftError e_ = (expr);                                                              \
+    if (e_ != SPFFT_SUCCESS) {                                                           \
+      fprintf(stderr, "FAIL %s:%d: %s -> %d\n", __FILE__, __LINE__, #expr, (int)e_);     \
+      return 1;                                                                          \
+    }                                                                                    \
+  } while (0)
+
+#define REQUIRE(cond)                                                                    \
+  do {                                                                                   \
+    if (!(cond)) {                                                                       \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);                    \
+      return 1;                                                                          \
+    }                                                                                    \
+  } while (0)
+
+static unsigned int rng_state = 42u;
+static double rng_uniform(void) {
+  rng_state = rng_state * 1664525u + 1013904223u;
+  return (double)(rng_state >> 8) / (double)(1u << 24) - 0.5;
+}
+
+int main(void) {
+  const int dim = 8;
+  const int n = dim * dim * dim;
+  int* indices = (int*)malloc((size_t)(3 * n) * sizeof(int));
+  int x, y, z, i, k = 0;
+  for (x = 0; x < dim; ++x)
+    for (y = 0; y < dim; ++y)
+      for (z = 0; z < dim; ++z) {
+        indices[k++] = x;
+        indices[k++] = y;
+        indices[k++] = z;
+      }
+
+  /* ---- double precision, grid-based -------------------------------------- */
+  SpfftGrid grid = NULL;
+  CHECK(spfft_grid_create(&grid, dim, dim, dim, dim * dim, SPFFT_PU_HOST, 1));
+
+  int got = 0;
+  CHECK(spfft_grid_max_dim_x(grid, &got));
+  REQUIRE(got == dim);
+
+  SpfftTransform t = NULL;
+  CHECK(spfft_transform_create(&t, grid, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim, dim, dim,
+                               dim, n, SPFFT_INDEX_TRIPLETS, indices));
+
+  CHECK(spfft_transform_dim_x(t, &got));
+  REQUIRE(got == dim);
+  CHECK(spfft_transform_num_local_elements(t, &got));
+  REQUIRE(got == n);
+  long long gs = 0;
+  CHECK(spfft_transform_global_size(t, &gs));
+  REQUIRE(gs == (long long)n);
+
+  double* freq = (double*)malloc((size_t)(2 * n) * sizeof(double));
+  for (i = 0; i < 2 * n; ++i) freq[i] = rng_uniform();
+
+  CHECK(spfft_transform_backward(t, freq, SPFFT_PU_HOST));
+
+  double* space = NULL;
+  CHECK(spfft_transform_get_space_domain(t, SPFFT_PU_HOST, &space));
+  REQUIRE(space != NULL);
+
+  /* Round trip with full scaling must reproduce the input. */
+  double* back = (double*)malloc((size_t)(2 * n) * sizeof(double));
+  CHECK(spfft_transform_forward(t, SPFFT_PU_HOST, back, SPFFT_FULL_SCALING));
+  {
+    double max_err = 0.0;
+    for (i = 0; i < 2 * n; ++i) {
+      double d = fabs(back[i] - freq[i]);
+      if (d > max_err) max_err = d;
+    }
+    printf("double roundtrip max err: %g\n", max_err);
+    REQUIRE(max_err < 1e-6);
+  }
+
+  /* Write-then-forward through the space-domain pointer: scale by 2. */
+  for (i = 0; i < 2 * n; ++i) space[i] *= 2.0;
+  CHECK(spfft_transform_forward(t, SPFFT_PU_HOST, back, SPFFT_FULL_SCALING));
+  {
+    double max_err = 0.0;
+    for (i = 0; i < 2 * n; ++i) {
+      double d = fabs(back[i] - 2.0 * freq[i]);
+      if (d > max_err) max_err = d;
+    }
+    REQUIRE(max_err < 1e-6);
+  }
+
+  /* Clone is independent but same layout. */
+  SpfftTransform tc = NULL;
+  CHECK(spfft_transform_clone(t, &tc));
+  CHECK(spfft_transform_dim_x(tc, &got));
+  REQUIRE(got == dim);
+
+  /* Multi-transform: run both plans batched. */
+  {
+    SpfftTransform pair[2];
+    const double* inputs[2];
+    double* outputs[2];
+    SpfftProcessingUnitType locs[2] = {SPFFT_PU_HOST, SPFFT_PU_HOST};
+    SpfftScalingType scals[2] = {SPFFT_FULL_SCALING, SPFFT_FULL_SCALING};
+    double* back2 = (double*)malloc((size_t)(2 * n) * sizeof(double));
+    pair[0] = t;
+    pair[1] = tc;
+    inputs[0] = freq;
+    inputs[1] = freq;
+    outputs[0] = back;
+    outputs[1] = back2;
+    CHECK(spfft_multi_transform_backward(2, pair, inputs, locs));
+    CHECK(spfft_multi_transform_forward(2, pair, locs, outputs, scals));
+    {
+      double max_err = 0.0;
+      for (i = 0; i < 2 * n; ++i) {
+        double d = fabs(back2[i] - freq[i]);
+        if (d > max_err) max_err = d;
+      }
+      REQUIRE(max_err < 1e-6);
+    }
+    free(back2);
+  }
+
+  /* ---- single precision, grid-less ---------------------------------------- */
+  {
+    SpfftFloatTransform ft = NULL;
+    float* ffreq = (float*)malloc((size_t)(2 * n) * sizeof(float));
+    float* fback = (float*)malloc((size_t)(2 * n) * sizeof(float));
+    for (i = 0; i < 2 * n; ++i) ffreq[i] = (float)rng_uniform();
+    CHECK(spfft_float_transform_create_independent(&ft, 1, SPFFT_PU_HOST,
+                                                   SPFFT_TRANS_C2C, dim, dim, dim, n,
+                                                   SPFFT_INDEX_TRIPLETS, indices));
+    CHECK(spfft_float_transform_backward(ft, ffreq, SPFFT_PU_HOST));
+    CHECK(spfft_float_transform_forward(ft, SPFFT_PU_HOST, fback, SPFFT_FULL_SCALING));
+    {
+      double max_err = 0.0;
+      for (i = 0; i < 2 * n; ++i) {
+        double d = fabs((double)fback[i] - (double)ffreq[i]);
+        if (d > max_err) max_err = d;
+      }
+      printf("float roundtrip max err: %g\n", max_err);
+      REQUIRE(max_err < 1e-4);
+    }
+    CHECK(spfft_float_transform_destroy(ft));
+    free(ffreq);
+    free(fback);
+  }
+
+  /* ---- error behavior ----------------------------------------------------- */
+  REQUIRE(spfft_transform_backward(NULL, freq, SPFFT_PU_HOST) ==
+          SPFFT_INVALID_HANDLE_ERROR);
+  {
+    /* Out-of-bounds index triplet must be rejected with an indices error. */
+    SpfftTransform bad = NULL;
+    int bad_idx[3] = {dim + 5, 0, 0};
+    SpfftError e = spfft_transform_create_independent(
+        &bad, 1, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim, dim, dim, 1,
+        SPFFT_INDEX_TRIPLETS, bad_idx);
+    REQUIRE(e == SPFFT_INVALID_INDICES_ERROR || e == SPFFT_INVALID_PARAMETER_ERROR);
+    /* Duplicate triplets must be rejected. */
+    int dup_idx[6] = {1, 1, 1, 1, 1, 1};
+    e = spfft_transform_create_independent(&bad, 1, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim,
+                                           dim, dim, 2, SPFFT_INDEX_TRIPLETS, dup_idx);
+    REQUIRE(e == SPFFT_DUPLICATE_INDICES_ERROR);
+  }
+
+  CHECK(spfft_transform_destroy(tc));
+  CHECK(spfft_transform_destroy(t));
+  CHECK(spfft_grid_destroy(grid));
+  free(freq);
+  free(back);
+  free(indices);
+  printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,258 @@
+"""Marshalling bridge for the native C/C++ API layer.
+
+The native library (``native/``) embeds a CPython interpreter and drives the
+XLA core through the functions in this module. Everything crossing the
+boundary is either a plain scalar or a writable/readonly buffer created by the
+C++ side with ``PyMemoryView_FromMemory`` — no numpy C API, no pybind11.
+
+Layout contracts (all row-major, matching the public Python API):
+- frequency values: ``num_local_elements`` complex numbers, interleaved
+  (re, im) pairs of the transform's real dtype.
+- space domain: ``(dim_z, dim_y, dim_x)`` slab; complex-interleaved for C2C,
+  real for R2C (reference semantics: docs/source/details.rst:21-27 — the
+  space-domain array of the reference is real for R2C and complex for C2C).
+- index triplets: ``3 * num_local_elements`` int32.
+
+Reference parity: this module plays the role of the reference's C-API
+implementation layer (reference: src/spfft/transform.cpp:178+ wraps the C++
+class in ``spfft_transform_*`` handle functions); here the handle lives in
+C++ and the compute core is the JAX/XLA plan object.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import errors
+from .grid import Grid
+from .multi_transform import multi_transform_backward, multi_transform_forward
+from .transform import Transform
+from .types import ExecType, ProcessingUnit, ScalingType, TransformType
+
+__all__ = [
+    "error_code",
+    "grid_create",
+    "grid_get",
+    "transform_create",
+    "transform_create_from_grid",
+    "transform_clone",
+    "transform_get",
+    "transform_set_execution_mode",
+    "transform_backward",
+    "transform_forward",
+    "multi_backward",
+    "multi_forward",
+]
+
+_SP_SUCCESS = 0
+_SP_UNKNOWN = int(errors.ErrorCode.UNKNOWN)
+
+
+def error_code(exc: BaseException) -> int:
+    """Translate a Python exception into an ``SpfftError`` C enum value.
+
+    Mirrors the reference's catch-GenericError-return-error_code pattern
+    (reference: src/spfft/transform.cpp:184-195)."""
+    if isinstance(exc, errors.GenericError):
+        return int(exc.error_code)
+    if isinstance(exc, (ValueError, TypeError, KeyError)):
+        return int(errors.ErrorCode.INVALID_PARAMETER)
+    if isinstance(exc, MemoryError):
+        return int(errors.ErrorCode.ALLOCATION)
+    return _SP_UNKNOWN
+
+
+def _real_dtype(t: Transform) -> np.dtype:
+    return np.dtype(t.dtype)
+
+
+def _complex_dtype(t: Transform) -> np.dtype:
+    return np.dtype(np.complex128 if _real_dtype(t) == np.float64 else np.complex64)
+
+
+# ---- creation ---------------------------------------------------------------
+
+
+def grid_create(
+    max_dim_x: int,
+    max_dim_y: int,
+    max_dim_z: int,
+    max_num_local_z_columns: int,
+    processing_unit: int,
+    max_num_threads: int,
+) -> Grid:
+    return Grid(
+        max_dim_x,
+        max_dim_y,
+        max_dim_z,
+        max_num_local_z_columns,
+        ProcessingUnit(processing_unit),
+        max_num_threads,
+    )
+
+
+def transform_create(
+    processing_unit: int,
+    transform_type: int,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    num_local_elements: int,
+    indices,
+    double_precision: bool,
+) -> Transform:
+    idx = np.frombuffer(indices, dtype=np.int32).copy()
+    return Transform(
+        ProcessingUnit(processing_unit),
+        TransformType(transform_type),
+        dim_x,
+        dim_y,
+        dim_z,
+        num_local_elements,
+        idx,
+        dtype=np.float64 if double_precision else np.float32,
+    )
+
+
+def transform_create_from_grid(
+    grid: Grid,
+    processing_unit: int,
+    transform_type: int,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    local_z_length: int,
+    num_local_elements: int,
+    indices,
+    double_precision: bool,
+) -> Transform:
+    idx = np.frombuffer(indices, dtype=np.int32).copy()
+    return grid.create_transform(
+        ProcessingUnit(processing_unit),
+        TransformType(transform_type),
+        dim_x,
+        dim_y,
+        dim_z,
+        num_local_elements,
+        idx,
+        local_z_length=local_z_length if local_z_length > 0 else None,
+        dtype=np.float64 if double_precision else np.float32,
+    )
+
+
+def transform_clone(t: Transform) -> Transform:
+    return t.clone()
+
+
+# ---- accessors --------------------------------------------------------------
+
+_TRANSFORM_GETTERS = {
+    "dim_x": lambda t: t.dim_x,
+    "dim_y": lambda t: t.dim_y,
+    "dim_z": lambda t: t.dim_z,
+    "local_z_length": lambda t: t.local_z_length,
+    "local_z_offset": lambda t: t.local_z_offset,
+    "local_slice_size": lambda t: t.local_slice_size,
+    "num_local_elements": lambda t: t.num_local_elements,
+    "num_global_elements": lambda t: t.num_global_elements,
+    "global_size": lambda t: t.global_size,
+    "transform_type": lambda t: int(t.transform_type),
+    "processing_unit": lambda t: int(t.processing_unit),
+    "device_id": lambda t: t.device_id,
+    "num_threads": lambda t: t.num_threads,
+    "execution_mode": lambda t: int(t.execution_mode()),
+}
+
+_GRID_GETTERS = {
+    "max_dim_x": lambda g: g.max_dim_x,
+    "max_dim_y": lambda g: g.max_dim_y,
+    "max_dim_z": lambda g: g.max_dim_z,
+    "max_num_local_z_columns": lambda g: g.max_num_local_z_columns,
+    "max_local_z_length": lambda g: g.max_local_z_length,
+    "processing_unit": lambda g: int(g.processing_unit),
+    "max_num_threads": lambda g: g.max_num_threads,
+    "device_id": lambda g: 0,
+}
+
+
+def transform_get(t: Transform, name: str) -> int:
+    return int(_TRANSFORM_GETTERS[name](t))
+
+
+def grid_get(g: Grid, name: str) -> int:
+    return int(_GRID_GETTERS[name](g))
+
+
+def transform_set_execution_mode(t: Transform, mode: int) -> None:
+    t.set_execution_mode(ExecType(mode))
+
+
+# ---- execution --------------------------------------------------------------
+
+
+def _freq_from_buffer(t: Transform, buf) -> np.ndarray:
+    n = t.num_local_elements
+    vals = np.frombuffer(buf, dtype=_real_dtype(t), count=2 * n)
+    return vals.view(_complex_dtype(t))
+
+
+def _space_size_reals(t: Transform) -> int:
+    n = t.local_slice_size
+    return n if int(t.transform_type) == int(TransformType.R2C) else 2 * n
+
+
+
+def _write_space(t: Transform, out, buf) -> None:
+    """Copy a space-domain result into a caller buffer (R2C: real, C2C:
+    complex-interleaved)."""
+    dst = np.frombuffer(buf, dtype=_real_dtype(t), count=_space_size_reals(t))
+    if int(t.transform_type) == int(TransformType.R2C):
+        dst[:] = np.asarray(out, dtype=_real_dtype(t)).ravel()
+    else:
+        dst.view(_complex_dtype(t))[:] = np.asarray(out).ravel()
+
+
+def _read_space(t: Transform, buf) -> np.ndarray:
+    """View a caller space-domain buffer as the (Z, Y, X) slab."""
+    flat = np.frombuffer(buf, dtype=_real_dtype(t), count=_space_size_reals(t))
+    if int(t.transform_type) == int(TransformType.R2C):
+        return flat.reshape(t.dim_z, t.dim_y, t.dim_x)
+    return flat.view(_complex_dtype(t)).reshape(t.dim_z, t.dim_y, t.dim_x)
+
+
+def _write_freq(t: Transform, vals, buf) -> None:
+    """Copy packed frequency values into a caller buffer."""
+    n = t.num_local_elements
+    dst = np.frombuffer(buf, dtype=_real_dtype(t), count=2 * n)
+    dst.view(_complex_dtype(t))[:] = np.asarray(vals).ravel()
+
+
+def transform_backward(t: Transform, values_buf, space_out_buf) -> None:
+    """Freq -> space; writes the (Z, Y, X) slab into ``space_out_buf``."""
+    _write_space(t, t.backward(_freq_from_buffer(t, values_buf)), space_out_buf)
+
+
+def transform_forward(t: Transform, space_buf, values_out_buf, scaling: int) -> None:
+    """Space -> freq; ``space_buf`` of None reads the retained space buffer
+    (the reference's pointer-free forward overload)."""
+    space = None if space_buf is None else _read_space(t, space_buf)
+    _write_freq(t, t.forward(space, ScalingType(scaling)), values_out_buf)
+
+
+# ---- multi-transform --------------------------------------------------------
+
+
+def multi_backward(transforms, values_bufs, space_out_bufs) -> None:
+    """Pipelined batched backward (reference: include/spfft/multi_transform.hpp:48)."""
+    values = [_freq_from_buffer(t, b) for t, b in zip(transforms, values_bufs)]
+    outs = multi_transform_backward(list(transforms), values)
+    for t, out, buf in zip(transforms, outs, space_out_bufs):
+        _write_space(t, out, buf)
+
+
+def multi_forward(transforms, space_bufs, values_out_bufs, scalings) -> None:
+    spaces = [None if b is None else _read_space(t, b) for t, b in zip(transforms, space_bufs)]
+    results = multi_transform_forward(
+        list(transforms), spaces, [ScalingType(s) for s in scalings]
+    )
+    for t, vals, buf in zip(transforms, results, values_out_bufs):
+        _write_freq(t, vals, buf)

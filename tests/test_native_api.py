@@ -1,0 +1,51 @@
+"""Build + run the native C/C++ API test executable.
+
+The native library (native/) is the C/C++/Fortran-facing runtime layer over
+the XLA core — the analogue of the reference's installed library surface
+(reference: include/spfft/*.h, src/spfft/*.cpp). This test drives the same
+flow as the reference's C example (reference: examples/example.c) through
+the compiled library to prove the full C ABI works, including error codes.
+"""
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+BUILD = NATIVE / "build"
+
+
+@pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("g++") is None,
+    reason="native toolchain unavailable",
+)
+def test_native_c_api_roundtrip():
+    generator = ["-G", "Ninja"] if shutil.which("ninja") else []
+    if not (BUILD / "CMakeCache.txt").exists():
+        subprocess.run(
+            ["cmake", "-S", str(NATIVE), "-B", str(BUILD), "-DCMAKE_BUILD_TYPE=Release"]
+            + generator,
+            check=True,
+            capture_output=True,
+        )
+    subprocess.run(
+        ["cmake", "--build", str(BUILD)], check=True, capture_output=True
+    )
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # The embedded interpreter must not inherit the virtual-mesh test config.
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [str(BUILD / "run_native_tests")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "ALL NATIVE TESTS PASSED" in result.stdout
