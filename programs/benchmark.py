@@ -102,7 +102,17 @@ def main(argv=None):
         "--precision", choices=["single", "double"], default=None,
         help="default: double on cpu, single on accelerators",
     )
+    ap.add_argument(
+        "--engine", choices=["auto", "mxu", "xla"], default="auto",
+        help="local execution engine (default: auto-select)",
+    )
+    ap.add_argument(
+        "--matmul-precision", choices=["highest", "high"], default="highest",
+        help="MXU engine matmul precision (high trades ~1e-5 accuracy for speed)",
+    )
     args = ap.parse_args(argv)
+    if args.shards > 1 and (args.engine != "auto" or args.matmul_precision != "highest"):
+        ap.error("--engine/--matmul-precision apply to local runs only (not --shards > 1)")
 
     import os
 
@@ -155,7 +165,10 @@ def main(argv=None):
                     for _ in range(args.m)
                 ]
             return [
-                sp.Transform(pu, ttype, dim_x, dim_y, dim_z, indices=triplets, dtype=dtype)
+                sp.Transform(
+                    pu, ttype, dim_x, dim_y, dim_z, indices=triplets, dtype=dtype,
+                    engine=args.engine, precision=args.matmul_precision,
+                )
                 for _ in range(args.m)
             ]
 

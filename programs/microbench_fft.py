@@ -1,0 +1,145 @@
+"""Microbenchmark: batched 1D complex DFT strategies on TPU.
+
+Compares, for the batched stage shapes the 3D pipeline actually issues
+((batch, N) contracted over N):
+
+  direct   -- (batch, N) @ (N, N) DFT matrix, 4 real matmuls (current MXU engine)
+  ct       -- Cooley-Tukey four-step N = N1*N2: DFT over N2, twiddle, DFT over N1
+  xla_fft  -- jnp.fft.fft along the last axis (XLA's native FFT lowering)
+
+Run: python programs/microbench_fft.py [--ns 128,256,512] [--reps 20]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+PRECISION = jax.lax.Precision.HIGHEST
+
+
+def dft_matrix(n, sign=+1, dtype=np.float32):
+    k = np.arange(n)
+    w = np.exp(sign * 2j * np.pi * np.outer(k, k) / n)
+    return w.real.astype(dtype), w.imag.astype(dtype)
+
+
+def cmatmul(xr, xi, wr, wi, spec):
+    yr = jnp.einsum(spec, xr, wr, precision=PRECISION) - jnp.einsum(
+        spec, xi, wi, precision=PRECISION
+    )
+    yi = jnp.einsum(spec, xr, wi, precision=PRECISION) + jnp.einsum(
+        spec, xi, wr, precision=PRECISION
+    )
+    return yr, yi
+
+
+def make_direct(n, dtype):
+    wr, wi = dft_matrix(n, dtype=dtype)
+    wr, wi = jnp.asarray(wr), jnp.asarray(wi)
+
+    def f(xr, xi):
+        return cmatmul(xr, xi, wr, wi, "bn,nk->bk")
+
+    return jax.jit(f)
+
+
+def split_factors(n):
+    """Pick N1*N2 = n with N2 as close to 128 as possible (MXU contraction dim)."""
+    best = None
+    for n2 in range(1, n + 1):
+        if n % n2:
+            continue
+        n1 = n // n2
+        score = abs(n2 - 128) + abs(n1 - 128) * 0.1
+        if best is None or score < best[0]:
+            best = (score, n1, n2)
+    return best[1], best[2]
+
+
+def make_ct(n, dtype):
+    n1, n2 = split_factors(n)
+    w2r, w2i = dft_matrix(n2, dtype=dtype)
+    w1r, w1i = dft_matrix(n1, dtype=dtype)
+    # twiddle[j1, k2] = exp(2i pi j1 k2 / n)  (sign +1 backward convention)
+    j1, k2 = np.arange(n1), np.arange(n2)
+    tw = np.exp(2j * np.pi * np.outer(j1, k2) / n)
+    twr, twi = jnp.asarray(tw.real.astype(dtype)), jnp.asarray(tw.imag.astype(dtype))
+    w2r, w2i, w1r, w1i = map(jnp.asarray, (w2r, w2i, w1r, w1i))
+
+    def f(xr, xi):
+        # x[b, j1*n2 + j2] -> X[b, k1 + n1*k2]  (four-step)
+        xr_ = xr.reshape(-1, n1, n2)
+        xi_ = xi.reshape(-1, n1, n2)
+        # inner DFT over j2 -> k2
+        yr, yi = cmatmul(xr_, xi_, w2r, w2i, "bjn,nk->bjk")
+        # twiddle
+        zr = yr * twr - yi * twi
+        zi = yr * twi + yi * twr
+        # outer DFT over j1 -> k1
+        or_, oi_ = cmatmul(zr, zi, w1r, w1i, "bjk,jm->bmk")
+        # output index is k1 + n1*k2 => layout (m, k) flatten order (k2 major?):
+        # X[k1 + n1*k2] -> reshape (n2, n1) transposed; return flattened (b, n)
+        return or_.transpose(0, 2, 1).reshape(-1, n), oi_.transpose(0, 2, 1).reshape(-1, n)
+
+    return jax.jit(f), (n1, n2)
+
+
+def make_xla_fft(n):
+    def f(xr, xi):
+        out = jnp.fft.ifft(jax.lax.complex(xr, xi), axis=-1) * n
+        return out.real, out.imag
+
+    return jax.jit(f)
+
+
+def timeit(f, args, reps):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default="128,256,512")
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+    dtype = np.dtype(args.dtype)
+
+    rng = np.random.default_rng(0)
+    for n in [int(x) for x in args.ns.split(",")]:
+        batch = n * n
+        xr = jnp.asarray(rng.standard_normal((batch, n)).astype(dtype))
+        xi = jnp.asarray(rng.standard_normal((batch, n)).astype(dtype))
+
+        direct = make_direct(n, dtype)
+        ct, (n1, n2) = make_ct(n, dtype)
+        xf = make_xla_fft(n)
+
+        # correctness vs numpy
+        ref = np.fft.ifft(np.asarray(xr) + 1j * np.asarray(xi), axis=-1) * n
+        for name, f in (("direct", direct), ("ct", ct), ("xla_fft", xf)):
+            rr, ri = f(xr, xi)
+            err = np.max(np.abs((np.asarray(rr) + 1j * np.asarray(ri)) - ref)) / np.max(
+                np.abs(ref)
+            )
+            t = timeit(f, (xr, xi), args.reps)
+            extra = f" (n1={n1},n2={n2})" if name == "ct" else ""
+            gflops = 5 * batch * n * np.log2(n) / t / 1e9
+            print(
+                f"N={n:4d} batch={batch:6d} {name:8s}{extra:16s} "
+                f"{t*1e3:8.3f} ms  rel_err={err:.2e}  eff_gflops={gflops:8.1f}"
+            )
+
+
+if __name__ == "__main__":
+    main()

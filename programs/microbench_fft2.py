@@ -1,0 +1,143 @@
+"""Microbench round 2: precision tiers, fixed four-step, radix-2 hybrid."""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def dft_matrix(n, sign=+1, dtype=np.float32):
+    k = np.arange(n)
+    w = np.exp(sign * 2j * np.pi * np.outer(k, k) / n)
+    return jnp.asarray(w.real.astype(dtype)), jnp.asarray(w.imag.astype(dtype))
+
+
+def make_cmatmul(precision):
+    def cmatmul(xr, xi, wr, wi, spec):
+        yr = jnp.einsum(spec, xr, wr, precision=precision) - jnp.einsum(
+            spec, xi, wi, precision=precision
+        )
+        yi = jnp.einsum(spec, xr, wi, precision=precision) + jnp.einsum(
+            spec, xi, wr, precision=precision
+        )
+        return yr, yi
+
+    return cmatmul
+
+
+def make_direct(n, dtype, precision):
+    wr, wi = dft_matrix(n, dtype=dtype)
+    cm = make_cmatmul(precision)
+    return jax.jit(lambda xr, xi: cm(xr, xi, wr, wi, "bn,nk->bk"))
+
+
+def make_ct(n, n1, dtype, precision):
+    """Four-step, correct index math: x[j1*n2+j2]; DFT over j1 -> k1; twiddle
+    W^{k1 j2}; DFT over j2 -> k2; out[k] = X[k1 + n1*k2]."""
+    n2 = n // n1
+    w1r, w1i = dft_matrix(n1, dtype=dtype)
+    w2r, w2i = dft_matrix(n2, dtype=dtype)
+    k1, j2 = np.arange(n1), np.arange(n2)
+    tw = np.exp(2j * np.pi * np.outer(k1, j2) / n)
+    twr, twi = jnp.asarray(tw.real.astype(dtype)), jnp.asarray(tw.imag.astype(dtype))
+    cm = make_cmatmul(precision)
+
+    def f(xr, xi):
+        xr_ = xr.reshape(-1, n1, n2)
+        xi_ = xi.reshape(-1, n1, n2)
+        yr, yi = cm(xr_, xi_, w1r, w1i, "bjn,jk->bkn")  # DFT over j1 -> k1
+        zr = yr * twr - yi * twi
+        zi = yr * twi + yi * twr
+        or_, oi_ = cm(zr, zi, w2r, w2i, "bkn,nm->bkm")  # DFT over j2 -> k2
+        # X[k1, k2] flat index k1 + n1*k2 -> row-major order is (k2, k1)
+        return (
+            or_.transpose(0, 2, 1).reshape(-1, n),
+            oi_.transpose(0, 2, 1).reshape(-1, n),
+        )
+
+    return jax.jit(f)
+
+
+def make_radix2(n, dtype, precision):
+    """One DIF radix-2 butterfly (VPU) + two half-size DFT matmuls.
+    X[2k]  = DFT_{n/2}(x[j] + x[j+n/2])
+    X[2k+1]= DFT_{n/2}((x[j] - x[j+n/2]) * W^j),  W = exp(+2i pi / n)."""
+    h = n // 2
+    whr, whi = dft_matrix(h, dtype=dtype)
+    j = np.arange(h)
+    tw = np.exp(2j * np.pi * j / n)
+    twr, twi = jnp.asarray(tw.real.astype(dtype)), jnp.asarray(tw.imag.astype(dtype))
+    cm = make_cmatmul(precision)
+
+    def f(xr, xi):
+        ar, ai = xr[:, :h], xi[:, :h]
+        br, bi = xr[:, h:], xi[:, h:]
+        er, ei = ar + br, ai + bi
+        dr, di = ar - br, ai - bi
+        odr = dr * twr - di * twi
+        odi = dr * twi + di * twr
+        # batch the two half-DFTs together as one matmul
+        sr = jnp.concatenate([er, odr], axis=0)
+        si = jnp.concatenate([ei, odi], axis=0)
+        yr, yi = cm(sr, si, whr, whi, "bn,nk->bk")
+        b = xr.shape[0]
+        out_r = jnp.stack([yr[:b], yr[b:]], axis=-1).reshape(b, n)
+        out_i = jnp.stack([yi[:b], yi[b:]], axis=-1).reshape(b, n)
+        return out_r, out_i
+
+    return jax.jit(f)
+
+
+def timeit(f, args, reps):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default="128,256,512")
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+    dtype = np.dtype("float32")
+    P = jax.lax.Precision
+
+    rng = np.random.default_rng(0)
+    for n in [int(x) for x in args.ns.split(",")]:
+        batch = n * n
+        xr = jnp.asarray(rng.standard_normal((batch, n)).astype(dtype))
+        xi = jnp.asarray(rng.standard_normal((batch, n)).astype(dtype))
+        ref = np.fft.ifft(np.asarray(xr) + 1j * np.asarray(xi), axis=-1) * n
+
+        cands = {
+            "direct/HIGHEST": make_direct(n, dtype, P.HIGHEST),
+            "direct/HIGH": make_direct(n, dtype, P.HIGH),
+            "radix2/HIGHEST": make_radix2(n, dtype, P.HIGHEST),
+            "radix2/HIGH": make_radix2(n, dtype, P.HIGH),
+        }
+        if n == 256:
+            cands["ct16x16/HIGHEST"] = make_ct(n, 16, dtype, P.HIGHEST)
+            cands["ct2x128/HIGHEST"] = make_ct(n, 2, dtype, P.HIGHEST)
+        if n == 512:
+            cands["ct4x128/HIGHEST"] = make_ct(n, 4, dtype, P.HIGHEST)
+            cands["ct4x128/HIGH"] = make_ct(n, 4, dtype, P.HIGH)
+
+        for name, f in cands.items():
+            rr, ri = f(xr, xi)
+            err = np.max(np.abs((np.asarray(rr) + 1j * np.asarray(ri)) - ref)) / np.max(
+                np.abs(ref)
+            )
+            t = timeit(f, (xr, xi), args.reps)
+            print(f"N={n:4d} {name:18s} {t*1e3:8.3f} ms  rel_err={err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,7 @@ class Transform:
         grid: Grid | None = None,
         dtype=None,
         engine: str = "auto",
+        precision: str = "highest",
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -88,6 +89,10 @@ class Transform:
         if self._real_dtype not in (np.dtype(np.float32), np.dtype(np.float64)):
             raise InvalidParameterError("dtype must be float32 or float64")
 
+        from .ops.fft import resolve_precision
+
+        resolve_precision(precision)  # validate up front on every engine path
+
         device = device_for_processing_unit(self._processing_unit)
         # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
         # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
@@ -101,7 +106,7 @@ class Transform:
                 from .execution_mxu import MxuLocalExecution
 
                 self._exec = MxuLocalExecution(
-                    self._params, self._real_dtype, device=device
+                    self._params, self._real_dtype, device=device, precision=precision
                 )
                 self._native_transposed = True
             elif engine == "xla":
@@ -110,6 +115,7 @@ class Transform:
             else:
                 raise InvalidParameterError(f"unknown engine {engine!r}")
         self._engine = engine
+        self._precision = precision
         self._space_data = None
 
     # ---- transforms -----------------------------------------------------------
@@ -289,6 +295,7 @@ class Transform:
             grid=self._grid,
             dtype=self._real_dtype,
             engine=self._engine,
+            precision=self._precision,
         )
 
     # ---- accessors, parity with include/spfft/transform.hpp:147-245 -----------
