@@ -12,19 +12,23 @@ holes) into row-granular work, exploiting that sparse-FFT value orders are
 *piecewise contiguous* (values grouped by z-stick in z order — the layout plane-wave
 callers use, reference: docs/source/details.rst:53):
 
-1. each 128-lane destination block is covered by <= ``max_runs`` affine runs
-   (``src - lane == const``),
+1. each 128-lane destination block is decomposed into affine runs
+   (``src - lane == const``); the k-th run of every block goes to pipe k, and
+   pipe k only covers the blocks that *have* a k-th run (so fragmented tails cost
+   work proportional to the total number of runs, not max-runs x blocks),
 2. per run: the source window ``src0 .. src0+127`` is fetched by TWO whole-row
    gathers (rows ``src0//128`` and ``+1``),
 3. lane alignment (``src0 % 128``) is resolved by grouping blocks by shift and
    taking one *static* 128-wide slice per shift group (<=128 static slices),
 4. block order is restored with one more row-gather, and holes/run boundaries are
-   applied with a static 0/1 mask multiply.
+   applied with a static 0/1 mask multiply; pipe 0 (full coverage) writes the
+   output directly, later pipes row-scatter-add into their block subset.
 
 Everything is planned host-side at Transform creation; at runtime the copy is a
-handful of fused row-gathers, slices and multiplies — no scatter, no element gather.
-Falls back to ``None`` when the order is too fragmented (caller then uses the plain
-scatter path).
+handful of fused row-gathers, slices, multiplies and row-granular scatter-adds —
+no element scatter, no element gather. Falls back to ``None`` only when the order
+is pathologically fragmented (> ``max_runs`` runs in one block; caller then uses
+the plain scatter path).
 """
 from __future__ import annotations
 
@@ -39,13 +43,15 @@ LANE = 128
 
 @dataclasses.dataclass(frozen=True)
 class _RunPipe:
-    """One affine-run pipeline: row indices (shift-sorted), shift group sizes,
-    inverse row order, and the 0/1 mask."""
+    """One affine-run pipeline over a subset of destination blocks: row indices
+    (shift-sorted), shift group sizes, inverse row order, the 0/1 mask, and the
+    destination block ids this pipe covers (None = all blocks, in order)."""
 
-    rows_sorted: np.ndarray  # (R,) int32 source row per block, in shift-group order
+    rows_sorted: np.ndarray  # (Rk,) int32 source row per covered block, shift-sorted
     shift_counts: tuple  # len-128 tuple of group sizes
-    inv_order: np.ndarray  # (R,) int32 restoring natural block order
-    mask: np.ndarray  # (R, LANE) float32 0/1
+    inv_order: np.ndarray  # (Rk,) int32 restoring natural covered-block order
+    mask: np.ndarray  # (Rk, LANE) float32 0/1
+    block_ids: np.ndarray | None  # (Rk,) int32 destination blocks, or None = all
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,9 +64,11 @@ class CopyPlan:
     pipes: tuple  # tuple of _RunPipe
 
     @staticmethod
-    def build(src_of_dst: np.ndarray, num_src: int, max_runs: int = 2):
+    def build(src_of_dst: np.ndarray, num_src: int, max_runs: int = 64):
         """Build a plan from the per-destination source index (-1 = hole), or return
-        None if any destination block needs more than ``max_runs`` affine runs."""
+        None if any destination block needs more than ``max_runs`` affine runs
+        (work scales with the *total* run count, so the cap is just a sanity bound
+        against pathological per-element fragmentation)."""
         m = np.asarray(src_of_dst, dtype=np.int64)
         D = ((m.size + LANE - 1) // LANE) * LANE
         pad = np.full(D - m.size, -1, dtype=np.int64)
@@ -72,39 +80,42 @@ class CopyPlan:
         base = blocks - lanes[None, :]
         filled = blocks >= 0
 
-        starts = [np.zeros(R, np.int64) for _ in range(max_runs)]
-        masks = [np.zeros((R, LANE), np.float32) for _ in range(max_runs)]
+        # per-pipe sparse assembly: pipe k holds the k-th run of each block that
+        # has one — (block id, run base, lane mask) triples
+        per_pipe: list[list] = []
         for r in range(R):
             if not filled[r].any():
                 continue
             vals = np.unique(base[r][filled[r]])
             if vals.size > max_runs:
                 return None
+            while len(per_pipe) < vals.size:
+                per_pipe.append([])
             for k, v in enumerate(vals):
-                starts[k][r] = v
-                masks[k][r] = (base[r] == v) & filled[r]
+                per_pipe[k].append((r, v, (base[r] == v) & filled[r]))
 
-        # drop pipes that are entirely empty
         pipes = []
         # source view: one zero lead row (handles negative run bases: a run that
         # starts mid-block has base in (-LANE, 0)), the data, two zero tail rows
         # (window overhang); mask guards every out-of-run lane.
         src_rows = 1 + (num_src + LANE - 1) // LANE + 2
-        for k in range(max_runs):
-            if not masks[k].any():
-                continue
-            start = starts[k] + LANE  # bias by the zero lead row; now >= 1
+        for k, entries in enumerate(per_pipe):
+            block_ids = np.asarray([e[0] for e in entries], dtype=np.int32)
+            start = np.asarray([e[1] for e in entries], dtype=np.int64) + LANE
+            mask = np.stack([e[2] for e in entries]).astype(np.float32)
             assert (start >= 0).all()
             rowA = (start // LANE).astype(np.int32)
             shift = (start % LANE).astype(np.int32)
             order = np.argsort(shift, kind="stable").astype(np.int32)
             counts = tuple(int((shift == t).sum()) for t in range(LANE))
+            full = block_ids.size == R and (block_ids == np.arange(R)).all()
             pipes.append(
                 _RunPipe(
                     rows_sorted=rowA[order],
                     shift_counts=counts,
                     inv_order=np.argsort(order).astype(np.int32),
-                    mask=masks[k],
+                    mask=mask,
+                    block_ids=None if full else block_ids,
                 )
             )
         return CopyPlan(num_dst=D, num_src=num_src, src_rows=src_rows, pipes=tuple(pipes))
@@ -132,7 +143,7 @@ class CopyPlan:
             w = jnp.concatenate(
                 [jnp.take(src2, rows, axis=0), jnp.take(src2, rows + 1, axis=0)],
                 axis=1,
-            )  # (R, 2*LANE), rows in shift order
+            )  # (Rk, 2*LANE), covered blocks in shift order
             pieces = []
             off = 0
             for t, c in enumerate(pipe.shift_counts):
@@ -143,19 +154,27 @@ class CopyPlan:
             aligned = jnp.concatenate(pieces, axis=0)
             aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
             contrib = aligned * jnp.asarray(pipe.mask, dtype=flat.dtype)
-            out = contrib if out is None else out + contrib
+            if pipe.block_ids is None:
+                out = contrib if out is None else out + contrib
+            else:
+                if out is None:
+                    out = jnp.zeros((self.num_dst // LANE, LANE), dtype=flat.dtype)
+                # row-granular scatter-add into the covered blocks (unique ids)
+                out = out.at[jnp.asarray(pipe.block_ids)].add(
+                    contrib, unique_indices=True, mode="drop"
+                )
         if out is None:
             out = jnp.zeros((self.num_dst // LANE, LANE), dtype=flat.dtype)
         return out
 
 
-def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values: int, max_runs: int = 2):
+def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values: int, max_runs: int = 64):
     """Plan scattering packed values into stick slots: dst = slot, src = value pos."""
     src_of_dst = np.full(num_slots, -1, dtype=np.int64)
     src_of_dst[np.asarray(value_indices, dtype=np.int64)] = np.arange(num_values)
     return CopyPlan.build(src_of_dst, num_values, max_runs)
 
 
-def build_compress_plan(value_indices: np.ndarray, num_slots: int, max_runs: int = 2):
+def build_compress_plan(value_indices: np.ndarray, num_slots: int, max_runs: int = 64):
     """Plan gathering packed values out of stick slots: dst = value pos, src = slot."""
     return CopyPlan.build(np.asarray(value_indices, dtype=np.int64), num_slots, max_runs)
