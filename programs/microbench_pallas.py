@@ -1,0 +1,136 @@
+"""Microbench: Pallas fused complex matmul vs 4-einsum, on real plan shapes.
+
+Builds the 256^3 spherical-cutoff plan, extracts the actual MXU stage shapes,
+and times both paths on the attached device. Decides whether wiring
+ops/pallas_fft.complex_matmul_fused into the engine pays.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import spfft_tpu as sp
+from spfft_tpu.execution_mxu import MxuLocalExecution
+from spfft_tpu.ops import fft as offt
+from spfft_tpu.ops import pallas_fft
+from spfft_tpu.parameters import make_local_parameters
+from spfft_tpu.types import TransformType
+
+
+def timeit(fn, args, reps=200):
+    """Time `reps` dependent iterations inside ONE compiled scan (excludes the
+    per-dispatch tunnel latency, same methodology as programs/benchmark.py)."""
+
+    @jax.jit
+    def loop(a, b):
+        def body(carry, _):
+            r, i = fn(carry[0], carry[1])
+            return (r, i), ()
+
+        (r, i), _ = jax.lax.scan(body, (a, b), None, length=reps)
+        return r.ravel()[0] + i.ravel()[0]
+
+    # Fence by fetching the scalar: block_until_ready does NOT wait for
+    # execution on the tunneled axon TPU (see benchmark.py's fence()).
+    float(loop(*args))
+    t0 = time.perf_counter()
+    out = float(loop(*args))
+    del out
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--sparsity", type=float, default=0.15)
+    args = ap.parse_args()
+
+    d = args.dim
+    trip = sp.create_spherical_cutoff_triplets(d, d, d, args.sparsity)
+    params = make_local_parameters(TransformType.C2C, d, d, d, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float32)
+    S, Z, Y, A = params.num_sticks, params.dim_z, params.dim_y, ex._num_x_active
+    print(f"plan: S={S} Z={Z} Y={Y} A={A}")
+
+    rng = np.random.default_rng(0)
+    prec = jax.lax.Precision.HIGHEST
+
+    # ---- z stage: (S, Z) @ (Z, Z), pure 2D ----
+    # pad S to sublane multiple for the pallas variant
+    Sp = -(-S // 8) * 8
+    xr = jnp.asarray(rng.standard_normal((Sp, Z)).astype(np.float32))
+    xi = jnp.asarray(rng.standard_normal((Sp, Z)).astype(np.float32))
+    wr, wi = (jnp.asarray(w) for w in ex._wz_b)
+
+    ein = jax.jit(
+        lambda a, b: offt.complex_matmul(a, b, wr, wi, "sz,zk->sk", prec)
+    )
+    t_ein = timeit(ein, (xr, xi))
+
+    if pallas_fft.supports(Sp, Z, Z, np.float32):
+        pal = jax.jit(
+            lambda a, b: pallas_fft.complex_matmul_fused(a, b, wr, wi)
+        )
+        t_pal = timeit(pal, (xr, xi))
+        # check numerics
+        er, ei = jax.device_get(ein(xr, xi))
+        pr, pi = jax.device_get(pal(xr, xi))
+        err = max(
+            float(np.abs(er - pr).max()), float(np.abs(ei - pi).max())
+        )
+    else:
+        t_pal, err = float("nan"), float("nan")
+    print(
+        f"z-stage  ({Sp}x{Z} @ {Z}x{Z}):  einsum {t_ein*1e3:8.3f} ms   "
+        f"pallas {t_pal*1e3:8.3f} ms   maxerr {err:.2e}"
+    )
+
+    # ---- y stage as W@X 2D: (Y,Y) @ (Y, A*Z) via x-transposed form ----
+    # einsum native 3D form
+    g_r = jnp.asarray(rng.standard_normal((Y, A, Z)).astype(np.float32))
+    g_i = jnp.asarray(rng.standard_normal((Y, A, Z)).astype(np.float32))
+    wyr, wyi = (jnp.asarray(w) for w in ex._wy_b)
+    ein_y = jax.jit(
+        lambda a, b: offt.complex_matmul(a, b, wyr, wyi, "yxz,yk->kxz", prec)
+    )
+    t_ein_y = timeit(ein_y, (g_r, g_i))
+
+    # pallas: reshape to (Y, A*Z), want W^T X -> compute (X^T W)^T without
+    # materialized transpose? Here just test X-major form: (A*Z, Y) @ (Y, K).
+    h_r = jnp.asarray(np.ascontiguousarray(
+        np.moveaxis(np.asarray(g_r), 0, -1).reshape(A * Z, Y)))
+    h_i = jnp.asarray(np.ascontiguousarray(
+        np.moveaxis(np.asarray(g_i), 0, -1).reshape(A * Z, Y)))
+    if pallas_fft.supports(A * Z, Y, Y, np.float32):
+        pal_y = jax.jit(
+            lambda a, b: pallas_fft.complex_matmul_fused(a, b, wyr, wyi)
+        )
+        t_pal_y = timeit(pal_y, (h_r, h_i))
+    else:
+        t_pal_y = float("nan")
+    print(
+        f"y-stage  3D einsum {t_ein_y*1e3:8.3f} ms   "
+        f"pallas-2D ({A*Z}x{Y} @ {Y}x{Y}) {t_pal_y*1e3:8.3f} ms"
+    )
+
+    # ---- x stage einsum for context ----
+    wxr, wxi = (jnp.asarray(w) for w in ex._wx_b)
+    def ein_x(a, b):
+        r, i = offt.complex_matmul(a, b, wxr, wxi, "kxz,xl->klz", prec)
+        return r[:, :A, :], i[:, :A, :]  # slice back so the scan chains
+
+    t_ein_x = timeit(ein_x, (g_r, g_i))
+    print(f"x-stage  3D einsum {t_ein_x*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

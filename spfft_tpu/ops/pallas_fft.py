@@ -1,0 +1,109 @@
+"""Pallas TPU kernels for the MXU DFT stages.
+
+The MXU engine's complex DFT stage is 4 real matmuls (ops/fft.complex_matmul);
+XLA compiles them as separate fusions, so the (re, im) operand pair is read from
+HBM twice and intermediate products round-trip once more. This module fuses the
+whole complex contraction into ONE Pallas kernel: each (re, im) input tile is
+loaded into VMEM once, both DFT matrix parts stay VMEM-resident across the batch,
+and both outputs are produced in the same pass — halving operand traffic for the
+bandwidth-bound stages (small-N DFTs over large batches).
+
+The kernel is shape-restricted (operands tiled on (8, 128) f32 boundaries).
+Reference analogue: the fused cuFFT 2D plans of the GPU backend (reference:
+src/fft/transform_2d_gpu.hpp:47-149) — one fused pass where the host path does
+separate ones.
+
+Measured on TPU v5e at the 256^3/15%-spherical plan shapes
+(programs/microbench_pallas.py; scan-loop timing, scalar-fetch fence): the
+fused kernel does NOT beat XLA's einsum lowering — z-stage (1160x256 @
+256x256) 0.57 ms fused vs 0.47 ms einsum; y-stage (10240x256 @ 256x256)
+0.65 ms vs 0.43 ms. XLA already fuses the 4-matmul complex product well. The
+einsum path (ops/fft.complex_matmul) therefore stays the engine default; this
+kernel is kept as a building block for shapes where manual VMEM residency wins
+(re-measure before wiring in).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(xr_ref, xi_ref, wr_ref, wi_ref, yr_ref, yi_ref, *, precision):
+    xr, xi = xr_ref[:], xi_ref[:]
+    wr, wi = wr_ref[:], wi_ref[:]
+    dot = functools.partial(
+        jnp.dot, preferred_element_type=jnp.float32, precision=precision
+    )
+    yr_ref[:] = dot(xr, wr) - dot(xi, wi)
+    yi_ref[:] = dot(xr, wi) + dot(xi, wr)
+
+
+def supports(m: int, k: int, n: int, dtype, block_m: int = 256) -> bool:
+    """True if the fused kernel handles an (m, k) @ (k, n) complex contraction.
+
+    VMEM budget: both W parts stay resident for the whole grid, and each grid
+    step double-buffers a (block_m, k) x-tile pair and a (block_m, n) y-tile
+    pair. Keep the total under ~12 MB of the ~16 MB per-core VMEM.
+    """
+    bm = min(block_m, m)
+    tiles = 2 * 2 * bm * (k + n) * 4  # double-buffered (re, im) x/y tiles
+    return (
+        np.dtype(dtype) == np.float32
+        and m % 8 == 0
+        and k % 128 == 0
+        and n % 128 == 0
+        and k * n * 4 * 2 + tiles <= 12 * 1024 * 1024
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "precision", "interpret"))
+def complex_matmul_fused(
+    xr,
+    xi,
+    wr,
+    wi,
+    *,
+    block_m: int = 256,
+    precision=jax.lax.Precision.HIGHEST,
+    interpret: bool | None = None,
+):
+    """(xr + i xi) @ (wr + i wi) -> (yr, yi), one fused Pallas pass.
+
+    x: (M, K) f32 pair, w: (K, N) f32 pair, M % 8 == 0, K/N % 128 == 0.
+    Grid tiles the batch dimension; the DFT matrix stays resident.
+    ``interpret`` defaults to True off-TPU so tests exercise the kernel on the
+    virtual CPU mesh (the same build-only-CI compromise as the reference's GPU
+    kernels, reference: .github/workflows/ci.yml:89-130).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = xr.shape
+    n = wr.shape[1]
+    bm = min(block_m, m)
+    while m % bm:
+        bm //= 2
+    grid = (m // bm,)
+    x_spec = pl.BlockSpec((bm, k), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((k, n), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    y_spec = pl.BlockSpec((bm, n), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    yr, yi = pl.pallas_call(
+        functools.partial(_kernel, precision=precision),
+        grid=grid,
+        in_specs=[x_spec, x_spec, w_spec, w_spec],
+        out_specs=[y_spec, y_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.float32),
+            jax.ShapeDtypeStruct((m, n), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=8 * m * k * n, transcendentals=0,
+            bytes_accessed=4 * (2 * m * k + 2 * k * n + 2 * m * n),
+        ),
+        interpret=interpret,
+    )(xr, xi, wr, wi)
+    return yr, yi

@@ -219,6 +219,17 @@ def make_distributed_parameters(
     )
 
 
+def stick_keys(triplets, dim_y: int) -> np.ndarray:
+    """Sign-safe composite (x, y) stick identity key for each value triplet.
+
+    Groups values by stick in *caller* index space (sign-sensitive keys map to
+    the same storage stick after conversion); the single definition shared by
+    the partitioner and the benchmark's stick accounting.
+    """
+    t = np.asarray(triplets).reshape(-1, 3).astype(np.int64)
+    return t[:, 0] * (4 * dim_y) + t[:, 1]
+
+
 def distribute_triplets(
     triplets: np.ndarray,
     num_shards: int,
@@ -233,9 +244,7 @@ def distribute_triplets(
     t = np.asarray(triplets).reshape(-1, 3)
     if num_shards < 1:
         raise InvalidParameterError("num_shards must be >= 1")
-    # Group values by stick (x, y) identity in *caller* index space (sign-sensitive
-    # keys map to the same storage stick after conversion).
-    keys = t[:, 0] * (4 * dim_y) + t[:, 1]  # sign-safe composite key
+    keys = stick_keys(t, dim_y)
     uniq, inverse, counts = np.unique(keys, return_inverse=True, return_counts=True)
     order = np.argsort(-counts)  # largest sticks first
     if weights is None:
