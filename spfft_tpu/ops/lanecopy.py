@@ -151,6 +151,16 @@ class CopyPlan:
                     continue
                 pieces.append(jax.lax.slice(w, (off, t), (off + c, t + LANE)))
                 off += c
+            # The barrier is a MISCOMPILE workaround, not an optimization: on the
+            # TPU backend (v5e, 2026-07), fusing the concat of >= 2 pieces lane-
+            # shifted by different amounts out of one buffer produces wrong values
+            # when the piece sublane counts are below the 8-row f32 tile (observed
+            # at Rk=2: two (1, 128) slices at shifts 5/77 of a (2, 256) buffer
+            # concat to garbage; each slice alone is correct). Keeping the pieces
+            # materialized before the concat sidesteps the bad fusion on every
+            # backend at negligible cost.
+            if len(pieces) > 1:
+                pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
             aligned = jnp.concatenate(pieces, axis=0)
             aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
             contrib = aligned * jnp.asarray(pipe.mask, dtype=flat.dtype)

@@ -1,0 +1,66 @@
+"""CopyPlan regression coverage for pipe shapes that miscompiled on TPU.
+
+A TPU (v5e) XLA fusion bug produced wrong values when a pipe concatenated >= 2
+lane-shifted pieces whose sublane counts were below the 8-row f32 tile (Rk=2,
+two distinct shifts); lanecopy.apply now materializes the pieces behind an
+optimization_barrier before the concat. These tests pin the shape classes —
+they pass on CPU either way, and exercise the fixed path directly on TPU.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spfft_tpu.ops.lanecopy import LANE, CopyPlan
+
+
+def _check(src_of_dst, num_src, seed=0):
+    plan = CopyPlan.build(np.asarray(src_of_dst, dtype=np.int64), num_src)
+    assert plan is not None
+    rng = np.random.default_rng(seed)
+    flat = rng.standard_normal(num_src).astype(np.float32)
+    got = np.asarray(plan.apply(jnp.asarray(flat))).reshape(-1)[: len(src_of_dst)]
+    want = np.where(
+        np.asarray(src_of_dst) >= 0,
+        flat[np.maximum(np.asarray(src_of_dst), 0)],
+        0.0,
+    )
+    np.testing.assert_array_equal(got, want)
+    return plan
+
+
+def test_two_block_two_shift_pipe():
+    # Two destination blocks whose second runs start at different unaligned
+    # source offsets -> an Rk=2 pipe with two distinct shifts (the TPU
+    # miscompile shape).
+    m = np.full(2 * LANE, -1, dtype=np.int64)
+    m[:40] = np.arange(5, 45)            # block 0 run: shift 5
+    m[40:128] = np.arange(300, 388)      # block 0 second run: shift (300-40)%128
+    m[128:200] = np.arange(77, 149)      # block 1 run: shift 77
+    m[200:256] = np.arange(500, 556)     # block 1 second run
+    plan = _check(m, 600)
+    assert any(p.rows_sorted.size == 2 for p in plan.pipes)
+
+
+def test_many_small_pipes_random_sticks():
+    # Random stick-like layout: variable-length contiguous runs at arbitrary
+    # offsets, producing a mix of pipe widths including sub-tile ones.
+    rng = np.random.default_rng(42)
+    pieces, src = [], 0
+    for _ in range(37):
+        ln = int(rng.integers(3, 97))
+        gap = int(rng.integers(0, 30))
+        pieces.append(np.full(gap, -1, dtype=np.int64))
+        pieces.append(np.arange(src, src + ln))
+        src += ln + int(rng.integers(0, 11))
+    m = np.concatenate(pieces)
+    _check(m, src + 1, seed=1)
+
+
+@pytest.mark.parametrize("shift_pair", [(1, 127), (5, 77), (0, 64)])
+def test_single_pipe_two_shifts(shift_pair):
+    s0, s1 = shift_pair
+    m = np.full(2 * LANE, -1, dtype=np.int64)
+    m[:LANE] = np.arange(s0, s0 + LANE)
+    m[LANE:] = np.arange(400 + s1, 400 + s1 + LANE)
+    _check(m, 700, seed=2)
