@@ -1,0 +1,124 @@
+"""Ablate the FULL backward pipeline to find its bottleneck stage.
+
+Timing methodology: DEPENDENT chains inside one compiled lax.scan — each
+iteration's input derives from the previous iteration's output (sliced/padded
+back to the input shape), so XLA cannot hoist the body out of the loop, and a
+scalar fetch fences completion (block_until_ready does not wait on the axon
+tunnel). Loop-invariant bodies get hoisted entirely (measured: a 5.8 ms
+pipeline "runs" in 1.3 ms with independent repeats).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import spfft_tpu as sp
+from spfft_tpu.execution_mxu import MxuLocalExecution
+from spfft_tpu.ops import fft as offt
+from spfft_tpu.parameters import make_local_parameters
+from spfft_tpu.types import TransformType
+
+
+def timeit_chain(fn, x0, reps=60):
+    """fn maps a pair (re, im) -> pair of the SAME shapes (caller adapts)."""
+
+    @jax.jit
+    def loop(a, b):
+        def body(carry, _):
+            return fn(*carry), ()
+
+        (r, i), _ = jax.lax.scan(body, (a, b), None, length=reps)
+        return r.ravel()[0] + i.ravel()[0]
+
+    float(loop(*x0))
+    t0 = time.perf_counter()
+    float(loop(*x0))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--sparsity", type=float, default=0.15)
+    ap.add_argument("--reps", type=int, default=60)
+    args = ap.parse_args()
+    d = args.dim
+    radius = float((6.0 * args.sparsity / np.pi) ** (1.0 / 3.0))
+    trip = sp.create_spherical_cutoff_triplets(d, d, d, radius)
+    params = make_local_parameters(TransformType.C2C, d, d, d, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float32)
+    p = params
+    S, Z, Y, A = p.num_sticks, p.dim_z, p.dim_y, ex._num_x_active
+    N = p.num_values
+    print(f"plan: S={S} Z={Z} Y={Y} A={A} values={N}")
+    prec = ex._precision
+    rng = np.random.default_rng(0)
+    vpair = tuple(
+        jnp.asarray(rng.standard_normal(N).astype(np.float32)) for _ in range(2)
+    )
+    spair = tuple(
+        jnp.asarray(rng.standard_normal((S, Z)).astype(np.float32)) for _ in range(2)
+    )
+
+    # Every fn below maps value-pair -> value-pair or stick-pair -> stick-pair
+    # so chains stay dependent. Grid outputs are folded back by slicing.
+
+    def full(a, b):
+        gr, gi = ex._backward_impl(a, b)
+        return gr.ravel()[:N], gi.ravel()[:N]
+
+    def no_decompress(a, b):
+        s2 = offt.complex_matmul(a, b, *ex._wz_b, "sz,zk->sk", prec)
+        g = ex._expand(*s2)
+        g = offt.complex_matmul(*g, *ex._wy_b, "yxz,yk->kxz", prec)
+        g = offt.complex_matmul(*g, *ex._wx_b, "kxz,xl->klz", prec)
+        return g[0].reshape(-1)[: S * Z].reshape(S, Z), g[1].reshape(-1)[: S * Z].reshape(S, Z)
+
+    def matmuls_only(a, b):
+        s2 = offt.complex_matmul(a, b, *ex._wz_b, "sz,zk->sk", prec)
+        g = (
+            jnp.broadcast_to(s2[0][: 1, :], (Y * A, Z)).reshape(Y, A, Z),
+            jnp.broadcast_to(s2[1][: 1, :], (Y * A, Z)).reshape(Y, A, Z),
+        )
+        g = offt.complex_matmul(*g, *ex._wy_b, "yxz,yk->kxz", prec)
+        g = offt.complex_matmul(*g, *ex._wx_b, "kxz,xl->klz", prec)
+        return g[0].reshape(-1)[: S * Z].reshape(S, Z), g[1].reshape(-1)[: S * Z].reshape(S, Z)
+
+    def decompress_z(a, b):
+        s2 = ex._decompress(a, b)
+        s2 = offt.complex_matmul(*s2, *ex._wz_b, "sz,zk->sk", prec)
+        return s2[0].ravel()[:N], s2[1].ravel()[:N]
+
+    def decompress_z_expand(a, b):
+        s2 = ex._decompress(a, b)
+        s2 = offt.complex_matmul(*s2, *ex._wz_b, "sz,zk->sk", prec)
+        g = ex._expand(*s2)
+        return g[0].reshape(-1)[:N], g[1].reshape(-1)[:N]
+
+    def z_only(a, b):
+        return offt.complex_matmul(a, b, *ex._wz_b, "sz,zk->sk", prec)
+
+    rows = [
+        ("FULL backward", full, vpair),
+        ("- decompress", no_decompress, spair),
+        ("matmuls only (no gathers)", matmuls_only, spair),
+        ("decompress+z", decompress_z, vpair),
+        ("decompress+z+expand", decompress_z_expand, vpair),
+        ("z matmul only", z_only, spair),
+    ]
+    for name, fn, x0 in rows:
+        t = timeit_chain(fn, x0, reps=args.reps)
+        print(f"{name:26s} {t*1e3:8.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -55,7 +55,9 @@ def main():
     args = ap.parse_args()
 
     d = args.dim
-    trip = sp.create_spherical_cutoff_triplets(d, d, d, args.sparsity)
+    # nnz fraction -> ball radius fraction (matches benchmark.py's spherical model)
+    radius = float((6.0 * args.sparsity / np.pi) ** (1.0 / 3.0))
+    trip = sp.create_spherical_cutoff_triplets(d, d, d, radius)
     params = make_local_parameters(TransformType.C2C, d, d, d, trip)
     ex = MxuLocalExecution(params, real_dtype=np.float32)
     S, Z, Y, A = params.num_sticks, params.dim_z, params.dim_y, ex._num_x_active

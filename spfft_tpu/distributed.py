@@ -43,6 +43,8 @@ class DistributedTransform:
         index_format: IndexFormat = IndexFormat.TRIPLETS,
         grid: Grid | None = None,
         dtype=None,
+        engine: str = "auto",
+        precision="highest",
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -91,9 +93,30 @@ class DistributedTransform:
             dtype = np.float64 if jax.config.read("jax_enable_x64") else np.float32
         self._real_dtype = np.dtype(dtype)
 
-        self._exec = DistributedExecution(
-            self._params, self._real_dtype, mesh, exchange_type
-        )
+        from .ops.fft import resolve_precision
+
+        resolve_precision(precision)  # validate up front on every engine path
+
+        # Engine selection mirrors the local Transform: the MXU engine (matmul
+        # DFT stages + lane-copy value plans, parallel/execution_mxu.py) wins on
+        # accelerator meshes; the XLA engine (jnp.fft + scatter) wins on CPU
+        # meshes where pocketfft is the fast path. Selected by the platform the
+        # MESH lives on, not the process default backend.
+        if engine == "auto":
+            engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
+        if engine == "mxu":
+            from .parallel.execution_mxu import MxuDistributedExecution
+
+            self._exec = MxuDistributedExecution(
+                self._params, self._real_dtype, mesh, exchange_type, precision
+            )
+        elif engine == "xla":
+            self._exec = DistributedExecution(
+                self._params, self._real_dtype, mesh, exchange_type
+            )
+        else:
+            raise InvalidParameterError(f"unknown engine {engine!r}")
+        self._engine = engine
         self._space_data = None
 
     # ---- transforms -----------------------------------------------------------

@@ -38,7 +38,74 @@ from .mesh import FFT_AXIS
 _FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 
 
-class DistributedExecution:
+class PaddingHelpers:
+    """Host-side padding between caller per-shard arrays and the padded-uniform
+    sharded device layout. Shared by both mesh engines (DistributedExecution and
+    MxuDistributedExecution); requires ``params``, ``real_dtype``,
+    ``complex_dtype``, ``is_r2c``, ``_V``, ``_L``, ``value_sharding`` and
+    ``space_sharding`` on the inheriting class."""
+
+    def pad_values(self, values_per_shard):
+        """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
+        p = self.params
+        re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
+        im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
+        for r, v in enumerate(values_per_shard):
+            v = np.asarray(v).reshape(-1)
+            if v.size != int(p.num_values_per_shard[r]):
+                from ..errors import InvalidParameterError
+
+                raise InvalidParameterError(
+                    f"shard {r}: expected {int(p.num_values_per_shard[r])} values, got {v.size}"
+                )
+            re[r, : v.size] = v.real
+            im[r, : v.size] = v.imag
+        return (
+            jax.device_put(re, self.value_sharding),
+            jax.device_put(im, self.value_sharding),
+        )
+
+    def unpad_values(self, pair):
+        """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays."""
+        re, im = np.asarray(pair[0]), np.asarray(pair[1])
+        return [
+            re[r, :n] + 1j * im[r, :n]
+            for r, n in enumerate(int(x) for x in self.params.num_values_per_shard)
+        ]
+
+    def pad_space(self, space):
+        """Global (Z, Y, X) array -> sharded (P, L, Y, X) real (re, im or re-only) arrays."""
+        p = self.params
+        arrs = []
+        parts = [np.asarray(space).real, None if self.is_r2c else np.asarray(space).imag]
+        for part in parts:
+            if part is None:
+                arrs.append(None)
+                continue
+            out = np.zeros((p.num_shards, self._L, p.dim_y, p.dim_x), dtype=self.real_dtype)
+            for r in range(p.num_shards):
+                l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
+                out[r, :l] = part[o : o + l]
+            arrs.append(jax.device_put(out, self.space_sharding))
+        return arrs[0], arrs[1]
+
+    def unpad_space(self, out):
+        """Sharded (P, L, Y, X) result -> global (Z, Y, X) numpy array."""
+        p = self.params
+        if self.is_r2c:
+            full = np.asarray(out)
+            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
+        else:
+            re, im = np.asarray(out[0]), np.asarray(out[1])
+            full = re + 1j * im
+            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
+        for r in range(p.num_shards):
+            l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
+            dst[o : o + l] = full[r, :l]
+        return dst
+
+
+class DistributedExecution(PaddingHelpers):
     """Compiled distributed pipelines for one transform plan over one mesh."""
 
     def __init__(
@@ -236,64 +303,3 @@ class DistributedExecution:
         if self.is_r2c:
             return fn(space_re, self._value_indices)
         return fn(space_re, space_im, self._value_indices)
-
-    # ---- host-side padding helpers --------------------------------------------
-
-    def pad_values(self, values_per_shard):
-        """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
-        p = self.params
-        re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
-        im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
-        for r, v in enumerate(values_per_shard):
-            v = np.asarray(v).reshape(-1)
-            if v.size != int(p.num_values_per_shard[r]):
-                from ..errors import InvalidParameterError
-
-                raise InvalidParameterError(
-                    f"shard {r}: expected {int(p.num_values_per_shard[r])} values, got {v.size}"
-                )
-            re[r, : v.size] = v.real
-            im[r, : v.size] = v.imag
-        return (
-            jax.device_put(re, self.value_sharding),
-            jax.device_put(im, self.value_sharding),
-        )
-
-    def unpad_values(self, pair):
-        """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays."""
-        re, im = np.asarray(pair[0]), np.asarray(pair[1])
-        return [
-            re[r, :n] + 1j * im[r, :n]
-            for r, n in enumerate(int(x) for x in self.params.num_values_per_shard)
-        ]
-
-    def pad_space(self, space):
-        """Global (Z, Y, X) array -> sharded (P, L, Y, X) real (re, im or re-only) arrays."""
-        p = self.params
-        arrs = []
-        parts = [np.asarray(space).real, None if self.is_r2c else np.asarray(space).imag]
-        for part in parts:
-            if part is None:
-                arrs.append(None)
-                continue
-            out = np.zeros((p.num_shards, self._L, p.dim_y, p.dim_x), dtype=self.real_dtype)
-            for r in range(p.num_shards):
-                l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
-                out[r, :l] = part[o : o + l]
-            arrs.append(jax.device_put(out, self.space_sharding))
-        return arrs[0], arrs[1]
-
-    def unpad_space(self, out):
-        """Sharded (P, L, Y, X) result -> global (Z, Y, X) numpy array."""
-        p = self.params
-        if self.is_r2c:
-            full = np.asarray(out)
-            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
-        else:
-            re, im = np.asarray(out[0]), np.asarray(out[1])
-            full = re + 1j * im
-            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
-        for r in range(p.num_shards):
-            l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
-            dst[o : o + l] = full[r, :l]
-        return dst

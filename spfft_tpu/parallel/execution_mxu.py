@@ -1,0 +1,366 @@
+"""Distributed MXU execution: the TPU-fast mesh pipeline.
+
+Same plan geometry and boundary contract as
+:class:`spfft_tpu.parallel.execution.DistributedExecution` (the XLA/pocketfft
+engine, the fast path on CPU meshes), but engineered like the local MXU engine
+(execution_mxu.py) for what profiles fast on TPU hardware:
+
+* every DFT stage is a batched matmul on the MXU (ops/fft.py) instead of
+  ``jnp.fft`` — the z stage runs stick-compact (padded-uniform S rows, Z lanes),
+  the xy stages run per local plane with x on the lanes,
+* sparse value pack/unpack (decompress/compress) run as per-shard lane-copy
+  plans (ops/lanecopy.py) selected by ``lax.switch`` on the mesh axis index —
+  the SPMD program embeds every shard's plan and each shard executes its own;
+  shards whose caller value order is too fragmented for copy planning fall back
+  to element scatter/gather in their branch only,
+* the slab<->pencil repartition is ONE ``lax.all_to_all`` over the mesh axis on
+  a (re, im)-stacked buffer — the uniform-block BUFFERED discipline of the
+  reference (reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
+  which is the collective shape ICI likes; ``*_FLOAT`` exchange variants halve
+  wire bytes (f64 -> f32 wire, f32 -> bf16 wire) inside the pack/unpack, the
+  analogue of the reference's float exchanges (reference:
+  include/spfft/types.h:41-47, src/gpu_util/complex_conversion.cuh:37-56),
+* complex data is carried as (re, im) real pairs end to end (axon TPU cannot
+  transfer complex across the host boundary, and real pairs let the 4-matmul
+  complex product run on the MXU).
+
+Space-domain layout is the public (L, Y, X) slab per shard; the backward
+pipeline's only transposes are one (Y*Xf, L) -> (L, Y*Xf) dense transpose per
+direction, placed so every xy matmul keeps x on the 128-lane minor dimension.
+
+Compile-size note: the ``lax.switch`` embeds P copy-plan branches in the one
+SPMD program. That is cheap for pod-slice shard counts (P <= 64); beyond that,
+group shards with identical stick layouts or fall back to the XLA engine.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import fft as offt
+from ..ops import lanecopy, symmetry
+from ..types import ExchangeType, ScalingType, TransformType
+from .execution import PaddingHelpers
+from .mesh import FFT_AXIS
+
+_FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
+
+
+def _complex_dtype(real_dtype):
+    return (
+        np.dtype(np.complex64)
+        if np.dtype(real_dtype) == np.float32
+        else np.dtype(np.complex128)
+    )
+
+
+class MxuDistributedExecution(PaddingHelpers):
+    """Compiled distributed MXU pipelines for one transform plan over one mesh.
+
+    Boundary-compatible with DistributedExecution: ``pad_values`` /
+    ``backward_pair`` / ``forward_pair`` / ``unpad_*`` carry the same shapes and
+    shardings, so DistributedTransform switches engines transparently.
+    """
+
+    def __init__(
+        self,
+        params,
+        real_dtype,
+        mesh,
+        exchange_type: ExchangeType = ExchangeType.DEFAULT,
+        precision="highest",
+    ):
+        self.params = params
+        self.mesh = mesh
+        self.real_dtype = np.dtype(real_dtype)
+        self.complex_dtype = _complex_dtype(real_dtype)
+        self.exchange_type = ExchangeType(exchange_type)
+        self._precision = offt.resolve_precision(precision)
+        p = params
+        if int(np.prod(mesh.devices.shape)) != p.num_shards:
+            from ..errors import MPIParameterMismatchError
+
+            raise MPIParameterMismatchError(
+                f"plan has {p.num_shards} shards but mesh has "
+                f"{int(np.prod(mesh.devices.shape))} devices"
+            )
+        rt = self.real_dtype
+        r2c = self.is_r2c
+        S = p.max_num_sticks
+        L = max(1, p.max_local_z_length)
+        V = p.max_num_values
+        Z, Y, Xf = p.dim_z, p.dim_y, p.dim_x_freq
+        self._S, self._L, self._V = S, L, V
+
+        # ---- DFT matrices (static constants; scale folded into forward z) ----
+        def pair(w):
+            return w.real.astype(rt), w.imag.astype(rt)
+
+        self._wz_b = pair(offt.c2c_matrix(Z, +1))
+        self._wy_b = pair(offt.c2c_matrix(Y, +1))
+        self._wy_f = pair(offt.c2c_matrix(Y, -1))
+        self._wz_f = {
+            ScalingType.NONE: pair(offt.c2c_matrix(Z, -1)),
+            ScalingType.FULL: pair(offt.c2c_matrix(Z, -1, scale=1.0 / p.total_size)),
+        }
+        if r2c:
+            self._wx_b = tuple(a.astype(rt) for a in offt.c2r_matrices(p.dim_x))  # (Xf, X)
+            self._wx_f = tuple(a.astype(rt) for a in offt.r2c_matrices(p.dim_x))  # (X, Xf)
+        else:
+            self._wx_b = pair(offt.c2c_matrix(p.dim_x, +1))
+            self._wx_f = pair(offt.c2c_matrix(p.dim_x, -1))
+
+        # ---- exchange geometry (global constants, identical on every shard) ----
+        # z-split: uniform slabs make pack/unpack pure reshapes; ragged slabs go
+        # through one lane-gather per direction.
+        lz, zo = np.asarray(p.local_z_lengths), np.asarray(p.z_offsets)
+        self._uniform_z = bool((lz == L).all() and (zo == np.arange(p.num_shards) * L).all())
+        self._pack_z = p.pack_z_map()  # (P*L,) global z per packed slot, sentinel dim_z
+        self._unpack_z = p.unpack_z_map()  # (Z,) packed slot per global z
+        # global stick slot tables over the padded (P, S) stick order
+        sx = p.stick_x_all.reshape(-1).astype(np.int64)
+        sy = p.stick_y_all.reshape(-1).astype(np.int64)
+        yx = sy * Xf + sx
+        yx[sx >= Xf] = Y * Xf  # padding sentinel: one past the plane
+        self._stick_yx = yx.astype(np.int32)  # (P*S,) plane slot per global stick
+        # inverse: plane slot -> global stick row (sentinel P*S -> zero row)
+        inv = np.full(Y * Xf, p.num_shards * S, dtype=np.int32)
+        inv[yx[yx < Y * Xf]] = np.flatnonzero(yx < Y * Xf).astype(np.int32)
+        self._yx_stick = inv
+        self._have_x0 = bool((sx[sx < Xf] == 0).any())
+
+        # ---- per-shard value copy plans (lax.switch branches) ----
+        self._decompress_branches = []
+        self._compress_branches = []
+        for r in range(p.num_shards):
+            n = int(p.num_values_per_shard[r])
+            vi = np.asarray(p.value_indices[r, :n], dtype=np.int64)
+            self._decompress_branches.append(self._make_decompress(vi, n))
+            self._compress_branches.append(self._make_compress(vi, n))
+
+        # ---- sharded constants + compiled pipelines ----
+        self.value_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
+        self.space_sharding = NamedSharding(mesh, P(FFT_AXIS, None, None, None))
+        specs_v = P(FFT_AXIS, None)
+        specs_s = P(FFT_AXIS, None, None, None)
+        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+
+        self._backward = jax.jit(
+            sm(
+                self._backward_impl,
+                in_specs=(specs_v, specs_v),
+                out_specs=(specs_s, specs_s) if not r2c else specs_s,
+            )
+        )
+        self._forward = {
+            s: jax.jit(
+                sm(
+                    functools.partial(self._forward_impl, scaling=s),
+                    in_specs=(specs_s, specs_s) if not r2c else (specs_s,),
+                    out_specs=(specs_v, specs_v),
+                )
+            )
+            for s in (ScalingType.NONE, ScalingType.FULL)
+        }
+
+    @property
+    def is_r2c(self) -> bool:
+        return self.params.transform_type == TransformType.R2C
+
+    # ---- per-shard value branches ---------------------------------------------
+
+    def _make_decompress(self, vi: np.ndarray, n: int):
+        """Branch: (V_max,) pair -> (S, Z) pair sticks for one shard."""
+        S, Z, V = self._S, self.params.dim_z, self._V
+        plan = lanecopy.build_decompress_plan(vi, S * Z, n) if n else None
+
+        if plan is not None:
+            def branch(vre, vim, plan=plan, n=n):
+                sre = plan.apply(vre[:n]).reshape(-1)[: S * Z].reshape(S, Z)
+                sim = plan.apply(vim[:n]).reshape(-1)[: S * Z].reshape(S, Z)
+                return sre, sim
+
+            return branch
+
+        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
+
+        def branch_scatter(vre, vim, idx=idx, n=n):
+            out = []
+            for v in (vre, vim):
+                flat = jnp.zeros(S * Z, dtype=v.dtype).at[idx].set(
+                    v[:n], mode="drop", unique_indices=True
+                )
+                out.append(flat.reshape(S, Z))
+            return tuple(out)
+
+        return branch_scatter
+
+    def _make_compress(self, vi: np.ndarray, n: int):
+        """Branch: (S, Z) pair sticks -> (V_max,) pair packed values."""
+        S, Z, V = self._S, self.params.dim_z, self._V
+        plan = lanecopy.build_compress_plan(vi, S * Z) if n else None
+
+        if n == 0:
+            def branch_empty(sre, sim):
+                z = jnp.zeros(V, dtype=sre.dtype)
+                return z, z
+
+            return branch_empty
+
+        if plan is not None:
+            def branch(sre, sim, plan=plan, n=n):
+                vre = plan.apply(sre.reshape(-1)).reshape(-1)[:n]
+                vim = plan.apply(sim.reshape(-1)).reshape(-1)[:n]
+                pad = (0, V - n)
+                return jnp.pad(vre, pad), jnp.pad(vim, pad)
+
+            return branch
+
+        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
+
+        def branch_gather(sre, sim, idx=idx, n=n):
+            pad = (0, V - n)
+            return (
+                jnp.pad(sre.reshape(-1)[idx], pad),
+                jnp.pad(sim.reshape(-1)[idx], pad),
+            )
+
+        return branch_gather
+
+    # ---- wire format ----------------------------------------------------------
+
+    def _wire_dtype(self):
+        # *_FLOAT halves the f64 wire exactly like the reference's float
+        # exchange (reference: include/spfft/types.h:41-47); f32 data is left
+        # untouched, matching the XLA engine — a bf16 wire would silently drop
+        # below the 1e-6 parity bar and is not offered implicitly.
+        if self.exchange_type in _FLOAT_EXCHANGES and self.real_dtype == np.float64:
+            return np.dtype(np.float32)
+        return self.real_dtype
+
+    def _exchange(self, bre, bim):
+        """(P, S, L) pair -> all_to_all over the mesh axis, one collective."""
+        wd = self._wire_dtype()
+        buf = jnp.stack([bre.astype(wd), bim.astype(wd)], axis=1)  # (P, 2, S, L)
+        recv = jax.lax.all_to_all(buf, FFT_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        recv = recv.astype(self.real_dtype)
+        return recv[:, 0], recv[:, 1]
+
+    # ---- pipelines (traced once; run per-shard under shard_map) ---------------
+
+    def _backward_impl(self, values_re, values_im):
+        p = self.params
+        prec = self._precision
+        S, L, Z, Y, Xf = self._S, self._L, p.dim_z, p.dim_y, p.dim_x_freq
+        rt = self.real_dtype
+        shard = jax.lax.axis_index(FFT_AXIS)
+
+        sre, sim = jax.lax.switch(
+            shard,
+            self._decompress_branches,
+            values_re[0].astype(rt),
+            values_im[0].astype(rt),
+        )
+
+        if self.is_r2c and p.zero_stick_shard >= 0:
+            i = p.zero_stick_row
+            fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+            own = shard == p.zero_stick_shard
+            sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
+            sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
+
+        sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
+
+        # pack: (S, Z) -> (P, S, L) exchange blocks
+        if not self._uniform_z:
+            zmap = jnp.asarray(self._pack_z)
+            sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
+            sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
+        bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+        bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+
+        rre, rim = self._exchange(bre, bim)
+
+        # expand: (P*S, L) global stick rows -> (L, Y, Xf) freq planes
+        rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
+        rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
+        m = jnp.asarray(self._yx_stick)
+        gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, Xf)
+        gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, Xf)
+
+        if self.is_r2c and self._have_x0:
+            pre, pim = symmetry.hermitian_fill_1d_pair(gre[:, :, 0], gim[:, :, 0], axis=1)
+            gre = gre.at[:, :, 0].set(pre)
+            gim = gim.at[:, :, 0].set(pim)
+
+        gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+        if self.is_r2c:
+            out = offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+            return out[None]
+        gre, gim = offt.complex_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+        return gre[None], gim[None]
+
+    def _forward_impl(self, space_re, space_im=None, *, scaling):
+        p = self.params
+        prec = self._precision
+        S, L, Z, Y, Xf = self._S, self._L, p.dim_z, p.dim_y, p.dim_x_freq
+        rt = self.real_dtype
+        shard = jax.lax.axis_index(FFT_AXIS)
+
+        if self.is_r2c:
+            gre, gim = offt.real_in_matmul(
+                space_re[0].astype(rt), *self._wx_f, "lyx,xk->lyk", prec
+            )
+        else:
+            gre, gim = offt.complex_matmul(
+                space_re[0].astype(rt), space_im[0].astype(rt),
+                *self._wx_f, "lyx,xk->lyk", prec,
+            )
+        gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
+
+        # pack: gather every global stick's (y, x) slot from my planes
+        flat_re = jnp.concatenate(
+            [gre.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+        )
+        flat_im = jnp.concatenate(
+            [gim.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+        )
+        m = jnp.asarray(self._stick_yx)
+        bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
+        bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
+
+        rre, rim = self._exchange(bre, bim)
+
+        # unpack: (P, S, L) my sticks' z chunks -> (S, Z)
+        sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+        sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+        if not self._uniform_z:
+            zmap = jnp.asarray(self._unpack_z)
+            sre = jnp.take(sre, zmap, axis=1)
+            sim = jnp.take(sim, zmap, axis=1)
+
+        sre, sim = offt.complex_matmul(
+            sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
+        )
+
+        vre, vim = jax.lax.switch(shard, self._compress_branches, sre, sim)
+        return vre[None], vim[None]
+
+    # ---- device-side entry points ---------------------------------------------
+
+    def backward_pair(self, values_re, values_im):
+        """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
+        return self._backward(values_re, values_im)
+
+    def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
+        fn = self._forward[ScalingType(scaling)]
+        if self.is_r2c:
+            return fn(space_re)
+        return fn(space_re, space_im)
+
