@@ -118,8 +118,6 @@ def main(argv=None):
         help="MXU engine matmul precision (high trades ~1e-5 accuracy for speed)",
     )
     args = ap.parse_args(argv)
-    if args.shards > 1 and (args.engine != "auto" or args.matmul_precision != "highest"):
-        ap.error("--engine/--matmul-precision apply to local runs only (not --shards > 1)")
 
     import os
 
@@ -176,7 +174,12 @@ def main(argv=None):
         exchange = ExchangeType[EXCHANGE_NAMES[exchange_name]]
         with timing.scoped("Grid + Transform init"):
             if args.shards > 1:
-                mesh = sp.make_fft_mesh(args.shards)
+                # -p cpu must mesh over the (virtual) CPU devices even when an
+                # accelerator is attached as the default backend.
+                mesh_devices = (
+                    jax.devices("cpu")[: args.shards] if args.p == "cpu" else None
+                )
+                mesh = sp.make_fft_mesh(args.shards, devices=mesh_devices)
                 if args.model == "spherical":
                     # variable-length sticks: balanced whole-stick partition
                     per_shard = sp.distribute_triplets(triplets, args.shards, dim_y)
@@ -186,6 +189,7 @@ def main(argv=None):
                     sp.DistributedTransform(
                         pu, ttype, dim_x, dim_y, dim_z, [t.copy() for t in per_shard],
                         mesh=mesh, exchange_type=exchange, dtype=dtype,
+                        engine=args.engine, precision=args.matmul_precision,
                     )
                     for _ in range(args.m)
                 ]
