@@ -214,13 +214,12 @@ def main(argv=None):
         n = t.num_local_elements
         return rng.standard_normal(n) + 1j * rng.standard_normal(n)
 
-    def fence(pairs):
-        """Force completion of every chain with scalar fetches (axon TPU:
-        block_until_ready does not wait). The scalar is sliced out device-side
-        first — fetching the full array would bill its host transfer (tens of MB
-        through the development tunnel) to the timed loop."""
-        for p in pairs:
-            _ = float(p[0].ravel()[0])
+    def fence(scalar):
+        """Force completion with ONE scalar fetch (axon TPU: block_until_ready
+        does not wait). The scalar is reduced inside the compiled program —
+        eager device-side slicing per transform would add several tunnel
+        round-trips (~2-40 ms each) per fence, dominating small timed loops."""
+        _ = float(scalar)
 
     def measure(exchange_name):
         transforms = build_transforms(exchange_name)
@@ -243,14 +242,16 @@ def main(argv=None):
                 freq_pairs.append((t._exec.put(re), t._exec.put(im)))
 
         def roundtrip_chain(pairs):
+            # trace_* (un-jitted impls): a jit boundary inside the scan body
+            # blocks cross-stage fusion (measured ~30% slower per pair).
             outs = []
             for e, (re, im) in zip(ex, pairs):
-                space = e.backward_pair(re, im)
+                space = e.trace_backward(re, im)
                 if r2c:
-                    outs.append(e.forward_pair(space, None, ScalingType.FULL))
+                    outs.append(e.trace_forward(space, None, ScalingType.FULL))
                 else:
                     sre, sim = space
-                    outs.append(e.forward_pair(sre, sim, ScalingType.FULL))
+                    outs.append(e.trace_forward(sre, sim, ScalingType.FULL))
             return outs
 
         # All r repeats run inside ONE compiled lax.scan so a single dispatch
@@ -263,20 +264,27 @@ def main(argv=None):
             def body(carry, _):
                 return tuple(roundtrip_chain(list(carry))), None
             out, _ = jax.lax.scan(body, tuple(pairs), None, length=args.r)
-            return out
+            # single fence scalar, reduced in-program (see fence())
+            return sum(p[0].ravel()[0] + p[1].ravel()[0] for p in out)
 
         jitted = jax.jit(scan_chain)
 
-        # Warm the exact timed path: AOT-compile the fused roundtrip chain
-        # without executing all r repeats (an executed warmup would double total
-        # device time — ~12 s extra at 256^3 f64).
+        # Warm the exact timed artifact: AOT-compile the fused roundtrip chain,
+        # then execute it ONCE untimed. Both steps are required for a clean
+        # measurement: `jitted(...)` in the timed section would re-pay tracing +
+        # lowering (lower().compile() does not populate the jit call cache), and
+        # the FIRST execution of a compiled executable pays one-time program
+        # load + constant upload through the device tunnel (measured 60-400
+        # ms/pair at 128^3 vs 5-7 ms steady-state). This mirrors the
+        # reference's executed warm-up run (reference: benchmark.cpp:63-70).
         with timing.scoped("warmup chain"):
-            jitted.lower(freq_pairs).compile()
+            compiled = jitted.lower(freq_pairs).compile()
+            fence(compiled(freq_pairs))
 
         with timing.scoped("benchmark loop"):
             start = time.perf_counter()
-            pairs = jitted(freq_pairs)
-            fence(pairs)
+            checksum = compiled(freq_pairs)
+            fence(checksum)
             elapsed = time.perf_counter() - start
 
         pair_seconds = elapsed / (args.r * args.m)

@@ -238,6 +238,17 @@ class MxuLocalExecution(ExecutionBase):
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
         return self._forward[ScalingType(scaling)](space_re, space_im)
 
+    # Un-jitted traceables for composition into larger jitted programs (see
+    # LocalExecution.trace_backward for rationale).
+
+    def trace_backward(self, values_re, values_im):
+        return self._backward_impl(values_re, values_im)
+
+    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        if space_im is None:
+            space_im = jnp.zeros((0,), dtype=self.real_dtype)
+        return self._forward_impl(space_re, space_im, scaling=ScalingType(scaling))
+
     # host-facing helpers translate between public (Z, Y, X) and native (Y, X, Z)
 
     def backward(self, values):

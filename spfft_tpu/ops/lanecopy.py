@@ -94,6 +94,20 @@ class CopyPlan:
             for k, v in enumerate(vals):
                 per_pipe[k].append((r, v, (base[r] == v) & filled[r]))
 
+        # Pad pipe 0 to FULL block coverage when it is nearly full: holes would
+        # otherwise force the zeros + row-scatter-add path for the whole pipe,
+        # measured ~80% slower than the direct write at 256^3/15% (a spherical
+        # plan has a handful of empty blocks out of tens of thousands). Dummy
+        # entries gather the zero lead row under an all-zero mask.
+        if per_pipe:
+            covered = {e[0] for e in per_pipe[0]}
+            missing = [r for r in range(R) if r not in covered]
+            if missing and len(covered) >= (9 * R) // 10:
+                no_lanes = np.zeros(LANE, dtype=bool)
+                for r in missing:
+                    per_pipe[0].append((r, -LANE, no_lanes))
+                per_pipe[0].sort(key=lambda e: e[0])
+
         pipes = []
         # source view: one zero lead row (handles negative run bases: a run that
         # starts mid-block has base in (-LANE, 0)), the data, two zero tail rows

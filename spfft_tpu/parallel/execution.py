@@ -158,27 +158,26 @@ class DistributedExecution(PaddingHelpers):
         specs_s = P(FFT_AXIS, None, None, None)  # global (P, L, Y, X) space slabs
         sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
 
-        self._backward = jax.jit(
-            sm(
-                self._backward_impl,
-                in_specs=(specs_v, specs_v, specs_v),
-                out_specs=(specs_s, specs_s) if not self.is_r2c else specs_s,
-            )
+        self._backward_sm = sm(
+            self._backward_impl,
+            in_specs=(specs_v, specs_v, specs_v),
+            out_specs=(specs_s, specs_s) if not self.is_r2c else specs_s,
         )
+        self._backward = jax.jit(self._backward_sm)
+        self._forward_sm = {}
         self._forward = {}
         for scaling, scale in (
             (ScalingType.NONE, None),
             (ScalingType.FULL, 1.0 / p.total_size),
         ):
-            self._forward[scaling] = jax.jit(
-                sm(
-                    functools.partial(self._forward_impl, scale=scale),
-                    in_specs=(specs_s, specs_s, specs_v)
-                    if not self.is_r2c
-                    else (specs_s, specs_v),
-                    out_specs=(specs_v, specs_v),
-                )
+            self._forward_sm[scaling] = sm(
+                functools.partial(self._forward_impl, scale=scale),
+                in_specs=(specs_s, specs_s, specs_v)
+                if not self.is_r2c
+                else (specs_s, specs_v),
+                out_specs=(specs_v, specs_v),
             )
+            self._forward[scaling] = jax.jit(self._forward_sm[scaling])
 
     @property
     def is_r2c(self) -> bool:
@@ -297,9 +296,20 @@ class DistributedExecution(PaddingHelpers):
         """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
         return self._backward(values_re, values_im, self._value_indices)
 
-    def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
-        """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
-        fn = self._forward[ScalingType(scaling)]
+    def _dispatch_forward(self, table, space_re, space_im, scaling):
+        fn = table[ScalingType(scaling)]
         if self.is_r2c:
             return fn(space_re, self._value_indices)
         return fn(space_re, space_im, self._value_indices)
+
+    def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
+        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
+
+    # Un-jitted traceables (see LocalExecution.trace_backward for rationale).
+
+    def trace_backward(self, values_re, values_im):
+        return self._backward_sm(values_re, values_im, self._value_indices)
+
+    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        return self._dispatch_forward(self._forward_sm, space_re, space_im, scaling)

@@ -150,23 +150,21 @@ class MxuDistributedExecution(PaddingHelpers):
         specs_s = P(FFT_AXIS, None, None, None)
         sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
 
-        self._backward = jax.jit(
-            sm(
-                self._backward_impl,
-                in_specs=(specs_v, specs_v),
-                out_specs=(specs_s, specs_s) if not r2c else specs_s,
-            )
+        self._backward_sm = sm(
+            self._backward_impl,
+            in_specs=(specs_v, specs_v),
+            out_specs=(specs_s, specs_s) if not r2c else specs_s,
         )
-        self._forward = {
-            s: jax.jit(
-                sm(
-                    functools.partial(self._forward_impl, scaling=s),
-                    in_specs=(specs_s, specs_s) if not r2c else (specs_s,),
-                    out_specs=(specs_v, specs_v),
-                )
+        self._backward = jax.jit(self._backward_sm)
+        self._forward_sm = {
+            s: sm(
+                functools.partial(self._forward_impl, scaling=s),
+                in_specs=(specs_s, specs_s) if not r2c else (specs_s,),
+                out_specs=(specs_v, specs_v),
             )
             for s in (ScalingType.NONE, ScalingType.FULL)
         }
+        self._forward = {s: jax.jit(f) for s, f in self._forward_sm.items()}
 
     @property
     def is_r2c(self) -> bool:
@@ -357,10 +355,21 @@ class MxuDistributedExecution(PaddingHelpers):
         """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
         return self._backward(values_re, values_im)
 
-    def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
-        """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
-        fn = self._forward[ScalingType(scaling)]
+    def _dispatch_forward(self, table, space_re, space_im, scaling):
+        fn = table[ScalingType(scaling)]
         if self.is_r2c:
             return fn(space_re)
         return fn(space_re, space_im)
+
+    def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
+        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
+
+    # Un-jitted traceables (see LocalExecution.trace_backward for rationale).
+
+    def trace_backward(self, values_re, values_im):
+        return self._backward_sm(values_re, values_im)
+
+    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+        return self._dispatch_forward(self._forward_sm, space_re, space_im, scaling)
 

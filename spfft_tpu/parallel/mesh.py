@@ -15,9 +15,36 @@ FFT_AXIS = "fft"
 
 
 def make_fft_mesh(num_devices: int | None = None, devices=None) -> Mesh:
-    """Build a 1-D mesh over ``num_devices`` devices (default: all local devices)."""
+    """Build a 1-D mesh over ``num_devices`` devices (default: all local devices).
+
+    After :func:`init_distributed`, ``jax.devices()`` spans every process, so the
+    same call builds a multi-host mesh (collectives ride ICI within a slice and
+    DCN across hosts).
+    """
     if devices is None:
         devices = jax.devices()
         if num_devices is not None:
             devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (FFT_AXIS,))
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> None:
+    """Join a multi-host run: every host calls this once before building meshes.
+
+    Thin wrapper over ``jax.distributed.initialize`` — the analogue of the
+    reference's ``MPI_Init`` requirement for its multi-node transforms
+    (reference: src/mpi_util/mpi_init_handle.hpp:43-48). On TPU pods the
+    arguments are inferred from the environment; on CPU/GPU clusters pass the
+    coordinator address and process coordinates explicitly.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )

@@ -57,6 +57,34 @@ def test_many_small_pipes_random_sticks():
     _check(m, src + 1, seed=1)
 
 
+def test_empty_block_hole_padding():
+    """Layouts with fully-empty 128-lane blocks exercise the pipe-0 padding
+    that promotes near-full pipes to the direct-write path (a spherical plan
+    has a handful of empty blocks out of tens of thousands)."""
+    # 12 blocks, blocks 3 and 7 completely empty, others dense-ish at assorted
+    # unaligned offsets -> covered fraction 10/12 >= 90%... (exactly 10/12 <
+    # 0.9 threshold would skip; use 20 blocks, 1 empty).
+    m = np.full(20 * LANE, -1, dtype=np.int64)
+    src = 0
+    for b in range(20):
+        if b == 11:
+            continue  # fully-empty block
+        ln = 100 + (b % 3) * 9
+        m[b * LANE : b * LANE + ln] = np.arange(src + 5, src + 5 + ln)
+        src += ln + 13
+    plan = _check(m, src + 40, seed=3)
+    # the padding must have promoted pipe 0 to full coverage (direct write)
+    assert plan.pipes[0].block_ids is None
+
+
+def test_empty_block_padding_not_applied_when_sparse():
+    """Below the 90% coverage threshold the scatter-add path is kept."""
+    m = np.full(10 * LANE, -1, dtype=np.int64)
+    m[0:LANE] = np.arange(7, 7 + LANE)  # only 1 of 10 blocks covered
+    plan = _check(m, 400, seed=4)
+    assert plan.pipes[0].block_ids is not None
+
+
 @pytest.mark.parametrize("shift_pair", [(1, 127), (5, 77), (0, 64)])
 def test_single_pipe_two_shifts(shift_pair):
     s0, s1 = shift_pair
