@@ -44,8 +44,10 @@ def main():
     ex = t._exec
 
     def roundtrip(re, im):
-        space_re, space_im = ex.backward_pair(re, im)
-        return ex.forward_pair(space_re, space_im, ScalingType.FULL)
+        # trace_* (un-jitted impls): jit boundaries inside the scan body block
+        # cross-stage fusion (measured ~30% slower per pair)
+        space_re, space_im = ex.trace_backward(re, im)
+        return ex.trace_forward(space_re, space_im, ScalingType.FULL)
 
     def chain(re, im):
         def body(carry, _):

@@ -1,6 +1,20 @@
-/* spfft_tpu native API — umbrella C header (reference: include/spfft/spfft.h). */
+/* spfft_tpu native API — umbrella C header (reference: include/spfft/spfft.h).
+ *
+ * Scope: local (single-process) transforms, double and single precision — the
+ * same surface the reference exposes when built without MPI (SPFFT_MPI=OFF).
+ * Mesh-distributed transforms are reached through the Python API
+ * (spfft_tpu.DistributedTransform over a jax.sharding.Mesh); a device mesh has
+ * no MPI-communicator analogue that can cross the C boundary meaningfully.
+ */
 #ifndef SPFFT_TPU_SPFFT_H
 #define SPFFT_TPU_SPFFT_H
+
+/* Version of the reference API surface this build mirrors (reference:
+ * CMakeLists.txt:2 project VERSION 1.0.2). */
+#define SPFFT_VERSION_MAJOR 1
+#define SPFFT_VERSION_MINOR 0
+#define SPFFT_VERSION_PATCH 2
+#define SPFFT_VERSION_STRING "1.0.2-tpu"
 
 #include <spfft/errors.h>
 #include <spfft/grid.h>

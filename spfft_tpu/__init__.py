@@ -67,3 +67,5 @@ from .types import (  # noqa: F401
 )
 
 __version__ = "0.1.0"
+# Reference API surface this build mirrors (reference: CMakeLists.txt:2).
+__reference_api_version__ = "1.0.2"

@@ -95,10 +95,8 @@ class LocalExecution(ExecutionBase):
 
         self._backward = jax.jit(self._backward_impl)
         self._forward = {
-            ScalingType.NONE: jax.jit(functools.partial(self._forward_impl, scale=None)),
-            ScalingType.FULL: jax.jit(
-                functools.partial(self._forward_impl, scale=1.0 / p.total_size)
-            ),
+            s: jax.jit(functools.partial(self._forward_impl, scale=self._scale_for(s)))
+            for s in (ScalingType.NONE, ScalingType.FULL)
         }
 
     # ---- pipelines (traced; complex internal, real pairs at the boundary) -----
@@ -174,12 +172,13 @@ class LocalExecution(ExecutionBase):
     def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
-        scale = (
-            None
-            if ScalingType(scaling) == ScalingType.NONE
-            else 1.0 / self.params.total_size
-        )
-        return self._forward_impl(space_re, space_im, scale)
+        return self._forward_impl(space_re, space_im, self._scale_for(scaling))
+
+    def _scale_for(self, scaling):
+        """The single ScalingType -> scale-factor mapping (jitted + traced paths)."""
+        if ScalingType(scaling) == ScalingType.NONE:
+            return None
+        return 1.0 / self.params.total_size
 
     # ---- host-facing entry points ---------------------------------------------
 

@@ -179,54 +179,75 @@ class MxuLocalExecution(ExecutionBase):
 
     # ---- pipelines ------------------------------------------------------------
 
+    # Stage names match the reference's rt_graph tags (reference:
+    # src/execution/execution_host.cpp:249-293) so jax.profiler traces read
+    # like the reference's timing tree.
+
     def _backward_impl(self, values_re, values_im):
         p = self.params
         rt = self.real_dtype
         values_re = values_re.astype(rt)
         values_im = values_im.astype(rt)
 
-        sre, sim = self._decompress(values_re, values_im)
+        with jax.named_scope("compression"):
+            sre, sim = self._decompress(values_re, values_im)
         if self.is_r2c and self._zero_stick_id is not None:
-            i = self._zero_stick_id
-            fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
-            sre, sim = sre.at[i].set(fre), sim.at[i].set(fim)
+            with jax.named_scope("stick symmetry"):
+                i = self._zero_stick_id
+                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+                sre, sim = sre.at[i].set(fre), sim.at[i].set(fim)
 
         prec = self._precision
-        sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-        gre, gim = self._expand(sre, sim)
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
+        with jax.named_scope("expand"):
+            gre, gim = self._expand(sre, sim)
 
         if self.is_r2c and self._x0_slot is not None:
-            s = self._x0_slot
-            pre, pim = symmetry.hermitian_fill_1d_pair(gre[:, s, :], gim[:, s, :], axis=0)
-            gre, gim = gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
+            with jax.named_scope("plane symmetry"):
+                s = self._x0_slot
+                pre, pim = symmetry.hermitian_fill_1d_pair(
+                    gre[:, s, :], gim[:, s, :], axis=0
+                )
+                gre, gim = gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
 
-        gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yxz,yk->kxz", prec)
-        if self.is_r2c:
-            return offt.real_out_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
-        return offt.complex_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yxz,yk->kxz", prec)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                return offt.real_out_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
+            return offt.complex_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
 
     def _forward_impl(self, space_re, space_im, scaling):
         rt = self.real_dtype
         prec = self._precision
-        if self.is_r2c:
-            gre, gim = offt.real_in_matmul(
-                space_re.astype(rt), *self._wx_f, "yxz,xk->ykz", prec
-            )
-        else:
-            gre, gim = offt.complex_matmul(
-                space_re.astype(rt), space_im.astype(rt), *self._wx_f, "yxz,xk->ykz", prec
-            )
-        gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "ykz,yl->lkz", prec)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                gre, gim = offt.real_in_matmul(
+                    space_re.astype(rt), *self._wx_f, "yxz,xk->ykz", prec
+                )
+            else:
+                gre, gim = offt.complex_matmul(
+                    space_re.astype(rt), space_im.astype(rt),
+                    *self._wx_f, "yxz,xk->ykz", prec,
+                )
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "ykz,yl->lkz", prec)
 
         p = self.params
-        flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
-        flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
-        keys = jnp.asarray(self._stick_keys)
-        sre = jnp.take(flat_re, keys, axis=0)
-        sim = jnp.take(flat_im, keys, axis=0)
+        with jax.named_scope("pack"):
+            flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
+            flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
+            keys = jnp.asarray(self._stick_keys)
+            sre = jnp.take(flat_re, keys, axis=0)
+            sim = jnp.take(flat_im, keys, axis=0)
 
-        sre, sim = offt.complex_matmul(sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec)
-        return self._compress(sre, sim)
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(
+                sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
+            )
+        with jax.named_scope("compression"):
+            return self._compress(sre, sim)
 
     # ---- boundary API (pair-form, native layout) ------------------------------
 

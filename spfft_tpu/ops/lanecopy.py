@@ -102,7 +102,7 @@ class CopyPlan:
         if per_pipe:
             covered = {e[0] for e in per_pipe[0]}
             missing = [r for r in range(R) if r not in covered]
-            if missing and len(covered) >= (9 * R) // 10:
+            if missing and 10 * len(covered) >= 9 * R:
                 no_lanes = np.zeros(LANE, dtype=bool)
                 for r in missing:
                     per_pipe[0].append((r, -LANE, no_lanes))

@@ -258,50 +258,61 @@ class MxuDistributedExecution(PaddingHelpers):
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)
 
-        sre, sim = jax.lax.switch(
-            shard,
-            self._decompress_branches,
-            values_re[0].astype(rt),
-            values_im[0].astype(rt),
-        )
+        with jax.named_scope("compression"):
+            sre, sim = jax.lax.switch(
+                shard,
+                self._decompress_branches,
+                values_re[0].astype(rt),
+                values_im[0].astype(rt),
+            )
 
         if self.is_r2c and p.zero_stick_shard >= 0:
-            i = p.zero_stick_row
-            fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
-            own = shard == p.zero_stick_shard
-            sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
-            sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
+            with jax.named_scope("stick symmetry"):
+                i = p.zero_stick_row
+                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+                own = shard == p.zero_stick_shard
+                sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
+                sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
 
-        sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
 
         # pack: (S, Z) -> (P, S, L) exchange blocks
-        if not self._uniform_z:
-            zmap = jnp.asarray(self._pack_z)
-            sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
-            sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
-        bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
-        bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+        with jax.named_scope("pack"):
+            if not self._uniform_z:
+                zmap = jnp.asarray(self._pack_z)
+                sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
+                sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
+            bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+            bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
 
-        rre, rim = self._exchange(bre, bim)
+        with jax.named_scope("exchange"):
+            rre, rim = self._exchange(bre, bim)
 
         # expand: (P*S, L) global stick rows -> (L, Y, Xf) freq planes
-        rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
-        rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
-        m = jnp.asarray(self._yx_stick)
-        gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, Xf)
-        gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, Xf)
+        with jax.named_scope("unpack"):
+            rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
+            rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
+            m = jnp.asarray(self._yx_stick)
+            gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, Xf)
+            gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, Xf)
 
         if self.is_r2c and self._have_x0:
-            pre, pim = symmetry.hermitian_fill_1d_pair(gre[:, :, 0], gim[:, :, 0], axis=1)
-            gre = gre.at[:, :, 0].set(pre)
-            gim = gim.at[:, :, 0].set(pim)
+            with jax.named_scope("plane symmetry"):
+                pre, pim = symmetry.hermitian_fill_1d_pair(
+                    gre[:, :, 0], gim[:, :, 0], axis=1
+                )
+                gre = gre.at[:, :, 0].set(pre)
+                gim = gim.at[:, :, 0].set(pim)
 
-        gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
-        if self.is_r2c:
-            out = offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
-            return out[None]
-        gre, gim = offt.complex_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
-        return gre[None], gim[None]
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                out = offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+                return out[None]
+            gre, gim = offt.complex_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+            return gre[None], gim[None]
 
     def _forward_impl(self, space_re, space_im=None, *, scaling):
         p = self.params
@@ -310,43 +321,50 @@ class MxuDistributedExecution(PaddingHelpers):
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)
 
-        if self.is_r2c:
-            gre, gim = offt.real_in_matmul(
-                space_re[0].astype(rt), *self._wx_f, "lyx,xk->lyk", prec
-            )
-        else:
-            gre, gim = offt.complex_matmul(
-                space_re[0].astype(rt), space_im[0].astype(rt),
-                *self._wx_f, "lyx,xk->lyk", prec,
-            )
-        gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                gre, gim = offt.real_in_matmul(
+                    space_re[0].astype(rt), *self._wx_f, "lyx,xk->lyk", prec
+                )
+            else:
+                gre, gim = offt.complex_matmul(
+                    space_re[0].astype(rt), space_im[0].astype(rt),
+                    *self._wx_f, "lyx,xk->lyk", prec,
+                )
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
 
         # pack: gather every global stick's (y, x) slot from my planes
-        flat_re = jnp.concatenate(
-            [gre.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
-        )
-        flat_im = jnp.concatenate(
-            [gim.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
-        )
-        m = jnp.asarray(self._stick_yx)
-        bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
-        bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
+        with jax.named_scope("pack"):
+            flat_re = jnp.concatenate(
+                [gre.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+            )
+            flat_im = jnp.concatenate(
+                [gim.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+            )
+            m = jnp.asarray(self._stick_yx)
+            bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
+            bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
 
-        rre, rim = self._exchange(bre, bim)
+        with jax.named_scope("exchange"):
+            rre, rim = self._exchange(bre, bim)
 
         # unpack: (P, S, L) my sticks' z chunks -> (S, Z)
-        sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-        sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-        if not self._uniform_z:
-            zmap = jnp.asarray(self._unpack_z)
-            sre = jnp.take(sre, zmap, axis=1)
-            sim = jnp.take(sim, zmap, axis=1)
+        with jax.named_scope("unpack"):
+            sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+            sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+            if not self._uniform_z:
+                zmap = jnp.asarray(self._unpack_z)
+                sre = jnp.take(sre, zmap, axis=1)
+                sim = jnp.take(sim, zmap, axis=1)
 
-        sre, sim = offt.complex_matmul(
-            sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
-        )
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(
+                sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
+            )
 
-        vre, vim = jax.lax.switch(shard, self._compress_branches, sre, sim)
+        with jax.named_scope("compression"):
+            vre, vim = jax.lax.switch(shard, self._compress_branches, sre, sim)
         return vre[None], vim[None]
 
     # ---- device-side entry points ---------------------------------------------

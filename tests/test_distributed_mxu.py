@@ -143,6 +143,48 @@ def test_mxu_ragged_z_split():
         assert_close(back[r], vals)
 
 
+def test_mxu_centered_indexing():
+    """Centered (negative-frequency) triplets on the distributed MXU engine."""
+    rng = np.random.default_rng(21)
+    dims = (12, 10, 14)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5, centered=True)
+    values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
+    per_shard = distribute_triplets(triplets, 4, dy)
+    vps = split_values(per_shard, triplets, values)
+    t = DistributedTransform(
+        ProcessingUnit.GPU,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(4),
+        engine="mxu",
+    )
+    expected = oracle_backward_c2c(triplets, values, *dims)
+    assert_close(t.backward(vps), expected)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_mxu_multi_transform_batch():
+    """multi_transform over distributed MXU transforms (pipelined dispatch)."""
+    from spfft_tpu import multi_transform_backward, multi_transform_forward
+
+    dims = (8, 9, 10)
+    t1, trip1, vals1, vps1 = make_c2c(2, dims, seed=1)
+    t2, trip2, vals2, vps2 = make_c2c(2, dims, seed=2)
+    outs = multi_transform_backward([t1, t2], [vps1, vps2])
+    assert_close(outs[0], oracle_backward_c2c(trip1, vals1, *dims))
+    assert_close(outs[1], oracle_backward_c2c(trip2, vals2, *dims))
+    backs = multi_transform_forward([t1, t2], None, ScalingType.FULL)
+    for back, vps in zip(backs, (vps1, vps2)):
+        for r, vals in enumerate(vps):
+            assert_close(back[r], vals)
+
+
 def test_mxu_all_sticks_on_one_shard():
     """Edge case from reference tests/mpi_tests/test_transform.cpp:38-127."""
     rng = np.random.default_rng(11)

@@ -61,9 +61,8 @@ def test_empty_block_hole_padding():
     """Layouts with fully-empty 128-lane blocks exercise the pipe-0 padding
     that promotes near-full pipes to the direct-write path (a spherical plan
     has a handful of empty blocks out of tens of thousands)."""
-    # 12 blocks, blocks 3 and 7 completely empty, others dense-ish at assorted
-    # unaligned offsets -> covered fraction 10/12 >= 90%... (exactly 10/12 <
-    # 0.9 threshold would skip; use 20 blocks, 1 empty).
+    # 20 blocks with one fully-empty block (19/20 = 95% covered, above the 90%
+    # padding threshold), the others dense-ish at assorted unaligned offsets.
     m = np.full(20 * LANE, -1, dtype=np.int64)
     src = 0
     for b in range(20):
