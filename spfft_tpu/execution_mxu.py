@@ -28,8 +28,6 @@ planning (CopyPlan.build -> None).
 from __future__ import annotations
 
 import functools
-import os
-
 import numpy as np
 
 import jax
@@ -41,10 +39,6 @@ from .ops import lanecopy, symmetry
 from .parameters import LocalParameters
 from .types import ScalingType, TransformType
 
-
-# Lane quantum for padding the active-x extent (SPFFT_TPU_XPAD, default 8 = the
-# f32 sublane tile). dim_x_freq caps it, so huge values disable compaction.
-_X_PAD_QUANTUM = os.environ.get("SPFFT_TPU_XPAD", "8")
 
 
 class MxuLocalExecution(ExecutionBase):
@@ -70,23 +64,15 @@ class MxuLocalExecution(ExecutionBase):
         # becomes *rectangular* DFT matrices here: the intermediate grid is
         # (Y, A, Z) with A = #active x rows, and the x-stage contracts A <-> dim_x
         # directly via the permutation-folding hook of ops/fft.c2c_matrix. At 15%
-        # spherical cutoff this cuts the xy-stage matmul flops ~6.7x.
+        # spherical cutoff this cuts the xy-stage matmul flops ~6.7x. Extent
+        # padding / full-extent fallback policy: ops/fft.compact_x_extent.
         if p.num_sticks:
             ux = np.unique(np.asarray(p.stick_x, dtype=np.int64))
             xslot = np.searchsorted(ux, np.asarray(p.stick_x, dtype=np.int64))
         else:
             ux = np.zeros(1, dtype=np.int64)
             xslot = np.zeros(0, dtype=np.int64)
-        # Pad the active set to a lane-friendly multiple (zero DFT rows via the
-        # row_perm == -1 hook) so the compact extent tiles cleanly on the MXU —
-        # measured 2.7x slower at 256^3/15% without the pad (ragged extents defeat
-        # XLA's tiling). Compaction only pays when the active set is genuinely
-        # sparse; near-dense plans keep the full power-of-two extent, which tiles
-        # better than e.g. 176/256.
-        quantum = max(1, int(_X_PAD_QUANTUM))
-        A = -(-int(ux.size) // quantum) * quantum
-        if A > p.dim_x_freq // 2:
-            A = p.dim_x_freq
+        A = offt.compact_x_extent(ux.size, p.dim_x_freq)
         self._x_active = ux
         self._num_x_active = A
 
@@ -101,19 +87,7 @@ class MxuLocalExecution(ExecutionBase):
             ScalingType.FULL: pair(offt.c2c_matrix(p.dim_z, -1, scale=1.0 / p.total_size)),
         }
         self._wy_f = pair(offt.c2c_matrix(p.dim_y, -1))
-        def pad_rows(m):
-            return np.vstack([m[ux], np.zeros((A - ux.size, m.shape[1]), m.dtype)])
-
-        if r2c:
-            a, b = offt.c2r_matrices(p.dim_x)
-            self._wx_b = (pad_rows(a).astype(rt), pad_rows(b).astype(rt))  # (A, X)
-            a, b = offt.r2c_matrices(p.dim_x)
-            self._wx_f = (pad_rows(a.T).T.astype(rt), pad_rows(b.T).T.astype(rt))  # (X, A)
-        else:
-            self._wx_b = pair(offt.c2c_matrix(p.dim_x, +1, row_perm=ux, num_rows=A))
-            # DFT matrix is symmetric, so the column-subset forward matrix is the
-            # transpose of the row-subset one.
-            self._wx_f = pair(offt.c2c_matrix(p.dim_x, -1, row_perm=ux, num_rows=A).T)
+        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux, A, r2c, rt)
 
         # R2C backward plane symmetry acts on the x == 0 plane; with x compaction
         # that is slot 0 iff an x == 0 stick exists (otherwise the plane is zero

@@ -25,8 +25,9 @@ engine, the fast path on CPU meshes), but engineered like the local MXU engine
   complex product run on the MXU).
 
 Space-domain layout is the public (L, Y, X) slab per shard; the backward
-pipeline's only transposes are one (Y*Xf, L) -> (L, Y*Xf) dense transpose per
-direction, placed so every xy matmul keeps x on the 128-lane minor dimension.
+pipeline's only transposes are one (Y*A, L) -> (L, Y*A) dense transpose per
+direction (A = global active-x extent, the mesh-wide "uniqueXIndices"
+compaction), placed so every xy matmul keeps x on the 128-lane minor dimension.
 
 Compile-size note: the ``lax.switch`` embeds P copy-plan branches in the one
 SPMD program. That is cheap for pod-slice shard counts (P <= 64); beyond that,
@@ -97,6 +98,26 @@ class MxuDistributedExecution(PaddingHelpers):
         Z, Y, Xf = p.dim_z, p.dim_y, p.dim_x_freq
         self._S, self._L, self._V = S, L, V
 
+        # ---- global active-x compaction ----------------------------------------
+        # The xy stages only touch x-rows that carry at least one stick anywhere
+        # in the mesh — the reference's "uniqueXIndices" optimization
+        # (reference: src/execution/execution_host.cpp:138-144) as rectangular
+        # DFT matrices, like the local MXU engine. Extent padding / full-extent
+        # fallback policy: ops/fft.compact_x_extent.
+        sx_all = p.stick_x_all.reshape(-1).astype(np.int64)
+        ux = np.unique(sx_all[sx_all < Xf])
+        if ux.size == 0:
+            ux = np.zeros(1, dtype=np.int64)
+        A = offt.compact_x_extent(ux.size, Xf)
+        if A == Xf:
+            ux_full = np.arange(Xf, dtype=np.int64)
+            xslot_of = np.arange(Xf, dtype=np.int64)
+        else:
+            ux_full = ux
+            xslot_of = np.zeros(Xf, dtype=np.int64)
+            xslot_of[ux] = np.arange(ux.size)
+        self._num_x_active = A
+
         # ---- DFT matrices (static constants; scale folded into forward z) ----
         def pair(w):
             return w.real.astype(rt), w.imag.astype(rt)
@@ -108,12 +129,7 @@ class MxuDistributedExecution(PaddingHelpers):
             ScalingType.NONE: pair(offt.c2c_matrix(Z, -1)),
             ScalingType.FULL: pair(offt.c2c_matrix(Z, -1, scale=1.0 / p.total_size)),
         }
-        if r2c:
-            self._wx_b = tuple(a.astype(rt) for a in offt.c2r_matrices(p.dim_x))  # (Xf, X)
-            self._wx_f = tuple(a.astype(rt) for a in offt.r2c_matrices(p.dim_x))  # (X, Xf)
-        else:
-            self._wx_b = pair(offt.c2c_matrix(p.dim_x, +1))
-            self._wx_f = pair(offt.c2c_matrix(p.dim_x, -1))
+        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux_full, A, r2c, rt)
 
         # ---- exchange geometry (global constants, identical on every shard) ----
         # z-split: uniform slabs make pack/unpack pure reshapes; ragged slabs go
@@ -122,17 +138,21 @@ class MxuDistributedExecution(PaddingHelpers):
         self._uniform_z = bool((lz == L).all() and (zo == np.arange(p.num_shards) * L).all())
         self._pack_z = p.pack_z_map()  # (P*L,) global z per packed slot, sentinel dim_z
         self._unpack_z = p.unpack_z_map()  # (Z,) packed slot per global z
-        # global stick slot tables over the padded (P, S) stick order
-        sx = p.stick_x_all.reshape(-1).astype(np.int64)
+        # global stick slot tables over the padded (P, S) stick order, in the
+        # COMPACT (Y, A) plane space: slot = y * A + xslot(x)
         sy = p.stick_y_all.reshape(-1).astype(np.int64)
-        yx = sy * Xf + sx
-        yx[sx >= Xf] = Y * Xf  # padding sentinel: one past the plane
-        self._stick_yx = yx.astype(np.int32)  # (P*S,) plane slot per global stick
-        # inverse: plane slot -> global stick row (sentinel P*S -> zero row)
-        inv = np.full(Y * Xf, p.num_shards * S, dtype=np.int32)
-        inv[yx[yx < Y * Xf]] = np.flatnonzero(yx < Y * Xf).astype(np.int32)
+        valid = sx_all < Xf
+        yx = np.full(sx_all.size, Y * A, dtype=np.int64)  # padding sentinel
+        yx[valid] = sy[valid] * A + xslot_of[sx_all[valid]]
+        self._stick_yx = yx.astype(np.int32)  # (P*S,) compact plane slot per stick
+        # inverse: compact plane slot -> global stick row (sentinel P*S -> zero row)
+        inv = np.full(Y * A, p.num_shards * S, dtype=np.int32)
+        inv[yx[valid]] = np.flatnonzero(valid).astype(np.int32)
         self._yx_stick = inv
-        self._have_x0 = bool((sx[sx < Xf] == 0).any())
+        # R2C backward plane symmetry acts on x == 0, which is slot 0 iff an
+        # x == 0 stick exists (otherwise that compact column is absent or zero;
+        # ux is sorted, so any valid x == 0 lands in slot 0)
+        self._have_x0 = bool((sx_all[valid] == 0).any())
 
         # ---- per-shard value copy plans (lax.switch branches) ----
         self._decompress_branches = []
@@ -254,7 +274,8 @@ class MxuDistributedExecution(PaddingHelpers):
     def _backward_impl(self, values_re, values_im):
         p = self.params
         prec = self._precision
-        S, L, Z, Y, Xf = self._S, self._L, p.dim_z, p.dim_y, p.dim_x_freq
+        S, L, Z, Y = self._S, self._L, p.dim_z, p.dim_y
+        A = self._num_x_active
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)
 
@@ -289,13 +310,13 @@ class MxuDistributedExecution(PaddingHelpers):
         with jax.named_scope("exchange"):
             rre, rim = self._exchange(bre, bim)
 
-        # expand: (P*S, L) global stick rows -> (L, Y, Xf) freq planes
+        # expand: (P*S, L) global stick rows -> (L, Y, A) compact freq planes
         with jax.named_scope("unpack"):
             rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
             rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
             m = jnp.asarray(self._yx_stick)
-            gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, Xf)
-            gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, Xf)
+            gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
+            gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
@@ -317,7 +338,8 @@ class MxuDistributedExecution(PaddingHelpers):
     def _forward_impl(self, space_re, space_im=None, *, scaling):
         p = self.params
         prec = self._precision
-        S, L, Z, Y, Xf = self._S, self._L, p.dim_z, p.dim_y, p.dim_x_freq
+        S, L, Z, Y = self._S, self._L, p.dim_z, p.dim_y
+        A = self._num_x_active
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)
 
@@ -334,13 +356,13 @@ class MxuDistributedExecution(PaddingHelpers):
         with jax.named_scope("y transform"):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
 
-        # pack: gather every global stick's (y, x) slot from my planes
+        # pack: gather every global stick's compact (y, x) slot from my planes
         with jax.named_scope("pack"):
             flat_re = jnp.concatenate(
-                [gre.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+                [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
             )
             flat_im = jnp.concatenate(
-                [gim.reshape(L, Y * Xf).T, jnp.zeros((1, L), rt)]
+                [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
             )
             m = jnp.asarray(self._stick_yx)
             bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)

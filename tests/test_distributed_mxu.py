@@ -143,6 +143,77 @@ def test_mxu_ragged_z_split():
         assert_close(back[r], vals)
 
 
+def test_mxu_active_x_compaction():
+    """Sticks concentrated on few x rows trigger the rectangular-matrix compact
+    path (A < dim_x_freq // 2) in the distributed MXU engine."""
+    rng = np.random.default_rng(17)
+    dx, dy, dz = 64, 16, 16
+    xs = np.asarray([0, 3, 50])  # 3 active x rows of 64 -> A = 8 after padding
+    trip = []
+    for x in xs:
+        for y in range(dy):
+            for z in range(dz):
+                trip.append((x, y, z))
+    trip = np.asarray(trip, dtype=np.int64)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+    t = DistributedTransform(
+        ProcessingUnit.GPU,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(4),
+        engine="mxu",
+    )
+    assert t._exec._num_x_active == 8  # compact, not the full 64
+    expected = oracle_backward_c2c(trip, values, dx, dy, dz)
+    assert_close(t.backward(vps), expected)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_mxu_r2c_active_x_compaction():
+    """R2C on few active x rows: rectangular c2r/r2c matrix pairs."""
+    rng = np.random.default_rng(19)
+    dx, dy, dz = 64, 12, 10
+    r = rng.standard_normal((dz, dy, dx))
+    full = np.fft.fftn(r)
+    xs = [0, 2, 9]  # 3 of 33 x-freq rows -> A = 8 after padding
+    trip = np.asarray(
+        [(x, y, z) for x in xs for y in range(dy) for z in range(dz)], dtype=np.int64
+    )
+    values = full[trip[:, 2], trip[:, 1], trip[:, 0]]
+
+    # hermitian-closed masked spectrum oracle
+    dense = np.zeros((dz, dy, dx), dtype=np.complex128)
+    dense[trip[:, 2], trip[:, 1], trip[:, 0]] = values
+    dense[(-trip[:, 2]) % dz, (-trip[:, 1]) % dy, (-trip[:, 0]) % dx] = np.conj(values)
+    expected = np.fft.ifftn(dense) * (dx * dy * dz)
+    assert np.abs(expected.imag).max() < 1e-9
+
+    per_shard = distribute_triplets(trip, 3, dy)
+    vps = split_values(per_shard, trip, values)
+    t = DistributedTransform(
+        ProcessingUnit.GPU,
+        TransformType.R2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(3),
+        engine="mxu",
+    )
+    assert t._exec._num_x_active == 8
+    assert_close(t.backward(vps), expected.real)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r_, vals in enumerate(vps):
+        assert_close(back[r_], vals)
+
+
 def test_mxu_centered_indexing():
     """Centered (negative-frequency) triplets on the distributed MXU engine."""
     rng = np.random.default_rng(21)
