@@ -1,0 +1,58 @@
+"""ExecType semantics: SPFFT_EXEC_SYNCHRONOUS / ASYNCHRONOUS + synchronize().
+
+Reference: include/spfft/types.h:108-117 (SpfftExecType),
+include/spfft/transform.hpp:225 (set_execution_mode). The host-facing calls
+materialize numpy results either way (docs/details.md "Asynchronous
+execution"); these tests pin the mode plumbing and that results are identical
+in both modes.
+"""
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import ExecType, ProcessingUnit, ScalingType, Transform, TransformType
+from spfft_tpu.errors import InvalidParameterError
+from utils import assert_close, random_sparse_triplets
+
+
+def _make(engine="xla"):
+    rng = np.random.default_rng(8)
+    trip = random_sparse_triplets(rng, 8, 9, 10, 0.5)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 9, 10, indices=trip, engine=engine
+    )
+    v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    return t, v
+
+
+def test_default_mode_is_synchronous():
+    t, _ = _make()
+    assert t.execution_mode() == ExecType.SYNCHRONOUS
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_async_mode_same_results(engine):
+    t, v = _make(engine)
+    sync_space = t.backward(v)
+    sync_round = t.forward(scaling=ScalingType.FULL)
+
+    t.set_execution_mode(ExecType.ASYNCHRONOUS)
+    assert t.execution_mode() == ExecType.ASYNCHRONOUS
+    async_space = t.backward(v)
+    t.synchronize()  # reference contract: wait on the retained space buffer
+    async_round = t.forward(scaling=ScalingType.FULL)
+
+    assert_close(async_space, sync_space)
+    assert_close(async_round, sync_round)
+    assert_close(async_round, v)
+
+
+def test_invalid_mode_rejected():
+    t, _ = _make()
+    with pytest.raises((InvalidParameterError, ValueError)):
+        t.set_execution_mode(99)
+
+
+def test_synchronize_before_any_transform_is_noop():
+    t, _ = _make()
+    t.synchronize()  # no retained buffer yet; must not raise
