@@ -41,68 +41,162 @@ _FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_F
 class PaddingHelpers:
     """Host-side padding between caller per-shard arrays and the padded-uniform
     sharded device layout. Shared by both mesh engines (DistributedExecution and
-    MxuDistributedExecution); requires ``params``, ``real_dtype``,
+    MxuDistributedExecution); requires ``params``, ``mesh``, ``real_dtype``,
     ``complex_dtype``, ``is_r2c``, ``_V``, ``_L``, ``value_sharding`` and
-    ``space_sharding`` on the inheriting class."""
+    ``space_sharding`` on the inheriting class.
+
+    Multi-host: when the mesh spans processes (after
+    :func:`spfft_tpu.init_distributed`), each process supplies/receives only the
+    shards on its own devices — the reference's per-rank data contract
+    (reference: docs/source/details.rst:50-53). Remote entries of
+    ``values_per_shard`` may be ``None``; ``unpad_*`` return ``None`` for
+    remote shards.
+    """
+
+    def _local_shard_ids(self):
+        me = jax.process_index()
+        return [
+            i for i, d in enumerate(self.mesh.devices.flat) if d.process_index == me
+        ]
+
+    def _check_count(self, r, v):
+        if v.size != int(self.params.num_values_per_shard[r]):
+            from ..errors import InvalidParameterError
+
+            raise InvalidParameterError(
+                f"shard {r}: expected {int(self.params.num_values_per_shard[r])} "
+                f"values, got {v.size}"
+            )
 
     def pad_values(self, values_per_shard):
         """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
         p = self.params
-        re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
-        im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
-        for r, v in enumerate(values_per_shard):
-            v = np.asarray(v).reshape(-1)
-            if v.size != int(p.num_values_per_shard[r]):
-                from ..errors import InvalidParameterError
+        if jax.process_count() == 1:
+            re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
+            im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
+            for r, v in enumerate(values_per_shard):
+                v = np.asarray(v).reshape(-1)
+                self._check_count(r, v)
+                re[r, : v.size] = v.real
+                im[r, : v.size] = v.imag
+            return (
+                jax.device_put(re, self.value_sharding),
+                jax.device_put(im, self.value_sharding),
+            )
+        # multi-host: assemble the global array from process-local shard blocks
+        if len(values_per_shard) != p.num_shards:
+            from ..errors import InvalidParameterError
 
-                raise InvalidParameterError(
-                    f"shard {r}: expected {int(p.num_values_per_shard[r])} values, got {v.size}"
-                )
-            re[r, : v.size] = v.real
-            im[r, : v.size] = v.imag
+            raise InvalidParameterError(
+                f"values_per_shard must have one entry per shard "
+                f"({p.num_shards}; None for shards owned by other processes), "
+                f"got {len(values_per_shard)}"
+            )
+        flat = list(self.mesh.devices.flat)
+        blocks_re, blocks_im = [], []
+        for r in self._local_shard_ids():
+            v = np.asarray(values_per_shard[r]).reshape(-1)
+            self._check_count(r, v)
+            re = np.zeros((1, self._V), dtype=self.real_dtype)
+            im = np.zeros((1, self._V), dtype=self.real_dtype)
+            re[0, : v.size] = v.real
+            im[0, : v.size] = v.imag
+            blocks_re.append(jax.device_put(re, flat[r]))
+            blocks_im.append(jax.device_put(im, flat[r]))
+        shape = (p.num_shards, self._V)
         return (
-            jax.device_put(re, self.value_sharding),
-            jax.device_put(im, self.value_sharding),
+            jax.make_array_from_single_device_arrays(
+                shape, self.value_sharding, blocks_re
+            ),
+            jax.make_array_from_single_device_arrays(
+                shape, self.value_sharding, blocks_im
+            ),
         )
 
     def unpad_values(self, pair):
-        """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays."""
-        re, im = np.asarray(pair[0]), np.asarray(pair[1])
-        return [
-            re[r, :n] + 1j * im[r, :n]
-            for r, n in enumerate(int(x) for x in self.params.num_values_per_shard)
-        ]
+        """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays
+        (``None`` for shards owned by other processes)."""
+        counts = [int(x) for x in self.params.num_values_per_shard]
+        if jax.process_count() == 1:
+            re, im = np.asarray(pair[0]), np.asarray(pair[1])
+            return [re[r, :n] + 1j * im[r, :n] for r, n in enumerate(counts)]
+        out = [None] * self.params.num_shards
+        ims = {s.index[0].start: np.asarray(s.data) for s in pair[1].addressable_shards}
+        for s in pair[0].addressable_shards:
+            r = s.index[0].start
+            n = counts[r]
+            out[r] = np.asarray(s.data)[0, :n] + 1j * ims[r][0, :n]
+        return out
 
     def pad_space(self, space):
-        """Global (Z, Y, X) array -> sharded (P, L, Y, X) real (re, im or re-only) arrays."""
+        """Global (Z, Y, X) array -> sharded (P, L, Y, X) real (re, im or re-only)
+        arrays. On a multi-process mesh each process stages only its own shards
+        (the global input array must still be supplied on every process)."""
         p = self.params
         arrs = []
         parts = [np.asarray(space).real, None if self.is_r2c else np.asarray(space).imag]
+        multihost = jax.process_count() > 1
+        flat = list(self.mesh.devices.flat)
         for part in parts:
             if part is None:
                 arrs.append(None)
                 continue
-            out = np.zeros((p.num_shards, self._L, p.dim_y, p.dim_x), dtype=self.real_dtype)
-            for r in range(p.num_shards):
+            if not multihost:
+                out = np.zeros(
+                    (p.num_shards, self._L, p.dim_y, p.dim_x), dtype=self.real_dtype
+                )
+                for r in range(p.num_shards):
+                    l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
+                    out[r, :l] = part[o : o + l]
+                arrs.append(jax.device_put(out, self.space_sharding))
+                continue
+            blocks = []
+            for r in self._local_shard_ids():
                 l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
-                out[r, :l] = part[o : o + l]
-            arrs.append(jax.device_put(out, self.space_sharding))
+                blk = np.zeros((1, self._L, p.dim_y, p.dim_x), dtype=self.real_dtype)
+                blk[0, :l] = part[o : o + l]
+                blocks.append(jax.device_put(blk, flat[r]))
+            arrs.append(
+                jax.make_array_from_single_device_arrays(
+                    (p.num_shards, self._L, p.dim_y, p.dim_x),
+                    self.space_sharding,
+                    blocks,
+                )
+            )
         return arrs[0], arrs[1]
 
     def unpad_space(self, out):
-        """Sharded (P, L, Y, X) result -> global (Z, Y, X) numpy array."""
+        """Sharded (P, L, Y, X) result -> global (Z, Y, X) numpy array.
+
+        On a multi-process mesh, returns a per-shard list instead (local slab
+        arrays of shape (local_z_length, Y, X); ``None`` for remote shards) —
+        the reference's per-rank space-domain contract."""
         p = self.params
+        if jax.process_count() == 1:
+            if self.is_r2c:
+                full = np.asarray(out)
+                dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
+            else:
+                re, im = np.asarray(out[0]), np.asarray(out[1])
+                full = re + 1j * im
+                dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
+            for r in range(p.num_shards):
+                l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
+                dst[o : o + l] = full[r, :l]
+            return dst
+        slabs = [None] * p.num_shards
         if self.is_r2c:
-            full = np.asarray(out)
-            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
-        else:
-            re, im = np.asarray(out[0]), np.asarray(out[1])
-            full = re + 1j * im
-            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
-        for r in range(p.num_shards):
-            l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
-            dst[o : o + l] = full[r, :l]
-        return dst
+            for s in out.addressable_shards:
+                r = s.index[0].start
+                l = int(p.local_z_lengths[r])
+                slabs[r] = np.asarray(s.data)[0, :l]
+            return slabs
+        ims = {s.index[0].start: np.asarray(s.data) for s in out[1].addressable_shards}
+        for s in out[0].addressable_shards:
+            r = s.index[0].start
+            l = int(p.local_z_lengths[r])
+            slabs[r] = np.asarray(s.data)[0, :l] + 1j * ims[r][0, :l]
+        return slabs
 
 
 class DistributedExecution(PaddingHelpers):

@@ -1,0 +1,41 @@
+"""Multi-host (2-process) distributed transforms via subprocess ranks.
+
+The analogue of the reference running its MPI tests under ``mpirun -n 2``
+(reference: .github/workflows/ci.yml:80-84): two OS processes, one CPU device
+each, a global 2-device mesh, collectives over Gloo. Each rank supplies and
+receives only its own shard's data (programs/multihost_smoke.py).
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+SCRIPT = Path(__file__).resolve().parent.parent / "programs" / "multihost_smoke.py"
+
+
+@pytest.mark.parametrize("engine,port", [("xla", 12971), ("mxu", 12973)])
+def test_two_process_roundtrip(engine, port):
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(SCRIPT), str(rank), str(port), engine],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:  # a hung rank must not leak Gloo processes / the port
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        assert f"RANK {rank} PASS" in out
