@@ -1,4 +1,14 @@
-"""Microbench round 2: precision tiers, fixed four-step, radix-2 hybrid."""
+"""Microbench round 2: precision tiers, fixed four-step, radix-2 hybrid.
+
+!! TIMING METHODOLOGY SUPERSEDED: this harness times independent repeats with
+jax.block_until_ready, which neither prevents XLA from hoisting loop-invariant
+work nor fences execution on the tunneled axon TPU. Numbers from it are
+unreliable; use the dependent-chain + scalar-fetch methodology of
+programs/microbench_ablate.py / microbench_pallas.py instead. Kept for the
+record of which variants were explored. (The direct-matmul-DFT design choice it
+informed was re-validated with correct timing: see BASELINE.md "Four-step
+factored DFT".)
+"""
 from __future__ import annotations
 
 import argparse
