@@ -1,0 +1,89 @@
+"""Plane-wave Poisson solver on a sparse frequency sphere — the workload class
+SpFFT was built for (SIRIUS-style plane-wave DFT codes; reference: README.md:8).
+
+Solves the periodic Poisson equation  -lap(phi) = rho  on an N^3 box:
+the charge density rho lives on the real-space grid; its spectrum is truncated
+to a spherical cutoff |G| <= G_max (the plane-wave basis), where the equation
+diagonalizes: phi_hat(G) = rho_hat(G) / |G|^2 (phi_hat(0) = 0 fixes the gauge
+for a neutral cell). Only the inside-cutoff coefficients are ever stored or
+transformed — exactly the sparse-frequency contract of the library.
+
+Run: PYTHONPATH=/root/repo python examples/poisson.py
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import spfft_tpu as sp
+from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+
+
+def main():
+    n = 48
+    box = 2 * np.pi  # cubic cell, side length 2*pi -> G vectors are integers
+
+    # Plane-wave basis: all G triplets inside the cutoff sphere (centered
+    # indexing: negative frequencies as negative integers).
+    g_max = n // 4
+    # generator returns centered triplets (negative frequencies as negatives)
+    trip = sp.create_spherical_cutoff_triplets(n, n, n, 2 * g_max / n)
+    g = trip.astype(np.float64) * (2 * np.pi / box)
+    g2 = (g**2).sum(axis=1)
+
+    t = Transform(
+        ProcessingUnit.GPU if _have_accel() else ProcessingUnit.HOST,
+        TransformType.C2C,
+        n,
+        n,
+        n,
+        indices=trip,
+    )
+
+    # A neutral charge density: two opposite Gaussian blobs.
+    zyx = np.stack(
+        np.meshgrid(*([np.arange(n) * (box / n)] * 3), indexing="ij"), axis=-1
+    )
+
+    def blob(center, sign, width=0.35):
+        d = zyx - np.asarray(center)
+        d -= box * np.round(d / box)  # minimum-image (periodic)
+        return sign * np.exp(-(d**2).sum(-1) / (2 * width**2))
+
+    rho = blob((2.0, 2.0, 2.0), +1.0) + blob((4.5, 4.0, 3.0), -1.0)
+    rho -= rho.mean()  # enforce neutrality exactly
+
+    # forward: real space -> sparse plane-wave coefficients (scaled DFT)
+    rho_hat = t.forward(rho.astype(np.complex128), scaling=ScalingType.FULL)
+
+    # solve in the plane-wave basis
+    phi_hat = np.where(g2 > 0, rho_hat / np.maximum(g2, 1e-300), 0.0)
+
+    # backward: coefficients -> potential on the grid
+    phi = t.backward(phi_hat).real
+
+    # residual of the PDE, evaluated spectrally on the SAME sparse basis
+    lap_hat = t.forward(phi.astype(np.complex128), scaling=ScalingType.FULL) * g2
+    mask = g2 > 0
+    res = np.abs(lap_hat[mask] - rho_hat[mask]).max() / np.abs(rho_hat[mask]).max()
+
+    print(f"plane-wave basis size: {len(trip)} of {n**3} grid points "
+          f"({100 * len(trip) / n**3:.1f}%)")
+    print(f"potential range: [{phi.min():.4f}, {phi.max():.4f}]")
+    print(f"spectral residual |G^2 phi - rho| / |rho|: {res:.2e}")
+    # the transform roundtrip is ~1e-9; the spectral residual amplifies it by
+    # |G|^2 (up to ~430 here), so a few 1e-6 is the expected floor
+    assert res < 1e-5, "Poisson solve failed"
+    print("OK")
+
+
+def _have_accel() -> bool:
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+if __name__ == "__main__":
+    main()
