@@ -77,16 +77,9 @@ class MxuLocalExecution(ExecutionBase):
         self._num_x_active = A
 
         # ---- DFT matrices (static constants; scale folded into forward z) ----
-        def pair(w):
-            return w.real.astype(rt), w.imag.astype(rt)
-
-        self._wz_b = pair(offt.c2c_matrix(p.dim_z, +1))
-        self._wy_b = pair(offt.c2c_matrix(p.dim_y, +1))
-        self._wz_f = {
-            ScalingType.NONE: pair(offt.c2c_matrix(p.dim_z, -1)),
-            ScalingType.FULL: pair(offt.c2c_matrix(p.dim_z, -1, scale=1.0 / p.total_size)),
-        }
-        self._wy_f = pair(offt.c2c_matrix(p.dim_y, -1))
+        self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
+            p.dim_z, p.dim_y, p.total_size, rt
+        )
         self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux, A, r2c, rt)
 
         # R2C backward plane symmetry acts on the x == 0 plane; with x compaction

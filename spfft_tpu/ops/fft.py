@@ -25,6 +25,8 @@ include/spfft/types.h:41-47).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -96,6 +98,31 @@ def c2r_matrices(n: int, scale: float = 1.0):
     return scale * (c[:, None] * np.cos(theta)), scale * (c[:, None] * np.sin(theta))
 
 
+def matrix_pair(w, real_dtype):
+    """Complex matrix -> (re, im) real pair in the engine dtype."""
+    return w.real.astype(real_dtype), w.imag.astype(real_dtype)
+
+
+def zy_stage_matrices(dim_z: int, dim_y: int, total_size: int, real_dtype):
+    """The z/y DFT matrix constants every MXU engine needs: backward z and y,
+    forward y, and the forward-z table with the FULL 1/(NxNyNz) scaling folded
+    in (reference applies it in the compress loop,
+    src/compression/compression_host.hpp:63). Returns (wz_b, wy_b, wy_f, wz_f)."""
+    from ..types import ScalingType
+
+    rt = real_dtype
+    wz_f = {
+        ScalingType.NONE: matrix_pair(c2c_matrix(dim_z, -1), rt),
+        ScalingType.FULL: matrix_pair(c2c_matrix(dim_z, -1, scale=1.0 / total_size), rt),
+    }
+    return (
+        matrix_pair(c2c_matrix(dim_z, +1), rt),
+        matrix_pair(c2c_matrix(dim_y, +1), rt),
+        matrix_pair(c2c_matrix(dim_y, -1), rt),
+        wz_f,
+    )
+
+
 def compact_x_extent(num_unique: int, dim_x_freq: int) -> int:
     """Padded active-x extent for the uniqueXIndices compaction.
 
@@ -108,8 +135,6 @@ def compact_x_extent(num_unique: int, dim_x_freq: int) -> int:
     copy plans and no longer wins). Shared by the local and distributed MXU
     engines; a huge SPFFT_TPU_XPAD still disables compaction.
     """
-    import os
-
     quantum = max(1, int(os.environ.get("SPFFT_TPU_XPAD", "8")))
     a = -(-max(1, int(num_unique)) // quantum) * quantum
     return min(a, dim_x_freq)
@@ -136,13 +161,10 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
         wx_f = (pad_rows(a.T).T.astype(rt), pad_rows(b.T).T.astype(rt))  # (X, A)
         return wx_b, wx_f
 
-    def pair(w):
-        return w.real.astype(rt), w.imag.astype(rt)
-
-    wx_b = pair(c2c_matrix(dim_x, +1, row_perm=ux, num_rows=num_rows))
+    wx_b = matrix_pair(c2c_matrix(dim_x, +1, row_perm=ux, num_rows=num_rows), rt)
     # the DFT matrix is symmetric, so the column-subset forward matrix is the
     # transpose of the row-subset one
-    wx_f = pair(c2c_matrix(dim_x, -1, row_perm=ux, num_rows=num_rows).T)
+    wx_f = matrix_pair(c2c_matrix(dim_x, -1, row_perm=ux, num_rows=num_rows).T, rt)
     return wx_b, wx_f
 
 

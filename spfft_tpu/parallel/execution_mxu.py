@@ -17,9 +17,9 @@ engine, the fast path on CPU meshes), but engineered like the local MXU engine
   a (re, im)-stacked buffer — the uniform-block BUFFERED discipline of the
   reference (reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
   which is the collective shape ICI likes; ``*_FLOAT`` exchange variants halve
-  wire bytes (f64 -> f32 wire, f32 -> bf16 wire) inside the pack/unpack, the
-  analogue of the reference's float exchanges (reference:
-  include/spfft/types.h:41-47, src/gpu_util/complex_conversion.cuh:37-56),
+  the f64 wire to f32 around the collective, the analogue of the reference's
+  float exchanges (reference: include/spfft/types.h:41-47,
+  src/gpu_util/complex_conversion.cuh:37-56),
 * complex data is carried as (re, im) real pairs end to end (axon TPU cannot
   transfer complex across the host boundary, and real pairs let the 4-matmul
   complex product run on the MXU).
@@ -119,16 +119,9 @@ class MxuDistributedExecution(PaddingHelpers):
         self._num_x_active = A
 
         # ---- DFT matrices (static constants; scale folded into forward z) ----
-        def pair(w):
-            return w.real.astype(rt), w.imag.astype(rt)
-
-        self._wz_b = pair(offt.c2c_matrix(Z, +1))
-        self._wy_b = pair(offt.c2c_matrix(Y, +1))
-        self._wy_f = pair(offt.c2c_matrix(Y, -1))
-        self._wz_f = {
-            ScalingType.NONE: pair(offt.c2c_matrix(Z, -1)),
-            ScalingType.FULL: pair(offt.c2c_matrix(Z, -1, scale=1.0 / p.total_size)),
-        }
+        self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
+            Z, Y, p.total_size, rt
+        )
         self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux_full, A, r2c, rt)
 
         # ---- exchange geometry (global constants, identical on every shard) ----
@@ -194,7 +187,7 @@ class MxuDistributedExecution(PaddingHelpers):
 
     def _make_decompress(self, vi: np.ndarray, n: int):
         """Branch: (V_max,) pair -> (S, Z) pair sticks for one shard."""
-        S, Z, V = self._S, self.params.dim_z, self._V
+        S, Z = self._S, self.params.dim_z
         plan = lanecopy.build_decompress_plan(vi, S * Z, n) if n else None
 
         if plan is not None:
@@ -274,7 +267,7 @@ class MxuDistributedExecution(PaddingHelpers):
     def _backward_impl(self, values_re, values_im):
         p = self.params
         prec = self._precision
-        S, L, Z, Y = self._S, self._L, p.dim_z, p.dim_y
+        S, L, Y = self._S, self._L, p.dim_y
         A = self._num_x_active
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)
@@ -338,7 +331,7 @@ class MxuDistributedExecution(PaddingHelpers):
     def _forward_impl(self, space_re, space_im=None, *, scaling):
         p = self.params
         prec = self._precision
-        S, L, Z, Y = self._S, self._L, p.dim_z, p.dim_y
+        S, L, Y = self._S, self._L, p.dim_y
         A = self._num_x_active
         rt = self.real_dtype
         shard = jax.lax.axis_index(FFT_AXIS)

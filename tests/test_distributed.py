@@ -19,6 +19,7 @@ from spfft_tpu import (
 )
 from spfft_tpu.parameters import distribute_triplets
 from utils import (
+    split_values,
     assert_close,
     oracle_backward_c2c,
     oracle_forward_c2c,
@@ -29,12 +30,6 @@ from utils import (
 
 def make_mesh(n):
     return sp.make_fft_mesh(n)
-
-
-def split_values(triplets_per_shard, full_triplets, full_values):
-    """Look up each shard's values from a global (triplet -> value) map."""
-    lut = {tuple(t): v for t, v in zip(map(tuple, full_triplets), full_values)}
-    return [np.asarray([lut[tuple(t)] for t in trip]) for trip in triplets_per_shard]
 
 
 @pytest.mark.parametrize("num_shards", [2, 4, 8])

@@ -17,12 +17,7 @@ from spfft_tpu import (
     TransformType,
 )
 from spfft_tpu.parameters import distribute_triplets
-from utils import assert_close, oracle_backward_c2c, random_sparse_triplets
-
-
-def split_values(triplets_per_shard, full_triplets, full_values):
-    lut = {tuple(t): v for t, v in zip(map(tuple, full_triplets), full_values)}
-    return [np.asarray([lut[tuple(t)] for t in trip]) for trip in triplets_per_shard]
+from utils import split_values, assert_close, oracle_backward_c2c, random_sparse_triplets
 
 
 def make_c2c(num_shards, dims, exchange=ExchangeType.BUFFERED, dtype=None, seed=42):
@@ -144,8 +139,8 @@ def test_mxu_ragged_z_split():
 
 
 def test_mxu_active_x_compaction():
-    """Sticks concentrated on few x rows trigger the rectangular-matrix compact
-    path (A < dim_x_freq // 2) in the distributed MXU engine."""
+    """Sticks concentrated on few x rows get a small compact extent
+    (rectangular matrices) in the distributed MXU engine."""
     rng = np.random.default_rng(17)
     dx, dy, dz = 64, 16, 16
     xs = np.asarray([0, 3, 50])  # 3 active x rows of 64 -> A = 8 after padding

@@ -96,3 +96,9 @@ def assert_close(actual, expected, dtype=np.float64):
     # looser bar.
     atol = 1e-6 * scale if np.dtype(dtype) == np.float64 else 1e-3 * scale
     np.testing.assert_allclose(actual, expected, rtol=0, atol=atol)
+
+
+def split_values(triplets_per_shard, full_triplets, full_values):
+    """Look up each shard's values from a global (triplet -> value) map."""
+    lut = {tuple(t): v for t, v in zip(map(tuple, full_triplets), full_values)}
+    return [np.asarray([lut[tuple(t)] for t in trip]) for trip in triplets_per_shard]
