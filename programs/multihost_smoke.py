@@ -7,7 +7,7 @@ supply values for their OWN shard only, run backward+forward through the mesh
 engine, and verify their local slab against a dense oracle plus the value
 roundtrip. Prints "RANK <r> PASS" on success.
 
-Usage: multihost_smoke.py <rank> <port> <engine>
+Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c]
 """
 import os
 import sys
@@ -15,6 +15,7 @@ import sys
 rank = int(sys.argv[1])
 port = int(sys.argv[2])
 engine = sys.argv[3]
+ttype_name = sys.argv[4] if len(sys.argv) > 4 else "c2c"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
@@ -36,11 +37,22 @@ mesh = sp.make_fft_mesh(2)
 
 dx, dy, dz = 8, 9, 10
 rng = np.random.default_rng(42)  # same seed on both ranks -> same global plan
-xs, ys = np.meshgrid(np.arange(dx), np.arange(dy), indexing="ij")
-keys = np.stack([xs.ravel(), ys.ravel()], axis=1)
-chosen = keys[rng.choice(len(keys), size=len(keys) // 2, replace=False)]
-triplets = np.asarray([(x, y, z) for x, y in chosen for z in range(dz)])
-values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
+r2c = ttype_name == "r2c"
+if r2c:
+    # full half-spectrum of a real field: real output, exact value roundtrip
+    real_field = rng.standard_normal((dz, dy, dx))
+    full = np.fft.fftn(real_field) / (dx * dy * dz)
+    xs = np.arange(dx // 2 + 1)
+    triplets = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    values = full[triplets[:, 2], triplets[:, 1], triplets[:, 0]]
+else:
+    xs, ys = np.meshgrid(np.arange(dx), np.arange(dy), indexing="ij")
+    keys = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    chosen = keys[rng.choice(len(keys), size=len(keys) // 2, replace=False)]
+    triplets = np.asarray([(x, y, z) for x, y in chosen for z in range(dz)])
+    values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
 per_shard = distribute_triplets(triplets, 2, dy)
 
 lut = {tuple(t): v for t, v in zip(map(tuple, triplets), values)}
@@ -48,7 +60,7 @@ values_per_shard = [np.asarray([lut[tuple(t)] for t in trip]) for trip in per_sh
 
 t = DistributedTransform(
     ProcessingUnit.HOST,
-    TransformType.C2C,
+    TransformType.R2C if r2c else TransformType.C2C,
     dx,
     dy,
     dz,
@@ -64,7 +76,10 @@ supplied = [v if r in mine else None for r, v in enumerate(values_per_shard)]
 pair = ex.pad_values(supplied)
 
 out = ex.backward_pair(*pair)
-back = ex.forward_pair(out[0], out[1], ScalingType.FULL)
+if r2c:
+    back = ex.forward_pair(out, None, ScalingType.FULL)
+else:
+    back = ex.forward_pair(out[0], out[1], ScalingType.FULL)
 
 # value roundtrip on local shards
 vb = ex.unpad_values(back)
@@ -73,15 +88,22 @@ for r in mine:
     assert err < 1e-6, f"rank {rank} shard {r} roundtrip err {err}"
 
 # local slab vs dense oracle
-dense = np.zeros((dz, dy, dx), dtype=np.complex128)
-tt = triplets
-dense[tt[:, 2] % dz, tt[:, 1] % dy, tt[:, 0] % dx] = values
-oracle = np.fft.ifftn(dense) * (dx * dy * dz)
+if r2c:
+    oracle = real_field
+else:
+    dense = np.zeros((dz, dy, dx), dtype=np.complex128)
+    tt = triplets
+    dense[tt[:, 2] % dz, tt[:, 1] % dy, tt[:, 0] % dx] = values
+    oracle = np.fft.ifftn(dense) * (dx * dy * dz)
 p = ex.params
-for s_re, s_im in zip(out[0].addressable_shards, out[1].addressable_shards):
+re_shards = (out if r2c else out[0]).addressable_shards
+im_shards = [None] * len(re_shards) if r2c else out[1].addressable_shards
+for s_re, s_im in zip(re_shards, im_shards):
     r = s_re.index[0].start
     l, o = int(p.local_z_lengths[r]), int(p.z_offsets[r])
-    slab = np.asarray(s_re.data)[0, :l] + 1j * np.asarray(s_im.data)[0, :l]
+    slab = np.asarray(s_re.data)[0, :l]
+    if s_im is not None:
+        slab = slab + 1j * np.asarray(s_im.data)[0, :l]
     err = np.abs(slab - oracle[o : o + l]).max()
     assert err < 1e-6, f"rank {rank} slab err {err}"
 

@@ -14,12 +14,15 @@ import pytest
 SCRIPT = Path(__file__).resolve().parent.parent / "programs" / "multihost_smoke.py"
 
 
-@pytest.mark.parametrize("engine,port", [("xla", 12971), ("mxu", 12973)])
-def test_two_process_roundtrip(engine, port):
+@pytest.mark.parametrize(
+    "engine,ttype,port",
+    [("xla", "c2c", 12971), ("mxu", "c2c", 12973), ("mxu", "r2c", 12975)],
+)
+def test_two_process_roundtrip(engine, ttype, port):
     env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
     procs = [
         subprocess.Popen(
-            [sys.executable, str(SCRIPT), str(rank), str(port), engine],
+            [sys.executable, str(SCRIPT), str(rank), str(port), engine, ttype],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,
