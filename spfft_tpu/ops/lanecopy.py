@@ -45,13 +45,21 @@ LANE = 128
 class _RunPipe:
     """One affine-run pipeline over a subset of destination blocks: row indices
     (shift-sorted), shift group sizes, inverse row order, the 0/1 mask, and the
-    destination block ids this pipe covers (None = all blocks, in order)."""
+    destination block ids this pipe covers (None = all blocks, in order).
+
+    When every row's valid lanes form one contiguous range, the mask is stored
+    as (starts, ends) int32 vectors and generated in-register at apply time
+    (iota compares) instead of as a (Rk, LANE) f32 constant — the constant
+    costs ~0.5 KB/row of HBM read traffic on every apply (~23 MB per part at
+    256^3/15%). ``mask`` is None in that case."""
 
     rows_sorted: np.ndarray  # (Rk,) int32 source row per covered block, shift-sorted
     shift_counts: tuple  # len-128 tuple of group sizes
     inv_order: np.ndarray  # (Rk,) int32 restoring natural covered-block order
-    mask: np.ndarray  # (Rk, LANE) float32 0/1
+    mask: np.ndarray | None  # (Rk, LANE) float32 0/1, or None = use starts/ends
     block_ids: np.ndarray | None  # (Rk,) int32 destination blocks, or None = all
+    mask_starts: np.ndarray | None = None  # (Rk,) int32 first valid lane
+    mask_ends: np.ndarray | None = None  # (Rk,) int32 one past last valid lane
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,20 +124,34 @@ class CopyPlan:
         for k, entries in enumerate(per_pipe):
             block_ids = np.asarray([e[0] for e in entries], dtype=np.int32)
             start = np.asarray([e[1] for e in entries], dtype=np.int64) + LANE
-            mask = np.stack([e[2] for e in entries]).astype(np.float32)
+            mask = np.stack([e[2] for e in entries])
             assert (start >= 0).all()
             rowA = (start // LANE).astype(np.int32)
             shift = (start % LANE).astype(np.int32)
             order = np.argsort(shift, kind="stable").astype(np.int32)
             counts = tuple(int((shift == t).sum()) for t in range(LANE))
             full = block_ids.size == R and (block_ids == np.arange(R)).all()
+            # range-form mask when every row's valid lanes are one contiguous
+            # run (the common case; disjoint same-base segments are rare)
+            nval = mask.sum(axis=1)
+            firsts = mask.argmax(axis=1)
+            lasts = LANE - 1 - mask[:, ::-1].argmax(axis=1)
+            contiguous = bool(((lasts - firsts + 1 == nval) | (nval == 0)).all())
+            if contiguous:
+                starts = np.where(nval > 0, firsts, 0).astype(np.int32)
+                ends = np.where(nval > 0, lasts + 1, 0).astype(np.int32)
+                mask_arr, mstarts, mends = None, starts, ends
+            else:
+                mask_arr, mstarts, mends = mask.astype(np.float32), None, None
             pipes.append(
                 _RunPipe(
                     rows_sorted=rowA[order],
                     shift_counts=counts,
                     inv_order=np.argsort(order).astype(np.int32),
-                    mask=mask,
+                    mask=mask_arr,
                     block_ids=None if full else block_ids,
+                    mask_starts=mstarts,
+                    mask_ends=mends,
                 )
             )
         return CopyPlan(num_dst=D, num_src=num_src, src_rows=src_rows, pipes=tuple(pipes))
@@ -177,7 +199,18 @@ class CopyPlan:
                 pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
             aligned = jnp.concatenate(pieces, axis=0)
             aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
-            contrib = aligned * jnp.asarray(pipe.mask, dtype=flat.dtype)
+            if pipe.mask is None:
+                # in-register range mask: two compares against iota instead of
+                # reading a (Rk, LANE) f32 constant from HBM
+                lane = jnp.arange(LANE, dtype=jnp.int32)[None, :]
+                lo = jnp.asarray(pipe.mask_starts)[:, None]
+                hi = jnp.asarray(pipe.mask_ends)[:, None]
+                contrib = jnp.where((lane >= lo) & (lane < hi), aligned, 0)
+            else:
+                # where (not multiply): holes must be exact zeros even when the
+                # source carries inf/NaN next to a run boundary, matching the
+                # range path's semantics
+                contrib = jnp.where(jnp.asarray(pipe.mask > 0), aligned, 0)
             if pipe.block_ids is None:
                 out = contrib if out is None else out + contrib
             else:

@@ -57,6 +57,26 @@ def test_many_small_pipes_random_sticks():
     _check(m, src + 1, seed=1)
 
 
+def test_disjoint_same_base_segments_use_full_mask():
+    """Two dst segments in one block with the SAME affine base (src - lane)
+    form one run with a non-contiguous mask — the f32-mask fallback path
+    (the range-mask fast path only handles contiguous valid-lane runs)."""
+    m = np.full(LANE, -1, dtype=np.int64)
+    m[0:10] = np.arange(100, 110)    # base 100
+    m[20:30] = np.arange(120, 130)   # base 100 again (120 - 20)
+    plan = _check(m, 200, seed=5)
+    assert any(p.mask is not None for p in plan.pipes)
+
+
+def test_contiguous_masks_use_range_form():
+    """Ordinary stick layouts compile to range-form masks (no f32 constant)."""
+    m = np.full(4 * LANE, -1, dtype=np.int64)
+    m[5:120] = np.arange(115)
+    m[130:300] = np.arange(200, 370)
+    plan = _check(m, 400, seed=6)
+    assert all(p.mask is None for p in plan.pipes)
+
+
 def test_empty_block_hole_padding():
     """Layouts with fully-empty 128-lane blocks exercise the pipe-0 padding
     that promotes near-full pipes to the direct-write path (a spherical plan
