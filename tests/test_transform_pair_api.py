@@ -1,0 +1,53 @@
+"""Transform-level device-pair API + accessor coverage.
+
+The engine-level pair paths are covered elsewhere; this pins the Transform
+wrappers: backward_pair retains the space buffer in the engine-native layout,
+forward_pair reuses it, and the layout contract matches space_domain_layout.
+"""
+import numpy as np
+import pytest
+
+from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+from spfft_tpu.errors import InvalidParameterError
+from utils import assert_close, random_sparse_triplets
+
+
+@pytest.mark.parametrize("engine,layout", [("xla", "zyx"), ("mxu", "yxz")])
+def test_pair_roundtrip_and_layout(engine, layout):
+    rng = np.random.default_rng(12)
+    dx, dy, dz = 6, 7, 8
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip, engine=engine
+    )
+    assert t.space_domain_layout == layout
+    n = len(trip)
+    vre = rng.standard_normal(n)
+    vim = rng.standard_normal(n)
+
+    sre, sim = t.backward_pair(t._exec.put(vre), t._exec.put(vim))
+    expected_shape = (dz, dy, dx) if layout == "zyx" else (dy, dx, dz)
+    assert sre.shape == expected_shape and sim.shape == expected_shape
+
+    fre, fim = t.forward_pair(ScalingType.FULL)
+    assert_close(np.asarray(fre) + 1j * np.asarray(fim), vre + 1j * vim)
+
+    # host-facing view of the same retained buffer is always (Z, Y, X)
+    assert t.space_domain_data().shape == (dz, dy, dx)
+
+
+def test_forward_pair_without_backward_raises():
+    rng = np.random.default_rng(13)
+    trip = random_sparse_triplets(rng, 4, 4, 4, 0.7)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, 4, 4, 4, indices=trip)
+    with pytest.raises(InvalidParameterError):
+        t.forward_pair(ScalingType.NONE)
+
+
+def test_accessors():
+    rng = np.random.default_rng(14)
+    trip = random_sparse_triplets(rng, 5, 6, 7, 0.5)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, 5, 6, 7, indices=trip)
+    assert t.processing_unit == ProcessingUnit.HOST
+    assert t.device_id == 0
+    assert t.num_threads >= 1
