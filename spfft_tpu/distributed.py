@@ -203,9 +203,18 @@ class DistributedTransform:
         return self._exec.unpad_values(pair)
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
-        """Global trimmed space-domain array of the most recent result."""
+        """Global trimmed space-domain array of the most recent result.
+
+        Same location semantics as :meth:`Transform.space_domain_data`:
+        ``ProcessingUnit.GPU`` returns the device-resident sharded
+        (P, L, Y, X) buffer (pair for C2C) without host transfers."""
         if self._space_data is None:
             raise InvalidParameterError("no space domain data available yet")
+        if processing_unit is not None:
+            from .transform import _validate_data_location
+
+            if _validate_data_location(processing_unit) == ProcessingUnit.GPU:
+                return self._space_data
         return self._exec.unpad_space(self._space_data)
 
     def space_domain_data_local(self, shard: int):

@@ -272,9 +272,20 @@ class Transform:
         return arr
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
-        """The most recent space-domain result (reference: transform.hpp:245)."""
+        """The most recent space-domain result (reference: transform.hpp:245).
+
+        ``ProcessingUnit.HOST`` (default) returns a numpy ``(Z, Y, X)`` array;
+        ``ProcessingUnit.GPU`` returns the device-resident buffer without a
+        host transfer, in the engine-native layout (see
+        :attr:`space_domain_layout`) — the analogue of the reference handing
+        out a device pointer for ``SPFFT_PU_GPU``.
+        """
         if self._space_data is None:
             raise InvalidParameterError("no space domain data available yet")
+        if processing_unit is not None:
+            pu = _validate_data_location(processing_unit)
+            if pu == ProcessingUnit.GPU:
+                return self._space_data
         return self._combine_space(self._space_data)
 
     def clone(self) -> "Transform":
@@ -378,6 +389,17 @@ def _validate_pu(pu) -> None:
         ProcessingUnit(pu)
     except ValueError as e:
         raise InvalidParameterError(f"invalid processing unit: {pu!r}") from e
+
+
+def _validate_data_location(pu) -> ProcessingUnit:
+    """A data location must be exactly HOST or GPU — the combined HOST|GPU flag
+    is valid as a grid/transform capability but not as a location (reference
+    treats a combined data-location as invalid)."""
+    _validate_pu(pu)
+    pu = ProcessingUnit(pu)
+    if pu not in (ProcessingUnit.HOST, ProcessingUnit.GPU):
+        raise InvalidParameterError(f"invalid data location: {pu!r}")
+    return pu
 
 
 def _storage_triplets(p) -> np.ndarray:

@@ -44,6 +44,36 @@ def test_forward_pair_without_backward_raises():
         t.forward_pair(ScalingType.NONE)
 
 
+def test_space_domain_data_locations():
+    rng = np.random.default_rng(15)
+    dx, dy, dz = 6, 5, 8
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip, engine="mxu"
+    )
+    v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    t.backward(v)
+    host = t.space_domain_data(ProcessingUnit.HOST)
+    assert host.shape == (dz, dy, dx)
+    dev = t.space_domain_data(ProcessingUnit.GPU)  # device-resident, native layout
+    dre, dim_ = dev
+    assert dre.shape == (dy, dx, dz)  # MXU engine native (Y, X, Z)
+    np.testing.assert_allclose(
+        np.asarray(dre).transpose(2, 0, 1) + 1j * np.asarray(dim_).transpose(2, 0, 1),
+        host,
+        atol=1e-9,
+    )
+
+
+def test_combined_pu_rejected_as_data_location():
+    rng = np.random.default_rng(16)
+    trip = random_sparse_triplets(rng, 4, 4, 4, 0.7)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, 4, 4, 4, indices=trip)
+    t.backward(rng.standard_normal(len(trip)) + 0j)
+    with pytest.raises(InvalidParameterError):
+        t.space_domain_data(ProcessingUnit.HOST | ProcessingUnit.GPU)
+
+
 def test_accessors():
     rng = np.random.default_rng(14)
     trip = random_sparse_triplets(rng, 5, 6, 7, 0.5)
