@@ -130,7 +130,7 @@ class Transform:
         """
 
         if output_location is not None:
-            _validate_pu(output_location)
+            _validate_data_location(output_location)
         # Timing scopes mirror the reference's top-level "backward" plus the
         # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
         # stage-level attribution lives in profiler traces — see timing module doc).
@@ -192,7 +192,7 @@ class Transform:
         """
 
         if input_location is not None:
-            _validate_pu(input_location)
+            _validate_data_location(input_location)
         with timing.scoped("forward"):
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
