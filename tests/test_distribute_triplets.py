@@ -1,0 +1,60 @@
+"""Properties of the whole-stick partitioner (reference zStickDistribution
+weight semantics, tests/test_util/generate_indices.hpp:39-100).
+"""
+import numpy as np
+import pytest
+
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parameters import distribute_triplets, stick_keys
+from utils import random_sparse_triplets
+
+
+def _whole_sticks(per_shard, dy):
+    seen = {}
+    for r, part in enumerate(per_shard):
+        for k in np.unique(stick_keys(part, dy)) if len(part) else []:
+            assert k not in seen, f"stick {k} split across shards {seen.get(k)} and {r}"
+            seen[k] = r
+    return seen
+
+
+def test_value_conservation_and_whole_sticks():
+    rng = np.random.default_rng(0)
+    trip = random_sparse_triplets(rng, 12, 13, 14, 0.6, z_fill=0.7)
+    per_shard = distribute_triplets(trip, 5, 13)
+    assert sum(len(p) for p in per_shard) == len(trip)
+    _whole_sticks(per_shard, 13)
+    # reasonable balance: no shard more than 2x the mean value count
+    counts = np.array([len(p) for p in per_shard])
+    assert counts.max() <= 2 * counts.mean()
+
+
+def test_zero_weight_shard_receives_nothing():
+    rng = np.random.default_rng(1)
+    trip = random_sparse_triplets(rng, 8, 9, 10, 0.7)
+    per_shard = distribute_triplets(trip, 3, 9, weights=[1.0, 0.0, 1.0])
+    assert len(per_shard[1]) == 0
+    assert sum(len(p) for p in per_shard) == len(trip)
+
+
+def test_weighted_split_skews_load():
+    rng = np.random.default_rng(2)
+    trip = random_sparse_triplets(rng, 16, 16, 16, 0.8)
+    per_shard = distribute_triplets(trip, 2, 16, weights=[3.0, 1.0])
+    # shard 0 should carry roughly 3x shard 1 (within whole-stick granularity)
+    assert len(per_shard[0]) > 2 * len(per_shard[1])
+
+
+@pytest.mark.parametrize(
+    "bad", [[1.0], [-1.0, 2.0, 1.0], [0.0, 0.0, 0.0]]
+)
+def test_invalid_weights_rejected(bad):
+    rng = np.random.default_rng(3)
+    trip = random_sparse_triplets(rng, 6, 6, 6, 0.5)
+    with pytest.raises(InvalidParameterError):
+        distribute_triplets(trip, 3, 6, weights=bad)
+
+
+def test_zero_shards_rejected():
+    with pytest.raises(InvalidParameterError):
+        distribute_triplets(np.zeros((0, 3), dtype=np.int64), 0, 4)
