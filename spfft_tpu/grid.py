@@ -125,6 +125,8 @@ class Grid:
         *,
         local_z_length=None,
         dtype=None,
+        engine: str = "auto",
+        precision: str = "highest",
     ):
         """Create a transform bound to this grid.
 
@@ -148,6 +150,8 @@ class Grid:
                 exchange_type=self._exchange_type,
                 grid=self,
                 dtype=dtype,
+                engine=engine,
+                precision=precision,
             )
         from .transform import Transform
 
@@ -162,4 +166,6 @@ class Grid:
             local_z_length=local_z_length,
             grid=self,
             dtype=dtype,
+            engine=engine,
+            precision=precision,
         )
