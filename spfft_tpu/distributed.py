@@ -52,7 +52,9 @@ class DistributedTransform:
             mesh = grid.mesh
         if mesh is None:
             raise InvalidParameterError("distributed transform requires a mesh")
-        num_shards = int(np.prod(mesh.devices.shape))
+        from .parallel.mesh import fft_axis_size
+
+        num_shards = fft_axis_size(mesh)
 
         if isinstance(indices, (list, tuple)):
             indices_per_shard = [np.asarray(t).reshape(-1, 3) for t in indices]

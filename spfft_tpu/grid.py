@@ -111,7 +111,11 @@ class Grid:
 
     @property
     def num_shards(self) -> int:
-        return 1 if self._mesh is None else int(self._mesh.devices.size)
+        if self._mesh is None:
+            return 1
+        from .parallel.mesh import fft_axis_size
+
+        return fft_axis_size(self._mesh)
 
     def create_transform(
         self,

@@ -33,9 +33,22 @@ from ..execution import _complex_dtype
 from ..ops import symmetry
 from ..parameters import DistributedParameters
 from ..types import ExchangeType, ScalingType, TransformType
-from .mesh import FFT_AXIS
+from .mesh import FFT_AXIS, fft_axis_size
 
 _FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
+
+
+def _check_multihost_mesh(mesh) -> None:
+    """Fail fast at plan creation: multi-process padding requires a dedicated
+    1-D fft mesh (multi-axis meshes are single-controller only) — catching it
+    here avoids compiling pipelines that die at first data staging."""
+    if jax.process_count() > 1 and mesh.devices.ndim != 1:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            "multi-process runs require a dedicated 1-D fft mesh "
+            "(multi-axis meshes are supported in single-controller mode)"
+        )
 
 
 class PaddingHelpers:
@@ -54,6 +67,15 @@ class PaddingHelpers:
     """
 
     def _local_shard_ids(self):
+        # flat device index == shard id only on a dedicated 1-D fft mesh; the
+        # per-process block-assembly path below relies on that
+        if self.mesh.devices.ndim != 1:
+            from ..errors import InvalidParameterError
+
+            raise InvalidParameterError(
+                "multi-process padding requires a dedicated 1-D fft mesh "
+                "(multi-axis meshes are supported in single-controller mode)"
+            )
         me = jax.process_index()
         return [
             i for i, d in enumerate(self.mesh.devices.flat) if d.process_index == me
@@ -215,13 +237,14 @@ class DistributedExecution(PaddingHelpers):
         self.complex_dtype = _complex_dtype(real_dtype)
         self.exchange_type = ExchangeType(exchange_type)
         p = params
-        if int(np.prod(mesh.devices.shape)) != p.num_shards:
+        if fft_axis_size(mesh) != p.num_shards:
             from ..errors import MPIParameterMismatchError
 
             raise MPIParameterMismatchError(
-                f"plan has {p.num_shards} shards but mesh has "
-                f"{int(np.prod(mesh.devices.shape))} devices"
+                f"plan has {p.num_shards} shards but the mesh {FFT_AXIS!r} axis "
+                f"has {fft_axis_size(mesh)} devices"
             )
+        _check_multihost_mesh(mesh)
 
         # ---- static exchange geometry (host-side, baked into the program) ----
         self._S = p.max_num_sticks

@@ -47,7 +47,7 @@ from ..ops import fft as offt
 from ..ops import lanecopy, symmetry
 from ..types import ExchangeType, ScalingType, TransformType
 from .execution import PaddingHelpers
-from .mesh import FFT_AXIS
+from .mesh import FFT_AXIS, fft_axis_size
 
 _FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 
@@ -83,13 +83,16 @@ class MxuDistributedExecution(PaddingHelpers):
         self.exchange_type = ExchangeType(exchange_type)
         self._precision = offt.resolve_precision(precision)
         p = params
-        if int(np.prod(mesh.devices.shape)) != p.num_shards:
+        if fft_axis_size(mesh) != p.num_shards:
             from ..errors import MPIParameterMismatchError
 
             raise MPIParameterMismatchError(
-                f"plan has {p.num_shards} shards but mesh has "
-                f"{int(np.prod(mesh.devices.shape))} devices"
+                f"plan has {p.num_shards} shards but the mesh {FFT_AXIS!r} axis "
+                f"has {fft_axis_size(mesh)} devices"
             )
+        from .execution import _check_multihost_mesh
+
+        _check_multihost_mesh(mesh)
         rt = self.real_dtype
         r2c = self.is_r2c
         S = p.max_num_sticks

@@ -14,6 +14,23 @@ from jax.sharding import Mesh
 FFT_AXIS = "fft"
 
 
+def fft_axis_size(mesh) -> int:
+    """Number of FFT shards in a mesh: the size of the ``"fft"`` axis.
+
+    Accepts both a dedicated 1-D FFT mesh and a larger multi-axis model mesh
+    that carries an ``"fft"`` sub-axis (transforms shard over it and are
+    replicated over the remaining axes). Raises if the axis is absent.
+    """
+    if FFT_AXIS not in mesh.axis_names:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            f'mesh has no "{FFT_AXIS}" axis (axes: {mesh.axis_names}); '
+            f"build one with make_fft_mesh or name an axis {FFT_AXIS!r}"
+        )
+    return int(mesh.shape[FFT_AXIS])
+
+
 def make_fft_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     """Build a 1-D mesh over ``num_devices`` devices (default: all local devices).
 

@@ -1,0 +1,61 @@
+"""Transforms over an "fft" sub-axis of a larger model mesh.
+
+A caller embedding the FFT in a bigger SPMD program carves an ``"fft"`` axis
+out of its model mesh; transforms shard over that axis and are replicated over
+the remaining axes. Results must match the dedicated 1-D mesh exactly.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import spfft_tpu as sp
+from spfft_tpu import DistributedTransform, ProcessingUnit, ScalingType, TransformType
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parameters import distribute_triplets
+from utils import assert_close, oracle_backward_c2c, random_sparse_triplets, split_values
+
+
+def make_2d_mesh(fft=2, rep=2):
+    devs = np.asarray(jax.devices()[: fft * rep]).reshape(fft, rep)
+    return Mesh(devs, ("fft", "rep"))
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_fft_subaxis_of_model_mesh(engine):
+    rng = np.random.default_rng(31)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 2, dy)
+    vps = split_values(per_shard, trip, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_2d_mesh(),
+        engine=engine,
+    )
+    expected = oracle_backward_c2c(trip, values, *dims)
+    assert_close(t.backward(vps), expected)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_mesh_without_fft_axis_rejected():
+    devs = np.asarray(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("data",))
+    rng = np.random.default_rng(32)
+    trip = random_sparse_triplets(rng, 4, 4, 4, 0.7)
+    with pytest.raises(InvalidParameterError):
+        DistributedTransform(
+            ProcessingUnit.HOST, TransformType.C2C, 4, 4, 4,
+            distribute_triplets(trip, 2, 4), mesh=mesh,
+        )
