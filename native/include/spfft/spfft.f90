@@ -35,6 +35,9 @@ module spfft
   integer(c_int), parameter :: SPFFT_EXCH_COMPACT_BUFFERED = 3
   integer(c_int), parameter :: SPFFT_EXCH_COMPACT_BUFFERED_FLOAT = 4
   integer(c_int), parameter :: SPFFT_EXCH_UNBUFFERED = 5
+  ! TPU extensions: explicit bfloat16 wire (accuracy ~1e-2, opt-in only)
+  integer(c_int), parameter :: SPFFT_EXCH_BUFFERED_BF16 = 6
+  integer(c_int), parameter :: SPFFT_EXCH_COMPACT_BUFFERED_BF16 = 7
 
   ! --- SpfftProcessingUnitType ---
   integer(c_int), parameter :: SPFFT_PU_HOST = 1

@@ -40,6 +40,9 @@ EXCHANGE_NAMES = {
     "compact": "COMPACT_BUFFERED",
     "compactFloat": "COMPACT_BUFFERED_FLOAT",
     "unbuffered": "UNBUFFERED",
+    # TPU extensions: explicit bf16 wire (see spfft_tpu/types.py ExchangeType).
+    "bufferedBF16": "BUFFERED_BF16",
+    "compactBF16": "COMPACT_BUFFERED_BF16",
 }
 
 

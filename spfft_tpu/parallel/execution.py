@@ -32,10 +32,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..execution import _complex_dtype
 from ..ops import symmetry
 from ..parameters import DistributedParameters
-from ..types import ExchangeType, ScalingType, TransformType
+from ..types import (
+    BF16_EXCHANGES as _BF16_EXCHANGES,
+    FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
+    ExchangeType,
+    ScalingType,
+    TransformType,
+)
 from .mesh import FFT_AXIS, fft_axis_size
-
-_FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 
 
 def _check_multihost_mesh(mesh) -> None:
@@ -310,6 +314,30 @@ class DistributedExecution(PaddingHelpers):
     def _from_wire(self, buf):
         return buf.astype(self.complex_dtype)
 
+    def _exchange(self, buffer):
+        """One ``all_to_all`` over the mesh axis in the configured wire format.
+
+        ``*_BF16`` (TPU extension, types.py): no complex-bf16 dtype exists, so the
+        payload rides as a (re, im)-stacked real bf16 buffer — still one
+        collective, half the f32 wire bytes."""
+        if self.exchange_type in _BF16_EXCHANGES:
+            wire = jnp.stack(
+                [
+                    buffer.real.astype(jnp.bfloat16),
+                    buffer.imag.astype(jnp.bfloat16),
+                ],
+                axis=1,
+            )
+            recv = jax.lax.all_to_all(
+                wire, FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            recv = recv.astype(self.real_dtype)
+            return jax.lax.complex(recv[:, 0], recv[:, 1]).astype(self.complex_dtype)
+        recv = jax.lax.all_to_all(
+            self._to_wire(buffer), FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        return self._from_wire(recv)
+
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _backward_impl(self, values_re, values_im, value_indices):
@@ -341,10 +369,7 @@ class DistributedExecution(PaddingHelpers):
         # exchange: shard r receives every shard's sticks on r's planes
         #   (the MPI_Alltoall of the reference's BUFFERED transpose,
         #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
-        recv = jax.lax.all_to_all(
-            self._to_wire(buffer), FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
-        )
-        recv = self._from_wire(recv)
+        recv = self._exchange(buffer)
 
         # unpack: scatter all sticks into the local slab planes
         planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
@@ -385,10 +410,7 @@ class DistributedExecution(PaddingHelpers):
         buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
 
         # exchange: shard r receives its own sticks' values on every shard's planes
-        recv = jax.lax.all_to_all(
-            self._to_wire(buffer), FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
-        )
-        recv = self._from_wire(recv)
+        recv = self._exchange(buffer)
 
         # unpack: (P, L, S) -> (S, Z) via the global-z map
         sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)

@@ -45,11 +45,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import fft as offt
 from ..ops import lanecopy, symmetry
-from ..types import ExchangeType, ScalingType, TransformType
+from ..types import (
+    BF16_EXCHANGES as _BF16_EXCHANGES,
+    FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
+    ExchangeType,
+    ScalingType,
+    TransformType,
+)
 from .execution import PaddingHelpers
 from .mesh import FFT_AXIS, fft_axis_size
-
-_FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 
 
 def _complex_dtype(real_dtype):
@@ -252,7 +256,12 @@ class MxuDistributedExecution(PaddingHelpers):
         # *_FLOAT halves the f64 wire exactly like the reference's float
         # exchange (reference: include/spfft/types.h:41-47); f32 data is left
         # untouched, matching the XLA engine — a bf16 wire would silently drop
-        # below the 1e-6 parity bar and is not offered implicitly.
+        # below the 1e-6 parity bar and is not offered implicitly. *_BF16 is
+        # that bf16 wire as an explicit opt-in (TPU extension, types.py): the
+        # (re, im)-stacked exchange buffer is already real, so it is a pure
+        # wire-dtype swap here.
+        if self.exchange_type in _BF16_EXCHANGES:
+            return jnp.bfloat16
         if self.exchange_type in _FLOAT_EXCHANGES and self.real_dtype == np.float64:
             return np.dtype(np.float32)
         return self.real_dtype

@@ -245,6 +245,44 @@ def test_float_wire_exchange():
     np.testing.assert_allclose(out, expected, rtol=0, atol=1e-4 * scale)
 
 
+@pytest.mark.parametrize(
+    "exchange",
+    [ExchangeType.BUFFERED_BF16, ExchangeType.COMPACT_BUFFERED_BF16],
+)
+def test_bf16_wire_exchange(exchange):
+    """*_BF16 (TPU extension): bfloat16 wire payload — explicit opt-in with a
+    documented ~1e-2 relative accuracy bar (spfft_tpu/types.py ExchangeType)."""
+    rng = np.random.default_rng(11)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    n = len(triplets)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(triplets, 4, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_mesh(4),
+        exchange_type=exchange,
+        dtype=np.float32,
+    )
+    out = t.backward(values_per_shard)
+    expected = oracle_backward_c2c(triplets, values, dx, dy, dz)
+    scale = np.abs(expected).max()
+    np.testing.assert_allclose(out, expected, rtol=0, atol=3e-2 * scale)
+    # forward roundtrip through the bf16 wire back to the packed values
+    back = t.forward(scaling=ScalingType.FULL)
+    vscale = max(np.abs(values).max(), 1.0)
+    for r, vals in enumerate(values_per_shard):
+        np.testing.assert_allclose(back[r], vals, rtol=0, atol=3e-2 * vscale)
+
+
 def test_grid_with_mesh_creates_distributed():
     rng = np.random.default_rng(8)
     dims = (8, 8, 8)
