@@ -8,8 +8,8 @@ src/transpose/transpose_mpi_buffered_gpu.cpp) — rebuilt TPU-first:
   backward/forward pipeline (FFTs + repack + collective) into a single executable,
 * the slab<->pencil repartition is an equal-split ``lax.all_to_all`` over ICI — the
   reference's BUFFERED exchange discipline (uniform max_sticks x max_planes blocks,
-  reference: src/transpose/transpose_mpi_buffered_host.cpp:53-270) is the only one
-  with an ICI-native lowering, so COMPACT/UNBUFFERED map onto it (pad -> exchange),
+  reference: src/transpose/transpose_mpi_buffered_host.cpp:53-270); COMPACT/UNBUFFERED
+  instead run the exact-counts ppermute chain (parallel/ragged.py),
 * the pack/unpack kernels of the reference (buffered_kernels.cu) become static
   gather/scatter index maps XLA fuses into the surrounding stages,
 * ``*_FLOAT`` exchange variants cast the wire payload to complex64 around the
@@ -35,11 +35,13 @@ from ..parameters import DistributedParameters
 from ..types import (
     BF16_EXCHANGES as _BF16_EXCHANGES,
     FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
+    RAGGED_EXCHANGES as _RAGGED_EXCHANGES,
     ExchangeType,
     ScalingType,
     TransformType,
 )
 from .mesh import FFT_AXIS, fft_axis_size
+from .ragged import RaggedExchange
 
 
 def _check_multihost_mesh(mesh) -> None:
@@ -266,6 +268,24 @@ class DistributedExecution(PaddingHelpers):
         self._pack_z = p.pack_z_map()
         self._unpack_z = p.unpack_z_map()
 
+        # Exact-counts exchange (COMPACT_*/UNBUFFERED): ppermute chain sending
+        # true sticks_i x planes_j blocks instead of padded uniform ones.
+        self._ragged = None
+        if self.exchange_type in _RAGGED_EXCHANGES and p.num_shards > 1:
+            self._ragged = RaggedExchange(
+                p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
+                self._S, self._L, p.dim_z, p.dim_y * xf, self._yx_flat,
+            )
+        if self.exchange_type in _BF16_EXCHANGES:
+            self._ragged_wire = "bf16"
+        elif (
+            self.exchange_type in _FLOAT_EXCHANGES
+            and self.complex_dtype == np.complex128
+        ):
+            self._ragged_wire = "f32"
+        else:
+            self._ragged_wire = None
+
         # ---- sharded per-shard constants ----
         vi_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
         self._value_indices = jax.device_put(
@@ -361,21 +381,33 @@ class DistributedExecution(PaddingHelpers):
 
         sticks = jnp.fft.ifft(sticks, axis=1)
 
-        # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
-        sticks_z = sticks.T
-        buffer = jnp.take(sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill", fill_value=0)
-        buffer = buffer.reshape(p.num_shards, L, S)
+        if self._ragged is not None:
+            # exact-counts exchange: ppermute chain, blocks sized sticks_i x planes_j
+            # (the reference's Alltoallv discipline, see parallel/ragged.py)
+            slab_flat = self._ragged.backward(
+                (sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
+            )[0]
+            slab = slab_flat[: L * p.dim_y * p.dim_x_freq].reshape(
+                L, p.dim_y, p.dim_x_freq
+            )
+        else:
+            # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
+            sticks_z = sticks.T
+            buffer = jnp.take(
+                sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill", fill_value=0
+            )
+            buffer = buffer.reshape(p.num_shards, L, S)
 
-        # exchange: shard r receives every shard's sticks on r's planes
-        #   (the MPI_Alltoall of the reference's BUFFERED transpose,
-        #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
-        recv = self._exchange(buffer)
+            # exchange: shard r receives every shard's sticks on r's planes
+            #   (the MPI_Alltoall of the reference's BUFFERED transpose,
+            #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
+            recv = self._exchange(buffer)
 
-        # unpack: scatter all sticks into the local slab planes
-        planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
-        slab = jnp.zeros((L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype)
-        slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
-        slab = slab[:, : p.dim_y * p.dim_x_freq].reshape(L, p.dim_y, p.dim_x_freq)
+            # unpack: scatter all sticks into the local slab planes
+            planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
+            slab = jnp.zeros((L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype)
+            slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
+            slab = slab[:, : p.dim_y * p.dim_x_freq].reshape(L, p.dim_y, p.dim_x_freq)
 
         if self.is_r2c:
             slab = symmetry.apply_plane_symmetry(slab)
@@ -402,19 +434,24 @@ class DistributedExecution(PaddingHelpers):
             grid = jnp.fft.fft(slab, axis=2)
         grid = jnp.fft.fft(grid, axis=1)
 
-        # pack: gather every shard's stick columns from my planes -> (P, L, S)
-        flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
-        planes = jnp.take(
-            flat_grid, jnp.asarray(self._yx_flat), axis=1, mode="fill", fill_value=0
-        )
-        buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
+        if self._ragged is not None:
+            sticks = self._ragged.forward(
+                (grid,), wire=self._ragged_wire, real_dtype=self.real_dtype
+            )[0]
+        else:
+            # pack: gather every shard's stick columns from my planes -> (P, L, S)
+            flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
+            planes = jnp.take(
+                flat_grid, jnp.asarray(self._yx_flat), axis=1, mode="fill", fill_value=0
+            )
+            buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
 
-        # exchange: shard r receives its own sticks' values on every shard's planes
-        recv = self._exchange(buffer)
+            # exchange: shard r receives its own sticks' values on every shard's planes
+            recv = self._exchange(buffer)
 
-        # unpack: (P, L, S) -> (S, Z) via the global-z map
-        sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)
-        sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
+            # unpack: (P, L, S) -> (S, Z) via the global-z map
+            sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)
+            sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
 
         sticks = jnp.fft.fft(sticks, axis=1)
 

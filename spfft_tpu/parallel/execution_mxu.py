@@ -16,10 +16,11 @@ engine, the fast path on CPU meshes), but engineered like the local MXU engine
 * the slab<->pencil repartition is ONE ``lax.all_to_all`` over the mesh axis on
   a (re, im)-stacked buffer — the uniform-block BUFFERED discipline of the
   reference (reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
-  which is the collective shape ICI likes; ``*_FLOAT`` exchange variants halve
-  the f64 wire to f32 around the collective, the analogue of the reference's
-  float exchanges (reference: include/spfft/types.h:41-47,
-  src/gpu_util/complex_conversion.cuh:37-56),
+  which is the collective shape ICI likes; COMPACT_*/UNBUFFERED run the
+  exact-counts ppermute chain instead (parallel/ragged.py); ``*_FLOAT``
+  exchange variants halve the f64 wire to f32 around the collective, the
+  analogue of the reference's float exchanges (reference:
+  include/spfft/types.h:41-47, src/gpu_util/complex_conversion.cuh:37-56),
 * complex data is carried as (re, im) real pairs end to end (axon TPU cannot
   transfer complex across the host boundary, and real pairs let the 4-matmul
   complex product run on the MXU).
@@ -52,8 +53,10 @@ from ..types import (
     ScalingType,
     TransformType,
 )
+from ..types import RAGGED_EXCHANGES as _RAGGED_EXCHANGES
 from .execution import PaddingHelpers
 from .mesh import FFT_AXIS, fft_axis_size
+from .ragged import RaggedExchange
 
 
 def _complex_dtype(real_dtype):
@@ -153,6 +156,21 @@ class MxuDistributedExecution(PaddingHelpers):
         # x == 0 stick exists (otherwise that compact column is absent or zero;
         # ux is sorted, so any valid x == 0 lands in slot 0)
         self._have_x0 = bool((sx_all[valid] == 0).any())
+
+        # Exact-counts exchange (COMPACT_*/UNBUFFERED): ppermute chain over the
+        # compact (Y, A) plane slots; see parallel/ragged.py.
+        self._ragged = None
+        if self.exchange_type in _RAGGED_EXCHANGES and p.num_shards > 1:
+            self._ragged = RaggedExchange(
+                p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
+                S, L, Z, Y * A, self._stick_yx,
+            )
+        if self.exchange_type in _BF16_EXCHANGES:
+            self._ragged_wire = "bf16"
+        elif self.exchange_type in _FLOAT_EXCHANGES and self.real_dtype == np.float64:
+            self._ragged_wire = "f32"
+        else:
+            self._ragged_wire = None
 
         # ---- per-shard value copy plans (lax.switch branches) ----
         self._decompress_branches = []
@@ -303,25 +321,34 @@ class MxuDistributedExecution(PaddingHelpers):
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
 
-        # pack: (S, Z) -> (P, S, L) exchange blocks
-        with jax.named_scope("pack"):
-            if not self._uniform_z:
-                zmap = jnp.asarray(self._pack_z)
-                sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
-                sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
-            bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
-            bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+        if self._ragged is not None:
+            # exact-counts exchange straight into the compact planes
+            with jax.named_scope("exchange"):
+                fre, fim = self._ragged.backward(
+                    (sre, sim), wire=self._ragged_wire, real_dtype=rt
+                )
+                gre = fre[: L * Y * A].reshape(L, Y, A)
+                gim = fim[: L * Y * A].reshape(L, Y, A)
+        else:
+            # pack: (S, Z) -> (P, S, L) exchange blocks
+            with jax.named_scope("pack"):
+                if not self._uniform_z:
+                    zmap = jnp.asarray(self._pack_z)
+                    sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
+                    sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
+                bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+                bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
 
-        with jax.named_scope("exchange"):
-            rre, rim = self._exchange(bre, bim)
+            with jax.named_scope("exchange"):
+                rre, rim = self._exchange(bre, bim)
 
-        # expand: (P*S, L) global stick rows -> (L, Y, A) compact freq planes
-        with jax.named_scope("unpack"):
-            rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
-            rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
-            m = jnp.asarray(self._yx_stick)
-            gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
-            gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
+            # expand: (P*S, L) global stick rows -> (L, Y, A) compact freq planes
+            with jax.named_scope("unpack"):
+                rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
+                rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
+                m = jnp.asarray(self._yx_stick)
+                gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
+                gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
@@ -361,29 +388,35 @@ class MxuDistributedExecution(PaddingHelpers):
         with jax.named_scope("y transform"):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
 
-        # pack: gather every global stick's compact (y, x) slot from my planes
-        with jax.named_scope("pack"):
-            flat_re = jnp.concatenate(
-                [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-            )
-            flat_im = jnp.concatenate(
-                [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-            )
-            m = jnp.asarray(self._stick_yx)
-            bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
-            bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
+        if self._ragged is not None:
+            with jax.named_scope("exchange"):
+                sre, sim = self._ragged.forward(
+                    (gre, gim), wire=self._ragged_wire, real_dtype=rt
+                )
+        else:
+            # pack: gather every global stick's compact (y, x) slot from my planes
+            with jax.named_scope("pack"):
+                flat_re = jnp.concatenate(
+                    [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+                )
+                flat_im = jnp.concatenate(
+                    [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+                )
+                m = jnp.asarray(self._stick_yx)
+                bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
+                bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
 
-        with jax.named_scope("exchange"):
-            rre, rim = self._exchange(bre, bim)
+            with jax.named_scope("exchange"):
+                rre, rim = self._exchange(bre, bim)
 
-        # unpack: (P, S, L) my sticks' z chunks -> (S, Z)
-        with jax.named_scope("unpack"):
-            sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-            sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-            if not self._uniform_z:
-                zmap = jnp.asarray(self._unpack_z)
-                sre = jnp.take(sre, zmap, axis=1)
-                sim = jnp.take(sim, zmap, axis=1)
+            # unpack: (P, S, L) my sticks' z chunks -> (S, Z)
+            with jax.named_scope("unpack"):
+                sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+                sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
+                if not self._uniform_z:
+                    zmap = jnp.asarray(self._unpack_z)
+                    sre = jnp.take(sre, zmap, axis=1)
+                    sim = jnp.take(sim, zmap, axis=1)
 
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(

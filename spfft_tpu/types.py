@@ -14,12 +14,15 @@ class ExchangeType(enum.IntEnum):
 
     Reference: include/spfft/types.h:33-62 (SpfftExchangeType).
 
-    On TPU all inter-chip exchanges lower to an equal-split ``lax.all_to_all`` over the
-    ICI mesh axis, which corresponds to the reference's BUFFERED (padded-block) wire
-    discipline. COMPACT_BUFFERED and UNBUFFERED are accepted and mapped onto the same
-    padded exchange (pad -> all_to_all -> slice); the ``*_FLOAT`` variants halve wire
-    bytes by converting the exchanged payload to single precision (complex64) on the
-    wire, exactly like the reference's float exchange
+    BUFFERED (and DEFAULT) lower to one equal-split ``lax.all_to_all`` over the ICI
+    mesh axis on padded-uniform blocks — the reference's BUFFERED wire discipline and
+    the collective shape ICI fuses best; it wins when shards are balanced.
+    COMPACT_BUFFERED and UNBUFFERED send exact ``sticks_i x planes_j`` blocks per
+    shard pair via a ppermute rotation chain (parallel/ragged.py) — true Alltoallv /
+    Alltoallw semantics; they win when stick or plane counts are imbalanced (wire
+    bytes track the exact volume instead of ``P^2 S_max L_max``). The ``*_FLOAT``
+    variants halve wire bytes by converting the exchanged payload to single precision
+    on the wire, exactly like the reference's float exchange
     (reference: src/gpu_util/complex_conversion.cuh:37-56).
 
     The ``*_BF16`` variants are a TPU-native extension beyond the reference enum
@@ -45,6 +48,16 @@ class ExchangeType(enum.IntEnum):
 # Wire-format groupings used by both mesh engines (execution.py, execution_mxu.py).
 FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 BF16_EXCHANGES = (ExchangeType.BUFFERED_BF16, ExchangeType.COMPACT_BUFFERED_BF16)
+# Exact-counts disciplines: realized as the ppermute-chain ragged exchange
+# (parallel/ragged.py) rather than the padded all_to_all. COMPACT_* mirrors the
+# reference's Alltoallv, UNBUFFERED its zero-copy Alltoallw — both send exactly
+# sticks_i x planes_j elements per shard pair.
+RAGGED_EXCHANGES = (
+    ExchangeType.COMPACT_BUFFERED,
+    ExchangeType.COMPACT_BUFFERED_FLOAT,
+    ExchangeType.COMPACT_BUFFERED_BF16,
+    ExchangeType.UNBUFFERED,
+)
 
 
 class ProcessingUnit(enum.IntFlag):
