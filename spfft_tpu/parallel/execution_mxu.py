@@ -49,11 +49,11 @@ from ..ops import lanecopy, symmetry
 from ..types import (
     BF16_EXCHANGES as _BF16_EXCHANGES,
     FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
+    RAGGED_EXCHANGES as _RAGGED_EXCHANGES,
     ExchangeType,
     ScalingType,
     TransformType,
 )
-from ..types import RAGGED_EXCHANGES as _RAGGED_EXCHANGES
 from .execution import PaddingHelpers
 from .mesh import FFT_AXIS, fft_axis_size
 from .ragged import RaggedExchange
@@ -173,13 +173,25 @@ class MxuDistributedExecution(PaddingHelpers):
             self._ragged_wire = None
 
         # ---- per-shard value copy plans (lax.switch branches) ----
+        # Shards with identical local value layouts (same packed order into the
+        # same (S, Z) slots — common in symmetric DFT workloads) share ONE
+        # switch branch: the program embeds unique plans only, and a static
+        # shard -> branch table indexes the switch. Keeps compile size bounded
+        # by layout diversity, not shard count.
+        unique_plans = {}
+        branch_of_shard = np.zeros(max(1, p.num_shards), dtype=np.int32)
         self._decompress_branches = []
         self._compress_branches = []
         for r in range(p.num_shards):
             n = int(p.num_values_per_shard[r])
             vi = np.asarray(p.value_indices[r, :n], dtype=np.int64)
-            self._decompress_branches.append(self._make_decompress(vi, n))
-            self._compress_branches.append(self._make_compress(vi, n))
+            key = (n, vi.tobytes())
+            if key not in unique_plans:
+                unique_plans[key] = len(self._decompress_branches)
+                self._decompress_branches.append(self._make_decompress(vi, n))
+                self._compress_branches.append(self._make_compress(vi, n))
+            branch_of_shard[r] = unique_plans[key]
+        self._branch_of_shard = branch_of_shard
 
         # ---- sharded constants + compiled pipelines ----
         self.value_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
@@ -304,7 +316,7 @@ class MxuDistributedExecution(PaddingHelpers):
 
         with jax.named_scope("compression"):
             sre, sim = jax.lax.switch(
-                shard,
+                jnp.asarray(self._branch_of_shard)[shard],
                 self._decompress_branches,
                 values_re[0].astype(rt),
                 values_im[0].astype(rt),
@@ -424,7 +436,9 @@ class MxuDistributedExecution(PaddingHelpers):
             )
 
         with jax.named_scope("compression"):
-            vre, vim = jax.lax.switch(shard, self._compress_branches, sre, sim)
+            vre, vim = jax.lax.switch(
+                jnp.asarray(self._branch_of_shard)[shard], self._compress_branches, sre, sim
+            )
         return vre[None], vim[None]
 
     # ---- device-side entry points ---------------------------------------------

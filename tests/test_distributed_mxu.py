@@ -131,6 +131,45 @@ def test_mxu_distributed_r2c():
         assert_close(back[r_], vals)
 
 
+def test_mxu_switch_branch_dedup():
+    """Shards with identical local value layouts share one lax.switch branch
+    (compile-size bound = layout diversity, not shard count)."""
+    rng = np.random.default_rng(21)
+    dx, dy, dz = 8, 8, 8
+    # symmetric workload: shard r owns sticks x == r, all y, full z — every
+    # shard's LOCAL packed order is identical, so one branch serves all 8
+    per_shard = [
+        np.stack(
+            np.meshgrid([r], np.arange(dy), np.arange(dz), indexing="ij"), -1
+        ).reshape(-1, 3)
+        for r in range(8)
+    ]
+    t = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz,
+        [p.copy() for p in per_shard],
+        mesh=sp.make_fft_mesh(8), engine="mxu",
+    )
+    ex = t._exec
+    assert len(ex._decompress_branches) == 1
+    assert len(ex._compress_branches) == 1
+    assert (ex._branch_of_shard == 0).all()
+    # correctness through the deduped switch
+    vps = [
+        rng.standard_normal(len(p)) + 1j * rng.standard_normal(len(p))
+        for p in per_shard
+    ]
+    triplets = np.concatenate(per_shard)
+    values = np.concatenate(vps)
+    assert_close(t.backward(vps), oracle_backward_c2c(triplets, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+    # asymmetric layouts still get distinct branches
+    t2, *_ = make_c2c(4, (12, 11, 13))
+    assert len(t2._exec._decompress_branches) > 1
+
+
 def test_mxu_ragged_z_split():
     """Non-uniform local_z_lengths exercise the pack/unpack z lane-gathers."""
     rng = np.random.default_rng(3)
