@@ -155,8 +155,7 @@ def main(argv=None):
         exchange_sweep = [args.e if args.e != "all" else "buffered"]
 
     if args.model == "spherical":
-        # nnz fraction ~= s: normalized ball volume pi*f^3/6 = s => f = (6s/pi)^(1/3)
-        radius = float((6.0 * args.s / np.pi) ** (1.0 / 3.0))
+        radius = sp.spherical_radius_for_fraction(args.s)
         if radius > 1.0:
             # beyond s = pi/6 the ball is clipped by the cube; the report records
             # the *effective* nonzero fraction below, not the requested s

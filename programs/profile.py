@@ -1,0 +1,93 @@
+"""Capture a jax.profiler trace of transform pairs, tagged by pipeline stage.
+
+The TPU-side analogue of the reference's rt_graph timing tree (reference:
+src/timing/rt_graph.hpp, stages tagged in src/execution/execution_host.cpp:
+249-293): every engine wraps its stages in ``jax.named_scope`` using the
+reference's stage names ("compression", "z transform", "exchange", ...), so a
+captured trace reads like the reference's timing output, but with XLA fusion
+boundaries and DMA activity visible.
+
+Usage:
+    python programs/profile.py -d 128 128 128 -s 0.15 --engine mxu -r 5 \
+        -o /tmp/spfft_trace
+
+View the result with TensorBoard (`tensorboard --logdir /tmp/spfft_trace`,
+Profile tab) or open the per-run `*.trace.json.gz` under
+`<outdir>/plugins/profile/` in Perfetto (ui.perfetto.dev). On backends where
+device trace collection is unsupported (e.g. tunneled devices), the capture
+degrades to host-side python/XLA events — the host timing tree
+(spfft_tpu.timing) stays the portable fallback and is printed either way.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-d", nargs=3, type=int, default=[128, 128, 128],
+                    metavar=("X", "Y", "Z"))
+    ap.add_argument("-s", type=float, default=0.15, help="nonzero fraction")
+    ap.add_argument("-r", type=int, default=5, help="traced roundtrips")
+    ap.add_argument("--engine", default="auto", choices=["auto", "xla", "mxu"])
+    ap.add_argument("-o", default="/tmp/spfft_trace", help="trace output dir")
+    args = ap.parse_args(argv)
+
+    if args.r < 1:
+        ap.error("-r must be >= 1")
+
+    import jax
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, ScalingType, TransformType, timing
+
+    timing.enable()
+    dx, dy, dz = args.d
+    radius = sp.spherical_radius_for_fraction(args.s)
+    if radius > 1.0:
+        print(f"note: -s {args.s} exceeds the inscribed ball (pi/6); clipping")
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, radius)
+    with timing.scoped("Grid + Transform init"):
+        t = sp.Transform(
+            ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz,
+            indices=trip, dtype=np.float32, engine=args.engine,
+        )
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+    # warm-up: compile outside the trace so the trace shows steady-state steps
+    with timing.scoped("warmup"):
+        t.backward(values)
+        t.forward(scaling=ScalingType.FULL)
+        t.synchronize()
+
+    try:
+        jax.profiler.start_trace(args.o)
+        capture = True
+    except Exception as e:  # tunneled/experimental backends may refuse capture
+        print(f"device trace capture unavailable on this backend: {e}")
+        print("host timing tree below is the fallback.")
+        capture = False
+    with timing.scoped("traced roundtrips"):
+        for _ in range(args.r):
+            t.backward(values)
+            out = t.forward(scaling=ScalingType.FULL)
+        t.synchronize()
+        np.asarray(out)  # fetch fences the tail
+    if capture:
+        jax.profiler.stop_trace()
+        print(f"trace written to {args.o}")
+        print(f"  view: tensorboard --logdir {args.o}  (Profile tab)")
+        print(f"  or open {args.o}/plugins/profile/*/…trace.json.gz in Perfetto")
+
+    print()
+    print(timing.process())
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,10 @@ from .errors import (  # noqa: F401
 from . import timing  # noqa: F401
 from .distributed import DistributedTransform  # noqa: F401
 from .grid import Grid  # noqa: F401
-from .indices import create_spherical_cutoff_triplets  # noqa: F401
+from .indices import (  # noqa: F401
+    create_spherical_cutoff_triplets,
+    spherical_radius_for_fraction,
+)
 from .multi_transform import (  # noqa: F401
     multi_transform_backward,
     multi_transform_forward,

@@ -130,6 +130,14 @@ def stick_xy_to_xy(stick_xy: np.ndarray, dim_y: int) -> tuple[np.ndarray, np.nda
     return stick_xy // dim_y, stick_xy % dim_y
 
 
+def spherical_radius_for_fraction(fraction: float) -> float:
+    """Radius fraction whose ball holds ``fraction`` of the cube's grid points
+    (normalized ball volume pi f^3 / 6 = fraction). Beyond fraction = pi/6 the
+    ball is clipped by the cube, so the effective nonzero fraction saturates
+    below the request — callers should warn (benchmark.py and profile.py do)."""
+    return float((6.0 * fraction / np.pi) ** (1.0 / 3.0))
+
+
 def create_spherical_cutoff_triplets(
     dim_x: int, dim_y: int, dim_z: int, radius_fraction: float,
     hermitian_symmetry: bool = False,
