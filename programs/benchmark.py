@@ -293,11 +293,15 @@ def main(argv=None):
         n_total = dim_x * dim_y * dim_z
         # Standard 5 N log2(N) flop model per 3D transform; x2 for fwd+bwd pair.
         flops = 2 * 5.0 * n_total * np.log2(n_total)
-        return {
+        out = {
             "wall_s_total": elapsed,
             "wall_s_per_transform_pair": pair_seconds,
             "gflops_per_pair": flops / pair_seconds / 1e9,
         }
+        if args.shards > 1:
+            # off-shard interconnect bytes per repartition under this discipline
+            out["exchange_wire_bytes"] = transforms[0].exchange_wire_bytes()
+        return out
 
     results = {name: measure(name) for name in exchange_sweep}
 

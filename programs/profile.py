@@ -73,17 +73,19 @@ def main(argv=None):
         print(f"device trace capture unavailable on this backend: {e}")
         print("host timing tree below is the fallback.")
         capture = False
-    with timing.scoped("traced roundtrips"):
-        for _ in range(args.r):
-            t.backward(values)
-            out = t.forward(scaling=ScalingType.FULL)
-        t.synchronize()
-        np.asarray(out)  # fetch fences the tail
-    if capture:
-        jax.profiler.stop_trace()
-        print(f"trace written to {args.o}")
-        print(f"  view: tensorboard --logdir {args.o}  (Profile tab)")
-        print(f"  or open {args.o}/plugins/profile/*/…trace.json.gz in Perfetto")
+    try:
+        with timing.scoped("traced roundtrips"):
+            for _ in range(args.r):
+                t.backward(values)
+                out = t.forward(scaling=ScalingType.FULL)
+            t.synchronize()
+            np.asarray(out)  # fetch fences the tail
+    finally:
+        if capture:
+            jax.profiler.stop_trace()
+            print(f"trace written to {args.o}")
+            print(f"  view: tensorboard --logdir {args.o}  (Profile tab)")
+            print(f"  or open {args.o}/plugins/profile/*/…trace.json.gz in Perfetto")
 
     print()
     print(timing.process())

@@ -284,6 +284,11 @@ class DistributedTransform:
     def exchange_type(self) -> ExchangeType:
         return self._exec.exchange_type
 
+    def exchange_wire_bytes(self) -> int:
+        """Off-shard interconnect bytes per slab<->pencil repartition under the
+        plan's exchange discipline (see PaddingHelpers.exchange_wire_bytes)."""
+        return self._exec.exchange_wire_bytes()
+
     @property
     def dtype(self) -> np.dtype:
         return self._real_dtype

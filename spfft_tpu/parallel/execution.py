@@ -59,10 +59,12 @@ def _check_multihost_mesh(mesh) -> None:
 
 class PaddingHelpers:
     """Host-side padding between caller per-shard arrays and the padded-uniform
-    sharded device layout. Shared by both mesh engines (DistributedExecution and
-    MxuDistributedExecution); requires ``params``, ``mesh``, ``real_dtype``,
-    ``complex_dtype``, ``is_r2c``, ``_V``, ``_L``, ``value_sharding`` and
-    ``space_sharding`` on the inheriting class.
+    sharded device layout, plus exchange-volume accounting. Shared by both mesh
+    engines (DistributedExecution and MxuDistributedExecution); requires
+    ``params``, ``mesh``, ``real_dtype``, ``complex_dtype``, ``is_r2c``, ``_S``,
+    ``_V``, ``_L``, ``_ragged`` (None for padded disciplines), a
+    ``_wire_scalar_bytes()`` method, ``value_sharding`` and ``space_sharding``
+    on the inheriting class.
 
     Multi-host: when the mesh spans processes (after
     :func:`spfft_tpu.init_distributed`), each process supplies/receives only the
@@ -95,6 +97,23 @@ class PaddingHelpers:
                 f"shard {r}: expected {int(self.params.num_values_per_shard[r])} "
                 f"values, got {v.size}"
             )
+
+    def exchange_wire_bytes(self) -> int:
+        """Off-shard bytes one slab<->pencil repartition puts on the
+        interconnect (self-blocks excluded for both disciplines; per direction
+        — forward and backward volumes are equal).
+
+        Padded (BUFFERED): every shard sends P-1 uniform S_max x L_max blocks.
+        Exact-counts (COMPACT/UNBUFFERED): the ppermute chain's per-step
+        buffers, sized max_i sticks_i * planes_{(i+k) mod P}. Lets callers pick
+        the discipline from plan geometry instead of folklore."""
+        p = self.params
+        if self._ragged is not None:
+            elems = p.num_shards * sum(self._ragged.step_buffer_sizes)
+        else:
+            elems = p.num_shards * (p.num_shards - 1) * self._S * self._L
+        # elems counts complex values; x2 real scalars each
+        return elems * 2 * self._wire_scalar_bytes()
 
     def pad_values(self, values_per_shard):
         """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
@@ -325,6 +344,13 @@ class DistributedExecution(PaddingHelpers):
         return self.params.transform_type == TransformType.R2C
 
     # ---- wire-format casts (float exchange) -----------------------------------
+
+    def _wire_scalar_bytes(self) -> int:
+        if self.exchange_type in _BF16_EXCHANGES:
+            return 2
+        if self.exchange_type in _FLOAT_EXCHANGES and self.complex_dtype == np.complex128:
+            return 4
+        return np.dtype(self.complex_dtype).itemsize // 2
 
     def _to_wire(self, buf):
         if self.exchange_type in _FLOAT_EXCHANGES and self.complex_dtype == np.complex128:

@@ -296,6 +296,9 @@ class MxuDistributedExecution(PaddingHelpers):
             return np.dtype(np.float32)
         return self.real_dtype
 
+    def _wire_scalar_bytes(self) -> int:
+        return int(np.dtype(self._wire_dtype()).itemsize)
+
     def _exchange(self, bre, bim):
         """(P, S, L) pair -> all_to_all over the mesh axis, one collective."""
         wd = self._wire_dtype()

@@ -13,9 +13,12 @@ ring of shifted permutes is the portable ICI-friendly form — each step is a
 uniform nearest-neighbor-style rotation). Step k moves the (i -> (i+k) mod P)
 blocks for every shard i at once; each step's buffer is padded only to
 ``max_i sticks_i * planes_{(i+k) mod P}`` — the per-step maximum of *exact
-products*, not the global ``S_max * L_max`` — so total wire bytes track the
-true Alltoallv volume as shard imbalance grows. The self-block (k = 0) never
-touches the wire.
+products*, not the global ``S_max * L_max``. Total wire volume is therefore
+``P * sum_k max_i(n_i * L_{(i+k) mod P})``: between the exact Alltoallv volume
+and the padded ``P (P-1) S_max L_max``, and strictly below the padded volume
+whenever the step maxima vary (imbalance in both sticks and planes; with
+uniform planes and one heavy stick shard the two volumes tie). The self-block
+(k = 0) never touches the wire.
 
 Block layout on the wire is stick-major ``(stick, plane)``, matching the
 reference's pack order (reference:
@@ -100,6 +103,13 @@ class RaggedExchange:
         self._b_fwd = [
             max(1, int((n[(np.arange(P) + k) % P] * L).max())) for k in range(P)
         ]
+
+    @property
+    def step_buffer_sizes(self):
+        """Static per-rotation buffer sizes (elements per shard per part) for
+        steps 1..P-1 — what actually rides the wire; the k=0 self-block stays
+        local. Backward and forward totals are equal (b_fwd[k] = b_bwd[P-k])."""
+        return tuple(self._b_bwd[1:])
 
     # ---- traced helpers ----
 
