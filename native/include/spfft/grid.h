@@ -20,6 +20,18 @@ SpfftError spfft_grid_create(SpfftGrid* grid, int maxDimX, int maxDimY, int maxD
                              int maxNumLocalZColumns,
                              SpfftProcessingUnitType processingUnit, int maxNumThreads);
 
+/* Distributed grid over a device mesh (the reference's MPI ctor in
+ * single-controller form: one process drives all numShards mesh shards; the
+ * mesh size replaces the MPI communicator). Set SPFFT_TPU_NUM_CPU_DEVICES=N
+ * in the environment before the first API call to get an N-device virtual
+ * CPU mesh for SPFFT_PU_HOST testing. */
+SpfftError spfft_grid_create_distributed(SpfftGrid* grid, int maxDimX, int maxDimY,
+                                         int maxDimZ, int maxNumLocalZColumns,
+                                         int maxLocalZLength, int numShards,
+                                         SpfftExchangeType exchangeType,
+                                         SpfftProcessingUnitType processingUnit,
+                                         int maxNumThreads);
+
 SpfftError spfft_grid_destroy(SpfftGrid grid);
 
 SpfftError spfft_grid_max_dim_x(SpfftGrid grid, int* dimX);
@@ -31,6 +43,8 @@ SpfftError spfft_grid_processing_unit(SpfftGrid grid,
                                       SpfftProcessingUnitType* processingUnit);
 SpfftError spfft_grid_device_id(SpfftGrid grid, int* deviceId);
 SpfftError spfft_grid_num_threads(SpfftGrid grid, int* numThreads);
+/* 1 for local grids; the mesh size for distributed ones. */
+SpfftError spfft_grid_num_shards(SpfftGrid grid, int* numShards);
 
 /* Single-precision grid — same capacity object (see grid.hpp). */
 typedef void* SpfftFloatGrid;

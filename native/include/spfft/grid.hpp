@@ -30,6 +30,13 @@ public:
   Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
        SpfftProcessingUnitType processing_unit, int max_num_threads);
 
+  /* Distributed grid over a device mesh (the reference's MPI ctor,
+   * grid.hpp:89-91, in single-controller form: ONE process drives every shard
+   * of the mesh; num_shards replaces the MPI communicator). */
+  Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+       int max_local_z_length, int num_shards, SpfftExchangeType exchange_type,
+       SpfftProcessingUnitType processing_unit, int max_num_threads);
+
   /* Copy creates independent capacity (reference copy ctor allocates fresh
    * buffers, grid.hpp "copy = fresh buffers"). */
   Grid(const Grid&);
@@ -53,6 +60,15 @@ public:
                                         SpfftIndexFormatType index_format,
                                         const int* indices) const;
 
+  /* Distributed transform over this grid's mesh (grid must be distributed).
+   * shard_num_elements: per-shard value counts; indices: shard-major
+   * concatenated triplets (3 * sum(shard_num_elements) ints). */
+  DistributedTransform create_transform_distributed(
+      SpfftProcessingUnitType processing_unit, SpfftTransformType transform_type,
+      int dim_x, int dim_y, int dim_z, int num_shards, const int* shard_num_elements,
+      SpfftIndexFormatType index_format, const int* indices,
+      bool double_precision = true) const;
+
   int max_dim_x() const;
   int max_dim_y() const;
   int max_dim_z() const;
@@ -61,6 +77,8 @@ public:
   SpfftProcessingUnitType processing_unit() const;
   int device_id() const;
   int max_num_threads() const;
+  /* 1 for local grids; the mesh size for distributed ones. */
+  int num_shards() const;
 
 private:
   friend const std::shared_ptr<detail::GridState>& detail::grid_state(const Grid&);

@@ -105,6 +105,24 @@ module spfft
       integer(c_int), intent(out) :: processingUnit
     end function
 
+    ! ---- distributed grid (single-controller mesh) --------------------------
+
+    integer(c_int) function spfft_grid_create_distributed(grid, maxDimX, maxDimY, &
+        maxDimZ, maxNumLocalZColumns, maxLocalZLength, numShards, exchangeType, &
+        processingUnit, maxNumThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: grid
+      integer(c_int), value :: maxDimX, maxDimY, maxDimZ
+      integer(c_int), value :: maxNumLocalZColumns, maxLocalZLength, numShards
+      integer(c_int), value :: exchangeType, processingUnit, maxNumThreads
+    end function
+
+    integer(c_int) function spfft_grid_num_shards(grid, numShards) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: numShards
+    end function
+
     ! ---- transform (double) -------------------------------------------------
 
     integer(c_int) function spfft_transform_create_independent(transform, &
@@ -280,6 +298,144 @@ module spfft
       integer(c_int), dimension(*), intent(in) :: inputLocations
       type(c_ptr), dimension(*), intent(in) :: output
       integer(c_int), dimension(*), intent(in) :: scalingTypes
+    end function
+
+    ! ---- distributed transform (single-controller mesh) ---------------------
+
+    integer(c_int) function spfft_dist_transform_create(transform, grid, &
+        processingUnit, transformType, dimX, dimY, dimZ, numShards, &
+        shardNumElements, indexFormat, indices, doublePrecision) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      type(c_ptr), value :: grid
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, numShards
+      integer(c_int), dimension(*), intent(in) :: shardNumElements
+      integer(c_int), value :: indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+      integer(c_int), value :: doublePrecision
+    end function
+
+    integer(c_int) function spfft_dist_transform_destroy(transform) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+    end function
+
+    integer(c_int) function spfft_dist_transform_backward(transform, values, &
+        space) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_double), dimension(*), intent(in) :: values
+      real(c_double), dimension(*), intent(out) :: space
+    end function
+
+    integer(c_int) function spfft_float_dist_transform_backward(transform, values, &
+        space) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_float), dimension(*), intent(in) :: values
+      real(c_float), dimension(*), intent(out) :: space
+    end function
+
+    integer(c_int) function spfft_dist_transform_forward(transform, space, values, &
+        scaling) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_double), dimension(*), intent(in) :: space
+      real(c_double), dimension(*), intent(out) :: values
+      integer(c_int), value :: scaling
+    end function
+
+    integer(c_int) function spfft_float_dist_transform_forward(transform, space, &
+        values, scaling) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      real(c_float), dimension(*), intent(in) :: space
+      real(c_float), dimension(*), intent(out) :: values
+      integer(c_int), value :: scaling
+    end function
+
+    integer(c_int) function spfft_dist_transform_type(transform, transformType) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: transformType
+    end function
+
+    integer(c_int) function spfft_dist_transform_dim_x(transform, dimX) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimX
+    end function
+
+    integer(c_int) function spfft_dist_transform_dim_y(transform, dimY) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimY
+    end function
+
+    integer(c_int) function spfft_dist_transform_dim_z(transform, dimZ) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimZ
+    end function
+
+    integer(c_int) function spfft_dist_transform_num_shards(transform, &
+        numShards) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: numShards
+    end function
+
+    integer(c_int) function spfft_dist_transform_num_global_elements(transform, &
+        numGlobalElements) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: numGlobalElements
+    end function
+
+    integer(c_int) function spfft_dist_transform_global_size(transform, &
+        globalSize) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: globalSize
+    end function
+
+    integer(c_int) function spfft_dist_transform_exchange_type(transform, &
+        exchangeType) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: exchangeType
+    end function
+
+    integer(c_int) function spfft_dist_transform_exchange_wire_bytes(transform, &
+        wireBytes) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: wireBytes
+    end function
+
+    integer(c_int) function spfft_dist_transform_local_z_length(transform, shard, &
+        localZLength) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: localZLength
+    end function
+
+    integer(c_int) function spfft_dist_transform_local_z_offset(transform, shard, &
+        offset) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: offset
+    end function
+
+    integer(c_int) function spfft_dist_transform_num_local_elements(transform, &
+        shard, numLocalElements) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: numLocalElements
     end function
 
   end interface

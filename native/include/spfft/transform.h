@@ -118,6 +118,58 @@ SpfftError spfft_float_transform_execution_mode(SpfftFloatTransform transform,
 SpfftError spfft_float_transform_set_execution_mode(SpfftFloatTransform transform,
                                                     SpfftExecType mode);
 
+/* ---- distributed transforms (single-controller mesh) ----------------------
+ * One process drives every shard; per-rank MPI arrays become shard-major
+ * concatenated host arrays. Precision is fixed at creation
+ * (doublePrecision != 0 -> double entry points, == 0 -> float ones). */
+
+typedef void* SpfftDistTransform;
+
+SpfftError spfft_dist_transform_create(SpfftDistTransform* transform, SpfftGrid grid,
+                                       SpfftProcessingUnitType processingUnit,
+                                       SpfftTransformType transformType, int dimX,
+                                       int dimY, int dimZ, int numShards,
+                                       const int* shardNumElements,
+                                       SpfftIndexFormatType indexFormat,
+                                       const int* indices, int doublePrecision);
+SpfftError spfft_dist_transform_destroy(SpfftDistTransform transform);
+
+/* values: 2 * num_global_elements reals, shard-major complex-interleaved;
+ * space: global (dimZ, dimY, dimX) slab (complex for C2C, real for R2C). */
+SpfftError spfft_dist_transform_backward(SpfftDistTransform transform,
+                                         const double* values, double* space);
+SpfftError spfft_float_dist_transform_backward(SpfftDistTransform transform,
+                                               const float* values, float* space);
+/* space may be NULL to reuse the slabs retained by the last backward. */
+SpfftError spfft_dist_transform_forward(SpfftDistTransform transform,
+                                        const double* space, double* values,
+                                        SpfftScalingType scaling);
+SpfftError spfft_float_dist_transform_forward(SpfftDistTransform transform,
+                                              const float* space, float* values,
+                                              SpfftScalingType scaling);
+
+SpfftError spfft_dist_transform_type(SpfftDistTransform transform,
+                                     SpfftTransformType* type);
+SpfftError spfft_dist_transform_dim_x(SpfftDistTransform transform, int* dimX);
+SpfftError spfft_dist_transform_dim_y(SpfftDistTransform transform, int* dimY);
+SpfftError spfft_dist_transform_dim_z(SpfftDistTransform transform, int* dimZ);
+SpfftError spfft_dist_transform_num_shards(SpfftDistTransform transform, int* numShards);
+SpfftError spfft_dist_transform_num_global_elements(SpfftDistTransform transform,
+                                                    long long int* numGlobalElements);
+SpfftError spfft_dist_transform_global_size(SpfftDistTransform transform,
+                                            long long int* globalSize);
+SpfftError spfft_dist_transform_exchange_type(SpfftDistTransform transform,
+                                              SpfftExchangeType* exchangeType);
+SpfftError spfft_dist_transform_exchange_wire_bytes(SpfftDistTransform transform,
+                                                    long long int* wireBytes);
+/* per-shard layout (the reference's per-rank accessors) */
+SpfftError spfft_dist_transform_local_z_length(SpfftDistTransform transform, int shard,
+                                               int* localZLength);
+SpfftError spfft_dist_transform_local_z_offset(SpfftDistTransform transform, int shard,
+                                               int* offset);
+SpfftError spfft_dist_transform_num_local_elements(SpfftDistTransform transform,
+                                                   int shard, int* numLocalElements);
+
 #ifdef __cplusplus
 }
 #endif

@@ -24,9 +24,11 @@ class Grid;
 
 class Transform;
 class TransformFloat;
+class DistributedTransform;
 
 namespace detail {
 struct Plan;
+struct DistPlan;
 std::shared_ptr<Plan> make_plan(const Grid* grid, bool double_precision,
                                 SpfftProcessingUnitType pu, SpfftTransformType tt,
                                 int dim_x, int dim_y, int dim_z, int local_z_length,
@@ -126,6 +128,54 @@ private:
   explicit TransformFloat(std::shared_ptr<detail::Plan> plan) : plan_(std::move(plan)) {}
 
   std::shared_ptr<detail::Plan> plan_;
+};
+
+/* Mesh-distributed sparse 3D FFT plan (single-controller: one process drives
+ * every shard; the reference's per-rank MPI contract becomes shard-major
+ * concatenated host arrays). Created via Grid::create_transform_distributed.
+ * Precision is chosen at creation; the double/float overloads must match it
+ * (InvalidParameterError otherwise). */
+class DistributedTransform {
+public:
+  /* values: shard-major concatenated packed frequency data
+   * (2 * num_global_elements reals, complex-interleaved); space_output: the
+   * assembled global (dimZ, dimY, dimX) slab (complex-interleaved for C2C,
+   * real for R2C). */
+  void backward(const double* values, double* space_output);
+  void backward(const float* values, float* space_output);
+
+  /* space: global (dimZ, dimY, dimX) array, or nullptr to reuse the slabs
+   * retained by the last backward; values_output as above. */
+  void forward(const double* space, double* values_output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+  void forward(const float* space, float* values_output,
+               SpfftScalingType scaling = SPFFT_NO_SCALING);
+
+  SpfftTransformType type() const;
+  int dim_x() const;
+  int dim_y() const;
+  int dim_z() const;
+  int num_shards() const;
+  long long num_global_elements() const;
+  long long global_size() const;
+  SpfftProcessingUnitType processing_unit() const;
+  SpfftExchangeType exchange_type() const;
+  /* Off-shard interconnect bytes per slab<->pencil repartition. */
+  long long exchange_wire_bytes() const;
+  bool double_precision() const;
+
+  /* Per-shard layout (the reference's per-rank accessors). */
+  int local_z_length(int shard) const;
+  int local_z_offset(int shard) const;
+  long long local_slice_size(int shard) const;
+  long long num_local_elements(int shard) const;
+
+private:
+  friend class Grid;
+  explicit DistributedTransform(std::shared_ptr<detail::DistPlan> plan)
+      : plan_(std::move(plan)) {}
+
+  std::shared_ptr<detail::DistPlan> plan_;
 };
 
 } // namespace spfft

@@ -32,6 +32,9 @@ spfft::Transform* as_transform(SpfftTransform h) {
 spfft::TransformFloat* as_float_transform(SpfftFloatTransform h) {
   return static_cast<spfft::TransformFloat*>(h);
 }
+spfft::DistributedTransform* as_dist_transform(SpfftDistTransform h) {
+  return static_cast<spfft::DistributedTransform*>(h);
+}
 
 } // namespace
 
@@ -57,6 +60,20 @@ SpfftError spfft_float_grid_create(SpfftFloatGrid* grid, int maxDimX, int maxDim
                            processingUnit, maxNumThreads);
 }
 
+SpfftError spfft_grid_create_distributed(SpfftGrid* grid, int maxDimX, int maxDimY,
+                                         int maxDimZ, int maxNumLocalZColumns,
+                                         int maxLocalZLength, int numShards,
+                                         SpfftExchangeType exchangeType,
+                                         SpfftProcessingUnitType processingUnit,
+                                         int maxNumThreads) {
+  if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *grid = new spfft::Grid(maxDimX, maxDimY, maxDimZ, maxNumLocalZColumns,
+                            maxLocalZLength, numShards, exchangeType, processingUnit,
+                            maxNumThreads);
+  });
+}
+
 SpfftError spfft_grid_destroy(SpfftGrid grid) {
   if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
   return guarded([&] { delete as_grid(grid); });
@@ -77,6 +94,7 @@ SPFFT_TPU_GRID_GETTER(spfft_grid_processing_unit, SpfftProcessingUnitType,
                       processing_unit)
 SPFFT_TPU_GRID_GETTER(spfft_grid_device_id, int, device_id)
 SPFFT_TPU_GRID_GETTER(spfft_grid_num_threads, int, max_num_threads)
+SPFFT_TPU_GRID_GETTER(spfft_grid_num_shards, int, num_shards)
 
 #undef SPFFT_TPU_GRID_GETTER
 
@@ -336,5 +354,90 @@ SpfftError spfft_float_multi_transform_forward(
                                    scalingTypes);
   });
 }
+
+/* ---- distributed transform ------------------------------------------------ */
+
+SpfftError spfft_dist_transform_create(SpfftDistTransform* transform, SpfftGrid grid,
+                                       SpfftProcessingUnitType processingUnit,
+                                       SpfftTransformType transformType, int dimX,
+                                       int dimY, int dimZ, int numShards,
+                                       const int* shardNumElements,
+                                       SpfftIndexFormatType indexFormat,
+                                       const int* indices, int doublePrecision) {
+  if (transform == nullptr || grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *transform = new spfft::DistributedTransform(
+        as_grid(grid)->create_transform_distributed(
+            processingUnit, transformType, dimX, dimY, dimZ, numShards,
+            shardNumElements, indexFormat, indices, doublePrecision != 0));
+  });
+}
+
+SpfftError spfft_dist_transform_destroy(SpfftDistTransform transform) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { delete as_dist_transform(transform); });
+}
+
+SpfftError spfft_dist_transform_backward(SpfftDistTransform transform,
+                                         const double* values, double* space) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_dist_transform(transform)->backward(values, space); });
+}
+
+SpfftError spfft_float_dist_transform_backward(SpfftDistTransform transform,
+                                               const float* values, float* space) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_dist_transform(transform)->backward(values, space); });
+}
+
+SpfftError spfft_dist_transform_forward(SpfftDistTransform transform,
+                                        const double* space, double* values,
+                                        SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_dist_transform(transform)->forward(space, values, scaling); });
+}
+
+SpfftError spfft_float_dist_transform_forward(SpfftDistTransform transform,
+                                              const float* space, float* values,
+                                              SpfftScalingType scaling) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_dist_transform(transform)->forward(space, values, scaling); });
+}
+
+#define SPFFT_TPU_DIST_GETTER(FN, OUT_T, METHOD)                                         \
+  SpfftError FN(SpfftDistTransform transform, OUT_T* out) {                              \
+    if (transform == nullptr || out == nullptr) return SPFFT_INVALID_HANDLE_ERROR;       \
+    return guarded(                                                                      \
+        [&] { *out = static_cast<OUT_T>(as_dist_transform(transform)->METHOD()); });     \
+  }
+
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_type, SpfftTransformType, type)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_dim_x, int, dim_x)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_dim_y, int, dim_y)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_dim_z, int, dim_z)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_num_shards, int, num_shards)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_num_global_elements, long long int,
+                      num_global_elements)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_global_size, long long int, global_size)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_type, SpfftExchangeType,
+                      exchange_type)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_wire_bytes, long long int,
+                      exchange_wire_bytes)
+
+#undef SPFFT_TPU_DIST_GETTER
+
+#define SPFFT_TPU_DIST_SHARD_GETTER(FN, OUT_T, METHOD)                                   \
+  SpfftError FN(SpfftDistTransform transform, int shard, OUT_T* out) {                   \
+    if (transform == nullptr || out == nullptr) return SPFFT_INVALID_HANDLE_ERROR;       \
+    return guarded(                                                                      \
+        [&] { *out = static_cast<OUT_T>(as_dist_transform(transform)->METHOD(shard)); });\
+  }
+
+SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_z_length, int, local_z_length)
+SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_z_offset, int, local_z_offset)
+SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_num_local_elements, int,
+                            num_local_elements)
+
+#undef SPFFT_TPU_DIST_SHARD_GETTER
 
 } /* extern "C" */

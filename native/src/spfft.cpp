@@ -77,6 +77,74 @@ struct GridState {
   br::Ref py;
 };
 
+/* Mesh-distributed plan: shard-major concatenated host arrays over the
+ * single-controller mesh (see spfft_tpu/capi.py dist_* functions). */
+struct DistPlan {
+  br::Ref py;
+  bool dbl = true;
+  long long num_global = 0; /* total packed values across shards */
+  long long space_reals = 0;
+
+  struct Meta {
+    int dim_x = 0, dim_y = 0, dim_z = 0, num_shards = 0;
+    int transform_type = 0, processing_unit = 0, exchange_type = 0;
+    long long global_size = 0, wire_bytes = 0;
+  } meta;
+  std::vector<long long> shard_elems, shard_zlen, shard_zoff, shard_slice;
+
+  std::size_t elem_bytes() const { return dbl ? sizeof(double) : sizeof(float); }
+
+  long long get(const char* name) const {
+    br::Gil gil;
+    br::Ref r = br::call("dist_transform_get", Py_BuildValue("(Os)", py.get(), name));
+    return br::as_longlong(r.get());
+  }
+
+  long long get_shard(const char* name, int shard) const {
+    br::Gil gil;
+    br::Ref r = br::call("dist_transform_get_shard",
+                         Py_BuildValue("(Osi)", py.get(), name, shard));
+    return br::as_longlong(r.get());
+  }
+
+  void check_shard(int shard) const {
+    if (shard < 0 || shard >= meta.num_shards) {
+      throw InvalidParameterError("spfft_tpu: shard index out of range");
+    }
+  }
+
+  void check_precision(bool want_dbl) const {
+    if (dbl != want_dbl) {
+      throw InvalidParameterError(
+          "spfft_tpu: value pointer precision does not match the plan");
+    }
+  }
+
+  void backward(const void* values, void* space) {
+    br::Gil gil;
+    br::Ref in = br::view_ro(values,
+                             static_cast<std::size_t>(2 * num_global) * elem_bytes());
+    br::Ref out =
+        br::view_rw(space, static_cast<std::size_t>(space_reals) * elem_bytes());
+    br::call("dist_backward", Py_BuildValue("(OOO)", py.get(), in.get(), out.get()));
+  }
+
+  void forward(const void* space, void* values, int scaling) {
+    br::Gil gil;
+    br::Ref out =
+        br::view_rw(values, static_cast<std::size_t>(2 * num_global) * elem_bytes());
+    if (space == nullptr) {
+      br::call("dist_forward",
+               Py_BuildValue("(OOOi)", py.get(), Py_None, out.get(), scaling));
+      return;
+    }
+    br::Ref in =
+        br::view_ro(space, static_cast<std::size_t>(space_reals) * elem_bytes());
+    br::call("dist_forward",
+             Py_BuildValue("(OOOi)", py.get(), in.get(), out.get(), scaling));
+  }
+};
+
 const std::shared_ptr<GridState>& grid_state(const Grid& grid) { return grid.state_; }
 
 Plan* plan_of(Transform& t) { return t.plan_.get(); }
@@ -162,6 +230,64 @@ long long grid_attr(const std::shared_ptr<GridState>& state, const char* name) {
   return br::as_longlong(r.get());
 }
 
+std::shared_ptr<DistPlan> make_dist_plan(const Grid& grid, bool double_precision,
+                                         SpfftProcessingUnitType pu,
+                                         SpfftTransformType tt, int dim_x, int dim_y,
+                                         int dim_z, int num_shards,
+                                         const int* shard_num_elements,
+                                         SpfftIndexFormatType fmt, const int* indices) {
+  if (fmt != SPFFT_INDEX_TRIPLETS) {
+    throw InvalidParameterError("spfft_tpu: only SPFFT_INDEX_TRIPLETS is supported");
+  }
+  if (num_shards < 1 || shard_num_elements == nullptr) {
+    throw InvalidParameterError("spfft_tpu: invalid shard layout");
+  }
+  long long total = 0;
+  for (int r = 0; r < num_shards; ++r) {
+    if (shard_num_elements[r] < 0) {
+      throw InvalidParameterError("spfft_tpu: negative shard element count");
+    }
+    total += shard_num_elements[r];
+  }
+  if (total > 0 && indices == nullptr) {
+    throw InvalidParameterError("spfft_tpu: invalid index array");
+  }
+  auto plan = std::make_shared<DistPlan>();
+  plan->dbl = double_precision;
+  {
+    br::Gil gil;
+    br::Ref counts = br::view_ro(shard_num_elements,
+                                 static_cast<std::size_t>(num_shards) * sizeof(int));
+    br::Ref idx =
+        br::view_ro(indices, static_cast<std::size_t>(3 * total) * sizeof(int));
+    plan->py = br::call(
+        "dist_transform_create",
+        Py_BuildValue("(OiiiiiiOOi)", grid_state(grid)->py.get(), static_cast<int>(pu),
+                      static_cast<int>(tt), dim_x, dim_y, dim_z, num_shards,
+                      counts.get(), idx.get(), double_precision ? 1 : 0));
+  }
+  DistPlan::Meta& m = plan->meta;
+  m.dim_x = static_cast<int>(plan->get("dim_x"));
+  m.dim_y = static_cast<int>(plan->get("dim_y"));
+  m.dim_z = static_cast<int>(plan->get("dim_z"));
+  m.num_shards = static_cast<int>(plan->get("num_shards"));
+  m.transform_type = static_cast<int>(plan->get("transform_type"));
+  m.processing_unit = static_cast<int>(plan->get("processing_unit"));
+  m.exchange_type = static_cast<int>(plan->get("exchange_type"));
+  m.global_size = plan->get("global_size");
+  m.wire_bytes = plan->get("exchange_wire_bytes");
+  plan->num_global = plan->get("num_global_elements");
+  for (int r = 0; r < m.num_shards; ++r) {
+    plan->shard_elems.push_back(plan->get_shard("num_local_elements", r));
+    plan->shard_zlen.push_back(plan->get_shard("local_z_length", r));
+    plan->shard_zoff.push_back(plan->get_shard("local_z_offset", r));
+    plan->shard_slice.push_back(plan->get_shard("local_slice_size", r));
+  }
+  bool r2c = m.transform_type == SPFFT_TRANS_R2C;
+  plan->space_reals = r2c ? m.global_size : 2 * m.global_size;
+  return plan;
+}
+
 } // namespace
 } // namespace detail
 
@@ -178,11 +304,38 @@ Grid::Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_colu
                     max_num_threads));
 }
 
+Grid::Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+           int max_local_z_length, int num_shards, SpfftExchangeType exchange_type,
+           SpfftProcessingUnitType processing_unit, int max_num_threads)
+    : state_(std::make_shared<detail::GridState>()) {
+  bridge::Gil gil;
+  state_->py = bridge::call(
+      "grid_create_distributed",
+      Py_BuildValue("(iiiiiiiii)", max_dim_x, max_dim_y, max_dim_z,
+                    max_num_local_z_columns, max_local_z_length, num_shards,
+                    static_cast<int>(processing_unit),
+                    static_cast<int>(exchange_type), max_num_threads));
+}
+
 Grid::Grid(const Grid& other) : state_(std::make_shared<detail::GridState>()) {
   /* Fresh capacity: re-create from the other grid's parameters (the XLA
    * backend holds no shared host buffers, so metadata equality suffices —
    * matches the reference's fresh-buffer copy, grid_internal.cpp:233-262). */
   bridge::Gil gil;
+  /* mesh presence, not shard count: a 1-shard distributed grid must copy to a
+   * distributed grid (the dist1 pipeline configs in BASELINE.md rely on it) */
+  if (detail::grid_attr(detail::grid_state(other), "has_mesh") != 0) {
+    state_->py = bridge::call(
+        "grid_create_distributed",
+        Py_BuildValue("(iiiiiiiii)", other.max_dim_x(), other.max_dim_y(),
+                      other.max_dim_z(), other.max_num_local_z_columns(),
+                      other.max_local_z_length(), other.num_shards(),
+                      static_cast<int>(other.processing_unit()),
+                      static_cast<int>(detail::grid_attr(
+                          detail::grid_state(other), "exchange_type")),
+                      other.max_num_threads()));
+    return;
+  }
   state_->py = bridge::call(
       "grid_create",
       Py_BuildValue("(iiiiii)", other.max_dim_x(), other.max_dim_y(),
@@ -230,6 +383,19 @@ int Grid::device_id() const {
 }
 int Grid::max_num_threads() const {
   return static_cast<int>(detail::grid_attr(state_, "max_num_threads"));
+}
+int Grid::num_shards() const {
+  return static_cast<int>(detail::grid_attr(state_, "num_shards"));
+}
+
+DistributedTransform Grid::create_transform_distributed(
+    SpfftProcessingUnitType processing_unit, SpfftTransformType transform_type,
+    int dim_x, int dim_y, int dim_z, int num_shards, const int* shard_num_elements,
+    SpfftIndexFormatType index_format, const int* indices,
+    bool double_precision) const {
+  return DistributedTransform(detail::make_dist_plan(
+      *this, double_precision, processing_unit, transform_type, dim_x, dim_y, dim_z,
+      num_shards, shard_num_elements, index_format, indices));
 }
 
 Transform Grid::create_transform(SpfftProcessingUnitType processing_unit,
@@ -448,6 +614,66 @@ void multi_transform_forward(int num_transforms, TransformFloat* transforms,
   multi_forward_impl(num_transforms, transforms,
                      reinterpret_cast<void* const*>(const_cast<float**>(output)),
                      scaling_types);
+}
+
+/* ---- DistributedTransform ------------------------------------------------- */
+
+void DistributedTransform::backward(const double* values, double* space_output) {
+  plan_->check_precision(true);
+  plan_->backward(values, space_output);
+}
+void DistributedTransform::backward(const float* values, float* space_output) {
+  plan_->check_precision(false);
+  plan_->backward(values, space_output);
+}
+void DistributedTransform::forward(const double* space, double* values_output,
+                                   SpfftScalingType scaling) {
+  plan_->check_precision(true);
+  plan_->forward(space, values_output, static_cast<int>(scaling));
+}
+void DistributedTransform::forward(const float* space, float* values_output,
+                                   SpfftScalingType scaling) {
+  plan_->check_precision(false);
+  plan_->forward(space, values_output, static_cast<int>(scaling));
+}
+
+SpfftTransformType DistributedTransform::type() const {
+  return static_cast<SpfftTransformType>(plan_->meta.transform_type);
+}
+int DistributedTransform::dim_x() const { return plan_->meta.dim_x; }
+int DistributedTransform::dim_y() const { return plan_->meta.dim_y; }
+int DistributedTransform::dim_z() const { return plan_->meta.dim_z; }
+int DistributedTransform::num_shards() const { return plan_->meta.num_shards; }
+long long DistributedTransform::num_global_elements() const {
+  return plan_->num_global;
+}
+long long DistributedTransform::global_size() const { return plan_->meta.global_size; }
+SpfftProcessingUnitType DistributedTransform::processing_unit() const {
+  return static_cast<SpfftProcessingUnitType>(plan_->meta.processing_unit);
+}
+SpfftExchangeType DistributedTransform::exchange_type() const {
+  return static_cast<SpfftExchangeType>(plan_->meta.exchange_type);
+}
+long long DistributedTransform::exchange_wire_bytes() const {
+  return plan_->meta.wire_bytes;
+}
+bool DistributedTransform::double_precision() const { return plan_->dbl; }
+
+int DistributedTransform::local_z_length(int shard) const {
+  plan_->check_shard(shard);
+  return static_cast<int>(plan_->shard_zlen[shard]);
+}
+int DistributedTransform::local_z_offset(int shard) const {
+  plan_->check_shard(shard);
+  return static_cast<int>(plan_->shard_zoff[shard]);
+}
+long long DistributedTransform::local_slice_size(int shard) const {
+  plan_->check_shard(shard);
+  return plan_->shard_slice[shard];
+}
+long long DistributedTransform::num_local_elements(int shard) const {
+  plan_->check_shard(shard);
+  return plan_->shard_elems[shard];
 }
 
 } // namespace spfft

@@ -20,17 +20,20 @@ C++ and the compute core is the JAX/XLA plan object.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import errors
 from .grid import Grid
 from .multi_transform import multi_transform_backward, multi_transform_forward
 from .transform import Transform
-from .types import ExecType, ProcessingUnit, ScalingType, TransformType
+from .types import ExchangeType, ExecType, ProcessingUnit, ScalingType, TransformType
 
 __all__ = [
     "error_code",
     "grid_create",
+    "grid_create_distributed",
     "grid_get",
     "transform_create",
     "transform_create_from_grid",
@@ -41,7 +44,29 @@ __all__ = [
     "transform_forward",
     "multi_backward",
     "multi_forward",
+    "dist_transform_create",
+    "dist_transform_get",
+    "dist_transform_get_shard",
+    "dist_backward",
+    "dist_forward",
 ]
+
+# Virtual CPU mesh size for native callers (the C analogue of the tests'
+# 8-device conftest): must be applied before JAX initializes its backends,
+# i.e. before the first Grid/Transform creation in the embedded interpreter.
+_num_cpu = os.environ.get("SPFFT_TPU_NUM_CPU_DEVICES")
+if _num_cpu:
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", int(_num_cpu))
+    except RuntimeError as e:  # backend already initialized elsewhere
+        import sys
+
+        print(
+            f"spfft_tpu.capi: SPFFT_TPU_NUM_CPU_DEVICES ignored ({e})",
+            file=sys.stderr,
+        )
 
 _SP_SUCCESS = 0
 _SP_UNKNOWN = int(errors.ErrorCode.UNKNOWN)
@@ -59,6 +84,18 @@ def error_code(exc: BaseException) -> int:
     if isinstance(exc, MemoryError):
         return int(errors.ErrorCode.ALLOCATION)
     return _SP_UNKNOWN
+
+
+def _ensure_x64(double_precision: bool) -> None:
+    """Native callers requesting double precision must actually get f64: the
+    embedded interpreter does not run the test conftest, and without x64 JAX
+    silently truncates f64 arrays to f32 (a ~4e-7 roundtrip instead of ~1e-15).
+    jax_enable_x64 is runtime-updatable, so flip it on first f64 plan."""
+    if double_precision:
+        import jax
+
+        if not jax.config.read("jax_enable_x64"):
+            jax.config.update("jax_enable_x64", True)
 
 
 def _real_dtype(t: Transform) -> np.dtype:
@@ -100,6 +137,7 @@ def transform_create(
     indices,
     double_precision: bool,
 ) -> Transform:
+    _ensure_x64(double_precision)
     idx = np.frombuffer(indices, dtype=np.int32).copy()
     return Transform(
         ProcessingUnit(processing_unit),
@@ -125,6 +163,7 @@ def transform_create_from_grid(
     indices,
     double_precision: bool,
 ) -> Transform:
+    _ensure_x64(double_precision)
     idx = np.frombuffer(indices, dtype=np.int32).copy()
     return grid.create_transform(
         ProcessingUnit(processing_unit),
@@ -141,6 +180,80 @@ def transform_create_from_grid(
 
 def transform_clone(t: Transform) -> Transform:
     return t.clone()
+
+
+# ---- distributed (single-controller) ----------------------------------------
+# The reference's MPI Grid ctor takes a communicator and each rank supplies its
+# local part (reference: include/spfft/grid.hpp:89-91). The native TPU analogue
+# is single-controller: ONE process drives every shard of a device mesh, so the
+# C caller passes per-shard counts and shard-major concatenated data.
+
+
+def grid_create_distributed(
+    max_dim_x: int,
+    max_dim_y: int,
+    max_dim_z: int,
+    max_num_local_z_columns: int,
+    max_local_z_length: int,
+    num_shards: int,
+    processing_unit: int,
+    exchange_type: int,
+    max_num_threads: int,
+) -> Grid:
+    import jax
+
+    from .parallel.mesh import make_fft_mesh
+
+    pu = ProcessingUnit(processing_unit)
+    devices = (
+        jax.devices("cpu")[:num_shards] if pu == ProcessingUnit.HOST else None
+    )
+    mesh = make_fft_mesh(num_shards, devices=devices)
+    return Grid(
+        max_dim_x,
+        max_dim_y,
+        max_dim_z,
+        max_num_local_z_columns,
+        pu,
+        max_num_threads,
+        max_local_z_length=max_local_z_length if max_local_z_length > 0 else None,
+        mesh=mesh,
+        exchange_type=ExchangeType(exchange_type),
+    )
+
+
+def dist_transform_create(
+    grid: Grid,
+    processing_unit: int,
+    transform_type: int,
+    dim_x: int,
+    dim_y: int,
+    dim_z: int,
+    num_shards: int,
+    shard_num_elements,
+    indices,
+    double_precision: bool,
+):
+    _ensure_x64(double_precision)
+    counts = np.frombuffer(shard_num_elements, dtype=np.int32, count=num_shards)
+    flat = np.frombuffer(indices, dtype=np.int32).copy().reshape(-1, 3)
+    if flat.shape[0] != int(counts.sum()):
+        raise errors.InvalidParameterError(
+            "indices length does not match the sum of shard_num_elements"
+        )
+    per_shard, off = [], 0
+    for n in counts:
+        per_shard.append(flat[off : off + int(n)])
+        off += int(n)
+    return grid.create_transform(
+        ProcessingUnit(processing_unit),
+        TransformType(transform_type),
+        dim_x,
+        dim_y,
+        dim_z,
+        indices=per_shard,
+        dtype=np.float64 if double_precision else np.float32,
+    )
 
 
 # ---- accessors --------------------------------------------------------------
@@ -171,6 +284,9 @@ _GRID_GETTERS = {
     "processing_unit": lambda g: int(g.processing_unit),
     "max_num_threads": lambda g: g.max_num_threads,
     "device_id": lambda g: 0,
+    "num_shards": lambda g: g.num_shards,
+    "has_mesh": lambda g: int(g.mesh is not None),
+    "exchange_type": lambda g: int(g.exchange_type),
 }
 
 
@@ -256,3 +372,90 @@ def multi_forward(transforms, space_bufs, values_out_bufs, scalings) -> None:
     )
     for t, vals, buf in zip(transforms, results, values_out_bufs):
         _write_freq(t, vals, buf)
+
+
+# ---- distributed execution --------------------------------------------------
+
+_DIST_GETTERS = {
+    "dim_x": lambda t: t.dim_x,
+    "dim_y": lambda t: t.dim_y,
+    "dim_z": lambda t: t.dim_z,
+    "num_shards": lambda t: t.num_shards,
+    "num_global_elements": lambda t: t.num_global_elements,
+    "global_size": lambda t: t.global_size,
+    "transform_type": lambda t: int(t.transform_type),
+    "processing_unit": lambda t: int(t.processing_unit),
+    "exchange_type": lambda t: int(t.exchange_type),
+    "exchange_wire_bytes": lambda t: t.exchange_wire_bytes(),
+    "execution_mode": lambda t: int(t.execution_mode()),
+}
+
+_DIST_SHARD_GETTERS = {
+    "local_z_length": lambda t, r: t.local_z_length(r),
+    "local_z_offset": lambda t, r: t.local_z_offset(r),
+    "local_slice_size": lambda t, r: t.local_slice_size(r),
+    "num_local_elements": lambda t, r: t.num_local_elements(r),
+}
+
+
+def dist_transform_get(t, name: str) -> int:
+    return int(_DIST_GETTERS[name](t))
+
+
+def dist_transform_get_shard(t, name: str, shard: int) -> int:
+    return int(_DIST_SHARD_GETTERS[name](t, shard))
+
+
+def _dist_dtypes(t):
+    rt = np.dtype(t.dtype)
+    return rt, np.dtype(np.complex128 if rt == np.float64 else np.complex64)
+
+
+def _dist_values_view(t, buf):
+    rt, ct = _dist_dtypes(t)
+    total = t.num_global_elements
+    return np.frombuffer(buf, dtype=rt, count=2 * total).view(ct)
+
+
+def _dist_space_reals(t) -> int:
+    n = t.global_size
+    return n if int(t.transform_type) == int(TransformType.R2C) else 2 * n
+
+
+def dist_backward(t, values_buf, space_out_buf) -> None:
+    """Shard-major concatenated freq values -> global (Z, Y, X) space array."""
+    rt, ct = _dist_dtypes(t)
+    vals = _dist_values_view(t, values_buf)
+    vps, off = [], 0
+    for r in range(t.num_shards):
+        n = t.num_local_elements(r)
+        vps.append(vals[off : off + n])
+        off += n
+    out = t.backward(vps)
+    dst = np.frombuffer(space_out_buf, dtype=rt, count=_dist_space_reals(t))
+    if int(t.transform_type) == int(TransformType.R2C):
+        dst[:] = np.asarray(out, dtype=rt).ravel()
+    else:
+        dst.view(ct)[:] = np.asarray(out).ravel()
+
+
+def dist_forward(t, space_buf, values_out_buf, scaling: int) -> None:
+    """Global (Z, Y, X) space array (or None for the retained buffer) ->
+    shard-major concatenated freq values."""
+    rt, ct = _dist_dtypes(t)
+    if space_buf is None:
+        space = None
+    else:
+        flat = np.frombuffer(space_buf, dtype=rt, count=_dist_space_reals(t))
+        if int(t.transform_type) != int(TransformType.R2C):
+            flat = flat.view(ct)
+        space = flat.reshape(t.dim_z, t.dim_y, t.dim_x)
+    res = t.forward(space, ScalingType(scaling))
+    dst = _dist_values_view(t, values_out_buf)
+    # frombuffer of a readonly memoryview is readonly; the C side passes a
+    # writable view for outputs, so this is writable
+    off = 0
+    for r, vals in enumerate(res):
+        n = t.num_local_elements(r)
+        dst[off : off + n] = np.asarray(vals)
+        off += n
