@@ -7,7 +7,7 @@ supply values for their OWN shard only, run backward+forward through the mesh
 engine, and verify their local slab against a dense oracle plus the value
 roundtrip. Prints "RANK <r> PASS" on success.
 
-Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c]
+Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c] [buffered|compact]
 """
 import os
 import sys
@@ -16,6 +16,7 @@ rank = int(sys.argv[1])
 port = int(sys.argv[2])
 engine = sys.argv[3]
 ttype_name = sys.argv[4] if len(sys.argv) > 4 else "c2c"
+exchange_name = sys.argv[5] if len(sys.argv) > 5 else "buffered"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
@@ -28,7 +29,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import spfft_tpu as sp
-from spfft_tpu import DistributedTransform, ProcessingUnit, ScalingType, TransformType
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
 from spfft_tpu.parameters import distribute_triplets
 
 sp.init_distributed(f"localhost:{port}", num_processes=2, process_id=rank)
@@ -66,6 +73,11 @@ t = DistributedTransform(
     dz,
     per_shard,
     mesh=mesh,
+    exchange_type=(
+        ExchangeType.COMPACT_BUFFERED
+        if exchange_name == "compact"
+        else ExchangeType.BUFFERED
+    ),
     engine=engine,
 )
 ex = t._exec

@@ -15,14 +15,21 @@ SCRIPT = Path(__file__).resolve().parent.parent / "programs" / "multihost_smoke.
 
 
 @pytest.mark.parametrize(
-    "engine,ttype,port",
-    [("xla", "c2c", 12971), ("mxu", "c2c", 12973), ("mxu", "r2c", 12975)],
+    "engine,ttype,port,exchange",
+    [
+        ("xla", "c2c", 12971, "buffered"),
+        ("mxu", "c2c", 12973, "buffered"),
+        ("mxu", "r2c", 12975, "buffered"),
+        # exact-counts ppermute chain over the cross-process (Gloo) mesh
+        ("xla", "c2c", 12977, "compact"),
+        ("mxu", "c2c", 12979, "compact"),
+    ],
 )
-def test_two_process_roundtrip(engine, ttype, port):
+def test_two_process_roundtrip(engine, ttype, port, exchange):
     env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
     procs = [
         subprocess.Popen(
-            [sys.executable, str(SCRIPT), str(rank), str(port), engine, ttype],
+            [sys.executable, str(SCRIPT), str(rank), str(port), engine, ttype, exchange],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,

@@ -22,14 +22,26 @@ def make_2d_mesh(fft=2, rep=2):
     return Mesh(devs, ("fft", "rep"))
 
 
+from spfft_tpu import ExchangeType
+
+
 @pytest.mark.parametrize("engine", ["xla", "mxu"])
-def test_fft_subaxis_of_model_mesh(engine):
-    rng = np.random.default_rng(31)
+@pytest.mark.parametrize(
+    "seed,weights,exchange",
+    [
+        (31, None, ExchangeType.BUFFERED),
+        # exact-counts ppermute chain: rotations must stay on the fft axis,
+        # replicated over the rest (imbalanced weights exercise the raggedness)
+        (33, [3, 1], ExchangeType.COMPACT_BUFFERED),
+    ],
+)
+def test_fft_subaxis_of_model_mesh(engine, seed, weights, exchange):
+    rng = np.random.default_rng(seed)
     dims = (8, 9, 10)
     dx, dy, dz = dims
     trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
     values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
-    per_shard = distribute_triplets(trip, 2, dy)
+    per_shard = distribute_triplets(trip, 2, dy, weights=weights)
     vps = split_values(per_shard, trip, values)
 
     t = DistributedTransform(
@@ -40,6 +52,7 @@ def test_fft_subaxis_of_model_mesh(engine):
         dz,
         per_shard,
         mesh=make_2d_mesh(),
+        exchange_type=exchange,
         engine=engine,
     )
     expected = oracle_backward_c2c(trip, values, *dims)
