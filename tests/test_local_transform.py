@@ -21,7 +21,17 @@ from utils import (
     random_sparse_triplets,
 )
 
-DIMS = [(2, 2, 2), (4, 5, 6), (11, 12, 13), (16, 16, 16), (1, 13, 7)]
+# dim set mirrors the reference sweep {1, 2, 11, 12, 13, 100}
+# (reference: tests/mpi_tests/test_transform.cpp:173-191)
+DIMS = [
+    (2, 2, 2),
+    (4, 5, 6),
+    (11, 12, 13),
+    (16, 16, 16),
+    (1, 13, 7),
+    (1, 1, 1),
+    (100, 11, 2),
+]
 
 
 def make_transform(dims, triplets, dtype=np.float64, ttype=TransformType.C2C):

@@ -8,7 +8,7 @@ from spfft_tpu.parameters import make_local_parameters
 from spfft_tpu.types import ScalingType, TransformType
 from utils import assert_close, oracle_backward_c2c, oracle_forward_c2c, random_sparse_triplets
 
-DIMS = [(4, 5, 6), (11, 12, 13), (16, 16, 16)]
+DIMS = [(4, 5, 6), (11, 12, 13), (16, 16, 16), (1, 13, 7), (100, 11, 2)]
 
 
 def sorted_triplets(trip, dims):
