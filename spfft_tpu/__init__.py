@@ -42,7 +42,7 @@ from .multi_transform import (  # noqa: F401
     multi_transform_backward,
     multi_transform_forward,
 )
-from .parallel import init_distributed, make_fft_mesh  # noqa: F401
+from .parallel import init_distributed, make_fft_mesh, make_fft_mesh2  # noqa: F401
 from .parameters import distribute_triplets  # noqa: F401
 from .transform import Transform, TransformFloat  # noqa: F401
 from .types import (  # noqa: F401

@@ -52,9 +52,10 @@ class DistributedTransform:
             mesh = grid.mesh
         if mesh is None:
             raise InvalidParameterError("distributed transform requires a mesh")
-        from .parallel.mesh import fft_axis_size
+        from .parallel.mesh import fft_mesh_size, is_pencil2_mesh
 
-        num_shards = fft_axis_size(mesh)
+        pencil2 = is_pencil2_mesh(mesh)
+        num_shards = fft_mesh_size(mesh)
 
         if isinstance(indices, (list, tuple)):
             indices_per_shard = [np.asarray(t).reshape(-1, 3) for t in indices]
@@ -104,6 +105,17 @@ class DistributedTransform:
         # accelerator meshes; the XLA engine (jnp.fft + scatter) wins on CPU
         # meshes where pocketfft is the fast path. Selected by the platform the
         # MESH lives on, not the process default backend.
+        if pencil2:
+            # 2-D pencil decomposition (parallel/pencil2.py): its own engine;
+            # the engine= knob selects between the 1-D engines only.
+            from .parallel.pencil2 import Pencil2Execution
+
+            self._exec = Pencil2Execution(
+                self._params, self._real_dtype, mesh, exchange_type
+            )
+            self._engine = "pencil2"
+            self._space_data = None
+            return
         if engine == "auto":
             engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
         if engine == "mxu":
@@ -220,15 +232,21 @@ class DistributedTransform:
         return self._exec.unpad_space(self._space_data)
 
     def space_domain_data_local(self, shard: int):
-        """Shard-local slab (local_z_length(shard), dim_y, dim_x) — the reference's
-        per-rank ``space_domain_data`` pointer. Fetches only that shard's slab."""
+        """Shard-local space block — the reference's per-rank
+        ``space_domain_data`` pointer. 1-D meshes: a z-slab
+        (local_z_length(shard), dim_y, dim_x); 2-D pencil meshes: a z×y block
+        (local_z_length(shard), local_y_length(shard), dim_x). Fetches only
+        that shard's block."""
         if self._space_data is None:
             raise InvalidParameterError("no space domain data available yet")
         l = self.local_z_length(shard)
+        ly = self.local_y_length(shard)
         if self._exec.is_r2c:
-            return np.asarray(self._space_data[shard])[:l]
+            return np.asarray(self._space_data[shard])[:l, :ly]
         re, im = self._space_data
-        return np.asarray(re[shard])[:l] + 1j * np.asarray(im[shard])[:l]
+        return (
+            np.asarray(re[shard])[:l, :ly] + 1j * np.asarray(im[shard])[:l, :ly]
+        )
 
     # ---- accessors ------------------------------------------------------------
 
@@ -256,14 +274,33 @@ class DistributedTransform:
     def mesh(self):
         return self._mesh
 
+    # Per-shard space layout. The 2-D pencil engine carries its own z×y split
+    # (the 1-D slab metadata in params does not describe it), so the engine is
+    # consulted when it defines the accessor.
+
     def local_z_length(self, shard: int) -> int:
+        if hasattr(self._exec, "local_z_length"):
+            return self._exec.local_z_length(shard)
         return int(self._params.local_z_lengths[shard])
 
     def local_z_offset(self, shard: int) -> int:
+        if hasattr(self._exec, "local_z_offset"):
+            return self._exec.local_z_offset(shard)
         return int(self._params.z_offsets[shard])
 
+    def local_y_length(self, shard: int) -> int:
+        """dim_y on 1-D meshes; the shard's y-slab length on 2-D pencil meshes."""
+        if hasattr(self._exec, "local_y_length"):
+            return self._exec.local_y_length(shard)
+        return self.dim_y
+
+    def local_y_offset(self, shard: int) -> int:
+        if hasattr(self._exec, "local_y_offset"):
+            return self._exec.local_y_offset(shard)
+        return 0
+
     def local_slice_size(self, shard: int) -> int:
-        return self.dim_x * self.dim_y * self.local_z_length(shard)
+        return self.dim_x * self.local_y_length(shard) * self.local_z_length(shard)
 
     def num_local_elements(self, shard: int) -> int:
         return int(self._params.num_values_per_shard[shard])

@@ -113,9 +113,9 @@ class Grid:
     def num_shards(self) -> int:
         if self._mesh is None:
             return 1
-        from .parallel.mesh import fft_axis_size
+        from .parallel.mesh import fft_mesh_size
 
-        return fft_axis_size(self._mesh)
+        return fft_mesh_size(self._mesh)
 
     def create_transform(
         self,

@@ -4,5 +4,5 @@ The analogue of the reference's MPI transpose + parameter machinery
 (reference: src/transpose/*, src/parameters/parameters.cpp:43-140), rebuilt on
 ``jax.sharding.Mesh`` + ``shard_map`` with ``lax.all_to_all`` collectives.
 """
-from .mesh import init_distributed, make_fft_mesh  # noqa: F401
+from .mesh import init_distributed, make_fft_mesh, make_fft_mesh2  # noqa: F401
 from .execution import DistributedExecution  # noqa: F401

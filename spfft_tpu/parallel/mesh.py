@@ -12,6 +12,20 @@ import numpy as np
 from jax.sharding import Mesh
 
 FFT_AXIS = "fft"
+FFT_AXIS2 = "fft2"
+
+
+def is_pencil2_mesh(mesh) -> bool:
+    """True for 2-D pencil meshes (both ``"fft"`` and ``"fft2"`` axes present)."""
+    return FFT_AXIS in mesh.axis_names and FFT_AXIS2 in mesh.axis_names
+
+
+def fft_mesh_size(mesh) -> int:
+    """Total FFT shards: the ``"fft"`` axis size, times ``"fft2"`` if present."""
+    n = fft_axis_size(mesh)
+    if FFT_AXIS2 in mesh.axis_names:
+        n *= int(mesh.shape[FFT_AXIS2])
+    return n
 
 
 def fft_axis_size(mesh) -> int:
@@ -43,6 +57,31 @@ def make_fft_mesh(num_devices: int | None = None, devices=None) -> Mesh:
         if num_devices is not None:
             devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (FFT_AXIS,))
+
+
+def make_fft_mesh2(p1: int, p2: int, devices=None) -> Mesh:
+    """Build a 2-D ``(p1, p2)`` pencil mesh (axes ``"fft"`` x ``"fft2"``).
+
+    Transforms over it use the 2-D pencil decomposition
+    (:mod:`spfft_tpu.parallel.pencil2`): space is split into z-slabs over
+    ``"fft2"`` AND y-slabs over ``"fft"``, lifting the 1-D slab engine's
+    ``P <= dim_z`` useful-parallelism cap to ``p1 * p2 <= dim_z * dim_y``.
+    """
+    if p1 < 1 or p2 < 1:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError("mesh factors must be positive")
+    if devices is None:
+        devices = jax.devices()[: p1 * p2]
+    devices = np.asarray(devices)
+    if devices.size < p1 * p2:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            f"make_fft_mesh2({p1}, {p2}) needs {p1 * p2} devices, "
+            f"have {devices.size}"
+        )
+    return Mesh(devices.reshape(p1, p2), (FFT_AXIS, FFT_AXIS2))
 
 
 def init_distributed(

@@ -1,0 +1,190 @@
+"""2-D pencil decomposition engine (parallel/pencil2.py).
+
+The beyond-reference scaling path: space split into z-slabs x y-slabs over a
+("fft", "fft2") mesh, lifting the slab engine's P <= dim_z cap to
+P1 * P2 <= dim_z * dim_y. Oracle scenarios mirror the 1-D distributed tests.
+"""
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parameters import distribute_triplets
+from utils import (
+    assert_close,
+    oracle_backward_c2c,
+    oracle_forward_c2c,
+    random_sparse_triplets,
+    split_values,
+)
+
+
+def build(p1, p2, dims, per_shard, exchange=ExchangeType.DEFAULT, dtype=None):
+    dx, dy, dz = dims
+    return DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh2(p1, p2),
+        exchange_type=exchange,
+        dtype=dtype,
+    )
+
+
+@pytest.mark.parametrize("p1,p2", [(2, 4), (4, 2), (1, 8), (8, 1), (2, 2)])
+def test_pencil2_c2c_roundtrip(p1, p2):
+    rng = np.random.default_rng(41)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, p1 * p2, dy)
+    vps = split_values(per_shard, trip, values)
+    t = build(p1, p2, dims, per_shard)
+    assert t._engine == "pencil2"
+    expected = oracle_backward_c2c(trip, values, dx, dy, dz)
+    assert_close(t.backward(vps), expected)
+    # run twice (zeroing check, reference: tests/test_util/test_transform.hpp:129-131)
+    assert_close(t.backward(vps), expected)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_pencil2_beyond_slab_limit():
+    """P = 8 > dim_z = 2: the 1-D slab engine would idle 6 shards in space;
+    the pencil split keeps every shard's slab non-trivial (z x y blocks)."""
+    rng = np.random.default_rng(43)
+    dims = (8, 8, 2)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.7)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 8, dy)
+    vps = split_values(per_shard, trip, values)
+    t = build(4, 2, dims, per_shard)
+    assert_close(t.backward(vps), oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_pencil2_explicit_space_forward():
+    rng = np.random.default_rng(44)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    per_shard = distribute_triplets(trip, 8, dy)
+    t = build(2, 4, dims, per_shard)
+    space = rng.standard_normal((dz, dy, dx)) + 1j * rng.standard_normal((dz, dy, dx))
+    got = t.forward(space)
+    for r, trip_r in enumerate(per_shard):
+        assert_close(got[r], oracle_forward_c2c(trip_r, space))
+
+
+def test_pencil2_imbalanced_sticks():
+    """All sticks on one shard; empty stick sets elsewhere."""
+    rng = np.random.default_rng(45)
+    dims = (6, 6, 6)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = [trip] + [np.zeros((0, 3), dtype=np.int64)] * 3
+    t = build(2, 2, dims, per_shard)
+    out = t.backward([values] + [np.zeros(0)] * 3)
+    assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back[0], values)
+
+
+@pytest.mark.parametrize(
+    "exchange,dtype,atol_scale",
+    [
+        (ExchangeType.BUFFERED_FLOAT, np.float64, 1e-4),
+        (ExchangeType.BUFFERED_BF16, np.float32, 3e-2),
+    ],
+)
+def test_pencil2_wire_formats(exchange, dtype, atol_scale):
+    rng = np.random.default_rng(46)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+    t = build(2, 2, dims, per_shard, exchange=exchange, dtype=dtype)
+    out = t.backward(vps)
+    expected = oracle_backward_c2c(trip, values, dx, dy, dz)
+    scale = np.abs(expected).max()
+    np.testing.assert_allclose(out, expected, rtol=0, atol=atol_scale * scale)
+    assert t.exchange_wire_bytes() > 0
+
+
+def test_pencil2_f32():
+    rng = np.random.default_rng(47)
+    dims = (16, 8, 12)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 8, dy)
+    vps = split_values(per_shard, trip, values)
+    t = build(2, 4, dims, per_shard, dtype=np.float32)
+    assert_close(t.backward(vps), oracle_backward_c2c(trip, values, dx, dy, dz),
+                 dtype=np.float32)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals, dtype=np.float32)
+
+
+def test_pencil2_per_shard_layout_and_local_blocks():
+    """Per-shard accessors describe the 2-D z×y split and
+    space_domain_data_local returns the matching block of the global result."""
+    rng = np.random.default_rng(50)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.6)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 8, dy)
+    vps = split_values(per_shard, trip, values)
+    t = build(2, 4, dims, per_shard)
+    out = t.backward(vps)
+    # z lengths tile dim_z within each y-slab row; y lengths tile dim_y
+    assert sum(t.local_z_length(r) for r in range(4)) == dz  # one y-row (a=0)
+    assert t.local_y_length(0) + t.local_y_length(4) == dy
+    for r in range(8):
+        lz, zo = t.local_z_length(r), t.local_z_offset(r)
+        ly, yo = t.local_y_length(r), t.local_y_offset(r)
+        assert t.local_slice_size(r) == lz * ly * dx
+        blk = t.space_domain_data_local(r)
+        assert blk.shape == (lz, ly, dx)
+        np.testing.assert_allclose(
+            blk, out[zo : zo + lz, yo : yo + ly], rtol=0, atol=1e-12
+        )
+
+
+def test_pencil2_r2c_rejected():
+    rng = np.random.default_rng(48)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.4, hermitian=True)
+    per_shard = distribute_triplets(trip, 4, 8)
+    with pytest.raises(InvalidParameterError):
+        DistributedTransform(
+            ProcessingUnit.HOST, TransformType.R2C, 8, 8, 8, per_shard,
+            mesh=sp.make_fft_mesh2(2, 2),
+        )
+
+
+def test_pencil2_mesh_size_mismatch_rejected():
+    rng = np.random.default_rng(49)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.4)
+    per_shard = distribute_triplets(trip, 4, 8)
+    with pytest.raises(Exception):
+        build(2, 4, (8, 8, 8), per_shard)  # 4 shard lists over an 8-device mesh
