@@ -98,6 +98,14 @@ class PaddingHelpers:
                 f"values, got {v.size}"
             )
 
+    def _dispatch_forward(self, table, space_re, space_im, scaling):
+        """Select the scaling-specialized forward and pass the r2c-dependent
+        argument tuple (engines with their own contract override this)."""
+        fn = table[ScalingType(scaling)]
+        if self.is_r2c:
+            return fn(space_re, self._value_indices)
+        return fn(space_re, space_im, self._value_indices)
+
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes one slab<->pencil repartition puts on the
         interconnect (self-blocks excluded for both disciplines; per direction
@@ -497,12 +505,6 @@ class DistributedExecution(PaddingHelpers):
     def backward_pair(self, values_re, values_im):
         """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
         return self._backward(values_re, values_im, self._value_indices)
-
-    def _dispatch_forward(self, table, space_re, space_im, scaling):
-        fn = table[ScalingType(scaling)]
-        if self.is_r2c:
-            return fn(space_re, self._value_indices)
-        return fn(space_re, space_im, self._value_indices)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""

@@ -20,9 +20,11 @@ FFT frameworks (AccFFT / mpi4py-fft lineage), adapted to sparse z-stick input
 (which removes one of their three transposes: sticks are already z-local).
 
 Wire discipline is padded-uniform (BUFFERED) on both exchanges; ``*_FLOAT`` /
-``*_BF16`` wire casts apply around each collective. C2C only (R2C callers use
-the 1-D engines; hermitian completion across a 2-D-split x/y layout is future
-work). XLA/jnp.fft compute path.
+``*_BF16`` wire casts apply around each collective. R2C works because both
+hermitian completions stay shard-local: the (0,0) stick fill happens on its
+owner before exchange A (as in 1-D), and the x=0 plane fill happens on the
+x-group-0 column after exchange A, where that shard holds the FULL y extent
+(reference: src/symmetry/symmetry_host.hpp:40-97). XLA/jnp.fft compute path.
 """
 from __future__ import annotations
 
@@ -35,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..errors import InvalidParameterError
 from ..execution import _complex_dtype
+from ..ops import symmetry
 from ..types import (
     BF16_EXCHANGES as _BF16,
     FLOAT_EXCHANGES as _FLOAT,
@@ -55,13 +58,9 @@ def _ceil_split(n: int, parts: int) -> np.ndarray:
 
 
 class Pencil2Execution(PaddingHelpers):
-    """Compiled 2-D-pencil distributed pipelines for one C2C plan."""
+    """Compiled 2-D-pencil distributed pipelines for one plan (C2C or R2C)."""
 
     def __init__(self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT):
-        if params.transform_type != TransformType.C2C:
-            raise InvalidParameterError(
-                "the 2-D pencil engine supports C2C only (use a 1-D fft mesh for R2C)"
-            )
         self.params = params
         self.mesh = mesh
         self.real_dtype = np.dtype(real_dtype)
@@ -124,6 +123,8 @@ class Pencil2Execution(PaddingHelpers):
         xcol = np.full(P1 * Ax, Xf, dtype=np.int64)
         xcol[group_of_x[ux] * Ax + slot_of_x[ux]] = ux
         self._xcol = xcol.astype(np.int32)
+        # R2C symmetry sites: x == 0 (if present) is group 0, slot 0 (ux sorted)
+        self._have_x0 = bool((ux == 0).any())
         # y chunk maps: global y of (group q, row l) with sentinel Y, and inverse
         ymap = np.full((P1, Ly), Y, dtype=np.int64)
         for a in range(P1):
@@ -143,11 +144,12 @@ class Pencil2Execution(PaddingHelpers):
         )
         specs_v = P(both, None)
         specs_s = P(both, None, None, None)
+        r2c = self.is_r2c
         sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
         self._backward_sm = sm(
             self._backward_impl,
             in_specs=(specs_v, specs_v, specs_v),
-            out_specs=(specs_s, specs_s),
+            out_specs=specs_s if r2c else (specs_s, specs_s),
         )
         self._backward = jax.jit(self._backward_sm)
         self._forward_sm = {}
@@ -158,7 +160,7 @@ class Pencil2Execution(PaddingHelpers):
         ):
             self._forward_sm[scaling] = sm(
                 functools.partial(self._forward_impl, scale=scale),
-                in_specs=(specs_s, specs_s, specs_v),
+                in_specs=(specs_s, specs_v) if r2c else (specs_s, specs_s, specs_v),
                 out_specs=(specs_v, specs_v),
             )
             self._forward[scaling] = jax.jit(self._forward_sm[scaling])
@@ -167,7 +169,7 @@ class Pencil2Execution(PaddingHelpers):
 
     @property
     def is_r2c(self) -> bool:
-        return False
+        return self.params.transform_type == TransformType.R2C
 
     def _wire_scalar_bytes(self) -> int:
         if self.exchange_type in _BF16:
@@ -202,11 +204,15 @@ class Pencil2Execution(PaddingHelpers):
     # ---- host boundary (2-D slabs) --------------------------------------------
 
     def pad_space(self, space):
-        """Global (Z, Y, X) array -> sharded (P, Lz, Ly, X) real pair."""
+        """Global (Z, Y, X) array -> sharded (P, Lz, Ly, X) real arrays
+        ((re, im) pair for C2C; (re, None) for R2C)."""
         p = self.params
         space = np.asarray(space)
         out = []
-        for part in (space.real, space.imag):
+        for part in (space.real, None if self.is_r2c else space.imag):
+            if part is None:
+                out.append(None)
+                continue
             buf = np.zeros(
                 (p.num_shards, self._Lz, self._Ly, p.dim_x), dtype=self.real_dtype
             )
@@ -220,11 +226,14 @@ class Pencil2Execution(PaddingHelpers):
         return out[0], out[1]
 
     def unpad_space(self, out):
-        """Sharded (P, Lz, Ly, X) pair -> global (Z, Y, X) numpy array."""
+        """Sharded (P, Lz, Ly, X) result -> global (Z, Y, X) numpy array."""
         p = self.params
-        re, im = np.asarray(out[0]), np.asarray(out[1])
-        full = re + 1j * im
-        dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
+        if self.is_r2c:
+            full = np.asarray(out)
+            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
+        else:
+            full = np.asarray(out[0]) + 1j * np.asarray(out[1])
+            dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.complex_dtype)
         for a in range(self.P1):
             for b in range(self.P2):
                 s = a * self.P2 + b
@@ -267,7 +276,16 @@ class Pencil2Execution(PaddingHelpers):
         )
         flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
         flat = flat.at[value_indices[0]].set(values, mode="drop")
-        sticks = jnp.fft.ifft(flat[: S * Z].reshape(S, Z), axis=1)
+        sticks = flat[: S * Z].reshape(S, Z)
+
+        if self.is_r2c and p.zero_stick_shard >= 0:
+            # (0,0)-stick hermitian fill on its owner, before the z transform
+            row = sticks[p.zero_stick_row]
+            filled = symmetry.hermitian_fill_1d(row, axis=0)
+            own = s_me == p.zero_stick_shard
+            sticks = sticks.at[p.zero_stick_row].set(jnp.where(own, filled, row))
+
+        sticks = jnp.fft.ifft(sticks, axis=1)
 
         # pack A: my sticks split by destination (x-group a', z-slab b')
         sflat = jnp.concatenate([sticks.reshape(-1), jnp.zeros(1, self.complex_dtype)])
@@ -293,7 +311,15 @@ class Pencil2Execution(PaddingHelpers):
         dest = jnp.where(okd, dest, Lz * (Y * Ax))
         g = jnp.zeros(Lz * Y * Ax + 1, dtype=self.complex_dtype)
         g = g.at[dest].set(recv)  # dest and recv both (P, SG, Lz)
-        grid = jnp.fft.ifft(g[: Lz * Y * Ax].reshape(Lz, Y, Ax), axis=1)
+        grid = g[: Lz * Y * Ax].reshape(Lz, Y, Ax)
+
+        if self.is_r2c and self._have_x0:
+            # x == 0 plane hermitian fill along y: group 0, slot 0 holds it,
+            # and that shard has the FULL y extent here (z is space-domain)
+            col = symmetry.hermitian_fill_1d(grid[:, :, 0], axis=1)
+            grid = grid.at[:, :, 0].set(jnp.where(a_me == 0, col, grid[:, :, 0]))
+
+        grid = jnp.fft.ifft(grid, axis=1)
 
         # pack B: slice each destination's y-rows (within my fixed z-slab)
         gpad = jnp.concatenate([grid, jnp.zeros((Lz, 1, Ax), self.complex_dtype)], axis=1)
@@ -308,10 +334,14 @@ class Pencil2Execution(PaddingHelpers):
         slab = jnp.zeros((Lz, Ly, Xf + 1), dtype=self.complex_dtype)
         slab = slab.at[:, :, jnp.asarray(self._xcol)].set(h, mode="drop")
         slab = slab[:, :, :Xf]
-        out = jnp.fft.ifft(slab, axis=2) * np.asarray(p.total_size, self.real_dtype)
+        total = np.asarray(p.total_size, self.real_dtype)
+        if self.is_r2c:
+            out = jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype)
+            return (out * total)[None]
+        out = jnp.fft.ifft(slab, axis=2) * total
         return out.real[None], out.imag[None]
 
-    def _forward_impl(self, space_re, space_im, value_indices, *, scale):
+    def _forward_impl(self, space_re, *rest, scale):
         p = self.params
         S, Z, Y, Xf = self._S, p.dim_z, p.dim_y, p.dim_x_freq
         P1, P2, Ax, Lz, Ly, SG = self.P1, self.P2, self._Ax, self._Lz, self._Ly, self._SG
@@ -321,10 +351,16 @@ class Pencil2Execution(PaddingHelpers):
         lz_t = jnp.asarray(self._lz.astype(np.int32))
         zo_t = jnp.asarray(self._zo.astype(np.int32))
 
-        slab = jax.lax.complex(
-            space_re[0].astype(self.real_dtype), space_im[0].astype(self.real_dtype)
-        )
-        freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
+        if self.is_r2c:
+            (value_indices,) = rest
+            slab = space_re[0].astype(self.real_dtype)
+            freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
+        else:
+            space_im, value_indices = rest
+            slab = jax.lax.complex(
+                space_re[0].astype(self.real_dtype), space_im[0].astype(self.real_dtype)
+            )
+            freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
 
         # split into x-group columns and send each group home (exchange B rev)
         hpad = jnp.concatenate(
@@ -377,12 +413,10 @@ class Pencil2Execution(PaddingHelpers):
         return self._backward(values_re, values_im, self._value_indices)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
-        return self._forward[ScalingType(scaling)](space_re, space_im, self._value_indices)
+        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
 
     def trace_backward(self, values_re, values_im):
         return self._backward_sm(values_re, values_im, self._value_indices)
 
     def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
-        return self._forward_sm[ScalingType(scaling)](
-            space_re, space_im, self._value_indices
-        )
+        return self._dispatch_forward(self._forward_sm, space_re, space_im, scaling)

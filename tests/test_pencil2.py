@@ -15,7 +15,6 @@ from spfft_tpu import (
     ScalingType,
     TransformType,
 )
-from spfft_tpu.errors import InvalidParameterError
 from spfft_tpu.parameters import distribute_triplets
 from utils import (
     assert_close,
@@ -171,15 +170,58 @@ def test_pencil2_per_shard_layout_and_local_blocks():
         )
 
 
-def test_pencil2_r2c_rejected():
+@pytest.mark.parametrize("p1,p2", [(2, 4), (4, 2)])
+def test_pencil2_r2c(p1, p2):
+    """R2C over the 2-D pencil split: both hermitian completions are
+    shard-local (the (0,0) stick pre-exchange-A; the x=0 plane post-exchange-A
+    on the x-group-0 column, which holds the full y extent)."""
     rng = np.random.default_rng(48)
-    trip = random_sparse_triplets(rng, 8, 8, 8, 0.4, hermitian=True)
-    per_shard = distribute_triplets(trip, 4, 8)
-    with pytest.raises(InvalidParameterError):
-        DistributedTransform(
-            ProcessingUnit.HOST, TransformType.R2C, 8, 8, 8, per_shard,
-            mesh=sp.make_fft_mesh2(2, 2),
-        )
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+    xs = np.arange(dx // 2 + 1)
+    trip = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    per_shard = distribute_triplets(trip, p1 * p2, dy)
+    vps = [freq[t_[:, 2], t_[:, 1], t_[:, 0]] for t_ in per_shard]
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz, per_shard,
+        mesh=sp.make_fft_mesh2(p1, p2),
+    )
+    out = t.backward([v.copy() for v in vps])
+    assert out.dtype == np.float64
+    assert_close(out, r)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r_, vals in enumerate(vps):
+        assert_close(back[r_], vals)
+    # per-shard block accessor in r2c form
+    blk = t.space_domain_data_local(1)
+    lz, zo = t.local_z_length(1), t.local_z_offset(1)
+    ly, yo = t.local_y_length(1), t.local_y_offset(1)
+    np.testing.assert_allclose(blk, r[zo : zo + lz, yo : yo + ly], atol=1e-10)
+
+
+def test_pencil2_r2c_partial_spectrum():
+    """Non-redundant spherical R2C set (redundant x=0 half omitted by the
+    caller; restored by the symmetry kernels)."""
+    rng = np.random.default_rng(52)
+    dims = (10, 8, 6)
+    dx, dy, dz = dims
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+    trip = random_sparse_triplets(rng, dx, dy, dz, 1.0, hermitian=True)
+    # drop the redundant (x=0, y > dy/2) sticks the reference lets callers omit
+    keep = ~((trip[:, 0] == 0) & (trip[:, 1] > dy // 2))
+    trip = trip[keep]
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = [freq[t_[:, 2] % dz, t_[:, 1] % dy, t_[:, 0] % dx] for t_ in per_shard]
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz, per_shard,
+        mesh=sp.make_fft_mesh2(2, 2),
+    )
+    assert_close(t.backward(vps), r)
 
 
 def test_pencil2_mesh_size_mismatch_rejected():
