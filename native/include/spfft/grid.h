@@ -32,6 +32,17 @@ SpfftError spfft_grid_create_distributed(SpfftGrid* grid, int maxDimX, int maxDi
                                          SpfftProcessingUnitType processingUnit,
                                          int maxNumThreads);
 
+/* 2-D pencil mesh (p1 x p2 shards; z-slabs x y-slabs in space — lifts the
+ * slab decomposition's P <= dimZ cap). Transforms created from this grid use
+ * the same spfft_dist_transform_* surface; per-shard space blocks are
+ * (local_z_length, local_y_length, dimX). */
+SpfftError spfft_grid_create_distributed2(SpfftGrid* grid, int maxDimX, int maxDimY,
+                                          int maxDimZ, int maxNumLocalZColumns,
+                                          int maxLocalZLength, int p1, int p2,
+                                          SpfftExchangeType exchangeType,
+                                          SpfftProcessingUnitType processingUnit,
+                                          int maxNumThreads);
+
 SpfftError spfft_grid_destroy(SpfftGrid grid);
 
 SpfftError spfft_grid_max_dim_x(SpfftGrid grid, int* dimX);

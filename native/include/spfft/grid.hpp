@@ -37,6 +37,12 @@ public:
        int max_local_z_length, int num_shards, SpfftExchangeType exchange_type,
        SpfftProcessingUnitType processing_unit, int max_num_threads);
 
+  /* 2-D pencil mesh (p1 x p2): z-slabs x y-slabs in space; lifts the slab
+   * decomposition's P <= dimZ cap to dimZ * dimY shards. */
+  Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+       int max_local_z_length, int p1, int p2, SpfftExchangeType exchange_type,
+       SpfftProcessingUnitType processing_unit, int max_num_threads);
+
   /* Copy creates independent capacity (reference copy ctor allocates fresh
    * buffers, grid.hpp "copy = fresh buffers"). */
   Grid(const Grid&);

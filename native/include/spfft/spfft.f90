@@ -123,6 +123,16 @@ module spfft
       integer(c_int), intent(out) :: numShards
     end function
 
+    integer(c_int) function spfft_grid_create_distributed2(grid, maxDimX, maxDimY, &
+        maxDimZ, maxNumLocalZColumns, maxLocalZLength, p1, p2, exchangeType, &
+        processingUnit, maxNumThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: grid
+      integer(c_int), value :: maxDimX, maxDimY, maxDimZ
+      integer(c_int), value :: maxNumLocalZColumns, maxLocalZLength, p1, p2
+      integer(c_int), value :: exchangeType, processingUnit, maxNumThreads
+    end function
+
     ! ---- transform (double) -------------------------------------------------
 
     integer(c_int) function spfft_transform_create_independent(transform, &
@@ -423,6 +433,22 @@ module spfft
     end function
 
     integer(c_int) function spfft_dist_transform_local_z_offset(transform, shard, &
+        offset) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: offset
+    end function
+
+    integer(c_int) function spfft_dist_transform_local_y_length(transform, shard, &
+        localYLength) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: localYLength
+    end function
+
+    integer(c_int) function spfft_dist_transform_local_y_offset(transform, shard, &
         offset) bind(C)
       use iso_c_binding
       type(c_ptr), value :: transform

@@ -162,10 +162,16 @@ SpfftError spfft_dist_transform_exchange_type(SpfftDistTransform transform,
                                               SpfftExchangeType* exchangeType);
 SpfftError spfft_dist_transform_exchange_wire_bytes(SpfftDistTransform transform,
                                                     long long int* wireBytes);
-/* per-shard layout (the reference's per-rank accessors) */
+/* per-shard layout (the reference's per-rank accessors). On 2-D pencil grids
+ * the space block is (local_z_length, local_y_length, dimX); on 1-D grids
+ * local_y_length == dimY and local_y_offset == 0. */
 SpfftError spfft_dist_transform_local_z_length(SpfftDistTransform transform, int shard,
                                                int* localZLength);
 SpfftError spfft_dist_transform_local_z_offset(SpfftDistTransform transform, int shard,
+                                               int* offset);
+SpfftError spfft_dist_transform_local_y_length(SpfftDistTransform transform, int shard,
+                                               int* localYLength);
+SpfftError spfft_dist_transform_local_y_offset(SpfftDistTransform transform, int shard,
                                                int* offset);
 SpfftError spfft_dist_transform_num_local_elements(SpfftDistTransform transform,
                                                    int shard, int* numLocalElements);

@@ -164,9 +164,13 @@ public:
   long long exchange_wire_bytes() const;
   bool double_precision() const;
 
-  /* Per-shard layout (the reference's per-rank accessors). */
+  /* Per-shard layout (the reference's per-rank accessors). On 2-D pencil
+   * grids the space block is (local_z_length, local_y_length, dim_x); on 1-D
+   * grids local_y_length == dim_y and local_y_offset == 0. */
   int local_z_length(int shard) const;
   int local_z_offset(int shard) const;
+  int local_y_length(int shard) const;
+  int local_y_offset(int shard) const;
   long long local_slice_size(int shard) const;
   long long num_local_elements(int shard) const;
 

@@ -74,6 +74,20 @@ SpfftError spfft_grid_create_distributed(SpfftGrid* grid, int maxDimX, int maxDi
   });
 }
 
+SpfftError spfft_grid_create_distributed2(SpfftGrid* grid, int maxDimX, int maxDimY,
+                                          int maxDimZ, int maxNumLocalZColumns,
+                                          int maxLocalZLength, int p1, int p2,
+                                          SpfftExchangeType exchangeType,
+                                          SpfftProcessingUnitType processingUnit,
+                                          int maxNumThreads) {
+  if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    *grid = new spfft::Grid(maxDimX, maxDimY, maxDimZ, maxNumLocalZColumns,
+                            maxLocalZLength, p1, p2, exchangeType, processingUnit,
+                            maxNumThreads);
+  });
+}
+
 SpfftError spfft_grid_destroy(SpfftGrid grid) {
   if (grid == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
   return guarded([&] { delete as_grid(grid); });
@@ -435,6 +449,8 @@ SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_wire_bytes, long long int,
 
 SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_z_length, int, local_z_length)
 SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_z_offset, int, local_z_offset)
+SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_y_length, int, local_y_length)
+SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_local_y_offset, int, local_y_offset)
 SPFFT_TPU_DIST_SHARD_GETTER(spfft_dist_transform_num_local_elements, int,
                             num_local_elements)
 

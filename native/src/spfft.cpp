@@ -91,6 +91,7 @@ struct DistPlan {
     long long global_size = 0, wire_bytes = 0;
   } meta;
   std::vector<long long> shard_elems, shard_zlen, shard_zoff, shard_slice;
+  std::vector<long long> shard_ylen, shard_yoff;
 
   std::size_t elem_bytes() const { return dbl ? sizeof(double) : sizeof(float); }
 
@@ -281,6 +282,8 @@ std::shared_ptr<DistPlan> make_dist_plan(const Grid& grid, bool double_precision
     plan->shard_elems.push_back(plan->get_shard("num_local_elements", r));
     plan->shard_zlen.push_back(plan->get_shard("local_z_length", r));
     plan->shard_zoff.push_back(plan->get_shard("local_z_offset", r));
+    plan->shard_ylen.push_back(plan->get_shard("local_y_length", r));
+    plan->shard_yoff.push_back(plan->get_shard("local_y_offset", r));
     plan->shard_slice.push_back(plan->get_shard("local_slice_size", r));
   }
   bool r2c = m.transform_type == SPFFT_TRANS_R2C;
@@ -317,6 +320,19 @@ Grid::Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_colu
                     static_cast<int>(exchange_type), max_num_threads));
 }
 
+Grid::Grid(int max_dim_x, int max_dim_y, int max_dim_z, int max_num_local_z_columns,
+           int max_local_z_length, int p1, int p2, SpfftExchangeType exchange_type,
+           SpfftProcessingUnitType processing_unit, int max_num_threads)
+    : state_(std::make_shared<detail::GridState>()) {
+  bridge::Gil gil;
+  state_->py = bridge::call(
+      "grid_create_distributed2",
+      Py_BuildValue("(iiiiiiiiii)", max_dim_x, max_dim_y, max_dim_z,
+                    max_num_local_z_columns, max_local_z_length, p1, p2,
+                    static_cast<int>(processing_unit),
+                    static_cast<int>(exchange_type), max_num_threads));
+}
+
 Grid::Grid(const Grid& other) : state_(std::make_shared<detail::GridState>()) {
   /* Fresh capacity: re-create from the other grid's parameters (the XLA
    * backend holds no shared host buffers, so metadata equality suffices —
@@ -325,14 +341,26 @@ Grid::Grid(const Grid& other) : state_(std::make_shared<detail::GridState>()) {
   /* mesh presence, not shard count: a 1-shard distributed grid must copy to a
    * distributed grid (the dist1 pipeline configs in BASELINE.md rely on it) */
   if (detail::grid_attr(detail::grid_state(other), "has_mesh") != 0) {
+    const int p1 =
+        static_cast<int>(detail::grid_attr(detail::grid_state(other), "mesh_p1"));
+    const int exch = static_cast<int>(
+        detail::grid_attr(detail::grid_state(other), "exchange_type"));
+    if (p1 > 0) {
+      state_->py = bridge::call(
+          "grid_create_distributed2",
+          Py_BuildValue("(iiiiiiiiii)", other.max_dim_x(), other.max_dim_y(),
+                        other.max_dim_z(), other.max_num_local_z_columns(),
+                        other.max_local_z_length(), p1, other.num_shards() / p1,
+                        static_cast<int>(other.processing_unit()), exch,
+                        other.max_num_threads()));
+      return;
+    }
     state_->py = bridge::call(
         "grid_create_distributed",
         Py_BuildValue("(iiiiiiiii)", other.max_dim_x(), other.max_dim_y(),
                       other.max_dim_z(), other.max_num_local_z_columns(),
                       other.max_local_z_length(), other.num_shards(),
-                      static_cast<int>(other.processing_unit()),
-                      static_cast<int>(detail::grid_attr(
-                          detail::grid_state(other), "exchange_type")),
+                      static_cast<int>(other.processing_unit()), exch,
                       other.max_num_threads()));
     return;
   }
@@ -666,6 +694,14 @@ int DistributedTransform::local_z_length(int shard) const {
 int DistributedTransform::local_z_offset(int shard) const {
   plan_->check_shard(shard);
   return static_cast<int>(plan_->shard_zoff[shard]);
+}
+int DistributedTransform::local_y_length(int shard) const {
+  plan_->check_shard(shard);
+  return static_cast<int>(plan_->shard_ylen[shard]);
+}
+int DistributedTransform::local_y_offset(int shard) const {
+  plan_->check_shard(shard);
+  return static_cast<int>(plan_->shard_yoff[shard]);
 }
 long long DistributedTransform::local_slice_size(int shard) const {
   plan_->check_shard(shard);

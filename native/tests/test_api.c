@@ -259,6 +259,34 @@ int main(void) {
     REQUIRE(spfft_dist_transform_local_z_length(dt, shards, &got2) ==
             SPFFT_INVALID_PARAMETER_ERROR);
 
+    /* 2-D pencil mesh grid (2x2) over the same 4 devices: same dist API */
+    {
+      SpfftGrid pgrid = NULL;
+      SpfftDistTransform pt = NULL;
+      CHECK(spfft_grid_create_distributed2(&pgrid, dim, dim, dim, dim * dim, dim, 2,
+                                           2, SPFFT_EXCH_DEFAULT, SPFFT_PU_HOST, 1));
+      CHECK(spfft_dist_transform_create(&pt, pgrid, SPFFT_PU_HOST, SPFFT_TRANS_C2C,
+                                        dim, dim, dim, shards, counts,
+                                        SPFFT_INDEX_TRIPLETS, didx, 1));
+      CHECK(spfft_dist_transform_local_y_length(pt, 0, &got2));
+      REQUIRE(got2 == dim / 2); /* y split over the first mesh axis */
+      CHECK(spfft_dist_transform_local_z_length(pt, 0, &got2));
+      REQUIRE(got2 == dim / 2);
+      CHECK(spfft_dist_transform_backward(pt, dfreq, dspace));
+      CHECK(spfft_dist_transform_forward(pt, dspace, dback, SPFFT_FULL_SCALING));
+      {
+        double max_err = 0.0;
+        for (i = 0; i < 2 * n; ++i) {
+          double d = fabs(dback[i] - dfreq[i]);
+          if (d > max_err) max_err = d;
+        }
+        printf("pencil2 roundtrip max err: %g\n", max_err);
+        REQUIRE(max_err < 1e-6);
+      }
+      CHECK(spfft_dist_transform_destroy(pt));
+      CHECK(spfft_grid_destroy(pgrid));
+    }
+
     CHECK(spfft_dist_transform_destroy(dt));
     CHECK(spfft_grid_destroy(dgrid));
     free(didx);

@@ -103,6 +103,10 @@ def main(argv=None):
     ap.add_argument("-p", choices=["cpu", "gpu", "gpu-gpu"], required=True)
     ap.add_argument("--shards", type=int, default=1, help="mesh size (1 = local)")
     ap.add_argument(
+        "--mesh2", nargs=2, type=int, default=None, metavar=("P1", "P2"),
+        help="2-D pencil mesh factors (selects the pencil engine; overrides --shards)",
+    )
+    ap.add_argument(
         "--precision", choices=["single", "double"], default=None,
         help="default: double on cpu, single on accelerators",
     )
@@ -121,6 +125,11 @@ def main(argv=None):
         help="MXU engine matmul precision (high trades ~1e-5 accuracy for speed)",
     )
     args = ap.parse_args(argv)
+    if args.mesh2 is not None:
+        p1, p2 = args.mesh2
+        if p1 < 1 or p2 < 1 or p1 * p2 < 2:
+            ap.error("--mesh2 factors must be >= 1 with product >= 2")
+        args.shards = p1 * p2
 
     import os
 
@@ -149,7 +158,16 @@ def main(argv=None):
     pu = ProcessingUnit.HOST if args.p == "cpu" else ProcessingUnit.GPU
     # "-e all" sweeps every exchange variant over the same plan geometry, like the
     # reference benchmark; local runs have no exchange so it degenerates to one run.
-    if args.shards > 1:
+    if args.mesh2 is not None:
+        # the pencil engine implements the padded BUFFERED discipline only
+        pencil_ok = {"buffered", "bufferedFloat", "bufferedBF16"}
+        if args.e == "all":
+            exchange_sweep = sorted(pencil_ok)
+        elif args.e in pencil_ok:
+            exchange_sweep = [args.e]
+        else:
+            ap.error(f"--mesh2 supports only {sorted(pencil_ok)} for -e")
+    elif args.shards > 1:
         exchange_sweep = sorted(EXCHANGE_NAMES) if args.e == "all" else [args.e]
     else:
         exchange_sweep = [args.e if args.e != "all" else "buffered"]
@@ -181,7 +199,10 @@ def main(argv=None):
                 mesh_devices = (
                     jax.devices("cpu")[: args.shards] if args.p == "cpu" else None
                 )
-                mesh = sp.make_fft_mesh(args.shards, devices=mesh_devices)
+                if args.mesh2 is not None:
+                    mesh = sp.make_fft_mesh2(*args.mesh2, devices=mesh_devices)
+                else:
+                    mesh = sp.make_fft_mesh(args.shards, devices=mesh_devices)
                 if args.model == "spherical":
                     # variable-length sticks: balanced whole-stick partition
                     per_shard = sp.distribute_triplets(triplets, args.shards, dim_y)
@@ -321,6 +342,7 @@ def main(argv=None):
             "num_transforms": args.m,
             "repeats": args.r,
             "shards": args.shards,
+            "mesh2": args.mesh2,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
         },

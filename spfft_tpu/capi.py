@@ -34,6 +34,7 @@ __all__ = [
     "error_code",
     "grid_create",
     "grid_create_distributed",
+    "grid_create_distributed2",
     "grid_get",
     "transform_create",
     "transform_create_from_grid",
@@ -189,6 +190,37 @@ def transform_clone(t: Transform) -> Transform:
 # C caller passes per-shard counts and shard-major concatenated data.
 
 
+def _make_dist_grid(
+    mesh_factory,
+    num_devices: int,
+    max_dim_x: int,
+    max_dim_y: int,
+    max_dim_z: int,
+    max_num_local_z_columns: int,
+    max_local_z_length: int,
+    processing_unit: int,
+    exchange_type: int,
+    max_num_threads: int,
+) -> Grid:
+    """Shared distributed-grid construction; ``mesh_factory(devices)`` builds
+    the mesh (1-D or 2-D pencil)."""
+    import jax
+
+    pu = ProcessingUnit(processing_unit)
+    devices = jax.devices("cpu")[:num_devices] if pu == ProcessingUnit.HOST else None
+    return Grid(
+        max_dim_x,
+        max_dim_y,
+        max_dim_z,
+        max_num_local_z_columns,
+        pu,
+        max_num_threads,
+        max_local_z_length=max_local_z_length if max_local_z_length > 0 else None,
+        mesh=mesh_factory(devices),
+        exchange_type=ExchangeType(exchange_type),
+    )
+
+
 def grid_create_distributed(
     max_dim_x: int,
     max_dim_y: int,
@@ -200,25 +232,49 @@ def grid_create_distributed(
     exchange_type: int,
     max_num_threads: int,
 ) -> Grid:
-    import jax
-
     from .parallel.mesh import make_fft_mesh
 
-    pu = ProcessingUnit(processing_unit)
-    devices = (
-        jax.devices("cpu")[:num_shards] if pu == ProcessingUnit.HOST else None
-    )
-    mesh = make_fft_mesh(num_shards, devices=devices)
-    return Grid(
+    return _make_dist_grid(
+        lambda devices: make_fft_mesh(num_shards, devices=devices),
+        num_shards,
         max_dim_x,
         max_dim_y,
         max_dim_z,
         max_num_local_z_columns,
-        pu,
+        max_local_z_length,
+        processing_unit,
+        exchange_type,
         max_num_threads,
-        max_local_z_length=max_local_z_length if max_local_z_length > 0 else None,
-        mesh=mesh,
-        exchange_type=ExchangeType(exchange_type),
+    )
+
+
+def grid_create_distributed2(
+    max_dim_x: int,
+    max_dim_y: int,
+    max_dim_z: int,
+    max_num_local_z_columns: int,
+    max_local_z_length: int,
+    p1: int,
+    p2: int,
+    processing_unit: int,
+    exchange_type: int,
+    max_num_threads: int,
+) -> Grid:
+    """2-D pencil mesh grid (parallel/pencil2.py): transforms created from it
+    use the z-slabs x y-slabs decomposition; same dist_* execution surface."""
+    from .parallel.mesh import make_fft_mesh2
+
+    return _make_dist_grid(
+        lambda devices: make_fft_mesh2(p1, p2, devices=devices),
+        p1 * p2,
+        max_dim_x,
+        max_dim_y,
+        max_dim_z,
+        max_num_local_z_columns,
+        max_local_z_length,
+        processing_unit,
+        exchange_type,
+        max_num_threads,
     )
 
 
@@ -286,6 +342,12 @@ _GRID_GETTERS = {
     "device_id": lambda g: 0,
     "num_shards": lambda g: g.num_shards,
     "has_mesh": lambda g: int(g.mesh is not None),
+    # p1 of a 2-D pencil mesh, 0 for local/1-D grids (drives copy fidelity)
+    "mesh_p1": lambda g: (
+        int(g.mesh.shape["fft"])
+        if g.mesh is not None and "fft2" in g.mesh.axis_names
+        else 0
+    ),
     "exchange_type": lambda g: int(g.exchange_type),
 }
 
@@ -393,6 +455,8 @@ _DIST_GETTERS = {
 _DIST_SHARD_GETTERS = {
     "local_z_length": lambda t, r: t.local_z_length(r),
     "local_z_offset": lambda t, r: t.local_z_offset(r),
+    "local_y_length": lambda t, r: t.local_y_length(r),
+    "local_y_offset": lambda t, r: t.local_y_offset(r),
     "local_slice_size": lambda t, r: t.local_slice_size(r),
     "num_local_elements": lambda t, r: t.num_local_elements(r),
 }

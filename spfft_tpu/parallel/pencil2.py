@@ -41,6 +41,7 @@ from ..ops import symmetry
 from ..types import (
     BF16_EXCHANGES as _BF16,
     FLOAT_EXCHANGES as _FLOAT,
+    RAGGED_EXCHANGES as _RAGGED,
     ExchangeType,
     ScalingType,
     TransformType,
@@ -66,6 +67,14 @@ class Pencil2Execution(PaddingHelpers):
         self.real_dtype = np.dtype(real_dtype)
         self.complex_dtype = _complex_dtype(real_dtype)
         self.exchange_type = ExchangeType(exchange_type)
+        if self.exchange_type in _RAGGED:
+            # Refuse rather than silently run padded: a caller comparing
+            # COMPACT vs BUFFERED must not time identical code under two names.
+            raise InvalidParameterError(
+                "the 2-D pencil engine implements the padded BUFFERED discipline "
+                "only; exact-counts COMPACT/UNBUFFERED exchanges are 1-D mesh "
+                "features (use BUFFERED or its *_FLOAT/*_BF16 wire variants)"
+            )
         self._ragged = None  # padded discipline on both exchanges
         p = params
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))

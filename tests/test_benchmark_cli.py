@@ -73,3 +73,10 @@ def test_cli_distributed(tmp_path):
     r = _run_cli(tmp_path, ["-p", "gpu", "--shards", "4", "-e", "bufferedFloat"])
     assert r["parameters"]["shards"] == 4
     assert r["parameters"]["exchange"] == "bufferedFloat"
+
+
+def test_cli_pencil2(tmp_path):
+    r = _run_cli(tmp_path, ["-p", "gpu", "--mesh2", "2", "2"])
+    assert r["parameters"]["mesh2"] == [2, 2]
+    assert r["parameters"]["shards"] == 4
+    assert r["results"]["exchange_wire_bytes"] > 0

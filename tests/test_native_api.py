@@ -49,3 +49,14 @@ def test_native_c_api_roundtrip():
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "ALL NATIVE TESTS PASSED" in result.stdout
+
+    # C++-surface test: Grid copy fidelity (local / 1-D / pencil meshes)
+    result = subprocess.run(
+        [str(BUILD / "run_native_tests_cpp")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "ALL NATIVE C++ TESTS PASSED" in result.stdout

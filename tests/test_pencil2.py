@@ -224,6 +224,17 @@ def test_pencil2_r2c_partial_spectrum():
     assert_close(t.backward(vps), r)
 
 
+def test_pencil2_exact_counts_exchange_rejected():
+    """COMPACT/UNBUFFERED must not silently run as padded under another name."""
+    from spfft_tpu.errors import InvalidParameterError
+
+    rng = np.random.default_rng(53)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.4)
+    per_shard = distribute_triplets(trip, 4, 8)
+    with pytest.raises(InvalidParameterError):
+        build(2, 2, (8, 8, 8), per_shard, exchange=ExchangeType.COMPACT_BUFFERED)
+
+
 def test_pencil2_mesh_size_mismatch_rejected():
     rng = np.random.default_rng(49)
     trip = random_sparse_triplets(rng, 8, 8, 8, 0.4)
