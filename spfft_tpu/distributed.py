@@ -101,36 +101,42 @@ class DistributedTransform:
         resolve_precision(precision)  # validate up front on every engine path
 
         # Engine selection mirrors the local Transform: the MXU engine (matmul
-        # DFT stages + lane-copy value plans, parallel/execution_mxu.py) wins on
-        # accelerator meshes; the XLA engine (jnp.fft + scatter) wins on CPU
-        # meshes where pocketfft is the fast path. Selected by the platform the
-        # MESH lives on, not the process default backend.
-        if pencil2:
-            # 2-D pencil decomposition (parallel/pencil2.py): its own engine;
-            # the engine= knob selects between the 1-D engines only.
-            from .parallel.pencil2 import Pencil2Execution
-
-            self._exec = Pencil2Execution(
-                self._params, self._real_dtype, mesh, exchange_type
-            )
-            self._engine = "pencil2"
-            self._space_data = None
-            return
+        # DFT stages + lane-copy value plans) wins on accelerator meshes; the
+        # XLA engine (jnp.fft + scatter) wins on CPU meshes where pocketfft is
+        # the fast path. Selected by the platform the MESH lives on, not the
+        # process default backend. The decomposition (1-D slab vs 2-D pencil)
+        # comes from the mesh shape; the engine knob picks the compute path.
         if engine == "auto":
             engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
-        if engine == "mxu":
+        if engine not in ("xla", "mxu"):
+            raise InvalidParameterError(f"unknown engine {engine!r}")
+        if pencil2:
+            if engine == "mxu":
+                from .parallel.pencil2_mxu import MxuPencil2Execution
+
+                self._exec = MxuPencil2Execution(
+                    self._params, self._real_dtype, mesh, exchange_type, precision
+                )
+                self._engine = "pencil2-mxu"
+            else:
+                from .parallel.pencil2 import Pencil2Execution
+
+                self._exec = Pencil2Execution(
+                    self._params, self._real_dtype, mesh, exchange_type
+                )
+                self._engine = "pencil2"
+        elif engine == "mxu":
             from .parallel.execution_mxu import MxuDistributedExecution
 
             self._exec = MxuDistributedExecution(
                 self._params, self._real_dtype, mesh, exchange_type, precision
             )
-        elif engine == "xla":
+            self._engine = engine
+        else:
             self._exec = DistributedExecution(
                 self._params, self._real_dtype, mesh, exchange_type
             )
-        else:
-            raise InvalidParameterError(f"unknown engine {engine!r}")
-        self._engine = engine
+            self._engine = engine
         self._space_data = None
 
     # ---- transforms -----------------------------------------------------------

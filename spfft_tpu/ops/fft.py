@@ -146,13 +146,18 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
     Backward maps the ``num_rows``-padded active x-frequency extent to the full
     ``dim_x`` space extent ((A, X), zero rows on padding slots); forward is the
     transposed selection ((X, A)). For R2C the pairs are the real c2r/r2c
-    matrices restricted the same way.
+    matrices restricted the same way. ``ux`` entries may be -1 (interior
+    padding slots — the 2-D pencil engines' slot layout interleaves them);
+    those produce zero rows, folding the slot->x scatter into the matmul.
     """
     ux = np.asarray(ux, dtype=np.int64)
     rt = real_dtype
 
     def pad_rows(m):
-        return np.vstack([m[ux], np.zeros((num_rows - ux.size, m.shape[1]), m.dtype)])
+        out = np.zeros((num_rows, m.shape[1]), m.dtype)
+        valid = np.flatnonzero(ux >= 0)
+        out[valid] = m[ux[valid]]
+        return out
 
     if r2c:
         a, b = c2r_matrices(dim_x)  # (Xf, X)

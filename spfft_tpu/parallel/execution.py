@@ -106,6 +106,11 @@ class PaddingHelpers:
             return fn(space_re, self._value_indices)
         return fn(space_re, space_im, self._value_indices)
 
+    def _wire_scalar_bytes(self) -> int:
+        from ..types import wire_scalar_bytes
+
+        return wire_scalar_bytes(self.exchange_type, self.real_dtype)
+
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes one slab<->pencil repartition puts on the
         interconnect (self-blocks excluded for both disciplines; per direction
@@ -352,13 +357,6 @@ class DistributedExecution(PaddingHelpers):
         return self.params.transform_type == TransformType.R2C
 
     # ---- wire-format casts (float exchange) -----------------------------------
-
-    def _wire_scalar_bytes(self) -> int:
-        if self.exchange_type in _BF16_EXCHANGES:
-            return 2
-        if self.exchange_type in _FLOAT_EXCHANGES and self.complex_dtype == np.complex128:
-            return 4
-        return np.dtype(self.complex_dtype).itemsize // 2
 
     def _to_wire(self, buf):
         if self.exchange_type in _FLOAT_EXCHANGES and self.complex_dtype == np.complex128:

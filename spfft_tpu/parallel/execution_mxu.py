@@ -67,7 +67,110 @@ def _complex_dtype(real_dtype):
     )
 
 
-class MxuDistributedExecution(PaddingHelpers):
+class MxuValuePlans:
+    """Shared MXU-engine machinery: per-shard value copy-plan branches (deduped
+    lax.switch), wire-format selection, and the stacked-pair exchange. Used by
+    the 1-D MXU mesh engine and the 2-D pencil MXU engine. Requires ``params``,
+    ``real_dtype``, ``exchange_type``, ``_S`` and ``_V`` on the inheriting
+    class."""
+
+    def _build_value_branches(self):
+        """Hash each shard's local value layout; shards with identical layouts
+        share one switch branch (compile size = layout diversity, not P)."""
+        p = self.params
+        unique_plans = {}
+        branch_of_shard = np.zeros(max(1, p.num_shards), dtype=np.int32)
+        self._decompress_branches = []
+        self._compress_branches = []
+        for r in range(p.num_shards):
+            n = int(p.num_values_per_shard[r])
+            vi = np.asarray(p.value_indices[r, :n], dtype=np.int64)
+            key = (n, vi.tobytes())
+            if key not in unique_plans:
+                unique_plans[key] = len(self._decompress_branches)
+                self._decompress_branches.append(self._make_decompress(vi, n))
+                self._compress_branches.append(self._make_compress(vi, n))
+            branch_of_shard[r] = unique_plans[key]
+        self._branch_of_shard = branch_of_shard
+
+    def _make_decompress(self, vi: np.ndarray, n: int):
+        """Branch: (V_max,) pair -> (S, Z) pair sticks for one shard."""
+        S, Z = self._S, self.params.dim_z
+        plan = lanecopy.build_decompress_plan(vi, S * Z, n) if n else None
+
+        if plan is not None:
+            def branch(vre, vim, plan=plan, n=n):
+                sre = plan.apply(vre[:n]).reshape(-1)[: S * Z].reshape(S, Z)
+                sim = plan.apply(vim[:n]).reshape(-1)[: S * Z].reshape(S, Z)
+                return sre, sim
+
+            return branch
+
+        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
+
+        def branch_scatter(vre, vim, idx=idx, n=n):
+            out = []
+            for v in (vre, vim):
+                flat = jnp.zeros(S * Z, dtype=v.dtype).at[idx].set(
+                    v[:n], mode="drop", unique_indices=True
+                )
+                out.append(flat.reshape(S, Z))
+            return tuple(out)
+
+        return branch_scatter
+
+    def _make_compress(self, vi: np.ndarray, n: int):
+        """Branch: (S, Z) pair sticks -> (V_max,) pair packed values."""
+        S, Z, V = self._S, self.params.dim_z, self._V
+        plan = lanecopy.build_compress_plan(vi, S * Z) if n else None
+
+        if n == 0:
+            def branch_empty(sre, sim):
+                z = jnp.zeros(V, dtype=sre.dtype)
+                return z, z
+
+            return branch_empty
+
+        if plan is not None:
+            def branch(sre, sim, plan=plan, n=n):
+                vre = plan.apply(sre.reshape(-1)).reshape(-1)[:n]
+                vim = plan.apply(sim.reshape(-1)).reshape(-1)[:n]
+                pad = (0, V - n)
+                return jnp.pad(vre, pad), jnp.pad(vim, pad)
+
+            return branch
+
+        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
+
+        def branch_gather(sre, sim, idx=idx, n=n):
+            pad = (0, V - n)
+            return (
+                jnp.pad(sre.reshape(-1)[idx], pad),
+                jnp.pad(sim.reshape(-1)[idx], pad),
+            )
+
+        return branch_gather
+
+    def _wire_dtype(self):
+        # the single-sourced wire rule (types.wire_dtype): *_FLOAT halves the
+        # f64 wire like the reference's float exchange, *_BF16 is the explicit
+        # bf16 opt-in; the (re, im)-stacked exchange buffer is already real,
+        # so it is a pure wire-dtype swap here.
+        from ..types import wire_dtype
+
+        return wire_dtype(self.exchange_type, self.real_dtype)
+
+    def _exchange_pair(self, bre, bim, axes):
+        """(re, im) blocks -> all_to_all over ``axes``, one collective on a
+        (P, 2, ...) stacked buffer in the wire dtype."""
+        wd = self._wire_dtype()
+        buf = jnp.stack([bre.astype(wd), bim.astype(wd)], axis=1)
+        recv = jax.lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
+        recv = recv.astype(self.real_dtype)
+        return recv[:, 0], recv[:, 1]
+
+
+class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
     """Compiled distributed MXU pipelines for one transform plan over one mesh.
 
     Boundary-compatible with DistributedExecution: ``pad_values`` /
@@ -172,26 +275,8 @@ class MxuDistributedExecution(PaddingHelpers):
         else:
             self._ragged_wire = None
 
-        # ---- per-shard value copy plans (lax.switch branches) ----
-        # Shards with identical local value layouts (same packed order into the
-        # same (S, Z) slots — common in symmetric DFT workloads) share ONE
-        # switch branch: the program embeds unique plans only, and a static
-        # shard -> branch table indexes the switch. Keeps compile size bounded
-        # by layout diversity, not shard count.
-        unique_plans = {}
-        branch_of_shard = np.zeros(max(1, p.num_shards), dtype=np.int32)
-        self._decompress_branches = []
-        self._compress_branches = []
-        for r in range(p.num_shards):
-            n = int(p.num_values_per_shard[r])
-            vi = np.asarray(p.value_indices[r, :n], dtype=np.int64)
-            key = (n, vi.tobytes())
-            if key not in unique_plans:
-                unique_plans[key] = len(self._decompress_branches)
-                self._decompress_branches.append(self._make_decompress(vi, n))
-                self._compress_branches.append(self._make_compress(vi, n))
-            branch_of_shard[r] = unique_plans[key]
-        self._branch_of_shard = branch_of_shard
+        # ---- per-shard value copy plans (deduped lax.switch branches) ----
+        self._build_value_branches()
 
         # ---- sharded constants + compiled pipelines ----
         self.value_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
@@ -220,92 +305,11 @@ class MxuDistributedExecution(PaddingHelpers):
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
-    # ---- per-shard value branches ---------------------------------------------
-
-    def _make_decompress(self, vi: np.ndarray, n: int):
-        """Branch: (V_max,) pair -> (S, Z) pair sticks for one shard."""
-        S, Z = self._S, self.params.dim_z
-        plan = lanecopy.build_decompress_plan(vi, S * Z, n) if n else None
-
-        if plan is not None:
-            def branch(vre, vim, plan=plan, n=n):
-                sre = plan.apply(vre[:n]).reshape(-1)[: S * Z].reshape(S, Z)
-                sim = plan.apply(vim[:n]).reshape(-1)[: S * Z].reshape(S, Z)
-                return sre, sim
-
-            return branch
-
-        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
-
-        def branch_scatter(vre, vim, idx=idx, n=n):
-            out = []
-            for v in (vre, vim):
-                flat = jnp.zeros(S * Z, dtype=v.dtype).at[idx].set(
-                    v[:n], mode="drop", unique_indices=True
-                )
-                out.append(flat.reshape(S, Z))
-            return tuple(out)
-
-        return branch_scatter
-
-    def _make_compress(self, vi: np.ndarray, n: int):
-        """Branch: (S, Z) pair sticks -> (V_max,) pair packed values."""
-        S, Z, V = self._S, self.params.dim_z, self._V
-        plan = lanecopy.build_compress_plan(vi, S * Z) if n else None
-
-        if n == 0:
-            def branch_empty(sre, sim):
-                z = jnp.zeros(V, dtype=sre.dtype)
-                return z, z
-
-            return branch_empty
-
-        if plan is not None:
-            def branch(sre, sim, plan=plan, n=n):
-                vre = plan.apply(sre.reshape(-1)).reshape(-1)[:n]
-                vim = plan.apply(sim.reshape(-1)).reshape(-1)[:n]
-                pad = (0, V - n)
-                return jnp.pad(vre, pad), jnp.pad(vim, pad)
-
-            return branch
-
-        idx = jnp.asarray(np.asarray(vi, dtype=np.int32))
-
-        def branch_gather(sre, sim, idx=idx, n=n):
-            pad = (0, V - n)
-            return (
-                jnp.pad(sre.reshape(-1)[idx], pad),
-                jnp.pad(sim.reshape(-1)[idx], pad),
-            )
-
-        return branch_gather
-
-    # ---- wire format ----------------------------------------------------------
-
-    def _wire_dtype(self):
-        # *_FLOAT halves the f64 wire exactly like the reference's float
-        # exchange (reference: include/spfft/types.h:41-47); f32 data is left
-        # untouched, matching the XLA engine — a bf16 wire would silently drop
-        # below the 1e-6 parity bar and is not offered implicitly. *_BF16 is
-        # that bf16 wire as an explicit opt-in (TPU extension, types.py): the
-        # (re, im)-stacked exchange buffer is already real, so it is a pure
-        # wire-dtype swap here.
-        if self.exchange_type in _BF16_EXCHANGES:
-            return jnp.bfloat16
-        if self.exchange_type in _FLOAT_EXCHANGES and self.real_dtype == np.float64:
-            return np.dtype(np.float32)
-        return self.real_dtype
-
-    def _wire_scalar_bytes(self) -> int:
-        return int(np.dtype(self._wire_dtype()).itemsize)
+    # ---- wire + exchange (shared machinery in MxuValuePlans) ------------------
 
     def _exchange(self, bre, bim):
         """(P, S, L) pair -> all_to_all over the mesh axis, one collective."""
-        wd = self._wire_dtype()
-        buf = jnp.stack([bre.astype(wd), bim.astype(wd)], axis=1)  # (P, 2, S, L)
-        recv = jax.lax.all_to_all(buf, FFT_AXIS, split_axis=0, concat_axis=0, tiled=True)
-        recv = recv.astype(self.real_dtype)
-        return recv[:, 0], recv[:, 1]
+        return self._exchange_pair(bre, bim, FFT_AXIS)
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 

@@ -180,13 +180,6 @@ class Pencil2Execution(PaddingHelpers):
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
-    def _wire_scalar_bytes(self) -> int:
-        if self.exchange_type in _BF16:
-            return 2
-        if self.exchange_type in _FLOAT and self.complex_dtype == np.complex128:
-            return 4
-        return np.dtype(self.complex_dtype).itemsize // 2
-
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes per repartition pair (exchange A + exchange B)."""
         p = self.params

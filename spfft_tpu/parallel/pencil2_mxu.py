@@ -1,0 +1,239 @@
+"""MXU compute path for the 2-D pencil decomposition.
+
+Same geometry, exchanges and boundary contract as
+:class:`spfft_tpu.parallel.pencil2.Pencil2Execution` (which this subclasses),
+with the compute stages engineered like the 1-D MXU engines for TPU hardware:
+
+* every DFT stage is a batched matmul (ops/fft.py) on (re, im) real pairs —
+  4 real matmuls per complex stage, 2 for the R2C/C2R x-stage,
+* the x-stage folds the pencil slot layout INTO the DFT matrix: the
+  ``(group, slot) -> x`` map (with sentinel padding slots as zero rows) rides
+  ``ops/fft.x_stage_matrices``, so the post-exchange-B column scatter and the
+  pre-exchange-B column gather of the XLA engine disappear into the matmul
+  (permutation folding, the designed fusion hook of ops/fft.c2c_matrix),
+* sparse decompress/compress run as per-shard lane-copy plans selected by a
+  deduped ``lax.switch`` (MxuValuePlans — shared with the 1-D MXU engine),
+* both exchanges ride ONE stacked (re, im) all_to_all each, in the plan's
+  wire dtype.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import fft as offt
+from ..ops import symmetry
+from ..types import ExchangeType, ScalingType
+from .execution_mxu import MxuValuePlans
+from .pencil2 import AX1, AX2, Pencil2Execution
+
+
+class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
+    """2-D pencil pipelines with matmul DFT stages and lane-copy value plans."""
+
+    def __init__(
+        self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT,
+        precision="highest",
+    ):
+        self._precision = offt.resolve_precision(precision)
+        super().__init__(params, real_dtype, mesh, exchange_type)
+        p = params
+        rt = self.real_dtype
+        self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
+            p.dim_z, p.dim_y, p.total_size, rt
+        )
+        # x-stage over the (P1 * Ax) slot columns; sentinel slots -> zero rows
+        slot_to_x = self._xcol.astype(np.int64).copy()
+        slot_to_x[slot_to_x >= p.dim_x_freq] = -1
+        self._wx_b, self._wx_f = offt.x_stage_matrices(
+            p.dim_x, slot_to_x, slot_to_x.size, self.is_r2c, rt
+        )
+        self._build_value_branches()
+
+    # ---- pipelines (traced lazily by the base's jit/shard_map wrappers) -------
+
+    def _backward_impl(self, values_re, values_im, value_indices):
+        del value_indices  # lane-copy branches close over their plans
+        p = self.params
+        prec = self._precision
+        rt = self.real_dtype
+        S, Z, Y = self._S, p.dim_z, p.dim_y
+        P1, P2, Ax, Lz, Ly = self.P1, self.P2, self._Ax, self._Lz, self._Ly
+        a_me = jax.lax.axis_index(AX1)
+        b_me = jax.lax.axis_index(AX2)
+        s_me = a_me * P2 + b_me
+        lz_t = jnp.asarray(self._lz.astype(np.int32))
+        zo_t = jnp.asarray(self._zo.astype(np.int32))
+
+        with jax.named_scope("compression"):
+            sre, sim = jax.lax.switch(
+                jnp.asarray(self._branch_of_shard)[s_me],
+                self._decompress_branches,
+                values_re[0].astype(rt),
+                values_im[0].astype(rt),
+            )
+
+        if self.is_r2c and p.zero_stick_shard >= 0:
+            with jax.named_scope("stick symmetry"):
+                i = p.zero_stick_row
+                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+                own = s_me == p.zero_stick_shard
+                sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
+                sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
+
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
+
+        # pack A: my sticks split by destination (x-group, z-slab)
+        with jax.named_scope("pack"):
+            my_rows = jnp.asarray(self._rows)[s_me]
+            j_l = jnp.arange(Lz, dtype=jnp.int32)
+            src = (
+                my_rows[:, None, :, None] * Z
+                + zo_t[None, :, None, None]
+                + j_l[None, None, None, :]
+            )
+            ok = (my_rows[:, None, :, None] < S) & (
+                j_l[None, None, None, :] < lz_t[None, :, None, None]
+            )
+            src = jnp.where(ok, src, S * Z).reshape(P1 * P2, -1, Lz)
+            fre = jnp.concatenate([sre.reshape(-1), jnp.zeros(1, rt)])
+            fim = jnp.concatenate([sim.reshape(-1), jnp.zeros(1, rt)])
+            bre, bim = fre[src], fim[src]
+
+        with jax.named_scope("exchange"):
+            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
+
+        # unpack A -> (Lz, Y, Ax) y-pencil grid
+        with jax.named_scope("unpack"):
+            cols = jnp.asarray(self._cols)[:, a_me, :]
+            lz_me = lz_t[b_me]
+            dest = (
+                jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
+                + cols[:, :, None]
+            )
+            okd = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
+            dest = jnp.where(okd, dest, Lz * (Y * Ax))
+            gre = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rre)
+            gim = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rim)
+            gre = gre[: Lz * Y * Ax].reshape(Lz, Y, Ax)
+            gim = gim[: Lz * Y * Ax].reshape(Lz, Y, Ax)
+
+        if self.is_r2c and self._have_x0:
+            with jax.named_scope("plane symmetry"):
+                pre, pim = symmetry.hermitian_fill_1d_pair(
+                    gre[:, :, 0], gim[:, :, 0], axis=1
+                )
+                gre = gre.at[:, :, 0].set(jnp.where(a_me == 0, pre, gre[:, :, 0]))
+                gim = gim.at[:, :, 0].set(jnp.where(a_me == 0, pim, gim[:, :, 0]))
+
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+
+        # pack B: each destination's y-rows (within my fixed z-slab)
+        with jax.named_scope("pack"):
+            ymap = jnp.asarray(self._ymap)
+            bre = jnp.take(
+                jnp.concatenate([gre, jnp.zeros((Lz, 1, Ax), rt)], axis=1), ymap, axis=1
+            ).reshape(Lz, P1, Ly, Ax).transpose(1, 0, 2, 3)
+            bim = jnp.take(
+                jnp.concatenate([gim, jnp.zeros((Lz, 1, Ax), rt)], axis=1), ymap, axis=1
+            ).reshape(Lz, P1, Ly, Ax).transpose(1, 0, 2, 3)
+
+        with jax.named_scope("exchange"):
+            rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
+
+        # x transform: the slot->x map is folded into the matrix (zero rows on
+        # sentinel slots), so assembly is a pure reshape + matmul
+        with jax.named_scope("x transform"):
+            hre = rbre.transpose(1, 2, 0, 3).reshape(Lz, Ly, P1 * Ax)
+            him = rbim.transpose(1, 2, 0, 3).reshape(Lz, Ly, P1 * Ax)
+            if self.is_r2c:
+                out = offt.real_out_matmul(hre, him, *self._wx_b, "lyc,cx->lyx", prec)
+                return out[None]
+            ore, oim = offt.complex_matmul(hre, him, *self._wx_b, "lyc,cx->lyx", prec)
+            return ore[None], oim[None]
+
+    def _forward_impl(self, space_re, *rest, scale):
+        p = self.params
+        prec = self._precision
+        rt = self.real_dtype
+        S, Z, Y = self._S, p.dim_z, p.dim_y
+        P1, P2, Ax, Lz, Ly = self.P1, self.P2, self._Ax, self._Lz, self._Ly
+        a_me = jax.lax.axis_index(AX1)
+        b_me = jax.lax.axis_index(AX2)
+        s_me = a_me * P2 + b_me
+        lz_t = jnp.asarray(self._lz.astype(np.int32))
+        zo_t = jnp.asarray(self._zo.astype(np.int32))
+        scaling = ScalingType.NONE if scale is None else ScalingType.FULL
+
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                (_,) = rest  # value_indices unused (lane-copy branches)
+                hre, him = offt.real_in_matmul(
+                    space_re[0].astype(rt), *self._wx_f, "lyx,xc->lyc", prec
+                )
+            else:
+                space_im, _ = rest
+                hre, him = offt.complex_matmul(
+                    space_re[0].astype(rt), space_im[0].astype(rt),
+                    *self._wx_f, "lyx,xc->lyc", prec,
+                )
+
+        # exchange B reverse: send each x-group home (within my z-slab)
+        with jax.named_scope("pack"):
+            bre = hre.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
+            bim = him.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
+        with jax.named_scope("exchange"):
+            rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
+
+        # reassemble the full y extent of my x-group
+        with jax.named_scope("unpack"):
+            yinv = jnp.asarray(self._yinv)
+            gre = jnp.take(rbre.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax), yinv, axis=1)
+            gim = jnp.take(rbim.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax), yinv, axis=1)
+
+        with jax.named_scope("y transform"):
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyx,yj->ljx", prec)
+
+        # exchange A reverse: each stick's z-chunk back to its owner
+        with jax.named_scope("pack"):
+            cols = jnp.asarray(self._cols)[:, a_me, :]
+            lz_me = lz_t[b_me]
+            src = (
+                jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
+                + cols[:, :, None]
+            )
+            ok = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
+            src = jnp.where(ok, src, Lz * Y * Ax)
+            fre = jnp.concatenate([gre.reshape(-1), jnp.zeros(1, rt)])
+            fim = jnp.concatenate([gim.reshape(-1), jnp.zeros(1, rt)])
+            bre, bim = fre[src], fim[src]
+        with jax.named_scope("exchange"):
+            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
+
+        with jax.named_scope("unpack"):
+            my_rows = jnp.asarray(self._rows)[s_me].reshape(P1, 1, -1, 1)
+            j_l = jnp.arange(Lz, dtype=jnp.int32)[None, None, None, :]
+            dest = my_rows * Z + zo_t[None, :, None, None] + j_l
+            okd = (my_rows < S) & (j_l < lz_t[None, :, None, None])
+            dest = jnp.where(okd, dest, S * Z)
+            SG = self._SG
+            sre = jnp.zeros(S * Z + 1, rt).at[dest].set(rre.reshape(P1, P2, SG, Lz))
+            sim = jnp.zeros(S * Z + 1, rt).at[dest].set(rim.reshape(P1, P2, SG, Lz))
+            sre = sre[: S * Z].reshape(S, Z)
+            sim = sim[: S * Z].reshape(S, Z)
+
+        with jax.named_scope("z transform"):
+            sre, sim = offt.complex_matmul(
+                sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
+            )
+
+        with jax.named_scope("compression"):
+            vre, vim = jax.lax.switch(
+                jnp.asarray(self._branch_of_shard)[s_me], self._compress_branches,
+                sre, sim,
+            )
+        return vre[None], vim[None]

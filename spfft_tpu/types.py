@@ -60,6 +60,25 @@ RAGGED_EXCHANGES = (
 )
 
 
+def wire_dtype(exchange_type: "ExchangeType", real_dtype):
+    """THE wire-format rule, single-sourced: the real scalar dtype an exchange
+    puts on the interconnect for a plan of ``real_dtype``. Engines cast with it
+    and the wire-byte accounting derives from it, so the two cannot diverge."""
+    import ml_dtypes
+    import numpy as np
+
+    if exchange_type in BF16_EXCHANGES:
+        return np.dtype(ml_dtypes.bfloat16)
+    if exchange_type in FLOAT_EXCHANGES and np.dtype(real_dtype) == np.float64:
+        return np.dtype(np.float32)
+    return np.dtype(real_dtype)
+
+
+def wire_scalar_bytes(exchange_type: "ExchangeType", real_dtype) -> int:
+    """Bytes per real scalar on the wire under ``exchange_type``."""
+    return int(wire_dtype(exchange_type, real_dtype).itemsize)
+
+
 class ProcessingUnit(enum.IntFlag):
     """Where a transform executes. Reference: include/spfft/types.h:67-76.
 
