@@ -33,8 +33,6 @@ from ..execution import _complex_dtype
 from ..ops import symmetry
 from ..parameters import DistributedParameters
 from ..types import (
-    BF16_EXCHANGES as _BF16_EXCHANGES,
-    FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
     RAGGED_EXCHANGES as _RAGGED_EXCHANGES,
     ExchangeType,
     ScalingType,
@@ -110,6 +108,44 @@ class PaddingHelpers:
         from ..types import wire_scalar_bytes
 
         return wire_scalar_bytes(self.exchange_type, self.real_dtype)
+
+    def _ragged_wire_format(self):
+        """The ragged chain's wire tag, derived from the same single-sourced
+        rule (types.wire_dtype) the padded exchanges use."""
+        from ..types import wire_dtype
+
+        wd = wire_dtype(self.exchange_type, self.real_dtype)
+        if wd == jnp.bfloat16:
+            return "bf16"
+        if wd != self.real_dtype:
+            return "f32"
+        return None
+
+    def _complex_wire_exchange(self, buffer, axes):
+        """all_to_all on a complex buffer in the plan's wire format — derived
+        from types.wire_dtype, the same rule the byte accounting uses, so the
+        cast and the accounting cannot diverge."""
+        from ..types import wire_dtype
+
+        wd = wire_dtype(self.exchange_type, self.real_dtype)
+        if wd == jnp.bfloat16:
+            # no complex-bf16 dtype: ride as a (re, im)-stacked real pair —
+            # still one collective, half the f32 wire bytes
+            wire = jnp.stack(
+                [buffer.real.astype(wd), buffer.imag.astype(wd)], axis=1
+            )
+            recv = jax.lax.all_to_all(
+                wire, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            recv = recv.astype(self.real_dtype)
+            return jax.lax.complex(recv[:, 0], recv[:, 1]).astype(self.complex_dtype)
+        if wd != self.real_dtype:  # f32 wire for an f64 plan
+            recv = jax.lax.all_to_all(
+                buffer.astype(np.complex64), axes, split_axis=0, concat_axis=0,
+                tiled=True,
+            )
+            return recv.astype(self.complex_dtype)
+        return jax.lax.all_to_all(buffer, axes, split_axis=0, concat_axis=0, tiled=True)
 
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes one slab<->pencil repartition puts on the
@@ -308,15 +344,7 @@ class DistributedExecution(PaddingHelpers):
                 p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
                 self._S, self._L, p.dim_z, p.dim_y * xf, self._yx_flat,
             )
-        if self.exchange_type in _BF16_EXCHANGES:
-            self._ragged_wire = "bf16"
-        elif (
-            self.exchange_type in _FLOAT_EXCHANGES
-            and self.complex_dtype == np.complex128
-        ):
-            self._ragged_wire = "f32"
-        else:
-            self._ragged_wire = None
+        self._ragged_wire = self._ragged_wire_format()
 
         # ---- sharded per-shard constants ----
         vi_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
@@ -358,37 +386,9 @@ class DistributedExecution(PaddingHelpers):
 
     # ---- wire-format casts (float exchange) -----------------------------------
 
-    def _to_wire(self, buf):
-        if self.exchange_type in _FLOAT_EXCHANGES and self.complex_dtype == np.complex128:
-            return buf.astype(np.complex64)
-        return buf
-
-    def _from_wire(self, buf):
-        return buf.astype(self.complex_dtype)
-
     def _exchange(self, buffer):
-        """One ``all_to_all`` over the mesh axis in the configured wire format.
-
-        ``*_BF16`` (TPU extension, types.py): no complex-bf16 dtype exists, so the
-        payload rides as a (re, im)-stacked real bf16 buffer — still one
-        collective, half the f32 wire bytes."""
-        if self.exchange_type in _BF16_EXCHANGES:
-            wire = jnp.stack(
-                [
-                    buffer.real.astype(jnp.bfloat16),
-                    buffer.imag.astype(jnp.bfloat16),
-                ],
-                axis=1,
-            )
-            recv = jax.lax.all_to_all(
-                wire, FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
-            )
-            recv = recv.astype(self.real_dtype)
-            return jax.lax.complex(recv[:, 0], recv[:, 1]).astype(self.complex_dtype)
-        recv = jax.lax.all_to_all(
-            self._to_wire(buffer), FFT_AXIS, split_axis=0, concat_axis=0, tiled=True
-        )
-        return self._from_wire(recv)
+        """One ``all_to_all`` over the mesh axis in the configured wire format."""
+        return self._complex_wire_exchange(buffer, FFT_AXIS)
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 

@@ -47,8 +47,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import fft as offt
 from ..ops import lanecopy, symmetry
 from ..types import (
-    BF16_EXCHANGES as _BF16_EXCHANGES,
-    FLOAT_EXCHANGES as _FLOAT_EXCHANGES,
     RAGGED_EXCHANGES as _RAGGED_EXCHANGES,
     ExchangeType,
     ScalingType,
@@ -268,12 +266,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
                 S, L, Z, Y * A, self._stick_yx,
             )
-        if self.exchange_type in _BF16_EXCHANGES:
-            self._ragged_wire = "bf16"
-        elif self.exchange_type in _FLOAT_EXCHANGES and self.real_dtype == np.float64:
-            self._ragged_wire = "f32"
-        else:
-            self._ragged_wire = None
+        self._ragged_wire = self._ragged_wire_format()
 
         # ---- per-shard value copy plans (deduped lax.switch branches) ----
         self._build_value_branches()

@@ -39,8 +39,6 @@ from ..errors import InvalidParameterError
 from ..execution import _complex_dtype
 from ..ops import symmetry
 from ..types import (
-    BF16_EXCHANGES as _BF16,
-    FLOAT_EXCHANGES as _FLOAT,
     RAGGED_EXCHANGES as _RAGGED,
     ExchangeType,
     ScalingType,
@@ -188,20 +186,9 @@ class Pencil2Execution(PaddingHelpers):
         return (a_elems + b_elems) * 2 * self._wire_scalar_bytes()
 
     def _exchange(self, buf, axes):
-        """Padded all_to_all with the configured wire format."""
-        if self.exchange_type in _BF16:
-            wire = jnp.stack(
-                [buf.real.astype(jnp.bfloat16), buf.imag.astype(jnp.bfloat16)], axis=1
-            )
-            recv = jax.lax.all_to_all(wire, axes, split_axis=0, concat_axis=0, tiled=True)
-            recv = recv.astype(self.real_dtype)
-            return jax.lax.complex(recv[:, 0], recv[:, 1]).astype(self.complex_dtype)
-        if self.exchange_type in _FLOAT and self.complex_dtype == np.complex128:
-            recv = jax.lax.all_to_all(
-                buf.astype(np.complex64), axes, split_axis=0, concat_axis=0, tiled=True
-            )
-            return recv.astype(self.complex_dtype)
-        return jax.lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
+        """Padded all_to_all with the configured wire format (single-sourced
+        rule: PaddingHelpers._complex_wire_exchange / types.wire_dtype)."""
+        return self._complex_wire_exchange(buf, axes)
 
     # ---- host boundary (2-D slabs) --------------------------------------------
 
@@ -261,6 +248,45 @@ class Pencil2Execution(PaddingHelpers):
     def local_slice_size(self, shard: int) -> int:
         return self.local_z_length(shard) * self.local_y_length(shard) * self.params.dim_x
 
+    # ---- shared exchange-A index maps (used by both compute paths) ------------
+    #
+    # The SAME map serves gather and scatter on each side: the stick-side map
+    # indexes the padded (S*Z + 1) stick flats (pack A backward / unpack A
+    # forward), the plane-side map indexes the (Lz*Y*Ax + 1) y-pencil flats
+    # (unpack A backward / pack A forward); both sentinel into the trailing
+    # zero/trash slot.
+
+    def _stickside_map(self, s_me):
+        """(P, SG, Lz) int32 map into the (S*Z + 1) stick flats."""
+        S, Z = self._S, self.params.dim_z
+        Lz = self._Lz
+        lz_t = jnp.asarray(self._lz.astype(np.int32))
+        zo_t = jnp.asarray(self._zo.astype(np.int32))
+        my_rows = jnp.asarray(self._rows)[s_me]  # (P1, SG), sentinel S
+        j_l = jnp.arange(Lz, dtype=jnp.int32)
+        src = (
+            my_rows[:, None, :, None] * Z
+            + zo_t[None, :, None, None]
+            + j_l[None, None, None, :]
+        )
+        ok = (my_rows[:, None, :, None] < S) & (
+            j_l[None, None, None, :] < lz_t[None, :, None, None]
+        )
+        return jnp.where(ok, src, S * Z).reshape(self.P1 * self.P2, self._SG, Lz)
+
+    def _planeside_map(self, a_me, b_me):
+        """(P, SG, Lz) int32 map into the (Lz*Y*Ax + 1) y-pencil flats."""
+        Y, Ax, Lz = self.params.dim_y, self._Ax, self._Lz
+        lz_t = jnp.asarray(self._lz.astype(np.int32))
+        cols = jnp.asarray(self._cols)[:, a_me, :]  # (P, SG), sentinel Y*Ax
+        lz_me = lz_t[b_me]
+        dest = (
+            jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
+            + cols[:, :, None]
+        )
+        ok = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
+        return jnp.where(ok, dest, Lz * (Y * Ax))
+
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _backward_impl(self, values_re, values_im, value_indices):
@@ -270,8 +296,6 @@ class Pencil2Execution(PaddingHelpers):
         a_me = jax.lax.axis_index(AX1)
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        zo_t = jnp.asarray(self._zo.astype(np.int32))
 
         values = jax.lax.complex(
             values_re[0].astype(self.real_dtype), values_im[0].astype(self.real_dtype)
@@ -291,28 +315,14 @@ class Pencil2Execution(PaddingHelpers):
 
         # pack A: my sticks split by destination (x-group a', z-slab b')
         sflat = jnp.concatenate([sticks.reshape(-1), jnp.zeros(1, self.complex_dtype)])
-        my_rows = jnp.asarray(self._rows)[s_me]            # (P1, SG), sentinel S
-        j_l = jnp.arange(Lz, dtype=jnp.int32)
-        src = (
-            my_rows[:, None, :, None] * Z
-            + zo_t[None, :, None, None]
-            + j_l[None, None, None, :]
-        )  # (P1, P2, SG, Lz)
-        ok = (my_rows[:, None, :, None] < S) & (j_l[None, None, None, :] < lz_t[None, :, None, None])
-        src = jnp.where(ok, src, S * Z)
-        buf = sflat[src].reshape(P1 * P2, SG, Lz)
+        buf = sflat[self._stickside_map(s_me)]
 
         # exchange A: one collective over BOTH mesh axes (flat row-major (a, b))
         recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz): recv[s] = s's sticks here
 
         # unpack A -> y-pencil grid (Lz, Y, Ax): all sticks in my x-group, my z
-        cols = jnp.asarray(self._cols)[:, a_me, :]          # (P, SG), sentinel Y*Ax
-        lz_me = lz_t[b_me]
-        dest = jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax) + cols[:, :, None]
-        okd = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
-        dest = jnp.where(okd, dest, Lz * (Y * Ax))
         g = jnp.zeros(Lz * Y * Ax + 1, dtype=self.complex_dtype)
-        g = g.at[dest].set(recv)  # dest and recv both (P, SG, Lz)
+        g = g.at[self._planeside_map(a_me, b_me)].set(recv)
         grid = g[: Lz * Y * Ax].reshape(Lz, Y, Ax)
 
         if self.is_r2c and self._have_x0:
@@ -350,8 +360,6 @@ class Pencil2Execution(PaddingHelpers):
         a_me = jax.lax.axis_index(AX1)
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        zo_t = jnp.asarray(self._zo.astype(np.int32))
 
         if self.is_r2c:
             (value_indices,) = rest
@@ -384,21 +392,12 @@ class Pencil2Execution(PaddingHelpers):
         gflat = jnp.concatenate(
             [grid.reshape(-1), jnp.zeros(1, self.complex_dtype)]
         )
-        cols = jnp.asarray(self._cols)[:, a_me, :]  # (P, SG) of MY x-group
-        lz_me = lz_t[b_me]
-        src = jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax) + cols[:, :, None]
-        ok = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
-        buf = gflat[jnp.where(ok, src, Lz * Y * Ax)]  # (P, SG, Lz)
+        buf = gflat[self._planeside_map(a_me, b_me)]  # (P, SG, Lz)
         recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz): my sticks, p's z
 
         # scatter into (S, Z): source p = (a', b') holds my group-a' sticks on z in b'
-        my_rows = jnp.asarray(self._rows)[s_me].reshape(P1, 1, SG, 1)  # by a'
-        j_l = jnp.arange(Lz, dtype=jnp.int32)[None, None, None, :]
-        dest = my_rows * Z + zo_t[None, :, None, None] + j_l
-        okd = (my_rows < S) & (j_l < lz_t[None, :, None, None])
-        dest = jnp.where(okd, dest, S * Z)
         sflat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-        sflat = sflat.at[dest].set(recv.reshape(P1, P2, SG, Lz))
+        sflat = sflat.at[self._stickside_map(s_me)].set(recv)
         sticks = jnp.fft.fft(sflat[: S * Z].reshape(S, Z), axis=1)
 
         values = jnp.take(sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0)

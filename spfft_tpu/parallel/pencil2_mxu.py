@@ -64,8 +64,6 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         a_me = jax.lax.axis_index(AX1)
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        zo_t = jnp.asarray(self._zo.astype(np.int32))
 
         with jax.named_scope("compression"):
             sre, sim = jax.lax.switch(
@@ -88,17 +86,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         # pack A: my sticks split by destination (x-group, z-slab)
         with jax.named_scope("pack"):
-            my_rows = jnp.asarray(self._rows)[s_me]
-            j_l = jnp.arange(Lz, dtype=jnp.int32)
-            src = (
-                my_rows[:, None, :, None] * Z
-                + zo_t[None, :, None, None]
-                + j_l[None, None, None, :]
-            )
-            ok = (my_rows[:, None, :, None] < S) & (
-                j_l[None, None, None, :] < lz_t[None, :, None, None]
-            )
-            src = jnp.where(ok, src, S * Z).reshape(P1 * P2, -1, Lz)
+            src = self._stickside_map(s_me)
             fre = jnp.concatenate([sre.reshape(-1), jnp.zeros(1, rt)])
             fim = jnp.concatenate([sim.reshape(-1), jnp.zeros(1, rt)])
             bre, bim = fre[src], fim[src]
@@ -108,14 +96,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         # unpack A -> (Lz, Y, Ax) y-pencil grid
         with jax.named_scope("unpack"):
-            cols = jnp.asarray(self._cols)[:, a_me, :]
-            lz_me = lz_t[b_me]
-            dest = (
-                jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
-                + cols[:, :, None]
-            )
-            okd = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
-            dest = jnp.where(okd, dest, Lz * (Y * Ax))
+            dest = self._planeside_map(a_me, b_me)
             gre = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rre)
             gim = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rim)
             gre = gre[: Lz * Y * Ax].reshape(Lz, Y, Ax)
@@ -165,8 +146,6 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         a_me = jax.lax.axis_index(AX1)
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        zo_t = jnp.asarray(self._zo.astype(np.int32))
         scaling = ScalingType.NONE if scale is None else ScalingType.FULL
 
         with jax.named_scope("x transform"):
@@ -200,14 +179,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         # exchange A reverse: each stick's z-chunk back to its owner
         with jax.named_scope("pack"):
-            cols = jnp.asarray(self._cols)[:, a_me, :]
-            lz_me = lz_t[b_me]
-            src = (
-                jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
-                + cols[:, :, None]
-            )
-            ok = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
-            src = jnp.where(ok, src, Lz * Y * Ax)
+            src = self._planeside_map(a_me, b_me)
             fre = jnp.concatenate([gre.reshape(-1), jnp.zeros(1, rt)])
             fim = jnp.concatenate([gim.reshape(-1), jnp.zeros(1, rt)])
             bre, bim = fre[src], fim[src]
@@ -215,14 +187,9 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
 
         with jax.named_scope("unpack"):
-            my_rows = jnp.asarray(self._rows)[s_me].reshape(P1, 1, -1, 1)
-            j_l = jnp.arange(Lz, dtype=jnp.int32)[None, None, None, :]
-            dest = my_rows * Z + zo_t[None, :, None, None] + j_l
-            okd = (my_rows < S) & (j_l < lz_t[None, :, None, None])
-            dest = jnp.where(okd, dest, S * Z)
-            SG = self._SG
-            sre = jnp.zeros(S * Z + 1, rt).at[dest].set(rre.reshape(P1, P2, SG, Lz))
-            sim = jnp.zeros(S * Z + 1, rt).at[dest].set(rim.reshape(P1, P2, SG, Lz))
+            dest = self._stickside_map(s_me)
+            sre = jnp.zeros(S * Z + 1, rt).at[dest].set(rre)
+            sim = jnp.zeros(S * Z + 1, rt).at[dest].set(rim)
             sre = sre[: S * Z].reshape(S, Z)
             sim = sim[: S * Z].reshape(S, Z)
 

@@ -83,7 +83,7 @@ def test_pencil2_beyond_slab_limit(engine):
 
 def test_pencil2_mxu_matches_xla():
     """The matmul-DFT pencil engine reproduces the jnp.fft one at the 1e-6 bar
-    on an imbalanced plan, C2C and wire variants."""
+    on an imbalanced C2C plan (wire variants: test_pencil2_wire_formats)."""
     rng = np.random.default_rng(51)
     dims = (12, 11, 13)
     dx, dy, dz = dims
@@ -157,6 +157,7 @@ def test_pencil2_imbalanced_sticks():
     assert_close(back[0], values)
 
 
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
 @pytest.mark.parametrize(
     "exchange,dtype,atol_scale",
     [
@@ -164,7 +165,7 @@ def test_pencil2_imbalanced_sticks():
         (ExchangeType.BUFFERED_BF16, np.float32, 3e-2),
     ],
 )
-def test_pencil2_wire_formats(exchange, dtype, atol_scale):
+def test_pencil2_wire_formats(engine, exchange, dtype, atol_scale):
     rng = np.random.default_rng(46)
     dims = (8, 8, 8)
     dx, dy, dz = dims
@@ -172,7 +173,8 @@ def test_pencil2_wire_formats(exchange, dtype, atol_scale):
     values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
     per_shard = distribute_triplets(trip, 4, dy)
     vps = split_values(per_shard, trip, values)
-    t = build(2, 2, dims, per_shard, exchange=exchange, dtype=dtype)
+    t = build(2, 2, dims, [p.copy() for p in per_shard], exchange=exchange,
+              dtype=dtype, engine=engine)
     out = t.backward(vps)
     expected = oracle_backward_c2c(trip, values, dx, dy, dz)
     scale = np.abs(expected).max()
@@ -274,6 +276,31 @@ def test_pencil2_r2c_partial_spectrum():
         mesh=sp.make_fft_mesh2(2, 2),
     )
     assert_close(t.backward(vps), r)
+
+
+def test_pencil2_multi_transform_batch():
+    """Pipelined batching works over pencil plans (engine-agnostic dispatch)."""
+    rng = np.random.default_rng(55)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    per_shard = distribute_triplets(trip, 4, dy)
+    ts = [build(2, 2, dims, [p.copy() for p in per_shard]) for _ in range(3)]
+    all_vps = []
+    for _ in ts:
+        values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+        all_vps.append(split_values(per_shard, trip, values))
+    outs = sp.multi_transform_backward(ts, all_vps)
+    for vps, out in zip(all_vps, outs):
+        flat = np.concatenate(vps)
+        tt = np.concatenate(per_shard)
+        lut = {tuple(t_): v for t_, v in zip(map(tuple, tt), flat)}
+        vals = np.asarray([lut[tuple(t_)] for t_ in trip])
+        assert_close(out, oracle_backward_c2c(trip, vals, dx, dy, dz))
+    backs = sp.multi_transform_forward(ts, None, ScalingType.FULL)
+    for vps, back in zip(all_vps, backs):
+        for r, vals in enumerate(vps):
+            assert_close(back[r], vals)
 
 
 def test_pencil2_exact_counts_exchange_rejected():
