@@ -283,6 +283,41 @@ def test_bf16_wire_exchange(exchange):
         np.testing.assert_allclose(back[r], vals, rtol=0, atol=3e-2 * vscale)
 
 
+@pytest.mark.parametrize("exchange", list(ExchangeType))
+def test_every_exchange_type_routes(exchange):
+    """Exhaustive enum sweep: every ExchangeType value produces a working
+    2-shard transform at its documented accuracy bar (insurance that a new
+    enum value cannot ship unrouted)."""
+    from spfft_tpu.types import BF16_EXCHANGES
+
+    rng = np.random.default_rng(17)
+    dx, dy, dz = 8, 8, 8
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
+    per_shard = distribute_triplets(triplets, 2, dy)
+    values_per_shard = split_values(per_shard, triplets, values)
+    bf16 = exchange in BF16_EXCHANGES
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=make_mesh(2),
+        exchange_type=exchange,
+        dtype=np.float32 if bf16 else None,
+    )
+    out = t.backward(values_per_shard)
+    expected = oracle_backward_c2c(triplets, values, dx, dy, dz)
+    scale = np.abs(expected).max()
+    np.testing.assert_allclose(out, expected, rtol=0, atol=(3e-2 if bf16 else 1e-6) * scale)
+    back = t.forward(scaling=ScalingType.FULL)
+    vtol = 3e-2 * max(1.0, np.abs(values).max()) if bf16 else 1e-6
+    for r, vals in enumerate(values_per_shard):
+        np.testing.assert_allclose(back[r], vals, rtol=0, atol=vtol)
+
+
 def test_grid_with_mesh_creates_distributed():
     rng = np.random.default_rng(8)
     dims = (8, 8, 8)

@@ -83,6 +83,27 @@ def test_engine_slot_tables_are_inverse():
             assert yx[row] == slot
 
 
+def test_wire_dtype_rule():
+    """The single-sourced wire rule (types.wire_dtype) drives every cast and
+    the byte accounting; pin its full value table."""
+    import ml_dtypes
+
+    from spfft_tpu.types import ExchangeType as E, wire_dtype, wire_scalar_bytes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    for rt in (np.float32, np.float64):
+        for et in (E.DEFAULT, E.BUFFERED, E.COMPACT_BUFFERED, E.UNBUFFERED):
+            assert wire_dtype(et, rt) == np.dtype(rt)
+        assert wire_dtype(E.BUFFERED_BF16, rt) == bf16
+        assert wire_dtype(E.COMPACT_BUFFERED_BF16, rt) == bf16
+    for et in (E.BUFFERED_FLOAT, E.COMPACT_BUFFERED_FLOAT):
+        assert wire_dtype(et, np.float32) == np.dtype(np.float32)
+        assert wire_dtype(et, np.float64) == np.dtype(np.float32)
+    assert wire_scalar_bytes(E.BUFFERED_BF16, np.float32) == 2
+    assert wire_scalar_bytes(E.BUFFERED_FLOAT, np.float64) == 4
+    assert wire_scalar_bytes(E.UNBUFFERED, np.float64) == 8
+
+
 def test_value_indices_padded_with_oob_sentinel():
     p = make_params()
     V = p.max_num_values
