@@ -57,17 +57,9 @@ __all__ = [
 # i.e. before the first Grid/Transform creation in the embedded interpreter.
 _num_cpu = os.environ.get("SPFFT_TPU_NUM_CPU_DEVICES")
 if _num_cpu:
-    import jax
+    from .parallel.mesh import configure_virtual_devices
 
-    try:
-        jax.config.update("jax_num_cpu_devices", int(_num_cpu))
-    except RuntimeError as e:  # backend already initialized elsewhere
-        import sys
-
-        print(
-            f"spfft_tpu.capi: SPFFT_TPU_NUM_CPU_DEVICES ignored ({e})",
-            file=sys.stderr,
-        )
+    configure_virtual_devices(int(_num_cpu), warn=True)
 
 _SP_SUCCESS = 0
 _SP_UNKNOWN = int(errors.ErrorCode.UNKNOWN)

@@ -45,6 +45,57 @@ def fft_axis_size(mesh) -> int:
     return int(mesh.shape[FFT_AXIS])
 
 
+def configure_virtual_devices(n_devices: int, *, warn: bool = False) -> None:
+    """Request an ``n_devices``-wide virtual CPU backend, without touching devices.
+
+    Safe at import time (no backend initialization). Must run before JAX
+    initializes its backends to take effect; if too late, ``warn=True`` prints
+    a stderr diagnostic and the caller's later device-count check decides
+    whether that matters.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", max(int(n_devices), 1))
+    except RuntimeError as e:  # backend already initialized elsewhere
+        if warn:
+            import sys
+
+            print(f"spfft_tpu: jax_num_cpu_devices ignored ({e})", file=sys.stderr)
+
+
+def ensure_virtual_devices(n_devices: int, *, warn: bool = False):
+    """Return ``n_devices`` JAX devices, standing up a virtual CPU backend if needed.
+
+    The single bootstrap for every single-controller caller that must validate
+    n-way sharding on a host with fewer than n chips (the analogue of the
+    reference exercising MPI paths under ``mpirun -n 2`` on one CI VM,
+    reference: tests/run_mpi_tests.cpp:14-21): pre-configures the CPU backend
+    with ``n_devices`` virtual devices (honored until first backend use) and
+    falls back to ``jax.devices("cpu")`` when the default platform has too few
+    devices. When the default platform already exposes enough (a real pod
+    slice), those are returned so collectives ride the actual interconnect.
+
+    ``warn=True`` prints a stderr note when the config arrives after backend
+    initialization (the embedded-interpreter caller wants the diagnostic;
+    raising would break an otherwise-valid single-device run).
+    """
+    n_devices = max(int(n_devices), 1)
+    configure_virtual_devices(n_devices, warn=warn)
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        try:
+            devices = jax.devices("cpu")
+        except RuntimeError:
+            devices = []
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices but only {len(devices)} are visible; "
+            f"start the process with JAX_NUM_CPU_DEVICES={n_devices} (or "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}) so "
+            "the config is applied before JAX backend initialization."
+        )
+    return list(devices[:n_devices])
+
+
 def make_fft_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     """Build a 1-D mesh over ``num_devices`` devices (default: all local devices).
 
