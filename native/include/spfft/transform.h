@@ -4,6 +4,12 @@
  * Opaque-handle mirror of the C++ Transform/TransformFloat (reference:
  * include/spfft/transform.h, transform_float.h). Handles are created either
  * grid-less or from an SpfftGrid; all functions return SpfftError.
+ *
+ * Embedding note: the first double-precision plan created through this API
+ * enables 64-bit mode (jax_enable_x64) in the embedded Python/JAX runtime.
+ * That flag is process-global — if the embedding application also uses JAX in
+ * the same process, default array dtypes there widen from that point on. Use
+ * the float entry points (spfft_float_*) to avoid it.
  */
 #ifndef SPFFT_TPU_TRANSFORM_H
 #define SPFFT_TPU_TRANSFORM_H

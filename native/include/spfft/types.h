@@ -4,23 +4,30 @@
  * ABI-compatible with the reference SpFFT C enums (reference:
  * include/spfft/types.h:33-117) so existing callers recompile unchanged.
  * Semantics on the TPU build:
- *  - exchange types all lower to an equal-split ICI all-to-all (the reference's
- *    BUFFERED discipline); COMPACT/UNBUFFERED map to pad -> all_to_all -> slice.
+ *  - BUFFERED lowers to one equal-split ICI all-to-all on padded blocks;
+ *    COMPACT_BUFFERED/UNBUFFERED send exact per-rank-pair blocks via a
+ *    P-1-round permute chain (Alltoallv/Alltoallw semantics).
  *  - SPFFT_PU_GPU selects the accelerator (TPU) backend.
  */
 #ifndef SPFFT_TPU_TYPES_H
 #define SPFFT_TPU_TYPES_H
 
 enum SpfftExchangeType {
+  /* DIVERGENCE from the reference: there DEFAULT == COMPACT_BUFFERED; here it
+   * routes to BUFFERED (the fused ICI all-to-all is the fast path for balanced
+   * shard layouts). Pass COMPACT_BUFFERED explicitly for exact-counts wire
+   * behavior. */
   SPFFT_EXCH_DEFAULT = 0,
   /* Equal-sized message blocks; the native ICI all-to-all discipline. */
   SPFFT_EXCH_BUFFERED = 1,
   /* Same, single-precision wire payload (half the ICI bytes). */
   SPFFT_EXCH_BUFFERED_FLOAT = 2,
-  /* Exact per-rank block sizes; realized as pad + all-to-all + slice. */
+  /* Exact per-rank-pair block sizes (Alltoallv semantics), via a P-1-round
+   * permute chain. */
   SPFFT_EXCH_COMPACT_BUFFERED = 3,
   SPFFT_EXCH_COMPACT_BUFFERED_FLOAT = 4,
-  /* Zero-copy datatype exchange in the reference; same mapping here. */
+  /* Zero-copy datatype exchange in the reference; maps to the same exact-counts
+   * chain here. */
   SPFFT_EXCH_UNBUFFERED = 5,
   /* TPU extensions (beyond the reference enum): explicit bfloat16 wire payload
    * — halves ICI bytes vs an f32 wire (quarters vs f64). Accuracy ~1e-2

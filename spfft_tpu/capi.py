@@ -83,7 +83,12 @@ def _ensure_x64(double_precision: bool) -> None:
     """Native callers requesting double precision must actually get f64: the
     embedded interpreter does not run the test conftest, and without x64 JAX
     silently truncates f64 arrays to f32 (a ~4e-7 roundtrip instead of ~1e-15).
-    jax_enable_x64 is runtime-updatable, so flip it on first f64 plan."""
+    jax_enable_x64 is runtime-updatable, so flip it on first f64 plan.
+
+    GLOBAL SIDE EFFECT: jax_enable_x64 is process-wide — an embedding
+    application that also uses JAX sees default dtypes widen from this point
+    on. Documented in the C header (native/include/spfft/transform.h); callers
+    who must not perturb the host process use the float entry points."""
     if double_precision:
         import jax
 

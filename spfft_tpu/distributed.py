@@ -14,6 +14,7 @@ import jax
 
 from . import timing
 from .errors import InvalidParameterError
+from .sync import fence
 from .grid import Grid
 from .parallel.execution import DistributedExecution
 from .parameters import distribute_triplets, make_distributed_parameters
@@ -151,7 +152,7 @@ class DistributedTransform:
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
-                    jax.block_until_ready(out)
+                    fence(out)
             with timing.scoped("output staging"):
                 return self._finalize_backward(out)
 
@@ -182,7 +183,7 @@ class DistributedTransform:
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
-                    jax.block_until_ready(pair)
+                    fence(pair)
             with timing.scoped("output staging"):
                 return self._finalize_forward(pair)
 
@@ -329,7 +330,9 @@ class DistributedTransform:
 
     def exchange_wire_bytes(self) -> int:
         """Off-shard interconnect bytes per slab<->pencil repartition under the
-        plan's exchange discipline (see PaddingHelpers.exchange_wire_bytes)."""
+        plan's exchange discipline (see PaddingHelpers.exchange_wire_bytes).
+        Bytes only — round count is not captured (see parallel/ragged.py's
+        LATENCY note)."""
         return self._exec.exchange_wire_bytes()
 
     @property
@@ -348,4 +351,4 @@ class DistributedTransform:
 
     def synchronize(self) -> None:
         if self._space_data is not None:
-            jax.block_until_ready(self._space_data)
+            fence(self._space_data)

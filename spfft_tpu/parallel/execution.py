@@ -155,7 +155,10 @@ class PaddingHelpers:
         Padded (BUFFERED): every shard sends P-1 uniform S_max x L_max blocks.
         Exact-counts (COMPACT/UNBUFFERED): the ppermute chain's per-step
         buffers, sized max_i sticks_i * planes_{(i+k) mod P}. Lets callers pick
-        the discipline from plan geometry instead of folklore."""
+        the discipline from plan geometry instead of folklore.
+
+        Bytes only — round count is not captured (see parallel/ragged.py's
+        LATENCY note)."""
         p = self.params
         if self._ragged is not None:
             elems = p.num_shards * sum(self._ragged.step_buffer_sizes)

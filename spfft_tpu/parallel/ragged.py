@@ -29,6 +29,13 @@ stick/plane counts), so no O(data)-sized index tables are materialized.
 Used by both mesh engines for ExchangeType.COMPACT_BUFFERED{,_FLOAT,_BF16} and
 UNBUFFERED (the reference's other exact-counts discipline); BUFFERED/DEFAULT
 keep the single fused all_to_all, which wins when shards are balanced.
+
+LATENCY: the chain is P-1 *sequential* collective rounds, so per-exchange step
+latency grows linearly with shard count, vs one fused collective for BUFFERED.
+``exchange_wire_bytes()`` captures only bytes, not rounds — at large P the
+exact-counts discipline can lose on latency even with lower wire volume. Pick
+the discipline from both: bytes (``exchange_wire_bytes``) and round count
+(P-1 vs 1).
 """
 from __future__ import annotations
 

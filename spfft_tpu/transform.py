@@ -15,6 +15,7 @@ import jax
 from . import timing
 from .errors import InvalidParameterError
 from .execution import LocalExecution, as_pair, from_pair
+from .sync import fence
 from .grid import Grid, device_for_processing_unit
 from .parameters import make_local_parameters
 from .types import ExecType, IndexFormat, ProcessingUnit, ScalingType, TransformType
@@ -66,6 +67,24 @@ class Transform:
         self._params = make_local_parameters(
             TransformType(transform_type), dim_x, dim_y, dim_z, indices
         )
+
+        # Envelope validation for an explicit local_z_length (reference:
+        # src/spfft/transform.cpp:51-55 rejects negatives; grid capacity checks
+        # in src/spfft/transform_internal.cpp:45-137). A local plan owns the
+        # full z-extent, so any other value is a porting error — reject loudly
+        # instead of silently accepting it.
+        if local_z_length is not None:
+            local_z_length = int(local_z_length)
+            if local_z_length < 0:
+                raise InvalidParameterError("local_z_length must be non-negative")
+            if local_z_length != int(dim_z):
+                raise InvalidParameterError(
+                    f"a local transform spans the full z-extent: local_z_length "
+                    f"must be dim_z ({int(dim_z)}), got {local_z_length}; use the "
+                    "distributed transform for partial z-slabs"
+                )
+            if grid is not None and local_z_length > grid.max_local_z_length:
+                raise InvalidParameterError("local z length exceeds grid maximum")
 
         if grid is not None:
             # Capacity validation, parity with src/spfft/transform_internal.cpp:45-137.
@@ -138,7 +157,7 @@ class Transform:
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
-                    jax.block_until_ready(out)
+                    fence(out)
             with timing.scoped("output staging"):
                 return self._finalize_backward(out)
 
@@ -197,7 +216,7 @@ class Transform:
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"):
-                    jax.block_until_ready(pair)
+                    fence(pair)
             with timing.scoped("output staging"):
                 return self._finalize_forward(pair)
 
@@ -381,7 +400,7 @@ class Transform:
 
     def synchronize(self) -> None:
         if self._space_data is not None:
-            jax.block_until_ready(self._space_data)
+            fence(self._space_data)
 
 
 def _validate_pu(pu) -> None:

@@ -20,10 +20,19 @@ class ExchangeType(enum.IntEnum):
     COMPACT_BUFFERED and UNBUFFERED send exact ``sticks_i x planes_j`` blocks per
     shard pair via a ppermute rotation chain (parallel/ragged.py) — true Alltoallv /
     Alltoallw semantics; they win when stick or plane counts are imbalanced (wire
-    bytes track the exact volume instead of ``P^2 S_max L_max``). The ``*_FLOAT``
-    variants halve wire bytes by converting the exchanged payload to single precision
-    on the wire, exactly like the reference's float exchange
+    bytes track the exact volume instead of ``P^2 S_max L_max``), at the cost of
+    P-1 sequential collective rounds per exchange (see parallel/ragged.py). The
+    ``*_FLOAT`` variants halve wire bytes by converting the exchanged payload to
+    single precision on the wire, exactly like the reference's float exchange
     (reference: src/gpu_util/complex_conversion.cuh:37-56).
+
+    DIVERGENCE from the reference: the reference documents SPFFT_EXCH_DEFAULT as
+    equivalent to COMPACT_BUFFERED (reference: include/spfft/types.h:34-39); here
+    DEFAULT routes to the padded BUFFERED discipline, because on ICI the single
+    fused all_to_all is the fast path for the balanced shard layouts
+    ``distribute_triplets`` produces. Ported code that relied on DEFAULT's
+    exact-counts wire volume should pass COMPACT_BUFFERED explicitly (see
+    docs/MIGRATION.md).
 
     The ``*_BF16`` variants are a TPU-native extension beyond the reference enum
     (which ends at UNBUFFERED): the wire payload is cast to bfloat16 around the

@@ -56,3 +56,41 @@ def test_invalid_mode_rejected():
 def test_synchronize_before_any_transform_is_noop():
     t, _ = _make()
     t.synchronize()  # no retained buffer yet; must not raise
+
+
+def test_synchronous_fence_scalar_path(monkeypatch):
+    """SYNCHRONOUS must observe completion even where block_until_ready is
+    advisory (the tunneled TPU platform, docs/details.md): fence() then takes a
+    scalar-fetch path. Exercised here by declaring cpu advisory."""
+    import jax
+    import jax.numpy as jnp
+
+    from spfft_tpu import sync
+
+    monkeypatch.setattr(sync, "ADVISORY_PLATFORMS", frozenset({"cpu", "axon"}))
+
+    # pairs, nested trees, complex (fetched via .real), scalars: all must fence
+    tree = (
+        jnp.arange(8.0),
+        [jnp.ones((2, 3)), (jnp.asarray(1.5), jnp.arange(4) + 2j * jnp.arange(4))],
+        np.arange(3),  # non-jax leaves pass through untouched
+    )
+    out = sync.fence(tree)
+    assert out is tree
+
+    # sharded leaves are fenced per addressable shard, not just element 0
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = sp.make_fft_mesh(8)
+    sharded = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2), NamedSharding(mesh, PartitionSpec("fft"))
+    )
+    assert len(sharded.addressable_shards) == 8
+    sync.fence((sharded,))
+
+    # and the Transform SYNCHRONOUS path still returns correct results
+    t, v = _make()
+    space = t.backward(v)
+    roundtrip = t.forward(scaling=ScalingType.FULL)
+    assert_close(roundtrip, v)
+    assert space.shape == (10, 9, 8)

@@ -152,3 +152,26 @@ def test_accessors():
     assert t.num_local_elements == len(triplets)
     assert t.transform_type == TransformType.C2C
     assert t.local_slice_size == 4 * 6 * 8
+
+
+def test_local_z_length_validation():
+    """An explicit local_z_length outside the local-slab envelope is rejected
+    (reference: src/spfft/transform.cpp:51-55, transform_internal.cpp:45-137);
+    the full-depth value is accepted."""
+    import pytest
+
+    from spfft_tpu.errors import InvalidParameterError
+
+    rng = np.random.default_rng(11)
+    trip = random_sparse_triplets(rng, 6, 6, 6, 0.5)
+    for bad in (-1, 0, 3, 7):
+        with pytest.raises(InvalidParameterError):
+            Transform(
+                ProcessingUnit.HOST, TransformType.C2C, 6, 6, 6,
+                indices=trip, local_z_length=bad,
+            )
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 6, 6, 6,
+        indices=trip, local_z_length=6,
+    )
+    assert t.dim_z == 6
