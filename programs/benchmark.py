@@ -143,7 +143,11 @@ def main(argv=None):
     # Virtual CPU mesh for distributed runs on a single host (the reference's
     # ``mpirun -n N`` on one CI VM): size the CPU platform before first backend use.
     if args.shards > 1 and (args.p == "cpu" or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
-        jax.config.update("jax_num_cpu_devices", args.shards)
+        # shared bootstrap: tolerates an already-initialized backend (e.g. when
+        # main() is driven in-process after other JAX work) with a stderr note
+        from spfft_tpu.parallel.mesh import configure_virtual_devices
+
+        configure_virtual_devices(args.shards, warn=True)
 
     import spfft_tpu as sp
     from spfft_tpu import timing

@@ -99,11 +99,28 @@ class MxuLocalExecution(ExecutionBase):
         self._yx_map = yx_map
         self._stick_keys = keys.astype(np.int32)
 
+        # f64 stage chunking (accelerators only): XLA:TPU's f64 emulation holds
+        # several ~8-component f32 temps per matmul — at 512^3 the x-stage alone
+        # needed 12 GB and OOM'd the chip (BASELINE.md). Chunking the batch (Y)
+        # axis of the x-stages bounds the temps; f32 and CPU paths are untouched.
+        platform = device.platform if device is not None else jax.default_backend()
+        self._x_stage_chunks = 1
+        if rt == np.dtype(np.float64) and platform != "cpu":
+            self._x_stage_chunks = offt.f64_stage_chunks(
+                p.dim_y,
+                p.dim_y * p.dim_x * p.dim_z,
+                p.dim_y * A * p.dim_z,
+            )
+
         self._backward = jax.jit(self._backward_impl)
         self._forward = {
             s: jax.jit(functools.partial(self._forward_impl, scaling=s))
             for s in (ScalingType.NONE, ScalingType.FULL)
         }
+        # Donating variant for the host-facing flow (staged input copies are
+        # dead after the call); see ExecutionBase.backward_pair_consuming for
+        # when the alias can actually engage.
+        self._backward_consume = jax.jit(self._backward_impl, donate_argnums=(0, 1))
 
     # ---- stages ---------------------------------------------------------------
 
@@ -182,21 +199,32 @@ class MxuLocalExecution(ExecutionBase):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yxz,yk->kxz", prec)
         with jax.named_scope("x transform"):
             if self.is_r2c:
-                return offt.real_out_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
-            return offt.complex_matmul(gre, gim, *self._wx_b, "kxz,xl->klz", prec)
+                fn = lambda r, i: offt.real_out_matmul(
+                    r, i, *self._wx_b, "kxz,xl->klz", prec
+                )
+            else:
+                fn = lambda r, i: offt.complex_matmul(
+                    r, i, *self._wx_b, "kxz,xl->klz", prec
+                )
+            return offt.map_chunked(fn, (gre, gim), self._x_stage_chunks)
 
     def _forward_impl(self, space_re, space_im, scaling):
         rt = self.real_dtype
         prec = self._precision
         with jax.named_scope("x transform"):
             if self.is_r2c:
-                gre, gim = offt.real_in_matmul(
-                    space_re.astype(rt), *self._wx_f, "yxz,xk->ykz", prec
+                gre, gim = offt.map_chunked(
+                    lambda s: offt.real_in_matmul(s, *self._wx_f, "yxz,xk->ykz", prec),
+                    (space_re.astype(rt),),
+                    self._x_stage_chunks,
                 )
             else:
-                gre, gim = offt.complex_matmul(
-                    space_re.astype(rt), space_im.astype(rt),
-                    *self._wx_f, "yxz,xk->ykz", prec,
+                gre, gim = offt.map_chunked(
+                    lambda r, i: offt.complex_matmul(
+                        r, i, *self._wx_f, "yxz,xk->ykz", prec
+                    ),
+                    (space_re.astype(rt), space_im.astype(rt)),
+                    self._x_stage_chunks,
                 )
         with jax.named_scope("y transform"):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "ykz,yl->lkz", prec)

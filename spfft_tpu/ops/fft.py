@@ -173,6 +173,50 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
     return wx_b, wx_f
 
 
+F64_STAGE_MB_ENV = "SPFFT_TPU_F64_STAGE_MB"
+
+
+def f64_stage_chunks(batch: int, *operand_elems: int) -> int:
+    """Batch-axis chunk count bounding an f64 matmul stage's emulation temps.
+
+    XLA:TPU emulates f64 matmuls with multi-component f32 arithmetic whose HLO
+    temporaries are ~8 f32 components per element with several alive at once —
+    measured: the single 512^3 R2C f64 backward x-stage held three
+    ``f32[8,512,512,512]`` temps (12 GB) and OOM'd a 15.75 GB chip
+    (BASELINE.md). Splitting the batch axis into chunks bounds each temp to
+    ``32 * max(operand_elems) / n`` bytes (default budget 256 MB, override via
+    ``SPFFT_TPU_F64_STAGE_MB``). Returns the smallest divisor of ``batch``
+    meeting the budget (1 = no chunking; ``batch`` if no smaller divisor fits).
+    """
+    budget = int(os.environ.get(F64_STAGE_MB_ENV, "256")) * (1 << 20)
+    temp_bytes = 32 * max(operand_elems)
+    if temp_bytes <= budget or batch <= 1:
+        return 1
+    want = -(-temp_bytes // budget)
+    for n in range(int(want), batch):
+        if batch % n == 0:
+            return n
+    return batch
+
+
+def map_chunked(fn, arrs, nchunks: int):
+    """Apply ``fn`` over leading-axis chunks of ``arrs`` via ``lax.map``.
+
+    Sequentializes the stage into ``nchunks`` pieces (each a full-width matmul
+    over a batch slice) so XLA's per-step temporaries shrink by ``nchunks``;
+    results are concatenated back along the leading axis. ``nchunks`` must
+    divide the common leading extent. ``fn`` may return one array or a tuple.
+    """
+    if nchunks <= 1:
+        return fn(*arrs)
+    b = arrs[0].shape[0] // nchunks
+    stacked = tuple(a.reshape(nchunks, b, *a.shape[1:]) for a in arrs)
+    out = jax.lax.map(lambda chunk: fn(*chunk), stacked)
+    if isinstance(out, tuple):
+        return tuple(o.reshape(o.shape[0] * o.shape[1], *o.shape[2:]) for o in out)
+    return out.reshape(out.shape[0] * out.shape[1], *out.shape[2:])
+
+
 def complex_matmul(xr, xi, wr, wi, spec: str, precision=_PRECISION):
     """(xr + i xi) contracted with (wr + i wi) via einsum ``spec``; 4 real matmuls."""
     yr = jnp.einsum(spec, xr, wr, precision=precision) - jnp.einsum(

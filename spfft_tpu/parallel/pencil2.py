@@ -65,15 +65,8 @@ class Pencil2Execution(PaddingHelpers):
         self.real_dtype = np.dtype(real_dtype)
         self.complex_dtype = _complex_dtype(real_dtype)
         self.exchange_type = ExchangeType(exchange_type)
-        if self.exchange_type in _RAGGED:
-            # Refuse rather than silently run padded: a caller comparing
-            # COMPACT vs BUFFERED must not time identical code under two names.
-            raise InvalidParameterError(
-                "the 2-D pencil engine implements the padded BUFFERED discipline "
-                "only; exact-counts COMPACT/UNBUFFERED exchanges are 1-D mesh "
-                "features (use BUFFERED or its *_FLOAT/*_BF16 wire variants)"
-            )
-        self._ragged = None  # padded discipline on both exchanges
+        self._ragged = None  # the 1-D chain is unused by the pencil engines
+        self._ragged2 = None  # exact-counts block chains, built after geometry
         p = params
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))
         P1, P2 = int(ax[AX1]), int(ax[AX2])
@@ -142,6 +135,31 @@ class Pencil2Execution(PaddingHelpers):
             yinv[yo[a] : yo[a] + ly[a]] = a * Ly + np.arange(ly[a])
         self._yinv = yinv.astype(np.int32)
 
+        # ---- exact-counts exchange chains (COMPACT/UNBUFFERED disciplines) ----
+        # Exchange A blocks are (P, SG, Lz) with valid rectangle
+        # (counts[s, a(d)], lz[b(d)]) — stick-count imbalance across x-groups
+        # and z-slab raggedness both shrink the wire. Exchange B blocks are
+        # (P1, Lz, Ly*Ax) with valid cols ly[q]*Ax; its rotation spans only the
+        # balanced y split, so its savings are usually small — A carries the
+        # discipline's value. Reference: MPI_Alltoallv
+        # (transpose_mpi_compact_buffered_host.cpp:183-200).
+        if self.exchange_type in _RAGGED:
+            from .ragged import RaggedBlockExchange
+
+            d = np.arange(Pn)
+            rows_a = counts[:, d // P2]  # (P, P): rows_a[s, d] = counts[s, a(d)]
+            cols_a = np.broadcast_to(lz[d % P2], (Pn, Pn))
+            rows_b = np.full((P1, P1), Lz, dtype=np.int64)
+            cols_b = np.broadcast_to((ly * Ax), (P1, P1))
+            self._ragged2 = {
+                (AX1, AX2): RaggedBlockExchange(
+                    (AX1, AX2), (P1, P2), rows_a, cols_a, SG, Lz
+                ),
+                (AX1,): RaggedBlockExchange(
+                    (AX1,), (P1,), rows_b, cols_b, Lz, Ly * Ax
+                ),
+            }
+
         # ---- sharded constants + compiled pipelines ----
         both = (AX1, AX2)
         self.value_sharding = NamedSharding(mesh, P(both, None))
@@ -179,16 +197,45 @@ class Pencil2Execution(PaddingHelpers):
         return self.params.transform_type == TransformType.R2C
 
     def exchange_wire_bytes(self) -> int:
-        """Off-shard bytes per repartition pair (exchange A + exchange B)."""
+        """Off-shard bytes per repartition pair (exchange A + exchange B).
+        Bytes only — the exact-counts chains add P-1 (A) and P1-1 (B)
+        sequential rounds (see parallel/ragged.py's LATENCY note)."""
         p = self.params
-        a_elems = p.num_shards * (p.num_shards - 1) * self._SG * self._Lz
-        b_elems = p.num_shards * (self.P1 - 1) * self._Lz * self._Ly * self._Ax
+        if self._ragged2 is not None:
+            a_elems = p.num_shards * sum(
+                self._ragged2[(AX1, AX2)].step_buffer_sizes
+            )
+            b_elems = p.num_shards * sum(self._ragged2[(AX1,)].step_buffer_sizes)
+        else:
+            a_elems = p.num_shards * (p.num_shards - 1) * self._SG * self._Lz
+            b_elems = p.num_shards * (self.P1 - 1) * self._Lz * self._Ly * self._Ax
         return (a_elems + b_elems) * 2 * self._wire_scalar_bytes()
 
-    def _exchange(self, buf, axes):
-        """Padded all_to_all with the configured wire format (single-sourced
-        rule: PaddingHelpers._complex_wire_exchange / types.wire_dtype)."""
+    def _exchange(self, buf, axes, reverse=False):
+        """Padded all_to_all (BUFFERED) or exact-counts block chain
+        (COMPACT/UNBUFFERED) with the configured wire format (single-sourced
+        rule: PaddingHelpers._complex_wire_exchange / types.wire_dtype).
+        ``reverse`` marks the forward-transform direction, whose exact valid
+        rectangles are transposed (padded path: symmetric, ignores it)."""
+        if self._ragged2 is not None:
+            (out,) = self._ragged_block_exchange([buf], axes, reverse)
+            return out
         return self._complex_wire_exchange(buf, axes)
+
+    def _ragged_block_exchange(self, parts, axes, reverse):
+        """Run the exact-counts block chain for ``axes`` on a list of
+        same-shaped block buffers (one complex array, or a (re, im) pair);
+        single dispatch point shared by both compute paths."""
+        rex = self._ragged2[tuple(axes)]
+        shape = parts[0].shape
+        blocks = [p.reshape(rex.P, rex.R, rex.C) for p in parts]
+        out = rex.exchange(
+            blocks,
+            wire=self._ragged_wire_format(),
+            real_dtype=self.real_dtype,
+            reverse=reverse,
+        )
+        return [o.reshape(shape) for o in out]
 
     # ---- host boundary (2-D slabs) --------------------------------------------
 
@@ -378,7 +425,8 @@ class Pencil2Execution(PaddingHelpers):
         )
         h = jnp.take(hpad, jnp.asarray(self._xcol), axis=2)  # (Lz, Ly, P1*Ax)
         bufb = h.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
-        recvb = self._exchange(bufb, (AX1,))  # (P1, Lz, Ly, Ax): my x-group, q's y
+        # (P1, Lz, Ly, Ax): my x-group, q's y
+        recvb = self._exchange(bufb, (AX1,), reverse=True)
 
         # reassemble the full y extent of my x-group
         rows = recvb.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax)
@@ -393,7 +441,8 @@ class Pencil2Execution(PaddingHelpers):
             [grid.reshape(-1), jnp.zeros(1, self.complex_dtype)]
         )
         buf = gflat[self._planeside_map(a_me, b_me)]  # (P, SG, Lz)
-        recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz): my sticks, p's z
+        # (P, SG, Lz): my sticks, p's z
+        recv = self._exchange(buf, (AX1, AX2), reverse=True)
 
         # scatter into (S, Z): source p = (a', b') holds my group-a' sticks on z in b'
         sflat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)

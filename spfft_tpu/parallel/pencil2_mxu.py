@@ -52,6 +52,17 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         )
         self._build_value_branches()
 
+    def _exchange_pair(self, bre, bim, axes, reverse=False):
+        """(re, im) blocks through the configured discipline: the padded
+        stacked-pair all_to_all (MxuValuePlans), or the exact-counts block
+        chain when the plan uses a COMPACT/UNBUFFERED exchange. ``reverse``
+        marks the forward-transform direction (transposed valid rectangles;
+        the padded path is symmetric and ignores it)."""
+        if self._ragged2 is not None:
+            out = self._ragged_block_exchange([bre, bim], axes, reverse)
+            return out[0], out[1]
+        return super()._exchange_pair(bre, bim, axes)
+
     # ---- pipelines (traced lazily by the base's jit/shard_map wrappers) -------
 
     def _backward_impl(self, values_re, values_im, value_indices):
@@ -166,7 +177,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             bre = hre.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
             bim = him.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
         with jax.named_scope("exchange"):
-            rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
+            rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
 
         # reassemble the full y extent of my x-group
         with jax.named_scope("unpack"):
@@ -184,7 +195,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             fim = jnp.concatenate([gim.reshape(-1), jnp.zeros(1, rt)])
             bre, bim = fre[src], fim[src]
         with jax.named_scope("exchange"):
-            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
+            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
 
         with jax.named_scope("unpack"):
             dest = self._stickside_map(s_me)

@@ -72,6 +72,19 @@ def _wire_cast_in(chunk, wire, dtype, real_dtype):
     return chunk.astype(dtype)
 
 
+def _wire_step(chunks, k, num_shards, axis_names, wire, dtype, real_dtype):
+    """One rotation step's wire protocol, shared by both chain forms: stack
+    multi-part chunks, cast to the wire format, ppermute by +k over the
+    (possibly joint) axis, cast back, unstack."""
+    perm = [(i, (i + k) % num_shards) for i in range(num_shards)]
+    stacked = len(chunks) > 1
+    wirebuf = jnp.stack(chunks) if stacked else chunks[0]
+    wirebuf = _wire_cast_out(wirebuf, wire)
+    wirebuf = jax.lax.ppermute(wirebuf, axis_names, perm)
+    wirebuf = _wire_cast_in(wirebuf, wire, dtype, real_dtype)
+    return [wirebuf[i] for i in range(len(chunks))] if stacked else [wirebuf]
+
+
 class RaggedExchange:
     """Static geometry + traced pipelines for one plan's exact-counts exchange.
 
@@ -184,13 +197,7 @@ class RaggedExchange:
             src = (me - k) % P
             chunks = make_chunk(flats, dst, sizes[k])
             if k:
-                perm = [(i, (i + k) % P) for i in range(P)]
-                stacked = len(chunks) > 1
-                wirebuf = jnp.stack(chunks) if stacked else chunks[0]
-                wirebuf = _wire_cast_out(wirebuf, wire)
-                wirebuf = jax.lax.ppermute(wirebuf, FFT_AXIS, perm)
-                wirebuf = _wire_cast_in(wirebuf, wire, dtype, rt)
-                chunks = [wirebuf[i] for i in range(len(chunks))] if stacked else [wirebuf]
+                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, rt)
             outs = scatter(outs, chunks, src)
         return outs
 
@@ -241,3 +248,106 @@ class RaggedExchange:
             flats, outs, make_chunk, scatter, self._b_fwd, wire, real_dtype
         )
         return [s[: self.S * self.Z].reshape(self.S, self.Z) for s in sticks]
+
+
+class RaggedBlockExchange:
+    """Exact-counts exchange over rectangular-valid padded block buffers.
+
+    Generic COMPACT-discipline form for exchanges whose pack stage already
+    produces per-destination blocks: a (P, R, C) buffer where the valid data of
+    the block for destination ``d`` on shard ``s`` is the top-left
+    ``(rows[s, d], cols[s, d])`` rectangle (row-major within (R, C)), the rest
+    zero padding. Each of the P-1 rotation steps ships only the exact
+    rectangles, padded to the per-step maximum product — the same discipline as
+    :class:`RaggedExchange`, without assuming the 1-D stick/plane geometry.
+    Used by the 2-D pencil engines for their exchanges A (joint-axis rotation
+    over ``("fft", "fft2")``) and B (rotation over ``"fft"`` within fixed
+    z-slab rows); reference discipline being matched: MPI_Alltoallv
+    (reference: src/transpose/transpose_mpi_compact_buffered_host.cpp:183-200).
+    The LATENCY note at the top of this module applies: P-1 sequential rounds.
+
+    ``axis_names``/``axis_sizes``: the mesh axes the flattened shard index runs
+    over, row-major (``ppermute`` accepts the tuple directly).
+    """
+
+    def __init__(self, axis_names, axis_sizes, rows, cols, R, C):
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(int(n) for n in axis_sizes)
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        self.P = int(np.prod(self.axis_sizes))
+        if rows.shape != (self.P, self.P) or cols.shape != (self.P, self.P):
+            raise ValueError("rows/cols must be (P, P) tables")
+        self.R, self.C = int(R), int(C)
+        if (rows > self.R).any() or (cols > self.C).any():
+            raise ValueError("rows/cols entries must fit the (R, C) block")
+        self._rows, self._cols = rows, cols
+        P = self.P
+        s = np.arange(P)
+        # reverse direction (the exchange's inverse repartition) swaps
+        # sender/receiver roles: its tables are the transposes, and its
+        # per-step sizes are the forward sizes reversed (size_rev[k] ==
+        # size_fwd[P-k], so wire totals are direction-independent)
+        self._sizes = {
+            False: [
+                max(1, int((rows[s, (s + k) % P] * cols[s, (s + k) % P]).max()))
+                for k in range(P)
+            ],
+            True: [
+                max(1, int((rows[(s + k) % P, s] * cols[(s + k) % P, s]).max()))
+                for k in range(P)
+            ],
+        }
+
+    @property
+    def step_buffer_sizes(self):
+        """Static per-rotation buffer sizes (elements per shard per part) for
+        steps 1..P-1 — what rides the wire; the k = 0 self-block stays local.
+        Direction-independent totals (see __init__)."""
+        return tuple(self._sizes[False][1:])
+
+    def _me(self):
+        me = 0
+        for name, size in zip(self.axis_names, self.axis_sizes):
+            me = me * size + jax.lax.axis_index(name)
+        return me
+
+    def exchange(self, parts, wire=None, real_dtype=None, reverse=False):
+        """parts: list of (P, R, C) arrays. Returns the received blocks as a
+        list of (P, R, C) arrays where out[src] is the block src sent here
+        (exact rectangle; padding zero). ``reverse=True`` runs the inverse
+        repartition (the forward transform direction), whose valid rectangles
+        are the transposed tables."""
+        P, R, C = self.P, self.R, self.C
+        rows = self._rows.T if reverse else self._rows
+        cols = self._cols.T if reverse else self._cols
+        rows_t = jnp.asarray(rows.astype(np.int32))
+        cols_t = jnp.asarray(cols.astype(np.int32))
+        me = self._me()
+        dtype = parts[0].dtype
+        flats = [
+            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
+        ]
+        outs = [jnp.zeros(P * R * C + 1, dtype=p.dtype) for p in parts]
+        for k in range(P):
+            dst = (me + k) % P
+            src = (me - k) % P
+            b = self._sizes[reverse][k]
+            idx = jnp.arange(b, dtype=jnp.int32)
+            # gather the exact rectangle for dst (sender-side shape)
+            c_s = jnp.maximum(cols_t[me, dst], 1)
+            r_i, c_i = idx // c_s, idx % c_s
+            valid_s = idx < rows_t[me, dst] * cols_t[me, dst]
+            gsrc = jnp.where(valid_s, dst * (R * C) + r_i * C + c_i, P * R * C)
+            chunks = [f[gsrc] for f in flats]
+            if k:
+                chunks = _wire_step(
+                    chunks, k, P, self.axis_names, wire, dtype, real_dtype
+                )
+            # scatter with the receiver-side shape of src's rectangle
+            c_r = jnp.maximum(cols_t[src, me], 1)
+            r_o, c_o = idx // c_r, idx % c_r
+            valid_r = idx < rows_t[src, me] * cols_t[src, me]
+            gdst = jnp.where(valid_r, src * (R * C) + r_o * C + c_o, P * R * C)
+            outs = [o.at[gdst].set(c) for o, c in zip(outs, chunks)]
+        return [o[: P * R * C].reshape(P, R, C) for o in outs]

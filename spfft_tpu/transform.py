@@ -178,7 +178,9 @@ class Transform:
             re, im = as_pair(values, self._real_dtype)
             re, im = self._exec.put(re), self._exec.put(im)
         with timing.scoped("dispatch"):
-            out = self._exec.backward_pair(re, im)
+            # staged copies are dead after the call: donate them so XLA reuses
+            # the allocations for pipeline temporaries
+            out = self._exec.backward_pair_consuming(re, im)
         self._space_data = out  # engine-native layout; pair for C2C, real for R2C
         return out
 

@@ -163,6 +163,10 @@ def test_pencil2_imbalanced_sticks():
     [
         (ExchangeType.BUFFERED_FLOAT, np.float64, 1e-4),
         (ExchangeType.BUFFERED_BF16, np.float32, 3e-2),
+        (ExchangeType.COMPACT_BUFFERED, np.float64, 1e-9),
+        (ExchangeType.COMPACT_BUFFERED_FLOAT, np.float64, 1e-4),
+        (ExchangeType.COMPACT_BUFFERED_BF16, np.float32, 3e-2),
+        (ExchangeType.UNBUFFERED, np.float64, 1e-9),
     ],
 )
 def test_pencil2_wire_formats(engine, exchange, dtype, atol_scale):
@@ -303,15 +307,35 @@ def test_pencil2_multi_transform_batch():
             assert_close(back[r], vals)
 
 
-def test_pencil2_exact_counts_exchange_rejected():
-    """COMPACT/UNBUFFERED must not silently run as padded under another name."""
-    from spfft_tpu.errors import InvalidParameterError
-
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_pencil2_exact_counts_roundtrip(engine):
+    """COMPACT on the pencil mesh: full roundtrip on an imbalanced plan, and
+    the exact-counts wire volume must undercut the padded discipline's (the
+    Alltoallv-vs-Alltoall contrast of the reference,
+    transpose_mpi_compact_buffered_host.cpp:183-200)."""
     rng = np.random.default_rng(53)
-    trip = random_sparse_triplets(rng, 8, 8, 8, 0.4)
-    per_shard = distribute_triplets(trip, 4, 8)
-    with pytest.raises(InvalidParameterError):
-        build(2, 2, (8, 8, 8), per_shard, exchange=ExchangeType.COMPACT_BUFFERED)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    # imbalanced: most sticks on shard 0 -> ragged counts across x-groups
+    per_shard = [trip] + [np.zeros((0, 3), dtype=np.int64)] * 3
+    vps = [values] + [np.zeros(0)] * 3
+
+    compact = build(
+        2, 2, dims, [p.copy() for p in per_shard],
+        exchange=ExchangeType.COMPACT_BUFFERED, engine=engine,
+    )
+    out = compact.backward(vps)
+    assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = compact.forward(scaling=ScalingType.FULL)
+    assert_close(back[0], values)
+
+    padded = build(
+        2, 2, dims, [p.copy() for p in per_shard],
+        exchange=ExchangeType.BUFFERED, engine=engine,
+    )
+    assert compact.exchange_wire_bytes() < padded.exchange_wire_bytes()
 
 
 def test_pencil2_mesh_size_mismatch_rejected():
