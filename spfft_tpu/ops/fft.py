@@ -192,11 +192,9 @@ def f64_stage_chunks(batch: int, *operand_elems: int) -> int:
     temp_bytes = 32 * max(operand_elems)
     if temp_bytes <= budget or batch <= 1:
         return 1
-    want = -(-temp_bytes // budget)
-    for n in range(int(want), batch):
-        if batch % n == 0:
-            return n
-    return batch
+    # map_chunked zero-pads the batch axis to a chunk multiple, so any count
+    # works — no divisor search (a prime batch must not serialize per-row)
+    return min(int(-(-temp_bytes // budget)), batch)
 
 
 def map_chunked(fn, arrs, nchunks: int):
@@ -204,17 +202,32 @@ def map_chunked(fn, arrs, nchunks: int):
 
     Sequentializes the stage into ``nchunks`` pieces (each a full-width matmul
     over a batch slice) so XLA's per-step temporaries shrink by ``nchunks``;
-    results are concatenated back along the leading axis. ``nchunks`` must
-    divide the common leading extent. ``fn`` may return one array or a tuple.
+    results are concatenated back along the leading axis. The batch axis is
+    zero-padded up to a chunk multiple (padding rows flow through the stage as
+    zeros and are sliced off), so ``nchunks`` need not divide the extent.
+    ``fn`` may return one array or a tuple.
     """
     if nchunks <= 1:
         return fn(*arrs)
-    b = arrs[0].shape[0] // nchunks
+    n0 = arrs[0].shape[0]
+    b = -(-n0 // nchunks)
+    padded = nchunks * b
+    if padded != n0:
+        arrs = tuple(
+            jnp.concatenate(
+                [a, jnp.zeros((padded - n0, *a.shape[1:]), dtype=a.dtype)]
+            )
+            for a in arrs
+        )
     stacked = tuple(a.reshape(nchunks, b, *a.shape[1:]) for a in arrs)
     out = jax.lax.map(lambda chunk: fn(*chunk), stacked)
+
+    def unstack(o):
+        return o.reshape(o.shape[0] * o.shape[1], *o.shape[2:])[:n0]
+
     if isinstance(out, tuple):
-        return tuple(o.reshape(o.shape[0] * o.shape[1], *o.shape[2:]) for o in out)
-    return out.reshape(out.shape[0] * out.shape[1], *out.shape[2:])
+        return tuple(unstack(o) for o in out)
+    return unstack(out)
 
 
 def complex_matmul(xr, xi, wr, wi, spec: str, precision=_PRECISION):

@@ -176,29 +176,37 @@ class CopyPlan:
         out = None
         for pipe in self.pipes:
             rows = jnp.asarray(pipe.rows_sorted)
-            w = jnp.concatenate(
-                [jnp.take(src2, rows, axis=0), jnp.take(src2, rows + 1, axis=0)],
-                axis=1,
-            )  # (Rk, 2*LANE), covered blocks in shift order
-            pieces = []
-            off = 0
-            for t, c in enumerate(pipe.shift_counts):
-                if c == 0:
-                    continue
-                pieces.append(jax.lax.slice(w, (off, t), (off + c, t + LANE)))
-                off += c
-            # The barrier is a MISCOMPILE workaround, not an optimization: on the
-            # TPU backend (v5e, 2026-07), fusing the concat of >= 2 pieces lane-
-            # shifted by different amounts out of one buffer produces wrong values
-            # when the piece sublane counts are below the 8-row f32 tile (observed
-            # at Rk=2: two (1, 128) slices at shifts 5/77 of a (2, 256) buffer
-            # concat to garbage; each slice alone is correct). Keeping the pieces
-            # materialized before the concat sidesteps the bad fusion on every
-            # backend at negligible cost.
-            if len(pieces) > 1:
-                pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
-            aligned = jnp.concatenate(pieces, axis=0)
-            aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
+            if pipe.shift_counts[0] == pipe.rows_sorted.size:
+                # All runs lane-aligned (shift 0, the layout plan-time stick
+                # rotation engineers — see execution_mxu's alignment rotations):
+                # the whole shift machinery collapses to ONE row gather — no
+                # second-window concat, no per-shift slices, no barrier, no
+                # reorder (shift-sort of all-zeros is the natural order).
+                aligned = jnp.take(src2, rows, axis=0)
+            else:
+                w = jnp.concatenate(
+                    [jnp.take(src2, rows, axis=0), jnp.take(src2, rows + 1, axis=0)],
+                    axis=1,
+                )  # (Rk, 2*LANE), covered blocks in shift order
+                pieces = []
+                off = 0
+                for t, c in enumerate(pipe.shift_counts):
+                    if c == 0:
+                        continue
+                    pieces.append(jax.lax.slice(w, (off, t), (off + c, t + LANE)))
+                    off += c
+                # The barrier is a MISCOMPILE workaround, not an optimization: on
+                # the TPU backend (v5e, 2026-07), fusing the concat of >= 2 pieces
+                # lane-shifted by different amounts out of one buffer produces
+                # wrong values when the piece sublane counts are below the 8-row
+                # f32 tile (observed at Rk=2: two (1, 128) slices at shifts 5/77
+                # of a (2, 256) buffer concat to garbage; each slice alone is
+                # correct). Keeping the pieces materialized before the concat
+                # sidesteps the bad fusion on every backend at negligible cost.
+                if len(pieces) > 1:
+                    pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
+                aligned = jnp.concatenate(pieces, axis=0)
+                aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
             if pipe.mask is None:
                 # in-register range mask: two compares against iota instead of
                 # reading a (Rk, LANE) f32 constant from HBM
@@ -235,3 +243,54 @@ def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values:
 def build_compress_plan(value_indices: np.ndarray, num_slots: int, max_runs: int = 64):
     """Plan gathering packed values out of stick slots: dst = value pos, src = slot."""
     return CopyPlan.build(np.asarray(value_indices, dtype=np.int64), num_slots, max_runs)
+
+
+def plan_alignment_rotations(value_indices, num_sticks: int, dim_z: int, keep_zero=()):
+    """Per-stick cyclic z-rotations that lane-align the packed-value layout.
+
+    The engine's internal stick table may hold stick s's frequency-z axis under
+    any cyclic rotation ``delta_s``: by the DFT rotation theorem this only costs
+    a unit-magnitude per-(stick, k) phase on the space side of the z-DFT, one
+    fused elementwise multiply. Choosing ``delta_s`` so the stick's first
+    packed value lands at a slot congruent to its value position mod LANE makes
+    every affine run of BOTH copy plans lane-aligned (shift 0) whenever the
+    caller's per-stick z order is cyclically contiguous (the plane-wave layout,
+    reference: docs/source/details.rst:53) — ``CopyPlan.apply`` then collapses
+    to single row gathers (measured 5.7 ms -> ~1 ms pack/unpack at 256^3/15%
+    spherical, BASELINE.md).
+
+    Returns ``(delta, rotated_indices)`` — the (num_sticks,) rotation table and
+    the value->slot map under the rotated layout — or ``None`` when alignment
+    cannot help: ``dim_z`` not a LANE multiple (run bases shift at the stick
+    wrap), empty plan, or a caller order that is not predominantly
+    stick-contiguous (>= 90% of adjacent value pairs must advance z by one
+    within a stick; otherwise runs fragment regardless of rotation and the
+    phase multiply would be pure cost). Sticks in ``keep_zero`` (the hermitian
+    (0, 0) stick, whose in-place frequency-domain fill assumes the standard
+    layout) stay unrotated.
+    """
+    vi = np.asarray(value_indices, dtype=np.int64)
+    Z, S = int(dim_z), int(num_sticks)
+    if S == 0 or vi.size == 0 or Z % LANE != 0:
+        return None
+    stick = vi // Z
+    z = vi % Z
+    # alignment-benefit predictor: fraction of adjacent pairs that continue a
+    # cyclically ascending run within one stick
+    same = stick[1:] == stick[:-1]
+    if same.sum() == 0:
+        return None
+    steps = ((z[1:] - z[:-1]) % Z == 1) & same
+    if 10 * int(steps.sum()) < 9 * int(same.sum()):
+        return None
+    uniq, first_idx = np.unique(stick, return_index=True)
+    target = first_idx % LANE  # slot offset making run bases ≡ 0 (mod LANE)
+    delta = np.zeros(S, dtype=np.int64)
+    delta[uniq] = (target - z[first_idx]) % Z
+    for s in keep_zero:
+        if s is not None and 0 <= int(s) < S:
+            delta[int(s)] = 0
+    if not delta.any():
+        return None
+    rotated = stick * Z + (z + delta[stick]) % Z
+    return delta, rotated.astype(np.int64)

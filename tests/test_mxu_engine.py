@@ -174,3 +174,91 @@ def test_transform_engine_mxu_parity():
     c = tm.clone()
     assert c._engine == "mxu"
     assert_close(c.backward(values), tx.backward(values))
+
+
+@pytest.mark.parametrize("ttype", [TransformType.C2C, TransformType.R2C])
+def test_lane_alignment_rotation_path(ttype):
+    """The lane-alignment stick rotations (plan_alignment_rotations + the
+    phase undo around the z matmuls + CopyPlan.apply's shift-0 fast path) only
+    engage when dim_z is a LANE multiple and the caller order is
+    stick-contiguous — production sizes, which the small-dim tests never
+    reach. Pin the whole path at dz=128 against the dense oracle."""
+    from spfft_tpu import ProcessingUnit, Transform
+
+    rng = np.random.default_rng(77)
+    dx, dy, dz = 6, 7, 128
+    r2c = ttype == TransformType.R2C
+    # meshgrid-style stick-contiguous order with a contiguous wrapped-z run
+    # per stick (the plane-wave layout the rotation targets)
+    trips = []
+    ys = range(-((dy - 1) // 2), dy // 2 + 1)
+    # R2C: non-negative x, excluding the even-dx Nyquist plane (its internal
+    # conjugate redundancy is the caller's responsibility, as in the reference)
+    xs = range((dx + 1) // 2) if r2c else range(-((dx - 1) // 2), dx // 2 + 1)
+    for x in xs:
+        for y in ys:
+            if rng.random() < 0.3:
+                continue
+            h = int(rng.integers(3, dz // 2))
+            if r2c and x == 0 and y < 0:
+                continue  # redundant half of the x == 0 plane
+            lo = 0 if (r2c and x == 0 and y == 0) else -h
+            trips.extend((x, y, z) for z in range(lo, h + 1))
+    trip = np.asarray(trips)
+
+    if r2c:
+        real = rng.standard_normal((dz, dy, dx))
+        freq = np.fft.fftn(real) / (dx * dy * dz)
+        values = freq[trip[:, 2], trip[:, 1], trip[:, 0]]
+    else:
+        values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+    t = Transform(ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip, engine="mxu")
+    assert t._exec._phase is not None, "rotation path must engage at dz=128"
+    for plan in (t._exec._decompress_plan, t._exec._compress_plan):
+        assert all(
+            p.shift_counts[0] == p.rows_sorted.size for p in plan.pipes
+        ), "every pipe must be shift-0 aligned"
+
+    out = t.backward(values)
+    if r2c:
+        # the sparse stick set does not span the full spectrum, so compare
+        # against the unrotated XLA engine (hermitian completion included)
+        tx = Transform(ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip, engine="xla")
+        assert_close(out, tx.backward(values))
+    else:
+        assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, values)
+
+
+def test_map_chunked_pads_non_divisible_batch():
+    """map_chunked must handle any chunk count via zero-padding (a prime batch
+    must not fall back to per-row serialization), and the engine's chunked
+    x-stages must stay exact when forced on, including a non-divisible batch."""
+    import jax.numpy as jnp
+
+    from spfft_tpu.ops import fft as offt
+
+    x = np.arange(21.0).reshape(7, 3)  # 7 rows, 4 chunks -> pad to 8
+    out = offt.map_chunked(lambda a: a * 2.0, (jnp.asarray(x),), 4)
+    np.testing.assert_allclose(np.asarray(out), x * 2.0)
+    pair = offt.map_chunked(
+        lambda a, b: (a + b, a - b), (jnp.asarray(x), jnp.asarray(x * 3)), 2
+    )
+    np.testing.assert_allclose(np.asarray(pair[0]), x * 4.0)
+    np.testing.assert_allclose(np.asarray(pair[1]), x * -2.0)
+
+    from spfft_tpu import ProcessingUnit, Transform
+
+    rng = np.random.default_rng(9)
+    dx, dy, dz = 8, 10, 8
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                  indices=trip, engine="mxu")
+    t._exec._x_stage_chunks = 3  # force chunking (pad 10 -> 12) before first trace
+    out = t.backward(values)
+    assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, values)
