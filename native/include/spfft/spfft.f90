@@ -105,6 +105,24 @@ module spfft
       integer(c_int), intent(out) :: processingUnit
     end function
 
+    integer(c_int) function spfft_grid_max_local_z_length(grid, maxLocalZLength) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: maxLocalZLength
+    end function
+
+    integer(c_int) function spfft_grid_device_id(grid, deviceId) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: deviceId
+    end function
+
+    integer(c_int) function spfft_grid_num_threads(grid, numThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: numThreads
+    end function
+
     ! ---- distributed grid (single-controller mesh) --------------------------
 
     integer(c_int) function spfft_grid_create_distributed(grid, maxDimX, maxDimY, &
@@ -247,6 +265,43 @@ module spfft
       integer(c_int), value :: mode
     end function
 
+    integer(c_int) function spfft_transform_execution_mode(transform, mode) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: mode
+    end function
+
+    integer(c_int) function spfft_transform_type(transform, transformType) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: transformType
+    end function
+
+    integer(c_int) function spfft_transform_processing_unit(transform, &
+        processingUnit) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: processingUnit
+    end function
+
+    integer(c_int) function spfft_transform_local_slice_size(transform, size) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: size
+    end function
+
+    integer(c_int) function spfft_transform_device_id(transform, deviceId) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: deviceId
+    end function
+
+    integer(c_int) function spfft_transform_num_threads(transform, numThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: numThreads
+    end function
+
     ! ---- transform (float) --------------------------------------------------
 
     integer(c_int) function spfft_float_transform_create_independent(transform, &
@@ -289,6 +344,96 @@ module spfft
       type(c_ptr), intent(out) :: dataPtr
     end function
 
+    integer(c_int) function spfft_float_grid_create(grid, maxDimX, maxDimY, &
+        maxDimZ, maxNumLocalZColumns, processingUnit, maxNumThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: grid
+      integer(c_int), value :: maxDimX, maxDimY, maxDimZ
+      integer(c_int), value :: maxNumLocalZColumns, processingUnit, maxNumThreads
+    end function
+
+    integer(c_int) function spfft_float_transform_create(transform, grid, &
+        processingUnit, transformType, dimX, dimY, dimZ, localZLength, &
+        numLocalElements, indexFormat, indices) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      type(c_ptr), value :: grid
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, localZLength
+      integer(c_int), value :: numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
+    integer(c_int) function spfft_float_transform_clone(transform, newTransform) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      type(c_ptr), intent(out) :: newTransform
+    end function
+
+    integer(c_int) function spfft_float_transform_type(transform, transformType) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: transformType
+    end function
+
+    integer(c_int) function spfft_float_transform_dim_x(transform, dimX) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimX
+    end function
+
+    integer(c_int) function spfft_float_transform_dim_y(transform, dimY) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimY
+    end function
+
+    integer(c_int) function spfft_float_transform_dim_z(transform, dimZ) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: dimZ
+    end function
+
+    integer(c_int) function spfft_float_transform_local_z_length(transform, len) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: len
+    end function
+
+    integer(c_int) function spfft_float_transform_local_z_offset(transform, off) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: off
+    end function
+
+    integer(c_int) function spfft_float_transform_num_local_elements(transform, &
+        n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_float_transform_processing_unit(transform, &
+        processingUnit) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: processingUnit
+    end function
+
+    integer(c_int) function spfft_float_transform_execution_mode(transform, &
+        mode) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: mode
+    end function
+
+    integer(c_int) function spfft_float_transform_set_execution_mode(transform, &
+        mode) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), value :: mode
+    end function
+
     ! ---- multi-transform ----------------------------------------------------
 
     integer(c_int) function spfft_multi_transform_backward(numTransforms, &
@@ -301,6 +446,25 @@ module spfft
     end function
 
     integer(c_int) function spfft_multi_transform_forward(numTransforms, &
+        transforms, inputLocations, output, scalingTypes) bind(C)
+      use iso_c_binding
+      integer(c_int), value :: numTransforms
+      type(c_ptr), dimension(*), intent(in) :: transforms
+      integer(c_int), dimension(*), intent(in) :: inputLocations
+      type(c_ptr), dimension(*), intent(in) :: output
+      integer(c_int), dimension(*), intent(in) :: scalingTypes
+    end function
+
+    integer(c_int) function spfft_float_multi_transform_backward(numTransforms, &
+        transforms, input, outputLocations) bind(C)
+      use iso_c_binding
+      integer(c_int), value :: numTransforms
+      type(c_ptr), dimension(*), intent(in) :: transforms
+      type(c_ptr), dimension(*), intent(in) :: input
+      integer(c_int), dimension(*), intent(in) :: outputLocations
+    end function
+
+    integer(c_int) function spfft_float_multi_transform_forward(numTransforms, &
         transforms, inputLocations, output, scalingTypes) bind(C)
       use iso_c_binding
       integer(c_int), value :: numTransforms

@@ -1,0 +1,91 @@
+"""Installed-package consumption: the parity check for the reference's
+SpFFTConfig.cmake / SpFFT.pc (reference: cmake/SpFFTConfig.cmake,
+cmake/SpFFT.pc.in). Installs the native tree into a scratch prefix, then
+builds the consumer project in native/tests/consumer against it via
+find_package(SpFFTTPU), runs the linked binary, and validates the installed
+pkg-config file."""
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+NATIVE = ROOT / "native"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("g++") is None,
+    reason="native toolchain not available",
+)
+
+
+def _run(cmd, **kw):
+    return subprocess.run(cmd, check=True, capture_output=True, text=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def installed_prefix(tmp_path_factory):
+    # scratch build dir: must NOT touch a developer's native/build cache
+    build = tmp_path_factory.mktemp("spfft_tpu_pkg_build")
+    prefix = tmp_path_factory.mktemp("spfft_tpu_prefix")
+    _run(
+        ["cmake", "-S", str(NATIVE), "-B", str(build),
+         "-DCMAKE_BUILD_TYPE=Release", "-DSPFFT_TPU_BUILD_TESTS=OFF",
+         f"-DCMAKE_INSTALL_PREFIX={prefix}"]
+    )
+    _run(["cmake", "--build", str(build)])
+    _run(["cmake", "--install", str(build)])
+    return prefix
+
+
+def _libdir(prefix: Path) -> Path:
+    # GNUInstallDirs may resolve to lib or lib64 depending on the platform
+    for name in ("lib", "lib64"):
+        if (prefix / name / "pkgconfig" / "spfft_tpu.pc").exists():
+            return prefix / name
+    raise AssertionError(f"no installed libdir with spfft_tpu.pc under {prefix}")
+
+
+def test_consumer_cmake_build_against_installed_tree(installed_prefix, tmp_path):
+    build = tmp_path / "consumer-build"
+    _run(
+        ["cmake", "-S", str(NATIVE / "tests" / "consumer"), "-B", str(build),
+         f"-DCMAKE_PREFIX_PATH={installed_prefix}"]
+    )
+    _run(["cmake", "--build", str(build)])
+    out = _run(
+        [str(build / "consumer")],
+        env={
+            "LD_LIBRARY_PATH": str(_libdir(installed_prefix)),
+            "PATH": "/usr/bin:/bin",
+        },
+    )
+    assert "consumer link OK" in out.stdout
+
+
+def test_pkgconfig_file_installed_and_valid(installed_prefix):
+    pc = _libdir(installed_prefix) / "pkgconfig" / "spfft_tpu.pc"
+    assert pc.exists()
+    text = pc.read_text()
+    assert "-lspfft_tpu" in text
+    assert "Version: 0.2.0" in text
+    if shutil.which("pkg-config"):
+        env = {"PKG_CONFIG_PATH": str(pc.parent), "PATH": "/usr/bin:/bin"}
+        cflags = _run(["pkg-config", "--cflags", "spfft_tpu"], env=env).stdout
+        assert "include" in cflags
+        libs = _run(["pkg-config", "--libs", "spfft_tpu"], env=env).stdout
+        assert "-lspfft_tpu" in libs
+
+
+def test_version_macros_match_cmake_project():
+    cmake = (NATIVE / "CMakeLists.txt").read_text()
+    header = (NATIVE / "include" / "spfft" / "version.h").read_text()
+    import re
+
+    m = re.search(r"VERSION\s+(\d+)\.(\d+)\.(\d+)", cmake)
+    assert m, "project VERSION missing in native/CMakeLists.txt"
+    major, minor, patch = m.groups()
+    assert f"SPFFT_TPU_VERSION_MAJOR {major}" in header
+    assert f"SPFFT_TPU_VERSION_MINOR {minor}" in header
+    assert f"SPFFT_TPU_VERSION_PATCH {patch}" in header
+    assert f'"{major}.{minor}.{patch}"' in header
