@@ -1,0 +1,60 @@
+"""Shared parsers for the native API surface (C headers + Fortran module).
+
+Single source for everything that pattern-matches the shipped interface files:
+the surface-verification tests (tests/test_fortran_surface.py) and the API
+reference generator (programs/gen_api_docs.py) must see the SAME prototype
+set, so they parse through these helpers rather than private copies.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+F90_PATH = ROOT / "native" / "include" / "spfft" / "spfft.f90"
+C_HEADER_NAMES = ("grid.h", "transform.h", "multi_transform.h")
+C_HEADER_PATHS = tuple(
+    ROOT / "native" / "include" / "spfft" / name for name in C_HEADER_NAMES
+)
+
+
+def join_continuations(text: str) -> str:
+    """Fortran free-form: a trailing '&' continues the statement."""
+    return re.sub(r"&\s*\n\s*", " ", text)
+
+
+def strip_c_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.DOTALL)
+    text = re.sub(r"//[^\n]*", " ", text)
+    return text
+
+
+def fortran_functions(path: Path = F90_PATH) -> dict:
+    """{lowercased name: arg count} for every bind(C) function interface."""
+    text = join_continuations(path.read_text())
+    out = {}
+    for m in re.finditer(
+        r"function\s+(spfft_\w+)\s*\(([^)]*)\)\s*bind\s*\(\s*C", text, re.IGNORECASE
+    ):
+        args = [a.strip() for a in m.group(2).split(",") if a.strip()]
+        out[m.group(1).lower()] = len(args)
+    return out
+
+
+def c_prototypes(path: Path) -> list:
+    """[(name, [arg, ...]), ...] for every SpfftError-returning prototype,
+    in declaration order."""
+    joined = re.sub(r"\s+", " ", strip_c_comments(path.read_text()))
+    return [
+        (m.group(1), [a.strip() for a in m.group(2).split(",") if a.strip()])
+        for m in re.finditer(r"SpfftError\s+(spfft_\w+)\s*\(([^)]*)\)\s*;", joined)
+    ]
+
+
+def c_functions(paths=C_HEADER_PATHS) -> dict:
+    """{lowercased name: arg count} across the given headers."""
+    out = {}
+    for path in paths:
+        for name, args in c_prototypes(path):
+            out[name.lower()] = len(args)
+    return out

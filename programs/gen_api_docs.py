@@ -1,0 +1,337 @@
+"""Generate the API reference (docs/api/*.md) from live docstrings + headers.
+
+The analogue of the reference's Sphinx/Doxygen pages (reference:
+docs/source/{grid,transform,multi_transform,types,errors_c,...}.rst — 18
+pages): the Python pages are introspected from the installed package so they
+cannot drift from the code, the C page is rendered from the shipped headers,
+and the Fortran page from the bind(C) module. ``tests/test_api_docs.py``
+regenerates into a scratch dir and diffs against the committed pages, so a
+stale reference fails CI.
+
+Usage: python programs/gen_api_docs.py [outdir]   (default docs/api)
+"""
+from __future__ import annotations
+
+import enum as enum_mod
+import inspect
+import re
+import sys
+import textwrap
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "programs"))
+
+from api_surface import (  # noqa: E402
+    C_HEADER_NAMES,
+    F90_PATH,
+    c_prototypes,
+    fortran_functions,
+)
+
+
+def doc(obj) -> str:
+    d = inspect.getdoc(obj)
+    return d.strip() if d else ""
+
+
+def sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def class_page(title: str, intro: str, classes, functions=()) -> str:
+    out = [f"# {title}", "", intro.strip(), ""]
+    for cls in classes:
+        out += [f"## class `{cls.__name__}`", "", doc(cls), ""]
+        init = cls.__dict__.get("__init__")
+        if init is not None:
+            out += [f"### `{cls.__name__}{sig(init)}`", ""]
+            init_doc = doc(init)
+            if init_doc and not init_doc.startswith("Initialize self"):
+                out += [init_doc, ""]
+        members = []
+        for name, member in sorted(vars(cls).items()):
+            if name.startswith("_"):
+                continue
+            members.append((name, member))
+        props = [(n, m) for n, m in members if isinstance(m, property)]
+        methods = [(n, m) for n, m in members if inspect.isfunction(m)]
+        if props:
+            out += ["### Properties", ""]
+            for name, p in props:
+                line = f"- **`{name}`**"
+                if doc(p):
+                    line += f" — {doc(p).splitlines()[0]}"
+                out.append(line)
+            out.append("")
+        if methods:
+            out += ["### Methods", ""]
+            for name, m in methods:
+                out += [f"#### `{name}{sig(m)}`", ""]
+                if doc(m):
+                    out += [doc(m), ""]
+    for fn in functions:
+        out += [f"## `{fn.__name__}{sig(fn)}`", ""]
+        if doc(fn):
+            out += [doc(fn), ""]
+    return "\n".join(out).rstrip() + "\n"
+
+
+def enum_page() -> str:
+    import spfft_tpu as sp
+
+    enums = [
+        sp.TransformType,
+        sp.ProcessingUnit,
+        sp.IndexFormat,
+        sp.ScalingType,
+        sp.ExecType,
+        sp.ExchangeType,
+    ]
+    out = [
+        "# Types",
+        "",
+        "Enum surface, ABI-compatible with the reference C enums"
+        " (`SPFFT_*` integer aliases are exported at package level"
+        " for ported code).",
+        "",
+    ]
+    for e in enums:
+        out += [f"## `{e.__name__}`", "", doc(e), "", "| name | value |", "|---|---|"]
+        for member in e:
+            out.append(f"| `{member.name}` | {int(member.value)} |")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def errors_page() -> str:
+    import spfft_tpu.errors as err
+
+    out = [
+        "# Errors",
+        "",
+        doc(err) or "Exception hierarchy and C error codes.",
+        "",
+        "## Error codes (`ErrorCode`)",
+        "",
+        "| name | value |",
+        "|---|---|",
+    ]
+    for member in err.ErrorCode:
+        out.append(f"| `{member.name}` | {int(member.value)} |")
+    out += ["", "## Exceptions", ""]
+    for name, cls in sorted(vars(err).items()):
+        if inspect.isclass(cls) and issubclass(cls, Exception):
+            bases = ", ".join(b.__name__ for b in cls.__bases__)
+            first = doc(cls).splitlines()[0] if doc(cls) else ""
+            out.append(f"- **`{name}`**({bases}) — {first}")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def c_api_page() -> str:
+    headers = ["errors.h", "types.h", "grid.h", "transform.h", "multi_transform.h"]
+    out = [
+        "# C API",
+        "",
+        "Opaque-handle C interface of `libspfft_tpu` (link via"
+        " `find_package(SpFFTTPU)` or `pkg-config spfft_tpu`; see"
+        " [installation](installation.md)). Every function returns"
+        " `SpfftError`. The float (`spfft_float_*`) entry points mirror the"
+        " double ones at single precision.",
+        "",
+    ]
+    for header in headers:
+        path = ROOT / "native" / "include" / "spfft" / header
+        protos = c_prototypes(path)
+        out += [f"## `<spfft/{header}>`", ""]
+        if not protos:
+            out += [
+                "Enum/typedef surface only (values tabulated in"
+                " [types](types.md) and [errors](errors.md)).",
+                "",
+            ]
+            continue
+        for name, args in protos:
+            out.append(f"- `SpfftError {name}({', '.join(args)})`")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def fortran_page() -> str:
+    names = list(fortran_functions(F90_PATH))
+    out = [
+        "# Fortran module",
+        "",
+        "`module spfft` (`native/include/spfft/spfft.f90`): `bind(C)`"
+        " interfaces over the whole C API plus the enum constants, compiled"
+        " into the application like the reference's module. Surface is"
+        " machine-checked against the C headers by"
+        " `tests/test_fortran_surface.py`.",
+        "",
+        f"{len(names)} bound functions:",
+        "",
+    ]
+    out += [f"- `{n}`" for n in names]
+    return "\n".join(out).rstrip() + "\n"
+
+
+def examples_page() -> str:
+    out = [
+        "# Examples",
+        "",
+        "Runnable sources in `examples/` (the reference ships the same set:"
+        " C, C++, Fortran and a mini application).",
+        "",
+    ]
+    lang = {".py": "python", ".c": "c", ".cpp": "cpp", ".f90": "fortran"}
+    paths = [
+        p
+        for p in sorted((ROOT / "examples").iterdir())
+        if p.is_file() and p.suffix in lang
+    ]
+    for path in paths:
+        out += [
+            f"## `{path.name}`",
+            "",
+            f"```{lang.get(path.suffix, '')}",
+            path.read_text().rstrip(),
+            "```",
+            "",
+        ]
+    return "\n".join(out).rstrip() + "\n"
+
+
+def installation_page() -> str:
+    return textwrap.dedent(
+        """\
+        # Installation
+
+        ## Python package
+
+        The package is pure Python over JAX; put the repository root on
+        `PYTHONPATH` (or `pip install -e .`-style vendoring into your tree)
+        and `import spfft_tpu`. Dependencies: `jax`, `numpy`, `ml_dtypes`
+        (all standard in a JAX TPU environment).
+
+        ## Native library
+
+        ```sh
+        cmake -S native -B native/build -DCMAKE_BUILD_TYPE=Release \\
+              -DCMAKE_INSTALL_PREFIX=/opt/spfft_tpu
+        cmake --build native/build
+        cmake --install native/build
+        ```
+
+        Installs `libspfft_tpu` (embedded-CPython runtime over the same
+        compute core), the `spfft/*.h` headers, the Fortran module source,
+        `SpFFTTPUConfig.cmake` (consume with
+        `find_package(SpFFTTPU); target_link_libraries(app SpFFTTPU::spfft_tpu)`)
+        and `spfft_tpu.pc` for pkg-config builds. The embedded interpreter
+        needs `spfft_tpu` importable at runtime (`PYTHONPATH`).
+
+        ## Verifying
+
+        `python -m pytest tests/ -x -q` runs the full suite on a virtual
+        8-device CPU mesh; `python bench.py` prints the headline benchmark on
+        the attached accelerator.
+        """
+    )
+
+
+def index_page() -> str:
+    import spfft_tpu as sp
+
+    return textwrap.dedent(
+        f"""\
+        # spfft_tpu API reference (v{sp.__version__})
+
+        {doc(sp).splitlines()[0]}
+
+        Generated by `programs/gen_api_docs.py` from the live package —
+        regenerate after API changes (`tests/test_api_docs.py` enforces it).
+
+        - [Installation](installation.md)
+        - [Types and enums](types.md)
+        - [Errors](errors.md)
+        - [Grid](grid.md)
+        - [Transform](transform.md)
+        - [Distributed transform](distributed.md)
+        - [Multi-transforms](multi_transform.md)
+        - [Index helpers and mesh utilities](utilities.md)
+        - [C API](c_api.md)
+        - [Fortran module](fortran.md)
+        - [Examples](examples.md)
+
+        Architecture and semantics prose lives in [docs/details.md]
+        (../details.md); porting notes from the reference library in
+        [docs/MIGRATION.md](../MIGRATION.md).
+        """
+    )
+
+
+def generate(outdir: Path) -> None:
+    import spfft_tpu as sp
+    from spfft_tpu import timing
+    from spfft_tpu.parallel import mesh
+
+    outdir.mkdir(parents=True, exist_ok=True)
+    pages = {
+        "index.md": index_page(),
+        "installation.md": installation_page(),
+        "types.md": enum_page(),
+        "errors.md": errors_page(),
+        "grid.md": class_page(
+            "Grid",
+            "Transform capacity holder (local and mesh-distributed ctors).",
+            [sp.Grid],
+        ),
+        "transform.md": class_page(
+            "Transform",
+            "Local sparse 3D FFT plans (`TransformFloat` is the single-"
+            "precision alias; precision is otherwise a `dtype` argument).",
+            [sp.Transform],
+        ),
+        "distributed.md": class_page(
+            "DistributedTransform",
+            "Mesh-sharded transforms (1-D slab and 2-D pencil decompositions).",
+            [sp.DistributedTransform],
+        ),
+        "multi_transform.md": class_page(
+            "Multi-transforms",
+            "Batched pipelined execution of independent transforms.",
+            [],
+            [sp.multi_transform_backward, sp.multi_transform_forward],
+        ),
+        "utilities.md": class_page(
+            "Utilities",
+            "Index generation, stick distribution, mesh construction, "
+            "multi-host init, and the timing subsystem "
+            "(`spfft_tpu.timing` mirrors the reference's rt_graph).",
+            [],
+            [
+                sp.create_spherical_cutoff_triplets,
+                sp.spherical_radius_for_fraction,
+                sp.distribute_triplets,
+                sp.make_fft_mesh,
+                sp.make_fft_mesh2,
+                sp.init_distributed,
+                mesh.ensure_virtual_devices,
+                timing.enable,
+                timing.scoped,
+            ],
+        ),
+        "c_api.md": c_api_page(),
+        "fortran.md": fortran_page(),
+        "examples.md": examples_page(),
+    }
+    for name, content in pages.items():
+        (outdir / name).write_text(content)
+    print(f"wrote {len(pages)} pages to {outdir}")
+
+
+if __name__ == "__main__":
+    generate(Path(sys.argv[1]) if len(sys.argv) > 1 else ROOT / "docs" / "api")

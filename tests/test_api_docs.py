@@ -1,0 +1,28 @@
+"""The committed API reference (docs/api) must match a fresh regeneration —
+the generated-docs analogue of the reference keeping docs/source/*.rst in its
+tree (reference: docs/source). A drifted page means an API change shipped
+without `python programs/gen_api_docs.py`."""
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_api_reference_is_current(tmp_path):
+    out = tmp_path / "api"
+    subprocess.run(
+        [sys.executable, str(ROOT / "programs" / "gen_api_docs.py"), str(out)],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    committed = ROOT / "docs" / "api"
+    fresh = {p.name: p.read_text() for p in out.glob("*.md")}
+    existing = {p.name: p.read_text() for p in committed.glob("*.md")}
+    assert fresh.keys() == existing.keys(), (
+        sorted(fresh.keys() ^ existing.keys()),
+        "page set drifted — rerun programs/gen_api_docs.py",
+    )
+    stale = [name for name in fresh if fresh[name] != existing[name]]
+    assert not stale, f"stale API pages {stale} — rerun programs/gen_api_docs.py"

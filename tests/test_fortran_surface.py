@@ -5,55 +5,22 @@ module in CI, reference: include/spfft/spfft.f90 + .github workflows), so the
 next-best check runs here: every ``bind(C)`` interface in
 ``native/include/spfft/spfft.f90`` must name a real C API function with the
 same arity, and every C API function must carry a Fortran binding — a typo in
-468 lines of interface blocks fails this test instead of a downstream user's
-link step. When a Fortran compiler is present, the module itself is
-syntax-checked too.
+the interface blocks fails this test instead of a downstream user's link
+step. When a Fortran compiler is present, the module itself is syntax-checked
+too. Parsers are shared with the API-reference generator
+(programs/api_surface.py), so docs and verification see the same surface.
 """
-import re
 import shutil
 import subprocess
+import sys
 from pathlib import Path
 
 import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
-F90 = ROOT / "native" / "include" / "spfft" / "spfft.f90"
-HEADERS = [
-    ROOT / "native" / "include" / "spfft" / name
-    for name in ("grid.h", "transform.h", "multi_transform.h")
-]
+sys.path.insert(0, str(ROOT / "programs"))
 
-
-def _join_continuations(text: str) -> str:
-    # Fortran free-form: trailing '&' continues the statement
-    return re.sub(r"&\s*\n\s*", " ", text)
-
-
-def fortran_functions() -> dict:
-    text = _join_continuations(F90.read_text())
-    out = {}
-    for m in re.finditer(
-        r"function\s+(spfft_\w+)\s*\(([^)]*)\)\s*bind\s*\(\s*C", text, re.IGNORECASE
-    ):
-        name = m.group(1).lower()
-        args = [a.strip() for a in m.group(2).split(",") if a.strip()]
-        out[name] = len(args)
-    return out
-
-
-def c_functions() -> dict:
-    out = {}
-    for header in HEADERS:
-        text = header.read_text()
-        # strip comments, join lines, then match prototypes
-        text = re.sub(r"/\*.*?\*/", " ", text, flags=re.DOTALL)
-        text = re.sub(r"//[^\n]*", " ", text)
-        joined = re.sub(r"\s+", " ", text)
-        for m in re.finditer(r"SpfftError\s+(spfft_\w+)\s*\(([^)]*)\)\s*;", joined):
-            name = m.group(1).lower()
-            args = [a.strip() for a in m.group(2).split(",") if a.strip()]
-            out[name] = len(args)
-    return out
+from api_surface import F90_PATH, c_functions, fortran_functions  # noqa: E402
 
 
 def test_every_fortran_binding_names_a_real_c_function_with_same_arity():
@@ -62,9 +29,7 @@ def test_every_fortran_binding_names_a_real_c_function_with_same_arity():
     assert fortran, "no bind(C) interfaces parsed from spfft.f90"
     missing = sorted(set(fortran) - set(c))
     assert not missing, f"Fortran bindings without a C function: {missing}"
-    mismatched = sorted(
-        name for name in fortran if fortran[name] != c[name]
-    )
+    mismatched = sorted(name for name in fortran if fortran[name] != c[name])
     assert not mismatched, {
         name: (fortran[name], c[name]) for name in mismatched
     }
@@ -90,6 +55,6 @@ def test_fortran_module_compiles_when_compiler_available():
     if fc is None:
         pytest.skip("no Fortran compiler in this environment")
     result = subprocess.run(
-        [fc, "-fsyntax-only", str(F90)], capture_output=True, text=True
+        [fc, "-fsyntax-only", str(F90_PATH)], capture_output=True, text=True
     )
     assert result.returncode == 0, result.stderr

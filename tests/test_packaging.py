@@ -4,6 +4,8 @@ cmake/SpFFT.pc.in). Installs the native tree into a scratch prefix, then
 builds the consumer project in native/tests/consumer against it via
 find_package(SpFFTTPU), runs the linked binary, and validates the installed
 pkg-config file."""
+import os
+import re
 import shutil
 import subprocess
 from pathlib import Path
@@ -53,14 +55,26 @@ def test_consumer_cmake_build_against_installed_tree(installed_prefix, tmp_path)
          f"-DCMAKE_PREFIX_PATH={installed_prefix}"]
     )
     _run(["cmake", "--build", str(build)])
+    libdir = str(_libdir(installed_prefix))
+    inherited = os.environ.get("LD_LIBRARY_PATH", "")
     out = _run(
         [str(build / "consumer")],
+        # extend, don't replace: libpython (a private dependency of the lib)
+        # may only resolve through the inherited loader path
         env={
-            "LD_LIBRARY_PATH": str(_libdir(installed_prefix)),
-            "PATH": "/usr/bin:/bin",
+            **os.environ,
+            "LD_LIBRARY_PATH": f"{libdir}:{inherited}" if inherited else libdir,
         },
     )
     assert "consumer link OK" in out.stdout
+
+
+def _cmake_project_version() -> str:
+    m = re.search(
+        r"VERSION\s+(\d+\.\d+\.\d+)", (NATIVE / "CMakeLists.txt").read_text()
+    )
+    assert m, "project VERSION missing in native/CMakeLists.txt"
+    return m.group(1)
 
 
 def test_pkgconfig_file_installed_and_valid(installed_prefix):
@@ -68,9 +82,9 @@ def test_pkgconfig_file_installed_and_valid(installed_prefix):
     assert pc.exists()
     text = pc.read_text()
     assert "-lspfft_tpu" in text
-    assert "Version: 0.2.0" in text
+    assert f"Version: {_cmake_project_version()}" in text
     if shutil.which("pkg-config"):
-        env = {"PKG_CONFIG_PATH": str(pc.parent), "PATH": "/usr/bin:/bin"}
+        env = {**os.environ, "PKG_CONFIG_PATH": str(pc.parent)}
         cflags = _run(["pkg-config", "--cflags", "spfft_tpu"], env=env).stdout
         assert "include" in cflags
         libs = _run(["pkg-config", "--libs", "spfft_tpu"], env=env).stdout
@@ -78,14 +92,14 @@ def test_pkgconfig_file_installed_and_valid(installed_prefix):
 
 
 def test_version_macros_match_cmake_project():
-    cmake = (NATIVE / "CMakeLists.txt").read_text()
     header = (NATIVE / "include" / "spfft" / "version.h").read_text()
-    import re
-
-    m = re.search(r"VERSION\s+(\d+)\.(\d+)\.(\d+)", cmake)
-    assert m, "project VERSION missing in native/CMakeLists.txt"
-    major, minor, patch = m.groups()
+    version = _cmake_project_version()
+    major, minor, patch = version.split(".")
     assert f"SPFFT_TPU_VERSION_MAJOR {major}" in header
     assert f"SPFFT_TPU_VERSION_MINOR {minor}" in header
     assert f"SPFFT_TPU_VERSION_PATCH {patch}" in header
-    assert f'"{major}.{minor}.{patch}"' in header
+    assert f'"{version}"' in header
+    # the Python package must carry the same version (was comment-enforced)
+    import spfft_tpu
+
+    assert spfft_tpu.__version__ == version
