@@ -102,8 +102,7 @@ class MxuLocalExecution(ExecutionBase):
         )
         if rot is not None:
             delta, self._vi = rot
-            theta = 2.0 * np.pi * np.outer(delta, np.arange(Z)) / Z
-            self._phase = (np.cos(theta).astype(rt), np.sin(theta).astype(rt))
+            self._phase = lanecopy.alignment_phase_tables(delta, Z, rt)
         else:
             self._vi = np.asarray(p.value_indices, dtype=np.int64)
             self._phase = None
@@ -203,9 +202,10 @@ class MxuLocalExecution(ExecutionBase):
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
             if self._phase is not None:
-                # undo the alignment rotations: x e^{-i theta} (fused multiply)
-                pr, ps = jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1])
-                sre, sim = sre * pr + sim * ps, sim * pr - sre * ps
+                # undo the alignment rotations (fused multiply)
+                sre, sim = lanecopy.apply_alignment_phase(
+                    sre, sim, jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1]), -1
+                )
         with jax.named_scope("expand"):
             gre, gim = self._expand(sre, sim)
 
@@ -261,9 +261,10 @@ class MxuLocalExecution(ExecutionBase):
 
         with jax.named_scope("z transform"):
             if self._phase is not None:
-                # enter the rotated layout: x e^{+i theta} on the space side
-                pr, ps = jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1])
-                sre, sim = sre * pr - sim * ps, sim * pr + sre * ps
+                # enter the rotated layout on the space side (fused multiply)
+                sre, sim = lanecopy.apply_alignment_phase(
+                    sre, sim, jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1]), +1
+                )
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
             )

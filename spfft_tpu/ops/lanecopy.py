@@ -294,3 +294,26 @@ def plan_alignment_rotations(value_indices, num_sticks: int, dim_z: int, keep_ze
         return None
     rotated = stick * Z + (z + delta[stick]) % Z
     return delta, rotated.astype(np.int64)
+
+
+def alignment_phase_tables(deltas, dim_z: int, real_dtype):
+    """(cos, sin) tables for the alignment rotations: shape ``deltas.shape +
+    (dim_z,)`` with ``theta[..., s, k] = 2 pi delta_s k / Z``. Single source
+    for every engine's table build (the sign convention lives in
+    :func:`apply_alignment_phase`)."""
+    deltas = np.asarray(deltas)
+    theta = 2.0 * np.pi * deltas[..., None] * np.arange(int(dim_z)) / int(dim_z)
+    return np.cos(theta).astype(real_dtype), np.sin(theta).astype(real_dtype)
+
+
+def apply_alignment_phase(re, im, cos_t, sin_t, sign: int):
+    """Fused multiply of the (re, im) pair by ``e^{sign * i theta}``.
+
+    ``sign=-1`` after the backward z matmul (undo the rotation on the space
+    side), ``sign=+1`` before the forward z matmul (enter the rotated layout).
+    THE sign convention for the whole rotation scheme — every engine calls
+    this instead of hand-writing the complex multiply, so a convention change
+    is one edit."""
+    if sign < 0:
+        return re * cos_t + im * sin_t, im * cos_t - re * sin_t
+    return re * cos_t - im * sin_t, im * cos_t + re * sin_t

@@ -94,6 +94,17 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
+            if self._align_phase is not None:
+                # undo the alignment rotations; the (P, S, Z) tables ride as
+                # program constants indexed by shard — fine at pencil shard
+                # counts (the 1-D engine shards them instead)
+                from ..ops import lanecopy
+
+                sre, sim = lanecopy.apply_alignment_phase(
+                    sre, sim,
+                    jnp.asarray(self._align_phase[0])[s_me],
+                    jnp.asarray(self._align_phase[1])[s_me], -1,
+                )
 
         # pack A: my sticks split by destination (x-group, z-slab)
         with jax.named_scope("pack"):
@@ -205,6 +216,15 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             sim = sim[: S * Z].reshape(S, Z)
 
         with jax.named_scope("z transform"):
+            if self._align_phase is not None:
+                # enter the rotated layout on the space side
+                from ..ops import lanecopy
+
+                sre, sim = lanecopy.apply_alignment_phase(
+                    sre, sim,
+                    jnp.asarray(self._align_phase[0])[s_me],
+                    jnp.asarray(self._align_phase[1])[s_me], +1,
+                )
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
             )

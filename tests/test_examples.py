@@ -1,0 +1,27 @@
+"""The shipped Python examples must run — the reference builds its examples in
+CI (reference: examples/ + CMake example targets), so a bit-rotted example is
+a test failure here, not a user's first impression."""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+EXAMPLES = [ROOT / "examples" / "example.py", ROOT / "examples" / "poisson.py"]
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
+def test_python_example_runs(script):
+    # force the portable CPU backend: the dev environment pins an accelerator
+    # platform via env that a fresh subprocess may not be able to initialize
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    result = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]

@@ -344,3 +344,40 @@ def test_pencil2_mesh_size_mismatch_rejected():
     per_shard = distribute_triplets(trip, 4, 8)
     with pytest.raises(Exception):
         build(2, 4, (8, 8, 8), per_shard)  # 4 shard lists over an 8-device mesh
+
+
+@pytest.mark.parametrize("ttype", [TransformType.C2C, TransformType.R2C])
+def test_pencil2_mxu_lane_alignment_rotation_path(ttype):
+    """dz=128 engages the lane-alignment rotations in the pencil MXU engine
+    (phase tables as shard-indexed constants): oracle + roundtrip must hold,
+    R2C covering the keep_zero hermitian-stick handling."""
+    from utils import contiguous_stick_triplets
+
+    rng = np.random.default_rng(79)
+    dx, dy, dz = 6, 8, 128
+    r2c = ttype == TransformType.R2C
+    trip = contiguous_stick_triplets(rng, dx, dy, dz, r2c=r2c)
+    if r2c:
+        real = rng.standard_normal((dz, dy, dx))
+        values = (np.fft.fftn(real) / (dx * dy * dz))[trip[:, 2], trip[:, 1], trip[:, 0]]
+    else:
+        values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+    t = DistributedTransform(
+        ProcessingUnit.HOST, ttype, dx, dy, dz, per_shard,
+        mesh=sp.make_fft_mesh2(2, 2), engine="mxu",
+    )
+    assert t._exec._align_phase is not None, "rotations must engage at dz=128"
+    out = t.backward(vps)
+    if r2c:
+        ref = DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz,
+            [p.copy() for p in per_shard], mesh=sp.make_fft_mesh2(2, 2), engine="xla",
+        )
+        assert_close(out, ref.backward([v.copy() for v in vps]))
+    else:
+        assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)

@@ -102,3 +102,23 @@ def split_values(triplets_per_shard, full_triplets, full_values):
     """Look up each shard's values from a global (triplet -> value) map."""
     lut = {tuple(t): v for t, v in zip(map(tuple, full_triplets), full_values)}
     return [np.asarray([lut[tuple(t)] for t in trip]) for trip in triplets_per_shard]
+
+
+def contiguous_stick_triplets(rng, dx, dy, dz, drop=0.3, r2c=False):
+    """Meshgrid-style stick-contiguous caller order with a contiguous wrapped-z
+    run per stick — the plane-wave layout the lane-alignment rotations target.
+    For R2C: non-negative x excluding the even-dx Nyquist plane (its internal
+    conjugate redundancy is the caller's responsibility, as in the reference),
+    and only the non-redundant half of the x == 0 plane."""
+    trips = []
+    xs = range((dx + 1) // 2) if r2c else range(-((dx - 1) // 2), dx // 2 + 1)
+    for x in xs:
+        for y in range(-((dy - 1) // 2), dy // 2 + 1):
+            if rng.random() < drop:
+                continue
+            h = int(rng.integers(3, dz // 2))
+            if r2c and x == 0 and y < 0:
+                continue
+            lo = 0 if (r2c and x == 0 and y == 0) else -h
+            trips.extend((x, y, z) for z in range(lo, h + 1))
+    return np.asarray(trips)
