@@ -100,6 +100,7 @@ class DistributedTransform:
         from .ops.fft import resolve_precision
 
         resolve_precision(precision)  # validate up front on every engine path
+        self._precision = precision
 
         # Engine selection mirrors the local Transform: the MXU engine (matmul
         # DFT stages + lane-copy value plans) wins on accelerator meshes; the
@@ -222,6 +223,42 @@ class DistributedTransform:
     def _finalize_forward(self, pair):
         """Host-side completion of a dispatched forward (fetch + unpad)."""
         return self._exec.unpad_values(pair)
+
+    def clone(self) -> "DistributedTransform":
+        """Create an independent distributed transform with identical layout.
+
+        Reference: include/spfft/transform.hpp:133 — clone deep-copies the
+        grid so the clone never shares buffers; here the compiled pipelines
+        and retained space buffers are per-object already, so a clone is a
+        fresh plan over the same mesh/shard geometry and engine."""
+        from .transform import storage_triplets_from
+
+        p = self._params
+        per_shard = [
+            storage_triplets_from(
+                p.value_indices[r, : int(p.num_values_per_shard[r])],
+                p.stick_x_all[r],
+                p.stick_y_all[r],
+                p.dim_z,
+            )
+            for r in range(p.num_shards)
+        ]
+        engine = "xla" if self._engine in ("xla", "pencil2") else "mxu"
+        return DistributedTransform(
+            self._processing_unit,
+            p.transform_type,
+            p.dim_x,
+            p.dim_y,
+            p.dim_z,
+            per_shard,
+            mesh=self._mesh,
+            local_z_lengths=np.asarray(p.local_z_lengths).copy(),
+            exchange_type=self.exchange_type,
+            grid=self._grid,
+            dtype=self._real_dtype,
+            engine=engine,
+            precision=self._precision,
+        )
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
         """Global trimmed space-domain array of the most recent result.

@@ -423,13 +423,22 @@ def _validate_data_location(pu) -> ProcessingUnit:
     return pu
 
 
+def storage_triplets_from(value_indices, stick_x, stick_y, dim_z) -> np.ndarray:
+    """Decode a value->slot map (``stick_id * dim_z + z``) back to storage-order
+    index triplets — THE inverse of the value-index wire rule
+    (indices.convert_index_triplets). Single decoder shared by both clone()
+    implementations; an encoding change is one edit here."""
+    vi = np.asarray(value_indices, dtype=np.int64)
+    stick_of_value = vi // dim_z
+    z = vi % dim_z
+    x = np.asarray(stick_x, dtype=np.int64)[stick_of_value]
+    y = np.asarray(stick_y, dtype=np.int64)[stick_of_value]
+    return np.stack([x, y, z], axis=1).astype(np.int32)
+
+
 def _storage_triplets(p) -> np.ndarray:
     """Reconstruct storage-order index triplets from plan metadata (for clone)."""
-    stick_of_value = p.value_indices // p.dim_z
-    z = p.value_indices % p.dim_z
-    x = p.stick_x[stick_of_value]
-    y = p.stick_y[stick_of_value]
-    return np.stack([x, y, z], axis=1).astype(np.int32)
+    return storage_triplets_from(p.value_indices, p.stick_x, p.stick_y, p.dim_z)
 
 
 class TransformFloat(Transform):

@@ -363,3 +363,55 @@ def test_mesh_size_mismatch_rejected():
             per_shard,
             mesh=make_mesh(2),
         )
+
+
+def test_distributed_clone():
+    """clone() yields an independent plan with identical layout on the same
+    mesh (reference: include/spfft/transform.hpp:133), on both the slab and
+    pencil decompositions."""
+    rng = np.random.default_rng(91)
+    dims = (8, 9, 10)
+    dx, dy, dz = dims
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+
+    from spfft_tpu import make_fft_mesh2
+
+    for mesh in (make_mesh(4), make_fft_mesh2(2, 2)):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+            [p.copy() for p in per_shard], mesh=mesh,
+        )
+        expected = t.backward([v.copy() for v in vps])
+        c = t.clone()
+        assert c is not t and c.num_shards == t.num_shards
+        assert c.exchange_type == t.exchange_type
+        out = c.backward([v.copy() for v in vps])
+        assert_close(out, expected)
+        back = c.forward(scaling=ScalingType.FULL)
+        for r, vals in enumerate(vps):
+            assert_close(back[r], vals)
+        # independence: the clone's retained space buffer is its own
+        assert c._space_data is not t._space_data
+
+    # R2C: the hermitian half-set must round-trip through clone's triplet decode
+    r = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(r) / (dx * dy * dz)
+    xs = np.arange(dx // 2 + 1)
+    r2c_trip = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    r2c_shards = distribute_triplets(r2c_trip, 4, dy)
+    r2c_vps = [freq[p[:, 2], p[:, 1], p[:, 0]] for p in r2c_shards]
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz, r2c_shards,
+        mesh=make_mesh(4), exchange_type=ExchangeType.COMPACT_BUFFERED,
+    )
+    c = t.clone()
+    assert c.exchange_type == ExchangeType.COMPACT_BUFFERED
+    assert_close(c.backward([v.copy() for v in r2c_vps]), r)
+    back = c.forward(scaling=ScalingType.FULL)
+    for i, vals in enumerate(r2c_vps):
+        assert_close(back[i], vals)
