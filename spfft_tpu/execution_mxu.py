@@ -89,27 +89,73 @@ class MxuLocalExecution(ExecutionBase):
 
         # ---- sparse copy plans + expansion map ----
         S, Z = p.num_sticks, p.dim_z
+
+        # Sparse-y stage (opt-in, SPFFT_TPU_SPARSE_Y=1; C2C only): group the
+        # sticks by active-x slot into an (A, Sy_max, Z) table and contract the
+        # y-DFT only over each slot's sticks via per-slot gathered DFT rows —
+        # the y-occupancy analogue of the uniqueXIndices compaction (stick
+        # table rows relabel s -> a*Sy + j; the expand gather and the forward
+        # pack disappear). Cuts y-stage flops by ~Sy_max/dim_y at spherical
+        # cutoffs, at the price of A*Sy - S extra padded z-matmul rows.
+        # Default OFF until measured on hardware (docs/ROADMAP.md P1).
+        import os as _os
+
+        self._sparse_y = False
+        value_indices = np.asarray(p.value_indices, dtype=np.int64)
+        if (
+            _os.environ.get("SPFFT_TPU_SPARSE_Y", "0") == "1"
+            and not r2c
+            and p.num_sticks
+        ):
+            cnt = np.bincount(xslot, minlength=A)
+            # same sublane-padding policy as the x compaction (shared quantum)
+            Sy = offt.compact_x_extent(int(cnt.max()), p.dim_y)
+            if Sy < p.dim_y:
+                self._sparse_y = True
+                self._sy = Sy
+                # j = running index of each stick within its slot, in stick-id
+                # order (preserves the caller's per-slot contiguity)
+                order = np.argsort(xslot, kind="stable")
+                j_of_stick = np.empty(S, dtype=np.int64)
+                j_of_stick[order] = np.arange(S) - np.repeat(
+                    np.cumsum(cnt) - cnt, cnt
+                )
+                row_of_stick = xslot * Sy + j_of_stick
+                stick_of_value = value_indices // Z
+                value_indices = row_of_stick[stick_of_value] * Z + value_indices % Z
+                # per-slot gathered y-DFT rows (zero rows on padding slots)
+                y_flat = np.full(A * Sy, -1, dtype=np.int64)
+                y_flat[row_of_stick] = p.stick_y.astype(np.int64)
+                wyb = offt.c2c_matrix(p.dim_y, +1, row_perm=y_flat)  # (A*Sy, Y)
+                wyf = offt.c2c_matrix(p.dim_y, -1, row_perm=y_flat)
+                self._wy_b_sp = offt.matrix_pair(wyb.reshape(A, Sy, p.dim_y), rt)
+                self._wy_f_sp = offt.matrix_pair(wyf.reshape(A, Sy, p.dim_y), rt)
+
+        rows = A * self._sy if self._sparse_y else S
+        self._table_rows = rows
+
         # Lane-alignment stick rotations: rotate each stick's frequency-z axis
         # so every copy-plan run is shift-0 (CopyPlan.apply fast path), at the
         # cost of one fused per-(stick, k) phase multiply on the space side of
         # each z matmul (the DFT rotation theorem). Measured 5.7 -> ~1 ms
         # pack/unpack at the 256^3/15% headline (BASELINE.md). The hermitian
         # (0, 0) stick stays unrotated — its in-place freq-domain fill assumes
-        # the standard layout.
+        # the standard layout. Composes with sparse-y (rotations act on the
+        # relabeled rows).
         rot = lanecopy.plan_alignment_rotations(
-            p.value_indices, S, Z,
+            value_indices, rows, Z,
             keep_zero=(self._zero_stick_id,) if r2c else (),
         )
         if rot is not None:
             delta, self._vi = rot
             self._phase = lanecopy.alignment_phase_tables(delta, Z, rt)
         else:
-            self._vi = np.asarray(p.value_indices, dtype=np.int64)
+            self._vi = value_indices
             self._phase = None
         self._decompress_plan = lanecopy.build_decompress_plan(
-            self._vi, S * Z, p.num_values
+            self._vi, rows * Z, p.num_values
         )
-        self._compress_plan = lanecopy.build_compress_plan(self._vi, S * Z)
+        self._compress_plan = lanecopy.build_compress_plan(self._vi, rows * Z)
         yx_map = np.full(p.dim_y * A, S, dtype=np.int32)  # S -> zero row
         keys = p.stick_y.astype(np.int64) * A + xslot
         yx_map[keys] = np.arange(S)
@@ -143,19 +189,19 @@ class MxuLocalExecution(ExecutionBase):
 
     def _decompress(self, values_re, values_im):
         p = self.params
-        S, Z = p.num_sticks, p.dim_z
+        R, Z = self._table_rows, p.dim_z
         if self._decompress_plan is not None:
             plan = self._decompress_plan
-            sre = plan.apply(values_re).reshape(-1)[: S * Z].reshape(S, Z)
-            sim = plan.apply(values_im).reshape(-1)[: S * Z].reshape(S, Z)
+            sre = plan.apply(values_re).reshape(-1)[: R * Z].reshape(R, Z)
+            sim = plan.apply(values_im).reshape(-1)[: R * Z].reshape(R, Z)
             return sre, sim
         vi = jnp.asarray(np.asarray(self._vi, dtype=np.int32))
         out = []
         for v in (values_re, values_im):
-            flat = jnp.zeros(S * Z, dtype=v.dtype).at[vi].set(
+            flat = jnp.zeros(R * Z, dtype=v.dtype).at[vi].set(
                 v, mode="drop", unique_indices=True
             )
-            out.append(flat.reshape(S, Z))
+            out.append(flat.reshape(R, Z))
         return tuple(out)
 
     def _compress(self, sre, sim):
@@ -206,19 +252,31 @@ class MxuLocalExecution(ExecutionBase):
                 sre, sim = lanecopy.apply_alignment_phase(
                     sre, sim, jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1]), -1
                 )
-        with jax.named_scope("expand"):
-            gre, gim = self._expand(sre, sim)
-
-        if self.is_r2c and self._x0_slot is not None:
-            with jax.named_scope("plane symmetry"):
-                s = self._x0_slot
-                pre, pim = symmetry.hermitian_fill_1d_pair(
-                    gre[:, s, :], gim[:, s, :], axis=0
+        if self._sparse_y:
+            # per-slot y contraction straight off the stick table: no expand,
+            # y-DFT rows gathered per slot into the matrix constants
+            with jax.named_scope("y transform"):
+                A, Sy, Z = self._num_x_active, self._sy, p.dim_z
+                gre, gim = offt.complex_matmul(
+                    sre.reshape(A, Sy, Z), sim.reshape(A, Sy, Z),
+                    *self._wy_b_sp, "ajz,ajk->kaz", prec,
                 )
-                gre, gim = gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
+        else:
+            with jax.named_scope("expand"):
+                gre, gim = self._expand(sre, sim)
 
-        with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yxz,yk->kxz", prec)
+            if self.is_r2c and self._x0_slot is not None:
+                with jax.named_scope("plane symmetry"):
+                    s = self._x0_slot
+                    pre, pim = symmetry.hermitian_fill_1d_pair(
+                        gre[:, s, :], gim[:, s, :], axis=0
+                    )
+                    gre, gim = gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
+
+            with jax.named_scope("y transform"):
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_b, "yxz,yk->kxz", prec
+                )
         with jax.named_scope("x transform"):
             if self.is_r2c:
                 fn = lambda r, i: offt.real_out_matmul(
@@ -248,16 +306,28 @@ class MxuLocalExecution(ExecutionBase):
                     (space_re.astype(rt), space_im.astype(rt)),
                     self._x_stage_chunks,
                 )
-        with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "ykz,yl->lkz", prec)
-
         p = self.params
-        with jax.named_scope("pack"):
-            flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
-            flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
-            keys = jnp.asarray(self._stick_keys)
-            sre = jnp.take(flat_re, keys, axis=0)
-            sim = jnp.take(flat_im, keys, axis=0)
+        if self._sparse_y:
+            # per-slot y contraction straight into the stick table: the pack
+            # gather disappears (output rows ARE the table rows)
+            with jax.named_scope("y transform"):
+                sre, sim = offt.complex_matmul(
+                    gre, gim, *self._wy_f_sp, "yaz,ajy->ajz", prec
+                )
+                R = self._table_rows
+                sre = sre.reshape(R, p.dim_z)
+                sim = sim.reshape(R, p.dim_z)
+        else:
+            with jax.named_scope("y transform"):
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_f, "ykz,yl->lkz", prec
+                )
+            with jax.named_scope("pack"):
+                flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
+                flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
+                keys = jnp.asarray(self._stick_keys)
+                sre = jnp.take(flat_re, keys, axis=0)
+                sim = jnp.take(flat_im, keys, axis=0)
 
         with jax.named_scope("z transform"):
             if self._phase is not None:

@@ -262,3 +262,37 @@ def test_map_chunked_pads_non_divisible_batch():
     assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
     back = t.forward(scaling=ScalingType.FULL)
     assert_close(back, values)
+
+
+def test_sparse_y_stage_opt_in(monkeypatch):
+    """SPFFT_TPU_SPARSE_Y=1 contracts the y-DFT only over each x-slot's sticks
+    (per-slot gathered DFT rows; no expand/pack stages). Opt-in until measured
+    on hardware (docs/ROADMAP.md P1); must agree with the dense path and
+    compose with the alignment rotations."""
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y", "1")
+    from spfft_tpu import ProcessingUnit, Transform
+    import spfft_tpu as sp
+
+    rng = np.random.default_rng(83)
+    # spherical workload at dz=128: rotations AND sparse-y both engage
+    # (dy=32 so the widest y-chord, ~0.6*dy, stays below the full extent
+    # after 8-padding)
+    dx, dy, dz = 16, 32, 128
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.6)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                  indices=trip, engine="mxu")
+    assert t._exec._sparse_y, "sparse-y must engage on a spherical plan"
+    assert t._exec._phase is not None, "rotations must compose with sparse-y"
+    v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    out = t.backward(v)
+    assert_close(out, oracle_backward_c2c(trip, v, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, v)
+
+    # near-dense y occupancy: the compaction cannot win -> stays disengaged
+    dense_trip = sorted_triplets(
+        random_sparse_triplets(rng, 8, 8, 8, 0.9, 1.0), (8, 8, 8)
+    )
+    t2 = Transform(ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+                   indices=dense_trip, engine="mxu")
+    assert not t2._exec._sparse_y
