@@ -31,10 +31,50 @@ import numpy as np
 CHAIN = 384
 
 
+def _acquire_backend():
+    """Initialize the accelerator backend, failing FAST on unavailability.
+
+    Two failure modes cost a round's capture if unhandled (both observed):
+    a raised ``Unable to initialize backend`` (rc=1 with a 40-line traceback)
+    and a wedged tunnel claim that blocks backend init forever (driver
+    timeout). Here: one retry after a short pause for transient flaps, a
+    single-line stderr diagnostic, and a watchdog (``SPFFT_TPU_BENCH_INIT_BUDGET_S``,
+    default 180 s) that turns a blocked init into a fast exit 2.
+    """
+    import sys
+
+    import jax
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "bench", "SPFFT_TPU_BENCH_INIT_BUDGET_S", 180, exit_code=2
+    )
+    try:
+        for attempt in (1, 2):
+            try:
+                dev = jax.devices()[0]
+                print(f"bench: backend ready: {dev}", file=sys.stderr)
+                return
+            except RuntimeError as e:
+                msg = str(e).split("\n")[0]
+                if attempt == 1:
+                    print(f"bench: backend init failed ({msg}); retrying in 15s",
+                          file=sys.stderr, flush=True)
+                    time.sleep(15)
+                else:
+                    print(f"bench: backend unavailable: {msg}", file=sys.stderr,
+                          flush=True)
+                    sys.exit(1)
+    finally:
+        disarm()
+
+
 def main():
     import jax
     import spfft_tpu as sp
     from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+
+    _acquire_backend()
 
     dim = 256
     rng = np.random.default_rng(0)

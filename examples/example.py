@@ -5,12 +5,7 @@ frequency-domain index triplets of a small grid, create a Grid and a Transform
 bound to it, run a backward transform (freq -> space), inspect the space-domain
 data, then transform forward with scaling and recover the input values.
 """
-import sys
-from pathlib import Path
-
 import numpy as np
-
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
 import spfft_tpu as sp
 from spfft_tpu import Grid, ProcessingUnit, ScalingType, TransformType

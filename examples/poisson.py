@@ -10,12 +10,7 @@ transformed — exactly the sparse-frequency contract of the library.
 
 Run: PYTHONPATH=/root/repo python examples/poisson.py
 """
-import sys
-from pathlib import Path
-
 import numpy as np
-
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import spfft_tpu as sp
 from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType

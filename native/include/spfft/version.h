@@ -9,8 +9,8 @@
 #define SPFFT_TPU_VERSION_H
 
 #define SPFFT_TPU_VERSION_MAJOR 0
-#define SPFFT_TPU_VERSION_MINOR 2
+#define SPFFT_TPU_VERSION_MINOR 3
 #define SPFFT_TPU_VERSION_PATCH 0
-#define SPFFT_TPU_VERSION_STRING "0.2.0"
+#define SPFFT_TPU_VERSION_STRING "0.3.0"
 
 #endif

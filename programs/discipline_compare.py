@@ -1,0 +1,138 @@
+"""Exchange-discipline comparison: BUFFERED vs COMPACT_BUFFERED vs UNBUFFERED.
+
+Measures, per shard count P, each discipline's (a) off-shard wire bytes per
+repartition (exact accounting from the plan geometry), (b) sequential
+collective rounds, and (c) wall-clock per backward+forward pair — the
+bytes-AND-latency picture the discipline choice actually trades off
+(parallel/ragged.py LATENCY note). The reference offers the same three wire
+disciplines but publishes no guidance numbers (reference:
+include/spfft/types.h:33-62); this program produces them for a given plan.
+
+On a virtual CPU mesh (default here) wall-clock is indicative only — CPU
+"collectives" are memory copies, so the chain's extra rounds cost far less
+than they do over ICI, and ragged-all-to-all falls back to the chain
+transport. Run on a real pod slice for decision-grade timings.
+
+Usage:
+    python programs/discipline_compare.py [--shards 8 16 32] [--dim 64]
+        [--sparsity 0.3] [--imbalance 0.0] [--repeats 20] [--json out.json]
+
+``--imbalance w`` skews the per-shard stick weights linearly from 1 to 1+w,
+exercising the regime where exact-counts disciplines win on bytes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, nargs="+", default=[8, 16, 32])
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--sparsity", type=float, default=0.3)
+    ap.add_argument("--imbalance", type=float, default=0.0)
+    ap.add_argument("--repeats", type=int, default=20)
+    ap.add_argument("--engine", default="mxu", choices=["xla", "mxu"])
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    max_p = max(args.shards)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", max_p)
+    except Exception as e:
+        print(f"late platform config ({e}); using visible devices", file=sys.stderr)
+
+    import numpy as np
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ExchangeType,
+        ProcessingUnit,
+        ScalingType,
+        TransformType,
+    )
+    from spfft_tpu.parameters import distribute_triplets
+
+    dim = args.dim
+    rng = np.random.default_rng(0)
+    triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, args.sparsity)
+    values = (
+        rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
+    ).astype(np.complex64)
+
+    disciplines = [
+        ("BUFFERED", ExchangeType.BUFFERED),
+        ("COMPACT", ExchangeType.COMPACT_BUFFERED),
+        ("UNBUFFERED", ExchangeType.UNBUFFERED),
+    ]
+    rows = []
+    for P in args.shards:
+        weights = 1.0 + args.imbalance * np.arange(P) / max(1, P - 1)
+        per_shard = distribute_triplets(triplets, P, dim, weights=weights)
+        vps = []
+        order = {tuple(t): i for i, t in enumerate(map(tuple, triplets))}
+        for p in per_shard:
+            idx = [order[tuple(t)] for t in map(tuple, p)]
+            vps.append(values[idx])
+        mesh = sp.make_fft_mesh(P)
+        for name, exchange in disciplines:
+            t = DistributedTransform(
+                ProcessingUnit.GPU if args.engine == "mxu" else ProcessingUnit.HOST,
+                TransformType.C2C,
+                dim,
+                dim,
+                dim,
+                [p.copy() for p in per_shard],
+                mesh=mesh,
+                dtype=np.float32,
+                engine=args.engine,
+                exchange_type=exchange,
+            )
+            ex = t._exec
+            pair = ex.pad_values(vps)
+            out = t.backward_pair(*pair)  # compile both directions
+            back = t.forward_pair(scaling=ScalingType.FULL)
+            jax.block_until_ready((out, back))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(args.repeats):
+                    out = t.backward_pair(*pair)
+                    back = t.forward_pair(scaling=ScalingType.FULL)
+                jax.block_until_ready((out, back))
+                best = min(best, (time.perf_counter() - t0) / args.repeats)
+            transport = getattr(ex._ragged, "transport", None)
+            rows.append(
+                {
+                    "P": P,
+                    "discipline": name,
+                    "wire_bytes": ex.exchange_wire_bytes(),
+                    "rounds": ex.exchange_rounds(),
+                    "transport": transport,
+                    "ms_per_pair": round(best * 1e3, 3),
+                }
+            )
+            r = rows[-1]
+            print(
+                f"P={P:3d} {name:10s} bytes={r['wire_bytes']:>12,} "
+                f"rounds={r['rounds']:3d} {r['ms_per_pair']:8.2f} ms/pair"
+                + (f" (transport={transport})" if transport else "")
+            )
+    if args.json:
+        Path(args.json).write_text(json.dumps(
+            {"config": vars(args), "rows": rows}, indent=2))
+        print(f"wrote {args.json}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

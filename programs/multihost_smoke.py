@@ -1,13 +1,15 @@
-"""Two-process multi-host smoke run: one rank of a distributed transform.
+"""Multi-process multi-host smoke run: one rank of a distributed transform.
 
-Each process owns one CPU device of a 2-device global mesh (collectives ride
+Each process owns one CPU device of an N-device global mesh (collectives ride
 Gloo across processes — the CPU stand-in for ICI/DCN, the analogue of the
-reference's `mpirun -n 2` CI). Both ranks build the same seeded global plan,
+reference's `mpirun -n 2` CI; N=4 exceeds that bar). All ranks build the same
+seeded global plan,
 supply values for their OWN shard only, run backward+forward through the mesh
 engine, and verify their local slab against a dense oracle plus the value
 roundtrip. Prints "RANK <r> PASS" on success.
 
-Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c] [buffered|compact]
+Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c]
+       [buffered|compact|unbuffered] [nprocs]
 """
 import os
 import sys
@@ -17,6 +19,7 @@ port = int(sys.argv[2])
 engine = sys.argv[3]
 ttype_name = sys.argv[4] if len(sys.argv) > 4 else "c2c"
 exchange_name = sys.argv[5] if len(sys.argv) > 5 else "buffered"
+nprocs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
@@ -38,9 +41,9 @@ from spfft_tpu import (
 )
 from spfft_tpu.parameters import distribute_triplets
 
-sp.init_distributed(f"localhost:{port}", num_processes=2, process_id=rank)
-assert jax.process_count() == 2
-mesh = sp.make_fft_mesh(2)
+sp.init_distributed(f"localhost:{port}", num_processes=nprocs, process_id=rank)
+assert jax.process_count() == nprocs
+mesh = sp.make_fft_mesh(nprocs)
 
 dx, dy, dz = 8, 9, 10
 rng = np.random.default_rng(42)  # same seed on both ranks -> same global plan
@@ -60,7 +63,7 @@ else:
     chosen = keys[rng.choice(len(keys), size=len(keys) // 2, replace=False)]
     triplets = np.asarray([(x, y, z) for x, y in chosen for z in range(dz)])
     values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(len(triplets))
-per_shard = distribute_triplets(triplets, 2, dy)
+per_shard = distribute_triplets(triplets, nprocs, dy)
 
 lut = {tuple(t): v for t, v in zip(map(tuple, triplets), values)}
 values_per_shard = [np.asarray([lut[tuple(t)] for t in trip]) for trip in per_shard]
@@ -73,11 +76,10 @@ t = DistributedTransform(
     dz,
     per_shard,
     mesh=mesh,
-    exchange_type=(
-        ExchangeType.COMPACT_BUFFERED
-        if exchange_name == "compact"
-        else ExchangeType.BUFFERED
-    ),
+    exchange_type={
+        "compact": ExchangeType.COMPACT_BUFFERED,
+        "unbuffered": ExchangeType.UNBUFFERED,
+    }.get(exchange_name, ExchangeType.BUFFERED),
     engine=engine,
 )
 ex = t._exec

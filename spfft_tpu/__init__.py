@@ -69,6 +69,6 @@ from .types import (  # noqa: F401
     SPFFT_TRANS_R2C,
 )
 
-__version__ = "0.2.0"  # keep in sync with native/CMakeLists.txt + spfft/version.h
+__version__ = "0.3.0"  # keep in sync with native/CMakeLists.txt + spfft/version.h
 # Reference API surface this build mirrors (reference: CMakeLists.txt:2).
 __reference_api_version__ = "1.0.2"

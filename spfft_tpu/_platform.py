@@ -1,0 +1,126 @@
+"""Guarded CPU device resolution.
+
+JAX initializes EVERY platform named in ``jax_platforms`` on the first device
+query (``jax._src.xla_bridge.backends()`` walks the whole list). On hosts whose
+default platform is a tunneled/experimental accelerator plugin, that init can
+block indefinitely (a wedged device claim never times out), taking down even
+code that only wanted a CPU device. The reference never has this failure mode:
+its HOST paths (``SPFFT_PU_HOST``, reference: src/spfft/grid.cpp,
+src/execution/execution_host.cpp) touch no accelerator runtime at all.
+
+This module restores that property for the TPU build: :func:`cpu_devices`
+resolves CPU devices WITHOUT triggering all-platform backend initialization.
+
+Resolution order:
+
+1. Backends already initialized -> the normal ``jax.devices("cpu")`` (cheap).
+2. Not initialized, but ``jax_platforms`` is cpu-only -> normal query (it can
+   only initialize the CPU backend).
+3. Otherwise -> instantiate the CPU backend factory directly and keep a
+   private client. The global backend table stays untouched, so a later
+   accelerator query still initializes normally.
+
+Arrays placed on private-client devices are committed; jit/dispatch resolve
+the backend from the array's client, so compute works without the global
+table (verified: jit + Mesh + shard_map all run on a private 8-device client).
+"""
+from __future__ import annotations
+
+import jax
+
+# (n_virtual_devices_at_creation, client); rebuilt if the requested virtual
+# device count changes while backends are still uninitialized.
+_private_cpu_client = None
+
+
+def _cpu_only_configured() -> bool:
+    """True when ``jax_platforms`` names only CPU (global init is then safe)."""
+    plats = jax.config.jax_platforms
+    if not plats:
+        return False
+    names = {p.strip() for p in str(plats).split(",") if p.strip()}
+    return names == {"cpu"}
+
+
+def global_init_is_safe() -> bool:
+    """True when querying default-platform devices cannot block on a
+    non-CPU backend init (already initialized, or cpu-only configured)."""
+    import jax._src.xla_bridge as xb
+
+    return xb.backends_are_initialized() or _cpu_only_configured()
+
+
+def cpu_devices(n: int | None = None):
+    """Return CPU devices, never initializing non-CPU backends.
+
+    ``n`` truncates the list; honors ``jax_num_cpu_devices`` /
+    ``--xla_force_host_platform_device_count`` for virtual multi-device CPU
+    setups (they configure the client at creation time on every path below).
+    """
+    global _private_cpu_client
+    import jax._src.xla_bridge as xb
+
+    num_cfg = int(jax.config.jax_num_cpu_devices or 1)
+    if _private_cpu_client is None or _private_cpu_client[0] != num_cfg:
+        if global_init_is_safe():
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = None  # initialized without a CPU backend
+            if devs:
+                return list(devs) if n is None else list(devs[:n])
+        try:
+            factory = xb._backend_factories["cpu"].factory
+        except (AttributeError, KeyError):
+            # jax internals moved: fall back to the public query (may
+            # initialize all platforms — correct, just unguarded).
+            devs = jax.devices("cpu")
+            return list(devs) if n is None else list(devs[:n])
+        # Rebuild when the requested virtual device count changed (e.g.
+        # configure_virtual_devices ran after a 1-device HOST resolution):
+        # the factory reads jax_num_cpu_devices at creation time. Arrays on a
+        # previous private client stay valid on their own devices.
+        _private_cpu_client = (num_cfg, factory())
+    devs = list(_private_cpu_client[1].local_devices())
+    return devs if n is None else devs[:n]
+
+
+def cpu_device():
+    """The first CPU device (see :func:`cpu_devices` for the guarantees)."""
+    return cpu_devices(1)[0]
+
+
+def hang_watchdog(label: str, budget_env: str, default_s: float, exit_code: int):
+    """Arm a wall-clock budget against unkillable native hangs (a wedged
+    accelerator-plugin init blocks forever and ignores signals delivered to
+    the blocked thread). Returns a disarm callable; if not disarmed within the
+    budget (env ``budget_env``, default ``default_s`` seconds), prints a
+    one-line diagnostic plus all-thread stacks and ``os._exit``\\ s with
+    ``exit_code`` — a fast, capturable failure instead of a driver timeout.
+
+    Used by the driver entry points (bench.py, __graft_entry__.py); ordinary
+    library calls never arm it.
+    """
+    import faulthandler
+    import os
+    import sys
+    import threading
+
+    budget_s = float(os.environ.get(budget_env, default_s))
+    disarmed = threading.Event()
+
+    def _watch():
+        if not disarmed.wait(budget_s):
+            print(
+                f"{label}: exceeded {budget_s:.0f}s wall-clock budget "
+                "(blocked backend init or collective?); dumping stacks and "
+                f"exiting {exit_code}",
+                file=sys.stderr,
+                flush=True,
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(exit_code)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    return disarmed.set

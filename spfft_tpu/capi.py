@@ -201,10 +201,16 @@ def _make_dist_grid(
 ) -> Grid:
     """Shared distributed-grid construction; ``mesh_factory(devices)`` builds
     the mesh (1-D or 2-D pencil)."""
-    import jax
-
     pu = ProcessingUnit(processing_unit)
-    devices = jax.devices("cpu")[:num_devices] if pu == ProcessingUnit.HOST else None
+    if pu == ProcessingUnit.HOST:
+        # Resolved without initializing non-CPU backends: the embedded
+        # interpreter's HOST paths must work (or fail fast) even when the
+        # host's accelerator runtime is unreachable (see _platform.py).
+        from ._platform import cpu_devices
+
+        devices = cpu_devices(num_devices)
+    else:
+        devices = None
     return Grid(
         max_dim_x,
         max_dim_y,

@@ -19,13 +19,18 @@ from .types import ExchangeType, ProcessingUnit
 def device_for_processing_unit(processing_unit: ProcessingUnit):
     """Resolve a ProcessingUnit to a JAX device.
 
-    HOST always maps to a CPU device. GPU (the accelerator slot — TPU in this build)
-    maps to the default backend's first device, falling back to CPU when no
-    accelerator is attached (so tests run anywhere).
+    HOST always maps to a CPU device — resolved WITHOUT initializing non-CPU
+    backends (parity with the reference, whose SPFFT_PU_HOST paths never touch
+    an accelerator runtime; see spfft_tpu/_platform.py). GPU (the accelerator
+    slot — TPU in this build) maps to the default backend's first device,
+    falling back to CPU when no accelerator is attached (so tests run
+    anywhere).
     """
     pu = ProcessingUnit(processing_unit)
     if pu == ProcessingUnit.HOST:
-        return jax.local_devices(backend="cpu")[0]
+        from ._platform import cpu_device
+
+        return cpu_device()
     return jax.devices()[0]
 
 

@@ -39,14 +39,25 @@ from ..types import (
     TransformType,
 )
 from .mesh import FFT_AXIS, fft_axis_size
-from .ragged import RaggedExchange
+from .ragged import OneShotExchange, RaggedExchange
+
+
+def mesh_process_span(mesh) -> int:
+    """Number of OS processes the mesh's devices live on.
+
+    Computed from the device objects themselves — NOT ``jax.process_count()``,
+    which queries the default backend and can therefore initialize (and block
+    on) an unrelated wedged accelerator plugin even when every mesh device is
+    a CPU device. The mesh-span semantic is also the correct one: per-process
+    block assembly is needed exactly when THIS mesh spans processes."""
+    return len({d.process_index for d in mesh.devices.flat})
 
 
 def _check_multihost_mesh(mesh) -> None:
     """Fail fast at plan creation: multi-process padding requires a dedicated
     1-D fft mesh (multi-axis meshes are single-controller only) — catching it
     here avoids compiling pipelines that die at first data staging."""
-    if jax.process_count() > 1 and mesh.devices.ndim != 1:
+    if mesh_process_span(mesh) > 1 and mesh.devices.ndim != 1:
         from ..errors import InvalidParameterError
 
         raise InvalidParameterError(
@@ -121,10 +132,23 @@ class PaddingHelpers:
             return "f32"
         return None
 
+    def _exchange_axis_span(self, axes) -> int:
+        """Static shard count an exchange over ``axes`` spans."""
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        return int(np.prod([int(self.mesh.shape[n]) for n in names]))
+
     def _complex_wire_exchange(self, buffer, axes):
         """all_to_all on a complex buffer in the plan's wire format — derived
         from types.wire_dtype, the same rule the byte accounting uses, so the
-        cast and the accounting cannot diverge."""
+        cast and the accounting cannot diverge.
+
+        A single-shard exchange is the identity: no collective is emitted, so a
+        P=1 distributed plan compiles to the same compute-only program shape as
+        a local one (the reference's 1-rank MPI transform likewise takes the
+        plain compute path, reference: src/spfft/transform_internal.cpp:45-137),
+        and the surrounding pack/unpack reshapes collapse to metadata."""
+        if self._exchange_axis_span(axes) == 1:
+            return buffer
         from ..types import wire_dtype
 
         wd = wire_dtype(self.exchange_type, self.real_dtype)
@@ -149,28 +173,38 @@ class PaddingHelpers:
 
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes one slab<->pencil repartition puts on the
-        interconnect (self-blocks excluded for both disciplines; per direction
+        interconnect (self-blocks excluded for all disciplines; per direction
         — forward and backward volumes are equal).
 
         Padded (BUFFERED): every shard sends P-1 uniform S_max x L_max blocks.
-        Exact-counts (COMPACT/UNBUFFERED): the ppermute chain's per-step
-        buffers, sized max_i sticks_i * planes_{(i+k) mod P}. Lets callers pick
-        the discipline from plan geometry instead of folklore.
+        COMPACT: the ppermute chain's per-step buffers, sized
+        max_i sticks_i * planes_{(i+k) mod P}. UNBUFFERED: the exact Alltoallw
+        volume sum_{i != j} sticks_i * planes_j. Lets callers pick the
+        discipline from plan geometry instead of folklore.
 
-        Bytes only — round count is not captured (see parallel/ragged.py's
-        LATENCY note)."""
+        Bytes only — pair with :meth:`exchange_rounds` for the latency side
+        (see parallel/ragged.py's LATENCY note)."""
         p = self.params
         if self._ragged is not None:
-            elems = p.num_shards * sum(self._ragged.step_buffer_sizes)
+            elems = self._ragged.offwire_elems()
         else:
             elems = p.num_shards * (p.num_shards - 1) * self._S * self._L
         # elems counts complex values; x2 real scalars each
         return elems * 2 * self._wire_scalar_bytes()
 
+    def exchange_rounds(self) -> int:
+        """Sequential collective rounds one repartition takes under the plan's
+        discipline: 1 for the padded all_to_all and the one-shot UNBUFFERED
+        exchange, P-1 for the COMPACT ppermute chain (and for UNBUFFERED's
+        chain-transport fallback on backends without ragged-all-to-all)."""
+        if self._ragged is not None:
+            return self._ragged.rounds()
+        return 1
+
     def pad_values(self, values_per_shard):
         """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
         p = self.params
-        if jax.process_count() == 1:
+        if mesh_process_span(self.mesh) == 1:
             re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
             im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
             for r, v in enumerate(values_per_shard):
@@ -216,7 +250,7 @@ class PaddingHelpers:
         """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays
         (``None`` for shards owned by other processes)."""
         counts = [int(x) for x in self.params.num_values_per_shard]
-        if jax.process_count() == 1:
+        if mesh_process_span(self.mesh) == 1:
             re, im = np.asarray(pair[0]), np.asarray(pair[1])
             return [re[r, :n] + 1j * im[r, :n] for r, n in enumerate(counts)]
         out = [None] * self.params.num_shards
@@ -234,7 +268,7 @@ class PaddingHelpers:
         p = self.params
         arrs = []
         parts = [np.asarray(space).real, None if self.is_r2c else np.asarray(space).imag]
-        multihost = jax.process_count() > 1
+        multihost = mesh_process_span(self.mesh) > 1
         flat = list(self.mesh.devices.flat)
         for part in parts:
             if part is None:
@@ -271,7 +305,7 @@ class PaddingHelpers:
         arrays of shape (local_z_length, Y, X); ``None`` for remote shards) —
         the reference's per-rank space-domain contract."""
         p = self.params
-        if jax.process_count() == 1:
+        if mesh_process_span(self.mesh) == 1:
             if self.is_r2c:
                 full = np.asarray(out)
                 dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
@@ -339,13 +373,22 @@ class DistributedExecution(PaddingHelpers):
         self._pack_z = p.pack_z_map()
         self._unpack_z = p.unpack_z_map()
 
-        # Exact-counts exchange (COMPACT_*/UNBUFFERED): ppermute chain sending
-        # true sticks_i x planes_j blocks instead of padded uniform ones.
+        # Exact-counts exchanges: COMPACT_* runs the ppermute chain (true
+        # Alltoallv blocks, P-1 rounds); UNBUFFERED runs the one-shot
+        # ragged-all-to-all discipline (true Alltoallw: exact counts in ONE
+        # collective round where the backend supports the HLO; same-layout
+        # chain transport elsewhere). See parallel/ragged.py.
         self._ragged = None
         if self.exchange_type in _RAGGED_EXCHANGES and p.num_shards > 1:
-            self._ragged = RaggedExchange(
+            cls = (
+                OneShotExchange
+                if self.exchange_type == ExchangeType.UNBUFFERED
+                else RaggedExchange
+            )
+            kw = {"mesh": mesh} if cls is OneShotExchange else {}
+            self._ragged = cls(
                 p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
-                self._S, self._L, p.dim_z, p.dim_y * xf, self._yx_flat,
+                self._S, self._L, p.dim_z, p.dim_y * xf, self._yx_flat, **kw,
             )
         self._ragged_wire = self._ragged_wire_format()
 

@@ -54,7 +54,7 @@ from ..types import (
 )
 from .execution import PaddingHelpers
 from .mesh import FFT_AXIS, fft_axis_size
-from .ragged import RaggedExchange
+from .ragged import OneShotExchange, RaggedExchange
 
 
 def _complex_dtype(real_dtype):
@@ -186,7 +186,15 @@ class MxuValuePlans:
 
     def _exchange_pair(self, bre, bim, axes):
         """(re, im) blocks -> all_to_all over ``axes``, one collective on a
-        (P, 2, ...) stacked buffer in the wire dtype."""
+        (P, 2, ...) stacked buffer in the wire dtype.
+
+        Single-shard exchanges are the identity (no collective emitted; the
+        surrounding pack/unpack reshapes then collapse to metadata), so a P=1
+        distributed plan matches the local compute path — the reference's
+        1-rank MPI transform does the same (reference:
+        src/spfft/transform_internal.cpp:45-137)."""
+        if self._exchange_axis_span(axes) == 1:
+            return bre, bim
         wd = self._wire_dtype()
         buf = jnp.stack([bre.astype(wd), bim.astype(wd)], axis=1)
         recv = jax.lax.all_to_all(buf, axes, split_axis=0, concat_axis=0, tiled=True)
@@ -284,13 +292,20 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         # ux is sorted, so any valid x == 0 lands in slot 0)
         self._have_x0 = bool((sx_all[valid] == 0).any())
 
-        # Exact-counts exchange (COMPACT_*/UNBUFFERED): ppermute chain over the
-        # compact (Y, A) plane slots; see parallel/ragged.py.
+        # Exact-counts exchanges over the compact (Y, A) plane slots:
+        # COMPACT_* runs the ppermute chain, UNBUFFERED the one-shot
+        # ragged-all-to-all discipline; see parallel/ragged.py.
         self._ragged = None
         if self.exchange_type in _RAGGED_EXCHANGES and p.num_shards > 1:
-            self._ragged = RaggedExchange(
+            cls = (
+                OneShotExchange
+                if self.exchange_type == ExchangeType.UNBUFFERED
+                else RaggedExchange
+            )
+            kw = {"mesh": mesh} if cls is OneShotExchange else {}
+            self._ragged = cls(
                 p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
-                S, L, Z, Y * A, self._stick_yx,
+                S, L, Z, Y * A, self._stick_yx, **kw,
             )
         self._ragged_wire = self._ragged_wire_format()
 

@@ -62,7 +62,7 @@ def configure_virtual_devices(n_devices: int, *, warn: bool = False) -> None:
             print(f"spfft_tpu: jax_num_cpu_devices ignored ({e})", file=sys.stderr)
 
 
-def ensure_virtual_devices(n_devices: int, *, warn: bool = False):
+def ensure_virtual_devices(n_devices: int, *, warn: bool = False, platform=None):
     """Return ``n_devices`` JAX devices, standing up a virtual CPU backend if needed.
 
     The single bootstrap for every single-controller caller that must validate
@@ -70,20 +70,36 @@ def ensure_virtual_devices(n_devices: int, *, warn: bool = False):
     reference exercising MPI paths under ``mpirun -n 2`` on one CI VM,
     reference: tests/run_mpi_tests.cpp:14-21): pre-configures the CPU backend
     with ``n_devices`` virtual devices (honored until first backend use) and
-    falls back to ``jax.devices("cpu")`` when the default platform has too few
-    devices. When the default platform already exposes enough (a real pod
-    slice), those are returned so collectives ride the actual interconnect.
+    falls back to CPU devices when the default platform has too few devices.
+    When the default platform is already initialized and exposes enough (a
+    real pod slice), those are returned so collectives ride the actual
+    interconnect.
+
+    ``platform="cpu"`` skips the default platform entirely. With
+    ``platform=None`` the default platform is consulted ONLY when doing so
+    cannot block: backend init walks every platform in ``jax_platforms``, and
+    on a wedged tunneled accelerator that init hangs indefinitely (round-2
+    MULTICHIP rc=124). When backends are uninitialized and a non-CPU platform
+    is configured, the virtual CPU path — which the ``n_devices`` config above
+    can always satisfy — is used instead of risking the hang.
 
     ``warn=True`` prints a stderr note when the config arrives after backend
     initialization (the embedded-interpreter caller wants the diagnostic;
     raising would break an otherwise-valid single-device run).
     """
+    from .._platform import cpu_devices, global_init_is_safe
+
     n_devices = max(int(n_devices), 1)
     configure_virtual_devices(n_devices, warn=warn)
-    devices = jax.devices()
+    if platform == "cpu":
+        devices = cpu_devices()
+    elif global_init_is_safe():
+        devices = jax.devices(platform)
+    else:
+        devices = cpu_devices()
     if len(devices) < n_devices:
         try:
-            devices = jax.devices("cpu")
+            devices = cpu_devices()
         except RuntimeError:
             devices = []
     if len(devices) < n_devices:

@@ -211,6 +211,14 @@ class Pencil2Execution(PaddingHelpers):
             b_elems = p.num_shards * (self.P1 - 1) * self._Lz * self._Ly * self._Ax
         return (a_elems + b_elems) * 2 * self._wire_scalar_bytes()
 
+    def exchange_rounds(self) -> int:
+        """Sequential collective rounds per repartition pair (exchange A +
+        exchange B): 2 padded all_to_alls, or the two block chains' (P-1) +
+        (P1-1) rotations."""
+        if self._ragged2 is not None:
+            return (self.params.num_shards - 1) + (self.P1 - 1)
+        return 2
+
     def _exchange(self, buf, axes, reverse=False):
         """Padded all_to_all (BUFFERED) or exact-counts block chain
         (COMPACT/UNBUFFERED) with the configured wire format (single-sourced

@@ -26,9 +26,11 @@ transpose_mpi_compact_buffered_host.cpp:109-175). All gather/scatter indices
 are computed in-trace from iota plus per-step traced scalars (the peer's
 stick/plane counts), so no O(data)-sized index tables are materialized.
 
-Used by both mesh engines for ExchangeType.COMPACT_BUFFERED{,_FLOAT,_BF16} and
-UNBUFFERED (the reference's other exact-counts discipline); BUFFERED/DEFAULT
-keep the single fused all_to_all, which wins when shards are balanced.
+Used by both mesh engines for ExchangeType.COMPACT_BUFFERED{,_FLOAT,_BF16};
+UNBUFFERED instead uses :class:`OneShotExchange` below (exact counts in ONE
+ragged-all-to-all collective — the reference's Alltoallw analogue), and
+BUFFERED/DEFAULT keep the single fused padded all_to_all, which wins when
+shards are balanced.
 
 LATENCY: the chain is P-1 *sequential* collective rounds, so per-exchange step
 latency grows linearly with shard count, vs one fused collective for BUFFERED.
@@ -130,6 +132,15 @@ class RaggedExchange:
         steps 1..P-1 — what actually rides the wire; the k=0 self-block stays
         local. Backward and forward totals are equal (b_fwd[k] = b_bwd[P-k])."""
         return tuple(self._b_bwd[1:])
+
+    def offwire_elems(self) -> int:
+        """Off-shard complex elements one exchange direction ships, summed over
+        the mesh: P shards each send every step's (per-step-max) buffer."""
+        return self.P * sum(self.step_buffer_sizes)
+
+    def rounds(self) -> int:
+        """Sequential collective rounds per exchange (see the LATENCY note)."""
+        return self.P - 1
 
     # ---- traced helpers ----
 
@@ -248,6 +259,357 @@ class RaggedExchange:
             flats, outs, make_chunk, scatter, self._b_fwd, wire, real_dtype
         )
         return [s[: self.S * self.Z].reshape(self.S, self.Z) for s in sticks]
+
+
+def _ragged_a2a_supported(mesh) -> bool:
+    """True when the mesh's backend compiles the ``ragged-all-to-all`` HLO.
+
+    Probed by compiling (not running) a tiny shard_map program once per
+    backend — XLA:CPU's thunk emitter rejects the op at compile time, real
+    TPU runtimes accept it. ``SPFFT_TPU_ONESHOT_TRANSPORT=ragged|chain``
+    overrides the probe in both directions.
+    """
+    import os
+
+    override = os.environ.get("SPFFT_TPU_ONESHOT_TRANSPORT")
+    if override == "ragged":
+        return True
+    if override == "chain":
+        return False
+    devs = mesh.devices.flat
+    key = (next(iter(devs)).platform, mesh.devices.size)
+    if key not in _RAGGED_A2A_PROBE_CACHE:
+        import numpy as np
+        from jax.sharding import PartitionSpec
+
+        P = int(mesh.devices.size)
+        names = tuple(mesh.axis_names)
+
+        def probe(x):
+            z = jnp.zeros(2 * P, x.dtype)
+            off = jnp.arange(P, dtype=jnp.int32)
+            one = jnp.ones(P, dtype=jnp.int32)
+            return jax.lax.ragged_all_to_all(
+                x, z, off, one, off, one, axis_name=names
+            )
+
+        spec = PartitionSpec(names)
+        try:
+            jax.jit(
+                jax.shard_map(
+                    probe, mesh=mesh, in_specs=spec, out_specs=spec,
+                    check_vma=False,
+                )
+            ).lower(jax.ShapeDtypeStruct((P * P,), np.float32)).compile()
+            _RAGGED_A2A_PROBE_CACHE[key] = True
+        except Exception:
+            _RAGGED_A2A_PROBE_CACHE[key] = False
+    return _RAGGED_A2A_PROBE_CACHE[key]
+
+
+_RAGGED_A2A_PROBE_CACHE: dict = {}
+
+
+class OneShotExchange:
+    """Exact-counts slab<->pencil exchange in ONE collective: the UNBUFFERED
+    discipline.
+
+    The reference's UNBUFFERED transpose is an ``MPI_Alltoallw`` with derived
+    datatypes — one call, exact per-pair counts, no intermediate padded copies
+    (reference: src/transpose/transpose_mpi_unbuffered_host.cpp:51-176). The
+    TPU-native analogue is XLA's ragged-all-to-all HLO
+    (:func:`jax.lax.ragged_all_to_all`): one collective whose per-peer segment
+    offsets/sizes are the exact ``sticks_i x planes_j`` products, so wire
+    volume is the true Alltoallv volume AND the latency is one round — beating
+    both the padded BUFFERED single collective (volume) and the COMPACT
+    ppermute chain (P-1 rounds, see the LATENCY note above).
+
+    Buffer layout (identical for both transports):
+
+    * backward send (per shard ``i``, size ``S * Z``): peer ``j``'s segment at
+      offset ``n_i * zo_j``, length ``n_i * L_j``, stick-major — i.e. the
+      (sticks x z) table re-packed so each destination slab's columns are
+      contiguous.
+    * backward recv (size ``N_total * L_max``): the contiguous segment from
+      peer ``i`` (its ``n_i`` stick rows x my ``L_me`` planes, row stride
+      ``L_me``) lands at ``cumn_i * L_max``; one gather re-spreads the rows
+      and one scatter places them into the slab planes (compact rows: no
+      padded inter-shard rows, unlike the BUFFERED unpack).
+    * forward reverses both layouts (send/recv swap roles).
+
+    Where the backend cannot compile ragged-all-to-all (XLA:CPU), the same
+    one-shot buffers ride a ppermute rotation chain (``transport="chain"``) —
+    bytes stay exact, rounds degrade to P-1; numerics and layout are identical,
+    so CPU-mesh tests validate the entire discipline minus the HLO itself.
+
+    Geometry parameters match :class:`RaggedExchange`.
+    """
+
+    def __init__(
+        self, num_sticks, local_z_lengths, z_offsets, s_max, l_max, dim_z,
+        num_slots, yx_flat, *, mesh=None, transport="auto",
+    ):
+        n = np.asarray(num_sticks, dtype=np.int64)
+        L = np.asarray(local_z_lengths, dtype=np.int64)
+        zo = np.asarray(z_offsets, dtype=np.int64)
+        self.P = int(n.size)
+        self.S, self.Lm, self.Z = int(s_max), int(l_max), int(dim_z)
+        self.nslots = int(num_slots)
+        self._n, self._L, self._zo = n, L, zo
+        self.N = int(n.sum())
+        self._cumn = np.concatenate([[0], np.cumsum(n)])[:-1]
+        if transport == "auto":
+            transport = (
+                "ragged" if mesh is not None and _ragged_a2a_supported(mesh)
+                else "chain"
+            )
+        if transport not in ("ragged", "chain"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self.transport = transport
+
+        # static owner map: which shard's slab holds global z
+        zmap = np.searchsorted(zo, np.arange(self.Z), side="right") - 1
+        self._z_L = L[zmap]  # L of the owner of each z
+        self._z_base = zo[zmap]  # zo of the owner of each z
+        # compact global stick row -> plane slot (strip the padded rows of the
+        # (P, S) stick tables; sentinel slots cannot occur on real sticks)
+        yx = np.asarray(yx_flat, dtype=np.int64)
+        rows = []
+        for r in range(self.P):
+            rows.append(yx[r * self.S : r * self.S + int(n[r])])
+        self._yx_compact = (
+            np.concatenate(rows) if rows else np.zeros(0, np.int64)
+        ).astype(np.int32)
+        # compact row -> (owner shard, local row) for the forward send packing
+        self._row_cumn = np.repeat(self._cumn, n).astype(np.int64)
+        # chain-transport per-step buffer sizes (same products as RaggedExchange)
+        s = np.arange(self.P)
+        self._b_bwd = [
+            max(1, int((n * L[(s + k) % self.P]).max())) for k in range(self.P)
+        ]
+        self._b_fwd = [
+            max(1, int((n[(s + k) % self.P] * L).max())) for k in range(self.P)
+        ]
+
+    def offwire_elems(self) -> int:
+        """Exact off-shard element count per exchange direction, summed over
+        the mesh: sum over i != j of sticks_i * planes_j — the true Alltoallv
+        volume (the chain transport ships per-step maxima instead; this
+        accounting reports the ragged one-shot volume the discipline targets)."""
+        n, L = self._n, self._L
+        return int(n.sum() * L.sum() - (n * L).sum())
+
+    def rounds(self) -> int:
+        """Sequential collective rounds per exchange under the active transport."""
+        return 1 if self.transport == "ragged" else self.P - 1
+
+    # ---- traced helpers ----
+
+    def _tables(self):
+        i32 = np.int32
+        return (
+            jnp.asarray(self._n.astype(i32)),
+            jnp.asarray(self._L.astype(i32)),
+            jnp.asarray(self._zo.astype(i32)),
+            jnp.asarray(self._cumn.astype(i32)),
+        )
+
+    @staticmethod
+    def _split_complex(parts):
+        """Complex parts ride as (re, im) real pairs: the ragged collective's
+        operand stays real (complex HLO support varies across backends), and
+        the wire casts become plain dtype swaps."""
+        if not jnp.iscomplexobj(parts[0]):
+            return list(parts), None
+        real_parts = []
+        for p in parts:
+            real_parts += [p.real, p.imag]
+        return real_parts, parts[0].dtype
+
+    @staticmethod
+    def _join_complex(outs, cdtype):
+        if cdtype is None:
+            return outs
+        return [
+            jax.lax.complex(outs[2 * i], outs[2 * i + 1]).astype(cdtype)
+            for i in range(len(outs) // 2)
+        ]
+
+    def _transport_exchange(self, send, out, in_off, send_sizes, out_off,
+                            recv_sizes, recv_off, step_sizes, wire, dtype, rt):
+        """Move the one-shot buffers: one ragged-all-to-all, or the ppermute
+        chain over the same layout. ``out_off`` is sender-side (where my
+        segment lands on each receiver), ``recv_off`` receiver-side (where the
+        segment FROM each peer lands here) — the collective needs the former,
+        the chain the latter."""
+        P = self.P
+        wd = None
+        if wire == "f32":
+            wd = np.float32
+        elif wire == "bf16":
+            wd = jnp.bfloat16
+        if self.transport == "ragged":
+            buf = send if wd is None else send.astype(wd)
+            obuf = out if wd is None else out.astype(wd)
+            res = jax.lax.ragged_all_to_all(
+                buf, obuf,
+                in_off.astype(jnp.int32), send_sizes.astype(jnp.int32),
+                out_off.astype(jnp.int32), recv_sizes.astype(jnp.int32),
+                axis_name=FFT_AXIS,
+            )
+            return res if wd is None else res.astype(dtype)
+        me = jax.lax.axis_index(FFT_AXIS)
+        k_parts = send.shape[-1]
+        sentinel_in = send.shape[0]
+        send_g = jnp.concatenate([send, jnp.zeros((1, k_parts), send.dtype)])
+        sentinel_out = out.shape[0]
+        out = jnp.concatenate([out, jnp.zeros((1, k_parts), out.dtype)])
+        for k in range(P):
+            dst = (me + k) % P
+            src = (me - k) % P
+            b = step_sizes[k]
+            idx = jnp.arange(b, dtype=jnp.int32)
+            gsrc = jnp.where(idx < send_sizes[dst], in_off[dst] + idx, sentinel_in)
+            chunks = [send_g[gsrc, j] for j in range(k_parts)]
+            if k:
+                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, rt)
+            gdst = jnp.where(idx < recv_sizes[src], recv_off[src] + idx, sentinel_out)
+            for j in range(k_parts):
+                out = out.at[gdst, j].set(chunks[j])
+        return out[:sentinel_out]
+
+    # ---- public pipelines (called inside shard_map) ----
+
+    def backward(self, parts, wire=None, real_dtype=None):
+        """(S, Z) stick parts -> (Lm * nslots + 1,) plane flats (padding slot
+        last). Same contract as :meth:`RaggedExchange.backward`."""
+        parts, cdt = self._split_complex(parts)
+        P, S, Z, Lm, N = self.P, self.S, self.Z, self.Lm, max(1, self.N)
+        n_t, L_t, zo_t, cumn_t = self._tables()
+        me = jax.lax.axis_index(FFT_AXIS)
+        n_me, L_me = n_t[me], L_t[me]
+        dtype = parts[0].dtype
+        rt = real_dtype
+
+        # pack: (S, Z) -> one-shot send buffer (destination-contiguous)
+        z_i = jnp.arange(Z, dtype=jnp.int32)
+        col = jnp.asarray((np.arange(Z) - self._z_base).astype(np.int32))
+        zL = jnp.asarray(self._z_L.astype(np.int32))
+        zbase = jnp.asarray(self._z_base.astype(np.int32))
+        # dest(s, z) = n_me * zo(owner) + s * L(owner) + (z - zo(owner))
+        s_i = jnp.arange(S, dtype=jnp.int32)[:, None]
+        dest = n_me * zbase[None, :] + s_i * zL[None, :] + col[None, :]
+        dest = jnp.where(s_i < n_me, dest, S * Z).reshape(-1)
+        send = jnp.stack(
+            [
+                jnp.zeros(S * Z + 1, dtype=dtype).at[dest].set(p.reshape(-1))[
+                    : S * Z
+                ]
+                for p in parts
+            ],
+            axis=-1,
+        )
+
+        out = jnp.zeros((N * Lm, len(parts)), dtype=dtype)
+        in_off = n_me * zo_t
+        send_sizes = n_me * L_t
+        out_off = jnp.full((P,), cumn_t[me] * Lm, dtype=jnp.int32)
+        recv_sizes = n_t * L_me
+        recv_off = cumn_t * Lm
+        res = self._transport_exchange(
+            send, out, in_off, send_sizes, out_off, recv_sizes, recv_off,
+            self._b_bwd, wire, dtype, rt,
+        )
+
+        # unpack: compact stick-row segments (rows packed at stride L_me within
+        # each peer's contiguous segment, segments spaced Lm rows apart) ->
+        # plane flats. One gather re-spreads rows, one scatter places them.
+        yx_c = jnp.asarray(self._yx_compact[: self.N])
+        l_i = jnp.arange(Lm, dtype=jnp.int32)[None, :]
+        if self.N:
+            r_i = jnp.arange(self.N, dtype=jnp.int32)[:, None]
+            cumn_r = jnp.asarray(self._row_cumn.astype(np.int32))[: self.N, None]
+            rsrc = cumn_r * Lm + (r_i - cumn_r) * L_me + l_i  # (N, Lm)
+            rsrc = jnp.where(l_i < L_me, rsrc, N * Lm)
+            pdest = l_i * self.nslots + yx_c[:, None]  # (N, Lm)
+        else:
+            rsrc = jnp.full((N, Lm), N * Lm, jnp.int32)
+            pdest = jnp.full((N, Lm), Lm * self.nslots, jnp.int32)
+        res_g = jnp.concatenate([res, jnp.zeros((1, len(parts)), dtype)])
+        rows = res_g[rsrc.reshape(-1)]  # (N * Lm, k); invalid slots read zero
+        outs = []
+        for j in range(len(parts)):
+            flat = jnp.zeros(Lm * self.nslots + 1, dtype=dtype)
+            outs.append(flat.at[pdest.reshape(-1)].set(rows[:, j]))
+        return self._join_complex(outs, cdt)
+
+    def forward(self, parts, wire=None, real_dtype=None):
+        """(Lm * nslots,) plane flats -> (S, Z) stick parts (padding rows
+        zero). Same contract as :meth:`RaggedExchange.forward`."""
+        parts, cdt = self._split_complex(parts)
+        P, S, Z, Lm, N = self.P, self.S, self.Z, self.Lm, max(1, self.N)
+        n_t, L_t, zo_t, cumn_t = self._tables()
+        me = jax.lax.axis_index(FFT_AXIS)
+        n_me, L_me = n_t[me], L_t[me]
+        dtype = parts[0].dtype
+        rt = real_dtype
+        flats = [
+            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
+        ]
+
+        # pack: gather the compact (N, Lm) row table from my planes, then
+        # re-pack rows at stride L_me so each owner's segment is contiguous
+        yx_c = jnp.asarray(self._yx_compact[: self.N])
+        l_i = jnp.arange(Lm, dtype=jnp.int32)[None, :]
+        if self.N:
+            psrc = jnp.where(
+                l_i < L_me, l_i * self.nslots + yx_c[:, None], Lm * self.nslots
+            )  # (N, Lm); cols >= L_me read the zero sentinel
+        else:
+            psrc = jnp.full((N, Lm), Lm * self.nslots, jnp.int32)
+        cumn_r = jnp.asarray(self._row_cumn.astype(np.int32))[: self.N]
+        if self.N:
+            r_i = jnp.arange(self.N, dtype=jnp.int32)
+            sdest = cumn_r[:, None] * Lm + (r_i - cumn_r)[:, None] * L_me + l_i
+            sdest = jnp.where(l_i < L_me, sdest, N * Lm)  # (N, Lm)
+        else:
+            sdest = jnp.full((N, Lm), N * Lm, jnp.int32)
+        send_parts = []
+        for f in flats:
+            rows = f[psrc]  # (N, Lm)
+            send_parts.append(
+                jnp.zeros(N * Lm + 1, dtype=dtype)
+                .at[sdest.reshape(-1)]
+                .set(rows.reshape(-1))[: N * Lm]
+            )
+        send = jnp.stack(send_parts, axis=-1)
+
+        out = jnp.zeros((S * Z, len(parts)), dtype=dtype)
+        in_off = cumn_t * Lm
+        send_sizes = n_t * L_me
+        out_off = n_t * zo_t[me]
+        recv_sizes = n_me * L_t
+        recv_off = n_me * zo_t
+        res = self._transport_exchange(
+            send, out, in_off, send_sizes, out_off, recv_sizes, recv_off,
+            self._b_fwd, wire, dtype, rt,
+        )
+
+        # unpack: destination-contiguous segments -> (S, Z) sticks
+        col = jnp.asarray((np.arange(Z) - self._z_base).astype(np.int32))[None, :]
+        zL = jnp.asarray(self._z_L.astype(np.int32))[None, :]
+        zbase = jnp.asarray(self._z_base.astype(np.int32))[None, :]
+        s_i = jnp.arange(S, dtype=jnp.int32)[:, None]
+        src = n_me * zbase + s_i * zL + col
+        valid = jnp.broadcast_to(s_i < n_me, (S, Z))
+        src = jnp.where(valid, src, 0).reshape(-1)
+        outs = []
+        for j in range(len(parts)):
+            sticks = jnp.where(
+                valid.reshape(-1), res[src, j], jnp.zeros((), dtype)
+            )
+            outs.append(sticks.reshape(S, Z))
+        return self._join_complex(outs, cdt)
 
 
 class RaggedBlockExchange:

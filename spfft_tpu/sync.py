@@ -16,6 +16,9 @@ skipped entirely, so ``fence`` costs one tree traversal beyond
 """
 from __future__ import annotations
 
+import os
+import re
+
 import numpy as np
 
 import jax
@@ -23,16 +26,28 @@ import jax
 # Runtimes whose block_until_ready is known not to wait for execution. The
 # tunneled TPU identifies as platform "tpu" with "axon" only in the client's
 # platform_version string, so both the platform name and the version string are
-# consulted.
+# consulted. Version matching is anchored on whole tokens (split at
+# non-alphanumerics) — a version string that merely *contains* the marker
+# inside another word must not trigger the per-array host fetches.
+# ``SPFFT_TPU_ADVISORY_FENCE=1`` forces the scalar-probe fence on any platform;
+# ``=0`` disables it everywhere (callers who know their runtime conforms).
 ADVISORY_PLATFORMS = frozenset({"axon"})
-ADVISORY_VERSION_MARKERS = ("axon",)
+ADVISORY_VERSION_MARKERS = frozenset({"axon"})
+
+
+def _advisory_override():
+    v = os.environ.get("SPFFT_TPU_ADVISORY_FENCE")
+    if v in ("0", "1"):
+        return v == "1"
+    return None
 
 
 def _client_is_advisory(client) -> bool:
+    if client.platform in ADVISORY_PLATFORMS:
+        return True
     version = str(getattr(client, "platform_version", "") or "")
-    return client.platform in ADVISORY_PLATFORMS or any(
-        marker in version for marker in ADVISORY_VERSION_MARKERS
-    )
+    tokens = set(re.split(r"[^A-Za-z0-9]+", version.lower()))
+    return not tokens.isdisjoint(ADVISORY_VERSION_MARKERS)
 
 
 def _on_advisory_platform(leaf) -> bool:
@@ -49,14 +64,15 @@ def _on_advisory_platform(leaf) -> bool:
     )
 
 
-def _probe_scalar(arr) -> None:
-    """Host-fetch one element of a single-device array, forcing its producer to
-    complete. ``.real`` so complex arrays fence too on platforms whose host
-    transport rejects complex payloads (the axon tunnel does)."""
+def _probe_scalar(arr):
+    """One-element probe of a single-device array; fetching it host-side forces
+    the array's producer to complete. ``.real`` so complex arrays fence too on
+    platforms whose host transport rejects complex payloads (the axon tunnel
+    does)."""
     probe = arr.ravel()[0] if arr.ndim else arr
     if np.issubdtype(probe.dtype, np.complexfloating):
         probe = probe.real
-    jax.device_get(probe)
+    return probe
 
 
 def fence(tree):
@@ -64,20 +80,29 @@ def fence(tree):
 
     Sharded arrays are fenced per addressable shard — a single global
     ``ravel()[0]`` would depend only on the device holding element 0, letting
-    the other shards' computations keep running past the "fence".
+    the other shards' computations keep running past the "fence". All probes
+    across every leaf and shard are fetched in ONE batched ``jax.device_get``:
+    on the tunneled platform each host fetch carries a fixed ~110 ms transport
+    cost, so a per-shard loop would bill that cost P times per fence.
     """
     jax.block_until_ready(tree)
+    force = _advisory_override()
+    if force is False:
+        return tree
+    probes = []
     for leaf in jax.tree_util.tree_leaves(tree):
         if (
             isinstance(leaf, jax.Array)
             and leaf.size
-            and _on_advisory_platform(leaf)
+            and (force or _on_advisory_platform(leaf))
         ):
             shards = getattr(leaf, "addressable_shards", None)
             if shards:
                 for shard in shards:
                     if shard.data is not None and shard.data.size:
-                        _probe_scalar(shard.data)
+                        probes.append(_probe_scalar(shard.data))
             else:
-                _probe_scalar(leaf)
+                probes.append(_probe_scalar(leaf))
+    if probes:
+        jax.device_get(probes)
     return tree

@@ -71,12 +71,18 @@ class Transform:
         # Envelope validation for an explicit local_z_length (reference:
         # src/spfft/transform.cpp:51-55 rejects negatives; grid capacity checks
         # in src/spfft/transform_internal.cpp:45-137). A local plan owns the
-        # full z-extent, so any other value is a porting error — reject loudly
-        # instead of silently accepting it.
+        # full z-extent, so any other positive value is a porting error —
+        # reject loudly instead of silently accepting it. 0 is treated as
+        # "unspecified", like None: the reference's serial path ignores the
+        # parameter entirely, and ported callers legally pass 0 there
+        # (divergence documented in docs/MIGRATION.md).
         if local_z_length is not None:
             local_z_length = int(local_z_length)
             if local_z_length < 0:
                 raise InvalidParameterError("local_z_length must be non-negative")
+            if local_z_length == 0:
+                local_z_length = None
+        if local_z_length is not None:
             if local_z_length != int(dim_z):
                 raise InvalidParameterError(
                     f"a local transform spans the full z-extent: local_z_length "

@@ -17,11 +17,19 @@ class ExchangeType(enum.IntEnum):
     BUFFERED (and DEFAULT) lower to one equal-split ``lax.all_to_all`` over the ICI
     mesh axis on padded-uniform blocks — the reference's BUFFERED wire discipline and
     the collective shape ICI fuses best; it wins when shards are balanced.
-    COMPACT_BUFFERED and UNBUFFERED send exact ``sticks_i x planes_j`` blocks per
-    shard pair via a ppermute rotation chain (parallel/ragged.py) — true Alltoallv /
-    Alltoallw semantics; they win when stick or plane counts are imbalanced (wire
-    bytes track the exact volume instead of ``P^2 S_max L_max``), at the cost of
-    P-1 sequential collective rounds per exchange (see parallel/ragged.py). The
+    COMPACT_BUFFERED sends exact ``sticks_i x planes_j`` blocks per shard pair via a
+    ppermute rotation chain (parallel/ragged.py) — true Alltoallv semantics; it wins
+    when stick or plane counts are imbalanced (wire bytes track the exact volume
+    instead of ``P^2 S_max L_max``), at the cost of P-1 sequential collective rounds
+    per exchange. UNBUFFERED sends the same exact counts in ONE collective via XLA's
+    ragged-all-to-all HLO (parallel/ragged.py OneShotExchange) — the analogue of the
+    reference's zero-copy ``MPI_Alltoallw`` exchange: exact bytes AND single-round
+    latency on backends that compile the HLO (TPU); elsewhere the same one-shot
+    buffers ride a chain transport (P-1 rounds, identical numerics). The one-shot
+    form applies to the 1-D slab meshes (the reference's scope); on a 2-D pencil
+    mesh (``make_fft_mesh2``, beyond the reference) UNBUFFERED currently runs the
+    exact-counts block chains like COMPACT_BUFFERED — check
+    ``exchange_rounds()``/``exchange_wire_bytes()`` for any plan's actual costs. The
     ``*_FLOAT`` variants halve wire bytes by converting the exchanged payload to
     single precision on the wire, exactly like the reference's float exchange
     (reference: src/gpu_util/complex_conversion.cuh:37-56).
@@ -57,10 +65,11 @@ class ExchangeType(enum.IntEnum):
 # Wire-format groupings used by both mesh engines (execution.py, execution_mxu.py).
 FLOAT_EXCHANGES = (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
 BF16_EXCHANGES = (ExchangeType.BUFFERED_BF16, ExchangeType.COMPACT_BUFFERED_BF16)
-# Exact-counts disciplines: realized as the ppermute-chain ragged exchange
-# (parallel/ragged.py) rather than the padded all_to_all. COMPACT_* mirrors the
-# reference's Alltoallv, UNBUFFERED its zero-copy Alltoallw — both send exactly
-# sticks_i x planes_j elements per shard pair.
+# Exact-counts disciplines (not the padded all_to_all): COMPACT_* mirrors the
+# reference's Alltoallv as a ppermute rotation chain; UNBUFFERED mirrors its
+# zero-copy Alltoallw as ONE ragged-all-to-all collective (chain-transport
+# fallback on backends without the HLO). Both send exactly sticks_i x planes_j
+# elements per shard pair; see parallel/ragged.py.
 RAGGED_EXCHANGES = (
     ExchangeType.COMPACT_BUFFERED,
     ExchangeType.COMPACT_BUFFERED_FLOAT,

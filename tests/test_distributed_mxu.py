@@ -372,3 +372,22 @@ def test_mxu_distributed_lane_alignment_rotation_path(ttype):
     back = t.forward(scaling=ScalingType.FULL)
     for r, vals in enumerate(vps):
         assert_close(back[r], vals)
+
+
+def test_p1_distributed_emits_no_collective():
+    """A 1-shard distributed plan must compile to the same compute-only
+    program shape as a local plan: the exchange specializes to the identity
+    and no all-to-all reaches the HLO (the reference's 1-rank MPI transform
+    likewise takes the plain compute path,
+    reference: src/spfft/transform_internal.cpp:45-137)."""
+    import jax
+
+    dims = (12, 12, 12)
+    t, triplets, values, vps = make_c2c(1, dims)
+    ex = t._exec
+    pair = ex.pad_values(vps)
+    hlo = jax.jit(ex._backward_sm).lower(*pair, *ex._phase_args()).compile().as_text()
+    assert "all-to-all" not in hlo
+    expected = oracle_backward_c2c(triplets, values, *dims)
+    out = t.backward(vps)
+    assert_close(out, expected)

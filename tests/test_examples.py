@@ -15,8 +15,11 @@ EXAMPLES = [ROOT / "examples" / "example.py", ROOT / "examples" / "poisson.py"]
 @pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
 def test_python_example_runs(script):
     # force the portable CPU backend: the dev environment pins an accelerator
-    # platform via env that a fresh subprocess may not be able to initialize
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    # platform via env that a fresh subprocess may not be able to initialize.
+    # PYTHONPATH points at the checkout: examples import spfft_tpu like an
+    # installed package (no sys.path editing inside them; pip install . is the
+    # real flow, exercised by test_packaging.py).
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(ROOT)}
     result = subprocess.run(
         [sys.executable, str(script)],
         capture_output=True,

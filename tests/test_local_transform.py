@@ -155,23 +155,27 @@ def test_accessors():
 
 
 def test_local_z_length_validation():
-    """An explicit local_z_length outside the local-slab envelope is rejected
-    (reference: src/spfft/transform.cpp:51-55, transform_internal.cpp:45-137);
-    the full-depth value is accepted."""
+    """An explicit positive local_z_length outside the local-slab envelope is
+    rejected (reference: src/spfft/transform.cpp:51-55,
+    transform_internal.cpp:45-137); the full-depth value is accepted, and 0
+    means "unspecified" like the reference's serial path, which ignores the
+    parameter entirely (docs/MIGRATION.md behavioral difference #7)."""
     import pytest
 
     from spfft_tpu.errors import InvalidParameterError
 
     rng = np.random.default_rng(11)
     trip = random_sparse_triplets(rng, 6, 6, 6, 0.5)
-    for bad in (-1, 0, 3, 7):
+    for bad in (-1, 3, 7):
         with pytest.raises(InvalidParameterError):
             Transform(
                 ProcessingUnit.HOST, TransformType.C2C, 6, 6, 6,
                 indices=trip, local_z_length=bad,
             )
-    t = Transform(
-        ProcessingUnit.HOST, TransformType.C2C, 6, 6, 6,
-        indices=trip, local_z_length=6,
-    )
-    assert t.dim_z == 6
+    for ok in (0, 6):  # 0 == unspecified (reference serial callers pass it)
+        t = Transform(
+            ProcessingUnit.HOST, TransformType.C2C, 6, 6, 6,
+            indices=trip, local_z_length=ok,
+        )
+        assert t.dim_z == 6
+        assert t.local_z_length == 6

@@ -1,9 +1,13 @@
-"""Multi-host (2-process) distributed transforms via subprocess ranks.
+"""Multi-host (multi-process) distributed transforms via subprocess ranks.
 
 The analogue of the reference running its MPI tests under ``mpirun -n 2``
-(reference: .github/workflows/ci.yml:80-84): two OS processes, one CPU device
-each, a global 2-device mesh, collectives over Gloo. Each rank supplies and
-receives only its own shard's data (programs/multihost_smoke.py).
+(reference: .github/workflows/ci.yml:80-84): N OS processes, one CPU device
+each, a global N-device mesh, collectives over Gloo. Each rank supplies and
+receives only its own shard's data (programs/multihost_smoke.py). The
+4-process cells exceed the reference's 2-rank CI bar and exercise the
+per-process block-assembly paths (parallel/execution.py pad_values /
+unpad_space) beyond the minimal case, on both engines and all three exchange
+disciplines.
 """
 import subprocess
 import sys
@@ -12,6 +16,35 @@ from pathlib import Path
 import pytest
 
 SCRIPT = Path(__file__).resolve().parent.parent / "programs" / "multihost_smoke.py"
+
+
+def _run_ranks(nprocs, port, engine, ttype, exchange, timeout=300):
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, str(SCRIPT), str(rank), str(port), engine,
+                ttype, exchange, str(nprocs),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for rank in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:  # a hung rank must not leak Gloo processes / the port
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        assert f"RANK {rank} PASS" in out
 
 
 @pytest.mark.parametrize(
@@ -26,26 +59,21 @@ SCRIPT = Path(__file__).resolve().parent.parent / "programs" / "multihost_smoke.
     ],
 )
 def test_two_process_roundtrip(engine, ttype, port, exchange):
-    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(SCRIPT), str(rank), str(port), engine, ttype, exchange],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            env=env,
-            text=True,
-        )
-        for rank in (0, 1)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
-    finally:
-        for p in procs:  # a hung rank must not leak Gloo processes / the port
-            if p.poll() is None:
-                p.kill()
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
-        assert f"RANK {rank} PASS" in out
+    _run_ranks(2, port, engine, ttype, exchange)
+
+
+@pytest.mark.parametrize(
+    "engine,ttype,port,exchange",
+    [
+        ("xla", "c2c", 12981, "buffered"),
+        ("xla", "c2c", 12983, "compact"),
+        ("mxu", "c2c", 12985, "buffered"),
+        ("mxu", "c2c", 12987, "compact"),
+        # one-shot UNBUFFERED layout over the cross-process mesh (chain
+        # transport on the Gloo CPU backend)
+        ("mxu", "c2c", 12989, "unbuffered"),
+        ("mxu", "r2c", 12991, "buffered"),
+    ],
+)
+def test_four_process_roundtrip(engine, ttype, port, exchange):
+    _run_ranks(4, port, engine, ttype, exchange)

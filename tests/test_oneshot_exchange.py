@@ -1,0 +1,201 @@
+"""One-shot UNBUFFERED exchange (parallel/ragged.py OneShotExchange).
+
+The reference's UNBUFFERED transpose is a single MPI_Alltoallw with derived
+datatypes — exact counts, one call (reference:
+src/transpose/transpose_mpi_unbuffered_host.cpp:51-176). Here that discipline
+is a single ragged-all-to-all collective on backends that compile the HLO, and
+the same one-shot buffer layout over a ppermute chain elsewhere (XLA:CPU —
+what these tests run, so they validate the entire discipline except the HLO
+itself, which the TPU bench exercises).
+"""
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
+from spfft_tpu.parallel.ragged import OneShotExchange, RaggedExchange
+from spfft_tpu.parameters import distribute_triplets
+from utils import random_sparse_triplets, split_values
+
+ENGINES = ["xla", "mxu"]
+PU = {"xla": ProcessingUnit.HOST, "mxu": ProcessingUnit.GPU}
+
+
+def build(engine, num_shards, dims, per_shard, exchange, dtype=None, **kw):
+    dx, dy, dz = dims
+    return DistributedTransform(
+        PU[engine],
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(num_shards),
+        exchange_type=exchange,
+        engine=engine,
+        dtype=dtype,
+        **kw,
+    )
+
+
+def test_unbuffered_is_a_distinct_implementation():
+    """Three enum disciplines -> three implementations: padded all_to_all
+    (no ragged object), COMPACT chain (RaggedExchange), UNBUFFERED one-shot
+    (OneShotExchange)."""
+    rng = np.random.default_rng(0)
+    dims = (8, 8, 8)
+    trip = random_sparse_triplets(rng, *dims, 0.5)
+    per_shard = distribute_triplets(trip, 4, dims[1])
+    t_pad = build("xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.BUFFERED)
+    t_cmp = build(
+        "xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.COMPACT_BUFFERED
+    )
+    t_one = build(
+        "xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.UNBUFFERED
+    )
+    assert t_pad._exec._ragged is None
+    assert type(t_cmp._exec._ragged) is RaggedExchange
+    assert type(t_one._exec._ragged) is OneShotExchange
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+@pytest.mark.parametrize("seed", range(4))
+def test_oneshot_matches_padded_fuzz(engine, seed):
+    """Randomized ragged geometries: UNBUFFERED must produce the same transform
+    as the padded discipline (identical FFT stages; only the repartition
+    differs)."""
+    rng = np.random.default_rng(100 + seed)
+    num_shards = int(rng.choice([2, 3, 5, 8]))
+    dims = tuple(int(d) for d in rng.integers(4, 14, size=3))
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(
+        rng, dx, dy, dz, float(rng.uniform(0.2, 0.8)),
+        z_fill=float(rng.uniform(0.4, 1.0)),
+    )
+    values = rng.standard_normal(len(triplets)) + 1j * rng.standard_normal(
+        len(triplets)
+    )
+    weights = rng.uniform(0.1, 1.0, size=num_shards)
+    per_shard = distribute_triplets(triplets, num_shards, dy, weights=weights)
+    vps = split_values(per_shard, triplets, values)
+
+    outs = {}
+    for exchange in (ExchangeType.BUFFERED, ExchangeType.UNBUFFERED):
+        t = build(engine, num_shards, dims, [p.copy() for p in per_shard], exchange)
+        outs[exchange] = (
+            t.backward([v.copy() for v in vps]),
+            t.forward(scaling=ScalingType.FULL),
+        )
+    b_pad, f_pad = outs[ExchangeType.BUFFERED]
+    b_one, f_one = outs[ExchangeType.UNBUFFERED]
+    scale = max(1.0, float(np.abs(b_pad).max()))
+    np.testing.assert_allclose(b_one, b_pad, rtol=0, atol=1e-11 * scale)
+    for r in range(num_shards):
+        np.testing.assert_allclose(f_one[r], f_pad[r], rtol=0, atol=1e-11)
+
+
+def test_oneshot_wire_bytes_are_exact_alltoallv_volume():
+    """UNBUFFERED's byte accounting is the exact sum_{i != j} n_i * L_j —
+    never above the COMPACT chain's per-step-max volume, and strictly below
+    the padded volume on imbalanced plans."""
+    rng = np.random.default_rng(7)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    triplets = random_sparse_triplets(rng, dx, dy, dz, 0.4)
+    skew = [triplets] + [np.zeros((0, 3), dtype=np.int64)] * 3
+    lz = [1, 1, 1, dz - 3]
+    kw = dict(local_z_lengths=lz)
+    t_pad = build("xla", 4, dims, [p.copy() for p in skew], ExchangeType.BUFFERED, **kw)
+    t_cmp = build(
+        "xla", 4, dims, [p.copy() for p in skew], ExchangeType.COMPACT_BUFFERED, **kw
+    )
+    t_one = build(
+        "xla", 4, dims, [p.copy() for p in skew], ExchangeType.UNBUFFERED, **kw
+    )
+    one, cmp_, pad = (
+        t.exchange_wire_bytes() for t in (t_one, t_cmp, t_pad)
+    )
+    assert one <= cmp_ < pad
+    # exact volume, computed independently from the plan geometry
+    p = t_one._exec.params
+    n = np.asarray(p.num_sticks_per_shard, dtype=np.int64)
+    L = np.asarray(p.local_z_lengths, dtype=np.int64)
+    exact = int(n.sum() * L.sum() - (n * L).sum())
+    scalar = 2 * np.dtype(t_one._exec.real_dtype).itemsize
+    assert one == exact * scalar
+
+
+def test_exchange_rounds_accounting():
+    """Latency accounting: padded and one-shot-ragged report 1 round, the
+    COMPACT chain P-1 (the chain-transport fallback also reports P-1)."""
+    rng = np.random.default_rng(8)
+    dims = (8, 8, 8)
+    trip = random_sparse_triplets(rng, *dims, 0.5)
+    per_shard = distribute_triplets(trip, 4, dims[1])
+    t_pad = build("xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.BUFFERED)
+    t_cmp = build(
+        "xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.COMPACT_BUFFERED
+    )
+    t_one = build(
+        "xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.UNBUFFERED
+    )
+    assert t_pad._exec.exchange_rounds() == 1
+    assert t_cmp._exec.exchange_rounds() == 3
+    one = t_one._exec
+    expected = 1 if one._ragged.transport == "ragged" else 3
+    assert one.exchange_rounds() == expected
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_oneshot_r2c(engine):
+    """Distributed R2C through the one-shot exchange (hermitian completion
+    downstream of the one-shot unpack)."""
+    rng = np.random.default_rng(9)
+    dims = (8, 8, 8)
+    dx, dy, dz = dims
+    real = rng.standard_normal((dz, dy, dx))
+    freq = np.fft.fftn(real) / (dx * dy * dz)
+    xs = np.arange(dx // 2 + 1)
+    trip = np.stack(
+        np.meshgrid(xs, np.arange(dy), np.arange(dz), indexing="ij"), -1
+    ).reshape(-1, 3)
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = [freq[t_[:, 2], t_[:, 1], t_[:, 0]] for t_ in per_shard]
+    t = DistributedTransform(
+        PU[engine],
+        TransformType.R2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(4),
+        exchange_type=ExchangeType.UNBUFFERED,
+        engine=engine,
+    )
+    out = t.backward([v.copy() for v in vps])
+    np.testing.assert_allclose(out, real, rtol=0, atol=1e-10)
+    back = t.forward(scaling=ScalingType.FULL)
+    for r in range(4):
+        np.testing.assert_allclose(back[r], vps[r], rtol=0, atol=1e-10)
+
+
+def test_oneshot_run_twice_zeroing():
+    """The reference runs every transform twice to catch stale-memory bugs
+    (reference: tests/test_util/test_transform.hpp:129-131); the one-shot
+    buffers are rebuilt in-trace so the second run must match the first."""
+    rng = np.random.default_rng(10)
+    dims = (9, 7, 10)
+    trip = random_sparse_triplets(rng, *dims, 0.6)
+    per_shard = distribute_triplets(trip, 5, dims[1])
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    vps = split_values(per_shard, trip, values)
+    t = build("mxu", 5, dims, per_shard, ExchangeType.UNBUFFERED)
+    first = t.backward([v.copy() for v in vps])
+    second = t.backward([v.copy() for v in vps])
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(second))

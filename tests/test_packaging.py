@@ -103,3 +103,39 @@ def test_version_macros_match_cmake_project():
     import spfft_tpu
 
     assert spfft_tpu.__version__ == version
+    # ... and so must the pip metadata
+    pyproject = (ROOT / "pyproject.toml").read_text()
+    assert f'version = "{version}"' in pyproject
+
+
+def test_pip_install_and_import(tmp_path):
+    """`pip install .` of the Python core works and the installed copy imports
+    from a neutral cwd — the Python-side parity of the reference's installed
+    CMake/pkg-config consumption (reference: cmake/SpFFTConfig.cmake). Run with
+    --no-deps/--no-build-isolation: the environment is zero-egress and jax is
+    already present."""
+    import sys
+
+    target = tmp_path / "site"
+    _run(
+        [sys.executable, "-m", "pip", "install", "--no-build-isolation",
+         "--no-deps", "--quiet", f"--target={target}", str(ROOT)]
+    )
+    assert (target / "spfft_tpu" / "__init__.py").exists()
+    out = _run(
+        [
+            sys.executable,
+            "-c",
+            "import spfft_tpu, numpy as np; "
+            "t = spfft_tpu.Transform("
+            "    spfft_tpu.ProcessingUnit.HOST, spfft_tpu.TransformType.C2C,"
+            "    4, 4, 4, indices=np.stack(np.meshgrid(*[np.arange(4)] * 3,"
+            "    indexing='ij'), -1).reshape(-1, 3), dtype=np.float64); "
+            "s = t.backward(np.ones(64, dtype=np.complex128)); "
+            "print(spfft_tpu.__file__); print('ok', s.shape)",
+        ],
+        cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(target)},
+    )
+    assert str(target) in out.stdout
+    assert "ok (4, 4, 4)" in out.stdout
