@@ -144,20 +144,29 @@ class Pencil2Execution(PaddingHelpers):
         # discipline's value. Reference: MPI_Alltoallv
         # (transpose_mpi_compact_buffered_host.cpp:183-200).
         if self.exchange_type in _RAGGED:
-            from .ragged import RaggedBlockExchange
+            from .ragged import (
+                OneShotBlockExchange,
+                RaggedBlockExchange,
+                _ragged_a2a_supported,
+            )
 
+            # UNBUFFERED: one ragged-all-to-all collective per exchange where
+            # the backend compiles the HLO (TPU); block chains elsewhere and
+            # for COMPACT_* (see parallel/ragged.py).
+            cls = (
+                OneShotBlockExchange
+                if self.exchange_type == ExchangeType.UNBUFFERED
+                and _ragged_a2a_supported(mesh)
+                else RaggedBlockExchange
+            )
             d = np.arange(Pn)
             rows_a = counts[:, d // P2]  # (P, P): rows_a[s, d] = counts[s, a(d)]
             cols_a = np.broadcast_to(lz[d % P2], (Pn, Pn))
             rows_b = np.full((P1, P1), Lz, dtype=np.int64)
             cols_b = np.broadcast_to((ly * Ax), (P1, P1))
             self._ragged2 = {
-                (AX1, AX2): RaggedBlockExchange(
-                    (AX1, AX2), (P1, P2), rows_a, cols_a, SG, Lz
-                ),
-                (AX1,): RaggedBlockExchange(
-                    (AX1,), (P1,), rows_b, cols_b, Lz, Ly * Ax
-                ),
+                (AX1, AX2): cls((AX1, AX2), (P1, P2), rows_a, cols_a, SG, Lz),
+                (AX1,): cls((AX1,), (P1,), rows_b, cols_b, Lz, Ly * Ax),
             }
 
         # ---- sharded constants + compiled pipelines ----
@@ -202,10 +211,10 @@ class Pencil2Execution(PaddingHelpers):
         sequential rounds (see parallel/ragged.py's LATENCY note)."""
         p = self.params
         if self._ragged2 is not None:
-            a_elems = p.num_shards * sum(
-                self._ragged2[(AX1, AX2)].step_buffer_sizes
-            )
-            b_elems = p.num_shards * sum(self._ragged2[(AX1,)].step_buffer_sizes)
+            # exchange A spans the whole mesh (its offwire_elems covers every
+            # shard); exchange B runs per "fft2" subgroup, P2 of them
+            a_elems = self._ragged2[(AX1, AX2)].offwire_elems()
+            b_elems = self.P2 * self._ragged2[(AX1,)].offwire_elems()
         else:
             a_elems = p.num_shards * (p.num_shards - 1) * self._SG * self._Lz
             b_elems = p.num_shards * (self.P1 - 1) * self._Lz * self._Ly * self._Ax
@@ -213,10 +222,13 @@ class Pencil2Execution(PaddingHelpers):
 
     def exchange_rounds(self) -> int:
         """Sequential collective rounds per repartition pair (exchange A +
-        exchange B): 2 padded all_to_alls, or the two block chains' (P-1) +
-        (P1-1) rotations."""
+        exchange B): 2 padded all_to_alls, the block chains' (P-1) + (P1-1)
+        rotations, or 2 one-shot ragged collectives for UNBUFFERED on
+        backends with the HLO."""
         if self._ragged2 is not None:
-            return (self.params.num_shards - 1) + (self.P1 - 1)
+            return (
+                self._ragged2[(AX1, AX2)].rounds() + self._ragged2[(AX1,)].rounds()
+            )
         return 2
 
     def _exchange(self, buf, axes, reverse=False):

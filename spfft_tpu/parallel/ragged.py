@@ -74,6 +74,48 @@ def _wire_cast_in(chunk, wire, dtype, real_dtype):
     return chunk.astype(dtype)
 
 
+def _wire_np_dtype(wire):
+    """Real scalar dtype a wire tag casts to (None: no cast). Callers split
+    complex parts into (re, im) real pairs BEFORE applying this — see
+    _split_complex."""
+    if wire is None:
+        return None
+    if wire == "f32":
+        return np.float32
+    if wire == "bf16":
+        return jnp.bfloat16
+    raise ValueError(f"unknown wire format {wire!r}")
+
+
+def _fold_axis_index(axis_names, axis_sizes):
+    """Traced row-major flat shard index over the given mesh axes."""
+    me = 0
+    for name, size in zip(axis_names, axis_sizes):
+        me = me * size + jax.lax.axis_index(name)
+    return me
+
+
+def _split_complex(parts):
+    """Complex parts ride as (re, im) real pairs: collective operands stay
+    real (complex HLO support varies across backends), and the wire casts
+    become plain dtype swaps."""
+    if not jnp.iscomplexobj(parts[0]):
+        return list(parts), None
+    real_parts = []
+    for p in parts:
+        real_parts += [p.real, p.imag]
+    return real_parts, parts[0].dtype
+
+
+def _join_complex(outs, cdtype):
+    if cdtype is None:
+        return outs
+    return [
+        jax.lax.complex(outs[2 * i], outs[2 * i + 1]).astype(cdtype)
+        for i in range(len(outs) // 2)
+    ]
+
+
 def _wire_step(chunks, k, num_shards, axis_names, wire, dtype, real_dtype):
     """One rotation step's wire protocol, shared by both chain forms: stack
     multi-part chunks, cast to the wire format, ppermute by +k over the
@@ -414,26 +456,9 @@ class OneShotExchange:
             jnp.asarray(self._cumn.astype(i32)),
         )
 
-    @staticmethod
-    def _split_complex(parts):
-        """Complex parts ride as (re, im) real pairs: the ragged collective's
-        operand stays real (complex HLO support varies across backends), and
-        the wire casts become plain dtype swaps."""
-        if not jnp.iscomplexobj(parts[0]):
-            return list(parts), None
-        real_parts = []
-        for p in parts:
-            real_parts += [p.real, p.imag]
-        return real_parts, parts[0].dtype
-
-    @staticmethod
-    def _join_complex(outs, cdtype):
-        if cdtype is None:
-            return outs
-        return [
-            jax.lax.complex(outs[2 * i], outs[2 * i + 1]).astype(cdtype)
-            for i in range(len(outs) // 2)
-        ]
+    # complex parts ride as (re, im) real pairs (module helpers)
+    _split_complex = staticmethod(_split_complex)
+    _join_complex = staticmethod(_join_complex)
 
     def _transport_exchange(self, send, out, in_off, send_sizes, out_off,
                             recv_sizes, recv_off, step_sizes, wire, dtype, rt):
@@ -443,11 +468,7 @@ class OneShotExchange:
         segment FROM each peer lands here) — the collective needs the former,
         the chain the latter."""
         P = self.P
-        wd = None
-        if wire == "f32":
-            wd = np.float32
-        elif wire == "bf16":
-            wd = jnp.bfloat16
+        wd = _wire_np_dtype(wire)
         if self.transport == "ragged":
             buf = send if wd is None else send.astype(wd)
             obuf = out if wd is None else out.astype(wd)
@@ -612,6 +633,128 @@ class OneShotExchange:
         return self._join_complex(outs, cdt)
 
 
+class OneShotBlockExchange:
+    """One-collective exact-counts variant of :class:`RaggedBlockExchange`.
+
+    Same block geometry and ``exchange`` contract (a (P, R, C) buffer per part
+    whose block for destination ``d`` on shard ``s`` is the top-left
+    ``(rows[s, d], cols[s, d])`` rectangle), but the exact rectangles ride ONE
+    :func:`jax.lax.ragged_all_to_all` instead of P-1 rotation rounds — the
+    UNBUFFERED (Alltoallw) discipline for the 2-D pencil engines' exchanges.
+    Requires a backend that compiles the ragged-all-to-all HLO
+    (:func:`_ragged_a2a_supported`); callers fall back to the chain class
+    elsewhere.
+
+    Send layout: destination-contiguous exact rectangles at static per-shard
+    offsets (exclusive prefix sums of ``rows * cols`` over destinations);
+    recv layout: source-contiguous segments at the receiver's prefix sums.
+    Both offset tables are static (P, P) numpy arrays — only the ``me`` row
+    lookup is traced.
+    """
+
+    def __init__(self, axis_names, axis_sizes, rows, cols, R, C):
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(int(n) for n in axis_sizes)
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        self.P = int(np.prod(self.axis_sizes))
+        if rows.shape != (self.P, self.P) or cols.shape != (self.P, self.P):
+            raise ValueError("rows/cols must be (P, P) tables")
+        self.R, self.C = int(R), int(C)
+        if (rows > self.R).any() or (cols > self.C).any():
+            raise ValueError("rows/cols entries must fit the (R, C) block")
+        self._rows, self._cols = rows, cols
+        self._geom = {}
+        for reverse in (False, True):
+            r = rows.T if reverse else rows
+            c = cols.T if reverse else cols
+            prod = r * c  # (P, P): prod[s, d] elements s sends d
+            off_in = np.cumsum(prod, axis=1) - prod  # exclusive, per sender
+            off_recv = np.cumsum(prod, axis=0) - prod  # exclusive, per receiver
+            self._geom[reverse] = (
+                r.astype(np.int32), c.astype(np.int32),
+                prod.astype(np.int32), off_in.astype(np.int32),
+                off_recv.astype(np.int32),
+                max(1, int(prod.sum(axis=1).max())),
+                max(1, int(prod.sum(axis=0).max())),
+            )
+
+    def offwire_elems(self) -> int:
+        """Exact off-shard elements per exchange (sum over i != j of the
+        rectangles) — direction-independent."""
+        prod = self._rows * self._cols
+        return int(prod.sum() - np.diag(prod).sum())
+
+    def rounds(self) -> int:
+        return 1
+
+    def _me(self):
+        return _fold_axis_index(self.axis_names, self.axis_sizes)
+
+    def exchange(self, parts, wire=None, real_dtype=None, reverse=False):
+        """Same contract as :meth:`RaggedBlockExchange.exchange`. Complex
+        parts are split into (re, im) real pairs around the collective (the
+        ragged-all-to-all operand stays real; see _split_complex)."""
+        parts, cdt = _split_complex(parts)
+        P, R, C = self.P, self.R, self.C
+        rows, cols, prod, off_in, off_recv, send_n, recv_n = self._geom[
+            bool(reverse)
+        ]
+        rows_t = jnp.asarray(rows)
+        cols_t = jnp.asarray(cols)
+        prod_t = jnp.asarray(prod)
+        off_in_t = jnp.asarray(off_in)
+        off_recv_t = jnp.asarray(off_recv)
+        me = self._me()
+        dtype = parts[0].dtype
+
+        # pack: (P, R, C) blocks -> destination-contiguous send buffer
+        r_i = jnp.arange(R, dtype=jnp.int32)[None, :, None]
+        c_i = jnp.arange(C, dtype=jnp.int32)[None, None, :]
+        valid_s = (r_i < rows_t[me][:, None, None]) & (
+            c_i < cols_t[me][:, None, None]
+        )
+        sdest = off_in_t[me][:, None, None] + r_i * cols_t[me][:, None, None] + c_i
+        sdest = jnp.where(valid_s, sdest, send_n).reshape(-1)
+        send = jnp.stack(
+            [
+                jnp.zeros(send_n + 1, dtype=dtype).at[sdest].set(p.reshape(-1))[
+                    :send_n
+                ]
+                for p in parts
+            ],
+            axis=-1,
+        )
+
+        wd = _wire_np_dtype(wire)
+        buf = send if wd is None else send.astype(wd)
+        out = jnp.zeros((recv_n, len(parts)), dtype=buf.dtype)
+        res = jax.lax.ragged_all_to_all(
+            buf, out,
+            off_in_t[me],
+            prod_t[me],
+            off_recv_t[me],  # where my segment lands on each receiver
+            prod_t[:, me],
+            axis_name=self.axis_names,
+        )
+        if wd is not None:
+            res = res.astype(dtype)
+
+        # unpack: source-contiguous segments -> (P, R, C) blocks
+        valid_r = (r_i < rows_t[:, me][:, None, None]) & (
+            c_i < cols_t[:, me][:, None, None]
+        )
+        gsrc = (
+            off_recv_t[:, me][:, None, None]
+            + r_i * cols_t[:, me][:, None, None]
+            + c_i
+        )
+        gsrc = jnp.where(valid_r, gsrc, recv_n).reshape(-1)
+        res_g = jnp.concatenate([res, jnp.zeros((1, len(parts)), dtype)])
+        outs = [res_g[gsrc, j].reshape(P, R, C) for j in range(len(parts))]
+        return _join_complex(outs, cdt)
+
+
 class RaggedBlockExchange:
     """Exact-counts exchange over rectangular-valid padded block buffers.
 
@@ -668,11 +811,16 @@ class RaggedBlockExchange:
         Direction-independent totals (see __init__)."""
         return tuple(self._sizes[False][1:])
 
+    def offwire_elems(self) -> int:
+        """Off-shard elements per exchange, summed over the subgroup's P
+        shards (each ships every step's per-step-max buffer)."""
+        return self.P * sum(self.step_buffer_sizes)
+
+    def rounds(self) -> int:
+        return self.P - 1
+
     def _me(self):
-        me = 0
-        for name, size in zip(self.axis_names, self.axis_sizes):
-            me = me * size + jax.lax.axis_index(name)
-        return me
+        return _fold_axis_index(self.axis_names, self.axis_sizes)
 
     def exchange(self, parts, wire=None, real_dtype=None, reverse=False):
         """parts: list of (P, R, C) arrays. Returns the received blocks as a

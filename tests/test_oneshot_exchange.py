@@ -199,3 +199,56 @@ def test_oneshot_run_twice_zeroing():
     first = t.backward([v.copy() for v in vps])
     second = t.backward([v.copy() for v in vps])
     np.testing.assert_array_equal(np.asarray(first), np.asarray(second))
+
+
+def test_oneshot_block_exchange_geometry():
+    """OneShotBlockExchange (the pencil engines' UNBUFFERED form): the static
+    offset tables must tile each shard's send/recv buffers exactly — segment
+    [off, off+size) ranges are disjoint, ordered, and the sender's size table
+    is the transpose of the receiver's (the ragged-all-to-all invariant
+    send_sizes == all_to_all(recv_sizes)). Numerics run on TPU (the HLO is
+    unavailable on XLA:CPU; CPU plans fall back to the chain class, which the
+    pencil2 tests cover)."""
+    from spfft_tpu.parallel.ragged import (
+        OneShotBlockExchange,
+        RaggedBlockExchange,
+    )
+
+    rng = np.random.default_rng(11)
+    P1, P2 = 2, 3
+    P = P1 * P2
+    R, C = 7, 5
+    rows = rng.integers(0, R + 1, size=(P, P))
+    cols = rng.integers(0, C + 1, size=(P, P))
+    one = OneShotBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
+    chain = RaggedBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
+    for reverse in (False, True):
+        r, c, prod, off_in, off_recv, send_n, recv_n = one._geom[reverse]
+        assert (prod == r.astype(np.int64) * c).all()
+        for s in range(P):
+            # sender s: destination segments tile [0, sum) in order
+            ends = off_in[s] + prod[s]
+            assert off_in[s][0] == 0
+            assert (off_in[s][1:] == ends[:-1]).all()
+            assert ends[-1] <= send_n
+            # receiver s: source segments tile [0, sum) in order
+            ends_r = off_recv[:, s] + prod[:, s]
+            assert off_recv[0, s] == 0
+            assert (off_recv[1:, s] == ends_r[:-1]).all()
+            assert ends_r[-1] <= recv_n
+        # cross-implementation check: the chain class derives its per-step
+        # buffer sizes independently (per-distance maxima over the same
+        # rows/cols geometry); the one-shot prod table must reproduce them
+        r64, c64 = (rows.T, cols.T) if reverse else (rows, cols)
+        s_idx = np.arange(P)
+        for k in range(P):
+            step_max = max(
+                1, int((r64[s_idx, (s_idx + k) % P] * c64[s_idx, (s_idx + k) % P]).max())
+            )
+            assert step_max == chain._sizes[reverse][k]
+            assert step_max == max(
+                1, int(prod[s_idx, (s_idx + k) % P].max())
+            )
+    # exact volume: never above the chain's per-step-max volume
+    assert one.offwire_elems() <= chain.offwire_elems()
+    assert one.rounds() == 1 and chain.rounds() == P - 1
