@@ -1,0 +1,230 @@
+"""Round-3 on-chip measurement batch — ONE process, one device claim.
+
+Runs every chip-gated A/B and re-measurement in a single interpreter so a
+flaky tunnel is claimed once: the sparse-y arm (ROADMAP P1), the
+lane-rotation arm (sanity re-check), the 32^3 long-chain re-measure, the
+exchange-specialized P=1 distributed plan, the 512^3 R2C config-5 shape, and
+the ragged-all-to-all backend probe. Results append incrementally to
+``bench_results/round3_onchip.json`` so a mid-batch death keeps earlier rows.
+
+Timing protocol: CHAIN dependent roundtrips inside one jitted ``lax.scan``
+with a scalar host fetch (the tunnel's ~110 ms fixed per-call cost amortized
+to noise; see bench.py / BASELINE.md).
+
+Usage: python programs/round3_measurements.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).resolve().parent.parent / "bench_results" / "round3_onchip.json"
+
+
+def flops_pair(dim):
+    import numpy as np
+
+    n = dim**3
+    return 2 * 5.0 * n * np.log2(n)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="short chains (smoke)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round3_measurements", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev} ({dev.client.platform_version})", file=sys.stderr)
+    disarm()
+
+    import os
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ExchangeType,
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+    from spfft_tpu.ops import lanecopy
+    from spfft_tpu.parameters import distribute_triplets
+
+    results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def time_chain(trace_backward, trace_forward, re0, im0, chain):
+        def body(carry, _):
+            sre, sim = trace_backward(*carry)
+            return trace_forward(sre, sim, ScalingType.FULL), None
+
+        step = jax.jit(lambda r, i: jax.lax.scan(body, (r, i), None, length=chain)[0])
+        wre, wim = step(re0, im0)
+        np.asarray(jax.device_get(wre.ravel()[0]))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, cim = step(re0, im0)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max())
+        return best, err
+
+    def measure_local(name, dim, sparsity, chain, env=None, no_rotation=False):
+        envs = dict(env or {})
+        saved = {k: os.environ.get(k) for k in envs}
+        os.environ.update(envs)
+        orig_rot = lanecopy.plan_alignment_rotations
+        if no_rotation:
+            lanecopy.plan_alignment_rotations = lambda *a, **k: None
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            t = Transform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                indices=trip, dtype=np.float32,
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            best, err = time_chain(ex.trace_backward, ex.trace_forward, re0, im0, chain)
+            row = {
+                "name": name, "dim": dim, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+                "sparse_y_engaged": bool(getattr(ex, "_sparse_y", False)),
+                "rotations": not no_rotation and ex._phase is not None,
+            }
+            record(row)
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+        finally:
+            lanecopy.plan_alignment_rotations = orig_rot
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def measure_dist1(name, dim, sparsity, chain):
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            per = distribute_triplets(trip, 1, dim)
+            mesh = sp.make_fft_mesh(1)
+            t = DistributedTransform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, per,
+                mesh=mesh, dtype=np.float32, engine="mxu",
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            vals = [
+                (rng.standard_normal(len(p)) + 1j * rng.standard_normal(len(p))).astype(
+                    np.complex64
+                )
+                for p in per
+            ]
+            re0, im0 = ex.pad_values(vals)
+            best, err = time_chain(ex.trace_backward, ex.trace_forward, re0, im0, chain)
+            record({
+                "name": name, "dim": dim, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+            })
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    CH = 48 if args.quick else 384
+    CH32 = 256 if args.quick else 2048
+
+    # ragged-all-to-all availability on this backend (UNBUFFERED's one-shot
+    # transport; P=1 probe — multi-chip isn't attachable here)
+    try:
+        from spfft_tpu.parallel.ragged import _ragged_a2a_supported
+
+        mesh1 = sp.make_fft_mesh(1)
+        record({
+            "name": "ragged_all_to_all_supported",
+            "platform": dev.platform,
+            "supported": bool(_ragged_a2a_supported(mesh1)),
+        })
+    except Exception as e:
+        record({"name": "ragged_all_to_all_supported", "error": str(e)})
+
+    # headline arms
+    measure_local("c2c_256_s15_baseline", 256, 0.659, CH)
+    measure_local(
+        "c2c_256_s15_sparse_y", 256, 0.659, CH, env={"SPFFT_TPU_SPARSE_Y": "1"}
+    )
+    measure_local("c2c_256_s15_no_rotation", 256, 0.659, CH, no_rotation=True)
+
+    # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)
+    measure_local("c2c_32_dense", 32, 1.1, CH32)
+
+    # P=1 distributed plan with the exchange specialized away
+    measure_dist1("dist1_c2c_256_s15_specialized", 256, 0.659, CH)
+
+    # config-5 shape re-check (512^3 R2C 15% spherical) — shorter chain
+    try:
+        dim = 512
+        trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+        xs = (trip[:, 0] >= 0) & (trip[:, 0] <= dim // 2)  # half-spectrum
+        trip_r2c = trip[xs]
+        t = Transform(
+            ProcessingUnit.GPU, TransformType.R2C, dim, dim, dim,
+            indices=trip_r2c, dtype=np.float32,
+        )
+        ex = t._exec
+        rng = np.random.default_rng(0)
+        n = len(trip_r2c)
+        re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        chain = 16 if args.quick else 96
+
+        def body(carry, _):
+            space = ex.trace_backward(*carry)
+            return ex.trace_forward(space, None, ScalingType.FULL), None
+
+        step = jax.jit(lambda r, i: jax.lax.scan(body, (r, i), None, length=chain)[0])
+        wre, _ = step(re0, im0)
+        float(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        record({
+            "name": "r2c_512_sph15", "dim": 512, "chain": chain,
+            "ms_per_pair": round(best * 1e3, 2),
+            "gflops": round(flops_pair(512) / best / 1e9, 1),
+        })
+    except Exception as e:
+        record({"name": "r2c_512_sph15", "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT} ({len(results)} rows)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
