@@ -87,15 +87,33 @@ def ensure_virtual_devices(n_devices: int, *, warn: bool = False, platform=None)
     initialization (the embedded-interpreter caller wants the diagnostic;
     raising would break an otherwise-valid single-device run).
     """
+    import os
+    import sys
+
     from .._platform import cpu_devices, global_init_is_safe
 
     n_devices = max(int(n_devices), 1)
     configure_virtual_devices(n_devices, warn=warn)
     if platform == "cpu":
         devices = cpu_devices()
-    elif global_init_is_safe():
+    elif global_init_is_safe() or os.environ.get(
+        "SPFFT_TPU_ENSURE_PLATFORM"
+    ) == "default":
         devices = jax.devices(platform)
     else:
+        # Uninitialized backends + a non-CPU platform configured: initializing
+        # the default platform here can block indefinitely on a wedged
+        # tunneled accelerator, so resolve the (always-satisfiable) virtual
+        # CPU path and say so. Callers on a healthy pod slice who want the
+        # real chips: initialize the backend first (any jax.devices() call),
+        # pass devices= explicitly, or set SPFFT_TPU_ENSURE_PLATFORM=default.
+        print(
+            "spfft_tpu: ensure_virtual_devices resolving virtual CPU devices "
+            "without initializing the configured default platform "
+            f"({jax.config.jax_platforms or 'autodetect'}); initialize it "
+            "first or set SPFFT_TPU_ENSURE_PLATFORM=default for real devices",
+            file=sys.stderr,
+        )
         devices = cpu_devices()
     if len(devices) < n_devices:
         try:

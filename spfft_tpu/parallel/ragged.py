@@ -116,6 +116,20 @@ def _join_complex(outs, cdtype):
     ]
 
 
+def _chain_step_sizes(n, L):
+    """Per-rotation static buffer sizes for an exact-counts chain over
+    per-shard stick counts ``n`` and plane counts ``L``: at step k every
+    shard's buffer is the per-step maximum exact product (>= 1 so iota shapes
+    stay valid). Returns (backward, forward) size lists; forward sizes are
+    the backward ones with the rotation reversed (b_fwd[k] == b_bwd[P-k]).
+    Shared by the COMPACT chain and the one-shot exchange's chain transport."""
+    P = int(n.size)
+    s = np.arange(P)
+    b_bwd = [max(1, int((n * L[(s + k) % P]).max())) for k in range(P)]
+    b_fwd = [max(1, int((n[(s + k) % P] * L).max())) for k in range(P)]
+    return b_bwd, b_fwd
+
+
 def _wire_step(chunks, k, num_shards, axis_names, wire, dtype, real_dtype):
     """One rotation step's wire protocol, shared by both chain forms: stack
     multi-part chunks, cast to the wire format, ppermute by +k over the
@@ -161,12 +175,7 @@ class RaggedExchange:
         # Per-step exact-product buffer sizes (>= 1 so iota shapes stay valid).
         # One static size per step serves both sides: at step k, max over
         # senders of the send size equals max over receivers of the recv size.
-        self._b_bwd = [
-            max(1, int((n * L[(np.arange(P) + k) % P]).max())) for k in range(P)
-        ]
-        self._b_fwd = [
-            max(1, int((n[(np.arange(P) + k) % P] * L).max())) for k in range(P)
-        ]
+        self._b_bwd, self._b_fwd = _chain_step_sizes(n, L)
 
     @property
     def step_buffer_sizes(self):
@@ -424,14 +433,8 @@ class OneShotExchange:
         ).astype(np.int32)
         # compact row -> (owner shard, local row) for the forward send packing
         self._row_cumn = np.repeat(self._cumn, n).astype(np.int64)
-        # chain-transport per-step buffer sizes (same products as RaggedExchange)
-        s = np.arange(self.P)
-        self._b_bwd = [
-            max(1, int((n * L[(s + k) % self.P]).max())) for k in range(self.P)
-        ]
-        self._b_fwd = [
-            max(1, int((n[(s + k) % self.P] * L).max())) for k in range(self.P)
-        ]
+        # chain-transport per-step buffer sizes (shared rule with RaggedExchange)
+        self._b_bwd, self._b_fwd = _chain_step_sizes(n, L)
 
     def offwire_elems(self) -> int:
         """Exact off-shard element count per exchange direction, summed over
