@@ -58,14 +58,19 @@ def _acquire_backend():
     def _reset_backends():
         # jax caches the backend table after first init (including a
         # CPU-only table when an accelerator plugin fail-quietly died), so a
-        # retry must clear it or it would be a no-op.
+        # retry must clear it or it would be a no-op. jax 0.9 removed the
+        # public jax.clear_backends; the maintained implementation lives in
+        # jax._src.api (it also clears the get_backend/util caches).
         try:
-            jax.clear_backends()
+            from jax._src.api import clear_backends
+
+            clear_backends()
         except Exception:
             try:
                 import jax._src.xla_bridge as xb
 
                 xb._clear_backends()
+                xb.get_backend.cache_clear()
             except Exception:
                 pass
 

@@ -368,9 +368,18 @@ class DistributedTransform:
     def exchange_wire_bytes(self) -> int:
         """Off-shard interconnect bytes per slab<->pencil repartition under the
         plan's exchange discipline (see PaddingHelpers.exchange_wire_bytes).
-        Bytes only — round count is not captured (see parallel/ragged.py's
-        LATENCY note)."""
+        Bytes only — pair with :meth:`exchange_rounds` for the latency side."""
         return self._exec.exchange_wire_bytes()
+
+    def exchange_rounds(self) -> int:
+        """Sequential collective rounds per repartition under the plan's
+        exchange discipline and active transport: 1 for the padded all_to_all
+        and the one-shot UNBUFFERED ragged exchange, P-1 for the COMPACT
+        ppermute chain (and UNBUFFERED's chain-transport fallback on backends
+        without the ragged-all-to-all HLO). Together with
+        :meth:`exchange_wire_bytes` this is the bytes-vs-latency picture a
+        discipline choice trades off (see BASELINE.md's measured comparison)."""
+        return self._exec.exchange_rounds()
 
     @property
     def dtype(self) -> np.dtype:

@@ -25,11 +25,11 @@ class ExchangeType(enum.IntEnum):
     ragged-all-to-all HLO (parallel/ragged.py OneShotExchange) — the analogue of the
     reference's zero-copy ``MPI_Alltoallw`` exchange: exact bytes AND single-round
     latency on backends that compile the HLO (TPU); elsewhere the same one-shot
-    buffers ride a chain transport (P-1 rounds, identical numerics). The one-shot
-    form applies to the 1-D slab meshes (the reference's scope); on a 2-D pencil
-    mesh (``make_fft_mesh2``, beyond the reference) UNBUFFERED currently runs the
-    exact-counts block chains like COMPACT_BUFFERED — check
-    ``exchange_rounds()``/``exchange_wire_bytes()`` for any plan's actual costs. The
+    buffers ride a chain transport (P-1 rounds, identical numerics). On a 2-D
+    pencil mesh (``make_fft_mesh2``, beyond the reference) UNBUFFERED runs the
+    same one-shot discipline per exchange (OneShotBlockExchange; block chains as
+    the off-TPU fallback) — check ``exchange_rounds()``/``exchange_wire_bytes()``
+    for any plan's actual costs under its active transport. The
     ``*_FLOAT`` variants halve wire bytes by converting the exchanged payload to
     single precision on the wire, exactly like the reference's float exchange
     (reference: src/gpu_util/complex_conversion.cuh:37-56).
