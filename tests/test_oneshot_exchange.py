@@ -5,8 +5,11 @@ datatypes — exact counts, one call (reference:
 src/transpose/transpose_mpi_unbuffered_host.cpp:51-176). Here that discipline
 is a single ragged-all-to-all collective on backends that compile the HLO, and
 the same one-shot buffer layout over a ppermute chain elsewhere (XLA:CPU —
-what these tests run, so they validate the entire discipline except the HLO
-itself, which the TPU bench exercises).
+what these tests run). The transform-level tests exercise the chain
+transport; the *_via_emulation tests additionally validate the ragged
+transport's exact collective call contract (offsets/sizes/output placement)
+against a ppermute-built emulation of ragged_all_to_all, so the only thing
+left to the TPU bench is the HLO implementation itself.
 """
 import numpy as np
 import pytest
@@ -252,3 +255,194 @@ def test_oneshot_block_exchange_geometry():
     # exact volume: never above the chain's per-step-max volume
     assert one.offwire_elems() <= chain.offwire_elems()
     assert one.rounds() == 1 and chain.rounds() == P - 1
+
+
+def _emulated_ragged_all_to_all(axis_names, axis_sizes):
+    """Reference emulation of jax.lax.ragged_all_to_all built from ppermute:
+    step k ships the ENTIRE (buffer, offsets, sizes) of the distance-k source
+    and copies out the one segment addressed to this shard. O(P * N) wire —
+    test-only — but semantically exact: it also checks the caller's axis_name
+    and cross-checks recv_sizes against the sender-side send_sizes each step
+    (a mismatch poisons the output with NaN so the comparison fails), so
+    patching it in validates the one-shot exchanges' full collective call
+    contract on backends without the HLO."""
+    import jax
+    import jax.numpy as jnp
+
+    from spfft_tpu.parallel.ragged import _fold_axis_index
+
+    P = int(np.prod(axis_sizes))
+
+    def emu(operand, output, input_offsets, send_sizes, output_offsets,
+            recv_sizes, *, axis_name=None, axis_index_groups=None):
+        assert axis_index_groups is None
+        names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        assert names == tuple(axis_names), (names, axis_names)
+        me = _fold_axis_index(axis_names, axis_sizes)
+        out = output
+        n_out = output.shape[0]
+        idx = jnp.arange(n_out, dtype=jnp.int32)
+        for k in range(P):
+            perm = [(i, (i + k) % P) for i in range(P)]
+            # after this ppermute I hold the buffers of src = me - k
+            op_s = jax.lax.ppermute(operand, axis_names, perm)
+            in_off_s = jax.lax.ppermute(input_offsets, axis_names, perm)
+            sz_s = jax.lax.ppermute(send_sizes, axis_names, perm)
+            out_off_s = jax.lax.ppermute(output_offsets, axis_names, perm)
+            # the segment src sends to ME: src-side tables indexed by me
+            src = (me - k) % P
+            src_off = in_off_s[me]
+            size = sz_s[me]
+            dst_off = out_off_s[me]
+            take = jnp.clip(idx - dst_off + src_off, 0, op_s.shape[0] - 1)
+            seg = op_s[take]
+            # contract check: my recv_sizes[src] must equal what src sends me
+            seg = jnp.where(recv_sizes[src] == size, seg, jnp.nan)
+            mask = (idx >= dst_off) & (idx < dst_off + size)
+            out = jnp.where(mask[:, None] if out.ndim == 2 else mask, seg, out)
+        return out
+
+    return emu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_oneshot_ragged_transport_matches_chain_via_emulation(seed, monkeypatch):
+    """Run the 1-D one-shot exchange with transport='ragged' against an
+    emulated ragged_all_to_all and compare to the chain transport on the same
+    geometry — validating the exact offsets/sizes the TPU HLO will receive."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P_
+
+    from spfft_tpu.parallel.ragged import OneShotExchange
+
+    rng = np.random.default_rng(300 + seed)
+    P = int(rng.choice([3, 4, 6]))
+    Z = int(rng.integers(6, 12))
+    S = int(rng.integers(2, 5))
+    n = rng.integers(0, S + 1, size=P)
+    if n.sum() == 0:
+        n[0] = 1
+    # random contiguous z-slabs
+    cuts = np.sort(rng.choice(np.arange(1, Z), size=P - 1, replace=False))
+    bounds = np.concatenate([[0], cuts, [Z]])
+    L = np.diff(bounds)
+    zo = bounds[:-1]
+    Lm = int(L.max())
+    nslots = P * S + 3
+    # unique plane slots for the real sticks
+    yx = np.full(P * S, nslots, dtype=np.int64)
+    slots = rng.permutation(nslots)[: int(n.sum())]
+    si = 0
+    for r in range(P):
+        yx[r * S : r * S + n[r]] = slots[si : si + n[r]]
+        si += n[r]
+
+    args = (n, L, zo, S, Lm, Z, nslots, yx)
+    one_ragged = OneShotExchange(*args, transport="ragged")
+    one_chain = OneShotExchange(*args, transport="chain")
+
+    devs = jax.devices()[:P]
+    if len(devs) < P:
+        pytest.skip(f"needs {P} devices")
+    mesh = Mesh(np.asarray(devs), ("fft",))
+    monkeypatch.setattr(
+        jax.lax, "ragged_all_to_all", _emulated_ragged_all_to_all(("fft",), (P,))
+    )
+
+    sticks = rng.standard_normal((P, S, Z)).astype(np.float32)
+    sharding = NamedSharding(mesh, P_("fft", None, None))
+    x = jax.device_put(sticks, sharding)
+
+    def run(ex):
+        def f(part):
+            flats = ex.backward((part[0],))
+            back = ex.forward((flats[0],))
+            return flats[0][None], back[0][None]
+
+        g = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=P_("fft", None, None),
+                out_specs=(P_("fft", None), P_("fft", None, None)),
+                check_vma=False,
+            )
+        )
+        return g(x)
+
+    planes_r, sticks_r = run(one_ragged)
+    planes_c, sticks_c = run(one_chain)
+    np.testing.assert_allclose(np.asarray(planes_r), np.asarray(planes_c), atol=0)
+    np.testing.assert_allclose(np.asarray(sticks_r), np.asarray(sticks_c), atol=0)
+    # forward(backward) recovers the real sticks (padding rows may differ)
+    for r in range(P):
+        np.testing.assert_allclose(
+            np.asarray(sticks_r)[r, : n[r]], sticks[r, : n[r]], atol=1e-6
+        )
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_oneshot_block_ragged_transport_matches_chain_via_emulation(seed, monkeypatch):
+    """OneShotBlockExchange (the pencil engines' UNBUFFERED form) against the
+    emulated ragged_all_to_all, compared to RaggedBlockExchange on identical
+    geometry — both directions (reverse=False/True)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P_
+
+    from spfft_tpu.parallel.ragged import (
+        OneShotBlockExchange,
+        RaggedBlockExchange,
+    )
+
+    rng = np.random.default_rng(500 + seed)
+    P1, P2 = (2, 2) if seed == 0 else (3, 2)
+    P = P1 * P2
+    R, C = 4, 5
+    rows = rng.integers(0, R + 1, size=(P, P))
+    cols = rng.integers(0, C + 1, size=(P, P))
+    one = OneShotBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
+    chain = RaggedBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
+
+    devs = jax.devices()[:P]
+    if len(devs) < P:
+        pytest.skip(f"needs {P} devices")
+    mesh = Mesh(np.asarray(devs).reshape(P1, P2), ("fft", "fft2"))
+    monkeypatch.setattr(
+        jax.lax,
+        "ragged_all_to_all",
+        _emulated_ragged_all_to_all(("fft", "fft2"), (P1, P2)),
+    )
+
+    # blocks with exact valid rectangles (sender-direction tables), zero padding
+    data = np.zeros((P, P, R, C), dtype=np.float32)
+    for s in range(P):
+        for d in range(P):
+            data[s, d, : rows[s, d], : cols[s, d]] = rng.standard_normal(
+                (rows[s, d], cols[s, d])
+            )
+    sharding = NamedSharding(mesh, P_(("fft", "fft2"), None, None, None))
+    x = jax.device_put(data, sharding)
+
+    for reverse in (False, True):
+        if reverse:
+            xr = jax.device_put(np.swapaxes(data, 0, 1).copy(), sharding)
+        else:
+            xr = x
+
+        def run(ex, xin):
+            def f(part):
+                out = ex.exchange([part[0]], reverse=reverse)
+                return out[0][None]
+
+            g = jax.jit(
+                jax.shard_map(
+                    f, mesh=mesh,
+                    in_specs=P_(("fft", "fft2"), None, None, None),
+                    out_specs=P_(("fft", "fft2"), None, None, None),
+                    check_vma=False,
+                )
+            )
+            return np.asarray(g(xin))
+
+        got_one = run(one, xr)
+        got_chain = run(chain, xr)
+        np.testing.assert_allclose(got_one, got_chain, atol=0, err_msg=f"reverse={reverse}")
