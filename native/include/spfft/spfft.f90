@@ -588,6 +588,13 @@ module spfft
       integer(c_long_long), intent(out) :: wireBytes
     end function
 
+    integer(c_int) function spfft_dist_transform_exchange_rounds(transform, &
+        rounds) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: rounds
+    end function
+
     integer(c_int) function spfft_dist_transform_local_z_length(transform, shard, &
         localZLength) bind(C)
       use iso_c_binding

@@ -168,6 +168,8 @@ SpfftError spfft_dist_transform_exchange_type(SpfftDistTransform transform,
                                               SpfftExchangeType* exchangeType);
 SpfftError spfft_dist_transform_exchange_wire_bytes(SpfftDistTransform transform,
                                                     long long int* wireBytes);
+SpfftError spfft_dist_transform_exchange_rounds(SpfftDistTransform transform,
+                                                int* rounds);
 /* per-shard layout (the reference's per-rank accessors). On 2-D pencil grids
  * the space block is (local_z_length, local_y_length, dimX); on 1-D grids
  * local_y_length == dimY and local_y_offset == 0. */

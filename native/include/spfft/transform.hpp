@@ -162,6 +162,11 @@ public:
   SpfftExchangeType exchange_type() const;
   /* Off-shard interconnect bytes per slab<->pencil repartition. */
   long long exchange_wire_bytes() const;
+  /* Sequential collective rounds per repartition under the plan's
+   * discipline and active transport. 1-D grids: 1 (padded all_to_all /
+   * one-shot ragged), P-1 (chains). 2-D pencil grids report the sum of
+   * their two exchanges: 2 (padded/one-shot) or (P-1)+(P1-1) (chains). */
+  int exchange_rounds() const;
   bool double_precision() const;
 
   /* Per-shard layout (the reference's per-rank accessors). On 2-D pencil

@@ -437,6 +437,7 @@ SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_type, SpfftExchangeType,
                       exchange_type)
 SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_wire_bytes, long long int,
                       exchange_wire_bytes)
+SPFFT_TPU_DIST_GETTER(spfft_dist_transform_exchange_rounds, int, exchange_rounds)
 
 #undef SPFFT_TPU_DIST_GETTER
 

@@ -89,6 +89,7 @@ struct DistPlan {
     int dim_x = 0, dim_y = 0, dim_z = 0, num_shards = 0;
     int transform_type = 0, processing_unit = 0, exchange_type = 0;
     long long global_size = 0, wire_bytes = 0;
+    int exchange_rounds = 0;
   } meta;
   std::vector<long long> shard_elems, shard_zlen, shard_zoff, shard_slice;
   std::vector<long long> shard_ylen, shard_yoff;
@@ -277,6 +278,7 @@ std::shared_ptr<DistPlan> make_dist_plan(const Grid& grid, bool double_precision
   m.exchange_type = static_cast<int>(plan->get("exchange_type"));
   m.global_size = plan->get("global_size");
   m.wire_bytes = plan->get("exchange_wire_bytes");
+  m.exchange_rounds = static_cast<int>(plan->get("exchange_rounds"));
   plan->num_global = plan->get("num_global_elements");
   for (int r = 0; r < m.num_shards; ++r) {
     plan->shard_elems.push_back(plan->get_shard("num_local_elements", r));
@@ -684,6 +686,9 @@ SpfftExchangeType DistributedTransform::exchange_type() const {
 }
 long long DistributedTransform::exchange_wire_bytes() const {
   return plan_->meta.wire_bytes;
+}
+int DistributedTransform::exchange_rounds() const {
+  return plan_->meta.exchange_rounds;
 }
 bool DistributedTransform::double_precision() const { return plan_->dbl; }
 

@@ -227,6 +227,13 @@ int main(void) {
     REQUIRE(got2 == counts[1]);
     CHECK(spfft_dist_transform_exchange_wire_bytes(dt, &ll));
     REQUIRE(ll > 0);
+    {
+      /* COMPACT_BUFFERED runs the ppermute chain: always shards-1 rounds,
+       * backend-independent. */
+      int rounds = 0;
+      CHECK(spfft_dist_transform_exchange_rounds(dt, &rounds));
+      REQUIRE(rounds == shards - 1);
+    }
 
     CHECK(spfft_dist_transform_backward(dt, dfreq, dspace));
     /* explicit-space forward */

@@ -452,6 +452,7 @@ _DIST_GETTERS = {
     "processing_unit": lambda t: int(t.processing_unit),
     "exchange_type": lambda t: int(t.exchange_type),
     "exchange_wire_bytes": lambda t: t.exchange_wire_bytes(),
+    "exchange_rounds": lambda t: t.exchange_rounds(),
     "execution_mode": lambda t: int(t.execution_mode()),
 }
 
