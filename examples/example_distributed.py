@@ -1,0 +1,74 @@
+"""Distributed spfft-tpu example: a 4-shard mesh transform from Python.
+
+Single-controller model: this ONE process drives every shard of a device mesh
+(the reference's per-rank MPI arrays become per-shard lists). On a machine
+without accelerators a virtual 4-device CPU mesh stands in — run with
+
+    JAX_PLATFORMS=cpu python examples/example_distributed.py
+
+(the script requests the virtual devices itself). Demonstrates the plan flow,
+the round-trip, and the exchange-discipline accounting
+(``exchange_wire_bytes`` / ``exchange_rounds``) that guides the
+BUFFERED / COMPACT_BUFFERED / UNBUFFERED choice.
+"""
+import numpy as np
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
+from spfft_tpu.parallel.mesh import ensure_virtual_devices
+from spfft_tpu.parameters import distribute_triplets
+
+
+def main():
+    dim = 16
+    num_shards = 4
+
+    devices = ensure_virtual_devices(num_shards, platform="cpu")
+    mesh = sp.make_fft_mesh(devices=devices)
+
+    # Frequency-domain triplets inside a spherical cutoff (plane-wave style),
+    # partitioned by whole z-sticks — every (x, y) column lives on one shard.
+    triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.7)
+    per_shard = distribute_triplets(triplets, num_shards, dim)
+
+    rng = np.random.default_rng(0)
+    values = [
+        rng.standard_normal(len(p)) + 1j * rng.standard_normal(len(p))
+        for p in per_shard
+    ]
+
+    for exchange in (
+        ExchangeType.BUFFERED,
+        ExchangeType.COMPACT_BUFFERED,
+        ExchangeType.UNBUFFERED,
+    ):
+        t = DistributedTransform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            dim,
+            dim,
+            dim,
+            [p.copy() for p in per_shard],
+            mesh=mesh,
+            exchange_type=exchange,
+        )
+        space = t.backward([v.copy() for v in values])  # global (Z, Y, X)
+        back = t.forward(scaling=ScalingType.FULL)  # per-shard value lists
+        err = max(np.abs(b - v).max() for b, v in zip(back, values))
+        print(
+            f"{exchange.name:16s} roundtrip {err:.2e}  "
+            f"wire {t.exchange_wire_bytes():>8,} B  "
+            f"rounds {t.exchange_rounds()}"
+        )
+        assert err < 1e-4  # f32 default dtype (dtype=np.float64 + x64 for 1e-14)
+    print("space domain shape:", space.shape)
+
+
+if __name__ == "__main__":
+    main()

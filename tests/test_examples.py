@@ -9,7 +9,11 @@ from pathlib import Path
 import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
-EXAMPLES = [ROOT / "examples" / "example.py", ROOT / "examples" / "poisson.py"]
+EXAMPLES = [
+    ROOT / "examples" / "example.py",
+    ROOT / "examples" / "example_distributed.py",
+    ROOT / "examples" / "poisson.py",
+]
 
 
 @pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
