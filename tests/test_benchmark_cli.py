@@ -80,3 +80,44 @@ def test_cli_pencil2(tmp_path):
     assert r["parameters"]["mesh2"] == [2, 2]
     assert r["parameters"]["shards"] == 4
     assert r["results"]["exchange_wire_bytes"] > 0
+
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_discipline_compare_cli(tmp_path):
+    """programs/discipline_compare.py (the BUFFERED/COMPACT/UNBUFFERED
+    bytes+rounds+wall-clock comparison behind BASELINE.md's table) runs and
+    emits consistent rows at toy scale."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "disc.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(ROOT)}
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [
+            sys.executable, str(ROOT / "programs" / "discipline_compare.py"),
+            "--shards", "2", "4", "--dim", "8", "--sparsity", "0.6",
+            "--repeats", "2", "--engine", "xla", "--json", str(out),
+        ],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    rows = json.loads(out.read_text())["rows"]
+    assert len(rows) == 6  # 2 shard counts x 3 disciplines
+    by = {(row["P"], row["discipline"]): row for row in rows}
+    for P in (2, 4):
+        assert by[(P, "BUFFERED")]["rounds"] == 1
+        # chain transport on CPU: P-1 rounds (1 when P-1 == 1)
+        assert by[(P, "COMPACT")]["rounds"] == P - 1
+        assert by[(P, "UNBUFFERED")]["transport"] == "chain"
+        assert (
+            by[(P, "UNBUFFERED")]["wire_bytes"]
+            <= by[(P, "COMPACT")]["wire_bytes"]
+            <= by[(P, "BUFFERED")]["wire_bytes"]
+        )
+        for d in ("BUFFERED", "COMPACT", "UNBUFFERED"):
+            assert by[(P, d)]["ms_per_pair"] > 0
