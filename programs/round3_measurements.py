@@ -178,6 +178,10 @@ def main():
         "c2c_256_s15_sparse_y", 256, 0.659, CH, env={"SPFFT_TPU_SPARSE_Y": "1"}
     )
     measure_local("c2c_256_s15_no_rotation", 256, 0.659, CH, no_rotation=True)
+    measure_local(
+        "c2c_256_s15_no_pair_copy", 256, 0.659, CH,
+        env={"SPFFT_TPU_PAIR_COPY": "0"},
+    )
 
     # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)
     measure_local("c2c_32_dense", 32, 1.1, CH32)

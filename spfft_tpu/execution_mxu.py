@@ -191,10 +191,13 @@ class MxuLocalExecution(ExecutionBase):
         p = self.params
         R, Z = self._table_rows, p.dim_z
         if self._decompress_plan is not None:
-            plan = self._decompress_plan
-            sre = plan.apply(values_re).reshape(-1)[: R * Z].reshape(R, Z)
-            sim = plan.apply(values_im).reshape(-1)[: R * Z].reshape(R, Z)
-            return sre, sim
+            # one gather per pipe moves both parts (half the descriptors);
+            # SPFFT_TPU_PAIR_COPY=0 inside apply_pair restores two applies
+            pre, pim = self._decompress_plan.apply_pair(values_re, values_im)
+            return (
+                pre.reshape(-1)[: R * Z].reshape(R, Z),
+                pim.reshape(-1)[: R * Z].reshape(R, Z),
+            )
         vi = jnp.asarray(np.asarray(self._vi, dtype=np.int32))
         out = []
         for v in (values_re, values_im):
@@ -207,10 +210,13 @@ class MxuLocalExecution(ExecutionBase):
     def _compress(self, sre, sim):
         p = self.params
         if self._compress_plan is not None:
-            plan = self._compress_plan
-            vre = plan.apply(sre.reshape(-1)).reshape(-1)[: p.num_values]
-            vim = plan.apply(sim.reshape(-1)).reshape(-1)[: p.num_values]
-            return vre, vim
+            pre, pim = self._compress_plan.apply_pair(
+                sre.reshape(-1), sim.reshape(-1)
+            )
+            return (
+                pre.reshape(-1)[: p.num_values],
+                pim.reshape(-1)[: p.num_values],
+            )
         vi = jnp.asarray(np.asarray(self._vi, dtype=np.int32))
         return sre.reshape(-1)[vi], sim.reshape(-1)[vi]
 

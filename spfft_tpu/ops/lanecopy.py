@@ -170,9 +170,12 @@ class CopyPlan:
             ]
         ).reshape(self.src_rows, LANE)
 
-    def apply(self, flat):
-        """Execute the copy: flat (num_src,) -> (num_dst/LANE, LANE)."""
-        src2 = self.source_view(flat)
+    def _apply_stacked(self, src3, dtype):
+        """The copy pipeline on a stacked (B, src_rows, LANE) source ->
+        (B, num_dst/LANE, LANE). Single implementation behind both public
+        entry points, so the miscompile workaround and mask semantics cannot
+        diverge between them."""
+        B = src3.shape[0]
         out = None
         for pipe in self.pipes:
             rows = jnp.asarray(pipe.rows_sorted)
@@ -182,18 +185,20 @@ class CopyPlan:
                 # the whole shift machinery collapses to ONE row gather — no
                 # second-window concat, no per-shift slices, no barrier, no
                 # reorder (shift-sort of all-zeros is the natural order).
-                aligned = jnp.take(src2, rows, axis=0)
+                aligned = jnp.take(src3, rows, axis=1)
             else:
                 w = jnp.concatenate(
-                    [jnp.take(src2, rows, axis=0), jnp.take(src2, rows + 1, axis=0)],
-                    axis=1,
-                )  # (Rk, 2*LANE), covered blocks in shift order
+                    [jnp.take(src3, rows, axis=1), jnp.take(src3, rows + 1, axis=1)],
+                    axis=2,
+                )  # (B, Rk, 2*LANE), covered blocks in shift order
                 pieces = []
                 off = 0
                 for t, c in enumerate(pipe.shift_counts):
                     if c == 0:
                         continue
-                    pieces.append(jax.lax.slice(w, (off, t), (off + c, t + LANE)))
+                    pieces.append(
+                        jax.lax.slice(w, (0, off, t), (B, off + c, t + LANE))
+                    )
                     off += c
                 # The barrier is a MISCOMPILE workaround, not an optimization: on
                 # the TPU backend (v5e, 2026-07), fusing the concat of >= 2 pieces
@@ -205,32 +210,62 @@ class CopyPlan:
                 # sidesteps the bad fusion on every backend at negligible cost.
                 if len(pieces) > 1:
                     pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
-                aligned = jnp.concatenate(pieces, axis=0)
-                aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
+                aligned = jnp.concatenate(pieces, axis=1)
+                aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=1)
             if pipe.mask is None:
                 # in-register range mask: two compares against iota instead of
                 # reading a (Rk, LANE) f32 constant from HBM
-                lane = jnp.arange(LANE, dtype=jnp.int32)[None, :]
-                lo = jnp.asarray(pipe.mask_starts)[:, None]
-                hi = jnp.asarray(pipe.mask_ends)[:, None]
+                lane = jnp.arange(LANE, dtype=jnp.int32)[None, None, :]
+                lo = jnp.asarray(pipe.mask_starts)[None, :, None]
+                hi = jnp.asarray(pipe.mask_ends)[None, :, None]
                 contrib = jnp.where((lane >= lo) & (lane < hi), aligned, 0)
             else:
                 # where (not multiply): holes must be exact zeros even when the
                 # source carries inf/NaN next to a run boundary, matching the
                 # range path's semantics
-                contrib = jnp.where(jnp.asarray(pipe.mask > 0), aligned, 0)
+                contrib = jnp.where(jnp.asarray(pipe.mask > 0)[None], aligned, 0)
             if pipe.block_ids is None:
                 out = contrib if out is None else out + contrib
             else:
                 if out is None:
-                    out = jnp.zeros((self.num_dst // LANE, LANE), dtype=flat.dtype)
+                    out = jnp.zeros((B, self.num_dst // LANE, LANE), dtype=dtype)
                 # row-granular scatter-add into the covered blocks (unique ids)
-                out = out.at[jnp.asarray(pipe.block_ids)].add(
+                out = out.at[:, jnp.asarray(pipe.block_ids)].add(
                     contrib, unique_indices=True, mode="drop"
                 )
         if out is None:
-            out = jnp.zeros((self.num_dst // LANE, LANE), dtype=flat.dtype)
+            out = jnp.zeros((B, self.num_dst // LANE, LANE), dtype=dtype)
         return out
+
+    def apply(self, flat):
+        """Execute the copy: flat (num_src,) -> (num_dst/LANE, LANE)."""
+        src3 = self.source_view(flat)[None]
+        return self._apply_stacked(src3, flat.dtype)[0]
+
+    def apply_pair(self, flat_a, flat_b):
+        """Execute the copy on two same-shaped flats with ONE gather per pipe.
+
+        The parts ride as a stacked (2, src_rows, LANE) source, so every row
+        gather, lane-shift slice, mask and scatter-add is issued once for
+        both — the hot path for the engines' (re, im) pairs, halving the
+        copy's descriptor count vs two :meth:`apply` calls. Semantics are
+        exactly two independent applies, and ``SPFFT_TPU_PAIR_COPY=0`` (read
+        at trace time) literally runs those instead — the A/B escape hatch.
+        Returns the pair of (num_dst/LANE, LANE) outputs.
+        """
+        if not pair_copy_enabled():
+            return self.apply(flat_a), self.apply(flat_b)
+        src3 = jnp.stack([self.source_view(flat_a), self.source_view(flat_b)])
+        out = self._apply_stacked(src3, flat_a.dtype)
+        return out[0], out[1]
+
+
+def pair_copy_enabled() -> bool:
+    """Engines use :meth:`CopyPlan.apply_pair` unless ``SPFFT_TPU_PAIR_COPY=0``
+    (the A/B escape hatch; semantics are identical either way)."""
+    import os
+
+    return os.environ.get("SPFFT_TPU_PAIR_COPY", "1") != "0"
 
 
 def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values: int, max_runs: int = 64):

@@ -124,9 +124,11 @@ class MxuValuePlans:
 
         if plan is not None:
             def branch(vre, vim, plan=plan, n=n):
-                sre = plan.apply(vre[:n]).reshape(-1)[: S * Z].reshape(S, Z)
-                sim = plan.apply(vim[:n]).reshape(-1)[: S * Z].reshape(S, Z)
-                return sre, sim
+                pre, pim = plan.apply_pair(vre[:n], vim[:n])
+                return (
+                    pre.reshape(-1)[: S * Z].reshape(S, Z),
+                    pim.reshape(-1)[: S * Z].reshape(S, Z),
+                )
 
             return branch
 
@@ -157,10 +159,12 @@ class MxuValuePlans:
 
         if plan is not None:
             def branch(sre, sim, plan=plan, n=n):
-                vre = plan.apply(sre.reshape(-1)).reshape(-1)[:n]
-                vim = plan.apply(sim.reshape(-1)).reshape(-1)[:n]
+                pre, pim = plan.apply_pair(sre.reshape(-1), sim.reshape(-1))
                 pad = (0, V - n)
-                return jnp.pad(vre, pad), jnp.pad(vim, pad)
+                return (
+                    jnp.pad(pre.reshape(-1)[:n], pad),
+                    jnp.pad(pim.reshape(-1)[:n], pad),
+                )
 
             return branch
 

@@ -26,6 +26,12 @@ def _check(src_of_dst, num_src, seed=0):
         0.0,
     )
     np.testing.assert_array_equal(got, want)
+    # apply_pair must be exactly two independent applies — every pipe shape
+    # class checked here also pins the batched path (one gather, both parts)
+    flat_b = rng.standard_normal(num_src).astype(np.float32)
+    pa, pb = plan.apply_pair(jnp.asarray(flat), jnp.asarray(flat_b))
+    np.testing.assert_array_equal(np.asarray(pa), np.asarray(plan.apply(jnp.asarray(flat))))
+    np.testing.assert_array_equal(np.asarray(pb), np.asarray(plan.apply(jnp.asarray(flat_b))))
     return plan
 
 
