@@ -178,9 +178,14 @@ def main():
         "c2c_256_s15_sparse_y", 256, 0.659, CH, env={"SPFFT_TPU_SPARSE_Y": "1"}
     )
     measure_local("c2c_256_s15_no_rotation", 256, 0.659, CH, no_rotation=True)
+    # NOTE on arm names vs bench_results/round3_onchip.json (2026-07-31): that
+    # batch ran BEFORE the pair-copy default flipped, so its "baseline" row is
+    # pair-copy ON (8.44 ms) and its "no_pair_copy" row (6.88 ms) is what
+    # "baseline" now measures. Current arms keep one variable per arm against
+    # the current defaults.
     measure_local(
-        "c2c_256_s15_no_pair_copy", 256, 0.659, CH,
-        env={"SPFFT_TPU_PAIR_COPY": "0"},
+        "c2c_256_s15_pair_copy", 256, 0.659, CH,
+        env={"SPFFT_TPU_PAIR_COPY": "1"},
     )
 
     # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)

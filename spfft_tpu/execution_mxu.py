@@ -191,8 +191,8 @@ class MxuLocalExecution(ExecutionBase):
         p = self.params
         R, Z = self._table_rows, p.dim_z
         if self._decompress_plan is not None:
-            # one gather per pipe moves both parts (half the descriptors);
-            # SPFFT_TPU_PAIR_COPY=0 inside apply_pair restores two applies
+            # two independent applies by default; SPFFT_TPU_PAIR_COPY=1 inside
+            # apply_pair stacks them into one gather per pipe (measured slower)
             pre, pim = self._decompress_plan.apply_pair(values_re, values_im)
             return (
                 pre.reshape(-1)[: R * Z].reshape(R, Z),

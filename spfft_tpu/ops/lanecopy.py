@@ -247,10 +247,14 @@ class CopyPlan:
 
         The parts ride as a stacked (2, src_rows, LANE) source, so every row
         gather, lane-shift slice, mask and scatter-add is issued once for
-        both — the hot path for the engines' (re, im) pairs, halving the
-        copy's descriptor count vs two :meth:`apply` calls. Semantics are
-        exactly two independent applies, and ``SPFFT_TPU_PAIR_COPY=0`` (read
-        at trace time) literally runs those instead — the A/B escape hatch.
+        both, halving the copy's descriptor count vs two :meth:`apply` calls.
+        Measured SLOWER on chip despite that (8.44 vs 6.88 ms/pair at the
+        256^3/15% headline, bench_results/round3_onchip.json): the leading
+        batch dim pushes XLA:TPU off its fast whole-row-gather lowering —
+        the same failure mode as the earlier vmap-batched probe
+        (docs/ROADMAP.md item 1a). Hence OFF by default; semantics are
+        exactly two independent applies either way, and
+        ``SPFFT_TPU_PAIR_COPY=1`` (read at trace time) opts back in for A/B.
         Returns the pair of (num_dst/LANE, LANE) outputs.
         """
         if not pair_copy_enabled():
@@ -261,11 +265,13 @@ class CopyPlan:
 
 
 def pair_copy_enabled() -> bool:
-    """Engines use :meth:`CopyPlan.apply_pair` unless ``SPFFT_TPU_PAIR_COPY=0``
-    (the A/B escape hatch; semantics are identical either way)."""
+    """Whether :meth:`CopyPlan.apply_pair` stacks the parts into one gather
+    per pipe. Default OFF — measured ~23% slower end-to-end on chip (see
+    :meth:`CopyPlan.apply_pair`); ``SPFFT_TPU_PAIR_COPY=1`` opts in for A/B.
+    Semantics are identical either way."""
     import os
 
-    return os.environ.get("SPFFT_TPU_PAIR_COPY", "1") != "0"
+    return os.environ.get("SPFFT_TPU_PAIR_COPY", "0") == "1"
 
 
 def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values: int, max_runs: int = 64):

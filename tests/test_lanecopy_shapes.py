@@ -6,6 +6,8 @@ two distinct shifts); lanecopy.apply now materializes the pieces behind an
 optimization_barrier before the concat. These tests pin the shape classes —
 they pass on CPU either way, and exercise the fixed path directly on TPU.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -26,12 +28,24 @@ def _check(src_of_dst, num_src, seed=0):
         0.0,
     )
     np.testing.assert_array_equal(got, want)
-    # apply_pair must be exactly two independent applies — every pipe shape
-    # class checked here also pins the batched path (one gather, both parts)
+    # apply_pair must be exactly two independent applies in BOTH settings of
+    # SPFFT_TPU_PAIR_COPY — every pipe shape class checked here also pins the
+    # stacked (2, rows, LANE) path, which is off by default but must not rot
+    # (it shares _apply_stacked with apply, including the sub-tile concat
+    # miscompile workaround).
     flat_b = rng.standard_normal(num_src).astype(np.float32)
-    pa, pb = plan.apply_pair(jnp.asarray(flat), jnp.asarray(flat_b))
-    np.testing.assert_array_equal(np.asarray(pa), np.asarray(plan.apply(jnp.asarray(flat))))
-    np.testing.assert_array_equal(np.asarray(pb), np.asarray(plan.apply(jnp.asarray(flat_b))))
+    for pair_env in ("0", "1"):
+        os.environ["SPFFT_TPU_PAIR_COPY"] = pair_env
+        try:
+            pa, pb = plan.apply_pair(jnp.asarray(flat), jnp.asarray(flat_b))
+        finally:
+            os.environ.pop("SPFFT_TPU_PAIR_COPY", None)
+        np.testing.assert_array_equal(
+            np.asarray(pa), np.asarray(plan.apply(jnp.asarray(flat)))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pb), np.asarray(plan.apply(jnp.asarray(flat_b)))
+        )
     return plan
 
 
