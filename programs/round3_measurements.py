@@ -23,7 +23,14 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-OUT = Path(__file__).resolve().parent.parent / "bench_results" / "round3_onchip.json"
+# NEVER bench_results/round3_onchip.json — that file is the archived
+# 2026-07-31 capture cited by BASELINE.md/ROADMAP.md; re-runs (including
+# --quick smoke runs off-chip) must not clobber it.
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round3_onchip_rerun.json"
+)
 
 
 def flops_pair(dim):

@@ -34,12 +34,16 @@ def _check(src_of_dst, num_src, seed=0):
     # (it shares _apply_stacked with apply, including the sub-tile concat
     # miscompile workaround).
     flat_b = rng.standard_normal(num_src).astype(np.float32)
+    saved = os.environ.get("SPFFT_TPU_PAIR_COPY")
     for pair_env in ("0", "1"):
         os.environ["SPFFT_TPU_PAIR_COPY"] = pair_env
         try:
             pa, pb = plan.apply_pair(jnp.asarray(flat), jnp.asarray(flat_b))
         finally:
-            os.environ.pop("SPFFT_TPU_PAIR_COPY", None)
+            if saved is None:
+                os.environ.pop("SPFFT_TPU_PAIR_COPY", None)
+            else:
+                os.environ["SPFFT_TPU_PAIR_COPY"] = saved
         np.testing.assert_array_equal(
             np.asarray(pa), np.asarray(plan.apply(jnp.asarray(flat)))
         )

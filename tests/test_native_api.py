@@ -17,12 +17,13 @@ REPO = Path(__file__).resolve().parent.parent
 NATIVE = REPO / "native"
 BUILD = NATIVE / "build"
 
-
-@pytest.mark.skipif(
+needs_toolchain = pytest.mark.skipif(
     shutil.which("cmake") is None or shutil.which("g++") is None,
     reason="native toolchain unavailable",
 )
-def test_native_c_api_roundtrip():
+
+
+def _build_native():
     generator = ["-G", "Ninja"] if shutil.which("ninja") else []
     if not (BUILD / "CMakeCache.txt").exists():
         subprocess.run(
@@ -31,15 +32,22 @@ def test_native_c_api_roundtrip():
             check=True,
             capture_output=True,
         )
-    subprocess.run(
-        ["cmake", "--build", str(BUILD)], check=True, capture_output=True
-    )
+    subprocess.run(["cmake", "--build", str(BUILD)], check=True, capture_output=True)
 
+
+def _native_env():
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     # The embedded interpreter must not inherit the virtual-mesh test config.
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@needs_toolchain
+def test_native_c_api_roundtrip():
+    _build_native()
+    env = _native_env()
     result = subprocess.run(
         [str(BUILD / "run_native_tests")],
         env=env,
@@ -60,3 +68,43 @@ def test_native_c_api_roundtrip():
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "ALL NATIVE C++ TESTS PASSED" in result.stdout
+
+
+@needs_toolchain
+def test_native_benchmark_cli():
+    """The native benchmark (native/programs/benchmark.c — the rebuild of the
+    reference's tests/programs/benchmark.cpp) runs the local, multi-transform
+    and distributed paths through the C ABI and emits the JSON report."""
+    import json
+
+    _build_native()
+    env = _native_env()
+    exe = str(BUILD / "spfft_tpu_benchmark")
+
+    out = BUILD / "bench_smoke.json"
+    result = subprocess.run(
+        [exe, "-d", "16", "16", "16", "-r", "2", "-s", "0.5", "-t", "r2c",
+         "-m", "2", "-o", str(out)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    report = json.loads(out.read_text())
+    assert report["parameters"]["num_transforms"] == 2
+    assert report["results"]["ms_per_pair"] > 0
+
+    result = subprocess.run(
+        [exe, "-d", "16", "16", "16", "-r", "2", "--shards", "2", "-e", "unbuffered"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "wire_bytes=" in result.stdout
+    report = json.loads(result.stdout[result.stdout.index("{"):])
+    assert report["parameters"]["exchange"] == "unbuffered"
+
+    # bad usage fails fast with a usage message, not a crash
+    for bad in (["-d", "16", "16"],
+                ["-d", "16", "16", "16", "-r", "2", "-t", "R2C"],
+                ["-d", "16", "16", "16", "-r", "2", "-e", "bufferred"]):
+        result = subprocess.run([exe] + bad, env=env,
+                                capture_output=True, text=True, timeout=60)
+        assert result.returncode == 2, bad
