@@ -148,7 +148,7 @@ class MxuLocalExecution(ExecutionBase):
         )
         if rot is not None:
             delta, self._vi = rot
-            self._phase = lanecopy.alignment_phase_tables(delta, Z, rt)
+            self._phase = lanecopy.alignment_phase_rep(delta, Z, rt)
         else:
             self._vi = value_indices
             self._phase = None
@@ -255,9 +255,8 @@ class MxuLocalExecution(ExecutionBase):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
             if self._phase is not None:
                 # undo the alignment rotations (fused multiply)
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim, jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1]), -1
-                )
+                cos_t, sin_t = lanecopy.phase_rep_tables(self._phase, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
         if self._sparse_y:
             # per-slot y contraction straight off the stick table: no expand,
             # y-DFT rows gathered per slot into the matrix constants
@@ -338,9 +337,8 @@ class MxuLocalExecution(ExecutionBase):
         with jax.named_scope("z transform"):
             if self._phase is not None:
                 # enter the rotated layout on the space side (fused multiply)
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim, jnp.asarray(self._phase[0]), jnp.asarray(self._phase[1]), +1
-                )
+                cos_t, sin_t = lanecopy.phase_rep_tables(self._phase, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
             )

@@ -16,8 +16,9 @@ tricks this module exploits:
 * **scale folding**: the forward 1/(NxNyNz) scaling rides the matrix constants
   (reference applies it in the compress loop, src/compression/compression_host.hpp:63).
 
-Complex data is carried as (re, im) pairs of real arrays; each complex DFT is 4 real
-matmuls (R2C/C2R: 2). Matmul precision is a plan-level knob (``resolve_precision``):
+Complex data is carried as (re, im) pairs of real arrays; each complex DFT contraction
+runs as 3 real matmuls by default (Gauss's trick, see :func:`complex_matmul`; R2C/C2R: 2).
+Matmul precision is a plan-level knob (``resolve_precision``):
 ``"highest"`` (default, 6-pass bf16 ~1e-7 relative — the 1e-6 parity bar) or
 ``"high"`` (3-pass bf16, ~1e-5, measured 1.6x faster at N=512 — the accuracy/speed
 dial analogous to the reference's *_FLOAT exchange variants, reference:

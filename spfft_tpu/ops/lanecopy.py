@@ -33,6 +33,7 @@ the plain scatter path).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -269,8 +270,6 @@ def pair_copy_enabled() -> bool:
     per pipe. Default OFF — measured ~23% slower end-to-end on chip (see
     :meth:`CopyPlan.apply_pair`); ``SPFFT_TPU_PAIR_COPY=1`` opts in for A/B.
     Semantics are identical either way."""
-    import os
-
     return os.environ.get("SPFFT_TPU_PAIR_COPY", "0") == "1"
 
 
@@ -345,6 +344,47 @@ def alignment_phase_tables(deltas, dim_z: int, real_dtype):
     deltas = np.asarray(deltas)
     theta = 2.0 * np.pi * deltas[..., None] * np.arange(int(dim_z)) / int(dim_z)
     return np.cos(theta).astype(real_dtype), np.sin(theta).astype(real_dtype)
+
+
+PHASE_TABLE_LIMIT_MB_ENV = "SPFFT_TPU_PHASE_TABLE_MB"
+
+
+def alignment_phase_rep(deltas, dim_z: int, real_dtype):
+    """Size-aware phase representation for a plan's rotation vector.
+
+    Below the budget (``SPFFT_TPU_PHASE_TABLE_MB``, default 64): ``("table",
+    cos, sin)`` with host-precomputed f64-accurate numpy tables — the fast
+    path, embedded once per program. Above it: ``("delta", deltas_i32,
+    dim_z)`` and the tables are generated in-trace at apply time — a (S, Z)
+    cos/sin table pair at 512^3 C2C is 366 MB of embedded HLO constants,
+    which overflowed the tunnel's compile transport (HTTP 413) and costs a
+    full HBM read per apply; the in-trace form embeds only the (S,) rotation
+    vector. :func:`phase_rep_tables` consumes either form.
+    """
+    deltas = np.asarray(deltas)
+    bytes_ = 2 * deltas.size * int(dim_z) * np.dtype(real_dtype).itemsize
+    limit = int(os.environ.get(PHASE_TABLE_LIMIT_MB_ENV, "64")) * (1 << 20)
+    # the in-trace form's exactness requires delta*k < 2^31 (int32 products)
+    if bytes_ <= limit or int(dim_z) * int(dim_z) >= 2**31:
+        return ("table", *alignment_phase_tables(deltas, dim_z, real_dtype))
+    return ("delta", deltas.astype(np.int32), int(dim_z))
+
+
+def phase_rep_tables(rep, real_dtype):
+    """Traced (cos, sin) tables from an :func:`alignment_phase_rep` value.
+
+    The in-trace form reduces ``delta * k`` mod Z in exact int32 arithmetic
+    BEFORE the float cast, so theta stays in [0, 2 pi) and f32 cos/sin keep
+    full precision (naive f32 ``cos(2 pi delta k / Z)`` at delta*k ~ 2.6e5
+    rad loses ~4 digits). Exactness bound: delta, k < Z and Z^2 < 2^31.
+    """
+    if rep[0] == "table":
+        return jnp.asarray(rep[1]), jnp.asarray(rep[2])
+    _, deltas, dim_z = rep
+    k = jnp.arange(dim_z, dtype=jnp.int32)
+    m = (jnp.asarray(deltas)[:, None] * k[None, :]) % dim_z
+    theta = (2.0 * np.pi / dim_z) * m.astype(real_dtype)
+    return jnp.cos(theta), jnp.sin(theta)
 
 
 def apply_alignment_phase(re, im, cos_t, sin_t, sign: int):

@@ -1,6 +1,7 @@
 """Pallas TPU kernels for the MXU DFT stages.
 
-The MXU engine's complex DFT stage is 4 real matmuls (ops/fft.complex_matmul);
+The MXU engine's complex DFT stage is 3-4 real matmuls (ops/fft.complex_matmul;
+Gauss's 3-multiplication form is the default since round 3);
 XLA compiles them as separate fusions, so the (re, im) operand pair is read from
 HBM twice and intermediate products round-trip once more. This module fuses the
 whole complex contraction into ONE Pallas kernel: each (re, im) input tile is

@@ -5,7 +5,8 @@ Same geometry, exchanges and boundary contract as
 with the compute stages engineered like the 1-D MXU engines for TPU hardware:
 
 * every DFT stage is a batched matmul (ops/fft.py) on (re, im) real pairs —
-  4 real matmuls per complex stage, 2 for the R2C/C2R x-stage,
+  3 real matmuls per complex stage (Gauss form, ops/fft.complex_matmul),
+  2 for the R2C/C2R x-stage,
 * the x-stage folds the pencil slot layout INTO the DFT matrix: the
   ``(group, slot) -> x`` map (with sentinel padding slots as zero rows) rides
   ``ops/fft.x_stage_matrices``, so the post-exchange-B column scatter and the

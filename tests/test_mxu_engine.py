@@ -296,3 +296,43 @@ def test_sparse_y_stage_opt_in(monkeypatch):
     t2 = Transform(ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
                    indices=dense_trip, engine="mxu")
     assert not t2._exec._sparse_y
+
+
+def test_phase_rep_in_trace_matches_table(monkeypatch):
+    """Forcing the compact ("delta") phase representation must reproduce the
+    table path exactly: the in-trace cos/sin generation reduces delta*k mod Z
+    in int32 before the float cast, so both forms agree to f32 rounding. The
+    compact form is what keeps 512^3-class plans compilable (the (S, Z)
+    tables are hundreds of MB of HLO constants otherwise — BASELINE.md)."""
+    from spfft_tpu import ProcessingUnit, Transform
+    from spfft_tpu.ops import lanecopy
+
+    rng = np.random.default_rng(5)
+    dx, dy, dz = 5, 6, 128
+    trips = []
+    for x in range(dx):
+        for y in range(dy):
+            if rng.random() < 0.3:
+                continue
+            h = int(rng.integers(3, dz // 2))
+            trips.extend((x, y, z) for z in range(dz - h, dz))  # wrapped runs
+            trips.extend((x, y, z) for z in range(h))
+    trip = np.asarray(trips)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+    t_table = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                        indices=trip, engine="mxu")
+    assert t_table._exec._phase is not None and t_table._exec._phase[0] == "table"
+
+    monkeypatch.setenv(lanecopy.PHASE_TABLE_LIMIT_MB_ENV, "0")
+    t_delta = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                        indices=trip, engine="mxu")
+    assert t_delta._exec._phase is not None and t_delta._exec._phase[0] == "delta"
+
+    out_t = t_table.backward(values)
+    out_d = t_delta.backward(values)
+    np.testing.assert_allclose(out_d, out_t, rtol=1e-5, atol=1e-5)
+    back_t = t_table.forward(scaling=ScalingType.FULL)
+    back_d = t_delta.forward(scaling=ScalingType.FULL)
+    np.testing.assert_allclose(back_d, back_t, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(back_d, values, rtol=1e-4, atol=1e-4)
