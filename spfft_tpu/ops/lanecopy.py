@@ -387,6 +387,18 @@ def phase_rep_tables(rep, real_dtype):
     return jnp.cos(theta), jnp.sin(theta)
 
 
+def phase_rep_tables_at(rep, idx, real_dtype):
+    """Per-shard (cos, sin) from a rep whose leading axis is the shard: the
+    table form indexes the stacked tables at (traced) ``idx``; the compact
+    form slices the (P, S) rotation matrix and generates that shard's tables
+    in-trace. Used by SPMD engines that close over the full rep and resolve
+    their shard inside the traced program (the pencil engines)."""
+    if rep[0] == "table":
+        return jnp.asarray(rep[1])[idx], jnp.asarray(rep[2])[idx]
+    _, deltas, dim_z = rep
+    return phase_rep_tables(("delta", jnp.asarray(deltas)[idx], dim_z), real_dtype)
+
+
 def apply_alignment_phase(re, im, cos_t, sin_t, sign: int):
     """Fused multiply of the (re, im) pair by ``e^{sign * i theta}``.
 

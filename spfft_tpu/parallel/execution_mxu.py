@@ -80,9 +80,13 @@ class MxuValuePlans:
         rotations (ops/lanecopy.plan_alignment_rotations — same optimization as
         the local MXU engine, measured 1.19x end-to-end at the 256^3 headline):
         the branch plans are built on the rotated value->slot map, and the
-        per-shard phase tables that undo the rotation on the space side of the
-        z matmuls land in ``self._align_phase`` ((P, S, Z) cos/sin numpy pair,
-        or None when no shard rotates) for the engine to stage sharded.
+        per-shard phase that undoes the rotation on the space side of the
+        z matmuls lands in ``self._align_rep`` (a size-aware
+        ``lanecopy.alignment_phase_rep`` value, or None when no shard
+        rotates); each engine decides how to materialize it — the 1-D engine
+        stages table-form reps as sharded runtime operands, the pencil
+        engines embed them, and the compact ("delta") form generates each
+        shard's tables in-trace everywhere.
         """
         p = self.params
         S, Z = self._S, p.dim_z
@@ -112,10 +116,15 @@ class MxuValuePlans:
                 self._compress_branches.append(self._make_compress(vi, n))
             branch_of_shard[r] = unique_plans[key]
         self._branch_of_shard = branch_of_shard
-        if deltas.any():
-            self._align_phase = lanecopy.alignment_phase_tables(deltas, Z, rt)
-        else:
-            self._align_phase = None
+        # Size-aware phase representation (lanecopy.alignment_phase_rep):
+        # ("table", cos, sin) below the budget — the 1-D engine stages those
+        # tables as sharded runtime operands, the pencil engines embed them —
+        # or ("delta", (P, S) i32, Z) above it, where each shard's tables are
+        # generated in-trace (the stacked tables at 512^3-class plans are
+        # hundreds of MB). None when no shard rotates.
+        self._align_rep = (
+            lanecopy.alignment_phase_rep(deltas, Z, rt) if deltas.any() else None
+        )
 
     def _make_decompress(self, vi: np.ndarray, n: int):
         """Branch: (V_max,) pair -> (S, Z) pair sticks for one shard."""
@@ -320,12 +329,15 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         self.value_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
         self.space_sharding = NamedSharding(mesh, P(FFT_AXIS, None, None, None))
         # per-shard alignment-rotation phase tables (see _build_value_branches),
-        # sharded so each device holds only its own (S, Z) slab
-        if self._align_phase is not None:
+        # sharded so each device holds only its own (S, Z) slab; the compact
+        # ("delta") rep needs no operands — tables generate in-trace
+        if self._align_rep is not None and self._align_rep[0] == "table":
             phase_sharding = NamedSharding(mesh, P(FFT_AXIS, None, None))
             self._align_phase = tuple(
-                jax.device_put(t, phase_sharding) for t in self._align_phase
+                jax.device_put(t, phase_sharding) for t in self._align_rep[1:]
             )
+        else:
+            self._align_phase = None
         specs_v = P(FFT_AXIS, None)
         specs_s = P(FFT_AXIS, None, None, None)
         sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
@@ -396,6 +408,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 sre, sim = lanecopy.apply_alignment_phase(
                     sre, sim, phase_re[0], phase_im[0], -1
                 )
+            elif self._align_rep is not None and self._align_rep[0] == "delta":
+                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
         if self._ragged is not None:
             # exact-counts exchange straight into the compact planes
@@ -506,6 +521,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 sre, sim = lanecopy.apply_alignment_phase(
                     sre, sim, phase_re[0], phase_im[0], +1
                 )
+            elif self._align_rep is not None and self._align_rep[0] == "delta":
+                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
             )

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import fft as offt
-from ..ops import symmetry
+from ..ops import lanecopy, symmetry
 from ..types import ExchangeType, ScalingType
 from .execution_mxu import MxuValuePlans
 from .pencil2 import AX1, AX2, Pencil2Execution
@@ -52,6 +52,9 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             p.dim_x, slot_to_x, slot_to_x.size, self.is_r2c, rt
         )
         self._build_value_branches()
+        # pencil programs consume the base's size-aware rep directly
+        # (lanecopy.phase_rep_tables_at): tables below the budget are embedded
+        # as constants, bigger plans generate in-trace
 
     def _exchange_pair(self, bre, bim, axes, reverse=False):
         """(re, im) blocks through the configured discipline: the padded
@@ -95,17 +98,11 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-            if self._align_phase is not None:
-                # undo the alignment rotations; the (P, S, Z) tables ride as
-                # program constants indexed by shard — fine at pencil shard
-                # counts (the 1-D engine shards them instead)
-                from ..ops import lanecopy
-
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim,
-                    jnp.asarray(self._align_phase[0])[s_me],
-                    jnp.asarray(self._align_phase[1])[s_me], -1,
-                )
+            if self._align_rep is not None:
+                # undo the alignment rotations; phase rides as embedded tables
+                # below the size budget, or is generated in-trace above it
+                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
         # pack A: my sticks split by destination (x-group, z-slab)
         with jax.named_scope("pack"):
@@ -217,15 +214,10 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             sim = sim[: S * Z].reshape(S, Z)
 
         with jax.named_scope("z transform"):
-            if self._align_phase is not None:
+            if self._align_rep is not None:
                 # enter the rotated layout on the space side
-                from ..ops import lanecopy
-
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim,
-                    jnp.asarray(self._align_phase[0])[s_me],
-                    jnp.asarray(self._align_phase[1])[s_me], +1,
-                )
+                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
+                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
             )

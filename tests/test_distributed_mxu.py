@@ -391,3 +391,43 @@ def test_p1_distributed_emits_no_collective():
     expected = oracle_backward_c2c(triplets, values, *dims)
     out = t.backward(vps)
     assert_close(out, expected)
+
+
+def test_mxu_distributed_compact_phase_rep(monkeypatch):
+    """Forcing the compact phase representation in the 1-D mesh engine must
+    reproduce the runtime-operand table path exactly: above the size budget
+    the engine embeds only the (P, S) rotation matrix and generates each
+    shard's tables in-trace (no phase operands thread the shard_map at all)."""
+    from utils import contiguous_stick_triplets
+
+    from spfft_tpu.ops import lanecopy
+
+    rng = np.random.default_rng(81)
+    dx, dy, dz = 6, 7, 128
+    trip = contiguous_stick_triplets(rng, dx, dy, dz, r2c=False)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+
+    t_table = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz, per_shard,
+        mesh=sp.make_fft_mesh(4), engine="mxu",
+    )
+    assert t_table._exec._align_rep is not None
+    assert t_table._exec._align_rep[0] == "table"
+    assert t_table._exec._align_phase is not None  # staged runtime operands
+    out_table = t_table.backward(vps)
+
+    monkeypatch.setenv(lanecopy.PHASE_TABLE_LIMIT_MB_ENV, "0")
+    t_delta = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz,
+        [p.copy() for p in per_shard], mesh=sp.make_fft_mesh(4), engine="mxu",
+    )
+    assert t_delta._exec._align_rep is not None
+    assert t_delta._exec._align_rep[0] == "delta"
+    assert t_delta._exec._align_phase is None  # no phase operands threaded
+    out_delta = t_delta.backward([v.copy() for v in vps])
+    assert_close(out_delta, out_table)
+    back = t_delta.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)

@@ -368,7 +368,7 @@ def test_pencil2_mxu_lane_alignment_rotation_path(ttype):
         ProcessingUnit.HOST, ttype, dx, dy, dz, per_shard,
         mesh=sp.make_fft_mesh2(2, 2), engine="mxu",
     )
-    assert t._exec._align_phase is not None, "rotations must engage at dz=128"
+    assert t._exec._align_rep is not None, "rotations must engage at dz=128"
     out = t.backward(vps)
     if r2c:
         ref = DistributedTransform(
@@ -379,5 +379,43 @@ def test_pencil2_mxu_lane_alignment_rotation_path(ttype):
     else:
         assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
     back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
+
+
+def test_pencil2_mxu_compact_phase_rep(monkeypatch):
+    """Forcing the compact ("delta") phase representation in the pencil MXU
+    engine must reproduce the table form exactly — big plans embed only the
+    (P, S) rotation matrix and generate each shard's tables in-trace
+    (lanecopy.phase_rep_tables_at; the stacked tables overflowed the compile
+    transport at 512^3-class plans)."""
+    from utils import contiguous_stick_triplets
+
+    from spfft_tpu.ops import lanecopy
+
+    rng = np.random.default_rng(80)
+    dx, dy, dz = 6, 8, 128
+    trip = contiguous_stick_triplets(rng, dx, dy, dz, r2c=False)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+
+    t_table = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, per_shard,
+        mesh=sp.make_fft_mesh2(2, 2), engine="mxu",
+    )
+    assert t_table._exec._align_rep is not None
+    assert t_table._exec._align_rep[0] == "table"
+    out_table = t_table.backward(vps)
+
+    monkeypatch.setenv(lanecopy.PHASE_TABLE_LIMIT_MB_ENV, "0")
+    t_delta = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+        [p.copy() for p in per_shard], mesh=sp.make_fft_mesh2(2, 2), engine="mxu",
+    )
+    assert t_delta._exec._align_rep[0] == "delta"
+    out_delta = t_delta.backward([v.copy() for v in vps])
+    assert_close(out_delta, out_table)
+    back = t_delta.forward(scaling=ScalingType.FULL)
     for r, vals in enumerate(vps):
         assert_close(back[r], vals)
