@@ -179,8 +179,12 @@ def main():
     except Exception as e:
         record({"name": "ragged_all_to_all_supported", "error": str(e)})
 
-    # headline arms
-    measure_local("c2c_256_s15_baseline", 256, 0.659, CH)
+    # headline arms. NOTE: sparse-y is AUTO since the crossover landed, so
+    # every arm not probing it pins SPFFT_TPU_SPARSE_Y explicitly to keep
+    # one variable per arm.
+    measure_local(
+        "c2c_256_s15_baseline", 256, 0.659, CH, env={"SPFFT_TPU_SPARSE_Y": "0"}
+    )
     measure_local(
         "c2c_256_s15_sparse_y", 256, 0.659, CH, env={"SPFFT_TPU_SPARSE_Y": "1"}
     )
@@ -193,6 +197,35 @@ def main():
     measure_local(
         "c2c_256_s15_pair_copy", 256, 0.659, CH,
         env={"SPFFT_TPU_PAIR_COPY": "1"},
+    )
+
+    # sparse-y crossover arms (the AUTO threshold's evidence, BASELINE.md
+    # `sparse_y_crossover_256`): Sy/Y = 0.469 at 5% (wins), 0.562 at 9%
+    # (wins), 0.688 at 15% (loses -> threshold 0.6)
+    for pct, radius in (("5pct", 0.457), ("9pct", 0.55), ("15pct", 0.659)):
+        for arm, sy in (("off", "0"), ("on", "1")):
+            measure_local(
+                f"sparse_y_{pct}_{arm}", 256, radius, CH,
+                env={"SPFFT_TPU_SPARSE_Y": sy},
+            )
+
+    # copy-plan LANE width sweep (ROADMAP P2 settlement): 256 is noise-level,
+    # 512 breaks the Z % LANE alignment precondition
+    for lane in (256, 512):
+        orig_lane = lanecopy.LANE
+        lanecopy.LANE = lane
+        try:
+            measure_local(
+                f"lane{lane}_c2c_256_s15", 256, 0.659, CH,
+                env={"SPFFT_TPU_SPARSE_Y": "0"},
+            )
+        finally:
+            lanecopy.LANE = orig_lane
+
+    # Gauss 3-multiplication matmul A/B + f64 accuracy guard
+    measure_local(
+        "c2c_256_s15_classic_4mm", 256, 0.659, CH,
+        env={"SPFFT_TPU_SPARSE_Y": "0", "SPFFT_TPU_GAUSS_MM": "0"},
     )
 
     # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)

@@ -90,27 +90,27 @@ class MxuLocalExecution(ExecutionBase):
         # ---- sparse copy plans + expansion map ----
         S, Z = p.num_sticks, p.dim_z
 
-        # Sparse-y stage (opt-in, SPFFT_TPU_SPARSE_Y=1; C2C only): group the
-        # sticks by active-x slot into an (A, Sy_max, Z) table and contract the
-        # y-DFT only over each slot's sticks via per-slot gathered DFT rows —
-        # the y-occupancy analogue of the uniqueXIndices compaction (stick
-        # table rows relabel s -> a*Sy + j; the expand gather and the forward
-        # pack disappear). Cuts y-stage flops by ~Sy_max/dim_y at spherical
-        # cutoffs, at the price of A*Sy - S extra padded z-matmul rows.
-        # Default OFF until measured on hardware (docs/ROADMAP.md P1).
+        # Sparse-y stage (C2C only): group the sticks by active-x slot into an
+        # (A, Sy_max, Z) table and contract the y-DFT only over each slot's
+        # sticks via per-slot gathered DFT rows — the y-occupancy analogue of
+        # the uniqueXIndices compaction (stick table rows relabel
+        # s -> a*Sy + j; the expand gather and the forward pack disappear).
+        # Cuts y-stage flops by ~Sy_max/dim_y at spherical cutoffs, at the
+        # price of A*Sy - S extra padded z-matmul rows. AUTO default from the
+        # on-chip crossover sweep (v5e, 256^3 spherical, CHAIN=384): engages
+        # when Sy_max/dim_y < 0.6 — measured 1.15x at Sy/Y=0.47 (5% cutoff),
+        # 1.06x at 0.56 (9%), 1.28x SLOWER at 0.69 (15%); see BASELINE.md.
+        # SPFFT_TPU_SPARSE_Y=1 forces it on, =0 forces it off.
         import os as _os
 
         self._sparse_y = False
         value_indices = np.asarray(p.value_indices, dtype=np.int64)
-        if (
-            _os.environ.get("SPFFT_TPU_SPARSE_Y", "0") == "1"
-            and not r2c
-            and p.num_sticks
-        ):
+        _sy_mode = _os.environ.get("SPFFT_TPU_SPARSE_Y", "auto")
+        if _sy_mode != "0" and not r2c and p.num_sticks:
             cnt = np.bincount(xslot, minlength=A)
             # same sublane-padding policy as the x compaction (shared quantum)
             Sy = offt.compact_x_extent(int(cnt.max()), p.dim_y)
-            if Sy < p.dim_y:
+            if Sy < p.dim_y and (_sy_mode == "1" or 5 * Sy < 3 * p.dim_y):
                 self._sparse_y = True
                 self._sy = Sy
                 # j = running index of each stick within its slot, in stick-id

@@ -265,9 +265,9 @@ def test_map_chunked_pads_non_divisible_batch():
 
 
 def test_sparse_y_stage_opt_in(monkeypatch):
-    """SPFFT_TPU_SPARSE_Y=1 contracts the y-DFT only over each x-slot's sticks
-    (per-slot gathered DFT rows; no expand/pack stages). Opt-in until measured
-    on hardware (docs/ROADMAP.md P1); must agree with the dense path and
+    """SPFFT_TPU_SPARSE_Y=1 forces the per-slot y-DFT contraction (no
+    expand/pack stages; auto mode gates on the measured Sy/Y crossover —
+    see test_sparse_y_auto_threshold). Must agree with the dense path and
     compose with the alignment rotations."""
     monkeypatch.setenv("SPFFT_TPU_SPARSE_Y", "1")
     from spfft_tpu import ProcessingUnit, Transform
@@ -336,3 +336,40 @@ def test_phase_rep_in_trace_matches_table(monkeypatch):
     back_d = t_delta.forward(scaling=ScalingType.FULL)
     np.testing.assert_allclose(back_d, back_t, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(back_d, values, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_y_auto_threshold(monkeypatch):
+    """Unset (auto) sparse-y engages only below the measured Sy/Y < 0.6
+    crossover; =0 forces it off even there; =1 forces it on above it."""
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, Transform
+
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y", raising=False)
+    dx, dy, dz = 16, 32, 128
+    # sharp cutoff: widest y-chord well under 0.6 * dy -> auto engages
+    # (radius 0.4 -> Sy = 16 = 0.5 * dy after 8-padding at these dims)
+    sharp = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.4)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                  indices=sharp, engine="mxu")
+    assert t._exec._sparse_y, "auto mode must engage at a sharp cutoff"
+
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y", "0")
+    t0 = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                   indices=sharp, engine="mxu")
+    assert not t0._exec._sparse_y
+
+    # above-threshold cutoff (radius 0.5 -> Sy = 24 = 0.75 * dy at these
+    # dims): auto stays off, =1 forces the stage on — both paths must agree
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y", raising=False)
+    wide = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.5)
+    tw = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                   indices=wide, engine="mxu")
+    assert not tw._exec._sparse_y, "auto mode must stay off above the crossover"
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y", "1")
+    tf = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                   indices=wide, engine="mxu")
+    assert tf._exec._sparse_y, "=1 must force the stage on above the crossover"
+    v = np.random.default_rng(7).standard_normal(len(wide))
+    out = tf.backward(v + 1j * v)
+    outw = tw.backward(v + 1j * v)
+    np.testing.assert_allclose(out, outw, rtol=1e-4, atol=1e-4)
