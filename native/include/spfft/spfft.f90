@@ -490,6 +490,21 @@ module spfft
       integer(c_int), value :: doublePrecision
     end function
 
+    integer(c_int) function spfft_dist_transform_create_independent(transform, &
+        maxNumThreads, numShards, exchangeType, processingUnit, transformType, &
+        dimX, dimY, dimZ, shardNumElements, indexFormat, indices, &
+        doublePrecision) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      integer(c_int), value :: maxNumThreads, numShards, exchangeType
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ
+      integer(c_int), dimension(*), intent(in) :: shardNumElements
+      integer(c_int), value :: indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+      integer(c_int), value :: doublePrecision
+    end function
+
     integer(c_int) function spfft_dist_transform_destroy(transform) bind(C)
       use iso_c_binding
       type(c_ptr), value :: transform

@@ -49,6 +49,10 @@ SpfftError spfft_transform_forward(SpfftTransform transform,
                                    SpfftScalingType scaling);
 SpfftError spfft_transform_forward_ptr(SpfftTransform transform, const double* input,
                                        double* output, SpfftScalingType scaling);
+/* Pointer-output backward: the space-domain slab is also written to
+ * ``output`` (reference: transform.h spfft_transform_backward_ptr). */
+SpfftError spfft_transform_backward_ptr(SpfftTransform transform, const double* input,
+                                        double* output);
 SpfftError spfft_transform_get_space_domain(SpfftTransform transform,
                                             SpfftProcessingUnitType dataLocation,
                                             double** data);
@@ -102,6 +106,8 @@ SpfftError spfft_float_transform_forward(SpfftFloatTransform transform,
 SpfftError spfft_float_transform_forward_ptr(SpfftFloatTransform transform,
                                              const float* input, float* output,
                                              SpfftScalingType scaling);
+SpfftError spfft_float_transform_backward_ptr(SpfftFloatTransform transform,
+                                              const float* input, float* output);
 SpfftError spfft_float_transform_get_space_domain(SpfftFloatTransform transform,
                                                   SpfftProcessingUnitType dataLocation,
                                                   float** data);
@@ -138,6 +144,16 @@ SpfftError spfft_dist_transform_create(SpfftDistTransform* transform, SpfftGrid 
                                        const int* shardNumElements,
                                        SpfftIndexFormatType indexFormat,
                                        const int* indices, int doublePrecision);
+/* Grid-less distributed ctor (reference: transform.h
+ * spfft_transform_create_independent_distributed, single-controller form:
+ * numShards + exchangeType replace the MPI communicator; the capacity
+ * envelope a Grid would carry is derived internally). */
+SpfftError spfft_dist_transform_create_independent(
+    SpfftDistTransform* transform, int maxNumThreads, int numShards,
+    SpfftExchangeType exchangeType, SpfftProcessingUnitType processingUnit,
+    SpfftTransformType transformType, int dimX, int dimY, int dimZ,
+    const int* shardNumElements, SpfftIndexFormatType indexFormat,
+    const int* indices, int doublePrecision);
 SpfftError spfft_dist_transform_destroy(SpfftDistTransform transform);
 
 /* values: 2 * num_global_elements reals, shard-major complex-interleaved;

@@ -52,6 +52,10 @@ public:
   /* Frequency -> space. Result lands in space_domain_data(). */
   void backward(const double* input, SpfftProcessingUnitType output_location);
 
+  /* Pointer-to-pointer overload: the space-domain result is also written to
+   * ``output`` (reference: transform.h spfft_transform_backward_ptr). */
+  void backward(const double* input, double* output);
+
   /* Space -> frequency, reading space_domain_data(). */
   void forward(SpfftProcessingUnitType input_location, double* output,
                SpfftScalingType scaling = SPFFT_NO_SCALING);
@@ -100,6 +104,7 @@ public:
   TransformFloat clone() const;
 
   void backward(const float* input, SpfftProcessingUnitType output_location);
+  void backward(const float* input, float* output);
   void forward(SpfftProcessingUnitType input_location, float* output,
                SpfftScalingType scaling = SPFFT_NO_SCALING);
   void forward(const float* input, float* output,

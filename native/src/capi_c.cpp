@@ -171,6 +171,12 @@ SpfftError spfft_transform_forward_ptr(SpfftTransform transform, const double* i
   return guarded([&] { as_transform(transform)->forward(input, output, scaling); });
 }
 
+SpfftError spfft_transform_backward_ptr(SpfftTransform transform, const double* input,
+                                        double* output) {
+  if (transform == nullptr || output == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_transform(transform)->backward(input, output); });
+}
+
 SpfftError spfft_transform_get_space_domain(SpfftTransform transform,
                                             SpfftProcessingUnitType dataLocation,
                                             double** data) {
@@ -276,6 +282,12 @@ SpfftError spfft_float_transform_forward_ptr(SpfftFloatTransform transform,
   if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
   return guarded(
       [&] { as_float_transform(transform)->forward(input, output, scaling); });
+}
+
+SpfftError spfft_float_transform_backward_ptr(SpfftFloatTransform transform,
+                                              const float* input, float* output) {
+  if (transform == nullptr || output == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] { as_float_transform(transform)->backward(input, output); });
 }
 
 SpfftError spfft_float_transform_get_space_domain(SpfftFloatTransform transform,
@@ -385,6 +397,29 @@ SpfftError spfft_dist_transform_create(SpfftDistTransform* transform, SpfftGrid 
             processingUnit, transformType, dimX, dimY, dimZ, numShards,
             shardNumElements, indexFormat, indices, doublePrecision != 0));
   });
+}
+
+SpfftError spfft_dist_transform_create_independent(
+    SpfftDistTransform* transform, int maxNumThreads, int numShards,
+    SpfftExchangeType exchangeType, SpfftProcessingUnitType processingUnit,
+    SpfftTransformType transformType, int dimX, int dimY, int dimZ,
+    const int* shardNumElements, SpfftIndexFormatType indexFormat,
+    const int* indices, int doublePrecision) {
+  if (transform == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  /* The internal grid is only a capacity envelope consumed at plan creation
+   * (the runtime keeps what it needs), so it is created wide and destroyed
+   * immediately after — the reference's grid-less ctor does the same
+   * internally (reference: src/spfft/transform.cpp grid-less path). */
+  SpfftGrid grid = nullptr;
+  SpfftError err = spfft_grid_create_distributed(
+      &grid, dimX, dimY, dimZ, dimX * dimY, dimZ, numShards, exchangeType,
+      processingUnit, maxNumThreads);
+  if (err != SPFFT_SUCCESS) return err;
+  err = spfft_dist_transform_create(transform, grid, processingUnit, transformType,
+                                    dimX, dimY, dimZ, numShards, shardNumElements,
+                                    indexFormat, indices, doublePrecision);
+  SpfftError destroy_err = spfft_grid_destroy(grid);
+  return err != SPFFT_SUCCESS ? err : destroy_err;
 }
 
 SpfftError spfft_dist_transform_destroy(SpfftDistTransform transform) {

@@ -465,6 +465,11 @@ void Transform::backward(const double* input, SpfftProcessingUnitType) {
   plan_->backward(input);
 }
 
+void Transform::backward(const double* input, double* output) {
+  plan_->backward(input);
+  std::memcpy(output, plan_->space.data(), plan_->space.size());
+}
+
 void Transform::forward(SpfftProcessingUnitType, double* output,
                         SpfftScalingType scaling) {
   plan_->forward(plan_->space.data(), output, static_cast<int>(scaling));
@@ -516,6 +521,11 @@ TransformFloat::TransformFloat(SpfftProcessingUnitType processing_unit,
 
 TransformFloat TransformFloat::clone() const {
   return TransformFloat(detail::clone_plan(plan_));
+}
+
+void TransformFloat::backward(const float* input, float* output) {
+  plan_->backward(input);
+  std::memcpy(output, plan_->space.data(), plan_->space.size());
 }
 
 void TransformFloat::backward(const float* input, SpfftProcessingUnitType) {

@@ -95,6 +95,21 @@ int main(void) {
     REQUIRE(max_err < 1e-6);
   }
 
+  /* backward_ptr writes the same slab to a caller buffer. */
+  {
+    double* slab = (double*)malloc((size_t)(2 * n) * sizeof(double));
+    CHECK(spfft_transform_backward_ptr(t, freq, slab));
+    {
+      double max_err = 0.0;
+      for (i = 0; i < 2 * n; ++i) {
+        double d = fabs(slab[i] - space[i]);
+        if (d > max_err) max_err = d;
+      }
+      REQUIRE(max_err == 0.0); /* identical bytes: same backward, same slab */
+    }
+    free(slab);
+  }
+
   /* Write-then-forward through the space-domain pointer: scale by 2. */
   for (i = 0; i < 2 * n; ++i) space[i] *= 2.0;
   CHECK(spfft_transform_forward(t, SPFFT_PU_HOST, back, SPFFT_FULL_SCALING));
@@ -149,7 +164,13 @@ int main(void) {
     CHECK(spfft_float_transform_create_independent(&ft, 1, SPFFT_PU_HOST,
                                                    SPFFT_TRANS_C2C, dim, dim, dim, n,
                                                    SPFFT_INDEX_TRIPLETS, indices));
+    float* fslab = (float*)malloc((size_t)(2 * n) * sizeof(float));
+    float* fspace = NULL;
     CHECK(spfft_float_transform_backward(ft, ffreq, SPFFT_PU_HOST));
+    CHECK(spfft_float_transform_backward_ptr(ft, ffreq, fslab));
+    CHECK(spfft_float_transform_get_space_domain(ft, SPFFT_PU_HOST, &fspace));
+    for (i = 0; i < 2 * n; ++i) REQUIRE(fslab[i] == fspace[i]);
+    free(fslab);
     CHECK(spfft_float_transform_forward(ft, SPFFT_PU_HOST, fback, SPFFT_FULL_SCALING));
     {
       double max_err = 0.0;
@@ -292,6 +313,26 @@ int main(void) {
       }
       CHECK(spfft_dist_transform_destroy(pt));
       CHECK(spfft_grid_destroy(pgrid));
+    }
+
+    /* grid-less distributed ctor (single-controller form of the reference's
+     * spfft_transform_create_independent_distributed) */
+    {
+      SpfftDistTransform it = NULL;
+      CHECK(spfft_dist_transform_create_independent(
+          &it, 1, shards, SPFFT_EXCH_DEFAULT, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim,
+          dim, dim, counts, SPFFT_INDEX_TRIPLETS, didx, 1));
+      CHECK(spfft_dist_transform_backward(it, dfreq, dspace));
+      CHECK(spfft_dist_transform_forward(it, dspace, dback, SPFFT_FULL_SCALING));
+      {
+        double max_err = 0.0;
+        for (i = 0; i < 2 * n; ++i) {
+          double d = fabs(dback[i] - dfreq[i]);
+          if (d > max_err) max_err = d;
+        }
+        REQUIRE(max_err < 1e-6);
+      }
+      CHECK(spfft_dist_transform_destroy(it));
     }
 
     CHECK(spfft_dist_transform_destroy(dt));

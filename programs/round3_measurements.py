@@ -227,6 +227,34 @@ def main():
         "c2c_256_s15_classic_4mm", 256, 0.659, CH,
         env={"SPFFT_TPU_SPARSE_Y": "0", "SPFFT_TPU_GAUSS_MM": "0"},
     )
+    try:
+        # f64 oracle accuracy under both matmul forms (32^3 C2C, CPU-exact
+        # complex128 oracle) — the Gauss default's accuracy evidence
+        import jax as _jax
+
+        _jax.config.update("jax_enable_x64", True)
+        dim32 = 32
+        trip32 = sp.create_spherical_cutoff_triplets(dim32, dim32, dim32, 1.1)
+        rng32 = np.random.default_rng(0)
+        v32 = rng32.standard_normal(len(trip32)) + 1j * rng32.standard_normal(
+            len(trip32)
+        )
+        dense = np.zeros((dim32,) * 3, dtype=np.complex128)
+        dense[trip32[:, 2], trip32[:, 1], trip32[:, 0]] = v32
+        oracle = np.fft.ifftn(dense) * dim32**3
+        accs = {}
+        for arm, env in (("gauss", "1"), ("classic", "0")):
+            os.environ["SPFFT_TPU_GAUSS_MM"] = env
+            t32 = Transform(
+                ProcessingUnit.GPU, TransformType.C2C, dim32, dim32, dim32,
+                indices=trip32, dtype=np.float64,
+            )
+            space = t32.backward(v32)
+            accs[arm] = float(np.abs(space - oracle).max() / np.abs(oracle).max())
+        os.environ.pop("SPFFT_TPU_GAUSS_MM", None)
+        record({"name": "f64_gauss_accuracy_32", **accs})
+    except Exception as e:
+        record({"name": "f64_gauss_accuracy_32", "error": f"{type(e).__name__}: {e}"})
 
     # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)
     measure_local("c2c_32_dense", 32, 1.1, CH32)

@@ -86,3 +86,109 @@ def test_distributed_engine_parity(case):
         back = t.forward(scaling=ScalingType.FULL)
         for r, vals in enumerate(vps):
             assert_close(back[r], vals)
+
+
+@pytest.mark.parametrize("case", [0, 1, 2, 3])
+def test_distributed_discipline_fuzz(case):
+    """Random plans × random exchange disciplines (incl. wire variants) ×
+    both engines × C2C/R2C must agree with the local oracle — the fuzz
+    analogue of the reference's exchange-type test sweep
+    (reference: tests/mpi_tests/test_transform.cpp:173-191)."""
+    from spfft_tpu import ExchangeType
+
+    rng = np.random.default_rng(3000 + case)
+    dims = tuple(int(rng.integers(4, 14)) for _ in range(3))
+    dx, dy, dz = dims
+    shards = int(rng.choice([2, 4]))
+    r2c = bool(case % 2)
+    trip = random_sparse_triplets(
+        rng, dx, dy, dz, float(rng.uniform(0.3, 0.8)), hermitian=r2c
+    )
+    ttype = TransformType.R2C if r2c else TransformType.C2C
+    n = len(trip)
+    if r2c:
+        real = rng.standard_normal((dz, dy, dx))
+        freq = np.fft.fftn(real) / (dx * dy * dz)
+        values = freq[trip[:, 2], trip[:, 1], trip[:, 0]]
+    else:
+        values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(trip, shards, dy)
+    lut = {tuple(t): v for t, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(t)] for t in s]) for s in per_shard]
+
+    local = Transform(
+        ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip
+    ).backward(values)
+
+    exchange = ExchangeType(
+        rng.choice([
+            ExchangeType.BUFFERED,
+            ExchangeType.BUFFERED_FLOAT,
+            ExchangeType.COMPACT_BUFFERED,
+            ExchangeType.COMPACT_BUFFERED_FLOAT,
+            ExchangeType.UNBUFFERED,
+        ])
+    )
+    for engine in ("xla", "mxu"):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz,
+            [p.copy() for p in per_shard],
+            mesh=sp.make_fft_mesh(shards),
+            engine=engine,
+            exchange_type=exchange,
+        )
+        out = t.backward([v.copy() for v in vps])
+        # float-wire exchanges round the payload to f32: compare at that bar
+        tol = (
+            dict(rtol=2e-4, atol=2e-4)
+            if exchange
+            in (ExchangeType.BUFFERED_FLOAT, ExchangeType.COMPACT_BUFFERED_FLOAT)
+            else {}
+        )
+        np.testing.assert_allclose(np.asarray(out), local, **(tol or dict(rtol=1e-6, atol=1e-8)))
+        back = t.forward(scaling=ScalingType.FULL)
+        for r, vals in enumerate(vps):
+            np.testing.assert_allclose(
+                np.asarray(back[r]), vals, **(tol or dict(rtol=1e-6, atol=1e-8))
+            )
+
+
+@pytest.mark.parametrize("case", [0, 1])
+def test_pencil_mesh_fuzz(case):
+    """Random plans on 2-D pencil meshes (both engines, random exchange)
+    against the local oracle — fuzz for the beyond-reference decomposition."""
+    from spfft_tpu import ExchangeType
+
+    rng = np.random.default_rng(4000 + case)
+    p1, p2 = (2, 2) if case == 0 else (2, 4)
+    # pencil needs dim_z >= p1 and dim_y >= p2 slabs with content
+    dx = int(rng.integers(4, 10))
+    dy = int(rng.integers(p2 + 2, 14))
+    dz = int(rng.integers(p1 + 2, 14))
+    trip = random_sparse_triplets(rng, dx, dy, dz, float(rng.uniform(0.4, 0.9)))
+    n = len(trip)
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    per_shard = distribute_triplets(trip, p1 * p2, dy)
+    lut = {tuple(t): v for t, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(t)] for t in s]) for s in per_shard]
+
+    local = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip
+    ).backward(values)
+
+    exchange = ExchangeType(
+        rng.choice([ExchangeType.BUFFERED, ExchangeType.COMPACT_BUFFERED])
+    )
+    for engine in ("xla", "mxu"):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+            [p.copy() for p in per_shard],
+            mesh=sp.make_fft_mesh2(p1, p2),
+            engine=engine,
+            exchange_type=exchange,
+        )
+        out = t.backward([v.copy() for v in vps])
+        assert_close(out, local)
+        back = t.forward(scaling=ScalingType.FULL)
+        for r, vals in enumerate(vps):
+            assert_close(back[r], vals)
