@@ -90,46 +90,22 @@ class MxuLocalExecution(ExecutionBase):
         # ---- sparse copy plans + expansion map ----
         S, Z = p.num_sticks, p.dim_z
 
-        # Sparse-y stage (C2C only): group the sticks by active-x slot into an
-        # (A, Sy_max, Z) table and contract the y-DFT only over each slot's
-        # sticks via per-slot gathered DFT rows — the y-occupancy analogue of
-        # the uniqueXIndices compaction (stick table rows relabel
+        # Sparse-y stage (C2C only): contract the y-DFT only over each active-x
+        # slot's sticks via an (A, Sy_max, Z) table — the y-occupancy analogue
+        # of the uniqueXIndices compaction (stick table rows relabel
         # s -> a*Sy + j; the expand gather and the forward pack disappear).
-        # Cuts y-stage flops by ~Sy_max/dim_y at spherical cutoffs, at the
-        # price of A*Sy - S extra padded z-matmul rows. AUTO default from the
-        # on-chip crossover sweep (v5e, 256^3 spherical, CHAIN=384): engages
-        # when Sy_max/dim_y < 0.6 — measured 1.15x at Sy/Y=0.47 (5% cutoff),
-        # 1.06x at 0.56 (9%), 1.28x SLOWER at 0.69 (15%); see BASELINE.md.
-        # SPFFT_TPU_SPARSE_Y=1 forces it on, =0 forces it off.
-        import os as _os
-
+        # Engagement policy, crossover measurements, and the per-slot matrix
+        # build live in ops/fft.plan_sparse_y (shared with the distributed
+        # engine).
         self._sparse_y = False
         value_indices = np.asarray(p.value_indices, dtype=np.int64)
-        _sy_mode = _os.environ.get("SPFFT_TPU_SPARSE_Y", "auto")
-        if _sy_mode != "0" and not r2c and p.num_sticks:
-            cnt = np.bincount(xslot, minlength=A)
-            # same sublane-padding policy as the x compaction (shared quantum)
-            Sy = offt.compact_x_extent(int(cnt.max()), p.dim_y)
-            if Sy < p.dim_y and (_sy_mode == "1" or 5 * Sy < 3 * p.dim_y):
+        if not r2c and p.num_sticks:
+            sy_plan = offt.plan_sparse_y(xslot, p.stick_y, A, p.dim_y, rt)
+            if sy_plan is not None:
                 self._sparse_y = True
-                self._sy = Sy
-                # j = running index of each stick within its slot, in stick-id
-                # order (preserves the caller's per-slot contiguity)
-                order = np.argsort(xslot, kind="stable")
-                j_of_stick = np.empty(S, dtype=np.int64)
-                j_of_stick[order] = np.arange(S) - np.repeat(
-                    np.cumsum(cnt) - cnt, cnt
-                )
-                row_of_stick = xslot * Sy + j_of_stick
+                self._sy, row_of_stick, self._wy_b_sp, self._wy_f_sp = sy_plan
                 stick_of_value = value_indices // Z
                 value_indices = row_of_stick[stick_of_value] * Z + value_indices % Z
-                # per-slot gathered y-DFT rows (zero rows on padding slots)
-                y_flat = np.full(A * Sy, -1, dtype=np.int64)
-                y_flat[row_of_stick] = p.stick_y.astype(np.int64)
-                wyb = offt.c2c_matrix(p.dim_y, +1, row_perm=y_flat)  # (A*Sy, Y)
-                wyf = offt.c2c_matrix(p.dim_y, -1, row_perm=y_flat)
-                self._wy_b_sp = offt.matrix_pair(wyb.reshape(A, Sy, p.dim_y), rt)
-                self._wy_f_sp = offt.matrix_pair(wyf.reshape(A, Sy, p.dim_y), rt)
 
         rows = A * self._sy if self._sparse_y else S
         self._table_rows = rows

@@ -19,10 +19,11 @@ tricks this module exploits:
 Complex data is carried as (re, im) pairs of real arrays; each complex DFT contraction
 runs as 3 real matmuls by default (Gauss's trick, see :func:`complex_matmul`; R2C/C2R: 2).
 Matmul precision is a plan-level knob (``resolve_precision``):
-``"highest"`` (default, 6-pass bf16 ~1e-7 relative — the 1e-6 parity bar) or
-``"high"`` (3-pass bf16, ~1e-5, measured 1.6x faster at N=512 — the accuracy/speed
-dial analogous to the reference's *_FLOAT exchange variants, reference:
-include/spfft/types.h:41-47).
+``"highest"`` (default, 6-pass bf16, ~2e-7 single-pair oracle error — the 1e-6
+parity bar) or ``"high"`` (3-pass bf16, ~3e-5, measured 16% faster end-to-end at
+the 256^3/15% headline — the accuracy/speed dial analogous to the reference's
+*_FLOAT exchange variants, reference: include/spfft/types.h:41-47; full matrix
+in BASELINE.md ``precision_oracle_matrix_128``).
 """
 from __future__ import annotations
 
@@ -172,6 +173,40 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
     # transpose of the row-subset one
     wx_f = matrix_pair(c2c_matrix(dim_x, -1, row_perm=ux, num_rows=num_rows).T, rt)
     return wx_b, wx_f
+
+
+def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
+    """Shared sparse-y planning for the MXU engines (C2C only — callers gate).
+
+    Groups sticks by active-x slot into an (A, Sy, *) table so the y-DFT
+    contracts only each slot's sticks. ONE home for the engagement policy:
+    ``SPFFT_TPU_SPARSE_Y`` = ``0`` (off) / ``1`` (forced) / unset ("auto" —
+    engage below the measured Sy/Y < 0.6 crossover, BASELINE.md
+    `sparse_y_crossover_256`; also measured on the distributed engine,
+    `dist1_5pct_sparse_y_*`). Returns ``None`` when disengaged, else
+    ``(Sy, row_of_stick, wy_backward_pair, wy_forward_pair)`` where
+    ``row_of_stick[i] = slot_a * Sy + j`` is stick i's table row and the
+    matrix pairs are the (A, Sy, Y) per-slot gathered DFT constants
+    (padding rows zero).
+    """
+    mode = os.environ.get("SPFFT_TPU_SPARSE_Y", "auto")
+    xslot = np.asarray(xslot, dtype=np.int64)
+    if mode == "0" or xslot.size == 0:
+        return None
+    A, Y = int(num_x_active), int(dim_y)
+    cnt = np.bincount(xslot, minlength=A)
+    sy_max = compact_x_extent(int(cnt.max()), Y)
+    if sy_max >= Y or (mode != "1" and not (5 * sy_max < 3 * Y)):
+        return None
+    order = np.argsort(xslot, kind="stable")
+    j = np.empty(xslot.size, dtype=np.int64)
+    j[order] = np.arange(xslot.size) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    row_of = xslot * sy_max + j
+    y_flat = np.full(A * sy_max, -1, dtype=np.int64)
+    y_flat[row_of] = np.asarray(ys, dtype=np.int64)
+    wyb = matrix_pair(c2c_matrix(Y, +1, row_perm=y_flat).reshape(A, sy_max, Y), real_dtype)
+    wyf = matrix_pair(c2c_matrix(Y, -1, row_perm=y_flat).reshape(A, sy_max, Y), real_dtype)
+    return sy_max, row_of, wyb, wyf
 
 
 F64_STAGE_MB_ENV = "SPFFT_TPU_F64_STAGE_MB"

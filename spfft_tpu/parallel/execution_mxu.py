@@ -305,9 +305,36 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         # ux is sorted, so any valid x == 0 lands in slot 0)
         self._have_x0 = bool((sx_all[valid] == 0).any())
 
-        # Exact-counts exchanges over the compact (Y, A) plane slots:
-        # COMPACT_* runs the ppermute chain, UNBUFFERED the one-shot
-        # ragged-all-to-all discipline; see parallel/ragged.py.
+        # Sparse-y stage (C2C only): global per-slot y contraction; the
+        # plane-side slot space then shrinks from Y * A to A * Sy, which also
+        # shrinks every exchange unpack/pack and the ragged exchanges' plane
+        # flats. Engagement policy + matrix build shared with the local engine
+        # (ops/fft.plan_sparse_y); built from the GLOBAL stick arrays, so
+        # every shard's SPMD program agrees.
+        self._sparse_y = False
+        if not r2c and valid.any():
+            xslot_valid = xslot_of[sx_all[valid]]
+            sy_plan = offt.plan_sparse_y(xslot_valid, sy[valid], A, Y, rt)
+            if sy_plan is not None:
+                self._sparse_y = True
+                self._sy, row_valid, self._wy_b_sp, self._wy_f_sp = sy_plan
+                Sy = self._sy
+                row_of = np.full(sx_all.size, A * Sy, dtype=np.int64)  # sentinel
+                row_of[np.flatnonzero(valid)] = row_valid
+                self._stick_row = row_of.astype(np.int32)  # (P*S,) table row
+                inv_row = np.full(A * Sy, p.num_shards * S, dtype=np.int32)
+                inv_row[row_valid] = np.flatnonzero(valid).astype(np.int32)
+                self._row_stick = inv_row  # table row -> global stick row
+
+        # Exact-counts exchanges over the compact plane slots (Y * A, or the
+        # sparse-y (A, Sy) table rows): COMPACT_* runs the ppermute chain,
+        # UNBUFFERED the one-shot ragged-all-to-all discipline; the exchange
+        # machinery is generic over (num_slots, per-stick slot map).
+        if self._sparse_y:
+            plane_slots, slot_of_stick = A * self._sy, self._stick_row
+        else:
+            plane_slots, slot_of_stick = Y * A, self._stick_yx
+        self._plane_slots = plane_slots
         self._ragged = None
         if self.exchange_type in _RAGGED_EXCHANGES and p.num_shards > 1:
             cls = (
@@ -318,7 +345,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             kw = {"mesh": mesh} if cls is OneShotExchange else {}
             self._ragged = cls(
                 p.num_sticks_per_shard, p.local_z_lengths, p.z_offsets,
-                S, L, Z, Y * A, self._stick_yx, **kw,
+                S, L, Z, plane_slots, slot_of_stick, **kw,
             )
         self._ragged_wire = self._ragged_wire_format()
 
@@ -413,13 +440,20 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
         if self._ragged is not None:
-            # exact-counts exchange straight into the compact planes
+            # exact-counts exchange straight into the compact planes (or the
+            # sparse-y (A, Sy) stick table — the slot space the exchange was
+            # built over)
             with jax.named_scope("exchange"):
                 fre, fim = self._ragged.backward(
                     (sre, sim), wire=self._ragged_wire, real_dtype=rt
                 )
-                gre = fre[: L * Y * A].reshape(L, Y, A)
-                gim = fim[: L * Y * A].reshape(L, Y, A)
+                ns = self._plane_slots
+                if self._sparse_y:
+                    gre = fre[: L * ns].reshape(L, A, self._sy)
+                    gim = fim[: L * ns].reshape(L, A, self._sy)
+                else:
+                    gre = fre[: L * ns].reshape(L, Y, A)
+                    gim = fim[: L * ns].reshape(L, Y, A)
         else:
             # pack: (S, Z) -> (P, S, L) exchange blocks
             with jax.named_scope("pack"):
@@ -433,13 +467,19 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             with jax.named_scope("exchange"):
                 rre, rim = self._exchange(bre, bim)
 
-            # expand: (P*S, L) global stick rows -> (L, Y, A) compact freq planes
+            # expand: (P*S, L) global stick rows -> compact freq planes
+            # ((L, Y, A), or the (A, Sy, L) table when sparse-y is engaged)
             with jax.named_scope("unpack"):
                 rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
                 rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
-                m = jnp.asarray(self._yx_stick)
-                gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
-                gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
+                if self._sparse_y:
+                    m = jnp.asarray(self._row_stick)
+                    gre = jnp.take(rows_re, m, axis=0).reshape(A, self._sy, L)
+                    gim = jnp.take(rows_im, m, axis=0).reshape(A, self._sy, L)
+                else:
+                    m = jnp.asarray(self._yx_stick)
+                    gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
+                    gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
@@ -450,7 +490,22 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 gim = gim.at[:, :, 0].set(pim)
 
         with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+            if self._sparse_y:
+                # per-slot y contraction straight off the stick table (the two
+                # table orientations of the paths above share one spec via a
+                # transpose-free relabeling)
+                if self._ragged is not None:
+                    gre, gim = offt.complex_matmul(
+                        gre, gim, *self._wy_b_sp, "laj,ajk->lka", prec
+                    )
+                else:
+                    gre, gim = offt.complex_matmul(
+                        gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
+                    )
+            else:
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_b, "lyx,yk->lkx", prec
+                )
         with jax.named_scope("x transform"):
             if self.is_r2c:
                 out = offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
@@ -483,7 +538,21 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     *self._wx_f, "lyx,xk->lyk", prec,
                 )
         with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
+            if self._sparse_y:
+                # per-slot y contraction straight into the stick table; the
+                # orientation matches what the exchange below consumes
+                if self._ragged is not None:
+                    gre, gim = offt.complex_matmul(
+                        gre, gim, *self._wy_f_sp, "lyk,kjy->lkj", prec
+                    )
+                else:
+                    gre, gim = offt.complex_matmul(
+                        gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
+                    )
+            else:
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_f, "lyk,yj->ljk", prec
+                )
 
         if self._ragged is not None:
             with jax.named_scope("exchange"):
@@ -491,15 +560,25 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     (gre, gim), wire=self._ragged_wire, real_dtype=rt
                 )
         else:
-            # pack: gather every global stick's compact (y, x) slot from my planes
+            # pack: gather every global stick's compact plane slot (or sparse-y
+            # table row) from my planes
             with jax.named_scope("pack"):
-                flat_re = jnp.concatenate(
-                    [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-                )
-                flat_im = jnp.concatenate(
-                    [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-                )
-                m = jnp.asarray(self._stick_yx)
+                if self._sparse_y:
+                    flat_re = jnp.concatenate(
+                        [gre.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
+                    )
+                    flat_im = jnp.concatenate(
+                        [gim.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
+                    )
+                    m = jnp.asarray(self._stick_row)
+                else:
+                    flat_re = jnp.concatenate(
+                        [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+                    )
+                    flat_im = jnp.concatenate(
+                        [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+                    )
+                    m = jnp.asarray(self._stick_yx)
                 bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
                 bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
 
