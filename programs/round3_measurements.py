@@ -96,7 +96,8 @@ def main():
         err = float(np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max())
         return best, err
 
-    def measure_local(name, dim, sparsity, chain, env=None, no_rotation=False):
+    def measure_local(name, dim, sparsity, chain, env=None, no_rotation=False,
+                      precision="highest"):
         envs = dict(env or {})
         saved = {k: os.environ.get(k) for k in envs}
         os.environ.update(envs)
@@ -107,7 +108,7 @@ def main():
             trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
             t = Transform(
                 ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
-                indices=trip, dtype=np.float32,
+                indices=trip, dtype=np.float32, precision=precision,
             )
             ex = t._exec
             rng = np.random.default_rng(0)
@@ -134,7 +135,10 @@ def main():
                 else:
                     os.environ[k] = v
 
-    def measure_dist1(name, dim, sparsity, chain):
+    def measure_dist1(name, dim, sparsity, chain, env=None):
+        envs = dict(env or {})
+        saved = {k: os.environ.get(k) for k in envs}
+        os.environ.update(envs)
         try:
             trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
             per = distribute_triplets(trip, 1, dim)
@@ -158,9 +162,16 @@ def main():
                 "ms_per_pair": round(best * 1e3, 3),
                 "gflops": round(flops_pair(dim) / best / 1e9, 1),
                 "roundtrip_err": err,
+                "engaged": bool(getattr(ex, "_sparse_y", False)),
             })
         except Exception as e:
             record({"name": name, "error": f"{type(e).__name__}: {e}"})
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
     CH = 48 if args.quick else 384
     CH32 = 256 if args.quick else 2048
@@ -227,6 +238,42 @@ def main():
         "c2c_256_s15_classic_4mm", 256, 0.659, CH,
         env={"SPFFT_TPU_SPARSE_Y": "0", "SPFFT_TPU_GAUSS_MM": "0"},
     )
+    # precision="high" speed tier (3-pass bf16; accuracy matrix below)
+    measure_local(
+        "precision_high_256_s15", 256, 0.659, CH,
+        env={"SPFFT_TPU_SPARSE_Y": "0"}, precision="high",
+    )
+    # (the per-stage ablation rows come from programs/microbench_ablate.py)
+
+    # precision x Gauss single-pair oracle accuracy matrix (128^3 on chip)
+    try:
+        dim128 = 128
+        trip128 = sp.create_spherical_cutoff_triplets(dim128, dim128, dim128, 0.659)
+        rng128 = np.random.default_rng(0)
+        v128 = (
+            rng128.standard_normal(len(trip128))
+            + 1j * rng128.standard_normal(len(trip128))
+        ).astype(np.complex64)
+        dense128 = np.zeros((dim128,) * 3, dtype=np.complex128)
+        dense128[trip128[:, 2], trip128[:, 1], trip128[:, 0]] = v128
+        oracle128 = np.fft.ifftn(dense128) * dim128**3
+        arms = {}
+        for prec in ("highest", "high"):
+            for gname, genv in (("gauss", "1"), ("classic", "0")):
+                os.environ["SPFFT_TPU_GAUSS_MM"] = genv
+                t128 = Transform(
+                    ProcessingUnit.GPU, TransformType.C2C,
+                    dim128, dim128, dim128,
+                    indices=trip128, dtype=np.float32, precision=prec,
+                )
+                space = t128.backward(v128)
+                arms[f"{prec}_{gname}"] = float(
+                    np.abs(space - oracle128).max() / np.abs(oracle128).max()
+                )
+        os.environ.pop("SPFFT_TPU_GAUSS_MM", None)
+        record({"name": "precision_oracle_matrix_128", "arms": arms})
+    except Exception as e:
+        record({"name": "precision_oracle_matrix_128", "error": f"{type(e).__name__}: {e}"})
     try:
         # f64 oracle accuracy under both matmul forms (32^3 C2C, CPU-exact
         # complex128 oracle) — the Gauss default's accuracy evidence
@@ -261,6 +308,14 @@ def main():
 
     # P=1 distributed plan with the exchange specialized away
     measure_dist1("dist1_c2c_256_s15_specialized", 256, 0.659, CH)
+
+    # distributed sparse-y A/B at the 5% cutoff (the stage's win case; same
+    # names as the archived rows so a re-run refreshes them)
+    for arm, sy in (("off", "0"), ("on", "1")):
+        measure_dist1(
+            f"dist1_5pct_sparse_y_{arm}", 256, 0.457, CH,
+            env={"SPFFT_TPU_SPARSE_Y": sy},
+        )
 
     # config-5 shape re-check (512^3 R2C 15% spherical) — shorter chain
     try:
