@@ -33,6 +33,7 @@
  */
 #define _POSIX_C_SOURCE 200112L /* clock_gettime, CLOCK_MONOTONIC, setenv */
 
+#include <limits.h>
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -166,16 +167,20 @@ static int parse_args(int argc, char** argv, Options* o) {
   return 1;
 }
 
-/* Reference stick model: returns malloc'd triplets + stick count. */
-static int* make_triplets(const Options* o, int* num_sticks, int* num_values) {
+/* Reference stick model: returns malloc'd triplets + stick count.
+ * Counting is done in 64 bits (1024^3-class dense plans exceed INT_MAX/3
+ * elements, so int products overflow before any cast); the C API itself
+ * takes int value counts, so the caller guards num_values <= INT_MAX. */
+static int* make_triplets(const Options* o, int* num_sticks, long long* num_values) {
   const int dim_x_freq = o->r2c ? o->dims[0] / 2 + 1 : o->dims[0];
   const int dim_y_freq = o->r2c ? o->dims[1] / 2 + 1 : o->dims[1];
   int num_x = (int)ceil(dim_x_freq * o->sparsity);
-  int x, y, z, k = 0, sticks = 0;
+  int x, y, z, sticks = 0;
+  size_t k = 0;
   int* trips;
   if (num_x < 1) num_x = 1;
   for (x = 0; x < num_x; ++x) sticks += (o->r2c && x == 0) ? dim_y_freq : o->dims[1];
-  trips = (int*)malloc((size_t)(3 * sticks * o->dims[2]) * sizeof(int));
+  trips = (int*)malloc((size_t)3 * (size_t)sticks * (size_t)o->dims[2] * sizeof(int));
   if (!trips) return NULL;
   for (x = 0; x < num_x; ++x) {
     const int ny = (o->r2c && x == 0) ? dim_y_freq : o->dims[1];
@@ -187,13 +192,14 @@ static int* make_triplets(const Options* o, int* num_sticks, int* num_values) {
       }
   }
   *num_sticks = sticks;
-  *num_values = sticks * o->dims[2];
+  *num_values = (long long)sticks * o->dims[2];
   return trips;
 }
 
 int main(int argc, char** argv) {
   Options o;
-  int num_sticks = 0, n = 0, i, m, rep;
+  int num_sticks = 0, m, rep;
+  long long n = 0, i;
   int* trips;
   SpfftProcessingUnitType pu;
   double* freq[MAX_TRANSFORMS];
@@ -212,11 +218,15 @@ int main(int argc, char** argv) {
   }
   trips = make_triplets(&o, &num_sticks, &n);
   if (!trips) return 1;
+  if (n > INT_MAX) {
+    fprintf(stderr, "benchmark: %lld values exceed the int-based C API limit\n", n);
+    return 1;
+  }
 
   for (m = 0; m < o.num_transforms; ++m) {
-    freq[m] = (double*)malloc((size_t)(2 * n) * sizeof(double));
+    freq[m] = (double*)malloc((size_t)2 * (size_t)n * sizeof(double));
     if (!freq[m]) {
-      fprintf(stderr, "benchmark: out of memory (%d values)\n", n);
+      fprintf(stderr, "benchmark: out of memory (%lld values)\n", n);
       return 1;
     }
     for (i = 0; i < 2 * n; ++i) freq[m][i] = rng_uniform();
@@ -281,7 +291,7 @@ int main(int argc, char** argv) {
       ts[m] = NULL;
       CHECK(spfft_transform_create_independent(
           &ts[m], 1, pu, o.r2c ? SPFFT_TRANS_R2C : SPFFT_TRANS_C2C, o.dims[0],
-          o.dims[1], o.dims[2], n, SPFFT_INDEX_TRIPLETS, trips));
+          o.dims[1], o.dims[2], (int)n, SPFFT_INDEX_TRIPLETS, trips));
       inputs[m] = freq[m];
       outputs[m] = freq[m]; /* identity chain: forward writes next input */
       locs[m] = pu;
@@ -331,7 +341,7 @@ int main(int argc, char** argv) {
              "  \"parameters\": {\"dims\": [%d, %d, %d], \"sparsity\": %g,"
              " \"type\": \"%s\", \"processing_unit\": \"%s\","
              " \"num_transforms\": %d, \"shards\": %d, \"exchange\": \"%s\","
-             " \"num_sticks\": %d, \"num_values\": %d, \"repeats\": %d},\n"
+             " \"num_sticks\": %d, \"num_values\": %lld, \"repeats\": %d},\n"
              "  \"results\": {\"ms_per_pair\": %.3f, \"gflops\": %.1f,"
              " \"backward_ms\": %.3f, \"forward_ms\": %.3f},\n"
              "  \"harness\": \"native-c\"\n"

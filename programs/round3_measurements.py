@@ -279,6 +279,7 @@ def main():
         # complex128 oracle) — the Gauss default's accuracy evidence
         import jax as _jax
 
+        _prev_x64 = bool(_jax.config.read("jax_enable_x64"))
         _jax.config.update("jax_enable_x64", True)
         dim32 = 32
         trip32 = sp.create_spherical_cutoff_triplets(dim32, dim32, dim32, 1.1)
@@ -302,6 +303,12 @@ def main():
         record({"name": "f64_gauss_accuracy_32", **accs})
     except Exception as e:
         record({"name": "f64_gauss_accuracy_32", "error": f"{type(e).__name__}: {e}"})
+    finally:
+        # x64 mode must not leak into the later arms (one variable per arm)
+        try:
+            _jax.config.update("jax_enable_x64", _prev_x64)
+        except NameError:
+            pass
 
     # 32^3 long-chain re-measure (round-1 row was ~97% fixed tunnel cost)
     measure_local("c2c_32_dense", 32, 1.1, CH32)

@@ -189,7 +189,13 @@ def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
     matrix pairs are the (A, Sy, Y) per-slot gathered DFT constants
     (padding rows zero).
     """
-    mode = os.environ.get("SPFFT_TPU_SPARSE_Y", "auto")
+    # empty string = unset (the usual shell idiom for clearing a knob)
+    mode = os.environ.get("SPFFT_TPU_SPARSE_Y") or "auto"
+    if mode not in ("0", "1", "auto"):
+        raise ValueError(
+            f"SPFFT_TPU_SPARSE_Y={mode!r}: must be '0' (off), '1' (forced), "
+            "or 'auto'/unset (measured Sy/Y crossover)"
+        )
     xslot = np.asarray(xslot, dtype=np.int64)
     if mode == "0" or xslot.size == 0:
         return None
