@@ -31,6 +31,8 @@ run_dryrun() {
 
 run_native() {
   echo "== Native build + API tests =="
+  # C API parity: zero reference-only names (exits nonzero on any hole).
+  python programs/api_surface.py
   cmake -S native -B native/build-ci -DCMAKE_BUILD_TYPE=Release >/dev/null
   cmake --build native/build-ci --parallel >/dev/null
   # HOST-only embedded-interpreter roundtrip: must pass with no accelerator.

@@ -1,0 +1,20 @@
+#----------------------------------------------------------------
+# Generated CMake target import file for configuration "Release".
+#----------------------------------------------------------------
+
+# Commands may need to know the format version.
+set(CMAKE_IMPORT_FILE_VERSION 1)
+
+# Import target "SpFFTTPU::spfft_tpu" for configuration "Release"
+set_property(TARGET SpFFTTPU::spfft_tpu APPEND PROPERTY IMPORTED_CONFIGURATIONS RELEASE)
+set_target_properties(SpFFTTPU::spfft_tpu PROPERTIES
+  IMPORTED_LINK_DEPENDENT_LIBRARIES_RELEASE "Python3::Python"
+  IMPORTED_LOCATION_RELEASE "${_IMPORT_PREFIX}/lib/libspfft_tpu.so.0.3.0"
+  IMPORTED_SONAME_RELEASE "libspfft_tpu.so.0"
+  )
+
+list(APPEND _cmake_import_check_targets SpFFTTPU::spfft_tpu )
+list(APPEND _cmake_import_check_files_for_SpFFTTPU::spfft_tpu "${_IMPORT_PREFIX}/lib/libspfft_tpu.so.0.3.0" )
+
+# Commands beyond this point should not need to know the version.
+set(CMAKE_IMPORT_FILE_VERSION)

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/run_native_tests.dir/tests/test_api.c.o"
+  "CMakeFiles/run_native_tests.dir/tests/test_api.c.o.d"
+  "run_native_tests"
+  "run_native_tests.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang C)
+  include(CMakeFiles/run_native_tests.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,2 @@
+# Empty dependencies file for run_native_tests.
+# This may be replaced when dependencies are built.

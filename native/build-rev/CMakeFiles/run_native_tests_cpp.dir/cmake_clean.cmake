@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/run_native_tests_cpp.dir/tests/test_api_cpp.cpp.o"
+  "CMakeFiles/run_native_tests_cpp.dir/tests/test_api_cpp.cpp.o.d"
+  "run_native_tests_cpp"
+  "run_native_tests_cpp.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/run_native_tests_cpp.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,17 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/spfft_tpu.dir/src/bridge.cpp.o"
+  "CMakeFiles/spfft_tpu.dir/src/bridge.cpp.o.d"
+  "CMakeFiles/spfft_tpu.dir/src/capi_c.cpp.o"
+  "CMakeFiles/spfft_tpu.dir/src/capi_c.cpp.o.d"
+  "CMakeFiles/spfft_tpu.dir/src/spfft.cpp.o"
+  "CMakeFiles/spfft_tpu.dir/src/spfft.cpp.o.d"
+  "libspfft_tpu.pdb"
+  "libspfft_tpu.so"
+  "libspfft_tpu.so.0"
+  "libspfft_tpu.so.0.3.0"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/spfft_tpu.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

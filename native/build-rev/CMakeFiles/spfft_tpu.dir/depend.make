@@ -1,0 +1,2 @@
+# Empty dependencies file for spfft_tpu.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/spfft_tpu_benchmark.dir/programs/benchmark.c.o"
+  "CMakeFiles/spfft_tpu_benchmark.dir/programs/benchmark.c.o.d"
+  "spfft_tpu_benchmark"
+  "spfft_tpu_benchmark.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang C)
+  include(CMakeFiles/spfft_tpu_benchmark.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

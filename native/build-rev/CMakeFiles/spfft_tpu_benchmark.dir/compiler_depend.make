@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for spfft_tpu_benchmark.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,2 @@
+# Empty dependencies file for spfft_tpu_benchmark.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,10 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build-rev
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(native_api "/root/repo/native/build-rev/run_native_tests")
+set_tests_properties(native_api PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;37;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test(native_api_cpp "/root/repo/native/build-rev/run_native_tests_cpp")
+set_tests_properties(native_api_cpp PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;40;add_test;/root/repo/native/CMakeLists.txt;0;")

@@ -33,6 +33,32 @@ SpfftError spfft_float_multi_transform_forward(
     const SpfftProcessingUnitType* inputLocations, float* const* output,
     const SpfftScalingType* scalingTypes);
 
+/* Pointer-based batch overloads (reference: include/spfft/multi_transform.h:60-95):
+ * the space-domain side is a caller-provided pointer per transform instead of
+ * each transform's internal space_domain_data() buffer. */
+
+SpfftError spfft_multi_transform_forward_ptr(int numTransforms,
+                                             SpfftTransform* transforms,
+                                             const double* const* inputPointers,
+                                             double* const* outputPointers,
+                                             const SpfftScalingType* scalingTypes);
+
+SpfftError spfft_multi_transform_backward_ptr(int numTransforms,
+                                              SpfftTransform* transforms,
+                                              const double* const* inputPointers,
+                                              double* const* outputPointers);
+
+SpfftError spfft_float_multi_transform_forward_ptr(int numTransforms,
+                                                   SpfftFloatTransform* transforms,
+                                                   const float* const* inputPointers,
+                                                   float* const* outputPointers,
+                                                   const SpfftScalingType* scalingTypes);
+
+SpfftError spfft_float_multi_transform_backward_ptr(int numTransforms,
+                                                    SpfftFloatTransform* transforms,
+                                                    const float* const* inputPointers,
+                                                    float* const* outputPointers);
+
 #ifdef __cplusplus
 }
 #endif

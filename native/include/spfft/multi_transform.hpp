@@ -33,6 +33,23 @@ void multi_transform_forward(int num_transforms, TransformFloat* transforms,
                              const SpfftProcessingUnitType* input_locations,
                              float* const* output, const SpfftScalingType* scaling_types);
 
+/* Pointer-based overloads (reference: include/spfft/multi_transform.hpp:64-95):
+ * the space-domain side reads from / writes to caller pointers instead of each
+ * transform's internal space buffer. */
+void multi_transform_backward(int num_transforms, Transform* transforms,
+                              const double* const* input, double* const* space_output);
+
+void multi_transform_forward(int num_transforms, Transform* transforms,
+                             const double* const* space_input, double* const* output,
+                             const SpfftScalingType* scaling_types);
+
+void multi_transform_backward(int num_transforms, TransformFloat* transforms,
+                              const float* const* input, float* const* space_output);
+
+void multi_transform_forward(int num_transforms, TransformFloat* transforms,
+                             const float* const* space_input, float* const* output,
+                             const SpfftScalingType* scaling_types);
+
 } // namespace spfft
 
 #endif // SPFFT_TPU_MULTI_TRANSFORM_HPP

@@ -434,6 +434,172 @@ module spfft
       integer(c_int), value :: mode
     end function
 
+    integer(c_int) function spfft_float_transform_local_slice_size(transform, &
+        size) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: size
+    end function
+
+    integer(c_int) function spfft_float_transform_num_global_elements(transform, &
+        n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_float_transform_global_size(transform, n) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_long_long), intent(out) :: n
+    end function
+
+    integer(c_int) function spfft_float_transform_device_id(transform, &
+        deviceId) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: deviceId
+    end function
+
+    integer(c_int) function spfft_float_transform_num_threads(transform, &
+        numThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: numThreads
+    end function
+
+    ! ---- grid (float tier) --------------------------------------------------
+    ! Same capacity object as the double grid (precision lives on the
+    ! Transform); full reference surface (reference: grid_float.h:30-190).
+
+    integer(c_int) function spfft_float_grid_create_distributed(grid, maxDimX, &
+        maxDimY, maxDimZ, maxNumLocalZColumns, maxLocalZLength, numShards, &
+        exchangeType, processingUnit, maxNumThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), intent(out) :: grid
+      integer(c_int), value :: maxDimX, maxDimY, maxDimZ
+      integer(c_int), value :: maxNumLocalZColumns, maxLocalZLength, numShards
+      integer(c_int), value :: exchangeType, processingUnit, maxNumThreads
+    end function
+
+    integer(c_int) function spfft_float_grid_destroy(grid) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+    end function
+
+    integer(c_int) function spfft_float_grid_max_dim_x(grid, dimX) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimX
+    end function
+
+    integer(c_int) function spfft_float_grid_max_dim_y(grid, dimY) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimY
+    end function
+
+    integer(c_int) function spfft_float_grid_max_dim_z(grid, dimZ) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: dimZ
+    end function
+
+    integer(c_int) function spfft_float_grid_max_num_local_z_columns(grid, &
+        maxNumLocalZColumns) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: maxNumLocalZColumns
+    end function
+
+    integer(c_int) function spfft_float_grid_max_local_z_length(grid, &
+        maxLocalZLength) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: maxLocalZLength
+    end function
+
+    integer(c_int) function spfft_float_grid_processing_unit(grid, &
+        processingUnit) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: processingUnit
+    end function
+
+    integer(c_int) function spfft_float_grid_device_id(grid, deviceId) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: deviceId
+    end function
+
+    integer(c_int) function spfft_float_grid_num_threads(grid, numThreads) bind(C)
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: numThreads
+    end function
+
+    ! ---- MPI-surface parity stubs -------------------------------------------
+    ! No MPI exists in this runtime (the device mesh replaces the
+    ! communicator); these link and return SPFFT_MPI_SUPPORT_ERROR. The bind
+    ! targets are the *_fortran entry points taking an MPI_Fint-style integer,
+    ! exactly like the reference module (reference: spfft.f90:165-169,310-316).
+
+    integer(c_int) function spfft_grid_communicator(grid, comm) &
+        bind(C, name="spfft_grid_communicator_fortran")
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: comm
+    end function
+
+    integer(c_int) function spfft_float_grid_communicator(grid, comm) &
+        bind(C, name="spfft_float_grid_communicator_fortran")
+      use iso_c_binding
+      type(c_ptr), value :: grid
+      integer(c_int), intent(out) :: comm
+    end function
+
+    integer(c_int) function spfft_transform_communicator(transform, comm) &
+        bind(C, name="spfft_transform_communicator_fortran")
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: comm
+    end function
+
+    integer(c_int) function spfft_float_transform_communicator(transform, comm) &
+        bind(C, name="spfft_float_transform_communicator_fortran")
+      use iso_c_binding
+      type(c_ptr), value :: transform
+      integer(c_int), intent(out) :: comm
+    end function
+
+    integer(c_int) function spfft_transform_create_independent_distributed( &
+        transform, maxNumThreads, comm, exchangeType, processingUnit, &
+        transformType, dimX, dimY, dimZ, localZLength, numLocalElements, &
+        indexFormat, indices) &
+        bind(C, name="spfft_transform_create_independent_distributed_fortran")
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      integer(c_int), value :: maxNumThreads, comm, exchangeType
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, localZLength
+      integer(c_int), value :: numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
+    integer(c_int) function spfft_float_transform_create_independent_distributed( &
+        transform, maxNumThreads, comm, exchangeType, processingUnit, &
+        transformType, dimX, dimY, dimZ, localZLength, numLocalElements, &
+        indexFormat, indices) &
+        bind(C, name="spfft_float_transform_create_independent_distributed_fortran")
+      use iso_c_binding
+      type(c_ptr), intent(out) :: transform
+      integer(c_int), value :: maxNumThreads, comm, exchangeType
+      integer(c_int), value :: processingUnit, transformType
+      integer(c_int), value :: dimX, dimY, dimZ, localZLength
+      integer(c_int), value :: numLocalElements, indexFormat
+      integer(c_int), dimension(*), intent(in) :: indices
+    end function
+
     ! ---- multi-transform ----------------------------------------------------
 
     integer(c_int) function spfft_multi_transform_backward(numTransforms, &

@@ -1,10 +1,10 @@
 /* spfft_tpu native API — umbrella C header (reference: include/spfft/spfft.h).
  *
- * Scope: local (single-process) transforms, double and single precision — the
- * same surface the reference exposes when built without MPI (SPFFT_MPI=OFF).
- * Mesh-distributed transforms are reached through the Python API
- * (spfft_tpu.DistributedTransform over a jax.sharding.Mesh); a device mesh has
- * no MPI-communicator analogue that can cross the C boundary meaningfully.
+ * Scope: the reference's full C surface, double and single precision. MPI-only
+ * entry points exist as linkable stubs returning SPFFT_MPI_SUPPORT_ERROR;
+ * mesh-distributed transforms run single-controller through the
+ * spfft_grid_create_distributed / spfft_dist_transform_* surface (one process
+ * drives every shard of a jax.sharding.Mesh).
  */
 #ifndef SPFFT_TPU_SPFFT_H
 #define SPFFT_TPU_SPFFT_H
@@ -16,10 +16,14 @@
 #define SPFFT_VERSION_PATCH 2
 #define SPFFT_VERSION_STRING "1.0.2-tpu"
 
+#include <spfft/config.h>
 #include <spfft/errors.h>
 #include <spfft/grid.h>
+#include <spfft/grid_float.h>
 #include <spfft/multi_transform.h>
+#include <spfft/multi_transform_float.h>
 #include <spfft/transform.h>
+#include <spfft/transform_float.h>
 #include <spfft/types.h>
 
 #endif /* SPFFT_TPU_SPFFT_H */

@@ -121,14 +121,43 @@ SpfftError spfft_float_transform_local_z_length(SpfftFloatTransform transform,
                                                 int* localZLength);
 SpfftError spfft_float_transform_local_z_offset(SpfftFloatTransform transform,
                                                 int* offset);
+SpfftError spfft_float_transform_local_slice_size(SpfftFloatTransform transform,
+                                                  int* size);
 SpfftError spfft_float_transform_num_local_elements(SpfftFloatTransform transform,
                                                     int* numLocalElements);
+SpfftError spfft_float_transform_num_global_elements(SpfftFloatTransform transform,
+                                                     long long int* numGlobalElements);
+SpfftError spfft_float_transform_global_size(SpfftFloatTransform transform,
+                                             long long int* globalSize);
 SpfftError spfft_float_transform_processing_unit(SpfftFloatTransform transform,
                                                  SpfftProcessingUnitType* processingUnit);
+SpfftError spfft_float_transform_device_id(SpfftFloatTransform transform, int* deviceId);
+SpfftError spfft_float_transform_num_threads(SpfftFloatTransform transform,
+                                             int* numThreads);
 SpfftError spfft_float_transform_execution_mode(SpfftFloatTransform transform,
                                                 SpfftExecType* mode);
 SpfftError spfft_float_transform_set_execution_mode(SpfftFloatTransform transform,
                                                     SpfftExecType mode);
+
+/* MPI-surface parity stubs (reference: include/spfft/transform.h:122,341 and
+ * transform_float.h). No MPI exists in this runtime — the device mesh replaces
+ * the communicator (use spfft_grid_create_distributed / the
+ * spfft_dist_transform_* surface instead) — so these link and return
+ * SPFFT_MPI_SUPPORT_ERROR, exactly what a ported caller can handle.
+ * SpfftMpiComm (types.h) is MPI_Comm whenever the caller compiles with MPI. */
+SpfftError spfft_transform_create_independent_distributed(
+    SpfftTransform* transform, int maxNumThreads, SpfftMpiComm comm,
+    SpfftExchangeType exchangeType, SpfftProcessingUnitType processingUnit,
+    SpfftTransformType transformType, int dimX, int dimY, int dimZ, int localZLength,
+    int numLocalElements, SpfftIndexFormatType indexFormat, const int* indices);
+SpfftError spfft_float_transform_create_independent_distributed(
+    SpfftFloatTransform* transform, int maxNumThreads, SpfftMpiComm comm,
+    SpfftExchangeType exchangeType, SpfftProcessingUnitType processingUnit,
+    SpfftTransformType transformType, int dimX, int dimY, int dimZ, int localZLength,
+    int numLocalElements, SpfftIndexFormatType indexFormat, const int* indices);
+SpfftError spfft_transform_communicator(SpfftTransform transform, SpfftMpiComm* comm);
+SpfftError spfft_float_transform_communicator(SpfftFloatTransform transform,
+                                              SpfftMpiComm* comm);
 
 /* ---- distributed transforms (single-controller mesh) ----------------------
  * One process drives every shard; per-rank MPI arrays become shard-major

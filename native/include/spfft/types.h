@@ -12,6 +12,21 @@
 #ifndef SPFFT_TPU_TYPES_H
 #define SPFFT_TPU_TYPES_H
 
+/* Communicator type for the MPI-surface parity stubs (reference:
+ * include/spfft/grid.h:35-37 includes <mpi.h> under SPFFT_MPI and uses
+ * MPI_Comm directly). When the caller builds with MPI this IS MPI_Comm, so
+ * reference call sites compile unchanged; otherwise it is an opaque
+ * placeholder — the stubs return SPFFT_MPI_SUPPORT_ERROR without reading it
+ * (no MPI exists in this runtime; the device mesh replaces the communicator). */
+#if defined(SPFFT_MPI) || defined(MPI_VERSION)
+#ifndef MPI_VERSION
+#include <mpi.h>
+#endif
+typedef MPI_Comm SpfftMpiComm;
+#else
+typedef void* SpfftMpiComm;
+#endif
+
 enum SpfftExchangeType {
   /* DIVERGENCE from the reference: there DEFAULT == COMPACT_BUFFERED; here it
    * routes to BUFFERED (the fused ICI all-to-all is the fast path for balanced

@@ -112,6 +112,109 @@ SPFFT_TPU_GRID_GETTER(spfft_grid_num_shards, int, num_shards)
 
 #undef SPFFT_TPU_GRID_GETTER
 
+/* ---- grid (float tier) ----------------------------------------------------
+ * GridFloat is the same capacity object (precision lives on the Transform,
+ * grid.hpp); the full reference surface (reference: include/spfft/
+ * grid_float.h:30-190, instantiated in src/spfft/grid_float.cpp) delegates. */
+
+SpfftError spfft_float_grid_create_distributed(SpfftFloatGrid* grid, int maxDimX,
+                                               int maxDimY, int maxDimZ,
+                                               int maxNumLocalZColumns,
+                                               int maxLocalZLength, int numShards,
+                                               SpfftExchangeType exchangeType,
+                                               SpfftProcessingUnitType processingUnit,
+                                               int maxNumThreads) {
+  return spfft_grid_create_distributed(grid, maxDimX, maxDimY, maxDimZ,
+                                       maxNumLocalZColumns, maxLocalZLength, numShards,
+                                       exchangeType, processingUnit, maxNumThreads);
+}
+
+SpfftError spfft_float_grid_destroy(SpfftFloatGrid grid) {
+  return spfft_grid_destroy(grid);
+}
+
+SpfftError spfft_float_grid_max_dim_x(SpfftFloatGrid grid, int* dimX) {
+  return spfft_grid_max_dim_x(grid, dimX);
+}
+SpfftError spfft_float_grid_max_dim_y(SpfftFloatGrid grid, int* dimY) {
+  return spfft_grid_max_dim_y(grid, dimY);
+}
+SpfftError spfft_float_grid_max_dim_z(SpfftFloatGrid grid, int* dimZ) {
+  return spfft_grid_max_dim_z(grid, dimZ);
+}
+SpfftError spfft_float_grid_max_num_local_z_columns(SpfftFloatGrid grid, int* out) {
+  return spfft_grid_max_num_local_z_columns(grid, out);
+}
+SpfftError spfft_float_grid_max_local_z_length(SpfftFloatGrid grid, int* out) {
+  return spfft_grid_max_local_z_length(grid, out);
+}
+SpfftError spfft_float_grid_processing_unit(SpfftFloatGrid grid,
+                                            SpfftProcessingUnitType* out) {
+  return spfft_grid_processing_unit(grid, out);
+}
+SpfftError spfft_float_grid_device_id(SpfftFloatGrid grid, int* deviceId) {
+  return spfft_grid_device_id(grid, deviceId);
+}
+SpfftError spfft_float_grid_num_threads(SpfftFloatGrid grid, int* numThreads) {
+  return spfft_grid_num_threads(grid, numThreads);
+}
+
+/* ---- MPI-surface parity stubs ---------------------------------------------
+ * No MPI exists in this runtime (the device mesh replaces the communicator,
+ * docs/api/c_api.md); these keep ported callers LINKING (reference:
+ * include/spfft/grid.h:184, transform.h:122,341) and fail with the same code
+ * a feature-less reference build reports. The comm argument is declared
+ * void* / long here and never read, so the symbols are ABI-compatible with
+ * both int-typed (MPICH) and pointer-typed (Open MPI) MPI_Comm. The
+ * *_fortran variants take the MPI_Fint the reference's Fortran module binds
+ * (reference: src/spfft/grid.cpp *_fortran entries). */
+
+SpfftError spfft_grid_communicator(SpfftGrid, SpfftMpiComm*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_grid_communicator(SpfftFloatGrid, SpfftMpiComm*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_transform_communicator(SpfftTransform, SpfftMpiComm*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_transform_communicator(SpfftFloatTransform, SpfftMpiComm*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_grid_communicator_fortran(SpfftGrid, int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_grid_communicator_fortran(SpfftFloatGrid, int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_transform_communicator_fortran(SpfftTransform, int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_transform_communicator_fortran(SpfftFloatTransform, int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+
+SpfftError spfft_transform_create_independent_distributed(
+    SpfftTransform*, int, SpfftMpiComm, SpfftExchangeType, SpfftProcessingUnitType,
+    SpfftTransformType, int, int, int, int, int, SpfftIndexFormatType, const int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_transform_create_independent_distributed(
+    SpfftFloatTransform*, int, SpfftMpiComm, SpfftExchangeType, SpfftProcessingUnitType,
+    SpfftTransformType, int, int, int, int, int, SpfftIndexFormatType, const int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_transform_create_independent_distributed_fortran(
+    SpfftTransform*, int, int, SpfftExchangeType, SpfftProcessingUnitType,
+    SpfftTransformType, int, int, int, int, int, SpfftIndexFormatType, const int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+SpfftError spfft_float_transform_create_independent_distributed_fortran(
+    SpfftFloatTransform*, int, int, SpfftExchangeType, SpfftProcessingUnitType,
+    SpfftTransformType, int, int, int, int, int, SpfftIndexFormatType, const int*) {
+  return SPFFT_MPI_SUPPORT_ERROR;
+}
+
 /* ---- transform (double) --------------------------------------------------- */
 
 SpfftError spfft_transform_create_independent(
@@ -311,9 +414,15 @@ SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_dim_y, int, dim_y)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_dim_z, int, dim_z)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_local_z_length, int, local_z_length)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_local_z_offset, int, local_z_offset)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_local_slice_size, int, local_slice_size)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_num_local_elements, int, num_local_elements)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_num_global_elements, long long int,
+                       num_global_elements)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_global_size, long long int, global_size)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_processing_unit, SpfftProcessingUnitType,
                        processing_unit)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_device_id, int, device_id)
+SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_num_threads, int, num_threads)
 SPFFT_TPU_FLOAT_GETTER(spfft_float_transform_execution_mode, SpfftExecType,
                        execution_mode)
 
@@ -378,6 +487,68 @@ SpfftError spfft_float_multi_transform_forward(
       objs.push_back(*as_float_transform(transforms[i]));
     spfft::multi_transform_forward(numTransforms, objs.data(), inputLocations, output,
                                    scalingTypes);
+  });
+}
+
+/* Pointer-based batch overloads (reference: include/spfft/multi_transform.h:60-95). */
+
+SpfftError spfft_multi_transform_backward_ptr(int numTransforms,
+                                              SpfftTransform* transforms,
+                                              const double* const* inputPointers,
+                                              double* const* outputPointers) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::Transform> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i) objs.push_back(*as_transform(transforms[i]));
+    spfft::multi_transform_backward(numTransforms, objs.data(), inputPointers,
+                                    outputPointers);
+  });
+}
+
+SpfftError spfft_multi_transform_forward_ptr(int numTransforms,
+                                             SpfftTransform* transforms,
+                                             const double* const* inputPointers,
+                                             double* const* outputPointers,
+                                             const SpfftScalingType* scalingTypes) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::Transform> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i) objs.push_back(*as_transform(transforms[i]));
+    spfft::multi_transform_forward(numTransforms, objs.data(), inputPointers,
+                                   outputPointers, scalingTypes);
+  });
+}
+
+SpfftError spfft_float_multi_transform_backward_ptr(int numTransforms,
+                                                    SpfftFloatTransform* transforms,
+                                                    const float* const* inputPointers,
+                                                    float* const* outputPointers) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::TransformFloat> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i)
+      objs.push_back(*as_float_transform(transforms[i]));
+    spfft::multi_transform_backward(numTransforms, objs.data(), inputPointers,
+                                    outputPointers);
+  });
+}
+
+SpfftError spfft_float_multi_transform_forward_ptr(int numTransforms,
+                                                   SpfftFloatTransform* transforms,
+                                                   const float* const* inputPointers,
+                                                   float* const* outputPointers,
+                                                   const SpfftScalingType* scalingTypes) {
+  if (transforms == nullptr) return SPFFT_INVALID_HANDLE_ERROR;
+  return guarded([&] {
+    std::vector<spfft::TransformFloat> objs;
+    objs.reserve(numTransforms);
+    for (int i = 0; i < numTransforms; ++i)
+      objs.push_back(*as_float_transform(transforms[i]));
+    spfft::multi_transform_forward(numTransforms, objs.data(), inputPointers,
+                                   outputPointers, scalingTypes);
   });
 }
 

@@ -578,8 +578,12 @@ void TransformFloat::set_execution_mode(SpfftExecType mode) {
 
 namespace {
 
+/* space_override[i], when non-null, replaces transform i's internal space
+ * buffer as the space-domain side (the reference's pointer-based overloads,
+ * multi_transform.hpp:64-95); byte count matches the internal buffer. */
 template <typename TransformT>
-void multi_backward_impl(int n, TransformT* transforms, const void* const* input) {
+void multi_backward_impl(int n, TransformT* transforms, const void* const* input,
+                         void* const* space_override = nullptr) {
   bridge::Gil gil;
   bridge::Ref transform_list(bridge::checked(PyList_New(n)));
   bridge::Ref inputs(bridge::checked(PyList_New(n)));
@@ -591,7 +595,8 @@ void multi_backward_impl(int n, TransformT* transforms, const void* const* input
     bridge::Ref in = bridge::view_ro(
         input[i], static_cast<std::size_t>(2 * p->num_values) * p->elem_bytes());
     PyList_SET_ITEM(inputs.get(), i, in.release());
-    bridge::Ref out = bridge::view_rw(p->space.data(), p->space.size());
+    void* space = space_override ? space_override[i] : p->space.data();
+    bridge::Ref out = bridge::view_rw(space, p->space.size());
     PyList_SET_ITEM(outputs.get(), i, out.release());
   }
   bridge::call("multi_backward", Py_BuildValue("(OOO)", transform_list.get(),
@@ -600,7 +605,8 @@ void multi_backward_impl(int n, TransformT* transforms, const void* const* input
 
 template <typename TransformT>
 void multi_forward_impl(int n, TransformT* transforms, void* const* output,
-                        const SpfftScalingType* scaling_types) {
+                        const SpfftScalingType* scaling_types,
+                        const void* const* space_override = nullptr) {
   bridge::Gil gil;
   bridge::Ref transform_list(bridge::checked(PyList_New(n)));
   bridge::Ref spaces(bridge::checked(PyList_New(n)));
@@ -610,8 +616,9 @@ void multi_forward_impl(int n, TransformT* transforms, void* const* output,
     detail::Plan* p = detail::plan_of(transforms[i]);
     Py_INCREF(p->py.get());
     PyList_SET_ITEM(transform_list.get(), i, p->py.get());
-    bridge::Ref space = bridge::view_ro(p->space.data(), p->space.size());
-    PyList_SET_ITEM(spaces.get(), i, space.release());
+    const void* space = space_override ? space_override[i] : p->space.data();
+    bridge::Ref space_view = bridge::view_ro(space, p->space.size());
+    PyList_SET_ITEM(spaces.get(), i, space_view.release());
     bridge::Ref out = bridge::view_rw(
         output[i], static_cast<std::size_t>(2 * p->num_values) * p->elem_bytes());
     PyList_SET_ITEM(outputs.get(), i, out.release());
@@ -654,6 +661,38 @@ void multi_transform_forward(int num_transforms, TransformFloat* transforms,
   multi_forward_impl(num_transforms, transforms,
                      reinterpret_cast<void* const*>(const_cast<float**>(output)),
                      scaling_types);
+}
+
+void multi_transform_backward(int num_transforms, Transform* transforms,
+                              const double* const* input, double* const* space_output) {
+  multi_backward_impl(num_transforms, transforms,
+                      reinterpret_cast<const void* const*>(input),
+                      reinterpret_cast<void* const*>(const_cast<double**>(space_output)));
+}
+
+void multi_transform_forward(int num_transforms, Transform* transforms,
+                             const double* const* space_input, double* const* output,
+                             const SpfftScalingType* scaling_types) {
+  multi_forward_impl(num_transforms, transforms,
+                     reinterpret_cast<void* const*>(const_cast<double**>(output)),
+                     scaling_types,
+                     reinterpret_cast<const void* const*>(space_input));
+}
+
+void multi_transform_backward(int num_transforms, TransformFloat* transforms,
+                              const float* const* input, float* const* space_output) {
+  multi_backward_impl(num_transforms, transforms,
+                      reinterpret_cast<const void* const*>(input),
+                      reinterpret_cast<void* const*>(const_cast<float**>(space_output)));
+}
+
+void multi_transform_forward(int num_transforms, TransformFloat* transforms,
+                             const float* const* space_input, float* const* output,
+                             const SpfftScalingType* scaling_types) {
+  multi_forward_impl(num_transforms, transforms,
+                     reinterpret_cast<void* const*>(const_cast<float**>(output)),
+                     scaling_types,
+                     reinterpret_cast<const void* const*>(space_input));
 }
 
 /* ---- DistributedTransform ------------------------------------------------- */

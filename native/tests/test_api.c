@@ -186,6 +186,129 @@ int main(void) {
     free(fback);
   }
 
+  /* ---- single precision, grid-based (reference: grid_float.h surface) ----- */
+  {
+    SpfftFloatGrid fgrid = NULL;
+    SpfftFloatTransform ft = NULL;
+    SpfftProcessingUnitType fpu;
+    float* ffreq = (float*)malloc((size_t)(2 * n) * sizeof(float));
+    float* fback = (float*)malloc((size_t)(2 * n) * sizeof(float));
+    int fgot = 0;
+    for (i = 0; i < 2 * n; ++i) ffreq[i] = (float)rng_uniform();
+    CHECK(spfft_float_grid_create(&fgrid, dim, dim, dim, dim * dim, SPFFT_PU_HOST, 1));
+    CHECK(spfft_float_grid_max_dim_x(fgrid, &fgot));
+    REQUIRE(fgot == dim);
+    CHECK(spfft_float_grid_max_dim_y(fgrid, &fgot));
+    REQUIRE(fgot == dim);
+    CHECK(spfft_float_grid_max_dim_z(fgrid, &fgot));
+    REQUIRE(fgot == dim);
+    CHECK(spfft_float_grid_max_num_local_z_columns(fgrid, &fgot));
+    REQUIRE(fgot == dim * dim);
+    CHECK(spfft_float_grid_processing_unit(fgrid, &fpu));
+    REQUIRE(fpu == SPFFT_PU_HOST);
+    CHECK(spfft_float_grid_num_threads(fgrid, &fgot));
+    REQUIRE(fgot >= 1);
+    CHECK(spfft_float_grid_device_id(fgrid, &fgot));
+    CHECK(spfft_float_transform_create(&ft, fgrid, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim,
+                                       dim, dim, dim, n, SPFFT_INDEX_TRIPLETS, indices));
+    /* Grid may be destroyed once the transform holds its capacity. */
+    CHECK(spfft_float_grid_destroy(fgrid));
+    {
+      long long fgs = 0;
+      CHECK(spfft_float_transform_local_slice_size(ft, &fgot));
+      REQUIRE(fgot == n);
+      CHECK(spfft_float_transform_num_global_elements(ft, &fgs));
+      REQUIRE(fgs == (long long)n);
+      CHECK(spfft_float_transform_global_size(ft, &fgs));
+      REQUIRE(fgs == (long long)n);
+      CHECK(spfft_float_transform_num_threads(ft, &fgot));
+      REQUIRE(fgot >= 1);
+      CHECK(spfft_float_transform_device_id(ft, &fgot));
+    }
+    CHECK(spfft_float_transform_backward(ft, ffreq, SPFFT_PU_HOST));
+    CHECK(spfft_float_transform_forward(ft, SPFFT_PU_HOST, fback, SPFFT_FULL_SCALING));
+    {
+      double max_err = 0.0;
+      for (i = 0; i < 2 * n; ++i) {
+        double d = fabs((double)fback[i] - (double)ffreq[i]);
+        if (d > max_err) max_err = d;
+      }
+      printf("float-grid roundtrip max err: %g\n", max_err);
+      REQUIRE(max_err < 1e-4);
+    }
+    /* Float pointer-based batch (reference: multi_transform_float.h:60-95). */
+    {
+      SpfftFloatTransform one[1];
+      const float* fins[1];
+      float* fspaces[1];
+      float* fouts[1];
+      SpfftScalingType fscals[1] = {SPFFT_FULL_SCALING};
+      float* fslab = (float*)malloc((size_t)(2 * n) * sizeof(float));
+      float* fout = (float*)malloc((size_t)(2 * n) * sizeof(float));
+      one[0] = ft;
+      fins[0] = ffreq;
+      fspaces[0] = fslab;
+      fouts[0] = fout;
+      CHECK(spfft_float_multi_transform_backward_ptr(1, one, fins, fspaces));
+      CHECK(spfft_float_multi_transform_forward_ptr(1, one, (const float* const*)fspaces,
+                                                    fouts, fscals));
+      {
+        double max_err = 0.0;
+        for (i = 0; i < 2 * n; ++i) {
+          double d = fabs((double)fout[i] - (double)ffreq[i]);
+          if (d > max_err) max_err = d;
+        }
+        REQUIRE(max_err < 1e-4);
+      }
+      free(fslab);
+      free(fout);
+    }
+    CHECK(spfft_float_transform_destroy(ft));
+    free(ffreq);
+    free(fback);
+  }
+
+  /* ---- pointer-based double batch (reference: multi_transform.h:60-95) ---- */
+  {
+    SpfftTransform one[1];
+    const double* ins[1];
+    double* spaces[1];
+    double* outs[1];
+    SpfftScalingType scals1[1] = {SPFFT_FULL_SCALING};
+    double* slab = (double*)malloc((size_t)(2 * n) * sizeof(double));
+    double* out = (double*)malloc((size_t)(2 * n) * sizeof(double));
+    one[0] = t;
+    ins[0] = freq;
+    spaces[0] = slab;
+    outs[0] = out;
+    CHECK(spfft_multi_transform_backward_ptr(1, one, ins, spaces));
+    CHECK(spfft_multi_transform_forward_ptr(1, one, (const double* const*)spaces, outs,
+                                            scals1));
+    {
+      double max_err = 0.0;
+      for (i = 0; i < 2 * n; ++i) {
+        double d = fabs(out[i] - freq[i]);
+        if (d > max_err) max_err = d;
+      }
+      REQUIRE(max_err < 1e-6);
+    }
+    free(slab);
+    free(out);
+  }
+
+  /* ---- MPI-surface parity stubs link and fail cleanly --------------------- */
+  {
+    void* comm = NULL;
+    SpfftTransform dt = NULL;
+    REQUIRE(spfft_grid_communicator(grid, &comm) == SPFFT_MPI_SUPPORT_ERROR);
+    REQUIRE(spfft_transform_communicator(t, &comm) == SPFFT_MPI_SUPPORT_ERROR);
+    REQUIRE(spfft_float_grid_communicator(grid, &comm) == SPFFT_MPI_SUPPORT_ERROR);
+    REQUIRE(spfft_transform_create_independent_distributed(
+                &dt, 1, NULL, SPFFT_EXCH_DEFAULT, SPFFT_PU_HOST, SPFFT_TRANS_C2C, dim,
+                dim, dim, dim, n, SPFFT_INDEX_TRIPLETS, indices) ==
+            SPFFT_MPI_SUPPORT_ERROR);
+  }
+
   /* ---- error behavior ----------------------------------------------------- */
   REQUIRE(spfft_transform_backward(NULL, freq, SPFFT_PU_HOST) ==
           SPFFT_INVALID_HANDLE_ERROR);

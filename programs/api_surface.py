@@ -58,3 +58,51 @@ def c_functions(paths=C_HEADER_PATHS) -> dict:
         for name, args in c_prototypes(path):
             out[name.lower()] = len(args)
     return out
+
+
+REFERENCE_INCLUDE = Path("/root/reference/include/spfft")
+
+
+def surface_names(include_dir: Path) -> dict:
+    """{name: arg count} across every C header (.h) in ``include_dir``."""
+    out = {}
+    for path in sorted(include_dir.glob("*.h")):
+        for name, args in c_prototypes(path):
+            out[name] = len(args)
+    return out
+
+
+def reference_only_names(reference_dir: Path = REFERENCE_INCLUDE) -> list:
+    """Reference C API names (with arity) absent from the shipped headers.
+
+    The parity contract: every reference prototype must exist here with the
+    same argument count — extensions beyond the reference are fine, holes are
+    not. Returns [] when the surface is complete (or the reference tree is
+    not present to compare against).
+    """
+    if not reference_dir.is_dir():
+        return []
+    ref = surface_names(reference_dir)
+    ours = surface_names(C_HEADER_PATHS[0].parent)
+    return sorted(
+        f"{name}/{arity}"
+        for name, arity in ref.items()
+        if name not in ours or ours[name] != arity
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    if not REFERENCE_INCLUDE.is_dir():
+        print("C API parity check SKIPPED: reference tree not present at "
+              f"{REFERENCE_INCLUDE}")
+        sys.exit(0)
+    missing = reference_only_names()
+    if missing:
+        print("reference-only C API names (name/arity):")
+        for entry in missing:
+            print(" ", entry)
+        sys.exit(1)
+    print(f"C API surface complete: {len(surface_names(REFERENCE_INCLUDE))} "
+          "reference names all present with matching arity")

@@ -20,7 +20,13 @@ import pytest
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT / "programs"))
 
-from api_surface import F90_PATH, c_functions, fortran_functions  # noqa: E402
+from api_surface import (  # noqa: E402
+    F90_PATH,
+    REFERENCE_INCLUDE,
+    c_functions,
+    fortran_functions,
+    reference_only_names,
+)
 
 
 def test_every_fortran_binding_names_a_real_c_function_with_same_arity():
@@ -48,6 +54,18 @@ def test_every_c_function_has_a_fortran_binding():
     }
     unbound = sorted(set(c) - set(fortran) - exempt)
     assert not unbound, f"C API functions with no Fortran binding: {unbound}"
+
+
+def test_no_reference_only_c_api_names():
+    """Every reference C prototype exists here with matching arity.
+
+    The reference tree (read-only, /root/reference) defines the parity bar:
+    a SIRIUS-style caller must find every name it links against, including the
+    float Grid tier and the MPI stubs (reference: include/spfft/grid_float.h,
+    transform.h:122,341, multi_transform.h:60-95)."""
+    if not REFERENCE_INCLUDE.is_dir():
+        pytest.skip("reference tree not present")
+    assert reference_only_names() == []
 
 
 def test_fortran_module_compiles_when_compiler_available():
