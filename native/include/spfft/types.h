@@ -29,9 +29,10 @@ typedef void* SpfftMpiComm;
 
 enum SpfftExchangeType {
   /* DIVERGENCE from the reference: there DEFAULT == COMPACT_BUFFERED; here it
-   * routes to BUFFERED (the fused ICI all-to-all is the fast path for balanced
-   * shard layouts). Pass COMPACT_BUFFERED explicitly for exact-counts wire
-   * behavior. */
+   * is a measured auto-policy — the runtime picks the discipline per plan
+   * from its exact wire volumes, round counts, and backend collective
+   * support (spfft_tpu/parallel/policy.py). Pass COMPACT_BUFFERED explicitly
+   * for the reference's exact-counts wire behavior. */
   SPFFT_EXCH_DEFAULT = 0,
   /* Equal-sized message blocks; the native ICI all-to-all discipline. */
   SPFFT_EXCH_BUFFERED = 1,

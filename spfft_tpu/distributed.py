@@ -97,6 +97,36 @@ class DistributedTransform:
             dtype = np.float64 if jax.config.read("jax_enable_x64") else np.float32
         self._real_dtype = np.dtype(dtype)
 
+        if ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
+            # Measured auto-policy (parallel/policy.py): pick the discipline
+            # from the plan's exact wire volumes + round counts + the
+            # backend's one-shot ragged-a2a support. The reference instead
+            # hardwires DEFAULT = COMPACT_BUFFERED
+            # (grid_internal.cpp:176-179); ported callers who want that exact
+            # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil meshes
+            # keep the padded discipline (their exchanges are block-uniform).
+            from .parallel.policy import resolve_default_exchange
+
+            p = self._params
+            picks = {
+                supported: resolve_default_exchange(
+                    p.num_sticks_per_shard,
+                    p.local_z_lengths,
+                    one_shot_supported=supported,
+                    wire_scalar_bytes=self._real_dtype.itemsize,
+                )
+                for supported in (False, True)
+            }
+            if picks[False] == picks[True] or p.num_shards <= 1:
+                exchange_type = picks[False]
+            else:
+                # Only when the answer depends on it: probe whether the
+                # backend compiles the one-shot ragged-all-to-all (compile-
+                # only, cached per platform/mesh-size — parallel/ragged.py).
+                from .parallel.ragged import _ragged_a2a_supported
+
+                exchange_type = picks[_ragged_a2a_supported(mesh)]
+
         from .ops.fft import resolve_precision
 
         resolve_precision(precision)  # validate up front on every engine path

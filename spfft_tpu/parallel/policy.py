@@ -1,0 +1,130 @@
+"""Measured auto-policy for ``ExchangeType.DEFAULT``.
+
+The reference hardwires DEFAULT to COMPACT_BUFFERED (reference:
+src/spfft/grid_internal.cpp:176-179) — a folklore pick. This build already
+computes the exact wire volume and round count of every discipline from plan
+geometry (``exchange_wire_bytes`` / ``exchange_rounds``), so DEFAULT resolves
+them through a cost model instead:
+
+    cost(d) = wire_bytes(d) + rounds(d) * round_cost_bytes
+
+``round_cost_bytes`` is the latency of one sequential collective round
+expressed in byte-equivalents (latency x bandwidth). The default, 128 KiB,
+comes from ICI-class numbers (~1-2 us/round at ~100 GB/s); override with
+``SPFFT_TPU_EXCH_ROUND_COST_KB``. Grounding against the measured CPU-mesh
+tables (BASELINE.md "Exchange-discipline comparison"): the model picks
+BUFFERED for every balanced row (where COMPACT ties its bytes and loses P-1
+rounds) and UNBUFFERED for the imbalanced rows on backends with the one-shot
+ragged-all-to-all (exact bytes, 1 round — the TPU transport); COMPACT wins
+only when both stick and plane distributions are uneven enough that its
+per-step maxima undercut the padded blocks by more than the chain's round
+cost. Decision-grade ICI wall-clock needs pod hardware (VERDICT r3 item 5);
+until then the constant is the documented, overridable part of the policy.
+
+Explicit disciplines are never overridden — the policy runs only for DEFAULT.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..types import ExchangeType
+
+ROUND_COST_ENV = "SPFFT_TPU_EXCH_ROUND_COST_KB"
+
+
+def discipline_volumes(num_sticks_per_shard, local_z_lengths):
+    """Exchange-A complex-element volumes per repartition, self-blocks excluded.
+
+    Returns ``{BUFFERED, COMPACT_BUFFERED, UNBUFFERED: off-wire elems}`` from
+    plan geometry alone (matches the engines' accounting:
+    PaddingHelpers.exchange_wire_bytes, parallel/ragged.py offwire_elems):
+
+    - BUFFERED: P(P-1) uniform S_max x L_max padded blocks.
+    - COMPACT: the ppermute chain's per-step uniform buffers, each sized
+      ``max_i sticks_i * planes_{(i+k) mod P}`` (true Alltoallv blocks ride a
+      rotation chain whose step buffer is the step's largest block).
+    - UNBUFFERED: the exact Alltoallw volume ``sum_{i != j} sticks_i * planes_j``.
+    """
+    from .ragged import _chain_step_sizes
+
+    s = np.asarray(num_sticks_per_shard, dtype=np.int64)
+    l = np.asarray(local_z_lengths, dtype=np.int64)
+    P = int(s.size)
+    if P <= 1:
+        return {
+            ExchangeType.BUFFERED: 0,
+            ExchangeType.COMPACT_BUFFERED: 0,
+            ExchangeType.UNBUFFERED: 0,
+        }
+    buffered = P * (P - 1) * int(s.max()) * int(max(1, l.max()))
+    exact_total = int(s.sum()) * int(l.sum()) - int((s * l).sum())
+    # Per-step maxima from the engines' own chain rule (single source so the
+    # cost model cannot diverge from what actually rides the wire).
+    b_bwd, _ = _chain_step_sizes(s, l)
+    compact = P * sum(b_bwd[1:])
+    return {
+        ExchangeType.BUFFERED: buffered,
+        ExchangeType.COMPACT_BUFFERED: compact,
+        ExchangeType.UNBUFFERED: exact_total,
+    }
+
+
+def round_cost_bytes() -> int:
+    """Per-round latency in byte-equivalents (see module docstring)."""
+    return int(os.environ.get(ROUND_COST_ENV, "128")) << 10
+
+
+def resolve_default_exchange(
+    num_sticks_per_shard,
+    local_z_lengths,
+    *,
+    one_shot_supported: bool,
+    wire_scalar_bytes: int = 4,
+) -> ExchangeType:
+    """Pick the discipline for ``ExchangeType.DEFAULT`` from plan geometry.
+
+    ``one_shot_supported``: whether the backend compiles the one-shot
+    ragged-all-to-all (parallel/ragged.py:_ragged_a2a_supported); without it
+    UNBUFFERED's transport degrades to the P-1-round chain and is costed as
+    such. ``wire_scalar_bytes``: bytes per real scalar on the wire (4 for f32,
+    8 for f64, 2 for the *_FLOAT half-wire variants' bf16).
+    """
+    s = np.asarray(num_sticks_per_shard)
+    P = int(s.size)
+    if P <= 1:
+        return ExchangeType.BUFFERED
+    vols = discipline_volumes(num_sticks_per_shard, local_z_lengths)
+    per_round = round_cost_bytes()
+    rounds = {
+        ExchangeType.BUFFERED: 1,
+        ExchangeType.COMPACT_BUFFERED: P - 1,
+        ExchangeType.UNBUFFERED: 1 if one_shot_supported else P - 1,
+    }
+    if not one_shot_supported:
+        # The chain transport ships per-step-maxima buffers, not the exact
+        # Alltoallw volume — cost what actually rides the wire (ragged.py
+        # OneShotExchange falls back to the same _chain_step_sizes rule).
+        vols[ExchangeType.UNBUFFERED] = vols[ExchangeType.COMPACT_BUFFERED]
+    costs = {
+        d: vols[d] * 2 * wire_scalar_bytes + rounds[d] * per_round
+        for d in vols
+    }
+    # Deterministic tie-break: the fused single collective is the ICI-native
+    # shape; then the one-shot exact exchange — unless its transport would be
+    # the chain anyway, where COMPACT is the honest name for the same wire
+    # behavior.
+    if one_shot_supported:
+        order = (
+            ExchangeType.BUFFERED,
+            ExchangeType.UNBUFFERED,
+            ExchangeType.COMPACT_BUFFERED,
+        )
+    else:
+        order = (
+            ExchangeType.BUFFERED,
+            ExchangeType.COMPACT_BUFFERED,
+            ExchangeType.UNBUFFERED,
+        )
+    return min(order, key=lambda d: costs[d])

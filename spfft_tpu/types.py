@@ -36,11 +36,14 @@ class ExchangeType(enum.IntEnum):
 
     DIVERGENCE from the reference: the reference documents SPFFT_EXCH_DEFAULT as
     equivalent to COMPACT_BUFFERED (reference: include/spfft/types.h:34-39); here
-    DEFAULT routes to the padded BUFFERED discipline, because on ICI the single
-    fused all_to_all is the fast path for the balanced shard layouts
-    ``distribute_triplets`` produces. Ported code that relied on DEFAULT's
-    exact-counts wire volume should pass COMPACT_BUFFERED explicitly (see
-    docs/MIGRATION.md).
+    DEFAULT is a measured auto-policy (parallel/policy.py): the discipline is
+    picked per plan by a cost model over the plan's exact wire volumes, round
+    counts, and the backend's one-shot ragged-a2a support — BUFFERED for
+    balanced layouts (the single fused all_to_all is the ICI-native shape),
+    UNBUFFERED when padding waste exceeds the round cost and the one-shot
+    transport compiles, COMPACT where its per-step maxima undercut both.
+    Ported code that relied on DEFAULT's exact-counts wire volume should pass
+    COMPACT_BUFFERED explicitly (see docs/MIGRATION.md).
 
     The ``*_BF16`` variants are a TPU-native extension beyond the reference enum
     (which ends at UNBUFFERED): the wire payload is cast to bfloat16 around the

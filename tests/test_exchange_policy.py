@@ -1,0 +1,163 @@
+"""ExchangeType.DEFAULT auto-policy (parallel/policy.py).
+
+The reference hardwires DEFAULT to COMPACT_BUFFERED
+(reference: src/spfft/grid_internal.cpp:176-179); here DEFAULT resolves by a
+cost model over the plan's exact wire volumes, round counts, and backend
+collective support. These tests pin the policy's decisions on the measured
+geometry classes of BASELINE.md's discipline tables, verify its volume
+accounting agrees with the engines', and check end-to-end resolution through
+DistributedTransform.
+"""
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu.parallel.policy import (
+    discipline_volumes,
+    resolve_default_exchange,
+)
+from spfft_tpu.types import ExchangeType
+from utils import random_sparse_triplets
+
+
+def test_balanced_plan_picks_buffered():
+    # Balanced sticks and planes: COMPACT/UNBUFFERED tie or barely undercut
+    # the padded volume, so the single fused all_to_all wins on rounds
+    # (BASELINE.md: balanced rows at P in {8, 16, 32}).
+    n = [40, 40, 40, 40]
+    l = [8, 8, 8, 8]
+    assert (
+        resolve_default_exchange(n, l, one_shot_supported=True)
+        == ExchangeType.BUFFERED
+    )
+    assert (
+        resolve_default_exchange(n, l, one_shot_supported=False)
+        == ExchangeType.BUFFERED
+    )
+
+
+def test_single_shard_picks_buffered():
+    assert (
+        resolve_default_exchange([100], [32], one_shot_supported=True)
+        == ExchangeType.BUFFERED
+    )
+
+
+def test_imbalanced_plan_with_one_shot_picks_unbuffered():
+    # Strong stick imbalance at a payload size where the saved bytes dwarf
+    # one round's cost: the exact one-shot exchange wins (the imbalanced rows
+    # of BASELINE.md's table, on the TPU transport).
+    n = [4000, 8000, 4000, 8000]
+    l = [64, 64, 64, 64]
+    vols = discipline_volumes(n, l)
+    assert vols[ExchangeType.UNBUFFERED] < vols[ExchangeType.BUFFERED]
+    assert (
+        resolve_default_exchange(n, l, one_shot_supported=True)
+        == ExchangeType.UNBUFFERED
+    )
+
+
+def test_imbalanced_plan_without_one_shot_weighs_rounds():
+    # Without the one-shot transport, exact-bytes disciplines ride the chain,
+    # which ships per-step MAXIMA — for one-sided stick imbalance those tie
+    # the padded volume, so BUFFERED wins on rounds at any payload size.
+    n_one_sided = [4000, 8000, 4000, 8000]
+    l_uniform = [64, 64, 64, 64]
+    assert (
+        resolve_default_exchange(n_one_sided, l_uniform, one_shot_supported=False)
+        == ExchangeType.BUFFERED
+    )
+    # Two-sided (anticorrelated) imbalance with a big payload: the chain's
+    # per-step maxima genuinely undercut the padded blocks by more than the
+    # P-1 round cost; COMPACT is the honest name for the chain discipline.
+    n_two_sided = [8000, 1000, 8000, 1000]
+    l_two_sided = [16, 128, 16, 128]
+    assert (
+        resolve_default_exchange(n_two_sided, l_two_sided, one_shot_supported=False)
+        == ExchangeType.COMPACT_BUFFERED
+    )
+    # Tiny payload: rounds dominate any byte saving.
+    n_small = [4, 8, 4, 8]
+    l_small = [2, 2, 2, 2]
+    assert (
+        resolve_default_exchange(n_small, l_small, one_shot_supported=False)
+        == ExchangeType.BUFFERED
+    )
+
+
+def test_two_sided_imbalance_compact_undercuts_padded():
+    # Anticorrelated stick/plane imbalance: COMPACT's per-step maxima sit
+    # strictly between UNBUFFERED's exact volume and BUFFERED's padded one.
+    n = [8000, 1000, 8000, 1000]
+    l = [16, 128, 16, 128]
+    vols = discipline_volumes(n, l)
+    assert (
+        vols[ExchangeType.UNBUFFERED]
+        < vols[ExchangeType.COMPACT_BUFFERED]
+        < vols[ExchangeType.BUFFERED]
+    )
+
+
+def test_round_cost_env_override(monkeypatch):
+    # A huge per-round cost forces the single-round disciplines.
+    n = [4000, 8000, 4000, 8000]
+    l = [64, 64, 64, 64]
+    monkeypatch.setenv("SPFFT_TPU_EXCH_ROUND_COST_KB", str(1 << 30))
+    assert (
+        resolve_default_exchange(n, l, one_shot_supported=False)
+        == ExchangeType.BUFFERED
+    )
+    assert (
+        resolve_default_exchange(n, l, one_shot_supported=True)
+        == ExchangeType.UNBUFFERED
+    )
+
+
+@pytest.mark.parametrize("discipline", [
+    ExchangeType.BUFFERED,
+    ExchangeType.COMPACT_BUFFERED,
+    ExchangeType.UNBUFFERED,
+])
+def test_volumes_match_engine_accounting(discipline):
+    """discipline_volumes agrees with the engines' exchange_wire_bytes."""
+    from spfft_tpu.parallel.mesh import make_fft_mesh
+
+    rng = np.random.default_rng(3)
+    dims = (12, 10, 16)
+    trip = random_sparse_triplets(rng, *dims, 0.4)
+    mesh = make_fft_mesh(4)
+    t = sp.DistributedTransform(
+        sp.ProcessingUnit.HOST, sp.TransformType.C2C, *dims,
+        trip, mesh=mesh, exchange_type=discipline, dtype=np.float32,
+    )
+    p = t._params
+    vols = discipline_volumes(p.num_sticks_per_shard, p.local_z_lengths)
+    assert t.exchange_wire_bytes() == vols[discipline] * 2 * 4
+
+
+def test_default_resolves_to_concrete_discipline():
+    from spfft_tpu.parallel.mesh import make_fft_mesh
+
+    rng = np.random.default_rng(5)
+    dims = (12, 10, 16)
+    trip = random_sparse_triplets(rng, *dims, 0.4)
+    mesh = make_fft_mesh(4)
+    t = sp.DistributedTransform(
+        sp.ProcessingUnit.HOST, sp.TransformType.C2C, *dims,
+        trip, mesh=mesh, dtype=np.float32,
+    )
+    assert t.exchange_type != ExchangeType.DEFAULT
+    # balanced distribute_triplets layout -> the fused padded collective
+    assert t.exchange_type == ExchangeType.BUFFERED
+    # and the resolved plan still round-trips
+    v = (
+        rng.standard_normal(t.num_global_elements)
+        + 1j * rng.standard_normal(t.num_global_elements)
+    ).astype(np.complex64)
+    per = np.split(v, np.cumsum(
+        [t.num_local_elements(r) for r in range(4)])[:-1])
+    space = t.backward(per)
+    out = t.forward(space, scaling=sp.ScalingType.FULL)
+    np.testing.assert_allclose(
+        np.concatenate(out), v, rtol=0, atol=2e-5
+    )
