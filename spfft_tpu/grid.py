@@ -16,17 +16,30 @@ from .errors import InvalidParameterError, OverflowError_
 from .types import ExchangeType, ProcessingUnit
 
 
-def device_for_processing_unit(processing_unit: ProcessingUnit):
-    """Resolve a ProcessingUnit to a JAX device.
+def device_for_processing_unit(processing_unit: ProcessingUnit, device=None):
+    """Resolve a ProcessingUnit (and optional explicit device) to a JAX device.
 
-    HOST always maps to a CPU device — resolved WITHOUT initializing non-CPU
-    backends (parity with the reference, whose SPFFT_PU_HOST paths never touch
-    an accelerator runtime; see spfft_tpu/_platform.py). GPU (the accelerator
-    slot — TPU in this build) maps to the default backend's first device,
-    falling back to CPU when no accelerator is attached (so tests run
-    anywhere).
+    Per-object binding parity with the reference, which pins each Grid /
+    Transform to the device current at creation (reference:
+    src/spfft/grid_internal.cpp:82, docs/source/details.rst:104-106):
+
+    - ``device`` explicitly given: used as-is (the ``device=`` ctor kwarg).
+    - ``jax.default_device`` set to a device of the matching class (CPU for
+      HOST, non-CPU for GPU): that device — the JAX analogue of "the device
+      current at creation".
+    - otherwise HOST maps to a CPU device, resolved WITHOUT initializing
+      non-CPU backends (parity with the reference, whose SPFFT_PU_HOST paths
+      never touch an accelerator runtime; see spfft_tpu/_platform.py), and GPU
+      (the accelerator slot — TPU in this build) maps to the default backend's
+      first device, falling back to CPU when no accelerator is attached.
     """
     pu = ProcessingUnit(processing_unit)
+    if device is not None:
+        return device
+    default = jax.config.jax_default_device
+    if default is not None and hasattr(default, "platform"):
+        if (default.platform == "cpu") == (pu == ProcessingUnit.HOST):
+            return default
     if pu == ProcessingUnit.HOST:
         from ._platform import cpu_device
 
@@ -53,6 +66,7 @@ class Grid:
         max_local_z_length: int | None = None,
         mesh=None,
         exchange_type: ExchangeType = ExchangeType.DEFAULT,
+        device=None,
     ):
         if min(max_dim_x, max_dim_y, max_dim_z) < 1:
             raise InvalidParameterError("grid dimensions must be positive")
@@ -71,7 +85,7 @@ class Grid:
         self._max_num_threads = max_num_threads
         self._mesh = mesh
         self._exchange_type = ExchangeType(exchange_type)
-        self._device = device_for_processing_unit(self._processing_unit)
+        self._device = device_for_processing_unit(self._processing_unit, device)
 
     # -- accessors, parity with include/spfft/grid.hpp:147-199 --
     @property
@@ -136,6 +150,7 @@ class Grid:
         dtype=None,
         engine: str = "auto",
         precision: str = "highest",
+        device=None,
     ):
         """Create a transform bound to this grid.
 
@@ -145,6 +160,11 @@ class Grid:
         Grid ctor, include/spfft/grid.hpp:89-91).
         """
         if self._mesh is not None:
+            if device is not None:
+                raise InvalidParameterError(
+                    "device= applies to local transforms only; distributed "
+                    "plans are placed by the grid's mesh"
+                )
             from .distributed import DistributedTransform
 
             return DistributedTransform(
@@ -177,4 +197,5 @@ class Grid:
             dtype=dtype,
             engine=engine,
             precision=precision,
+            device=device,
         )

@@ -49,6 +49,7 @@ class Transform:
         dtype=None,
         engine: str = "auto",
         precision: str = "highest",
+        device=None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -118,7 +119,19 @@ class Transform:
 
         resolve_precision(precision)  # validate up front on every engine path
 
-        device = device_for_processing_unit(self._processing_unit)
+        # Per-object device binding (reference: each Grid/Transform pins the
+        # device current at creation, grid_internal.cpp:82): explicit device=
+        # wins, then the grid's bound device, then jax.default_device / the
+        # PU's default. put() commits inputs there, so the jitted pipelines
+        # compile for and execute on that device.
+        if device is None and grid is not None:
+            gdev = grid.device
+            if (gdev.platform == "cpu") == (
+                self._processing_unit == ProcessingUnit.HOST
+            ):
+                device = gdev
+        device = device_for_processing_unit(self._processing_unit, device)
+        self._device = device
         # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
         # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
         # execution.py) wins on CPU where pocketfft is the fast path.
@@ -334,6 +347,7 @@ class Transform:
             dtype=self._real_dtype,
             engine=self._engine,
             precision=self._precision,
+            device=self._device,
         )
 
     # ---- accessors, parity with include/spfft/transform.hpp:147-245 -----------
@@ -381,6 +395,12 @@ class Transform:
     @property
     def processing_unit(self) -> ProcessingUnit:
         return self._processing_unit
+
+    @property
+    def device(self):
+        """The JAX device this plan is bound to (reference: the CUDA device
+        current at creation, grid_internal.cpp:82)."""
+        return self._device
 
     @property
     def device_id(self) -> int:
