@@ -60,6 +60,15 @@ class DistributedTransform:
 
         if isinstance(indices, (list, tuple)):
             indices_per_shard = [np.asarray(t).reshape(-1, 3) for t in indices]
+        elif pencil2:
+            # Column-local stick placement (x-groups whole per shard-column)
+            # makes the pencil engines' exchange A column-diagonal — see
+            # distribute_triplets(layout=...).
+            ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+            indices_per_shard = distribute_triplets(
+                np.asarray(indices), num_shards, int(dim_y),
+                layout=(int(ax["fft"]), int(ax["fft2"])), dim_x=int(dim_x),
+            )
         else:
             indices_per_shard = distribute_triplets(
                 np.asarray(indices), num_shards, int(dim_y)
@@ -103,8 +112,10 @@ class DistributedTransform:
             # backend's one-shot ragged-a2a support. The reference instead
             # hardwires DEFAULT = COMPACT_BUFFERED
             # (grid_internal.cpp:176-179); ported callers who want that exact
-            # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil meshes
-            # keep the padded discipline (their exchanges are block-uniform).
+            # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil plans
+            # resolve DEFAULT inside the engine (pencil2.py
+            # _resolve_pencil2_default — the x-group strategy and the
+            # discipline are chosen together there).
             from .parallel.policy import resolve_default_exchange
 
             p = self._params

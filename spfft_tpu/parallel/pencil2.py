@@ -7,8 +7,10 @@ a 2-D ``("fft", "fft2")`` mesh instead:
 
 * frequency domain: z-sticks sharded over ALL P1*P2 shards (whole-stick
   constraint unchanged),
-* intermediate domain: y-pencils — shard (a, b) owns x-group a (a contiguous
-  chunk of the active-x list) and z-planes b, with the full y extent,
+* intermediate domain: y-pencils — shard (a, b) owns x-group a (a subset of
+  the active-x list chosen per plan: round-robin for the padded discipline,
+  ownership-aligned for the exact-counts ones — see _x_group_assignment) and
+  z-planes b, with the full y extent,
 * space domain: 2-D slabs — shard (a, b) owns z-planes b and y-rows a, full x.
 
 Backward pipeline: z-FFT (stick-local) -> exchange A (ONE all_to_all over both
@@ -22,8 +24,9 @@ FFT frameworks (AccFFT / mpi4py-fft lineage), adapted to sparse z-stick input
 Wire discipline is padded-uniform (BUFFERED) on both exchanges; ``*_FLOAT`` /
 ``*_BF16`` wire casts apply around each collective. R2C works because both
 hermitian completions stay shard-local: the (0,0) stick fill happens on its
-owner before exchange A (as in 1-D), and the x=0 plane fill happens on the
-x-group-0 column after exchange A, where that shard holds the FULL y extent
+owner before exchange A (as in 1-D), and the x=0 plane fill happens after
+exchange A on whichever (group, slot) holds x=0 under the plan's assignment,
+where that shard holds the FULL y extent
 (reference: src/symmetry/symmetry_host.hpp:40-97). XLA/jnp.fft compute path.
 """
 from __future__ import annotations
@@ -56,6 +59,109 @@ def _ceil_split(n: int, parts: int) -> np.ndarray:
     return np.asarray([base + (1 if i < extra else 0) for i in range(parts)])
 
 
+def _x_group_assignment(ux, sx_all, valid, P1, P2, aligned):
+    """Assign active-x values to the P1 x-groups; returns
+    ``(group_of_ux, slot_of_ux, Ax)`` over the sorted active list ``ux``.
+
+    Two strategies (the x-group map is arbitrary — the slot->x reassembly
+    table handles any assignment):
+
+    - balanced (``aligned=False``): round-robin over the active-x list.
+      Equalizes per-(shard, group) stick counts even when stick ownership is
+      x-contiguous (distribute_triplets), which is what the PADDED exchange-A
+      blocks (uniform SG x Lz) need — measured at 256^3/15% x-slab, round-robin
+      halves SG vs the earlier contiguous equal-width split.
+    - ownership-aligned (``aligned=True``): each x goes to the group of the
+      shard-COLUMN (a = s // P2) owning most of its sticks. When stick
+      ownership is x-contiguous this makes exchange A near-column-diagonal:
+      only the z-chunk redistribution inside each column crosses the wire
+      ((P2-1)/P2 of stick data instead of (P-1)/P), and the EXACT-counts
+      disciplines (whose off-column blocks then ship ~0 bytes) collect the
+      saving. The padded discipline cannot (its blocks stay SG x Lz uniform),
+      so callers pick the strategy via the discipline (see __init__).
+    """
+    ux = np.asarray(ux, dtype=np.int64)
+    if not aligned:
+        group = np.arange(ux.size) % P1
+        slot = np.arange(ux.size) // P1
+        return group, slot, max(1, -(-ux.size // P1))
+    col_weight = np.zeros((ux.size, P1), dtype=np.int64)
+    col_of_shard = np.arange(sx_all.shape[0]) // P2
+    colmat = np.broadcast_to(col_of_shard[:, None], sx_all.shape)
+    xi = np.searchsorted(ux, sx_all[valid])
+    np.add.at(col_weight, (xi, colmat[valid]), 1)
+    group = np.argmax(col_weight, axis=1)
+    slot = np.zeros(ux.size, dtype=np.int64)
+    fill = np.zeros(P1, dtype=np.int64)
+    for i in range(ux.size):
+        slot[i] = fill[group[i]]
+        fill[group[i]] += 1
+    return group, slot, max(1, int(fill.max()))
+
+
+def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
+                             wire_scalar_bytes):
+    """ExchangeType.DEFAULT resolution for 2-D pencil plans.
+
+    Same cost model as the 1-D engines (parallel/policy.py:
+    ``cost = bytes + rounds * round_cost``), evaluated over this engine's two
+    exchanges with each discipline's own x-group strategy: the padded
+    discipline with the balanced assignment, the exact-counts disciplines
+    with the ownership-aligned one (see _x_group_assignment). The backend's
+    one-shot ragged-a2a support is probed only when the answer depends on it.
+    """
+    from .policy import round_cost_bytes
+    from ..types import ExchangeType as ET
+
+    Pn = P1 * P2
+    d = np.arange(Pn)
+    a_of, b_of = d // P2, d % P2
+    per_round = round_cost_bytes()
+    s_idx = np.arange(Pn)
+    q_idx = np.arange(P1)
+
+    def volumes(aligned):
+        _, _, ax, counts = assign[aligned]
+        blocks_a = counts[:, a_of] * lz[b_of][None, :]  # (P, P) elems s -> d
+        a_pad = Pn * (Pn - 1) * max(1, int(counts.max())) * Lz
+        a_exact = int(blocks_a.sum() - np.diag(blocks_a).sum())
+        a_chain = Pn * sum(
+            max(1, int(blocks_a[s_idx, (s_idx + k) % Pn].max()))
+            for k in range(1, Pn)
+        )
+        blocks_b = np.broadcast_to(Lz * ly * ax, (P1, P1))  # (q, q') elems
+        b_pad = Pn * (P1 - 1) * Lz * Ly * ax
+        b_exact = P2 * int(blocks_b.sum() - np.diag(blocks_b).sum())
+        b_chain = P2 * P1 * sum(
+            max(1, int(blocks_b[q_idx, (q_idx + k) % P1].max()))
+            for k in range(1, P1)
+        )
+        return (a_pad, a_exact, a_chain), (b_pad, b_exact, b_chain)
+
+    (a_pad, _, _), (b_pad, _, _) = volumes(False)
+    (_, a_exact, a_chain), (_, b_exact, b_chain) = volumes(True)
+
+    def cost(vol, rounds):
+        return vol * 2 * wire_scalar_bytes + rounds * per_round
+
+    c_buffered = cost(a_pad + b_pad, 2)
+    c_oneshot = cost(a_exact + b_exact, 2)
+    c_chain = cost(a_chain + b_chain, (Pn - 1) + (P1 - 1))
+
+    def pick(one_shot_supported):
+        cands = [(c_buffered, 0, ET.BUFFERED)]
+        if one_shot_supported:
+            cands.append((c_oneshot, 1, ET.UNBUFFERED))
+        cands.append((c_chain, 2, ET.COMPACT_BUFFERED))
+        return min(cands)[2]
+
+    if pick(False) == pick(True) or Pn <= 1:
+        return pick(False)
+    from .ragged import _ragged_a2a_supported
+
+    return pick(_ragged_a2a_supported(mesh))
+
+
 class Pencil2Execution(PaddingHelpers):
     """Compiled 2-D-pencil distributed pipelines for one plan (C2C or R2C)."""
 
@@ -85,27 +191,73 @@ class Pencil2Execution(PaddingHelpers):
         ux = np.unique(sx_all[valid])
         if ux.size == 0:
             ux = np.zeros(1, dtype=np.int64)
-        # x-groups: contiguous chunks of the active-x list, uniform padded width
-        Ax = -(-ux.size // P1)
-        group_of_x = np.full(Xf, P1, dtype=np.int64)  # sentinel P1
-        slot_of_x = np.zeros(Xf, dtype=np.int64)
-        group_of_x[ux] = np.arange(ux.size) // Ax
-        slot_of_x[ux] = np.arange(ux.size) % Ax
         # z-slabs over AX2, y-slabs over AX1
         lz = _ceil_split(Z, P2)
         ly = _ceil_split(Y, P1)
         zo = np.concatenate([[0], np.cumsum(lz)[:-1]])
         yo = np.concatenate([[0], np.cumsum(ly)[:-1]])
         Lz, Ly = max(1, int(lz.max())), max(1, int(ly.max()))
-        self._Ax, self._Lz, self._Ly = int(Ax), Lz, Ly
+        self._Lz, self._Ly = Lz, Ly
         self._lz, self._zo, self._ly, self._yo = lz, zo, ly, yo
 
-        # per (shard, x-group): that shard's stick rows, j-ordered by row index
+        # ---- x-group assignment + DEFAULT resolution ---------------------------
+        # The padded discipline needs balanced per-(shard, group) stick counts;
+        # the exact-counts disciplines profit from ownership-aligned groups
+        # (near-column-diagonal exchange A) — see _x_group_assignment. DEFAULT
+        # picks the discipline (and with it the strategy) by the same cost
+        # model as the 1-D engines (parallel/policy.py).
         Pn = p.num_shards
-        counts = np.zeros((Pn, P1), dtype=np.int64)
-        for s in range(Pn):
-            for r in np.flatnonzero(valid[s]):
-                counts[s, group_of_x[sx_all[s, r]]] += 1
+
+        def group_counts(group_of_ux):
+            g_of_x = np.full(Xf, P1, dtype=np.int64)
+            g_of_x[ux] = group_of_ux
+            counts = np.zeros((Pn, P1), dtype=np.int64)
+            for s in range(Pn):
+                gs = g_of_x[sx_all[s, valid[s]]]
+                np.add.at(counts, (s, gs), 1)
+            return counts
+
+        assign = {}
+
+        def get_assign(aligned):
+            if aligned not in assign:
+                g, slot, ax = _x_group_assignment(
+                    ux, sx_all, valid, P1, P2, aligned
+                )
+                assign[aligned] = (g, slot, ax, group_counts(g))
+            return assign[aligned]
+
+        def exact_volume(aligned):
+            """Exact-counts A+B element volume under an assignment — the
+            quantity the ragged disciplines actually ship."""
+            _, _, ax, counts = get_assign(aligned)
+            d = np.arange(Pn)
+            blocks_a = counts[:, d // P2] * lz[d % P2][None, :]
+            a_ex = int(blocks_a.sum() - np.diag(blocks_a).sum())
+            b_ex = P2 * (P1 - 1) * int(Lz * ly.sum() * ax)
+            return a_ex + b_ex
+
+        if self.exchange_type == ExchangeType.DEFAULT:
+            get_assign(False), get_assign(True)
+            self.exchange_type = _resolve_pencil2_default(
+                assign, lz, ly, Lz, Ly, P1, P2, mesh,
+                wire_scalar_bytes=self.real_dtype.itemsize,
+            )
+
+        if self.exchange_type in _RAGGED:
+            # The aligned strategy only helps when stick placement is
+            # column-local (distribute_triplets layout=...); user-supplied or
+            # greedy placements can make it strictly worse (bigger Ax, no
+            # diagonal A) — pick whichever assignment ships fewer bytes.
+            aligned = exact_volume(True) < exact_volume(False)
+        else:
+            aligned = False
+        group_of_ux, slot_of_ux, Ax, counts = get_assign(aligned)
+        group_of_x = np.full(Xf, P1, dtype=np.int64)  # sentinel P1
+        slot_of_x = np.zeros(Xf, dtype=np.int64)
+        group_of_x[ux] = group_of_ux
+        slot_of_x[ux] = slot_of_ux
+        self._Ax = int(Ax)
         SG = max(1, int(counts.max()))
         self._SG = SG
         rows = np.full((Pn, P1, SG), S, dtype=np.int32)        # local stick row
@@ -123,8 +275,11 @@ class Pencil2Execution(PaddingHelpers):
         xcol = np.full(P1 * Ax, Xf, dtype=np.int64)
         xcol[group_of_x[ux] * Ax + slot_of_x[ux]] = ux
         self._xcol = xcol.astype(np.int32)
-        # R2C symmetry sites: x == 0 (if present) is group 0, slot 0 (ux sorted)
+        # R2C symmetry site: the x == 0 plane's (group, slot) under the active
+        # assignment (any strategy may place it anywhere)
         self._have_x0 = bool((ux == 0).any())
+        self._x0_group = int(group_of_x[0]) if self._have_x0 else 0
+        self._x0_slot = int(slot_of_x[0]) if self._have_x0 else 0
         # y chunk maps: global y of (group q, row l) with sentinel Y, and inverse
         ymap = np.full((P1, Ly), Y, dtype=np.int64)
         for a in range(P1):
@@ -393,10 +548,13 @@ class Pencil2Execution(PaddingHelpers):
         grid = g[: Lz * Y * Ax].reshape(Lz, Y, Ax)
 
         if self.is_r2c and self._have_x0:
-            # x == 0 plane hermitian fill along y: group 0, slot 0 holds it,
-            # and that shard has the FULL y extent here (z is space-domain)
-            col = symmetry.hermitian_fill_1d(grid[:, :, 0], axis=1)
-            grid = grid.at[:, :, 0].set(jnp.where(a_me == 0, col, grid[:, :, 0]))
+            # x == 0 plane hermitian fill along y on its (group, slot) owner,
+            # which has the FULL y extent here (z is space-domain)
+            g0, s0 = self._x0_group, self._x0_slot
+            col = symmetry.hermitian_fill_1d(grid[:, :, s0], axis=1)
+            grid = grid.at[:, :, s0].set(
+                jnp.where(a_me == g0, col, grid[:, :, s0])
+            )
 
         grid = jnp.fft.ifft(grid, axis=1)
 

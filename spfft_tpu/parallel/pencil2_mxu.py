@@ -124,11 +124,12 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
+                g0, s0 = self._x0_group, self._x0_slot
                 pre, pim = symmetry.hermitian_fill_1d_pair(
-                    gre[:, :, 0], gim[:, :, 0], axis=1
+                    gre[:, :, s0], gim[:, :, s0], axis=1
                 )
-                gre = gre.at[:, :, 0].set(jnp.where(a_me == 0, pre, gre[:, :, 0]))
-                gim = gim.at[:, :, 0].set(jnp.where(a_me == 0, pim, gim[:, :, 0]))
+                gre = gre.at[:, :, s0].set(jnp.where(a_me == g0, pre, gre[:, :, s0]))
+                gim = gim.at[:, :, s0].set(jnp.where(a_me == g0, pim, gim[:, :, s0]))
 
         with jax.named_scope("y transform"):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)

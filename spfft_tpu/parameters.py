@@ -235,17 +235,73 @@ def distribute_triplets(
     num_shards: int,
     dim_y: int,
     weights: Sequence[float] | None = None,
+    *,
+    layout: tuple[int, int] | None = None,
+    dim_x: int | None = None,
 ) -> list[np.ndarray]:
     """Partition global triplets into per-shard lists, keeping z-sticks whole
     (the hard constraint, reference: docs/source/details.rst:50-53) and balancing
     value counts across shards (optionally by weight, mirroring the reference tests'
     ``zStickDistribution`` weight vectors, tests/test_util/generate_indices.hpp:39-100).
+
+    ``layout=(P1, P2)`` requests an x-column-local split for a 2-D pencil mesh
+    (``dim_x`` required, for centered-index folding): the x-sorted stick list
+    is cut into P1 contiguous column groups balanced by value counts, then each
+    group is split over its column's P2 shards (shard = a*P2 + b). Every stick
+    of column group ``a`` lands on a shard of column ``a``, so the pencil
+    engines' ownership-aligned x-grouping makes exchange A column-diagonal —
+    only the z-chunk redistribution inside each column crosses the wire,
+    (P2-1)/P2 of the stick data instead of (P-1)/P. For the 1-D slab engine
+    the stick->shard map has no wire effect, so the default (greedy
+    largest-first) stays; ``weights`` are unsupported with ``layout``.
     """
     t = np.asarray(triplets).reshape(-1, 3)
     if num_shards < 1:
         raise InvalidParameterError("num_shards must be >= 1")
     keys = stick_keys(t, dim_y)
     uniq, inverse, counts = np.unique(keys, return_inverse=True, return_counts=True)
+
+    if layout is not None:
+        P1, P2 = int(layout[0]), int(layout[1])
+        if P1 * P2 != num_shards:
+            raise InvalidParameterError("layout does not match num_shards")
+        if weights is not None:
+            raise InvalidParameterError("weights are unsupported with layout")
+        if dim_x is None:
+            raise InvalidParameterError("layout requires dim_x")
+        # storage-x of each unique stick (centered callers fold negatives onto
+        # the same physical column), then sort sticks x-major. Nearest-int
+        # recovery: |y| <= dim_y/2 < (4*dim_y)/2, so rounding key/(4*dim_y)
+        # yields the signed x exactly even when floor division would not.
+        raw_x = np.rint(uniq / (4 * dim_y)).astype(np.int64)
+        storage_x = np.where(raw_x < 0, raw_x + dim_x, raw_x)
+        xorder = np.argsort(storage_x, kind="stable")
+        # 1) contiguous column groups balanced by value counts; a group
+        # boundary never splits one x column (column-local is the point)
+        csum = np.cumsum(counts[xorder])
+        total = int(csum[-1])
+        group_of_sorted = np.minimum(
+            (csum - 1) * P1 // max(1, total), P1 - 1
+        )
+        # snap each column's sticks to the group of its first stick
+        sx_sorted = storage_x[xorder]
+        first_of_col = np.concatenate([[True], sx_sorted[1:] != sx_sorted[:-1]])
+        col_group = group_of_sorted[np.flatnonzero(first_of_col)]
+        group_of_sorted = np.repeat(col_group, np.diff(
+            np.concatenate([np.flatnonzero(first_of_col), [sx_sorted.size]])
+        ))
+        # 2) greedy largest-first within each column group over its P2 shards
+        stick_shard = np.zeros(uniq.size, dtype=np.int64)
+        for a in range(P1):
+            members = xorder[group_of_sorted == a]
+            load = np.zeros(P2)
+            for s in members[np.argsort(-counts[members], kind="stable")]:
+                b = int(np.argmin(load))
+                stick_shard[s] = a * P2 + b
+                load[b] += counts[s]
+        value_shard = stick_shard[inverse]
+        return [t[value_shard == r] for r in range(num_shards)]
+
     order = np.argsort(-counts)  # largest sticks first
     if weights is None:
         weights = np.ones(num_shards)
