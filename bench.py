@@ -122,15 +122,15 @@ def main():
     )
     ex = t._exec
 
-    def roundtrip(re, im):
+    def roundtrip(re, im, phase):
         # trace_* (un-jitted impls): jit boundaries inside the scan body block
         # cross-stage fusion (measured ~30% slower per pair)
-        space_re, space_im = ex.trace_backward(re, im)
-        return ex.trace_forward(space_re, space_im, ScalingType.FULL)
+        space_re, space_im = ex.trace_backward(re, im, phase=phase)
+        return ex.trace_forward(space_re, space_im, ScalingType.FULL, phase=phase)
 
-    def chain(re, im):
+    def chain(re, im, phase):
         def body(carry, _):
-            return roundtrip(*carry), None
+            return roundtrip(*carry, phase), None
         out, _ = jax.lax.scan(body, (re, im), None, length=CHAIN)
         return out
 
@@ -138,15 +138,18 @@ def main():
 
     re = ex.put(rng.standard_normal(n).astype(np.float32))
     im = ex.put(rng.standard_normal(n).astype(np.float32))
+    # rotation tables enter as jit operands, not embedded constants
+    # (ops/lanecopy.phase_rep_operands)
+    phase = getattr(ex, "phase_operands", ())
 
     # warmup / compile
-    wre, wim = step(re, im)
+    wre, wim = step(re, im, phase)
     float(wre[0])
 
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        cre, cim = step(re, im)
+        cre, cim = step(re, im, phase)
         float(cre[0])  # forces the whole chain to complete
         best = min(best, (time.perf_counter() - t0) / CHAIN)
 

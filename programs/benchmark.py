@@ -268,17 +268,26 @@ def main(argv=None):
                 re, im = as_pair(v, dtype)
                 freq_pairs.append((t._exec.put(re), t._exec.put(im)))
 
-        def roundtrip_chain(pairs):
+        # rotation tables as jit operands (ops/lanecopy.phase_rep_operands) —
+        # the embedded-constant form overflows the compile transport at 512^3,
+        # so they thread through the outer jit's argument list
+        phase_args = [getattr(e, "phase_operands", ()) for e in ex]
+
+        def roundtrip_chain(pairs, phases):
             # trace_* (un-jitted impls): a jit boundary inside the scan body
             # blocks cross-stage fusion (measured ~30% slower per pair).
             outs = []
-            for e, (re, im) in zip(ex, pairs):
-                space = e.trace_backward(re, im)
+            for e, ph, (re, im) in zip(ex, phases, pairs):
+                space = e.trace_backward(re, im, phase=ph)
                 if r2c:
-                    outs.append(e.trace_forward(space, None, ScalingType.FULL))
+                    outs.append(
+                        e.trace_forward(space, None, ScalingType.FULL, phase=ph)
+                    )
                 else:
                     sre, sim = space
-                    outs.append(e.trace_forward(sre, sim, ScalingType.FULL))
+                    outs.append(
+                        e.trace_forward(sre, sim, ScalingType.FULL, phase=ph)
+                    )
             return outs
 
         # All r repeats run inside ONE compiled lax.scan so a single dispatch
@@ -287,9 +296,9 @@ def main(argv=None):
         # development tunnel; sub-ms on directly attached hardware) to every
         # pair. The repeats remain *dependent* roundtrips, exactly like the
         # reference's repeated in-place loop (reference: benchmark.cpp:84-96).
-        def scan_chain(pairs):
+        def scan_chain(pairs, phases):
             def body(carry, _):
-                return tuple(roundtrip_chain(list(carry))), None
+                return tuple(roundtrip_chain(list(carry), phases)), None
             out, _ = jax.lax.scan(body, tuple(pairs), None, length=args.r)
             # single fence scalar, reduced in-program (see fence())
             return sum(p[0].ravel()[0] + p[1].ravel()[0] for p in out)
@@ -305,12 +314,12 @@ def main(argv=None):
         # ms/pair at 128^3 vs 5-7 ms steady-state). This mirrors the
         # reference's executed warm-up run (reference: benchmark.cpp:63-70).
         with timing.scoped("warmup chain"):
-            compiled = jitted.lower(freq_pairs).compile()
-            fence(compiled(freq_pairs))
+            compiled = jitted.lower(freq_pairs, phase_args).compile()
+            fence(compiled(freq_pairs, phase_args))
 
         with timing.scoped("benchmark loop"):
             start = time.perf_counter()
-            checksum = compiled(freq_pairs)
+            checksum = compiled(freq_pairs, phase_args)
             fence(checksum)
             elapsed = time.perf_counter() - start
 

@@ -1,0 +1,315 @@
+"""Round-4 on-chip measurement batch — ONE process, one device claim.
+
+Chip-gated A/Bs for this round's engine work, batched so a flaky tunnel is
+claimed once (the round-3 discipline, programs/round3_measurements.py):
+
+1. blocked sparse-y at the 256^3/15% spherical headline (auto G=4 vs off vs
+   G=2/G=8) — the y-stage flop cut above the per-slot crossover,
+2. phase-table operands vs the round-3 embedded/in-trace forms at 256^3 and
+   512^3 (the 512^3 regression suspect: per-apply in-trace cos/sin),
+3. 512^3 C2C sph15 local with the round-4 defaults (driver config-5 size),
+4. f64 512^3 R2C host-facing pair with chunked staging (VERDICT r3 item 8;
+   round-3 row: ~174 s/pair unchunked),
+5. distributed multi-transform: 4 P=1-mesh transforms fused into one jitted
+   chain vs 1 (the `-m 4 --shards 1` row, VERDICT r3 item 7).
+
+Results append incrementally to ``bench_results/round4_onchip.json`` so a
+mid-batch death keeps earlier rows. One variable per arm; every arm pins the
+env knobs it depends on.
+
+Usage: python programs/round4_measurements.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round4_onchip.json"
+)
+
+
+def flops_pair(dim):
+    import numpy as np
+
+    n = dim**3
+    return 2 * 5.0 * n * np.log2(n)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="short chains (smoke)")
+    ap.add_argument(
+        "--skip-f64", action="store_true", help="skip the slow f64 staging arm"
+    )
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round4_measurements", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev} ({dev.client.platform_version})", file=sys.stderr)
+    disarm()
+
+    import os
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+    from spfft_tpu.parameters import distribute_triplets
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def time_chain(ex, re0, im0, chain):
+        phase = getattr(ex, "phase_operands", ())
+
+        # phase operands thread through the jit argument list (never closure
+        # constants — ops/lanecopy.phase_rep_operands)
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                sre, sim = ex.trace_backward(*carry, phase=ph)
+                return (
+                    ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph),
+                    None,
+                )
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, wim = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, cim = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    def with_env(envs, fn):
+        saved = {k: os.environ.get(k) for k in envs}
+        for k, v in envs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def measure_local(name, dim, sparsity, chain, env=None):
+        def run():
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            t = Transform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                indices=trip, dtype=np.float32, engine="mxu",
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            best, err = time_chain(ex, re0, im0, chain)
+            record({
+                "name": name, "dim": dim, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+                "blocked_buckets": (
+                    len(getattr(ex, "_sparse_y_blocked", None) or ())
+                ),
+                "phase_operands": len(getattr(ex, "phase_operands", ())),
+            })
+
+        try:
+            with_env(env or {}, run)
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    CH = 48 if args.quick else 384
+    CH512 = 8 if args.quick else 48
+
+    # ---- 1+2: headline blocked sparse-y + operand arms at 256^3 ----
+    # every arm pins the three knobs it varies (one variable per arm);
+    # SPFFT_TPU_SPARSE_Y stays unset (auto; it never engages at 0.659)
+    base = {"SPFFT_TPU_SPARSE_Y": None}
+    measure_local("c2c_256_s15_r4_default", 256, 0.659, CH, env={**base})
+    measure_local(
+        "c2c_256_s15_blocked_off", 256, 0.659, CH,
+        env={**base, "SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
+    )
+    measure_local(
+        "c2c_256_s15_blocked_g2", 256, 0.659, CH,
+        env={**base, "SPFFT_TPU_SPARSE_Y_BLOCKS": "2"},
+    )
+    measure_local(
+        "c2c_256_s15_blocked_g8", 256, 0.659, CH,
+        env={**base, "SPFFT_TPU_SPARSE_Y_BLOCKS": "8"},
+    )
+    # operands OFF, blocked OFF == the round-3 shipped engine (6.15 ms row)
+    measure_local(
+        "c2c_256_s15_r3_config", 256, 0.659, CH,
+        env={
+            **base,
+            "SPFFT_TPU_SPARSE_Y_BLOCKS": "0",
+            "SPFFT_TPU_PHASE_DEVICE_MB": "0",
+        },
+    )
+    # 128^3 headline-class re-pin under the new defaults
+    measure_local("c2c_128_sph15_r4", 128, 0.659, 96 if args.quick else 768)
+
+    # ---- 3: 512^3 local (driver config-5 size class) ----
+    measure_local("c2c_512_sph15_r4_default", 512, 0.659, CH512, env={**base})
+    measure_local(
+        "c2c_512_sph15_blocked_off", 512, 0.659, CH512,
+        env={**base, "SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
+    )
+    # operands off -> the round-3 in-trace phase rep (the 87 ms / 416 GFLOP/s
+    # row): isolates how much of the 512^3 regression was phase regeneration
+    measure_local(
+        "c2c_512_sph15_r3_config", 512, 0.659, CH512,
+        env={
+            **base,
+            "SPFFT_TPU_SPARSE_Y_BLOCKS": "0",
+            "SPFFT_TPU_PHASE_DEVICE_MB": "0",
+        },
+    )
+
+    # ---- 4: f64 512^3 R2C host-facing pair, chunked staging ----
+    if not args.skip_f64:
+        def run_f64():
+            jax.config.update("jax_enable_x64", True)
+            try:
+                dim = 128 if args.quick else 512
+                trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+                # hermitian non-redundant half (x >= 0 of the centered sphere)
+                trip = trip[trip[:, 0] >= 0]
+                t = Transform(
+                    ProcessingUnit.GPU, TransformType.R2C, dim, dim, dim,
+                    indices=trip, dtype=np.float64,
+                )
+                rng = np.random.default_rng(0)
+                v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(
+                    len(trip)
+                )
+                # one warm host-facing pair (compile), then two timed
+                t.backward(v)
+                t.forward(scaling=ScalingType.FULL)
+                best = float("inf")
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    space = t.backward(v)
+                    out = t.forward(space, scaling=ScalingType.FULL)
+                    best = min(best, time.perf_counter() - t0)
+                err = float(np.abs(out - v).max() / np.abs(v).max())
+                record({
+                    "name": "f64_512_r2c_hostfacing_chunked",
+                    "dim": dim,
+                    "s_per_pair": round(best, 1),
+                    "roundtrip_rel_err": err,
+                    "stage_chunk_mb": os.environ.get(
+                        "SPFFT_TPU_STAGE_CHUNK_MB", "256(default)"
+                    ),
+                })
+            finally:
+                jax.config.update("jax_enable_x64", False)
+
+        try:
+            run_f64()
+        except Exception as e:
+            record({"name": "f64_512_r2c_hostfacing_chunked",
+                    "error": f"{type(e).__name__}: {e}"})
+
+    # ---- 5: distributed multi-transform (-m 4 --shards 1) ----
+    def measure_dist_multi(name, m, dim, sparsity, chain):
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            per = distribute_triplets(trip, 1, dim)
+            mesh = sp.make_fft_mesh(1)
+            ts = [
+                DistributedTransform(
+                    ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                    per, mesh=mesh, dtype=np.float32, engine="mxu",
+                )
+                for _ in range(m)
+            ]
+            exs = [t._exec for t in ts]
+            rng = np.random.default_rng(0)
+            vals = [
+                (rng.standard_normal(len(p)) + 1j * rng.standard_normal(len(p)))
+                .astype(np.complex64)
+                for p in per
+            ]
+            pairs = [ex.pad_values(vals) for ex in exs]
+
+            def body(carry, _):
+                outs = []
+                for ex, (re, im) in zip(exs, carry):
+                    s = ex.trace_backward(re, im)
+                    outs.append(ex.trace_forward(*s, ScalingType.FULL))
+                return tuple(outs), None
+
+            step = jax.jit(
+                lambda ps: jax.lax.scan(body, ps, None, length=chain)[0]
+            )
+            out = step(tuple(pairs))
+            float(jax.device_get(out[0][0].ravel()[0]))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = step(tuple(pairs))
+                float(jax.device_get(out[0][0].ravel()[0]))
+                best = min(best, (time.perf_counter() - t0) / (chain * m))
+            record({
+                "name": name, "m": m, "dim": dim, "chain": chain,
+                "ms_per_transform_pair": round(best * 1e3, 3),
+                "gflops_per_transform": round(flops_pair(dim) / best / 1e9, 1),
+            })
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    CHM = 12 if args.quick else 96
+    measure_dist_multi("dist1_m1_128_sph15", 1, 128, 0.659, CHM)
+    measure_dist_multi("dist1_m4_128_sph15", 4, 128, 0.659, CHM)
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,8 +71,58 @@ class ExecutionBase:
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
+    @staticmethod
+    def _stage_rows(nbytes: int, dim0: int):
+        """Leading-axis rows per staging chunk, or None for one-shot transfer.
+
+        Single source of the chunking rule shared by :meth:`put` and
+        :meth:`fetch`: ``SPFFT_TPU_STAGE_CHUNK_MB`` (default 256) bounds each
+        piece; <= 0 disables chunking."""
+        import os
+
+        limit = int(os.environ.get("SPFFT_TPU_STAGE_CHUNK_MB", "256")) << 20
+        if limit <= 0 or nbytes <= limit or dim0 <= 1:
+            return None
+        per_row = max(1, nbytes // dim0)
+        return max(1, limit // per_row)
+
     def put(self, array):
-        return jax.device_put(array, self.device)
+        """Host -> device staging, chunked above the size threshold.
+
+        One monolithic transfer of a 512^3-class f64 slab (~1-2 GB per part)
+        measured pathologically slow through the tunneled dev TPU (~23 MB/s —
+        the ~174 s/pair host-facing row of BASELINE.md's f64 table); chunked
+        staging pipelines the same bytes in bounded pieces. Device-resident
+        inputs keep the cheap device_put path (same-device is a no-op)."""
+        if isinstance(array, jax.Array):
+            return jax.device_put(array, self.device)
+        array = np.asarray(array)
+        rows = self._stage_rows(array.nbytes, array.shape[0] if array.ndim else 1)
+        if rows is None:
+            return jax.device_put(array, self.device)
+        chunks = [
+            jax.device_put(array[i : i + rows], self.device)
+            for i in range(0, array.shape[0], rows)
+        ]
+        # donate the chunks so XLA frees each as it is consumed — peak HBM
+        # stays ~1x the array (+1 chunk), not 2x
+        cat = jax.jit(
+            lambda *cs: jnp.concatenate(cs, axis=0),
+            donate_argnums=tuple(range(len(chunks))),
+        )
+        return cat(*chunks)
+
+    def fetch(self, arr):
+        """Device -> host fetch, chunked above the same threshold as put()."""
+        rows = self._stage_rows(
+            arr.size * arr.dtype.itemsize, arr.shape[0] if arr.ndim else 1
+        )
+        if rows is None:
+            return np.asarray(arr)
+        out = np.empty(arr.shape, dtype=arr.dtype)
+        for i in range(0, arr.shape[0], rows):
+            out[i : i + rows] = np.asarray(arr[i : i + rows])
+        return out
 
     def backward_pair_consuming(self, values_re, values_im):
         """``backward_pair`` that DONATES its input buffers to XLA.
@@ -94,7 +144,11 @@ class ExecutionBase:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            return self._backward_consume(values_re, values_im)
+            # engines with threaded rotation-table operands append them
+            # (never donated; see execution_mxu.phase_operands)
+            return self._backward_consume(
+                values_re, values_im, *getattr(self, "phase_operands", ())
+            )
 
 
 class LocalExecution(ExecutionBase):
@@ -189,10 +243,14 @@ class LocalExecution(ExecutionBase):
     # the benchmark's scan chain): a jit boundary inside a scan body blocks
     # cross-stage fusion (measured ~30% slower per pair at 128^3).
 
-    def trace_backward(self, values_re, values_im):
+    def trace_backward(self, values_re, values_im, phase=()):
+        del phase  # this engine has no rotation operands (MXU-engine contract)
         return self._backward_impl(values_re, values_im)
 
-    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+    def trace_forward(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE, phase=()
+    ):
+        del phase
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
         return self._forward_impl(space_re, space_im, self._scale_for(scaling))

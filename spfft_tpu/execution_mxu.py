@@ -73,19 +73,12 @@ class MxuLocalExecution(ExecutionBase):
             ux = np.zeros(1, dtype=np.int64)
             xslot = np.zeros(0, dtype=np.int64)
         A = offt.compact_x_extent(ux.size, p.dim_x_freq)
-        self._x_active = ux
         self._num_x_active = A
 
         # ---- DFT matrices (static constants; scale folded into forward z) ----
         self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
             p.dim_z, p.dim_y, p.total_size, rt
         )
-        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux, A, r2c, rt)
-
-        # R2C backward plane symmetry acts on the x == 0 plane; with x compaction
-        # that is slot 0 iff an x == 0 stick exists (otherwise the plane is zero
-        # and the fill is a no-op).
-        self._x0_slot = 0 if (p.num_sticks and int(ux[0]) == 0) else None
 
         # ---- sparse copy plans + expansion map ----
         S, Z = p.num_sticks, p.dim_z
@@ -96,8 +89,11 @@ class MxuLocalExecution(ExecutionBase):
         # s -> a*Sy + j; the expand gather and the forward pack disappear).
         # Engagement policy, crossover measurements, and the per-slot matrix
         # build live in ops/fft.plan_sparse_y (shared with the distributed
-        # engine).
+        # engine). ABOVE its Sy/Y crossover the blocked variant
+        # (ops/fft.plan_sparse_y_blocked) takes over: exact stick table,
+        # per-bucket padding, bucket gathers in place of expand/pack.
         self._sparse_y = False
+        self._sparse_y_blocked = None
         value_indices = np.asarray(p.value_indices, dtype=np.int64)
         if not r2c and p.num_sticks:
             sy_plan = offt.plan_sparse_y(xslot, p.stick_y, A, p.dim_y, rt)
@@ -106,6 +102,29 @@ class MxuLocalExecution(ExecutionBase):
                 self._sy, row_of_stick, self._wy_b_sp, self._wy_f_sp = sy_plan
                 stick_of_value = value_indices // Z
                 value_indices = row_of_stick[stick_of_value] * Z + value_indices % Z
+            else:
+                blk = offt.plan_sparse_y_blocked(
+                    xslot, p.stick_y, p.dim_y, rt, S, A * p.dim_y
+                )
+                if blk is not None:
+                    self._sparse_y_blocked = blk["buckets"]
+                    self._sy_row_of_stick = blk["row_of_stick"]
+                    # bucket-major slot order: permute the active-x list (the
+                    # x-stage matrices fold the permutation) and remap slots
+                    perm = blk["slot_perm"]
+                    ux = ux[perm]
+                    pos = np.empty(perm.size, dtype=np.int64)
+                    pos[perm] = np.arange(perm.size)
+                    xslot = pos[xslot]
+
+        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux, A, r2c, rt)
+        self._x_active = ux
+
+        # R2C backward plane symmetry acts on the x == 0 plane; with x compaction
+        # that is slot 0 iff an x == 0 stick exists (otherwise the plane is zero
+        # and the fill is a no-op). (The blocked sparse-y permutation is C2C-only,
+        # so the slot-0 assumption holds wherever this matters.)
+        self._x0_slot = 0 if (p.num_sticks and int(ux[0]) == 0) else None
 
         rows = A * self._sy if self._sparse_y else S
         self._table_rows = rows
@@ -125,9 +144,15 @@ class MxuLocalExecution(ExecutionBase):
         if rot is not None:
             delta, self._vi = rot
             self._phase = lanecopy.alignment_phase_rep(delta, Z, rt)
+            # device-resident operand form — threaded through the jit
+            # boundaries instead of embedded (critical at 512^3-class sizes)
+            self.phase_operands = lanecopy.phase_rep_operands(
+                self._phase, rt, self.put
+            )
         else:
             self._vi = value_indices
             self._phase = None
+            self.phase_operands = ()
         self._decompress_plan = lanecopy.build_decompress_plan(
             self._vi, rows * Z, p.num_values
         )
@@ -212,7 +237,13 @@ class MxuLocalExecution(ExecutionBase):
     # src/execution/execution_host.cpp:249-293) so jax.profiler traces read
     # like the reference's timing tree.
 
-    def _backward_impl(self, values_re, values_im):
+    def _phase_tables(self, phase):
+        """(cos, sin) from threaded operands, or the rep's fallback form."""
+        if phase:
+            return phase
+        return lanecopy.phase_rep_tables(self._phase, self.real_dtype)
+
+    def _backward_impl(self, values_re, values_im, *phase):
         p = self.params
         rt = self.real_dtype
         values_re = values_re.astype(rt)
@@ -231,7 +262,7 @@ class MxuLocalExecution(ExecutionBase):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
             if self._phase is not None:
                 # undo the alignment rotations (fused multiply)
-                cos_t, sin_t = lanecopy.phase_rep_tables(self._phase, rt)
+                cos_t, sin_t = self._phase_tables(phase)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
         if self._sparse_y:
             # per-slot y contraction straight off the stick table: no expand,
@@ -242,6 +273,29 @@ class MxuLocalExecution(ExecutionBase):
                     sre.reshape(A, Sy, Z), sim.reshape(A, Sy, Z),
                     *self._wy_b_sp, "ajz,ajk->kaz", prec,
                 )
+        elif self._sparse_y_blocked is not None:
+            # blocked sparse-y: per-bucket row gathers off the EXACT stick
+            # table (replacing the expand gather), per-bucket batched y
+            # contractions, bucket-major slot concatenation
+            with jax.named_scope("y transform"):
+                Z, A = p.dim_z, self._num_x_active
+                zero = jnp.zeros((1, Z), dtype=sre.dtype)
+                spad_re = jnp.concatenate([sre, zero])
+                spad_im = jnp.concatenate([sim, zero])
+                outs_re, outs_im = [], []
+                for row_idx, wyb, _ in self._sparse_y_blocked:
+                    idx = jnp.asarray(row_idx)
+                    ore, oim = offt.complex_matmul(
+                        spad_re[idx], spad_im[idx], *wyb, "ajz,ajk->kaz", prec
+                    )
+                    outs_re.append(ore)
+                    outs_im.append(oim)
+                gre = jnp.concatenate(outs_re, axis=1)
+                gim = jnp.concatenate(outs_im, axis=1)
+                if gre.shape[1] < A:  # compact_x_extent padding slots
+                    padw = A - gre.shape[1]
+                    gre = jnp.pad(gre, ((0, 0), (0, padw), (0, 0)))
+                    gim = jnp.pad(gim, ((0, 0), (0, padw), (0, 0)))
         else:
             with jax.named_scope("expand"):
                 gre, gim = self._expand(sre, sim)
@@ -269,7 +323,7 @@ class MxuLocalExecution(ExecutionBase):
                 )
             return offt.map_chunked(fn, (gre, gim), self._x_stage_chunks)
 
-    def _forward_impl(self, space_re, space_im, scaling):
+    def _forward_impl(self, space_re, space_im, *phase, scaling):
         rt = self.real_dtype
         prec = self._precision
         with jax.named_scope("x transform"):
@@ -298,6 +352,25 @@ class MxuLocalExecution(ExecutionBase):
                 R = self._table_rows
                 sre = sre.reshape(R, p.dim_z)
                 sim = sim.reshape(R, p.dim_z)
+        elif self._sparse_y_blocked is not None:
+            # blocked sparse-y: per-bucket contractions into bucket flats, one
+            # regather to exact stick rows (replacing the pack gather)
+            with jax.named_scope("y transform"):
+                Z = p.dim_z
+                flats_re, flats_im = [], []
+                col = 0
+                for row_idx, _, wyf in self._sparse_y_blocked:
+                    Ag, Syg = row_idx.shape
+                    fre, fim = offt.complex_matmul(
+                        gre[:, col : col + Ag, :], gim[:, col : col + Ag, :],
+                        *wyf, "yaz,ajy->ajz", prec,
+                    )
+                    flats_re.append(fre.reshape(Ag * Syg, Z))
+                    flats_im.append(fim.reshape(Ag * Syg, Z))
+                    col += Ag
+                rs = jnp.asarray(self._sy_row_of_stick)
+                sre = jnp.concatenate(flats_re, axis=0)[rs]
+                sim = jnp.concatenate(flats_im, axis=0)[rs]
         else:
             with jax.named_scope("y transform"):
                 gre, gim = offt.complex_matmul(
@@ -313,7 +386,7 @@ class MxuLocalExecution(ExecutionBase):
         with jax.named_scope("z transform"):
             if self._phase is not None:
                 # enter the rotated layout on the space side (fused multiply)
-                cos_t, sin_t = lanecopy.phase_rep_tables(self._phase, rt)
+                cos_t, sin_t = self._phase_tables(phase)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
@@ -324,32 +397,42 @@ class MxuLocalExecution(ExecutionBase):
     # ---- boundary API (pair-form, native layout) ------------------------------
 
     def backward_pair(self, values_re, values_im):
-        return self._backward(values_re, values_im)
+        return self._backward(values_re, values_im, *self.phase_operands)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
-        return self._forward[ScalingType(scaling)](space_re, space_im)
+        return self._forward[ScalingType(scaling)](
+            space_re, space_im, *self.phase_operands
+        )
 
     # Un-jitted traceables for composition into larger jitted programs (see
-    # LocalExecution.trace_backward for rationale).
+    # LocalExecution.trace_backward for rationale). Callers owning the outer
+    # jit thread ``phase=self.phase_operands`` through their own argument list
+    # so the rotation tables stay jit OPERANDS (embedding them as closure
+    # constants costs compile transport and, at 512^3, per-apply in-trace
+    # regeneration — see ops/lanecopy.phase_rep_operands).
 
-    def trace_backward(self, values_re, values_im):
-        return self._backward_impl(values_re, values_im)
+    def trace_backward(self, values_re, values_im, phase=()):
+        return self._backward_impl(values_re, values_im, *phase)
 
-    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+    def trace_forward(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE, phase=()
+    ):
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
-        return self._forward_impl(space_re, space_im, scaling=ScalingType(scaling))
+        return self._forward_impl(
+            space_re, space_im, *phase, scaling=ScalingType(scaling)
+        )
 
     # host-facing helpers translate between public (Z, Y, X) and native (Y, X, Z)
 
     def backward(self, values):
         re, im = as_pair(values, self.real_dtype)
-        out = self._backward(self.put(re), self.put(im))
+        out = self._backward(self.put(re), self.put(im), *self.phase_operands)
         if self.is_r2c:
-            return np.asarray(out).transpose(2, 0, 1)
-        return from_pair(out).transpose(2, 0, 1)
+            return self.fetch(out).transpose(2, 0, 1)
+        return (self.fetch(out[0]) + 1j * self.fetch(out[1])).transpose(2, 0, 1)
 
     def forward(self, space, scaling: ScalingType = ScalingType.NONE):
         space = np.asarray(space).transpose(1, 2, 0)  # (Z,Y,X) -> (Y,X,Z)

@@ -215,6 +215,106 @@ def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
     return sy_max, row_of, wyb, wyf
 
 
+def plan_sparse_y_blocked(
+    xslot, ys, dim_y: int, real_dtype, num_sticks: int, dense_rows: int
+):
+    """Blocked (two-level) sparse-y planning — the win region ABOVE the
+    per-slot crossover (``plan_sparse_y`` auto-disengages at Sy/Y >= 0.6,
+    where its single (A, Sy_max) padding inflates the stick table and with it
+    the z matmuls and copy plans — measured 1.28x slower at the 256^3/15%
+    headline, BASELINE.md). This variant keeps the stick table EXACT:
+
+    - active-x slots are sorted by stick count and cut into ``G`` buckets
+      (``SPFFT_TPU_SPARSE_Y_BLOCKS``; auto picks G=4), each padded only to
+      its own bucket maximum (8-sublane quantum),
+    - each bucket's y-DFT runs as a batched (Ag, Syg, Z) x (Ag, Syg, Y)
+      contraction; bucket outputs concatenate into the (Y, A, Z) grid in
+      bucket-major slot order (the x-stage matrices fold the slot
+      permutation, ops/fft.x_stage_matrices),
+    - the bucket gathers replace the dense path's expand/pack gathers
+      one-for-one, so the z/copy stages are untouched.
+
+    Total y flops drop from ``A * Y`` rows to ``sum_g Ag * Syg`` —
+    ~2x at the 15% spherical headline. Engages when the padded row total is
+    under ``SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC`` (default 0.8) of the dense
+    extent. Returns ``None`` when disengaged, else a dict with:
+
+    - ``slot_perm``: original slot index per new (bucket-major) position,
+    - ``buckets``: list of ``(row_idx (Ag, Syg) int32 into the
+      (num_sticks+1)-padded stick table, wyb pair (Ag, Syg, Y), wyf pair)``,
+    - ``row_of_stick``: (S,) int32 — each stick's row in the concatenation of
+      the bucket flats (the forward regather map).
+
+    Reference being out-done: the y-FFT-only-on-stick-bearing-rows idea of
+    ``src/fft/transform_1d_host.hpp:155-235``, which skips empty x-rows but
+    still transforms every y column of occupied ones.
+    """
+    mode = os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKS") or "auto"
+    if mode == "0":
+        return None
+    xslot = np.asarray(xslot, dtype=np.int64)
+    ys = np.asarray(ys, dtype=np.int64)
+    if xslot.size == 0:
+        return None
+    n_slots = int(xslot.max()) + 1
+    counts = np.bincount(xslot, minlength=n_slots)
+    G = 4 if mode == "auto" else max(1, int(mode))
+    G = min(G, n_slots)
+    order = np.argsort(-counts, kind="stable")  # slots by stick count, desc
+    bounds = np.linspace(0, n_slots, G + 1).astype(np.int64)
+    sy_of = lambda c: min(dim_y, -(-max(1, int(c)) // 8) * 8)
+    padded_rows = sum(
+        (bounds[g + 1] - bounds[g]) * sy_of(counts[order[bounds[g]]])
+        for g in range(G)
+        if bounds[g + 1] > bounds[g]
+    )
+    # engagement: blocked y flops ~ padded_rows * Y * Z vs dense ~ A * Y * Y * Z,
+    # so the row totals compare directly (dense_rows = A * dim_y)
+    frac = float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
+    if mode == "auto" and padded_rows >= frac * dense_rows:
+        return None
+    # stable per-slot stick enumeration (same j-ordering as plan_sparse_y)
+    by_slot = np.argsort(xslot, kind="stable")
+    cum = np.cumsum(counts) - counts
+    j_of = np.empty(xslot.size, dtype=np.int64)
+    j_of[by_slot] = np.arange(xslot.size) - cum[xslot[by_slot]]
+    slot_pos = np.empty(n_slots, dtype=np.int64)  # slot -> bucket-major pos
+    slot_pos[order] = np.arange(n_slots)
+    buckets = []
+    offsets = np.zeros(n_slots, dtype=np.int64)  # per-slot flat offset
+    flat_off = 0
+    for g in range(G):
+        lo, hi = int(bounds[g]), int(bounds[g + 1])
+        if hi <= lo:
+            continue
+        slots_g = order[lo:hi]
+        Ag = hi - lo
+        Syg = sy_of(counts[slots_g].max() if Ag else 1)
+        row_idx = np.full((Ag, Syg), num_sticks, dtype=np.int64)
+        y_flat = np.full(Ag * Syg, -1, dtype=np.int64)
+        for a_local, s in enumerate(slots_g):
+            members = by_slot[cum[s] : cum[s] + counts[s]]
+            row_idx[a_local, : counts[s]] = members
+            y_flat[a_local * Syg : a_local * Syg + counts[s]] = ys[members]
+            offsets[s] = flat_off + a_local * Syg
+        wyb = matrix_pair(
+            c2c_matrix(dim_y, +1, row_perm=y_flat).reshape(Ag, Syg, dim_y),
+            real_dtype,
+        )
+        wyf = matrix_pair(
+            c2c_matrix(dim_y, -1, row_perm=y_flat).reshape(Ag, Syg, dim_y),
+            real_dtype,
+        )
+        buckets.append((row_idx.astype(np.int32), wyb, wyf))
+        flat_off += Ag * Syg
+    row_of_stick = (offsets[xslot] + j_of).astype(np.int32)
+    return {
+        "slot_perm": order,
+        "buckets": buckets,
+        "row_of_stick": row_of_stick,
+    }
+
+
 F64_STAGE_MB_ENV = "SPFFT_TPU_F64_STAGE_MB"
 
 

@@ -370,6 +370,39 @@ def alignment_phase_rep(deltas, dim_z: int, real_dtype):
     return ("delta", deltas.astype(np.int32), int(dim_z))
 
 
+PHASE_DEVICE_LIMIT_MB_ENV = "SPFFT_TPU_PHASE_DEVICE_MB"
+
+
+def phase_rep_operands(rep, real_dtype, put):
+    """Device-resident (cos, sin) operand pair for a phase rep, or ``()``.
+
+    Operands enter the jitted programs as ARGUMENTS, not embedded constants,
+    so they inflate neither the compiled program nor its compile transport —
+    the 512^3 table pair (366 MB) that overflowed the tunnel as an HLO
+    constant is one ``device_put`` here, and the per-apply in-trace cos/sin
+    regeneration it forced disappears. Table reps convert directly; delta
+    reps materialize their tables up to the HBM budget
+    (``SPFFT_TPU_PHASE_DEVICE_MB``, default 2048) and keep the in-trace
+    fallback above it. Callers pass the pair through their jit boundary
+    (``phase=`` on the engine's trace entry points) and
+    :func:`phase_rep_tables` stays the closure fallback for paths that do
+    not thread operands.
+    """
+    if rep is None:
+        return ()
+    limit = int(os.environ.get(PHASE_DEVICE_LIMIT_MB_ENV, "2048")) * (1 << 20)
+    if limit <= 0:  # <= 0 disables operands entirely (A/B escape hatch)
+        return ()
+    if rep[0] == "table":
+        return (put(rep[1]), put(rep[2]))
+    _, deltas, dim_z = rep
+    bytes_ = 2 * deltas.size * int(dim_z) * np.dtype(real_dtype).itemsize
+    if bytes_ > limit:
+        return ()
+    cos, sin = alignment_phase_tables(deltas, dim_z, real_dtype)
+    return (put(cos), put(sin))
+
+
 def phase_rep_tables(rep, real_dtype):
     """Traced (cos, sin) tables from an :func:`alignment_phase_rep` value.
 

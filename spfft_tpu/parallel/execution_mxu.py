@@ -634,9 +634,13 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
 
     # Un-jitted traceables (see LocalExecution.trace_backward for rationale).
 
-    def trace_backward(self, values_re, values_im):
+    def trace_backward(self, values_re, values_im, phase=()):
+        del phase  # mesh engines keep per-shard reps internal (no operands)
         return self._backward_sm(values_re, values_im, *self._phase_args())
 
-    def trace_forward(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
+    def trace_forward(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE, phase=()
+    ):
+        del phase
         return self._dispatch_forward(self._forward_sm, space_re, space_im, scaling)
 

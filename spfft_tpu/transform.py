@@ -305,8 +305,11 @@ class Transform:
         return self._params.transform_type == TransformType.R2C
 
     def _combine_space(self, out):
-
-        arr = np.asarray(out) if self._is_r2c else from_pair(out)
+        # chunked fetch above the staging threshold (execution.ExecutionBase.fetch)
+        if self._is_r2c:
+            arr = self._exec.fetch(out)
+        else:
+            arr = self._exec.fetch(out[0]) + 1j * self._exec.fetch(out[1])
         if self._native_transposed:
             arr = arr.transpose(2, 0, 1)  # native (Y,X,Z) -> public (Z,Y,X)
         return arr

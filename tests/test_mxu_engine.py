@@ -338,6 +338,53 @@ def test_phase_rep_in_trace_matches_table(monkeypatch):
     np.testing.assert_allclose(back_d, values, rtol=1e-4, atol=1e-4)
 
 
+def test_sparse_y_blocked_stage(monkeypatch):
+    """Blocked sparse-y (the win region ABOVE the per-slot crossover,
+    ops/fft.plan_sparse_y_blocked): exact stick table, per-bucket padded y
+    contractions, bucket-major slot permutation folded into the x matrices.
+    Must agree with the dense oracle in both directions and compose with the
+    alignment rotations."""
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, Transform
+
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y", raising=False)
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y_BLOCKS", raising=False)
+    rng = np.random.default_rng(19)
+    dx, dy, dz = 32, 32, 128  # dz=128 so the alignment rotations engage too
+    # headline-class spherical density: per-slot sparse-y stays off
+    # (Sy/Y ~ 0.69 > 0.6), the blocked variant engages (row total < 0.8 A*Y)
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.659)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                  indices=trip, engine="mxu")
+    assert not t._exec._sparse_y
+    assert t._exec._sparse_y_blocked is not None, "blocked must auto-engage"
+    assert t._exec._phase is not None, "rotations must compose"
+    # padded bucket rows genuinely undercut the dense extent
+    rows = sum(ri.size for ri, _, _ in t._exec._sparse_y_blocked)
+    assert rows < 0.8 * t._exec._num_x_active * dy
+    v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    out = t.backward(v)
+    assert_close(out, oracle_backward_c2c(trip, v, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, v)
+
+    # forced bucket count; off switch; R2C never engages
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_BLOCKS", "2")
+    t2 = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                   indices=trip, engine="mxu")
+    assert len(t2._exec._sparse_y_blocked) == 2
+    assert_close(t2.backward(v), oracle_backward_c2c(trip, v, dx, dy, dz))
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_BLOCKS", "0")
+    t0 = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                   indices=trip, engine="mxu")
+    assert t0._exec._sparse_y_blocked is None
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y_BLOCKS", raising=False)
+    rtrip = trip[(trip[:, 0] >= 0) & (trip[:, 0] <= dx // 2)]
+    tr = Transform(ProcessingUnit.HOST, TransformType.R2C, dx, dy, dz,
+                   indices=rtrip, engine="mxu")
+    assert tr._exec._sparse_y_blocked is None
+
+
 def test_sparse_y_auto_threshold(monkeypatch):
     """Unset (auto) sparse-y engages only below the measured Sy/Y < 0.6
     crossover; =0 forces it off even there; =1 forces it on above it."""
