@@ -53,6 +53,16 @@ def from_pair(pair):
     return re + 1j * im
 
 
+# One jitted updater reused for every chunked put (jax.jit caches by this
+# function's identity + operand shapes; the donated accumulator lets XLA
+# update in place instead of holding chunks + result concurrently).
+def _chunk_update_fn(buf, chunk, start):
+    return jax.lax.dynamic_update_slice_in_dim(buf, chunk, start, 0)
+
+
+_chunk_update = jax.jit(_chunk_update_fn, donate_argnums=(0,))
+
+
 class ExecutionBase:
     """Shared boundary state/helpers for the single-device engines (this XLA engine
     and execution_mxu.MxuLocalExecution)."""
@@ -92,25 +102,21 @@ class ExecutionBase:
         One monolithic transfer of a 512^3-class f64 slab (~1-2 GB per part)
         measured pathologically slow through the tunneled dev TPU (~23 MB/s —
         the ~174 s/pair host-facing row of BASELINE.md's f64 table); chunked
-        staging pipelines the same bytes in bounded pieces. Device-resident
-        inputs keep the cheap device_put path (same-device is a no-op)."""
+        staging pipelines the same bytes in bounded pieces, assembled by
+        donated in-place slice updates so peak HBM stays ~1x the array plus
+        one chunk. Device-resident inputs keep the cheap device_put path
+        (same-device is a no-op)."""
         if isinstance(array, jax.Array):
             return jax.device_put(array, self.device)
         array = np.asarray(array)
         rows = self._stage_rows(array.nbytes, array.shape[0] if array.ndim else 1)
         if rows is None:
             return jax.device_put(array, self.device)
-        chunks = [
-            jax.device_put(array[i : i + rows], self.device)
-            for i in range(0, array.shape[0], rows)
-        ]
-        # donate the chunks so XLA frees each as it is consumed — peak HBM
-        # stays ~1x the array (+1 chunk), not 2x
-        cat = jax.jit(
-            lambda *cs: jnp.concatenate(cs, axis=0),
-            donate_argnums=tuple(range(len(chunks))),
-        )
-        return cat(*chunks)
+        buf = jnp.zeros(array.shape, dtype=array.dtype, device=self.device)
+        for i in range(0, array.shape[0], rows):
+            chunk = jax.device_put(array[i : i + rows], self.device)
+            buf = _chunk_update(buf, chunk, i)
+        return buf
 
     def fetch(self, arr):
         """Device -> host fetch, chunked above the same threshold as put()."""
@@ -123,6 +129,11 @@ class ExecutionBase:
         for i in range(0, arr.shape[0], rows):
             out[i : i + rows] = np.asarray(arr[i : i + rows])
         return out
+
+    def fetch_space_complex(self, pair):
+        """(re, im) device pair -> host complex array via chunked fetch —
+        the one combine shared by every host-facing C2C space fetch."""
+        return self.fetch(pair[0]) + 1j * self.fetch(pair[1])
 
     def backward_pair_consuming(self, values_re, values_im):
         """``backward_pair`` that DONATES its input buffers to XLA.

@@ -33,7 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .execution import ExecutionBase, as_pair, from_pair
+from .execution import ExecutionBase, as_pair
 from .ops import fft as offt
 from .ops import lanecopy, symmetry
 from .parameters import LocalParameters
@@ -432,7 +432,7 @@ class MxuLocalExecution(ExecutionBase):
         out = self._backward(self.put(re), self.put(im), *self.phase_operands)
         if self.is_r2c:
             return self.fetch(out).transpose(2, 0, 1)
-        return (self.fetch(out[0]) + 1j * self.fetch(out[1])).transpose(2, 0, 1)
+        return self.fetch_space_complex(out).transpose(2, 0, 1)
 
     def forward(self, space, scaling: ScalingType = ScalingType.NONE):
         space = np.asarray(space).transpose(1, 2, 0)  # (Z,Y,X) -> (Y,X,Z)

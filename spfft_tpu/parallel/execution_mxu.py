@@ -280,7 +280,6 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
             Z, Y, p.total_size, rt
         )
-        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux_full, A, r2c, rt)
 
         # ---- exchange geometry (global constants, identical on every shard) ----
         # z-split: uniform slabs make pack/unpack pure reshapes; ragged slabs go
@@ -312,6 +311,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         # (ops/fft.plan_sparse_y); built from the GLOBAL stick arrays, so
         # every shard's SPMD program agrees.
         self._sparse_y = False
+        self._sparse_y_blocked = None
         if not r2c and valid.any():
             xslot_valid = xslot_of[sx_all[valid]]
             sy_plan = offt.plan_sparse_y(xslot_valid, sy[valid], A, Y, rt)
@@ -325,13 +325,45 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 inv_row = np.full(A * Sy, p.num_shards * S, dtype=np.int32)
                 inv_row[row_valid] = np.flatnonzero(valid).astype(np.int32)
                 self._row_stick = inv_row  # table row -> global stick row
+            elif A < Xf:
+                # Blocked sparse-y ABOVE the per-slot crossover, like the local
+                # engine (ops/fft.plan_sparse_y_blocked): exact global stick
+                # set, per-bucket padded tables whose flats also become the
+                # plane slot space the exchanges ship (A < Xf gate: at the
+                # full extent the slot domain is all of x and the permutation
+                # bookkeeping buys nothing).
+                nvalid = int(valid.sum())
+                blk = offt.plan_sparse_y_blocked(
+                    xslot_valid, sy[valid], Y, rt, nvalid, A * Y
+                )
+                if blk is not None:
+                    vrows = np.flatnonzero(valid)
+                    buckets = []
+                    for row_idx, wyb, wyf in blk["buckets"]:
+                        g = np.full(row_idx.shape, p.num_shards * S, np.int64)
+                        ok = row_idx < nvalid
+                        g[ok] = vrows[row_idx[ok]]
+                        buckets.append((g.astype(np.int32), wyb, wyf))
+                    self._sparse_y_blocked = buckets
+                    rb = sum(ri.size for ri, _, _ in buckets)
+                    self._rb = rb
+                    row_of = np.full(sx_all.size, rb, dtype=np.int64)
+                    row_of[vrows] = blk["row_of_stick"]
+                    self._stick_row_b = row_of.astype(np.int32)
+                    # bucket-major slot order folds into the x matrices
+                    ux_full = ux_full[blk["slot_perm"]]
 
-        # Exact-counts exchanges over the compact plane slots (Y * A, or the
-        # sparse-y (A, Sy) table rows): COMPACT_* runs the ppermute chain,
-        # UNBUFFERED the one-shot ragged-all-to-all discipline; the exchange
-        # machinery is generic over (num_slots, per-stick slot map).
+        self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux_full, A, r2c, rt)
+
+        # Exact-counts exchanges over the compact plane slots (Y * A, the
+        # sparse-y (A, Sy) table rows, or the blocked bucket flats): COMPACT_*
+        # runs the ppermute chain, UNBUFFERED the one-shot ragged-all-to-all
+        # discipline; the exchange machinery is generic over
+        # (num_slots, per-stick slot map).
         if self._sparse_y:
             plane_slots, slot_of_stick = A * self._sy, self._stick_row
+        elif self._sparse_y_blocked is not None:
+            plane_slots, slot_of_stick = self._rb, self._stick_row_b
         else:
             plane_slots, slot_of_stick = Y * A, self._stick_yx
         self._plane_slots = plane_slots
@@ -451,6 +483,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 if self._sparse_y:
                     gre = fre[: L * ns].reshape(L, A, self._sy)
                     gim = fim[: L * ns].reshape(L, A, self._sy)
+                elif self._sparse_y_blocked is not None:
+                    gre = fre[: L * ns].reshape(L, ns)
+                    gim = fim[: L * ns].reshape(L, ns)
                 else:
                     gre = fre[: L * ns].reshape(L, Y, A)
                     gim = fim[: L * ns].reshape(L, Y, A)
@@ -476,6 +511,8 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     m = jnp.asarray(self._row_stick)
                     gre = jnp.take(rows_re, m, axis=0).reshape(A, self._sy, L)
                     gim = jnp.take(rows_im, m, axis=0).reshape(A, self._sy, L)
+                elif self._sparse_y_blocked is not None:
+                    gre, gim = rows_re, rows_im  # bucket gathers follow per bucket
                 else:
                     m = jnp.asarray(self._yx_stick)
                     gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
@@ -502,6 +539,33 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     gre, gim = offt.complex_matmul(
                         gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
                     )
+            elif self._sparse_y_blocked is not None:
+                # per-bucket contractions; bucket-major slot concatenation
+                # (the x matrices fold the slot permutation)
+                outs_re, outs_im = [], []
+                off = 0
+                for row_idx, wyb, _ in self._sparse_y_blocked:
+                    Ag, Syg = row_idx.shape
+                    if self._ragged is not None:
+                        bre = gre[:, off : off + Ag * Syg].reshape(L, Ag, Syg)
+                        bim = gim[:, off : off + Ag * Syg].reshape(L, Ag, Syg)
+                        ore, oim = offt.complex_matmul(
+                            bre, bim, *wyb, "laj,ajk->lka", prec
+                        )
+                    else:
+                        idx = jnp.asarray(row_idx)
+                        ore, oim = offt.complex_matmul(
+                            gre[idx], gim[idx], *wyb, "ajl,ajk->lka", prec
+                        )
+                    outs_re.append(ore)
+                    outs_im.append(oim)
+                    off += Ag * Syg
+                gre = jnp.concatenate(outs_re, axis=2)
+                gim = jnp.concatenate(outs_im, axis=2)
+                if gre.shape[2] < A:  # compact_x_extent padding slots
+                    padw = A - gre.shape[2]
+                    gre = jnp.pad(gre, ((0, 0), (0, 0), (0, padw)))
+                    gim = jnp.pad(gim, ((0, 0), (0, 0), (0, padw)))
             else:
                 gre, gim = offt.complex_matmul(
                     gre, gim, *self._wy_b, "lyx,yk->lkx", prec
@@ -549,6 +613,28 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     gre, gim = offt.complex_matmul(
                         gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
                     )
+            elif self._sparse_y_blocked is not None:
+                # per-bucket contractions into bucket flats, oriented for the
+                # exchange below ((L, rb) ragged / (rb, L) padded pack)
+                flats_re, flats_im = [], []
+                col = 0
+                for row_idx, _, wyf in self._sparse_y_blocked:
+                    Ag, Syg = row_idx.shape
+                    spec = "lyk,kjy->lkj" if self._ragged is not None else "lyk,kjy->kjl"
+                    fre_b, fim_b = offt.complex_matmul(
+                        gre[:, :, col : col + Ag], gim[:, :, col : col + Ag],
+                        *wyf, spec, prec,
+                    )
+                    if self._ragged is not None:
+                        flats_re.append(fre_b.reshape(L, Ag * Syg))
+                        flats_im.append(fim_b.reshape(L, Ag * Syg))
+                    else:
+                        flats_re.append(fre_b.reshape(Ag * Syg, L))
+                        flats_im.append(fim_b.reshape(Ag * Syg, L))
+                    col += Ag
+                axis = 1 if self._ragged is not None else 0
+                gre = jnp.concatenate(flats_re, axis=axis)
+                gim = jnp.concatenate(flats_im, axis=axis)
             else:
                 gre, gim = offt.complex_matmul(
                     gre, gim, *self._wy_f, "lyk,yj->ljk", prec
@@ -571,6 +657,10 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                         [gim.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
                     )
                     m = jnp.asarray(self._stick_row)
+                elif self._sparse_y_blocked is not None:
+                    flat_re = jnp.concatenate([gre, jnp.zeros((1, L), rt)])
+                    flat_im = jnp.concatenate([gim, jnp.zeros((1, L), rt)])
+                    m = jnp.asarray(self._stick_row_b)
                 else:
                     flat_re = jnp.concatenate(
                         [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]

@@ -309,7 +309,7 @@ class Transform:
         if self._is_r2c:
             arr = self._exec.fetch(out)
         else:
-            arr = self._exec.fetch(out[0]) + 1j * self._exec.fetch(out[1])
+            arr = self._exec.fetch_space_complex(out)
         if self._native_transposed:
             arr = arr.transpose(2, 0, 1)  # native (Y,X,Z) -> public (Z,Y,X)
         return arr
@@ -401,8 +401,10 @@ class Transform:
 
     @property
     def device(self):
-        """The JAX device this plan is bound to (reference: the CUDA device
-        current at creation, grid_internal.cpp:82)."""
+        """The JAX device this plan is bound to.
+
+        Reference parity: the CUDA device current at creation pins the object
+        (grid_internal.cpp:82, details.rst:104-106)."""
         return self._device
 
     @property

@@ -467,3 +467,40 @@ def test_mxu_distributed_sparse_y(monkeypatch, exchange):
     back = t.forward(scaling=ScalingType.FULL)
     for r, vals in enumerate(vps):
         assert_close(back[r], vals)
+
+
+@pytest.mark.parametrize(
+    "exchange",
+    [ExchangeType.BUFFERED, ExchangeType.COMPACT_BUFFERED, ExchangeType.UNBUFFERED],
+)
+def test_mxu_distributed_sparse_y_blocked(monkeypatch, exchange):
+    """The distributed blocked sparse-y stage (per-bucket y contractions over
+    the EXACT global stick set; the bucket flats become the plane slot space
+    every exchange discipline ships) must agree with the dense oracle and
+    close the roundtrip. Forced bucket count so the small dims engage it;
+    headline-class density keeps the per-slot stage off (Sy/Y > 0.6)."""
+    import spfft_tpu as sp2
+
+    monkeypatch.delenv("SPFFT_TPU_SPARSE_Y", raising=False)
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_BLOCKS", "3")
+    rng = np.random.default_rng(93)
+    dx = dy = dz = 32
+    trip = sp2.create_spherical_cutoff_triplets(dx, dy, dz, 0.659)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+
+    t = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz, per_shard,
+        mesh=sp2.make_fft_mesh(4), engine="mxu", exchange_type=exchange,
+    )
+    assert not t._exec._sparse_y
+    assert t._exec._sparse_y_blocked is not None, "blocked must engage"
+    assert len(t._exec._sparse_y_blocked) == 3
+    # the plane slot space the exchanges ship IS the (smaller) bucket flats
+    assert t._exec._plane_slots < t._exec._num_x_active * dy
+    out = t.backward(vps)
+    assert_close(out, oracle_backward_c2c(trip, values, dx, dy, dz))
+    back = t.forward(scaling=ScalingType.FULL)
+    for r, vals in enumerate(vps):
+        assert_close(back[r], vals)
