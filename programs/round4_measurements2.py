@@ -1,0 +1,272 @@
+"""Round-4 on-chip batch 2 — follow-ups to round4_measurements.py.
+
+1. 512^3 blocked sparse-y re-run: batch 1's arm died because the ~800 MB of
+   bucket matrices were embedded HLO constants; they are jit operands now.
+2. 256^3 default re-pin after the operand restructure.
+3. distributed multi-transform arms (batch 1 hit a mid-run source edit).
+4. f64 512^3 host-facing split: device-side compute chain vs host-facing
+   pair isolates staging from f64-emulation compute.
+
+Appends to bench_results/round4_onchip2.json.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round4_onchip2.json"
+)
+
+
+def flops_pair(dim):
+    import numpy as np
+
+    n = dim**3
+    return 2 * 5.0 * n * np.log2(n)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round4_measurements2", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import os
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+    from spfft_tpu.parameters import distribute_triplets
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def time_chain(ex, re0, im0, chain):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                sre, sim = ex.trace_backward(*carry, phase=ph)
+                return (
+                    ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph),
+                    None,
+                )
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, wim = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, cim = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    def measure_local(name, dim, sparsity, chain, env=None):
+        saved = {k: os.environ.get(k) for k in (env or {})}
+        os.environ.update({k: v for k, v in (env or {}).items() if v is not None})
+        for k, v in (env or {}).items():
+            if v is None:
+                os.environ.pop(k, None)
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            t = Transform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                indices=trip, dtype=np.float32, engine="mxu",
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            best, err = time_chain(ex, re0, im0, chain)
+            record({
+                "name": name, "dim": dim, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+                "blocked_buckets": len(
+                    getattr(ex, "_sparse_y_blocked", None) or ()
+                ),
+                "n_operands": len(getattr(ex, "phase_operands", ())),
+            })
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    CH = 48 if args.quick else 384
+    CH512 = 8 if args.quick else 48
+
+    # 1 + 2
+    measure_local("c2c_256_s15_r4b_default", 256, 0.659, CH)
+    measure_local("c2c_512_sph15_r4b_default", 512, 0.659, CH512)
+    measure_local(
+        "c2c_512_sph15_r4b_g8", 512, 0.659, CH512,
+        env={"SPFFT_TPU_SPARSE_Y_BLOCKS": "8"},
+    )
+
+    # 3: distributed multi-transform (-m 4 --shards 1)
+    def measure_dist_multi(name, m, dim, sparsity, chain):
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+            per = distribute_triplets(trip, 1, dim)
+            mesh = sp.make_fft_mesh(1)
+            ts = [
+                DistributedTransform(
+                    ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                    per, mesh=mesh, dtype=np.float32, engine="mxu",
+                )
+                for _ in range(m)
+            ]
+            exs = [t._exec for t in ts]
+            rng = np.random.default_rng(0)
+            vals = [
+                (rng.standard_normal(len(p)) + 1j * rng.standard_normal(len(p)))
+                .astype(np.complex64)
+                for p in per
+            ]
+            pairs = [ex.pad_values(vals) for ex in exs]
+
+            def body(carry, _):
+                outs = []
+                for ex, (re, im) in zip(exs, carry):
+                    s = ex.trace_backward(re, im)
+                    outs.append(ex.trace_forward(*s, ScalingType.FULL))
+                return tuple(outs), None
+
+            step = jax.jit(
+                lambda ps: jax.lax.scan(body, ps, None, length=chain)[0]
+            )
+            out = step(tuple(pairs))
+            float(jax.device_get(out[0][0].ravel()[0]))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = step(tuple(pairs))
+                float(jax.device_get(out[0][0].ravel()[0]))
+                best = min(best, (time.perf_counter() - t0) / (chain * m))
+            record({
+                "name": name, "m": m, "dim": dim, "chain": chain,
+                "ms_per_transform_pair": round(best * 1e3, 3),
+                "gflops_per_transform": round(flops_pair(dim) / best / 1e9, 1),
+            })
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    CHM = 12 if args.quick else 96
+    measure_dist_multi("dist1_m1_128_sph15", 1, 128, 0.659, CHM)
+    measure_dist_multi("dist1_m4_128_sph15", 4, 128, 0.659, CHM)
+
+    # 4: f64 512^3 R2C — device-side compute chain vs host-facing pair
+    def run_f64():
+        jax.config.update("jax_enable_x64", True)
+        try:
+            dim = 128 if args.quick else 512
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+            trip = trip[trip[:, 0] >= 0]
+            t = Transform(
+                ProcessingUnit.GPU, TransformType.R2C, dim, dim, dim,
+                indices=trip, dtype=np.float64,
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n))
+            im0 = ex.put(rng.standard_normal(n))
+            phase = getattr(ex, "phase_operands", ())
+
+            # device-side compute: CHAIN dependent pairs, no host staging
+            def chain_fn(r, i, ph):
+                def body(carry, _):
+                    space = ex.trace_backward(*carry, phase=ph)
+                    vr, vi = ex.trace_forward(
+                        space, None, ScalingType.FULL, phase=ph
+                    )
+                    return (vr, vi), None
+
+                return jax.lax.scan(body, (r, i), None, length=3)[0]
+
+            step = jax.jit(chain_fn)
+            wr, wi = step(re0, im0, phase)
+            float(jax.device_get(wr.ravel()[0]))
+            t0 = time.perf_counter()
+            wr, wi = step(re0, im0, phase)
+            float(jax.device_get(wr.ravel()[0]))
+            compute_s = (time.perf_counter() - t0) / 3
+            record({
+                "name": "f64_512_r2c_device_compute",
+                "s_per_pair": round(compute_s, 1),
+            })
+
+            # host-facing pair (staging + compute)
+            v = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            t.backward(v)
+            t.forward(scaling=ScalingType.FULL)
+            t0 = time.perf_counter()
+            space = t.backward(v)
+            t.forward(space, scaling=ScalingType.FULL)
+            record({
+                "name": "f64_512_r2c_hostfacing_b2",
+                "s_per_pair": round(time.perf_counter() - t0, 1),
+                "stage_chunk_mb": os.environ.get(
+                    "SPFFT_TPU_STAGE_CHUNK_MB", "256(default)"
+                ),
+            })
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    try:
+        run_f64()
+    except Exception as e:
+        record({"name": "f64_512_r2c_b2", "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -146,13 +146,25 @@ class MxuLocalExecution(ExecutionBase):
             self._phase = lanecopy.alignment_phase_rep(delta, Z, rt)
             # device-resident operand form — threaded through the jit
             # boundaries instead of embedded (critical at 512^3-class sizes)
-            self.phase_operands = lanecopy.phase_rep_operands(
-                self._phase, rt, self.put
-            )
+            phase_ops = lanecopy.phase_rep_operands(self._phase, rt, self.put)
         else:
             self._vi = value_indices
             self._phase = None
-            self.phase_operands = ()
+            phase_ops = ()
+        # Plan operands = phase tables + blocked-y bucket matrices, one flat
+        # tuple threaded through every jit boundary. The bucket matrices MUST
+        # be operands at large sizes: at 512^3 they are ~800 MB, which
+        # overflowed the tunnel compile transport as embedded HLO constants
+        # (measured round 4 — the same failure class as the phase tables).
+        self._n_phase_ops = len(phase_ops)
+        mat_ops = ()
+        if self._sparse_y_blocked is not None:
+            for _, wyb, wyf in self._sparse_y_blocked:
+                mat_ops += (
+                    self.put(wyb[0]), self.put(wyb[1]),
+                    self.put(wyf[0]), self.put(wyf[1]),
+                )
+        self.phase_operands = phase_ops + mat_ops
         self._decompress_plan = lanecopy.build_decompress_plan(
             self._vi, rows * Z, p.num_values
         )
@@ -237,11 +249,27 @@ class MxuLocalExecution(ExecutionBase):
     # src/execution/execution_host.cpp:249-293) so jax.profiler traces read
     # like the reference's timing tree.
 
-    def _phase_tables(self, phase):
+    def _split_operands(self, ops):
+        """Threaded plan operands -> (phase pair or (), bucket matrices or ())."""
+        if not ops:
+            return (), ()
+        return ops[: self._n_phase_ops], ops[self._n_phase_ops :]
+
+    def _phase_tables(self, phase_ops):
         """(cos, sin) from threaded operands, or the rep's fallback form."""
-        if phase:
-            return phase
+        if phase_ops:
+            return phase_ops
         return lanecopy.phase_rep_tables(self._phase, self.real_dtype)
+
+    def _bucket_mats(self, mats, b, forward):
+        """Bucket ``b``'s (pair) y matrix from threaded operands, or the
+        embedded-constant fallback (trace paths that do not thread operands —
+        fine at the sizes those paths run)."""
+        if mats:
+            base = 4 * b + (2 if forward else 0)
+            return (mats[base], mats[base + 1])
+        row_idx, wyb, wyf = self._sparse_y_blocked[b]
+        return wyf if forward else wyb
 
     def _backward_impl(self, values_re, values_im, *phase):
         p = self.params
@@ -258,11 +286,12 @@ class MxuLocalExecution(ExecutionBase):
                 sre, sim = sre.at[i].set(fre), sim.at[i].set(fim)
 
         prec = self._precision
+        phase_ops, mat_ops = self._split_operands(phase)
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
             if self._phase is not None:
                 # undo the alignment rotations (fused multiply)
-                cos_t, sin_t = self._phase_tables(phase)
+                cos_t, sin_t = self._phase_tables(phase_ops)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
         if self._sparse_y:
             # per-slot y contraction straight off the stick table: no expand,
@@ -283,8 +312,9 @@ class MxuLocalExecution(ExecutionBase):
                 spad_re = jnp.concatenate([sre, zero])
                 spad_im = jnp.concatenate([sim, zero])
                 outs_re, outs_im = [], []
-                for row_idx, wyb, _ in self._sparse_y_blocked:
+                for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
                     idx = jnp.asarray(row_idx)
+                    wyb = self._bucket_mats(mat_ops, b, forward=False)
                     ore, oim = offt.complex_matmul(
                         spad_re[idx], spad_im[idx], *wyb, "ajz,ajk->kaz", prec
                     )
@@ -326,6 +356,7 @@ class MxuLocalExecution(ExecutionBase):
     def _forward_impl(self, space_re, space_im, *phase, scaling):
         rt = self.real_dtype
         prec = self._precision
+        phase_ops, mat_ops = self._split_operands(phase)
         with jax.named_scope("x transform"):
             if self.is_r2c:
                 gre, gim = offt.map_chunked(
@@ -359,8 +390,9 @@ class MxuLocalExecution(ExecutionBase):
                 Z = p.dim_z
                 flats_re, flats_im = [], []
                 col = 0
-                for row_idx, _, wyf in self._sparse_y_blocked:
+                for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
                     Ag, Syg = row_idx.shape
+                    wyf = self._bucket_mats(mat_ops, b, forward=True)
                     fre, fim = offt.complex_matmul(
                         gre[:, col : col + Ag, :], gim[:, col : col + Ag, :],
                         *wyf, "yaz,ajy->ajz", prec,
@@ -386,7 +418,7 @@ class MxuLocalExecution(ExecutionBase):
         with jax.named_scope("z transform"):
             if self._phase is not None:
                 # enter the rotated layout on the space side (fused multiply)
-                cos_t, sin_t = self._phase_tables(phase)
+                cos_t, sin_t = self._phase_tables(phase_ops)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
             sre, sim = offt.complex_matmul(
                 sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec

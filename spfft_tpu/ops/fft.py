@@ -216,7 +216,8 @@ def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
 
 
 def plan_sparse_y_blocked(
-    xslot, ys, dim_y: int, real_dtype, num_sticks: int, dense_rows: int
+    xslot, ys, dim_y: int, real_dtype, num_sticks: int, dense_rows: int,
+    matrix_budget_mb: int | None = None,
 ):
     """Blocked (two-level) sparse-y planning — the win region ABOVE the
     per-slot crossover (``plan_sparse_y`` auto-disengages at Sy/Y >= 0.6,
@@ -273,6 +274,28 @@ def plan_sparse_y_blocked(
     frac = float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
     if mode == "auto" and padded_rows >= frac * dense_rows:
         return None
+    # callers that EMBED the bucket matrices as program constants (the SPMD
+    # engines' shard_map closures) bound them here; the local engine threads
+    # them as jit operands instead and passes no budget (at 512^3 the
+    # matrices are ~800 MB — measured overflowing the tunnel compile
+    # transport as constants, round 4)
+    if matrix_budget_mb is not None:
+        mat_bytes = (
+            4 * int(padded_rows) * dim_y * np.dtype(real_dtype).itemsize
+        )
+        if mat_bytes > matrix_budget_mb * (1 << 20):
+            if mode != "auto":
+                import warnings
+
+                warnings.warn(
+                    f"SPFFT_TPU_SPARSE_Y_BLOCKS={mode} forced the blocked "
+                    f"sparse-y stage, but its {mat_bytes >> 20} MB of bucket "
+                    f"matrices exceed this engine's embedded-constant budget "
+                    f"(SPFFT_TPU_SPARSE_Y_MATRIX_MB={matrix_budget_mb}); "
+                    "falling back to the dense y stage",
+                    stacklevel=3,
+                )
+            return None
     # stable per-slot stick enumeration (same j-ordering as plan_sparse_y)
     by_slot = np.argsort(xslot, kind="stable")
     cum = np.cumsum(counts) - counts
