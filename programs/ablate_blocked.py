@@ -1,0 +1,125 @@
+"""Per-stage ablation of the CURRENT MXU backward pipeline (blocked sparse-y
++ operand-threaded tables), at any size including 512^3 — plan operands ride
+the jit argument list, so the 512^3-class constants that broke
+microbench_ablate's closures (HTTP 413) never enter the program body.
+
+Methodology: DEPENDENT chains inside one jitted lax.scan (see
+microbench_ablate.py), scalar-fetch fence, stage prefixes of the backward
+pipeline so successive rows isolate stage costs by subtraction.
+
+Usage: python programs/ablate_blocked.py [--dim 512] [--reps 8]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+import spfft_tpu as sp
+from spfft_tpu.execution_mxu import MxuLocalExecution
+from spfft_tpu.ops import fft as offt
+from spfft_tpu.ops import lanecopy
+from spfft_tpu.parameters import make_local_parameters
+from spfft_tpu.types import TransformType
+
+
+def timeit_chain(fn, x0, ops, reps):
+    @jax.jit
+    def loop(a, b, ph):
+        def body(carry, _):
+            return fn(*carry, ph), ()
+
+        (r, i), _ = jax.lax.scan(body, (a, b), None, length=reps)
+        return r.ravel()[0] + i.ravel()[0]
+
+    float(loop(*x0, ops))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(loop(*x0, ops))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--reps", type=int, default=8)
+    args = ap.parse_args()
+    d = args.dim
+    trip = sp.create_spherical_cutoff_triplets(d, d, d, 0.659)
+    params = make_local_parameters(TransformType.C2C, d, d, d, trip)
+    ex = MxuLocalExecution(params, real_dtype=np.float32)
+    p = params
+    S, Z, Y, A = p.num_sticks, p.dim_z, p.dim_y, ex._num_x_active
+    N = p.num_values
+    blocked = ex._sparse_y_blocked
+    print(
+        f"plan: S={S} Z={Z} Y={Y} A={A} values={N} "
+        f"buckets={len(blocked) if blocked else 0} "
+        f"operands={len(ex.phase_operands)}",
+        flush=True,
+    )
+    prec = ex._precision
+    rt = ex.real_dtype
+    rng = np.random.default_rng(0)
+    vpair = tuple(
+        ex.put(rng.standard_normal(N).astype(np.float32)) for _ in range(2)
+    )
+    ops = ex.phase_operands
+
+    def phase_undo(sre, sim, ph):
+        if ex._phase is None:
+            return sre, sim
+        phase_ops, _ = ex._split_operands(ph)
+        cos_t, sin_t = ex._phase_tables(phase_ops)
+        return lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+
+    def blocked_y(sre, sim, ph):
+        _, mat_ops = ex._split_operands(ph)
+        return ex._blocked_y_backward(sre, sim, mat_ops)
+
+    def s_decompress(a, b, ph):
+        sre, sim = ex._decompress(a, b)
+        return sre.ravel()[:N], sim.ravel()[:N]
+
+    def s_decompress_z(a, b, ph):
+        sre, sim = ex._decompress(a, b)
+        sre, sim = offt.complex_matmul(sre, sim, *ex._wz_b, "sz,zk->sk", prec)
+        sre, sim = phase_undo(sre, sim, ph)
+        return sre.ravel()[:N], sim.ravel()[:N]
+
+    def s_through_y(a, b, ph):
+        sre, sim = ex._decompress(a, b)
+        sre, sim = offt.complex_matmul(sre, sim, *ex._wz_b, "sz,zk->sk", prec)
+        sre, sim = phase_undo(sre, sim, ph)
+        gre, gim = blocked_y(sre, sim, ph)
+        return gre.ravel()[:N], gim.ravel()[:N]
+
+    def s_full(a, b, ph):
+        gre, gim = ex._backward_impl(a, b, *ph)
+        return gre.ravel()[:N], gim.ravel()[:N]
+
+    rows = [
+        ("decompress", s_decompress),
+        ("decompress+z(+phase)", s_decompress_z),
+        ("... +blocked-y", s_through_y),
+        ("FULL backward", s_full),
+    ]
+    if blocked is None:
+        rows = [r for r in rows if "blocked" not in r[0]]
+    for name, fn in rows:
+        t = timeit_chain(fn, vpair, ops, args.reps)
+        print(f"{name:24s} {t*1e3:9.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

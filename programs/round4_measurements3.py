@@ -1,0 +1,140 @@
+"""Round-4 on-chip batch 3: staging-bandwidth probe + final re-pins.
+
+1. Host<->device staging bandwidth through the tunnel at several chunk
+   sizes — quantifies the floor under the f64 512^3 host-facing pair
+   (device compute measured 1.5 s; the host-facing pair 88-164 s, i.e.
+   ~98% staging) so BASELINE.md can report the split honestly.
+2. 512^3 default re-pin with the measured auto G rule (G=8 at 512).
+3. Headline re-pin with embedded matrices (the size-dependent operand rule).
+
+Appends to bench_results/round4_onchip3.json.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round4_onchip3.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round4_measurements3", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    # ---- 1: staging bandwidth probe ----
+    for mb in (64, 256, 1024):
+        try:
+            arr = np.random.default_rng(0).standard_normal((mb << 20) // 8)
+            t0 = time.perf_counter()
+            d = jax.device_put(arr, dev)
+            d.block_until_ready()
+            # a scalar fetch is the only reliable fence on this tunnel
+            float(jax.device_get(d[0]))
+            up = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _ = np.asarray(d)
+            down = time.perf_counter() - t0
+            record({
+                "name": f"staging_bandwidth_{mb}mb",
+                "put_s": round(up, 2),
+                "put_mb_s": round(mb / up, 1),
+                "fetch_s": round(down, 2),
+                "fetch_mb_s": round(mb / down, 1),
+            })
+            del d
+        except Exception as e:
+            record({"name": f"staging_bandwidth_{mb}mb",
+                    "error": f"{type(e).__name__}: {e}"})
+
+    # ---- 2+3: re-pins under the shipped auto rules ----
+    def time_chain(ex, re0, im0, chain):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                sre, sim = ex.trace_backward(*carry, phase=ph)
+                return (
+                    ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph),
+                    None,
+                )
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, wim = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, cim = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        return best
+
+    def measure(name, dim, chain):
+        try:
+            trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+            t = Transform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+                indices=trip, dtype=np.float32, engine="mxu",
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            best = time_chain(ex, re0, im0, chain)
+            ntot = dim**3
+            record({
+                "name": name, "dim": dim,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(2 * 5.0 * ntot * np.log2(ntot) / best / 1e9, 1),
+                "blocked_buckets": len(
+                    getattr(ex, "_sparse_y_blocked", None) or ()
+                ),
+                "n_operands": len(getattr(ex, "phase_operands", ())),
+            })
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    measure("c2c_256_s15_final", 256, 384)
+    measure("c2c_512_sph15_final", 512, 48)
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -156,14 +156,28 @@ class MxuLocalExecution(ExecutionBase):
         # be operands at large sizes: at 512^3 they are ~800 MB, which
         # overflowed the tunnel compile transport as embedded HLO constants
         # (measured round 4 — the same failure class as the phase tables).
+        # Below the budget they stay embedded: measured ~2% faster at 256^3
+        # (101 MB of matrices; bench_results/round4_onchip2.json
+        # c2c_256_s15_r4b_default vs round4_onchip.json r4_default).
         self._n_phase_ops = len(phase_ops)
         mat_ops = ()
         if self._sparse_y_blocked is not None:
-            for _, wyb, wyf in self._sparse_y_blocked:
-                mat_ops += (
-                    self.put(wyb[0]), self.put(wyb[1]),
-                    self.put(wyf[0]), self.put(wyf[1]),
-                )
+            mat_bytes = sum(
+                2 * (wyb[0].nbytes + wyf[0].nbytes)
+                for _, wyb, wyf in self._sparse_y_blocked
+            )
+            if mat_bytes > offt.sparse_y_matrix_budget_bytes():
+                for row_idx, wyb, wyf in self._sparse_y_blocked:
+                    mat_ops += (
+                        self.put(wyb[0]), self.put(wyb[1]),
+                        self.put(wyf[0]), self.put(wyf[1]),
+                    )
+                # the host copies' only consumer is the embedded fallback,
+                # unreachable once operands thread — free ~800 MB at 512^3
+                self._sparse_y_blocked = [
+                    (row_idx, None, None)
+                    for row_idx, _, _ in self._sparse_y_blocked
+                ]
         self.phase_operands = phase_ops + mat_ops
         self._decompress_plan = lanecopy.build_decompress_plan(
             self._vi, rows * Z, p.num_values
@@ -269,7 +283,44 @@ class MxuLocalExecution(ExecutionBase):
             base = 4 * b + (2 if forward else 0)
             return (mats[base], mats[base + 1])
         row_idx, wyb, wyf = self._sparse_y_blocked[b]
-        return wyf if forward else wyb
+        mat = wyf if forward else wyb
+        if mat is None:
+            raise RuntimeError(
+                "this plan's blocked-y matrices ride as jit operands "
+                "(above SPFFT_TPU_SPARSE_Y_MATRIX_MB); thread "
+                "phase=engine.phase_operands through the enclosing jit "
+                "when composing via trace_backward/trace_forward"
+            )
+        return mat
+
+    def _blocked_y_backward(self, sre, sim, mat_ops):
+        """Blocked sparse-y backward stage: per-bucket row gathers off the
+        EXACT stick table (replacing the expand gather), per-bucket batched y
+        contractions, bucket-major slot concatenation. Shared by
+        _backward_impl and the ablation harness (programs/ablate_blocked.py)
+        so stage timings always bracket the shipped pipeline."""
+        p = self.params
+        prec = self._precision
+        Z, A = p.dim_z, self._num_x_active
+        zero = jnp.zeros((1, Z), dtype=sre.dtype)
+        spad_re = jnp.concatenate([sre, zero])
+        spad_im = jnp.concatenate([sim, zero])
+        outs_re, outs_im = [], []
+        for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
+            idx = jnp.asarray(row_idx)
+            wyb = self._bucket_mats(mat_ops, b, forward=False)
+            ore, oim = offt.complex_matmul(
+                spad_re[idx], spad_im[idx], *wyb, "ajz,ajk->kaz", prec
+            )
+            outs_re.append(ore)
+            outs_im.append(oim)
+        gre = jnp.concatenate(outs_re, axis=1)
+        gim = jnp.concatenate(outs_im, axis=1)
+        if gre.shape[1] < A:  # compact_x_extent padding slots
+            padw = A - gre.shape[1]
+            gre = jnp.pad(gre, ((0, 0), (0, padw), (0, 0)))
+            gim = jnp.pad(gim, ((0, 0), (0, padw), (0, 0)))
+        return gre, gim
 
     def _backward_impl(self, values_re, values_im, *phase):
         p = self.params
@@ -303,29 +354,8 @@ class MxuLocalExecution(ExecutionBase):
                     *self._wy_b_sp, "ajz,ajk->kaz", prec,
                 )
         elif self._sparse_y_blocked is not None:
-            # blocked sparse-y: per-bucket row gathers off the EXACT stick
-            # table (replacing the expand gather), per-bucket batched y
-            # contractions, bucket-major slot concatenation
             with jax.named_scope("y transform"):
-                Z, A = p.dim_z, self._num_x_active
-                zero = jnp.zeros((1, Z), dtype=sre.dtype)
-                spad_re = jnp.concatenate([sre, zero])
-                spad_im = jnp.concatenate([sim, zero])
-                outs_re, outs_im = [], []
-                for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
-                    idx = jnp.asarray(row_idx)
-                    wyb = self._bucket_mats(mat_ops, b, forward=False)
-                    ore, oim = offt.complex_matmul(
-                        spad_re[idx], spad_im[idx], *wyb, "ajz,ajk->kaz", prec
-                    )
-                    outs_re.append(ore)
-                    outs_im.append(oim)
-                gre = jnp.concatenate(outs_re, axis=1)
-                gim = jnp.concatenate(outs_im, axis=1)
-                if gre.shape[1] < A:  # compact_x_extent padding slots
-                    padw = A - gre.shape[1]
-                    gre = jnp.pad(gre, ((0, 0), (0, padw), (0, 0)))
-                    gim = jnp.pad(gim, ((0, 0), (0, padw), (0, 0)))
+                gre, gim = self._blocked_y_backward(sre, sim, mat_ops)
         else:
             with jax.named_scope("expand"):
                 gre, gim = self._expand(sre, sim)

@@ -259,7 +259,10 @@ def plan_sparse_y_blocked(
         return None
     n_slots = int(xslot.max()) + 1
     counts = np.bincount(xslot, minlength=n_slots)
-    G = 4 if mode == "auto" else max(1, int(mode))
+    # measured bucket-count sweep (bench_results/round4_onchip{,2}.json):
+    # G=4 best at 256^3 (5.893 vs 5.979/6.031 ms), G=8 best at 512^3
+    # (76.3 vs 77.0 ms) — larger grids profit from tighter padding
+    G = (4 if dim_y <= 256 else 8) if mode == "auto" else max(1, int(mode))
     G = min(G, n_slots)
     order = np.argsort(-counts, kind="stable")  # slots by stick count, desc
     bounds = np.linspace(0, n_slots, G + 1).astype(np.int64)
@@ -336,6 +339,17 @@ def plan_sparse_y_blocked(
         "buckets": buckets,
         "row_of_stick": row_of_stick,
     }
+
+
+SPARSE_Y_MATRIX_MB_ENV = "SPFFT_TPU_SPARSE_Y_MATRIX_MB"
+
+
+def sparse_y_matrix_budget_bytes() -> int:
+    """Blocked-y bucket-matrix budget (bytes): above it the local engine
+    threads the matrices as jit operands and the SPMD engines (which embed
+    constants in their shard_map closures) veto engagement. One definition
+    so the two engines' thresholds cannot desynchronize."""
+    return int(os.environ.get(SPARSE_Y_MATRIX_MB_ENV, "128")) << 20
 
 
 F64_STAGE_MB_ENV = "SPFFT_TPU_F64_STAGE_MB"

@@ -103,19 +103,24 @@ class CopyPlan:
             for k, v in enumerate(vals):
                 per_pipe[k].append((r, v, (base[r] == v) & filled[r]))
 
-        # Pad pipe 0 to FULL block coverage when it is nearly full: holes would
-        # otherwise force the zeros + row-scatter-add path for the whole pipe,
-        # measured ~80% slower than the direct write at 256^3/15% (a spherical
-        # plan has a handful of empty blocks out of tens of thousands). Dummy
-        # entries gather the zero lead row under an all-zero mask.
-        if per_pipe:
-            covered = {e[0] for e in per_pipe[0]}
-            missing = [r for r in range(R) if r not in covered]
-            if missing and 10 * len(covered) >= 9 * R:
-                no_lanes = np.zeros(LANE, dtype=bool)
-                for r in missing:
-                    per_pipe[0].append((r, -LANE, no_lanes))
-                per_pipe[0].sort(key=lambda e: e[0])
+        # Pad well-covered pipes to FULL block coverage: a full pipe combines
+        # by direct write / dense array add, while a partial pipe needs the
+        # row-scatter-add path, whose TPU lowering is catastrophically slower
+        # per covered row (measured ~70 ns/row at 512^3/15%, where pipe 0's
+        # 69% coverage made decompress alone cost 19.3 ms of a 56 ms
+        # backward; the padded direct write moves the same data at ~row-gather
+        # bandwidth). Dummy entries gather the zero lead row under an all-zero
+        # mask, so padding costs one extra gathered row each — worth it down
+        # to low coverage fractions (``SPFFT_TPU_COPY_DENSE_FRAC``, default
+        # 0.1); genuinely sparse tail pipes keep the scatter-add.
+        dense_frac = float(os.environ.get("SPFFT_TPU_COPY_DENSE_FRAC", "0.1"))
+        no_lanes = np.zeros(LANE, dtype=bool)
+        for k, entries in enumerate(per_pipe):
+            covered = {e[0] for e in entries}
+            if len(covered) == R or len(covered) < dense_frac * R:
+                continue
+            entries.extend((r, -LANE, no_lanes) for r in range(R) if r not in covered)
+            entries.sort(key=lambda e: e[0])
 
         pipes = []
         # source view: one zero lead row (handles negative run bases: a run that

@@ -333,13 +333,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 # full extent the slot domain is all of x and the permutation
                 # bookkeeping buys nothing).
                 nvalid = int(valid.sum())
-                import os as _os
-
                 blk = offt.plan_sparse_y_blocked(
                     xslot_valid, sy[valid], Y, rt, nvalid, A * Y,
-                    matrix_budget_mb=int(
-                        _os.environ.get("SPFFT_TPU_SPARSE_Y_MATRIX_MB", "128")
-                    ),
+                    matrix_budget_mb=offt.sparse_y_matrix_budget_bytes() >> 20,
                 )
                 if blk is not None:
                     vrows = np.flatnonzero(valid)

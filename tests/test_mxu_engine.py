@@ -385,6 +385,38 @@ def test_sparse_y_blocked_stage(monkeypatch):
     assert tr._exec._sparse_y_blocked is None
 
 
+def test_sparse_y_blocked_operand_path(monkeypatch):
+    """SPFFT_TPU_SPARSE_Y_MATRIX_MB=0 forces the bucket matrices onto the
+    jit-operand path (the 512^3 compile-transport fix); results must match
+    the embedded-constant path exactly (same constants, different plumbing)."""
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, Transform
+
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_BLOCKS", "3")
+    rng = np.random.default_rng(31)
+    dx = dy = dz = 32
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.659)
+    v = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+    t_embed = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                        indices=trip, engine="mxu", dtype=np.float32)
+    assert len(t_embed._exec.phase_operands) == 0
+
+    monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_MATRIX_MB", "0")
+    t_ops = Transform(ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz,
+                      indices=trip, engine="mxu", dtype=np.float32)
+    assert len(t_ops._exec.phase_operands) == 12  # 3 buckets x 4 matrices
+    # host numpy matrices are freed once operands thread
+    assert all(wyb is None for _, wyb, _ in t_ops._exec._sparse_y_blocked)
+
+    out_e = t_embed.backward(v)
+    out_o = t_ops.backward(v)
+    np.testing.assert_array_equal(np.asarray(out_e), np.asarray(out_o))
+    back_e = t_embed.forward(scaling=ScalingType.FULL)
+    back_o = t_ops.forward(scaling=ScalingType.FULL)
+    np.testing.assert_array_equal(np.asarray(back_e), np.asarray(back_o))
+
+
 def test_sparse_y_auto_threshold(monkeypatch):
     """Unset (auto) sparse-y engages only below the measured Sy/Y < 0.6
     crossover; =0 forces it off even there; =1 forces it on above it."""
