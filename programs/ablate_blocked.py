@@ -21,7 +21,6 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
-import jax.numpy as jnp
 
 import spfft_tpu as sp
 from spfft_tpu.execution_mxu import MxuLocalExecution

@@ -20,9 +20,12 @@ callers use, reference: docs/source/details.rst:53):
    gathers (rows ``src0//128`` and ``+1``),
 3. lane alignment (``src0 % 128``) is resolved by grouping blocks by shift and
    taking one *static* 128-wide slice per shift group (<=128 static slices),
-4. block order is restored with one more row-gather, and holes/run boundaries are
-   applied with a static 0/1 mask multiply; pipe 0 (full coverage) writes the
-   output directly, later pipes row-scatter-add into their block subset.
+4. block order is restored with one more row-gather, and holes/run boundaries
+   are applied with a static 0/1 mask; pipes covering at least
+   ``SPFFT_TPU_COPY_DENSE_FRAC`` of the blocks are padded to FULL coverage
+   (zero-row dummies) and combine by direct write / dense array add — the
+   row-scatter-add lowering costs ~70 ns per covered row on TPU — while
+   genuinely sparse tail pipes keep the row-granular scatter-add.
 
 Everything is planned host-side at Transform creation; at runtime the copy is a
 handful of fused row-gathers, slices, multiplies and row-granular scatter-adds —
@@ -178,9 +181,11 @@ class CopyPlan:
 
     def _apply_stacked(self, src3, dtype):
         """The copy pipeline on a stacked (B, src_rows, LANE) source ->
-        (B, num_dst/LANE, LANE). Single implementation behind both public
-        entry points, so the miscompile workaround and mask semantics cannot
-        diverge between them."""
+        (B, num_dst/LANE, LANE). Used only by the opt-in pair-copy path;
+        the default single-part path is :meth:`_apply_single`, an axis-shifted
+        twin (B=1 batch dims penalize the TPU gather lowering ~36%). ANY
+        change to the miscompile barrier or mask semantics MUST be mirrored
+        between the two bodies — tests/test_lanecopy_shapes.py pins both."""
         B = src3.shape[0]
         out = None
         for pipe in self.pipes:
@@ -243,10 +248,56 @@ class CopyPlan:
             out = jnp.zeros((B, self.num_dst // LANE, LANE), dtype=dtype)
         return out
 
+    def _apply_single(self, src2, dtype):
+        """The copy pipeline on an unbatched (src_rows, LANE) source ->
+        (num_dst/LANE, LANE). Same stages as :meth:`_apply_stacked` minus the
+        leading batch dim, which XLA:TPU's gather lowering penalizes ~36%
+        even at B=1 (measured at 512^3 row counts, BASELINE.md round 4 —
+        the same slow-lowering class as the rejected pair-copy stacking)."""
+        out = None
+        for pipe in self.pipes:
+            rows = jnp.asarray(pipe.rows_sorted)
+            if pipe.shift_counts[0] == pipe.rows_sorted.size:
+                aligned = jnp.take(src2, rows, axis=0)
+            else:
+                w = jnp.concatenate(
+                    [jnp.take(src2, rows, axis=0), jnp.take(src2, rows + 1, axis=0)],
+                    axis=1,
+                )  # (Rk, 2*LANE), covered blocks in shift order
+                pieces = []
+                off = 0
+                for t, c in enumerate(pipe.shift_counts):
+                    if c == 0:
+                        continue
+                    pieces.append(jax.lax.slice(w, (off, t), (off + c, t + LANE)))
+                    off += c
+                # miscompile workaround — see _apply_stacked
+                if len(pieces) > 1:
+                    pieces = list(jax.lax.optimization_barrier(tuple(pieces)))
+                aligned = jnp.concatenate(pieces, axis=0)
+                aligned = jnp.take(aligned, jnp.asarray(pipe.inv_order), axis=0)
+            if pipe.mask is None:
+                lane = jnp.arange(LANE, dtype=jnp.int32)[None, :]
+                lo = jnp.asarray(pipe.mask_starts)[:, None]
+                hi = jnp.asarray(pipe.mask_ends)[:, None]
+                contrib = jnp.where((lane >= lo) & (lane < hi), aligned, 0)
+            else:
+                contrib = jnp.where(jnp.asarray(pipe.mask > 0), aligned, 0)
+            if pipe.block_ids is None:
+                out = contrib if out is None else out + contrib
+            else:
+                if out is None:
+                    out = jnp.zeros((self.num_dst // LANE, LANE), dtype=dtype)
+                out = out.at[jnp.asarray(pipe.block_ids)].add(
+                    contrib, unique_indices=True, mode="drop"
+                )
+        if out is None:
+            out = jnp.zeros((self.num_dst // LANE, LANE), dtype=dtype)
+        return out
+
     def apply(self, flat):
         """Execute the copy: flat (num_src,) -> (num_dst/LANE, LANE)."""
-        src3 = self.source_view(flat)[None]
-        return self._apply_stacked(src3, flat.dtype)[0]
+        return self._apply_single(self.source_view(flat), flat.dtype)
 
     def apply_pair(self, flat_a, flat_b):
         """Execute the copy on two same-shaped flats with ONE gather per pipe.
