@@ -1,0 +1,156 @@
+"""Round-4 on-chip batch 4 (final): pencil engine on the chip + R2C re-pin.
+
+- The 2-D pencil MXU engine has only ever run on the virtual CPU mesh; a
+  1x1 pencil mesh on the chip proves the pipeline (two exchanges, slot
+  permutation, x-matrix folding) compiles and performs on real hardware.
+- R2C 128^3 dense re-pin under the round-4 engine (dense-promoted copy
+  plans touch R2C paths too).
+
+Appends to bench_results/round4_onchip4.json.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round4_onchip4.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round4_measurements4", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def flops_pair(dim):
+        n = dim**3
+        return 2 * 5.0 * n * np.log2(n)
+
+    def chain_time(ex, re0, im0, chain, r2c=False):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                if r2c:
+                    space = ex.trace_backward(carry[0], carry[1], phase=ph)
+                    out = ex.trace_forward(space, None, ScalingType.FULL, phase=ph)
+                else:
+                    sre, sim = ex.trace_backward(*carry, phase=ph)
+                    out = ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph)
+                return out, None
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, wim = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    # ---- R2C 128^3 dense re-pin ----
+    try:
+        dim = 128
+        xs, ys, zs = np.meshgrid(
+            np.arange(dim // 2 + 1), np.arange(dim), np.arange(dim),
+            indexing="ij",
+        )
+        # hermitian non-redundant dense set (reference benchmark model)
+        keep = ~((xs == 0) & (ys > dim // 2))
+        trip = np.stack(
+            [xs[keep].ravel(), ys[keep].ravel(), zs[keep].ravel()], 1
+        ).astype(np.int32)
+        t = Transform(
+            ProcessingUnit.GPU, TransformType.R2C, dim, dim, dim,
+            indices=trip, dtype=np.float32, engine="mxu",
+        )
+        ex = t._exec
+        rng = np.random.default_rng(0)
+        n = len(trip)
+        re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        best, _ = chain_time(ex, re0, im0, 512, r2c=True)
+        record({
+            "name": "r2c_128_dense_r4",
+            "ms_per_pair": round(best * 1e3, 3),
+            "gflops": round(flops_pair(dim) / best / 1e9, 1),
+        })
+    except Exception as e:
+        record({"name": "r2c_128_dense_r4", "error": f"{type(e).__name__}: {e}"})
+
+    # ---- pencil 1x1 on chip, 256^3 C2C 15% spherical ----
+    try:
+        dim = 256
+        trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+        mesh = sp.make_fft_mesh2(1, 1)
+        t = DistributedTransform(
+            ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+            mesh=mesh, dtype=np.float32, engine="mxu",
+        )
+        ex = t._exec
+        rng = np.random.default_rng(0)
+        pairs = ex.pad_values([
+            (rng.standard_normal(t.num_local_elements(0))
+             + 1j * rng.standard_normal(t.num_local_elements(0))).astype(np.complex64)
+        ])
+        best, err = chain_time(ex, pairs[0], pairs[1], 96)
+        record({
+            "name": "pencil1x1_c2c_256_sph15_onchip",
+            "ms_per_pair": round(best * 1e3, 3),
+            "gflops": round(flops_pair(dim) / best / 1e9, 1),
+            "roundtrip_err": err,
+            "engine": t._engine,
+        })
+    except Exception as e:
+        record({"name": "pencil1x1_c2c_256_sph15_onchip",
+                "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,3 +58,43 @@ def test_invalid_weights_rejected(bad):
 def test_zero_shards_rejected():
     with pytest.raises(InvalidParameterError):
         distribute_triplets(np.zeros((0, 3), dtype=np.int64), 0, 4)
+
+
+def test_layout_mode_column_local_and_centered():
+    """layout=(P1, P2): whole sticks, column-local x (every stick of column
+    group a lands on a shard of column a), value conservation — including
+    with CENTERED indices, where the storage x of a negative caller x folds
+    onto the same physical column (the rint key-recovery path)."""
+    import spfft_tpu as sp
+
+    dx = dy = dz = 16
+    # centered spherical set: caller x spans negatives
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, 0.8)
+    assert (trip[:, 0] < 0).any(), "test needs centered indices"
+    P1, P2 = 2, 2
+    per = distribute_triplets(trip, P1 * P2, dy, layout=(P1, P2), dim_x=dx)
+    # value conservation + whole sticks
+    assert sum(len(p) for p in per) == len(trip)
+    _whole_sticks(per, dy)
+    # column-locality in STORAGE x: each physical x column appears on the
+    # shards of exactly one column group
+    col_of_x = {}
+    for r, part in enumerate(per):
+        col = r // P2
+        xs = np.where(part[:, 0] < 0, part[:, 0] + dx, part[:, 0])
+        for x in np.unique(xs):
+            assert col_of_x.setdefault(int(x), col) == col, (
+                f"storage x={x} split across column groups"
+            )
+    # balanced-ish: no column group empty
+    assert len({c for c in col_of_x.values()}) == P1
+
+
+def test_layout_mode_validation():
+    t = random_sparse_triplets(np.random.default_rng(0), 8, 8, 8, 0.5)
+    with pytest.raises(InvalidParameterError):
+        distribute_triplets(t, 4, 8, layout=(3, 2), dim_x=8)  # 3*2 != 4
+    with pytest.raises(InvalidParameterError):
+        distribute_triplets(t, 4, 8, layout=(2, 2))  # dim_x required
+    with pytest.raises(InvalidParameterError):
+        distribute_triplets(t, 4, 8, weights=[1, 1, 1, 1], layout=(2, 2), dim_x=8)
