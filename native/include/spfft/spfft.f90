@@ -27,6 +27,15 @@ module spfft
   integer(c_int), parameter :: SPFFT_HOST_EXECUTION_ERROR = 11
   integer(c_int), parameter :: SPFFT_FFTW_ERROR = 12
   integer(c_int), parameter :: SPFFT_GPU_ERROR = 13
+  integer(c_int), parameter :: SPFFT_GPU_PRECEDING_ERROR = 14
+  integer(c_int), parameter :: SPFFT_GPU_SUPPORT_ERROR = 15
+  integer(c_int), parameter :: SPFFT_GPU_ALLOCATION_ERROR = 16
+  integer(c_int), parameter :: SPFFT_GPU_LAUNCH_ERROR = 17
+  integer(c_int), parameter :: SPFFT_GPU_NO_DEVICE_ERROR = 18
+  integer(c_int), parameter :: SPFFT_GPU_INVALID_VALUE_ERROR = 19
+  integer(c_int), parameter :: SPFFT_GPU_INVALID_DEVICE_PTR_ERROR = 20
+  integer(c_int), parameter :: SPFFT_GPU_COPY_ERROR = 21
+  integer(c_int), parameter :: SPFFT_GPU_FFT_ERROR = 22
 
   ! --- SpfftExchangeType (spfft/types.h) ---
   integer(c_int), parameter :: SPFFT_EXCH_DEFAULT = 0

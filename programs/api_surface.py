@@ -60,6 +60,49 @@ def c_functions(paths=C_HEADER_PATHS) -> dict:
     return out
 
 
+C_CONSTANT_HEADER_NAMES = ("errors.h", "types.h")
+C_CONSTANT_HEADER_PATHS = tuple(
+    ROOT / "native" / "include" / "spfft" / name for name in C_CONSTANT_HEADER_NAMES
+)
+
+
+def fortran_constants(path: Path = F90_PATH) -> dict:
+    """{NAME: value} for every ``integer(c_int), parameter`` constant.
+
+    Handles both one-constant-per-statement declarations and the reference
+    module's continuation-list style, where a single ``parameter ::`` heads
+    many '&'-continued ``NAME = value`` entries
+    (reference: include/spfft/spfft.f90:54-110)."""
+    text = join_continuations(path.read_text())
+    out = {}
+    for stmt in re.finditer(
+        r"integer\s*\(\s*c_int\s*\)\s*,\s*parameter\s*::([^\n]*)",
+        text,
+        re.IGNORECASE,
+    ):
+        for m in re.finditer(r"(SPFFT_\w+)\s*=\s*(-?\d+)", stmt.group(1)):
+            out[m.group(1)] = int(m.group(2))
+    return out
+
+
+def c_enum_constants(paths=C_CONSTANT_HEADER_PATHS) -> dict:
+    """{NAME: value} for every SPFFT_* enumerator, explicit or implicit."""
+    out = {}
+    for path in paths:
+        text = strip_c_comments(path.read_text())
+        for body in re.finditer(r"\benum\s+\w+\s*\{([^}]*)\}", text):
+            counter = 0
+            for entry in body.group(1).split(","):
+                m = re.match(r"\s*(SPFFT_[A-Z0-9_]+)\s*(?:=\s*(-?\d+))?\s*$", entry)
+                if m is None:
+                    continue
+                if m.group(2) is not None:
+                    counter = int(m.group(2))
+                out[m.group(1)] = counter
+                counter += 1
+    return out
+
+
 REFERENCE_INCLUDE = Path("/root/reference/include/spfft")
 
 

@@ -23,7 +23,9 @@ sys.path.insert(0, str(ROOT / "programs"))
 from api_surface import (  # noqa: E402
     F90_PATH,
     REFERENCE_INCLUDE,
+    c_enum_constants,
     c_functions,
+    fortran_constants,
     fortran_functions,
     reference_only_names,
 )
@@ -66,6 +68,50 @@ def test_no_reference_only_c_api_names():
     if not REFERENCE_INCLUDE.is_dir():
         pytest.skip("reference tree not present")
     assert reference_only_names() == []
+
+
+def test_constants_match_between_fortran_and_c_both_directions():
+    """Every C enum constant has a Fortran twin with the same value, and vice
+    versa.
+
+    Round-4 drift class: the module carried all 80 functions but stopped its
+    error constants at SPFFT_GPU_ERROR=13 while errors.h defined the full GPU
+    tier 14-22 (reference: include/spfft/spfft.f90:59-77 defines all 23), and
+    no checker noticed because only names/arity of *functions* were machine-
+    checked. This test closes that hole for constants in both directions."""
+    fortran = fortran_constants()
+    c = c_enum_constants()
+    assert fortran and c, "constant parsers returned nothing"
+    missing_in_fortran = sorted(set(c) - set(fortran))
+    assert not missing_in_fortran, (
+        f"C constants with no Fortran twin: {missing_in_fortran}"
+    )
+    missing_in_c = sorted(set(fortran) - set(c))
+    assert not missing_in_c, (
+        f"Fortran constants with no C definition: {missing_in_c}"
+    )
+    mismatched = {
+        name: (fortran[name], c[name]) for name in c if fortran[name] != c[name]
+    }
+    assert not mismatched, f"value mismatches (fortran, c): {mismatched}"
+
+
+def test_reference_fortran_constants_all_present():
+    """Every constant the reference Fortran module defines exists here with
+    the same value (reference: include/spfft/spfft.f90:28-110); extensions
+    beyond the reference (e.g. the BF16 exchange values) are allowed."""
+    ref_f90 = REFERENCE_INCLUDE / "spfft.f90"
+    if not ref_f90.is_file():
+        pytest.skip("reference tree not present")
+    ref = fortran_constants(ref_f90)
+    ours = fortran_constants()
+    assert ref, "reference constant parser returned nothing"
+    holes = {
+        name: value
+        for name, value in ref.items()
+        if ours.get(name) != value
+    }
+    assert not holes, f"reference constants missing or mismatched here: {holes}"
 
 
 def test_fortran_module_compiles_when_compiler_available():
