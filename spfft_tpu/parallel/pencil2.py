@@ -21,6 +21,12 @@ slabs) -> x-FFT. Forward reverses. Useful parallelism now scales to
 FFT frameworks (AccFFT / mpi4py-fft lineage), adapted to sparse z-stick input
 (which removes one of their three transposes: sticks are already z-local).
 
+The intermediate y-pencil grid is laid out (Y, Ax, Lz) with z MINOR, so every
+pack/unpack around both exchanges moves whole contiguous z-rows — compiled as
+row gathers and static slices, never element scatters (the TPU-fast form; see
+the "exchange-A pack/unpack" section). The space-domain boundary stays the
+(Lz, Ly, X) slab contract.
+
 Wire discipline is padded-uniform (BUFFERED) on both exchanges; ``*_FLOAT`` /
 ``*_BF16`` wire casts apply around each collective. R2C works because both
 hermitian completions stay shard-local: the (0,0) stick fill happens on its
@@ -271,6 +277,23 @@ class Pencil2Execution(PaddingHelpers):
                 cols[s, a, j] = sy_all[s, r] * Ax + slot_of_x[sx_all[s, r]]
                 fill[s, a] = j + 1
         self._rows, self._cols = rows, cols
+        # Inverse tables for the ROW-GRANULAR exchange-A pack/unpack (see the
+        # "exchange-A pack/unpack" section below): destination grid row
+        # (y*Ax + slot) -> owning source row d*SG + j in the received block
+        # stack (per x-group a; sentinel Pn*SG -> zero row), and stick row
+        # r -> its gathered-stack row a*SG + j (per shard; sentinel P1*SG).
+        inv_rows = np.full((P1, Y * Ax), Pn * SG, dtype=np.int32)
+        stick_src = np.full((Pn, S), P1 * SG, dtype=np.int32)
+        for s in range(Pn):
+            for a in range(P1):
+                for j in range(SG):
+                    r = rows[s, a, j]
+                    if r >= S:
+                        continue
+                    inv_rows[a, cols[s, a, j]] = s * SG + j
+                    stick_src[s, r] = a * SG + j
+        self._inv_rows = inv_rows
+        self._stick_src = stick_src
         # x reassembly: global Xf column of (group q, slot g); sentinel Xf
         xcol = np.full(P1 * Ax, Xf, dtype=np.int64)
         xcol[group_of_x[ux] * Ax + slot_of_x[ux]] = ux
@@ -294,9 +317,9 @@ class Pencil2Execution(PaddingHelpers):
         # Exchange A blocks are (P, SG, Lz) with valid rectangle
         # (counts[s, a(d)], lz[b(d)]) — stick-count imbalance across x-groups
         # and z-slab raggedness both shrink the wire. Exchange B blocks are
-        # (P1, Lz, Ly*Ax) with valid cols ly[q]*Ax; its rotation spans only the
-        # balanced y split, so its savings are usually small — A carries the
-        # discipline's value. Reference: MPI_Alltoallv
+        # (P1, Ly, Ax*Lz) with valid rows ly[q] (z-minor row layout); its
+        # rotation spans only the balanced y split, so its savings are usually
+        # small — A carries the discipline's value. Reference: MPI_Alltoallv
         # (transpose_mpi_compact_buffered_host.cpp:183-200).
         if self.exchange_type in _RAGGED:
             from .ragged import (
@@ -317,11 +340,11 @@ class Pencil2Execution(PaddingHelpers):
             d = np.arange(Pn)
             rows_a = counts[:, d // P2]  # (P, P): rows_a[s, d] = counts[s, a(d)]
             cols_a = np.broadcast_to(lz[d % P2], (Pn, Pn))
-            rows_b = np.full((P1, P1), Lz, dtype=np.int64)
-            cols_b = np.broadcast_to((ly * Ax), (P1, P1))
+            rows_b = np.broadcast_to(ly, (P1, P1))  # valid rows = dest y-length
+            cols_b = np.full((P1, P1), int(Ax) * Lz, dtype=np.int64)
             self._ragged2 = {
                 (AX1, AX2): cls((AX1, AX2), (P1, P2), rows_a, cols_a, SG, Lz),
-                (AX1,): cls((AX1,), (P1,), rows_b, cols_b, Lz, Ly * Ax),
+                (AX1,): cls((AX1,), (P1,), rows_b, cols_b, Ly, int(Ax) * Lz),
             }
 
         # ---- sharded constants + compiled pipelines ----
@@ -470,44 +493,98 @@ class Pencil2Execution(PaddingHelpers):
     def local_slice_size(self, shard: int) -> int:
         return self.local_z_length(shard) * self.local_y_length(shard) * self.params.dim_x
 
-    # ---- shared exchange-A index maps (used by both compute paths) ------------
+    # ---- exchange-A pack/unpack: row-granular, z-minor layout -----------------
     #
-    # The SAME map serves gather and scatter on each side: the stick-side map
-    # indexes the padded (S*Z + 1) stick flats (pack A backward / unpack A
-    # forward), the plane-side map indexes the (Lz*Y*Ax + 1) y-pencil flats
-    # (unpack A backward / pack A forward); both sentinel into the trailing
-    # zero/trash slot.
+    # Every transfer moves whole z-rows: the intermediate y-pencil grid is laid
+    # out (Y, Ax, Lz) with z MINOR, so each (stick, z-window) is one contiguous
+    # row and pack/unpack compile to whole-row gathers plus static slices — the
+    # TPU-fast form (ops/lanecopy.py's measured ~0.01 ns/element row-gather
+    # path). The earlier (Lz, Y, Ax) layout forced (P, SG, Lz) ELEMENT
+    # scatters/gathers here (~20 ns/element), which made on-chip pencil runs
+    # ~230x slower than the local engine (round-4 root cause, ROADMAP 8b).
+    # Reference pack/unpack being matched:
+    # src/transpose/transpose_mpi_compact_buffered_host.cpp:109-175.
 
-    def _stickside_map(self, s_me):
-        """(P, SG, Lz) int32 map into the (S*Z + 1) stick flats."""
+    def _pack_a(self, sticks, s_me):
+        """(S, Z) stick table -> (P, SG, Lz) exchange-A blocks: one whole-row
+        gather of my sticks (sentinel rows -> zeros), then one static z-window
+        slice per destination z-slab (zero-padded to Lz)."""
         S, Z = self._S, self.params.dim_z
-        Lz = self._Lz
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        zo_t = jnp.asarray(self._zo.astype(np.int32))
-        my_rows = jnp.asarray(self._rows)[s_me]  # (P1, SG), sentinel S
-        j_l = jnp.arange(Lz, dtype=jnp.int32)
-        src = (
-            my_rows[:, None, :, None] * Z
-            + zo_t[None, :, None, None]
-            + j_l[None, None, None, :]
+        P1, P2, SG, Lz = self.P1, self.P2, self._SG, self._Lz
+        rows = jnp.asarray(self._rows)[s_me].reshape(-1)  # (P1*SG,), sentinel S
+        padded = jnp.concatenate([sticks, jnp.zeros((1, Z), sticks.dtype)])
+        g = jnp.take(padded, rows, axis=0)  # (P1*SG, Z)
+        wins = []
+        for b in range(P2):
+            lz, zo = int(self._lz[b]), int(self._zo[b])
+            w = jax.lax.slice(g, (0, zo), (P1 * SG, zo + lz))
+            if lz < Lz:
+                w = jnp.pad(w, ((0, 0), (0, Lz - lz)))
+            wins.append(w)
+        buf = jnp.stack(wins, axis=1)  # (P1*SG, P2, Lz)
+        return buf.reshape(P1, SG, P2, Lz).transpose(0, 2, 1, 3).reshape(
+            P1 * P2, SG, Lz
         )
-        ok = (my_rows[:, None, :, None] < S) & (
-            j_l[None, None, None, :] < lz_t[None, :, None, None]
-        )
-        return jnp.where(ok, src, S * Z).reshape(self.P1 * self.P2, self._SG, Lz)
 
-    def _planeside_map(self, a_me, b_me):
-        """(P, SG, Lz) int32 map into the (Lz*Y*Ax + 1) y-pencil flats."""
+    def _unpack_a(self, recv, a_me):
+        """(P, SG, Lz) received blocks -> (Y, Ax, Lz) y-pencil grid: one
+        whole-row gather through the per-group inverse row table."""
         Y, Ax, Lz = self.params.dim_y, self._Ax, self._Lz
-        lz_t = jnp.asarray(self._lz.astype(np.int32))
-        cols = jnp.asarray(self._cols)[:, a_me, :]  # (P, SG), sentinel Y*Ax
-        lz_me = lz_t[b_me]
-        dest = (
-            jnp.arange(Lz, dtype=jnp.int32)[None, None, :] * (Y * Ax)
-            + cols[:, :, None]
+        flat = recv.reshape(self.P1 * self.P2 * self._SG, Lz)
+        flat = jnp.concatenate([flat, jnp.zeros((1, Lz), recv.dtype)])
+        inv = jnp.asarray(self._inv_rows)[a_me]  # (Y*Ax,), sentinel -> zero row
+        return jnp.take(flat, inv, axis=0).reshape(Y, Ax, Lz)
+
+    def _pack_a_rev(self, grid, a_me, b_me):
+        """(Y, Ax, Lz) grid -> (P, SG, Lz) blocks (forward direction): one
+        whole-row gather of each destination's stick rows."""
+        Y, Ax, Lz = self.params.dim_y, self._Ax, self._Lz
+        Pn, SG = self.P1 * self.P2, self._SG
+        g2 = grid.reshape(Y * Ax, Lz)
+        g2 = jnp.concatenate([g2, jnp.zeros((1, Lz), grid.dtype)])
+        cols = jnp.asarray(self._cols)[:, a_me, :].reshape(-1)  # (P*SG,)
+        buf = jnp.take(g2, cols, axis=0).reshape(Pn, SG, Lz)
+        # ship zeros beyond my z-length (padded windows must stay clean)
+        lz_me = jnp.asarray(self._lz.astype(np.int32))[b_me]
+        return jnp.where(jnp.arange(Lz)[None, None, :] < lz_me, buf, 0)
+
+    def _unpack_a_rev(self, recv, s_me):
+        """(P, SG, Lz) received z-windows -> (S, Z) stick table (forward
+        direction): static window compaction, then one whole-row gather."""
+        S, Z = self._S, self.params.dim_z
+        P1, P2, SG, Lz = self.P1, self.P2, self._SG, self._Lz
+        big = recv.reshape(P1, P2, SG, Lz).transpose(0, 2, 1, 3)  # (P1, SG, P2, Lz)
+        if int(self._lz.min()) == Lz:
+            rows = big.reshape(P1 * SG, Z)
+        else:
+            parts = [
+                jax.lax.slice(big, (0, 0, b, 0), (P1, SG, b + 1, int(self._lz[b])))
+                for b in range(P2)
+            ]
+            rows = jnp.concatenate(
+                [pc.reshape(P1, SG, -1) for pc in parts], axis=-1
+            ).reshape(P1 * SG, Z)
+        rows = jnp.concatenate([rows, jnp.zeros((1, Z), recv.dtype)])
+        src = jnp.asarray(self._stick_src)[s_me]  # (S,), sentinel -> zero row
+        return jnp.take(rows, src, axis=0)
+
+    def _pack_b(self, grid):
+        """(Y, Ax, Lz) grid -> (P1, Ly, Ax, Lz) exchange-B blocks: one
+        whole-row gather of each destination's y-rows."""
+        Ax, Lz, Ly, P1 = self._Ax, self._Lz, self._Ly, self.P1
+        gp = jnp.concatenate(
+            [grid, jnp.zeros((1, Ax, Lz), grid.dtype)], axis=0
         )
-        ok = (cols[:, :, None] < Y * Ax) & (jnp.arange(Lz)[None, None, :] < lz_me)
-        return jnp.where(ok, dest, Lz * (Y * Ax))
+        return jnp.take(gp, jnp.asarray(self._ymap), axis=0).reshape(
+            P1, Ly, Ax, Lz
+        )
+
+    def _unpack_b_rev(self, recvb):
+        """(P1, Ly, Ax, Lz) received blocks -> (Y, Ax, Lz) grid (forward
+        direction): one whole-row gather through the y inverse map."""
+        Ax, Lz, Ly, P1 = self._Ax, self._Lz, self._Ly, self.P1
+        rows = recvb.reshape(P1 * Ly, Ax, Lz)
+        return jnp.take(rows, jnp.asarray(self._yinv), axis=0)
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
@@ -536,46 +613,42 @@ class Pencil2Execution(PaddingHelpers):
         sticks = jnp.fft.ifft(sticks, axis=1)
 
         # pack A: my sticks split by destination (x-group a', z-slab b')
-        sflat = jnp.concatenate([sticks.reshape(-1), jnp.zeros(1, self.complex_dtype)])
-        buf = sflat[self._stickside_map(s_me)]
+        buf = self._pack_a(sticks, s_me)
 
         # exchange A: one collective over BOTH mesh axes (flat row-major (a, b))
         recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz): recv[s] = s's sticks here
 
-        # unpack A -> y-pencil grid (Lz, Y, Ax): all sticks in my x-group, my z
-        g = jnp.zeros(Lz * Y * Ax + 1, dtype=self.complex_dtype)
-        g = g.at[self._planeside_map(a_me, b_me)].set(recv)
-        grid = g[: Lz * Y * Ax].reshape(Lz, Y, Ax)
+        # unpack A -> y-pencil grid (Y, Ax, Lz): all sticks in my x-group, my z
+        grid = self._unpack_a(recv, a_me)
 
         if self.is_r2c and self._have_x0:
             # x == 0 plane hermitian fill along y on its (group, slot) owner,
             # which has the FULL y extent here (z is space-domain)
             g0, s0 = self._x0_group, self._x0_slot
-            col = symmetry.hermitian_fill_1d(grid[:, :, s0], axis=1)
-            grid = grid.at[:, :, s0].set(
-                jnp.where(a_me == g0, col, grid[:, :, s0])
+            col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
+            grid = grid.at[:, s0, :].set(
+                jnp.where(a_me == g0, col, grid[:, s0, :])
             )
 
-        grid = jnp.fft.ifft(grid, axis=1)
+        grid = jnp.fft.ifft(grid, axis=0)
 
-        # pack B: slice each destination's y-rows (within my fixed z-slab)
-        gpad = jnp.concatenate([grid, jnp.zeros((Lz, 1, Ax), self.complex_dtype)], axis=1)
-        bufb = jnp.take(gpad, jnp.asarray(self._ymap), axis=1)  # (Lz, P1*Ly, Ax)
-        bufb = bufb.reshape(Lz, P1, Ly, Ax).transpose(1, 0, 2, 3)
+        # pack B: gather each destination's y-rows (within my fixed z-slab)
+        bufb = self._pack_b(grid)
 
         # exchange B: within the row (fixed z-slab), over the x-group axis
-        recvb = self._exchange(bufb, (AX1,))  # (P1, Lz, Ly, Ax): q's x-cols, my y
+        recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, Lz): q's x-cols, my y
 
         # assemble the full frequency-x extent and transform
-        h = recvb.transpose(1, 2, 0, 3).reshape(Lz, Ly, P1 * Ax)
-        slab = jnp.zeros((Lz, Ly, Xf + 1), dtype=self.complex_dtype)
-        slab = slab.at[:, :, jnp.asarray(self._xcol)].set(h, mode="drop")
-        slab = slab[:, :, :Xf]
+        h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        slab = jnp.zeros((Ly, Xf + 1, Lz), dtype=self.complex_dtype)
+        slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
+        slab = slab[:, :Xf, :]
         total = np.asarray(p.total_size, self.real_dtype)
         if self.is_r2c:
-            out = jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype)
-            return (out * total)[None]
-        out = jnp.fft.ifft(slab, axis=2) * total
+            out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(self.real_dtype)
+            return (out.transpose(2, 0, 1) * total)[None]
+        out = jnp.fft.ifft(slab, axis=1) * total
+        out = out.transpose(2, 0, 1)  # (Lz, Ly, X) space slab contract
         return out.real[None], out.imag[None]
 
     def _forward_impl(self, space_re, *rest, scale):
@@ -598,34 +671,26 @@ class Pencil2Execution(PaddingHelpers):
             freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
 
         # split into x-group columns and send each group home (exchange B rev)
+        fq = freq.transpose(1, 2, 0)  # (Ly, Xf, Lz) z-minor
         hpad = jnp.concatenate(
-            [freq, jnp.zeros((Lz, Ly, 1), self.complex_dtype)], axis=2
+            [fq, jnp.zeros((Ly, 1, Lz), self.complex_dtype)], axis=1
         )
-        h = jnp.take(hpad, jnp.asarray(self._xcol), axis=2)  # (Lz, Ly, P1*Ax)
-        bufb = h.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
-        # (P1, Lz, Ly, Ax): my x-group, q's y
+        h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)  # (Ly, P1*Ax, Lz)
+        bufb = h.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
+        # (P1, Ly, Ax, Lz): my x-group, q's y
         recvb = self._exchange(bufb, (AX1,), reverse=True)
 
         # reassemble the full y extent of my x-group
-        rows = recvb.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax)
-        rpad = jnp.concatenate(
-            [rows, jnp.zeros((Lz, 1, Ax), self.complex_dtype)], axis=1
-        )
-        grid = jnp.take(rpad, jnp.asarray(self._yinv), axis=1)  # (Lz, Y, Ax)
-        grid = jnp.fft.fft(grid, axis=1)
+        grid = self._unpack_b_rev(recvb)  # (Y, Ax, Lz)
+        grid = jnp.fft.fft(grid, axis=0)
 
         # exchange A reverse: each stick's z-chunk back to its owner
-        gflat = jnp.concatenate(
-            [grid.reshape(-1), jnp.zeros(1, self.complex_dtype)]
-        )
-        buf = gflat[self._planeside_map(a_me, b_me)]  # (P, SG, Lz)
+        buf = self._pack_a_rev(grid, a_me, b_me)  # (P, SG, Lz)
         # (P, SG, Lz): my sticks, p's z
         recv = self._exchange(buf, (AX1, AX2), reverse=True)
 
-        # scatter into (S, Z): source p = (a', b') holds my group-a' sticks on z in b'
-        sflat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-        sflat = sflat.at[self._stickside_map(s_me)].set(recv)
-        sticks = jnp.fft.fft(sflat[: S * Z].reshape(S, Z), axis=1)
+        # reassemble my (S, Z) stick table and transform
+        sticks = jnp.fft.fft(self._unpack_a_rev(recv, s_me), axis=1)
 
         values = jnp.take(sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0)
         if scale is not None:

@@ -104,45 +104,36 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
                 cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
-        # pack A: my sticks split by destination (x-group, z-slab)
+        # pack A: my sticks split by destination (x-group, z-slab) — whole-row
+        # gathers + static window slices (base-class helpers; z-minor layout)
         with jax.named_scope("pack"):
-            src = self._stickside_map(s_me)
-            fre = jnp.concatenate([sre.reshape(-1), jnp.zeros(1, rt)])
-            fim = jnp.concatenate([sim.reshape(-1), jnp.zeros(1, rt)])
-            bre, bim = fre[src], fim[src]
+            bre = self._pack_a(sre, s_me)
+            bim = self._pack_a(sim, s_me)
 
         with jax.named_scope("exchange"):
             rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
 
-        # unpack A -> (Lz, Y, Ax) y-pencil grid
+        # unpack A -> (Y, Ax, Lz) y-pencil grid (one row gather per part)
         with jax.named_scope("unpack"):
-            dest = self._planeside_map(a_me, b_me)
-            gre = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rre)
-            gim = jnp.zeros(Lz * Y * Ax + 1, rt).at[dest].set(rim)
-            gre = gre[: Lz * Y * Ax].reshape(Lz, Y, Ax)
-            gim = gim[: Lz * Y * Ax].reshape(Lz, Y, Ax)
+            gre = self._unpack_a(rre, a_me)
+            gim = self._unpack_a(rim, a_me)
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
                 g0, s0 = self._x0_group, self._x0_slot
                 pre, pim = symmetry.hermitian_fill_1d_pair(
-                    gre[:, :, s0], gim[:, :, s0], axis=1
+                    gre[:, s0, :], gim[:, s0, :], axis=0
                 )
-                gre = gre.at[:, :, s0].set(jnp.where(a_me == g0, pre, gre[:, :, s0]))
-                gim = gim.at[:, :, s0].set(jnp.where(a_me == g0, pim, gim[:, :, s0]))
+                gre = gre.at[:, s0, :].set(jnp.where(a_me == g0, pre, gre[:, s0, :]))
+                gim = gim.at[:, s0, :].set(jnp.where(a_me == g0, pim, gim[:, s0, :]))
 
         with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yal,yk->kal", prec)
 
         # pack B: each destination's y-rows (within my fixed z-slab)
         with jax.named_scope("pack"):
-            ymap = jnp.asarray(self._ymap)
-            bre = jnp.take(
-                jnp.concatenate([gre, jnp.zeros((Lz, 1, Ax), rt)], axis=1), ymap, axis=1
-            ).reshape(Lz, P1, Ly, Ax).transpose(1, 0, 2, 3)
-            bim = jnp.take(
-                jnp.concatenate([gim, jnp.zeros((Lz, 1, Ax), rt)], axis=1), ymap, axis=1
-            ).reshape(Lz, P1, Ly, Ax).transpose(1, 0, 2, 3)
+            bre = self._pack_b(gre)
+            bim = self._pack_b(gim)
 
         with jax.named_scope("exchange"):
             rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
@@ -150,12 +141,12 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         # x transform: the slot->x map is folded into the matrix (zero rows on
         # sentinel slots), so assembly is a pure reshape + matmul
         with jax.named_scope("x transform"):
-            hre = rbre.transpose(1, 2, 0, 3).reshape(Lz, Ly, P1 * Ax)
-            him = rbim.transpose(1, 2, 0, 3).reshape(Lz, Ly, P1 * Ax)
+            hre = rbre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+            him = rbim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
             if self.is_r2c:
-                out = offt.real_out_matmul(hre, him, *self._wx_b, "lyc,cx->lyx", prec)
+                out = offt.real_out_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
                 return out[None]
-            ore, oim = offt.complex_matmul(hre, him, *self._wx_b, "lyc,cx->lyx", prec)
+            ore, oim = offt.complex_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
             return ore[None], oim[None]
 
     def _forward_impl(self, space_re, *rest, scale):
@@ -173,46 +164,40 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             if self.is_r2c:
                 (_,) = rest  # value_indices unused (lane-copy branches)
                 hre, him = offt.real_in_matmul(
-                    space_re[0].astype(rt), *self._wx_f, "lyx,xc->lyc", prec
+                    space_re[0].astype(rt), *self._wx_f, "lyx,xc->ycl", prec
                 )
             else:
                 space_im, _ = rest
                 hre, him = offt.complex_matmul(
                     space_re[0].astype(rt), space_im[0].astype(rt),
-                    *self._wx_f, "lyx,xc->lyc", prec,
+                    *self._wx_f, "lyx,xc->ycl", prec,
                 )
 
         # exchange B reverse: send each x-group home (within my z-slab)
         with jax.named_scope("pack"):
-            bre = hre.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
-            bim = him.reshape(Lz, Ly, P1, Ax).transpose(2, 0, 1, 3)
+            bre = hre.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
+            bim = him.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
         with jax.named_scope("exchange"):
             rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
 
-        # reassemble the full y extent of my x-group
+        # reassemble the full y extent of my x-group (one row gather per part)
         with jax.named_scope("unpack"):
-            yinv = jnp.asarray(self._yinv)
-            gre = jnp.take(rbre.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax), yinv, axis=1)
-            gim = jnp.take(rbim.transpose(1, 0, 2, 3).reshape(Lz, P1 * Ly, Ax), yinv, axis=1)
+            gre = self._unpack_b_rev(rbre)
+            gim = self._unpack_b_rev(rbim)
 
         with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "lyx,yj->ljx", prec)
+            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "yal,yj->jal", prec)
 
         # exchange A reverse: each stick's z-chunk back to its owner
         with jax.named_scope("pack"):
-            src = self._planeside_map(a_me, b_me)
-            fre = jnp.concatenate([gre.reshape(-1), jnp.zeros(1, rt)])
-            fim = jnp.concatenate([gim.reshape(-1), jnp.zeros(1, rt)])
-            bre, bim = fre[src], fim[src]
+            bre = self._pack_a_rev(gre, a_me, b_me)
+            bim = self._pack_a_rev(gim, a_me, b_me)
         with jax.named_scope("exchange"):
             rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
 
         with jax.named_scope("unpack"):
-            dest = self._stickside_map(s_me)
-            sre = jnp.zeros(S * Z + 1, rt).at[dest].set(rre)
-            sim = jnp.zeros(S * Z + 1, rt).at[dest].set(rim)
-            sre = sre[: S * Z].reshape(S, Z)
-            sim = sim[: S * Z].reshape(S, Z)
+            sre = self._unpack_a_rev(rre, s_me)
+            sim = self._unpack_a_rev(rim, s_me)
 
         with jax.named_scope("z transform"):
             if self._align_rep is not None:

@@ -1,0 +1,105 @@
+"""Guard: the pencil engines' pack/unpack must stay row-granular.
+
+Round-4 on-chip finding (ROADMAP 8b): the pencil exchanges' pack/unpack ran as
+(P, SG, Lz) ELEMENT scatters/gathers (~20 ns/element on TPU), making the
+1x1-mesh pencil ~230x slower than the local engine at 256^3/15% — invisible on
+the CPU mesh where pocketfft costs dominate, so every oracle test stayed green.
+These tests make the regression visible off-chip: they lower the compiled MXU
+pencil pipelines to StableHLO and assert no gather/scatter moves data
+element-by-element. Reference pack/unpack being matched:
+src/transpose/transpose_mpi_compact_buffered_host.cpp:109-175.
+"""
+import re
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    TransformType,
+)
+from spfft_tpu.parameters import distribute_triplets
+from utils import random_sparse_triplets, split_values
+
+# metadata lookups (branch tables, shard geometry) legitimately gather single
+# elements out of tiny operands; data arrays are far larger
+_METADATA_ELEMS = 4096
+
+
+def _operand_elems(shape_str: str) -> int:
+    """Element count of a StableHLO tensor type like 'tensor<16385xf32>'."""
+    dims = re.findall(r"(\d+)x", shape_str)
+    n = 1
+    for d in dims:
+        n *= int(d)
+    return n
+
+
+def _element_granular_ops(hlo: str):
+    """(op, operand, detail) rows for every gather/scatter that moves single
+    elements out of/into a non-metadata operand."""
+    bad = []
+    # gathers: slice_sizes all-1 means one element per index row
+    for m in re.finditer(
+        r'"stablehlo\.gather"[^\n]*?slice_sizes\s*=\s*array<i64([^>]*)>[^\n]*?:\s*\(tensor<([^>]+)>',
+        hlo,
+    ):
+        sizes = [int(x) for x in re.findall(r"-?\d+", m.group(1))]
+        if sizes and all(s == 1 for s in sizes):
+            if _operand_elems(m.group(2)) > _METADATA_ELEMS:
+                bad.append(("gather", m.group(2), sizes))
+    # scatters: no update_window_dims (StableHLO omits the attribute when
+    # empty) means element updates
+    for m in re.finditer(
+        r'"stablehlo\.scatter"\(.*?\}\)\s*:\s*\(tensor<([^>]+)>', hlo, re.DOTALL
+    ):
+        mw = re.search(r"update_window_dims = \[([^\]]*)\]", m.group(0))
+        window = re.findall(r"\d+", mw.group(1)) if mw else []
+        if not window and _operand_elems(m.group(1)) > _METADATA_ELEMS:
+            bad.append(("scatter", m.group(1), []))
+    return bad
+
+
+def _lowered_texts(p1, p2):
+    rng = np.random.default_rng(77)
+    dx, dy, dz = 16, 16, 16
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, p1 * p2, dy)
+    vps = split_values(per_shard, trip, values)
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh2(p1, p2),
+        exchange_type=ExchangeType.BUFFERED,
+        engine="mxu",
+    )
+    assert t._engine == "pencil2-mxu"
+    ex = t._exec
+    pair = ex.pad_values(vps)
+    texts = [ex._backward.lower(*pair, ex._value_indices).as_text()]
+    out = ex.backward_pair(*pair)
+    texts.append(
+        ex._forward[ScalingType.FULL]
+        .lower(out[0], out[1], ex._value_indices)
+        .as_text()
+    )
+    return texts
+
+
+@pytest.mark.parametrize("p1,p2", [(1, 1), (2, 2), (2, 4)])
+def test_mxu_pencil_pipelines_have_no_element_scatters(p1, p2):
+    for hlo in _lowered_texts(p1, p2):
+        bad = _element_granular_ops(hlo)
+        assert not bad, (
+            "element-granular data movement in the compiled pencil pipeline "
+            f"(the round-4 on-chip pathology, ROADMAP 8b): {bad}"
+        )
