@@ -8,6 +8,7 @@
 # multichip dryrun, and the native C/C++ build + API roundtrip.
 #
 # Usage:   ./ci.sh            # everything
+#          ./ci.sh lint       # import hygiene + env-knob docs consistency
 #          ./ci.sh python     # Python suite only
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
@@ -18,6 +19,11 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 stage="${1:-all}"
+
+run_lint() {
+  echo "== Lint (programs/lint.py: imports + env-knob docs) =="
+  python programs/lint.py
+}
 
 run_python() {
   echo "== Python test suite (virtual 8-device CPU mesh) =="
@@ -44,17 +50,19 @@ run_native() {
 }
 
 case "$stage" in
+  lint) run_lint ;;
   python) run_python ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
+    run_lint
     run_python
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use python | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -17,7 +17,16 @@
  * MPI_Comm directly). When the caller builds with MPI this IS MPI_Comm, so
  * reference call sites compile unchanged; otherwise it is an opaque
  * placeholder — the stubs return SPFFT_MPI_SUPPORT_ERROR without reading it
- * (no MPI exists in this runtime; the device mesh replaces the communicator). */
+ * (no MPI exists in this runtime; the device mesh replaces the communicator).
+ *
+ * ABI note: the library TU compiles without <mpi.h>, so a caller built with
+ * an int-typed MPI_Comm (MPICH) passes a different by-value parameter type
+ * than the TU declares (void*). The stubs never read the argument, and every
+ * supported ABI (x86-64 SysV/Win64, AArch64 AAPCS) passes both int and
+ * pointer scalars in the same argument register, so the call is benign —
+ * but it relies on register passing of scalar arguments; an ABI that
+ * class-splits them differently would need the library rebuilt with MPI
+ * headers present (which makes the types identical). */
 #if defined(SPFFT_MPI) || defined(MPI_VERSION)
 #ifndef MPI_VERSION
 #include <mpi.h>

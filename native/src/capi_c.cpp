@@ -164,8 +164,10 @@ SpfftError spfft_float_grid_num_threads(SpfftFloatGrid grid, int* numThreads) {
  * docs/api/c_api.md); these keep ported callers LINKING (reference:
  * include/spfft/grid.h:184, transform.h:122,341) and fail with the same code
  * a feature-less reference build reports. The comm argument is declared
- * void* / long here and never read, so the symbols are ABI-compatible with
- * both int-typed (MPICH) and pointer-typed (Open MPI) MPI_Comm. The
+ * void* here and never read; callers compiled with an int-typed MPI_Comm
+ * (MPICH) pass a technically different by-value type, which is benign on
+ * every supported ABI because scalar arguments ride the same registers —
+ * see the ABI note at the SpfftMpiComm typedef (types.h). The
  * *_fortran variants take the MPI_Fint the reference's Fortran module binds
  * (reference: src/spfft/grid.cpp *_fortran entries). */
 

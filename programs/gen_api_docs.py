@@ -12,9 +12,7 @@ Usage: python programs/gen_api_docs.py [outdir]   (default docs/api)
 """
 from __future__ import annotations
 
-import enum as enum_mod
 import inspect
-import re
 import sys
 import textwrap
 from pathlib import Path

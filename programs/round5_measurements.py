@@ -1,0 +1,198 @@
+"""Round-5 on-chip batch 1: pencil row-granular fix + P=1 overhead A/B.
+
+One process, one device claim (the round-3 discipline). Arms:
+
+1. ``local_c2c_256_s15`` — the matched local baseline (chain 384), shared
+   reference arm for both comparisons below.
+2. ``pencil1x1_c2c_256_sph15_r5`` — the round-5 row-granular pencil engine on
+   the chip. Round-4 row: 1.28 s/pair (~230x local, element-scatter bound,
+   ROADMAP 8b). Done-criterion: within ~1.5x the local arm. A short chain
+   runs first (watchdog safety if the fix regressed); a long chain re-pins
+   when the short one lands under 50 ms/pair.
+3. ``dist1_c2c_256_s15`` — 1-D mesh P=1 distributed, same config/chain as the
+   local arm (VERDICT r4 weak-item 4: 7.5-8.1 ms recorded vs 5.52 local while
+   round-3 text claimed ~7%; exchange is specialized away at P=1, so any gap
+   is pure engine overhead). One consistent matched pair decides it.
+
+Results append incrementally to ``bench_results/round5_onchip.json``.
+
+Usage: python programs/round5_measurements.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_onchip.json"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="short chains (smoke)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_measurements", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def flops_pair(dim):
+        n = dim**3
+        return 2 * 5.0 * n * np.log2(n)
+
+    def chain_time(ex, re0, im0, chain):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                sre, sim = ex.trace_backward(*carry, phase=ph)
+                return ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph), None
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, wim = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    dim = 256
+    CH = 48 if args.quick else 384
+    trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+    rng = np.random.default_rng(0)
+
+    # ---- 1: matched local baseline ----
+    local_ms = None
+    try:
+        t = Transform(
+            ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
+            indices=trip, dtype=np.float32, engine="mxu",
+        )
+        ex = t._exec
+        n = len(trip)
+        re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+        best, err = chain_time(ex, re0, im0, CH)
+        local_ms = best * 1e3
+        record({
+            "name": "local_c2c_256_s15", "chain": CH,
+            "ms_per_pair": round(best * 1e3, 3),
+            "gflops": round(flops_pair(dim) / best / 1e9, 1),
+            "roundtrip_err": err,
+        })
+    except Exception as e:
+        record({"name": "local_c2c_256_s15", "error": f"{type(e).__name__}: {e}"})
+
+    # ---- 2: pencil 1x1, short probe then long re-pin ----
+    try:
+        t = DistributedTransform(
+            ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+            mesh=sp.make_fft_mesh2(1, 1), dtype=np.float32, engine="mxu",
+        )
+        ex = t._exec
+        vals = (
+            rng.standard_normal(t.num_local_elements(0))
+            + 1j * rng.standard_normal(t.num_local_elements(0))
+        ).astype(np.complex64)
+        pairs = ex.pad_values([vals])
+        probe_chain = 16 if args.quick else 48
+        best, err = chain_time(ex, pairs[0], pairs[1], probe_chain)
+        row = {
+            "name": "pencil1x1_c2c_256_sph15_r5_probe", "chain": probe_chain,
+            "ms_per_pair": round(best * 1e3, 3),
+            "gflops": round(flops_pair(dim) / best / 1e9, 1),
+            "roundtrip_err": err, "engine": t._engine,
+            "r4_row_ms": 1280.0,
+        }
+        record(row)
+        if best * 1e3 < 50 and not args.quick:
+            best, err = chain_time(ex, pairs[0], pairs[1], CH)
+            record({
+                "name": "pencil1x1_c2c_256_sph15_r5", "chain": CH,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+                "vs_local": (
+                    round(best * 1e3 / local_ms, 3) if local_ms else None
+                ),
+            })
+    except Exception as e:
+        record({
+            "name": "pencil1x1_c2c_256_sph15_r5",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+    # ---- 3: dist P=1 (1-D mesh), matched arm ----
+    try:
+        t = DistributedTransform(
+            ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+            mesh=sp.make_fft_mesh(1), dtype=np.float32, engine="mxu",
+        )
+        ex = t._exec
+        vals = (
+            rng.standard_normal(t.num_local_elements(0))
+            + 1j * rng.standard_normal(t.num_local_elements(0))
+        ).astype(np.complex64)
+        pairs = ex.pad_values([vals])
+        best, err = chain_time(ex, pairs[0], pairs[1], CH)
+        record({
+            "name": "dist1_c2c_256_s15", "chain": CH,
+            "ms_per_pair": round(best * 1e3, 3),
+            "gflops": round(flops_pair(dim) / best / 1e9, 1),
+            "roundtrip_err": err,
+            "vs_local": round(best * 1e3 / local_ms, 3) if local_ms else None,
+        })
+    except Exception as e:
+        record({"name": "dist1_c2c_256_s15", "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

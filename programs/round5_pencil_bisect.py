@@ -1,0 +1,235 @@
+"""Round-5 pencil stage bisection — where do 980 ms/pair go at 1x1/256^3?
+
+The row-granular rewrite (z-minor layout, whole-row gathers) left the 1x1-mesh
+pencil at 980 ms/pair on chip vs 5.5 ms local — the element-scatter theory is
+dead (guard test holds, roundtrip 9e-6); this isolates the cost. Methodology =
+microbench_ablate's: DEPENDENT chains inside one lax.scan, each variant mapping
+a stick-pair to a stick-pair (stage outputs folded back by cheap reshapes/
+slices), timed under PLAIN jit with shard indices passed as ints (the helpers
+take s_me as an argument) — plus the full pipeline under the real 1x1
+shard_map for the jit-vs-shard_map split.
+
+Appends to bench_results/round5_pencil_bisect.json.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_pencil_bisect.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_pencil_bisect", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900, exit_code=2
+    )
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import DistributedTransform, ProcessingUnit, TransformType
+    from spfft_tpu.ops import fft as offt
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    dim = 256
+    trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+    t = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+        mesh=sp.make_fft_mesh2(1, 1), dtype=np.float32, engine="mxu",
+    )
+    ex = t._exec
+    p = ex.params
+    rt = ex.real_dtype
+    S, Z, Y = ex._S, p.dim_z, p.dim_y
+    Ax, Lz, Ly, P1, P2 = ex._Ax, ex._Lz, ex._Ly, ex.P1, ex.P2
+    SG = ex._SG
+    prec = ex._precision
+    record({
+        "name": "plan_geometry", "S": int(S), "Z": int(Z), "Y": int(Y),
+        "Ax": int(Ax), "Lz": int(Lz), "Ly": int(Ly), "SG": int(SG),
+        "engine": t._engine,
+    })
+    rng = np.random.default_rng(0)
+    spair = tuple(
+        jnp.asarray(rng.standard_normal((S, Z)).astype(rt)) for _ in range(2)
+    )
+
+    REPS = 48
+
+    def timed(name, fn, x0=spair):
+        """Dependent-chain time of fn: pair -> same-shape pair."""
+        @jax.jit
+        def loop(a, b):
+            def body(carry, _):
+                return fn(*carry), ()
+
+            (r, i), _ = jax.lax.scan(body, (a, b), None, length=REPS)
+            return r.ravel()[0] + i.ravel()[0]
+
+        try:
+            float(loop(*x0))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(loop(*x0))
+                best = min(best, (time.perf_counter() - t0) / REPS)
+            record({"name": name, "ms": round(best * 1e3, 3)})
+            return best
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+            return None
+
+    def fold_to_sticks(x):
+        """Any array -> (S, Z) by flatten/slice/pad (cheap, fusible)."""
+        flat = x.ravel()
+        n = S * Z
+        if flat.shape[0] >= n:
+            return flat[:n].reshape(S, Z)
+        return jnp.pad(flat, (0, n - flat.shape[0])).reshape(S, Z)
+
+    # ---- cumulative pipeline prefixes, stick-pair -> stick-pair ----
+    def v_z(a, b):
+        return offt.complex_matmul(a, b, *ex._wz_b, "sz,zk->sk", prec)
+
+    def v_packa(a, b):
+        a, b = v_z(a, b)
+        return fold_to_sticks(ex._pack_a(a, 0)), fold_to_sticks(ex._pack_a(b, 0))
+
+    def v_unpacka(a, b):
+        a, b = v_z(a, b)
+        ba, bb = ex._pack_a(a, 0), ex._pack_a(b, 0)
+        return (
+            fold_to_sticks(ex._unpack_a(ba, 0)),
+            fold_to_sticks(ex._unpack_a(bb, 0)),
+        )
+
+    def v_y(a, b):
+        a, b = v_z(a, b)
+        ga = ex._unpack_a(ex._pack_a(a, 0), 0)
+        gb = ex._unpack_a(ex._pack_a(b, 0), 0)
+        ga, gb = offt.complex_matmul(ga, gb, *ex._wy_b, "yal,yk->kal", prec)
+        return fold_to_sticks(ga), fold_to_sticks(gb)
+
+    def v_packb(a, b):
+        a, b = v_z(a, b)
+        ga = ex._unpack_a(ex._pack_a(a, 0), 0)
+        gb = ex._unpack_a(ex._pack_a(b, 0), 0)
+        ga, gb = offt.complex_matmul(ga, gb, *ex._wy_b, "yal,yk->kal", prec)
+        ba, bb = ex._pack_b(ga), ex._pack_b(gb)
+        ha = ba.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        hb = bb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        return fold_to_sticks(ha), fold_to_sticks(hb)
+
+    def v_x(a, b):
+        a, b = v_z(a, b)
+        ga = ex._unpack_a(ex._pack_a(a, 0), 0)
+        gb = ex._unpack_a(ex._pack_a(b, 0), 0)
+        ga, gb = offt.complex_matmul(ga, gb, *ex._wy_b, "yal,yk->kal", prec)
+        ha = ex._pack_b(ga).transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        hb = ex._pack_b(gb).transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        oa, ob = offt.complex_matmul(ha, hb, *ex._wx_b, "ycl,cx->lyx", prec)
+        return fold_to_sticks(oa), fold_to_sticks(ob)
+
+    timed("z_only", v_z)
+    timed("z+packA", v_packa)
+    timed("z+packA+unpackA", v_unpacka)
+    timed("z+..+y", v_y)
+    timed("z+..+packB", v_packb)
+    timed("z+..+x (full bwd compute)", v_x)
+
+    # ---- standalone suspects ----
+    grid_pair = tuple(
+        jnp.asarray(rng.standard_normal((Y, Ax, Lz)).astype(rt))
+        for _ in range(2)
+    )
+
+    def y_only(a, b):
+        return offt.complex_matmul(a, b, *ex._wy_b, "yal,yk->kal", prec)
+
+    timed("y_matmul_alone", y_only, grid_pair)
+
+    h_pair = tuple(
+        jnp.asarray(rng.standard_normal((Ly, P1 * Ax, Lz)).astype(rt))
+        for _ in range(2)
+    )
+
+    # fold: (Lz, Ly, X) -> (Ly, C, Lz) shape for the chain
+    def x_only2(a, b):
+        oa, ob = offt.complex_matmul(a, b, *ex._wx_b, "ycl,cx->lyx", prec)
+        fa = oa.ravel()[: Ly * P1 * Ax * Lz].reshape(Ly, P1 * Ax, Lz)
+        fb = ob.ravel()[: Ly * P1 * Ax * Lz].reshape(Ly, P1 * Ax, Lz)
+        return fa, fb
+
+    timed("x_matmul_alone", x_only2, h_pair)
+
+    def x_natural(a, b):
+        oa, ob = offt.complex_matmul(a, b, *ex._wx_b, "ycl,cx->yxl", prec)
+        fa = oa.ravel()[: Ly * P1 * Ax * Lz].reshape(Ly, P1 * Ax, Lz)
+        fb = ob.ravel()[: Ly * P1 * Ax * Lz].reshape(Ly, P1 * Ax, Lz)
+        return fa, fb
+
+    timed("x_matmul_natural_order", x_natural, h_pair)
+
+    # ---- full pipeline under the real 1x1 shard_map (reference point) ----
+    from spfft_tpu import ScalingType
+
+    vals = (
+        rng.standard_normal(t.num_local_elements(0))
+        + 1j * rng.standard_normal(t.num_local_elements(0))
+    ).astype(np.complex64)
+    pairs = ex.pad_values([vals])
+    phase = getattr(ex, "phase_operands", ())
+
+    def chain_fn(r, i, ph):
+        def body(carry, _):
+            sre, sim = ex.trace_backward(*carry, phase=ph)
+            return ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph), None
+
+        return jax.lax.scan(body, (r, i), None, length=REPS)[0]
+
+    try:
+        step = jax.jit(chain_fn)
+        wre, _ = step(pairs[0], pairs[1], phase)
+        float(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(pairs[0], pairs[1], phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / REPS)
+        record({"name": "full_pair_shardmap_1x1", "ms": round(best * 1e3, 3)})
+    except Exception as e:
+        record({"name": "full_pair_shardmap_1x1", "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

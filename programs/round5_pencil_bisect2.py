@@ -1,0 +1,209 @@
+"""Round-5 pencil bisection, part 2: the slow stage IS inside shard_map.
+
+Part 1 (round5_pencil_bisect.json): every backward compute stage under plain
+jit (static shard indices) sums to 4.4 ms; the identical pipeline under the
+1x1 shard_map runs 980 ms/pair. A follow-up probe refuted the traced-index
+gather theory (const/traced/operand indices all gather alike). This part
+times cumulative prefixes of the REAL per-shard program — lax.switch
+decompress, phase tables, traced axis_index-derived maps — under the REAL
+shard_map, to isolate which construct explodes.
+
+Appends to bench_results/round5_pencil_bisect2.json.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_pencil_bisect2.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_pencil_bisect2", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900,
+        exit_code=2,
+    )
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import DistributedTransform, ProcessingUnit, TransformType
+    from spfft_tpu.ops import fft as offt, lanecopy
+    from spfft_tpu.parallel.pencil2 import AX1, AX2
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    dim = 256
+    trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+    t = DistributedTransform(
+        ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+        mesh=sp.make_fft_mesh2(1, 1), dtype=np.float32, engine="mxu",
+    )
+    ex = t._exec
+    p = ex.params
+    rt = ex.real_dtype
+    S, Z = ex._S, p.dim_z
+    Ax, Lz, Ly, P1, P2 = ex._Ax, ex._Lz, ex._Ly, ex.P1, ex.P2
+    prec = ex._precision
+    rng = np.random.default_rng(0)
+
+    vals = (
+        rng.standard_normal(t.num_local_elements(0))
+        + 1j * rng.standard_normal(t.num_local_elements(0))
+    ).astype(np.complex64)
+    vre, vim = ex.pad_values([vals])
+
+    REPS = 48
+    both = (AX1, AX2)
+    specs_v = P(both, None)
+
+    def fold_to_values(x, n):
+        flat = x.ravel()
+        if flat.shape[0] >= n:
+            return flat[:n].astype(rt)
+        return jnp.pad(flat, (0, n - flat.shape[0])).astype(rt)
+
+    def make_sm(stage_fn):
+        """shard_map'd (1, V)-pair -> (1, V)-pair program running stage_fn on
+        per-shard data with the REAL traced axis indices."""
+
+        def body(a, b):
+            a_me = jax.lax.axis_index(AX1)
+            b_me = jax.lax.axis_index(AX2)
+            s_me = a_me * P2 + b_me
+            oa, ob = stage_fn(a[0], b[0], a_me, b_me, s_me)
+            n = a.shape[1]
+            return fold_to_values(oa, n)[None], fold_to_values(ob, n)[None]
+
+        return functools.partial(
+            jax.shard_map, mesh=ex.mesh, check_vma=False
+        )(body, in_specs=(specs_v, specs_v), out_specs=(specs_v, specs_v))
+
+    def timed(name, stage_fn):
+        smf = make_sm(stage_fn)
+
+        @jax.jit
+        def loop(a, b):
+            def sbody(carry, _):
+                return smf(*carry), ()
+
+            (r, i), _ = jax.lax.scan(sbody, (a, b), None, length=REPS)
+            return r.ravel()[0] + i.ravel()[0]
+
+        try:
+            float(jax.device_get(loop(vre, vim)))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = loop(vre, vim)
+                float(jax.device_get(out))
+                best = min(best, (time.perf_counter() - t0) / REPS)
+            record({"name": name, "ms": round(best * 1e3, 3)})
+            return best
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+            return None
+
+    # ---- cumulative prefixes of the real backward body ----
+    def s_decompress(a, b, a_me, b_me, s_me):
+        return jax.lax.switch(
+            jnp.asarray(ex._branch_of_shard)[s_me],
+            ex._decompress_branches,
+            a.astype(rt), b.astype(rt),
+        )
+
+    def s_z(a, b, a_me, b_me, s_me):
+        sre, sim = s_decompress(a, b, a_me, b_me, s_me)
+        return offt.complex_matmul(sre, sim, *ex._wz_b, "sz,zk->sk", prec)
+
+    def s_phase(a, b, a_me, b_me, s_me):
+        sre, sim = s_z(a, b, a_me, b_me, s_me)
+        if ex._align_rep is not None:
+            cos_t, sin_t = lanecopy.phase_rep_tables_at(ex._align_rep, s_me, rt)
+            sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+        return sre, sim
+
+    def s_packa(a, b, a_me, b_me, s_me):
+        sre, sim = s_phase(a, b, a_me, b_me, s_me)
+        return ex._pack_a(sre, s_me), ex._pack_a(sim, s_me)
+
+    def s_unpacka(a, b, a_me, b_me, s_me):
+        bre, bim = s_packa(a, b, a_me, b_me, s_me)
+        return ex._unpack_a(bre, a_me), ex._unpack_a(bim, a_me)
+
+    def s_y(a, b, a_me, b_me, s_me):
+        gre, gim = s_unpacka(a, b, a_me, b_me, s_me)
+        return offt.complex_matmul(gre, gim, *ex._wy_b, "yal,yk->kal", prec)
+
+    def s_x(a, b, a_me, b_me, s_me):
+        gre, gim = s_y(a, b, a_me, b_me, s_me)
+        bre, bim = ex._pack_b(gre), ex._pack_b(gim)
+        hre = bre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        him = bim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+        return offt.complex_matmul(hre, him, *ex._wx_b, "ycl,cx->lyx", prec)
+
+    timed("sm_decompress", s_decompress)
+    timed("sm_+z", s_z)
+    timed("sm_+phase", s_phase)
+    timed("sm_+packA", s_packa)
+    timed("sm_+unpackA", s_unpacka)
+    timed("sm_+y", s_y)
+    timed("sm_full_bwd_compute", s_x)
+
+    # ---- the full real backward_impl under its own jit (no forward) ----
+    @jax.jit
+    def bwd_loop(a, b):
+        def sbody(carry, _):
+            out = ex._backward_sm(carry[0], carry[1], ex._value_indices)
+            oa = out[0].ravel()[: carry[0].shape[1]][None].astype(rt)
+            ob = out[1].ravel()[: carry[1].shape[1]][None].astype(rt)
+            return (oa, ob), ()
+
+        (r, i), _ = jax.lax.scan(sbody, (a, b), None, length=REPS)
+        return r.ravel()[0] + i.ravel()[0]
+
+    try:
+        float(jax.device_get(bwd_loop(vre, vim)))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = bwd_loop(vre, vim)
+            float(jax.device_get(out))
+            best = min(best, (time.perf_counter() - t0) / REPS)
+        record({"name": "sm_real_backward_impl", "ms": round(best * 1e3, 3)})
+    except Exception as e:
+        record({"name": "sm_real_backward_impl", "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -152,16 +152,14 @@ def make_fft_mesh2(p1: int, p2: int, devices=None) -> Mesh:
     ``"fft2"`` AND y-slabs over ``"fft"``, lifting the 1-D slab engine's
     ``P <= dim_z`` useful-parallelism cap to ``p1 * p2 <= dim_z * dim_y``.
     """
-    if p1 < 1 or p2 < 1:
-        from ..errors import InvalidParameterError
+    from ..errors import InvalidParameterError
 
+    if p1 < 1 or p2 < 1:
         raise InvalidParameterError("mesh factors must be positive")
     if devices is None:
         devices = jax.devices()[: p1 * p2]
     devices = np.asarray(devices)
     if devices.size < p1 * p2:
-        from ..errors import InvalidParameterError
-
         raise InvalidParameterError(
             f"make_fft_mesh2({p1}, {p2}) needs {p1 * p2} devices, "
             f"have {devices.size}"

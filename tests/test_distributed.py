@@ -24,7 +24,6 @@ from utils import (
     oracle_backward_c2c,
     oracle_forward_c2c,
     random_sparse_triplets,
-    storage,
 )
 
 

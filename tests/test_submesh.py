@@ -10,7 +10,6 @@ import pytest
 import jax
 from jax.sharding import Mesh
 
-import spfft_tpu as sp
 from spfft_tpu import DistributedTransform, ProcessingUnit, ScalingType, TransformType
 from spfft_tpu.errors import InvalidParameterError
 from spfft_tpu.parameters import distribute_triplets
