@@ -1,0 +1,141 @@
+"""Round-5 on-chip batch 2: pencil re-measure after the ragged-exchange fix.
+
+Batch 1 + bisection found the 980 ms 1x1-pencil cost in the block exchanges'
+element-granular pack/unpack (RaggedBlockExchange flat exact-product buffers,
+~20 ns/element; bench_results/round5_pencil_bisect2.json). Both block
+exchange classes are now row-granular (2-D dynamic-slice chain windows /
+C-row ragged units). This batch re-pins the pencil arms:
+
+1. 1x1 COMPACT (what DEFAULT resolves to at P=1) — the headline fix check
+   against the 5.461 ms local arm (done = within ~1.5x),
+2. 1x1 BUFFERED (exchange specialized away entirely) — isolates any residual
+   non-exchange pencil overhead.
+
+Appends to bench_results/round5_onchip.json (same file as batch 1).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_onchip.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_measurements2", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900,
+        exit_code=2,
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ExchangeType,
+        ProcessingUnit,
+        ScalingType,
+        TransformType,
+    )
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def flops_pair(dim):
+        n = dim**3
+        return 2 * 5.0 * n * np.log2(n)
+
+    def chain_time(ex, re0, im0, chain):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                sre, sim = ex.trace_backward(*carry, phase=ph)
+                return ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph), None
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, _ = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    dim = 256
+    LOCAL_MS = 5.461  # batch-1 matched local arm
+    trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+    rng = np.random.default_rng(0)
+
+    for name, exchange, chain in (
+        ("pencil1x1_c2c_256_sph15_r5_fixed", ExchangeType.DEFAULT, 48),
+        ("pencil1x1_c2c_256_sph15_r5_buffered", ExchangeType.BUFFERED, 48),
+    ):
+        try:
+            t = DistributedTransform(
+                ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim, trip,
+                mesh=sp.make_fft_mesh2(1, 1), dtype=np.float32, engine="mxu",
+                exchange_type=exchange,
+            )
+            ex = t._exec
+            vals = (
+                rng.standard_normal(t.num_local_elements(0))
+                + 1j * rng.standard_normal(t.num_local_elements(0))
+            ).astype(np.complex64)
+            pairs = ex.pad_values([vals])
+            best, err = chain_time(ex, pairs[0], pairs[1], chain)
+            row = {
+                "name": name, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+                "resolved_exchange": str(t.exchange_type),
+                "vs_local": round(best * 1e3 / LOCAL_MS, 3),
+            }
+            record(row)
+            if best * 1e3 < 50:
+                best, err = chain_time(ex, pairs[0], pairs[1], 384)
+                record({**row, "name": name + "_long", "chain": 384,
+                        "ms_per_pair": round(best * 1e3, 3),
+                        "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                        "roundtrip_err": err,
+                        "vs_local": round(best * 1e3 / LOCAL_MS, 3)})
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

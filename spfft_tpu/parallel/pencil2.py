@@ -127,19 +127,27 @@ def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
     q_idx = np.arange(P1)
 
     def volumes(aligned):
+        """Per-discipline wire volumes matching the transports' actual row-
+        granular buffer forms (parallel/ragged.py): the one-shot ships exact
+        rows x the full C row width; the chain ships per-step
+        (max rows x max cols) 2-D rectangles."""
         _, _, ax, counts = assign[aligned]
-        blocks_a = counts[:, a_of] * lz[b_of][None, :]  # (P, P) elems s -> d
+        rows_a = counts[:, a_of]  # (P, P): rows of block s -> d
+        cols_a = lz[b_of]  # (P,) per-destination valid cols
         a_pad = Pn * (Pn - 1) * max(1, int(counts.max())) * Lz
-        a_exact = int(blocks_a.sum() - np.diag(blocks_a).sum())
+        a_exact = Lz * int(rows_a.sum() - np.diag(rows_a).sum())
         a_chain = Pn * sum(
-            max(1, int(blocks_a[s_idx, (s_idx + k) % Pn].max()))
+            max(1, int(rows_a[s_idx, (s_idx + k) % Pn].max()))
+            * max(1, int(cols_a[(s_idx + k) % Pn].max()))
             for k in range(1, Pn)
         )
-        blocks_b = np.broadcast_to(Lz * ly * ax, (P1, P1))  # (q, q') elems
+        rows_b = np.broadcast_to(ly, (P1, P1))  # (q, q'): rows q -> q'
         b_pad = Pn * (P1 - 1) * Lz * Ly * ax
-        b_exact = P2 * int(blocks_b.sum() - np.diag(blocks_b).sum())
+        b_exact = P2 * int(
+            (rows_b.sum() - np.diag(rows_b).sum()) * ax * Lz
+        )
         b_chain = P2 * P1 * sum(
-            max(1, int(blocks_b[q_idx, (q_idx + k) % P1].max()))
+            max(1, int(rows_b[q_idx, (q_idx + k) % P1].max())) * ax * Lz
             for k in range(1, P1)
         )
         return (a_pad, a_exact, a_chain), (b_pad, b_exact, b_chain)
@@ -233,15 +241,31 @@ class Pencil2Execution(PaddingHelpers):
                 assign[aligned] = (g, slot, ax, group_counts(g))
             return assign[aligned]
 
-        def exact_volume(aligned):
-            """Exact-counts A+B element volume under an assignment — the
-            quantity the ragged disciplines actually ship."""
+        def ragged_volume(aligned, one_shot):
+            """A+B element volume under an assignment, computed for the
+            transport that will actually run (parallel/ragged.py): the
+            one-shot ships exact rows x the full C row width; the chain
+            ships per-step (max rows x max cols) 2-D windows."""
             _, _, ax, counts = get_assign(aligned)
             d = np.arange(Pn)
-            blocks_a = counts[:, d // P2] * lz[d % P2][None, :]
-            a_ex = int(blocks_a.sum() - np.diag(blocks_a).sum())
-            b_ex = P2 * (P1 - 1) * int(Lz * ly.sum() * ax)
-            return a_ex + b_ex
+            rows_a = counts[:, d // P2]
+            if one_shot:
+                a_vol = Lz * int(rows_a.sum() - np.diag(rows_a).sum())
+                b_vol = P2 * (P1 - 1) * int(ly.sum() * ax * Lz)
+                return a_vol + b_vol
+            cols_a = lz[d % P2]
+            si = np.arange(Pn)
+            a_vol = Pn * sum(
+                max(1, int(rows_a[si, (si + k) % Pn].max()))
+                * max(1, int(cols_a[(si + k) % Pn].max()))
+                for k in range(1, Pn)
+            )
+            qi = np.arange(P1)
+            b_vol = P2 * P1 * sum(
+                max(1, int(ly[(qi + k) % P1].max())) * int(ax) * Lz
+                for k in range(1, P1)
+            )
+            return a_vol + b_vol
 
         if self.exchange_type == ExchangeType.DEFAULT:
             get_assign(False), get_assign(True)
@@ -251,12 +275,24 @@ class Pencil2Execution(PaddingHelpers):
             )
 
         if self.exchange_type in _RAGGED:
+            from .ragged import _ragged_a2a_supported
+
+            # resolved here once: drives both the assignment pick below and
+            # the transport class choice (one-shot where the backend compiles
+            # ragged-all-to-all, the rotation chain elsewhere / for COMPACT_*)
+            one_shot = (
+                self.exchange_type == ExchangeType.UNBUFFERED
+                and _ragged_a2a_supported(mesh)
+            )
             # The aligned strategy only helps when stick placement is
             # column-local (distribute_triplets layout=...); user-supplied or
             # greedy placements can make it strictly worse (bigger Ax, no
-            # diagonal A) — pick whichever assignment ships fewer bytes.
-            aligned = exact_volume(True) < exact_volume(False)
+            # diagonal A) — pick whichever assignment ships fewer bytes UNDER
+            # THE TRANSPORT THAT WILL RUN (the chain's per-step maxima can
+            # rank assignments differently than the one-shot's exact rows).
+            aligned = ragged_volume(True, one_shot) < ragged_volume(False, one_shot)
         else:
+            one_shot = False
             aligned = False
         group_of_ux, slot_of_ux, Ax, counts = get_assign(aligned)
         group_of_x = np.full(Xf, P1, dtype=np.int64)  # sentinel P1
@@ -322,21 +358,13 @@ class Pencil2Execution(PaddingHelpers):
         # small — A carries the discipline's value. Reference: MPI_Alltoallv
         # (transpose_mpi_compact_buffered_host.cpp:183-200).
         if self.exchange_type in _RAGGED:
-            from .ragged import (
-                OneShotBlockExchange,
-                RaggedBlockExchange,
-                _ragged_a2a_supported,
-            )
+            from .ragged import OneShotBlockExchange, RaggedBlockExchange
 
             # UNBUFFERED: one ragged-all-to-all collective per exchange where
             # the backend compiles the HLO (TPU); block chains elsewhere and
-            # for COMPACT_* (see parallel/ragged.py).
-            cls = (
-                OneShotBlockExchange
-                if self.exchange_type == ExchangeType.UNBUFFERED
-                and _ragged_a2a_supported(mesh)
-                else RaggedBlockExchange
-            )
+            # for COMPACT_* (``one_shot`` resolved with the assignment pick
+            # above, see parallel/ragged.py).
+            cls = OneShotBlockExchange if one_shot else RaggedBlockExchange
             d = np.arange(Pn)
             rows_a = counts[:, d // P2]  # (P, P): rows_a[s, d] = counts[s, a(d)]
             cols_a = np.broadcast_to(lz[d % P2], (Pn, Pn))

@@ -648,10 +648,16 @@ class OneShotBlockExchange:
     (:func:`_ragged_a2a_supported`); callers fall back to the chain class
     elsewhere.
 
-    Send layout: destination-contiguous exact rectangles at static per-shard
-    offsets (exclusive prefix sums of ``rows * cols`` over destinations);
-    recv layout: source-contiguous segments at the receiver's prefix sums.
-    Both offset tables are static (P, P) numpy arrays — only the ``me`` row
+    Send layout: destination-contiguous blocks of whole C-wide ROWS at static
+    per-shard row offsets (exclusive prefix sums of ``rows`` over
+    destinations); recv layout: source-contiguous row segments at the
+    receiver's prefix sums. The ragged unit is one (C,) row — never an
+    element — so pack/unpack compile to whole-row gathers (the round-5
+    on-chip finding: element-unit packing cost ~20 ns/element through
+    XLA:TPU's serialized scatter, bench_results/round5_pencil_bisect2.json).
+    Rows ship their full C width; the valid-cols tail is zero by the pack
+    contract and carries no information (wire accounting reflects this).
+    All offset tables are static (P, P) numpy arrays — only the ``me`` row
     lookup is traced.
     """
 
@@ -670,23 +676,21 @@ class OneShotBlockExchange:
         self._geom = {}
         for reverse in (False, True):
             r = rows.T if reverse else rows
-            c = cols.T if reverse else cols
-            prod = r * c  # (P, P): prod[s, d] elements s sends d
-            off_in = np.cumsum(prod, axis=1) - prod  # exclusive, per sender
-            off_recv = np.cumsum(prod, axis=0) - prod  # exclusive, per receiver
+            off_in = np.cumsum(r, axis=1) - r  # exclusive row offsets, sender
+            off_recv = np.cumsum(r, axis=0) - r  # exclusive, per receiver
             self._geom[reverse] = (
-                r.astype(np.int32), c.astype(np.int32),
-                prod.astype(np.int32), off_in.astype(np.int32),
+                r.astype(np.int32),
+                off_in.astype(np.int32),
                 off_recv.astype(np.int32),
-                max(1, int(prod.sum(axis=1).max())),
-                max(1, int(prod.sum(axis=0).max())),
+                max(1, int(r.sum(axis=1).max())),  # send rows, padded max
+                max(1, int(r.sum(axis=0).max())),  # recv rows, padded max
             )
 
     def offwire_elems(self) -> int:
-        """Exact off-shard elements per exchange (sum over i != j of the
-        rectangles) — direction-independent."""
-        prod = self._rows * self._cols
-        return int(prod.sum() - np.diag(prod).sum())
+        """Off-shard elements per exchange: exact rows x the full C row width
+        (the row-granular wire form) — direction-independent."""
+        off = int(self._rows.sum() - np.diag(self._rows).sum())
+        return off * self.C
 
     def rounds(self) -> int:
         return 1
@@ -700,61 +704,60 @@ class OneShotBlockExchange:
         ragged-all-to-all operand stays real; see _split_complex)."""
         parts, cdt = _split_complex(parts)
         P, R, C = self.P, self.R, self.C
-        rows, cols, prod, off_in, off_recv, send_n, recv_n = self._geom[
-            bool(reverse)
-        ]
+        rows, off_in, off_recv, send_rows, recv_rows = self._geom[bool(reverse)]
         rows_t = jnp.asarray(rows)
-        cols_t = jnp.asarray(cols)
-        prod_t = jnp.asarray(prod)
         off_in_t = jnp.asarray(off_in)
         off_recv_t = jnp.asarray(off_recv)
         me = self._me()
         dtype = parts[0].dtype
+        nparts = len(parts)
 
-        # pack: (P, R, C) blocks -> destination-contiguous send buffer
-        r_i = jnp.arange(R, dtype=jnp.int32)[None, :, None]
-        c_i = jnp.arange(C, dtype=jnp.int32)[None, None, :]
-        valid_s = (r_i < rows_t[me][:, None, None]) & (
-            c_i < cols_t[me][:, None, None]
-        )
-        sdest = off_in_t[me][:, None, None] + r_i * cols_t[me][:, None, None] + c_i
-        sdest = jnp.where(valid_s, sdest, send_n).reshape(-1)
+        # pack: (P, R, C) blocks -> destination-contiguous ROW buffer via one
+        # whole-row gather: send row t belongs to destination d(t) (found by
+        # binary search over my row-offset prefix) at block row t - off[d]
+        t_idx = jnp.arange(send_rows, dtype=jnp.int32)
+        cum_me = off_in_t[me] + rows_t[me]  # inclusive prefix, (P,)
+        d_of = jnp.searchsorted(cum_me, t_idx, side="right").astype(jnp.int32)
+        d_safe = jnp.minimum(d_of, P - 1)
+        r_in = t_idx - off_in_t[me][d_safe]
+        total_me = cum_me[P - 1]
+        srow = jnp.where(t_idx < total_me, d_safe * R + r_in, P * R)
         send = jnp.stack(
             [
-                jnp.zeros(send_n + 1, dtype=dtype).at[sdest].set(p.reshape(-1))[
-                    :send_n
-                ]
+                jnp.take(
+                    jnp.concatenate([p.reshape(P * R, C), jnp.zeros((1, C), dtype)]),
+                    srow, axis=0,
+                )
                 for p in parts
             ],
             axis=-1,
-        )
+        )  # (send_rows, C, nparts)
 
         wd = _wire_np_dtype(wire)
         buf = send if wd is None else send.astype(wd)
-        out = jnp.zeros((recv_n, len(parts)), dtype=buf.dtype)
+        out = jnp.zeros((recv_rows, C, nparts), dtype=buf.dtype)
         res = jax.lax.ragged_all_to_all(
             buf, out,
             off_in_t[me],
-            prod_t[me],
-            off_recv_t[me],  # where my segment lands on each receiver
-            prod_t[:, me],
+            rows_t[me],
+            off_recv_t[me],  # where my row segment lands on each receiver
+            rows_t[:, me],
             axis_name=self.axis_names,
         )
         if wd is not None:
             res = res.astype(dtype)
 
-        # unpack: source-contiguous segments -> (P, R, C) blocks
-        valid_r = (r_i < rows_t[:, me][:, None, None]) & (
-            c_i < cols_t[:, me][:, None, None]
-        )
-        gsrc = (
-            off_recv_t[:, me][:, None, None]
-            + r_i * cols_t[:, me][:, None, None]
-            + c_i
-        )
-        gsrc = jnp.where(valid_r, gsrc, recv_n).reshape(-1)
-        res_g = jnp.concatenate([res, jnp.zeros((1, len(parts)), dtype)])
-        outs = [res_g[gsrc, j].reshape(P, R, C) for j in range(len(parts))]
+        # unpack: source-contiguous row segments -> (P, R, C) blocks, one
+        # whole-row gather per part (sentinel -> zero row)
+        r_i = jnp.arange(R, dtype=jnp.int32)[None, :]
+        grow = off_recv_t[:, me][:, None] + r_i  # (P, R)
+        grow = jnp.where(r_i < rows_t[:, me][:, None], grow, recv_rows)
+        grow = grow.reshape(-1)
+        res_g = jnp.concatenate([res, jnp.zeros((1, C, nparts), dtype)])
+        outs = [
+            jnp.take(res_g[..., j], grow, axis=0).reshape(P, R, C)
+            for j in range(nparts)
+        ]
         return _join_complex(outs, cdt)
 
 
@@ -765,8 +768,11 @@ class RaggedBlockExchange:
     produces per-destination blocks: a (P, R, C) buffer where the valid data of
     the block for destination ``d`` on shard ``s`` is the top-left
     ``(rows[s, d], cols[s, d])`` rectangle (row-major within (R, C)), the rest
-    zero padding. Each of the P-1 rotation steps ships only the exact
-    rectangles, padded to the per-step maximum product — the same discipline as
+    zero padding. Each of the P-1 rotation steps ships a 2-D window sized to
+    the step's (max rows x max cols) over its shard pairs — row-granular
+    dynamic slices, never element index math (the round-5 on-chip finding;
+    see __init__), slightly above the exact-product padding for skewed
+    geometries but the same exact-counts discipline class as
     :class:`RaggedExchange`, without assuming the 1-D stick/plane geometry.
     Used by the 2-D pencil engines for their exchanges A (joint-axis rotation
     over ``("fft", "fft2")``) and B (rotation over ``"fft"`` within fixed
@@ -792,26 +798,40 @@ class RaggedBlockExchange:
         self._rows, self._cols = rows, cols
         P = self.P
         s = np.arange(P)
-        # reverse direction (the exchange's inverse repartition) swaps
-        # sender/receiver roles: its tables are the transposes, and its
-        # per-step sizes are the forward sizes reversed (size_rev[k] ==
-        # size_fwd[P-k], so wire totals are direction-independent)
+        # Per-step 2-D buffer dims: step k ships the (max rows, max cols)
+        # rectangle over its (s, (s+k)%P) pairs. Blocks are zero outside
+        # their valid rects (the pack contract), so slicing and writing the
+        # padded rectangle moves only zeros beyond the exact data — and the
+        # transport stays ROW-granular (dynamic_slice / dynamic_update_slice,
+        # no element index math; the round-5 on-chip finding: the earlier
+        # flat exact-product buffers cost ~20 ns/element through XLA:TPU's
+        # serialized element gather/scatter — 640 ms of a 980 ms pencil pair
+        # at 256^3, bench_results/round5_pencil_bisect2.json).
+        # The reverse direction (the exchange's inverse repartition) swaps
+        # sender/receiver roles: its tables are the transposes.
+        def step_dims(r, c):
+            return [
+                (
+                    max(1, int(r[s, (s + k) % P].max())),
+                    max(1, int(c[s, (s + k) % P].max())),
+                )
+                for k in range(P)
+            ]
+
+        self._dims = {False: step_dims(rows, cols), True: step_dims(rows.T, cols.T)}
+        # wire accounting follows the 2-D padded rectangles
         self._sizes = {
-            False: [
-                max(1, int((rows[s, (s + k) % P] * cols[s, (s + k) % P]).max()))
-                for k in range(P)
-            ],
-            True: [
-                max(1, int((rows[(s + k) % P, s] * cols[(s + k) % P, s]).max()))
-                for k in range(P)
-            ],
+            d: [r * c for r, c in dims] for d, dims in self._dims.items()
         }
 
     @property
     def step_buffer_sizes(self):
         """Static per-rotation buffer sizes (elements per shard per part) for
         steps 1..P-1 — what rides the wire; the k = 0 self-block stays local.
-        Direction-independent totals (see __init__)."""
+        Direction-independent totals: reverse step k covers the transposed
+        pairs of forward step P-k (rows.T[s, s+k] enumerates the same (s, d)
+        set as rows[s, s+(P-k)]), so its per-step maxima — and with them the
+        size list — are the forward ones reversed."""
         return tuple(self._sizes[False][1:])
 
     def offwire_elems(self) -> int:
@@ -831,36 +851,29 @@ class RaggedBlockExchange:
         (exact rectangle; padding zero). ``reverse=True`` runs the inverse
         repartition (the forward transform direction), whose valid rectangles
         are the transposed tables."""
-        P, R, C = self.P, self.R, self.C
-        rows = self._rows.T if reverse else self._rows
-        cols = self._cols.T if reverse else self._cols
-        rows_t = jnp.asarray(rows.astype(np.int32))
-        cols_t = jnp.asarray(cols.astype(np.int32))
+        P = self.P
         me = self._me()
         dtype = parts[0].dtype
-        flats = [
-            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
-        ]
-        outs = [jnp.zeros(P * R * C + 1, dtype=p.dtype) for p in parts]
+        outs = [jnp.zeros(p.shape, dtype=p.dtype) for p in parts]
         for k in range(P):
             dst = (me + k) % P
             src = (me - k) % P
-            b = self._sizes[reverse][k]
-            idx = jnp.arange(b, dtype=jnp.int32)
-            # gather the exact rectangle for dst (sender-side shape)
-            c_s = jnp.maximum(cols_t[me, dst], 1)
-            r_i, c_i = idx // c_s, idx % c_s
-            valid_s = idx < rows_t[me, dst] * cols_t[me, dst]
-            gsrc = jnp.where(valid_s, dst * (R * C) + r_i * C + c_i, P * R * C)
-            chunks = [f[gsrc] for f in flats]
+            bR, bC = self._dims[bool(reverse)][k]
+            # slice dst's padded rectangle (whole rows; zeros beyond the
+            # valid rect ride along, carrying no information)
+            zero = jnp.zeros((), dst.dtype)
+            chunks = [
+                jax.lax.dynamic_slice(p, (dst, zero, zero), (1, bR, bC))[0]
+                for p in parts
+            ]
             if k:
                 chunks = _wire_step(
                     chunks, k, P, self.axis_names, wire, dtype, real_dtype
                 )
-            # scatter with the receiver-side shape of src's rectangle
-            c_r = jnp.maximum(cols_t[src, me], 1)
-            r_o, c_o = idx // c_r, idx % c_r
-            valid_r = idx < rows_t[src, me] * cols_t[src, me]
-            gdst = jnp.where(valid_r, src * (R * C) + r_o * C + c_o, P * R * C)
-            outs = [o.at[gdst].set(c) for o, c in zip(outs, chunks)]
-        return [o[: P * R * C].reshape(P, R, C) for o in outs]
+            # write src's rectangle; the padded window beyond src's valid
+            # rect holds zeros over the zero-initialized output
+            outs = [
+                jax.lax.dynamic_update_slice(o, c[None], (src, zero, zero))
+                for o, c in zip(outs, chunks)
+            ]
+        return outs

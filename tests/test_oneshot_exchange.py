@@ -206,12 +206,15 @@ def test_oneshot_run_twice_zeroing():
 
 def test_oneshot_block_exchange_geometry():
     """OneShotBlockExchange (the pencil engines' UNBUFFERED form): the static
-    offset tables must tile each shard's send/recv buffers exactly — segment
-    [off, off+size) ranges are disjoint, ordered, and the sender's size table
-    is the transpose of the receiver's (the ragged-all-to-all invariant
-    send_sizes == all_to_all(recv_sizes)). Numerics run on TPU (the HLO is
-    unavailable on XLA:CPU; CPU plans fall back to the chain class, which the
-    pencil2 tests cover)."""
+    ROW-offset tables must tile each shard's send/recv row buffers exactly —
+    segment [off, off+rows) ranges are disjoint, ordered, and the sender's
+    size table is the transpose of the receiver's (the ragged-all-to-all
+    invariant send_sizes == all_to_all(recv_sizes)). Since round 5 the ragged
+    unit is one C-wide ROW (whole-row gathers on pack/unpack; element-unit
+    packing measured ~20 ns/element on TPU — bench_results/
+    round5_pencil_bisect2.json), so offsets/sizes count rows and the wire
+    ships rows x C. Numerics run on TPU (the HLO is unavailable on XLA:CPU;
+    CPU plans fall back to the chain class, which the pencil2 tests cover)."""
     from spfft_tpu.parallel.ragged import (
         OneShotBlockExchange,
         RaggedBlockExchange,
@@ -226,34 +229,33 @@ def test_oneshot_block_exchange_geometry():
     one = OneShotBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
     chain = RaggedBlockExchange(("fft", "fft2"), (P1, P2), rows, cols, R, C)
     for reverse in (False, True):
-        r, c, prod, off_in, off_recv, send_n, recv_n = one._geom[reverse]
-        assert (prod == r.astype(np.int64) * c).all()
+        r, off_in, off_recv, send_rows, recv_rows = one._geom[reverse]
+        r_expect = (rows.T if reverse else rows).astype(np.int64)
+        assert (r == r_expect).all()
         for s in range(P):
-            # sender s: destination segments tile [0, sum) in order
-            ends = off_in[s] + prod[s]
+            # sender s: destination row segments tile [0, sum) in order
+            ends = off_in[s] + r[s]
             assert off_in[s][0] == 0
             assert (off_in[s][1:] == ends[:-1]).all()
-            assert ends[-1] <= send_n
-            # receiver s: source segments tile [0, sum) in order
-            ends_r = off_recv[:, s] + prod[:, s]
+            assert ends[-1] <= send_rows
+            # receiver s: source row segments tile [0, sum) in order
+            ends_r = off_recv[:, s] + r[:, s]
             assert off_recv[0, s] == 0
             assert (off_recv[1:, s] == ends_r[:-1]).all()
-            assert ends_r[-1] <= recv_n
+            assert ends_r[-1] <= recv_rows
         # cross-implementation check: the chain class derives its per-step
-        # buffer sizes independently (per-distance maxima over the same
-        # rows/cols geometry); the one-shot prod table must reproduce them
+        # 2-D buffer dims independently (per-distance maxima over the same
+        # rows/cols geometry); its size table must be their products
         r64, c64 = (rows.T, cols.T) if reverse else (rows, cols)
         s_idx = np.arange(P)
         for k in range(P):
-            step_max = max(
-                1, int((r64[s_idx, (s_idx + k) % P] * c64[s_idx, (s_idx + k) % P]).max())
-            )
-            assert step_max == chain._sizes[reverse][k]
-            assert step_max == max(
-                1, int(prod[s_idx, (s_idx + k) % P].max())
-            )
-    # exact volume: never above the chain's per-step-max volume
-    assert one.offwire_elems() <= chain.offwire_elems()
+            step_r = max(1, int(r64[s_idx, (s_idx + k) % P].max()))
+            step_c = max(1, int(c64[s_idx, (s_idx + k) % P].max()))
+            assert (step_r, step_c) == chain._dims[reverse][k]
+            assert step_r * step_c == chain._sizes[reverse][k]
+    # row-granular volume accounting: exact off-diagonal rows x full C width
+    off_rows = int(rows.sum() - np.diag(rows).sum())
+    assert one.offwire_elems() == off_rows * C
     assert one.rounds() == 1 and chain.rounds() == P - 1
 
 
@@ -299,7 +301,8 @@ def _emulated_ragged_all_to_all(axis_names, axis_sizes):
             # contract check: my recv_sizes[src] must equal what src sends me
             seg = jnp.where(recv_sizes[src] == size, seg, jnp.nan)
             mask = (idx >= dst_off) & (idx < dst_off + size)
-            out = jnp.where(mask[:, None] if out.ndim == 2 else mask, seg, out)
+            mask = mask.reshape(mask.shape + (1,) * (out.ndim - 1))
+            out = jnp.where(mask, seg, out)
         return out
 
     return emu

@@ -64,7 +64,9 @@ def _element_granular_ops(hlo: str):
     return bad
 
 
-def _lowered_texts(p1, p2):
+def _lowered_texts(p1, p2, exchange):
+    import jax
+
     rng = np.random.default_rng(77)
     dx, dy, dz = 16, 16, 16
     trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
@@ -79,27 +81,45 @@ def _lowered_texts(p1, p2):
         dz,
         per_shard,
         mesh=sp.make_fft_mesh2(p1, p2),
-        exchange_type=ExchangeType.BUFFERED,
+        exchange_type=exchange,
         engine="mxu",
     )
     assert t._engine == "pencil2-mxu"
     ex = t._exec
     pair = ex.pad_values(vps)
     texts = [ex._backward.lower(*pair, ex._value_indices).as_text()]
-    out = ex.backward_pair(*pair)
+    # lowering only (no execution): the one-shot ragged transport lowers on
+    # every backend but compiles only where the HLO is implemented
+    out_shapes = jax.eval_shape(
+        ex._backward_sm, *(jax.typeof(x) for x in (*pair, ex._value_indices))
+    )
     texts.append(
         ex._forward[ScalingType.FULL]
-        .lower(out[0], out[1], ex._value_indices)
+        .lower(out_shapes[0], out_shapes[1], ex._value_indices)
         .as_text()
     )
     return texts
 
 
+_DISCIPLINES = [
+    ExchangeType.BUFFERED,
+    ExchangeType.COMPACT_BUFFERED,  # RaggedBlockExchange rotation chain
+    ExchangeType.UNBUFFERED,  # one-shot ragged-all-to-all (forced below)
+]
+
+
 @pytest.mark.parametrize("p1,p2", [(1, 1), (2, 2), (2, 4)])
-def test_mxu_pencil_pipelines_have_no_element_scatters(p1, p2):
-    for hlo in _lowered_texts(p1, p2):
+@pytest.mark.parametrize("exchange", _DISCIPLINES)
+def test_mxu_pencil_pipelines_have_no_element_scatters(
+    p1, p2, exchange, monkeypatch
+):
+    if exchange == ExchangeType.UNBUFFERED:
+        # force the one-shot transport (the CPU probe would fall back to the
+        # chain and hide OneShotBlockExchange from the guard)
+        monkeypatch.setenv("SPFFT_TPU_ONESHOT_TRANSPORT", "ragged")
+    for hlo in _lowered_texts(p1, p2, exchange):
         bad = _element_granular_ops(hlo)
         assert not bad, (
             "element-granular data movement in the compiled pencil pipeline "
-            f"(the round-4 on-chip pathology, ROADMAP 8b): {bad}"
+            f"({exchange}; the round-4/5 on-chip pathology, ROADMAP 8b): {bad}"
         )
