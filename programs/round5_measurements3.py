@@ -1,0 +1,190 @@
+"""Round-5 on-chip batch 3: R2C blocked sparse-y rows + copy-plan LANE sweep.
+
+1. R2C spherical rows with the round-5 engine (blocked sparse-y now covers
+   R2C via the dense-x0-bucket extension — VERDICT r4 item 3): 128^3 and
+   512^3, blocked-auto vs blocked-off arms (one variable).
+2. Copy-plan LANE width at 512^3 (VERDICT r4 item 2, the descriptor floor):
+   at Z = 512 the Z %% LANE == 0 alignment precondition holds for LANE = 256
+   AND 512 (the round-3 rejection was measured at 256^3 where Z = 256 breaks
+   LANE = 512). Wider lanes quarter the gather descriptor count — decompress
+   is 15.6 ms of the 46 ms 512^3 backward at ~25 ns/row. Arms: LANE 128
+   (default re-pin), 256, 512 at 512^3 C2C sph15; plus 256^3 C2C LANE=256
+   re-check (expected noise, pins the scale dependence).
+
+Appends to bench_results/round5_onchip.json.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_onchip.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_measurements3", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900,
+        exit_code=2,
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import os
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+    from spfft_tpu.ops import lanecopy
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def flops_pair(dim):
+        n = dim**3
+        return 2 * 5.0 * n * np.log2(n)
+
+    def chain_time(ex, re0, im0, chain, r2c=False):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                if r2c:
+                    space = ex.trace_backward(carry[0], carry[1], phase=ph)
+                    out = ex.trace_forward(space, None, ScalingType.FULL, phase=ph)
+                else:
+                    sre, sim = ex.trace_backward(*carry, phase=ph)
+                    out = ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph)
+                return out, None
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, _ = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    def with_env(envs, fn):
+        saved = {k: os.environ.get(k) for k in envs}
+        for k, v in envs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def spherical_r2c_trip(dim):
+        trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)
+        return trip[trip[:, 0] >= 0]  # hermitian non-redundant half
+
+    def measure(name, dim, ttype, chain, env=None, lane=None):
+        def run():
+            orig_lane = lanecopy.LANE
+            if lane is not None:
+                lanecopy.LANE = lane
+            try:
+                if ttype == TransformType.R2C:
+                    trip = spherical_r2c_trip(dim)
+                else:
+                    trip = sp.create_spherical_cutoff_triplets(
+                        dim, dim, dim, 0.659
+                    )
+                t = Transform(
+                    ProcessingUnit.GPU, ttype, dim, dim, dim,
+                    indices=trip, dtype=np.float32, engine="mxu",
+                )
+                ex = t._exec
+                rng = np.random.default_rng(0)
+                n = len(trip)
+                re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+                im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+                best, err = chain_time(
+                    ex, re0, im0, chain, r2c=ttype == TransformType.R2C
+                )
+                record({
+                    "name": name, "dim": dim, "chain": chain,
+                    "ms_per_pair": round(best * 1e3, 3),
+                    "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                    "roundtrip_err": err,
+                    "blocked_buckets": len(ex._sparse_y_blocked or ()),
+                    "x0_bucket": ex._sy_x0_bucket,
+                    "lane": lane or 128,
+                })
+            finally:
+                lanecopy.LANE = orig_lane
+
+        try:
+            with_env(env or {}, run)
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    R2C = TransformType.R2C
+    C2C = TransformType.C2C
+
+    # ---- 1: R2C blocked arms ----
+    measure("r2c_128_sph15_r5_blocked", 128, R2C, 768)
+    measure(
+        "r2c_128_sph15_r5_blocked_off", 128, R2C, 768,
+        env={"SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
+    )
+    measure("r2c_512_sph15_r5_blocked", 512, R2C, 48)
+    measure(
+        "r2c_512_sph15_r5_blocked_off", 512, R2C, 48,
+        env={"SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
+    )
+
+    # ---- 2: LANE sweep at 512^3 (Z % 512 == 0 holds there) ----
+    measure("c2c_512_sph15_r5_lane128", 512, C2C, 48)
+    measure("c2c_512_sph15_r5_lane256", 512, C2C, 48, lane=256)
+    measure("c2c_512_sph15_r5_lane512", 512, C2C, 48, lane=512)
+    # 256^3 scale re-check (expected ~noise per round 3)
+    measure("c2c_256_s15_r5_lane256", 256, C2C, 384, lane=256)
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

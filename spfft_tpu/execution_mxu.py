@@ -94,21 +94,34 @@ class MxuLocalExecution(ExecutionBase):
         # per-bucket padding, bucket gathers in place of expand/pack.
         self._sparse_y = False
         self._sparse_y_blocked = None
+        self._sy_x0_bucket = None
         value_indices = np.asarray(p.value_indices, dtype=np.int64)
-        if not r2c and p.num_sticks:
-            sy_plan = offt.plan_sparse_y(xslot, p.stick_y, A, p.dim_y, rt)
+        if p.num_sticks:
+            sy_plan = (
+                offt.plan_sparse_y(xslot, p.stick_y, A, p.dim_y, rt)
+                if not r2c
+                else None  # per-slot variant stays C2C-only
+            )
             if sy_plan is not None:
                 self._sparse_y = True
                 self._sy, row_of_stick, self._wy_b_sp, self._wy_f_sp = sy_plan
                 stick_of_value = value_indices // Z
                 value_indices = row_of_stick[stick_of_value] * Z + value_indices % Z
             else:
+                # R2C rides the blocked variant too: the x == 0 plane (the
+                # hermitian-fill site) becomes a dense trailing bucket, all
+                # other slots keep exact per-bucket tables (VERDICT r4 item 3)
+                dense_slots = (0,) if r2c and int(ux[0]) == 0 else ()
                 blk = offt.plan_sparse_y_blocked(
-                    xslot, p.stick_y, p.dim_y, rt, S, A * p.dim_y
+                    xslot, p.stick_y, p.dim_y, rt, S, A * p.dim_y,
+                    dense_slots=dense_slots,
                 )
                 if blk is not None:
                     self._sparse_y_blocked = blk["buckets"]
                     self._sy_row_of_stick = blk["row_of_stick"]
+                    if dense_slots:
+                        # the x0 plane is the LAST bucket (trailing dense)
+                        self._sy_x0_bucket = len(blk["buckets"]) - 1
                     # bucket-major slot order: permute the active-x list (the
                     # x-stage matrices fold the permutation) and remap slots
                     perm = blk["slot_perm"]
@@ -120,11 +133,12 @@ class MxuLocalExecution(ExecutionBase):
         self._wx_b, self._wx_f = offt.x_stage_matrices(p.dim_x, ux, A, r2c, rt)
         self._x_active = ux
 
-        # R2C backward plane symmetry acts on the x == 0 plane; with x compaction
-        # that is slot 0 iff an x == 0 stick exists (otherwise the plane is zero
-        # and the fill is a no-op). (The blocked sparse-y permutation is C2C-only,
-        # so the slot-0 assumption holds wherever this matters.)
-        self._x0_slot = 0 if (p.num_sticks and int(ux[0]) == 0) else None
+        # R2C backward plane symmetry acts on the x == 0 plane; locate its slot
+        # in the CURRENT (possibly bucket-major-permuted) active-x order. The
+        # dense-path fill below uses it; when blocked sparse-y engages for R2C
+        # the fill instead runs inside the dense x0 bucket (_sy_x0_bucket).
+        x0_pos = np.flatnonzero(ux == 0) if p.num_sticks else np.empty(0)
+        self._x0_slot = int(x0_pos[0]) if x0_pos.size else None
 
         rows = A * self._sy if self._sparse_y else S
         self._table_rows = rows
@@ -308,9 +322,19 @@ class MxuLocalExecution(ExecutionBase):
         outs_re, outs_im = [], []
         for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
             idx = jnp.asarray(row_idx)
+            gre_b, gim_b = spad_re[idx], spad_im[idx]
+            if b == self._sy_x0_bucket:
+                # R2C: the x == 0 plane rides as this (1, Y, Z) dense bucket;
+                # hermitian-complete it along y before its y-DFT (space-z
+                # domain, same site as the dense path's plane symmetry)
+                with jax.named_scope("plane symmetry"):
+                    fre, fim = symmetry.hermitian_fill_1d_pair(
+                        gre_b[0], gim_b[0], axis=0
+                    )
+                    gre_b, gim_b = fre[None], fim[None]
             wyb = self._bucket_mats(mat_ops, b, forward=False)
             ore, oim = offt.complex_matmul(
-                spad_re[idx], spad_im[idx], *wyb, "ajz,ajk->kaz", prec
+                gre_b, gim_b, *wyb, "ajz,ajk->kaz", prec
             )
             outs_re.append(ore)
             outs_im.append(oim)

@@ -16,6 +16,23 @@ from .errors import InvalidParameterError, OverflowError_
 from .types import ExchangeType, ProcessingUnit
 
 
+def _effective_default_device():
+    """The effective ``jax_default_device``, thread-local override included.
+
+    The ``jax.default_device(...)`` context manager installs a THREAD-LOCAL
+    override; ``jax.config.jax_default_device`` surfaces it on the pinned JAX
+    version but is documented to return only the global value on others
+    (advisor r4). Reading the config object's ``.value`` is the
+    thread-local-aware accessor; fall back to the public attribute if the
+    private module moves."""
+    try:
+        from jax._src.config import default_device
+
+        return default_device.value
+    except Exception:
+        return jax.config.jax_default_device
+
+
 def device_for_processing_unit(processing_unit: ProcessingUnit, device=None):
     """Resolve a ProcessingUnit (and optional explicit device) to a JAX device.
 
@@ -36,7 +53,7 @@ def device_for_processing_unit(processing_unit: ProcessingUnit, device=None):
     pu = ProcessingUnit(processing_unit)
     if device is not None:
         return device
-    default = jax.config.jax_default_device
+    default = _effective_default_device()
     if default is not None and hasattr(default, "platform"):
         if (default.platform == "cpu") == (pu == ProcessingUnit.HOST):
             return default

@@ -217,7 +217,7 @@ def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
 
 def plan_sparse_y_blocked(
     xslot, ys, dim_y: int, real_dtype, num_sticks: int, dense_rows: int,
-    matrix_budget_mb: int | None = None,
+    matrix_budget_mb: int | None = None, dense_slots=(),
 ):
     """Blocked (two-level) sparse-y planning — the win region ABOVE the
     per-slot crossover (``plan_sparse_y`` auto-disengages at Sy/Y >= 0.6,
@@ -244,7 +244,17 @@ def plan_sparse_y_blocked(
     - ``buckets``: list of ``(row_idx (Ag, Syg) int32 into the
       (num_sticks+1)-padded stick table, wyb pair (Ag, Syg, Y), wyf pair)``,
     - ``row_of_stick``: (S,) int32 — each stick's row in the concatenation of
-      the bucket flats (the forward regather map).
+      the bucket flats (the forward regather map),
+    - ``dense_flat``: {original slot: flat row offset} for ``dense_slots``.
+
+    ``dense_slots`` (R2C support): original slot indices to DENSIFY — each
+    becomes its own trailing bucket of shape (1, dim_y) whose rows are the
+    full y extent (stick rows where sticks exist, zero rows elsewhere) with
+    the plain dense y-DFT matrices. The x == 0 plane rides this way so its
+    hermitian fill has every y row available inside the blocked stage
+    (reference wiring being out-done: src/execution/execution_host.cpp:185-191
+    applies sticks-only-y in R2C but this build had fallen back to the dense
+    y stage for R2C entirely).
 
     Reference being out-done: the y-FFT-only-on-stick-bearing-rows idea of
     ``src/fft/transform_1d_host.hpp:155-235``, which skips empty x-rows but
@@ -253,25 +263,41 @@ def plan_sparse_y_blocked(
     mode = os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKS") or "auto"
     if mode == "0":
         return None
+    if mode != "auto":
+        # validated like SPFFT_TPU_SPARSE_Y: 'auto'/'0'/positive int only
+        try:
+            forced_g = int(mode)
+        except ValueError:
+            forced_g = -1
+        if forced_g < 1:
+            raise ValueError(
+                f"SPFFT_TPU_SPARSE_Y_BLOCKS={mode!r}: expected 'auto', '0' "
+                "(disable), or a positive bucket count"
+            )
     xslot = np.asarray(xslot, dtype=np.int64)
     ys = np.asarray(ys, dtype=np.int64)
     if xslot.size == 0:
         return None
     n_slots = int(xslot.max()) + 1
     counts = np.bincount(xslot, minlength=n_slots)
+    dense_slots = tuple(int(s) for s in dense_slots if 0 <= int(s) < n_slots)
+    sortable = np.asarray(
+        [s for s in range(n_slots) if s not in set(dense_slots)], dtype=np.int64
+    )
     # measured bucket-count sweep (bench_results/round4_onchip{,2}.json):
     # G=4 best at 256^3 (5.893 vs 5.979/6.031 ms), G=8 best at 512^3
     # (76.3 vs 77.0 ms) — larger grids profit from tighter padding
-    G = (4 if dim_y <= 256 else 8) if mode == "auto" else max(1, int(mode))
-    G = min(G, n_slots)
-    order = np.argsort(-counts, kind="stable")  # slots by stick count, desc
-    bounds = np.linspace(0, n_slots, G + 1).astype(np.int64)
+    G = (4 if dim_y <= 256 else 8) if mode == "auto" else forced_g
+    G = min(G, sortable.size) if sortable.size else 0
+    # slots by stick count, desc (dense slots excluded — they bucket alone)
+    order = sortable[np.argsort(-counts[sortable], kind="stable")]
+    bounds = np.linspace(0, order.size, G + 1).astype(np.int64)
     sy_of = lambda c: min(dim_y, -(-max(1, int(c)) // 8) * 8)
     padded_rows = sum(
         (bounds[g + 1] - bounds[g]) * sy_of(counts[order[bounds[g]]])
         for g in range(G)
         if bounds[g + 1] > bounds[g]
-    )
+    ) + len(dense_slots) * dim_y
     # engagement: blocked y flops ~ padded_rows * Y * Z vs dense ~ A * Y * Y * Z,
     # so the row totals compare directly (dense_rows = A * dim_y)
     frac = float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
@@ -304,8 +330,6 @@ def plan_sparse_y_blocked(
     cum = np.cumsum(counts) - counts
     j_of = np.empty(xslot.size, dtype=np.int64)
     j_of[by_slot] = np.arange(xslot.size) - cum[xslot[by_slot]]
-    slot_pos = np.empty(n_slots, dtype=np.int64)  # slot -> bucket-major pos
-    slot_pos[order] = np.arange(n_slots)
     buckets = []
     offsets = np.zeros(n_slots, dtype=np.int64)  # per-slot flat offset
     flat_off = 0
@@ -333,11 +357,34 @@ def plan_sparse_y_blocked(
         )
         buckets.append((row_idx.astype(np.int32), wyb, wyf))
         flat_off += Ag * Syg
-    row_of_stick = (offsets[xslot] + j_of).astype(np.int32)
+    # dense trailing buckets (R2C x == 0 plane): full y extent, plain dense
+    # y-DFT matrices; member sticks sit at their natural y row so the
+    # hermitian fill sees the whole plane
+    dense_flat = {}
+    for s in dense_slots:
+        row_idx = np.full((1, dim_y), num_sticks, dtype=np.int64)
+        members = by_slot[cum[s] : cum[s] + counts[s]]
+        row_idx[0, ys[members]] = members
+        wyb = matrix_pair(
+            c2c_matrix(dim_y, +1).reshape(1, dim_y, dim_y), real_dtype
+        )
+        wyf = matrix_pair(
+            c2c_matrix(dim_y, -1).reshape(1, dim_y, dim_y), real_dtype
+        )
+        buckets.append((row_idx.astype(np.int32), wyb, wyf))
+        dense_flat[s] = flat_off
+        flat_off += dim_y
+    row_of_stick = offsets[xslot] + j_of
+    for s in dense_slots:
+        members = by_slot[cum[s] : cum[s] + counts[s]]
+        row_of_stick[members] = dense_flat[s] + ys[members]
     return {
-        "slot_perm": order,
+        "slot_perm": np.concatenate(
+            [order, np.asarray(dense_slots, dtype=np.int64)]
+        ),
         "buckets": buckets,
-        "row_of_stick": row_of_stick,
+        "row_of_stick": row_of_stick.astype(np.int32),
+        "dense_flat": dense_flat,
     }
 
 

@@ -312,9 +312,15 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         # every shard's SPMD program agrees.
         self._sparse_y = False
         self._sparse_y_blocked = None
-        if not r2c and valid.any():
+        self._sy_x0_bucket = None
+        self._sy_x0_flat = 0
+        if valid.any():
             xslot_valid = xslot_of[sx_all[valid]]
-            sy_plan = offt.plan_sparse_y(xslot_valid, sy[valid], A, Y, rt)
+            sy_plan = (
+                offt.plan_sparse_y(xslot_valid, sy[valid], A, Y, rt)
+                if not r2c
+                else None  # per-slot variant stays C2C-only
+            )
             if sy_plan is not None:
                 self._sparse_y = True
                 self._sy, row_valid, self._wy_b_sp, self._wy_f_sp = sy_plan
@@ -333,9 +339,14 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 # full extent the slot domain is all of x and the permutation
                 # bookkeeping buys nothing).
                 nvalid = int(valid.sum())
+                # R2C rides the blocked variant too: the x == 0 plane (the
+                # hermitian-fill site) becomes a dense trailing bucket whose
+                # flat rows [off, off+Y) every shard holds post-exchange
+                dense_slots = (0,) if r2c and self._have_x0 else ()
                 blk = offt.plan_sparse_y_blocked(
                     xslot_valid, sy[valid], Y, rt, nvalid, A * Y,
                     matrix_budget_mb=offt.sparse_y_matrix_budget_bytes() >> 20,
+                    dense_slots=dense_slots,
                 )
                 if blk is not None:
                     vrows = np.flatnonzero(valid)
@@ -351,6 +362,10 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     row_of = np.full(sx_all.size, rb, dtype=np.int64)
                     row_of[vrows] = blk["row_of_stick"]
                     self._stick_row_b = row_of.astype(np.int32)
+                    if dense_slots:
+                        # the x0 plane is the LAST bucket (trailing dense)
+                        self._sy_x0_bucket = len(buckets) - 1
+                        self._sy_x0_flat = int(blk["dense_flat"][0])
                     # bucket-major slot order folds into the x matrices
                     ux_full = ux_full[blk["slot_perm"]]
 
@@ -521,11 +536,25 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
-                pre, pim = symmetry.hermitian_fill_1d_pair(
-                    gre[:, :, 0], gim[:, :, 0], axis=1
-                )
-                gre = gre.at[:, :, 0].set(pre)
-                gim = gim.at[:, :, 0].set(pim)
+                if self._sparse_y_blocked is not None:
+                    if self._ragged is not None:
+                        # blocked flats (L, rb): the dense x0 bucket occupies
+                        # cols [off, off+Y) in natural y order
+                        o = self._sy_x0_flat
+                        pre, pim = symmetry.hermitian_fill_1d_pair(
+                            gre[:, o : o + Y], gim[:, o : o + Y], axis=1
+                        )
+                        gre = gre.at[:, o : o + Y].set(pre)
+                        gim = gim.at[:, o : o + Y].set(pim)
+                    # padded path: the fill runs on the gathered dense bucket
+                    # inside the y-transform loop below (rows are still the
+                    # global stick stack here)
+                else:
+                    pre, pim = symmetry.hermitian_fill_1d_pair(
+                        gre[:, :, 0], gim[:, :, 0], axis=1
+                    )
+                    gre = gre.at[:, :, 0].set(pre)
+                    gim = gim.at[:, :, 0].set(pim)
 
         with jax.named_scope("y transform"):
             if self._sparse_y:
@@ -545,7 +574,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 # (the x matrices fold the slot permutation)
                 outs_re, outs_im = [], []
                 off = 0
-                for row_idx, wyb, _ in self._sparse_y_blocked:
+                for b, (row_idx, wyb, _) in enumerate(self._sparse_y_blocked):
                     Ag, Syg = row_idx.shape
                     if self._ragged is not None:
                         bre = gre[:, off : off + Ag * Syg].reshape(L, Ag, Syg)
@@ -555,8 +584,16 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                         )
                     else:
                         idx = jnp.asarray(row_idx)
+                        bre, bim = gre[idx], gim[idx]  # (Ag, Syg, L)
+                        if b == self._sy_x0_bucket:
+                            # R2C: hermitian-complete the dense x0 plane
+                            # along y before its y-DFT (see plane symmetry)
+                            fre, fim = symmetry.hermitian_fill_1d_pair(
+                                bre[0], bim[0], axis=0
+                            )
+                            bre, bim = fre[None], fim[None]
                         ore, oim = offt.complex_matmul(
-                            gre[idx], gim[idx], *wyb, "ajl,ajk->lka", prec
+                            bre, bim, *wyb, "ajl,ajk->lka", prec
                         )
                     outs_re.append(ore)
                     outs_im.append(oim)

@@ -286,10 +286,23 @@ def distribute_triplets(
         # snap each column's sticks to the group of its first stick
         sx_sorted = storage_x[xorder]
         first_of_col = np.concatenate([[True], sx_sorted[1:] != sx_sorted[:-1]])
-        col_group = group_of_sorted[np.flatnonzero(first_of_col)]
-        group_of_sorted = np.repeat(col_group, np.diff(
+        col_sizes = np.diff(
             np.concatenate([np.flatnonzero(first_of_col), [sx_sorted.size]])
-        ))
+        )
+        col_group = group_of_sorted[np.flatnonzero(first_of_col)]
+        # Snapping can starve later groups when one column dominates the
+        # value counts (advisor r4): if any group came out empty, fall back
+        # to an even split over column boundaries — whole columns stay
+        # together and every group gets at least one column whenever
+        # P1 <= #columns (a dominant column forces load imbalance either
+        # way; starving whole shard columns of ALL sticks is the part this
+        # prevents).
+        if not np.isin(np.arange(P1), col_group).all():
+            n_cols = col_group.size
+            col_group = np.minimum(
+                np.arange(n_cols) * P1 // max(1, n_cols), P1 - 1
+            )
+        group_of_sorted = np.repeat(col_group, col_sizes)
         # 2) greedy largest-first within each column group over its P2 shards
         stick_shard = np.zeros(uniq.size, dtype=np.int64)
         for a in range(P1):

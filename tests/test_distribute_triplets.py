@@ -98,3 +98,25 @@ def test_layout_mode_validation():
         distribute_triplets(t, 4, 8, layout=(2, 2))  # dim_x required
     with pytest.raises(InvalidParameterError):
         distribute_triplets(t, 4, 8, weights=[1, 1, 1, 1], layout=(2, 2), dim_x=8)
+
+
+def test_layout_mode_dominant_column_rebalance():
+    """A value-dominant x column must not starve the other column groups of
+    ALL their sticks (advisor r4): when count-quantile snapping would leave a
+    group empty, the split falls back to even column boundaries — whole
+    columns stay together and every group owns at least one column whenever
+    P1 <= #columns."""
+    trip = [(0, y % 8, z) for y in range(8) for z in range(125)]
+    trip += [(x, 0, z) for x in (1, 2, 3) for z in (0, 1)]
+    trip = np.asarray(trip, dtype=np.int64)
+    P1, P2 = 4, 2
+    per = distribute_triplets(trip, P1 * P2, 8, layout=(P1, P2), dim_x=4)
+    group_sizes = [
+        sum(len(per[a * P2 + b]) for b in range(P2)) for a in range(P1)
+    ]
+    assert all(g > 0 for g in group_sizes), group_sizes
+    # column-locality still holds
+    col_of_x = {}
+    for r, part in enumerate(per):
+        for x in np.unique(part[:, 0]) if len(part) else []:
+            assert col_of_x.setdefault(int(x), r // P2) == r // P2
