@@ -1,0 +1,215 @@
+"""Pallas-DMA vs XLA-gather copy-plan apply — the round-5 A/B (VERDICT r4 #2).
+
+The named-but-unattempted round-4 lever: replace the CopyPlan row gathers
+with a Pallas kernel that DMAs rows directly. Context from this round's LANE
+sweep (bench_results/round5_onchip.json c2c_512_sph15_r5_lane{128,256,512}):
+widening rows 2x/4x (quartering the gather descriptor count) measured
+NEUTRAL-to-worse at 512^3, so the gather's cost is not per-descriptor issue
+overhead — this benchmark probes whether explicit DMA row moves beat
+whatever the gather lowering actually does.
+
+Arms (same (R rows out of M) x 128-lane geometry as the 512^3 decompress):
+  1. jnp.take baseline (the CopyPlan aligned fast path),
+  2. Pallas grid kernel: T-row VMEM output blocks, scalar-prefetched row
+     indices, T in-flight HBM->VMEM row DMAs per program,
+  3. Pallas HBM->HBM single-program kernel: fori_loop over rows with a
+     ring of in-flight DMAs.
+
+Chain-timed on chip (dependent iterations, scalar fence). Appends to
+bench_results/round5_pallas_dma.json.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_pallas_dma.json"
+)
+
+LANE = 128
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "microbench_pallas_dma", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900,
+        exit_code=2,
+    )
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    # 512^3 decompress-class geometry: gather R rows out of an (M, 128) table
+    rng = np.random.default_rng(0)
+    M = 735_000   # ~ S*Z/LANE source rows at 512^3/15% (value flats)
+    R = 360_448   # destination rows (stick table blocks), 8-divisible
+    idx = np.sort(rng.choice(M, size=R, replace=False)).astype(np.int32)
+    src = jnp.asarray(rng.standard_normal((M, LANE)).astype(np.float32))
+    idx_t = jnp.asarray(idx)
+
+    REPS = 32
+
+    def timed(name, fn, *args, extra=None):
+        @jax.jit
+        def loop(s):
+            def body(carry, _):
+                out = fn(carry, *args)
+                # dependent chain: fold output back into a source-shaped
+                # carry via one cheap dynamic slice write
+                return carry.at[:LANE, :].set(out[:LANE, :]), ()
+
+            final, _ = jax.lax.scan(body, s, None, length=REPS)
+            return final.ravel()[0]
+
+        try:
+            float(jax.device_get(loop(src)))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = loop(src)
+                float(jax.device_get(out))
+                best = min(best, (time.perf_counter() - t0) / REPS)
+            row = {"name": name, "ms": round(best * 1e3, 3),
+                   "ns_per_row": round(best / R * 1e9, 2)}
+            if extra:
+                row.update(extra)
+            record(row)
+            return best
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"})
+            return None
+
+    # ---- 1: jnp.take baseline ----
+    timed("xla_take", lambda s: jnp.take(s, idx_t, axis=0))
+
+    # ---- 2: Pallas grid kernel, T rows per program ----
+    def make_grid_kernel(T):
+        def kernel(idx_ref, src_ref, out_ref, sems):
+            i = pl.program_id(0)
+            for j in range(T):
+                pltpu.make_async_copy(
+                    src_ref.at[idx_ref[i * T + j]],
+                    out_ref.at[j],
+                    sems.at[j],
+                ).start()
+            for j in range(T):
+                pltpu.make_async_copy(
+                    src_ref.at[idx_ref[i * T + j]],
+                    out_ref.at[j],
+                    sems.at[j],
+                ).wait()
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(R // T,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(
+                (T, LANE), lambda i, idx_ref: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((T,))],
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, LANE), jnp.float32),
+            grid_spec=grid_spec,
+        )
+
+    for T in (32, 128, 512):
+        try:
+            k = make_grid_kernel(T)
+            timed(f"pallas_grid_T{T}", lambda s, k=k: k(idx_t, s),
+                  extra={"T": T})
+        except Exception as e:
+            record({"name": f"pallas_grid_T{T}",
+                    "error": f"{type(e).__name__}: {e}"})
+
+    # ---- 3: Pallas single-program HBM->HBM ring ----
+    def make_ring_kernel(NSEM):
+        def kernel(idx_ref, src_ref, out_ref, sems):
+            def issue(r, _):
+                slot = jax.lax.rem(r, NSEM)
+                # wait the previous DMA occupying this semaphore slot
+                @pl.when(r >= NSEM)
+                def _():
+                    prev = r - NSEM
+                    pltpu.make_async_copy(
+                        src_ref.at[idx_ref[prev]], out_ref.at[prev],
+                        sems.at[slot],
+                    ).wait()
+
+                pltpu.make_async_copy(
+                    src_ref.at[idx_ref[r]], out_ref.at[r], sems.at[slot]
+                ).start()
+                return ()
+
+            jax.lax.fori_loop(0, R, issue, ())
+
+            def drain(k, _):
+                r = R - NSEM + k
+                slot = jax.lax.rem(r, NSEM)
+                pltpu.make_async_copy(
+                    src_ref.at[idx_ref[r]], out_ref.at[r], sems.at[slot]
+                ).wait()
+                return ()
+
+            jax.lax.fori_loop(0, NSEM, drain, ())
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((NSEM,))],
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, LANE), jnp.float32),
+            grid_spec=grid_spec,
+        )
+
+    for NSEM in (8, 32):
+        try:
+            k = make_ring_kernel(NSEM)
+            timed(f"pallas_ring_N{NSEM}", lambda s, k=k: k(idx_t, s),
+                  extra={"NSEM": NSEM})
+        except Exception as e:
+            record({"name": f"pallas_ring_N{NSEM}",
+                    "error": f"{type(e).__name__}: {e}"})
+
+    # ---- context: contiguous-slice ceiling (what a perfect copy costs) ----
+    timed("contiguous_slice", lambda s: jax.lax.slice(s, (0, 0), (R, LANE)))
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

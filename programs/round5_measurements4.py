@@ -1,0 +1,176 @@
+"""Round-5 on-chip batch 4: driver-config refresh + heuristic-boundary sweeps.
+
+1. Re-pin the remaining driver configs with the round-5 engine: 32^3 dense
+   C2C (config 1), 128^3 spherical C2C (config 2 class), R2C 128^3 dense
+   (config 3).
+2. Engagement-boundary sweeps so the promotion heuristics carry measured
+   error bars (VERDICT r4 item 6, on-chip half): COPY_DENSE_FRAC
+   {0.05, 0.1, 0.3} and SPARSE_Y_BLOCKED_FRAC {0.6, 0.8, 1.0} at the 256^3
+   headline, one variable per arm.
+
+Appends to bench_results/round5_onchip.json.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (
+    Path(__file__).resolve().parent.parent
+    / "bench_results"
+    / "round5_onchip.json"
+)
+
+
+def main():
+    import numpy as np
+
+    from spfft_tpu._platform import hang_watchdog
+
+    disarm = hang_watchdog(
+        "round5_measurements4", "SPFFT_TPU_MEASURE_INIT_BUDGET_S", 900,
+        exit_code=2,
+    )
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"backend ready: {dev}", file=sys.stderr)
+    disarm()
+
+    import os
+
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        ProcessingUnit,
+        ScalingType,
+        Transform,
+        TransformType,
+    )
+
+    results = []
+    if OUT.exists():
+        try:
+            results = json.loads(OUT.read_text())
+        except Exception:
+            results = []
+
+    def record(row):
+        results.append(row)
+        OUT.write_text(json.dumps(results, indent=2))
+        print(json.dumps(row), flush=True)
+
+    def flops_pair(dim):
+        n = dim**3
+        return 2 * 5.0 * n * np.log2(n)
+
+    def chain_time(ex, re0, im0, chain, r2c=False):
+        phase = getattr(ex, "phase_operands", ())
+
+        def chain_fn(r, i, ph):
+            def body(carry, _):
+                if r2c:
+                    space = ex.trace_backward(carry[0], carry[1], phase=ph)
+                    out = ex.trace_forward(space, None, ScalingType.FULL, phase=ph)
+                else:
+                    sre, sim = ex.trace_backward(*carry, phase=ph)
+                    out = ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph)
+                return out, None
+
+            return jax.lax.scan(body, (r, i), None, length=chain)[0]
+
+        step = jax.jit(chain_fn)
+        wre, _ = step(re0, im0, phase)
+        np.asarray(jax.device_get(wre.ravel()[0]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cre, _ = step(re0, im0, phase)
+            float(jax.device_get(cre.ravel()[0]))
+            best = min(best, (time.perf_counter() - t0) / chain)
+        err = float(
+            np.abs(np.asarray(cre).ravel()[:64] - np.asarray(re0).ravel()[:64]).max()
+        )
+        return best, err
+
+    def with_env(envs, fn):
+        saved = {k: os.environ.get(k) for k in envs}
+        for k, v in envs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def measure(name, trip, dim, ttype, chain, env=None):
+        def run():
+            t = Transform(
+                ProcessingUnit.GPU, ttype, dim, dim, dim,
+                indices=trip, dtype=np.float32, engine="mxu",
+            )
+            ex = t._exec
+            rng = np.random.default_rng(0)
+            n = len(trip)
+            re0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            im0 = ex.put(rng.standard_normal(n).astype(np.float32))
+            best, err = chain_time(
+                ex, re0, im0, chain, r2c=ttype == TransformType.R2C
+            )
+            record({
+                "name": name, "dim": dim, "chain": chain,
+                "ms_per_pair": round(best * 1e3, 3),
+                "gflops": round(flops_pair(dim) / best / 1e9, 1),
+                "roundtrip_err": err,
+            })
+
+        try:
+            with_env(env or {}, run)
+        except Exception as e:
+            record({"name": name, "error": f"{type(e).__name__}: {e}"[:300]})
+
+    C2C, R2C = TransformType.C2C, TransformType.R2C
+
+    # ---- 1: remaining driver configs ----
+    dim = 32
+    xs, ys, zs = np.meshgrid(*[np.arange(dim)] * 3, indexing="ij")
+    dense32 = np.stack([xs.ravel(), ys.ravel(), zs.ravel()], 1).astype(np.int64)
+    measure("c2c_32_dense_r5", dense32, 32, C2C, 2048)
+
+    trip128 = sp.create_spherical_cutoff_triplets(128, 128, 128, 0.659)
+    measure("c2c_128_sph15_r5", trip128, 128, C2C, 768)
+
+    xs, ys, zs = np.meshgrid(
+        np.arange(64 + 1), np.arange(128), np.arange(128), indexing="ij"
+    )
+    keep = ~((xs == 0) & (ys > 64))
+    r2c128 = np.stack([xs[keep].ravel(), ys[keep].ravel(), zs[keep].ravel()], 1)
+    measure("r2c_128_dense_r5", r2c128, 128, R2C, 512)
+
+    # ---- 2: heuristic boundary sweeps at the 256^3 headline ----
+    trip256 = sp.create_spherical_cutoff_triplets(256, 256, 256, 0.659)
+    for frac in ("0.05", "0.1", "0.3"):
+        measure(
+            f"c2c_256_s15_r5_densefrac{frac}", trip256, 256, C2C, 384,
+            env={"SPFFT_TPU_COPY_DENSE_FRAC": frac},
+        )
+    for frac in ("0.6", "0.8", "1.0"):
+        measure(
+            f"c2c_256_s15_r5_blockedfrac{frac}", trip256, 256, C2C, 384,
+            env={"SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC": frac},
+        )
+
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
