@@ -20,7 +20,7 @@ bench_results/round5_pallas_dma.json.
 """
 from __future__ import annotations
 
-import functools
+
 import json
 import sys
 import time

@@ -462,12 +462,10 @@ class DistributedExecution(PaddingHelpers):
         if self._ragged is not None:
             # exact-counts exchange: ppermute chain, blocks sized sticks_i x planes_j
             # (the reference's Alltoallv discipline, see parallel/ragged.py)
-            slab_flat = self._ragged.backward(
+            planes = self._ragged.backward(
                 (sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
-            )[0]
-            slab = slab_flat[: L * p.dim_y * p.dim_x_freq].reshape(
-                L, p.dim_y, p.dim_x_freq
-            )
+            )[0]  # (Y*Xf, L) slot-major plane rows
+            slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
         else:
             # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
             sticks_z = sticks.T
@@ -514,7 +512,8 @@ class DistributedExecution(PaddingHelpers):
 
         if self._ragged is not None:
             sticks = self._ragged.forward(
-                (grid,), wire=self._ragged_wire, real_dtype=self.real_dtype
+                (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
+                wire=self._ragged_wire, real_dtype=self.real_dtype,
             )[0]
         else:
             # pack: gather every shard's stick columns from my planes -> (P, L, S)

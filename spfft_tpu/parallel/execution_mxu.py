@@ -492,19 +492,19 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             # sparse-y (A, Sy) stick table — the slot space the exchange was
             # built over)
             with jax.named_scope("exchange"):
+                # (nslots, L) slot-major plane rows (round-5 row-granular
+                # contract) — same orientation family as the padded unpack
                 fre, fim = self._ragged.backward(
                     (sre, sim), wire=self._ragged_wire, real_dtype=rt
                 )
-                ns = self._plane_slots
                 if self._sparse_y:
-                    gre = fre[: L * ns].reshape(L, A, self._sy)
-                    gim = fim[: L * ns].reshape(L, A, self._sy)
+                    gre = fre.reshape(A, self._sy, L)
+                    gim = fim.reshape(A, self._sy, L)
                 elif self._sparse_y_blocked is not None:
-                    gre = fre[: L * ns].reshape(L, ns)
-                    gim = fim[: L * ns].reshape(L, ns)
+                    gre, gim = fre, fim  # (rb, L) bucket flats
                 else:
-                    gre = fre[: L * ns].reshape(L, Y, A)
-                    gim = fim[: L * ns].reshape(L, Y, A)
+                    gre = fre.reshape(Y, A, L).transpose(2, 0, 1)
+                    gim = fim.reshape(Y, A, L).transpose(2, 0, 1)
         else:
             # pack: (S, Z) -> (P, S, L) exchange blocks
             with jax.named_scope("pack"):
@@ -538,14 +538,14 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             with jax.named_scope("plane symmetry"):
                 if self._sparse_y_blocked is not None:
                     if self._ragged is not None:
-                        # blocked flats (L, rb): the dense x0 bucket occupies
-                        # cols [off, off+Y) in natural y order
+                        # blocked flats (rb, L): the dense x0 bucket occupies
+                        # rows [off, off+Y) in natural y order
                         o = self._sy_x0_flat
                         pre, pim = symmetry.hermitian_fill_1d_pair(
-                            gre[:, o : o + Y], gim[:, o : o + Y], axis=1
+                            gre[o : o + Y], gim[o : o + Y], axis=0
                         )
-                        gre = gre.at[:, o : o + Y].set(pre)
-                        gim = gim.at[:, o : o + Y].set(pim)
+                        gre = gre.at[o : o + Y].set(pre)
+                        gim = gim.at[o : o + Y].set(pim)
                     # padded path: the fill runs on the gathered dense bucket
                     # inside the y-transform loop below (rows are still the
                     # global stick stack here)
@@ -558,17 +558,11 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
 
         with jax.named_scope("y transform"):
             if self._sparse_y:
-                # per-slot y contraction straight off the stick table (the two
-                # table orientations of the paths above share one spec via a
-                # transpose-free relabeling)
-                if self._ragged is not None:
-                    gre, gim = offt.complex_matmul(
-                        gre, gim, *self._wy_b_sp, "laj,ajk->lka", prec
-                    )
-                else:
-                    gre, gim = offt.complex_matmul(
-                        gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
-                    )
+                # per-slot y contraction straight off the stick table (both
+                # exchange paths deliver the same (A, Sy, L) orientation)
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
+                )
             elif self._sparse_y_blocked is not None:
                 # per-bucket contractions; bucket-major slot concatenation
                 # (the x matrices fold the slot permutation)
@@ -577,11 +571,8 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 for b, (row_idx, wyb, _) in enumerate(self._sparse_y_blocked):
                     Ag, Syg = row_idx.shape
                     if self._ragged is not None:
-                        bre = gre[:, off : off + Ag * Syg].reshape(L, Ag, Syg)
-                        bim = gim[:, off : off + Ag * Syg].reshape(L, Ag, Syg)
-                        ore, oim = offt.complex_matmul(
-                            bre, bim, *wyb, "laj,ajk->lka", prec
-                        )
+                        bre = gre[off : off + Ag * Syg].reshape(Ag, Syg, L)
+                        bim = gim[off : off + Ag * Syg].reshape(Ag, Syg, L)
                     else:
                         idx = jnp.asarray(row_idx)
                         bre, bim = gre[idx], gim[idx]  # (Ag, Syg, L)
@@ -592,9 +583,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                                 bre[0], bim[0], axis=0
                             )
                             bre, bim = fre[None], fim[None]
-                        ore, oim = offt.complex_matmul(
-                            bre, bim, *wyb, "ajl,ajk->lka", prec
-                        )
+                    ore, oim = offt.complex_matmul(
+                        bre, bim, *wyb, "ajl,ajk->lka", prec
+                    )
                     outs_re.append(ore)
                     outs_im.append(oim)
                     off += Ag * Syg
@@ -641,38 +632,27 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 )
         with jax.named_scope("y transform"):
             if self._sparse_y:
-                # per-slot y contraction straight into the stick table; the
-                # orientation matches what the exchange below consumes
-                if self._ragged is not None:
-                    gre, gim = offt.complex_matmul(
-                        gre, gim, *self._wy_f_sp, "lyk,kjy->lkj", prec
-                    )
-                else:
-                    gre, gim = offt.complex_matmul(
-                        gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
-                    )
+                # per-slot y contraction straight into the stick table (both
+                # exchange paths consume the same (A, Sy, L) orientation)
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
+                )
             elif self._sparse_y_blocked is not None:
-                # per-bucket contractions into bucket flats, oriented for the
-                # exchange below ((L, rb) ragged / (rb, L) padded pack)
+                # per-bucket contractions into (rb, L) bucket flats (the
+                # orientation both exchange paths consume)
                 flats_re, flats_im = [], []
                 col = 0
                 for row_idx, _, wyf in self._sparse_y_blocked:
                     Ag, Syg = row_idx.shape
-                    spec = "lyk,kjy->lkj" if self._ragged is not None else "lyk,kjy->kjl"
                     fre_b, fim_b = offt.complex_matmul(
                         gre[:, :, col : col + Ag], gim[:, :, col : col + Ag],
-                        *wyf, spec, prec,
+                        *wyf, "lyk,kjy->kjl", prec,
                     )
-                    if self._ragged is not None:
-                        flats_re.append(fre_b.reshape(L, Ag * Syg))
-                        flats_im.append(fim_b.reshape(L, Ag * Syg))
-                    else:
-                        flats_re.append(fre_b.reshape(Ag * Syg, L))
-                        flats_im.append(fim_b.reshape(Ag * Syg, L))
+                    flats_re.append(fre_b.reshape(Ag * Syg, L))
+                    flats_im.append(fim_b.reshape(Ag * Syg, L))
                     col += Ag
-                axis = 1 if self._ragged is not None else 0
-                gre = jnp.concatenate(flats_re, axis=axis)
-                gim = jnp.concatenate(flats_im, axis=axis)
+                gre = jnp.concatenate(flats_re, axis=0)
+                gim = jnp.concatenate(flats_im, axis=0)
             else:
                 gre, gim = offt.complex_matmul(
                     gre, gim, *self._wy_f, "lyk,yj->ljk", prec
@@ -680,8 +660,17 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
 
         if self._ragged is not None:
             with jax.named_scope("exchange"):
+                # (nslots, L) slot-major rows (round-5 row-granular contract)
+                if self._sparse_y:
+                    fre = gre.reshape(A * self._sy, L)
+                    fim = gim.reshape(A * self._sy, L)
+                elif self._sparse_y_blocked is not None:
+                    fre, fim = gre, gim  # (rb, L) already
+                else:
+                    fre = gre.reshape(L, Y * A).T
+                    fim = gim.reshape(L, Y * A).T
                 sre, sim = self._ragged.forward(
-                    (gre, gim), wire=self._ragged_wire, real_dtype=rt
+                    (fre, fim), wire=self._ragged_wire, real_dtype=rt
                 )
         else:
             # pack: gather every global stick's compact plane slot (or sparse-y

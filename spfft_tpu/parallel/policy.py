@@ -13,12 +13,13 @@ expressed in byte-equivalents (latency x bandwidth). The default, 128 KiB,
 comes from ICI-class numbers (~1-2 us/round at ~100 GB/s); override with
 ``SPFFT_TPU_EXCH_ROUND_COST_KB``. Grounding against the measured CPU-mesh
 tables (BASELINE.md "Exchange-discipline comparison"): the model picks
-BUFFERED for every balanced row (where COMPACT ties its bytes and loses P-1
-rounds) and UNBUFFERED for the imbalanced rows on backends with the one-shot
-ragged-all-to-all (exact bytes, 1 round — the TPU transport); COMPACT wins
-only when both stick and plane distributions are uneven enough that its
-per-step maxima undercut the padded blocks by more than the chain's round
-cost. Decision-grade ICI wall-clock needs pod hardware (VERDICT r3 item 5);
+BUFFERED for every balanced row and UNBUFFERED for the stick-imbalanced
+rows on backends with the one-shot ragged-all-to-all (exact rows, 1 round —
+the TPU transport). Since the round-5 row-granular transport, the COMPACT
+chain's constant (maxn, Lm) windows tie BUFFERED's volume while costing P-1
+rounds, so DEFAULT never resolves to COMPACT — the enum remains for API
+parity and as the portable exact-rows transport where ragged-all-to-all
+does not compile. Decision-grade ICI wall-clock needs pod hardware (VERDICT r3 item 5);
 until then the constant is the documented, overridable part of the policy.
 
 Explicit disciplines are never overridden — the policy runs only for DEFAULT.
@@ -39,13 +40,17 @@ def discipline_volumes(num_sticks_per_shard, local_z_lengths):
 
     Returns ``{BUFFERED, COMPACT_BUFFERED, UNBUFFERED: off-wire elems}`` from
     plan geometry alone (matches the engines' accounting:
-    PaddingHelpers.exchange_wire_bytes, parallel/ragged.py offwire_elems):
+    PaddingHelpers.exchange_wire_bytes, parallel/ragged.py offwire_elems) —
+    all three reflecting the round-5 ROW-GRANULAR transports:
 
     - BUFFERED: P(P-1) uniform S_max x L_max padded blocks.
-    - COMPACT: the ppermute chain's per-step uniform buffers, each sized
-      ``max_i sticks_i * planes_{(i+k) mod P}`` (true Alltoallv blocks ride a
-      rotation chain whose step buffer is the step's largest block).
-    - UNBUFFERED: the exact Alltoallw volume ``sum_{i != j} sticks_i * planes_j``.
+    - COMPACT: the ppermute chain's constant (S_max x L_max) 2-D windows
+      (the engines' _chain_step_sizes rule — single source so the cost
+      model cannot diverge from what actually rides the wire; ties
+      BUFFERED's volume, see the ragged module docstring).
+    - UNBUFFERED: exact rows x the full L_max row width,
+      ``sum_{i != j} sticks_i * L_max`` (the ragged-all-to-all unit is an
+      L_max-wide row).
     """
     from .ragged import _chain_step_sizes
 
@@ -58,16 +63,15 @@ def discipline_volumes(num_sticks_per_shard, local_z_lengths):
             ExchangeType.COMPACT_BUFFERED: 0,
             ExchangeType.UNBUFFERED: 0,
         }
-    buffered = P * (P - 1) * int(s.max()) * int(max(1, l.max()))
-    exact_total = int(s.sum()) * int(l.sum()) - int((s * l).sum())
-    # Per-step maxima from the engines' own chain rule (single source so the
-    # cost model cannot diverge from what actually rides the wire).
+    Lm = int(max(1, l.max()))
+    buffered = P * (P - 1) * int(s.max()) * Lm
+    oneshot = (P - 1) * int(s.sum()) * Lm
     b_bwd, _ = _chain_step_sizes(s, l)
     compact = P * sum(b_bwd[1:])
     return {
         ExchangeType.BUFFERED: buffered,
         ExchangeType.COMPACT_BUFFERED: compact,
-        ExchangeType.UNBUFFERED: exact_total,
+        ExchangeType.UNBUFFERED: oneshot,
     }
 
 

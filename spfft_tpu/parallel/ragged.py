@@ -11,20 +11,26 @@ This module realizes exact counts on TPU as a chain of P-1 ``lax.ppermute``
 rotations (XLA's ragged-all-to-all HLO is not available on all backends; a
 ring of shifted permutes is the portable ICI-friendly form — each step is a
 uniform nearest-neighbor-style rotation). Step k moves the (i -> (i+k) mod P)
-blocks for every shard i at once; each step's buffer is padded only to
-``max_i sticks_i * planes_{(i+k) mod P}`` — the per-step maximum of *exact
-products*, not the global ``S_max * L_max``. Total wire volume is therefore
-``P * sum_k max_i(n_i * L_{(i+k) mod P})``: between the exact Alltoallv volume
-and the padded ``P (P-1) S_max L_max``, and strictly below the padded volume
-whenever the step maxima vary (imbalance in both sticks and planes; with
-uniform planes and one heavy stick shard the two volumes tie). The self-block
-(k = 0) never touches the wire.
+blocks for every shard i at once. The self-block (k = 0) never touches the
+wire.
+
+ROW-GRANULAR transport (round 5): every buffer moves whole rows — constant
+(maxn, Lm) 2-D windows on the chain, L_max-wide row units on the
+one-shot ragged-all-to-all — via dynamic slices and static-map row gathers,
+never per-element index math (XLA:TPU serializes element gathers/scatters at
+~20 ns/element; bench_results/round5_pencil_bisect2.json measured 640 ms of
+a 980 ms pencil pair in exactly this pathology). Consequence for the CHAIN's
+wire volume: each step's window spans the maxima over ALL its shard pairs,
+which for P >= 2 ties the padded BUFFERED volume — the chain's value is now
+latency-shape portability (the exact-rows transport where ragged-all-to-all
+does not compile), while the byte savings of exact counts live in the
+one-shot UNBUFFERED form (exact rows x L_max; 1/P of the padded volume under
+maximal stick skew). ``_chain_step_sizes`` is the single source for what the
+chain ships, shared with the DEFAULT policy's cost model.
 
 Block layout on the wire is stick-major ``(stick, plane)``, matching the
 reference's pack order (reference:
-transpose_mpi_compact_buffered_host.cpp:109-175). All gather/scatter indices
-are computed in-trace from iota plus per-step traced scalars (the peer's
-stick/plane counts), so no O(data)-sized index tables are materialized.
+transpose_mpi_compact_buffered_host.cpp:109-175).
 
 Used by both mesh engines for ExchangeType.COMPACT_BUFFERED{,_FLOAT,_BF16};
 UNBUFFERED instead uses :class:`OneShotExchange` below (exact counts in ONE
@@ -118,16 +124,23 @@ def _join_complex(outs, cdtype):
 
 def _chain_step_sizes(n, L):
     """Per-rotation static buffer sizes for an exact-counts chain over
-    per-shard stick counts ``n`` and plane counts ``L``: at step k every
-    shard's buffer is the per-step maximum exact product (>= 1 so iota shapes
-    stay valid). Returns (backward, forward) size lists; forward sizes are
-    the backward ones with the rotation reversed (b_fwd[k] == b_bwd[P-k]).
-    Shared by the COMPACT chain and the one-shot exchange's chain transport."""
+    per-shard stick counts ``n`` and plane counts ``L``.
+
+    Since round 5 the chain ships 2-D ROW windows, never flat element
+    buffers (whole-row dynamic slices are the TPU-fast form — element-unit
+    packing measured ~20 ns/element, bench_results/round5_pencil_bisect2.json).
+    Every step's window must fit every shard pair of the step, and each
+    step's pairs range over ALL shards, so the window is the CONSTANT
+    (max_i n_i, max_i L_i) rectangle — the chain's wire volume therefore
+    ties the padded BUFFERED discipline's; its remaining value is
+    portability (the exact-rows transport where ragged-all-to-all does not
+    compile). Returns (backward, forward) per-step size lists (uniform;
+    kept list-shaped for the accounting sums). Shared by the COMPACT chain
+    and the one-shot exchange's chain transport — and by the DEFAULT
+    policy's cost model, which must stay single-sourced with this rule."""
     P = int(n.size)
-    s = np.arange(P)
-    b_bwd = [max(1, int((n * L[(s + k) % P]).max())) for k in range(P)]
-    b_fwd = [max(1, int((n[(s + k) % P] * L).max())) for k in range(P)]
-    return b_bwd, b_fwd
+    window = max(1, int(n.max())) * max(1, int(L.max()))
+    return [window] * P, [window] * P
 
 
 def _wire_step(chunks, k, num_shards, axis_names, wire, dtype, real_dtype):
@@ -172,10 +185,25 @@ class RaggedExchange:
         self._n, self._L, self._zo = n, L, zo
         self._yx = np.asarray(yx_flat, dtype=np.int32)
         P = self.P
-        # Per-step exact-product buffer sizes (>= 1 so iota shapes stay valid).
-        # One static size per step serves both sides: at step k, max over
-        # senders of the send size equals max over receivers of the recv size.
-        self._b_bwd, self._b_fwd = _chain_step_sizes(n, L)
+        # Row-granular transport geometry (see _chain_step_sizes): the
+        # constant (maxn, Lm) window, its size for the wire accounting, and
+        # the static maps the end-of-chain compactions gather through.
+        self._b_bwd, _ = _chain_step_sizes(n, L)
+        self._maxn = max(1, int(n.max()))
+        # plane slot -> row in the received (P, maxn) stick-row stack
+        # (sentinel P*maxn -> zero row)
+        slot_src = np.full(self.nslots, P * self._maxn, dtype=np.int32)
+        for r in range(P):
+            for j in range(int(n[r])):
+                slot = int(self._yx[r * self.S + j])
+                if slot < self.nslots:
+                    slot_src[slot] = r * self._maxn + j
+        self._slot_src = slot_src
+        # padded global stick row -> plane slot, sentinel -> the zero row
+        # appended after the (nslots, Lm) planes
+        self._yx_rows = np.minimum(
+            self._yx.astype(np.int64), self.nslots
+        ).astype(np.int32)
 
     @property
     def step_buffer_sizes(self):
@@ -203,113 +231,102 @@ class RaggedExchange:
             jnp.asarray(self._yx),
         )
 
-    def _stick_chunk(self, flats, b, n_me, L_peer, zo_peer):
-        """Gather (n_me sticks x L_peer planes of `peer`) from padded (S*Z + 1)
-        stick flats, stick-major, zero-padded to static size b."""
-        idx = jnp.arange(b, dtype=jnp.int32)
-        Ls = jnp.maximum(L_peer, 1)
-        s, l = idx // Ls, idx % Ls
-        src = jnp.where(idx < n_me * L_peer, s * self.Z + zo_peer + l, self.S * self.Z)
-        return [f[src] for f in flats]
+    # ---- public pipelines (called inside shard_map) ----
+    #
+    # ROW-GRANULAR transport (round 5): every chain step moves a 2-D window
+    # of whole rows via dynamic_slice / dynamic_update_slice — never element
+    # index math (measured ~20 ns/element through XLA:TPU's serialized
+    # gather/scatter, bench_results/round5_pencil_bisect2.json). Receives
+    # accumulate into per-source (P, maxn, Lm) row stacks; the slab/stick
+    # reassembly happens ONCE at the end through static maps (the same
+    # z-minor restructuring the pencil engines got in this round).
 
-    def _plane_chunk(self, flats, peer, b, n_peer, L_me, yx):
-        """Gather (n_peer sticks of `peer` x L_me planes) from padded
-        (Lm*nslots + 1) plane flats, stick-major, zero-padded to size b."""
-        idx = jnp.arange(b, dtype=jnp.int32)
-        Ls = jnp.maximum(L_me, 1)
-        s, l = idx // Ls, idx % Ls
-        valid = idx < n_peer * L_me
-        slot = yx[peer * self.S + jnp.where(valid, s, 0)]
-        src = jnp.where(
-            valid & (slot < self.nslots), l * self.nslots + slot, self.Lm * self.nslots
-        )
-        return [f[src] for f in flats]
+    def backward(self, parts, wire=None, real_dtype=None):
+        """(S, Z) stick parts -> (nslots, Lm) slot-major plane-row parts.
 
-    def _scatter_planes(self, outs, chunks, src_shard, n_src, L_me, yx):
-        """Scatter a received (n_src sticks x L_me planes) chunk into the
-        (Lm*nslots + 1) plane flats."""
-        b = chunks[0].shape[-1]
-        idx = jnp.arange(b, dtype=jnp.int32)
-        Ls = jnp.maximum(L_me, 1)
-        s, l = idx // Ls, idx % Ls
-        valid = idx < n_src * L_me
-        slot = yx[src_shard * self.S + jnp.where(valid, s, 0)]
-        dest = jnp.where(
-            valid & (slot < self.nslots), l * self.nslots + slot, self.Lm * self.nslots
-        )
-        return [o.at[dest].set(c) for o, c in zip(outs, chunks)]
-
-    def _scatter_sticks(self, outs, chunks, n_me, L_src, zo_src):
-        """Scatter a received (n_me sticks x L_src planes) chunk into the
-        (S*Z + 1) stick flats."""
-        b = chunks[0].shape[-1]
-        idx = jnp.arange(b, dtype=jnp.int32)
-        Ls = jnp.maximum(L_src, 1)
-        s, l = idx // Ls, idx % Ls
-        dest = jnp.where(idx < n_me * L_src, s * self.Z + zo_src + l, self.S * self.Z)
-        return [o.at[dest].set(c) for o, c in zip(outs, chunks)]
-
-    def _chain(self, flats, outs, make_chunk, scatter, sizes, wire, rt):
-        """The ppermute chain: self-block locally, then P-1 rotations."""
-        P = self.P
+        parts: tuple of (S, Z) arrays (one complex array, or a (re, im) pair).
+        Each output row is one plane slot's z-extent (valid prefix = the
+        local plane count); consumers reorient with plain reshapes/transposes.
+        """
+        P, S, Lm = self.P, self.S, self.Lm
+        n_t, L_t, zo_t, _ = self._tables()
         me = jax.lax.axis_index(FFT_AXIS)
-        dtype = flats[0].dtype
+        dtype = parts[0].dtype
+        maxn = self._maxn
+        zero = jnp.zeros((), jnp.int32)
+        # z-padding keeps the (maxn, Lm) window slice clamp-free at every zo
+        padded = [jnp.pad(p, ((0, 0), (0, Lm))) for p in parts]
+        stacks = [jnp.zeros((P, maxn, Lm), dtype) for _ in parts]
         for k in range(P):
             dst = (me + k) % P
             src = (me - k) % P
-            chunks = make_chunk(flats, dst, sizes[k])
+            chunks = [
+                jax.lax.dynamic_slice(pz, (zero, zo_t[dst]), (maxn, Lm))
+                for pz in padded
+            ]
+            # ship zeros beyond the destination's plane count (rows beyond
+            # the local stick count are zero already: stick-table padding)
+            cmask = jnp.arange(Lm, dtype=jnp.int32)[None, :] < L_t[dst]
+            chunks = [jnp.where(cmask, c, 0) for c in chunks]
             if k:
-                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, rt)
-            outs = scatter(outs, chunks, src)
+                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, real_dtype)
+            stacks = [
+                jax.lax.dynamic_update_slice(o, c[None], (src, zero, zero))
+                for o, c in zip(stacks, chunks)
+            ]
+        # one static whole-row gather: plane slot -> (source shard, stick row)
+        inv = jnp.asarray(self._slot_src)
+        outs = []
+        for st in stacks:
+            rows = jnp.concatenate(
+                [st.reshape(P * maxn, Lm), jnp.zeros((1, Lm), dtype)]
+            )
+            outs.append(jnp.take(rows, inv, axis=0))
         return outs
 
-    # ---- public pipelines (called inside shard_map) ----
-
-    def backward(self, parts, wire=None, real_dtype=None):
-        """(S, Z) stick parts -> (Lm * nslots + 1,) plane flats (padding slot last).
-
-        parts: tuple of (S, Z) arrays (one complex array, or a (re, im) pair).
-        """
-        n_t, L_t, zo_t, yx = self._tables()
-        me = jax.lax.axis_index(FFT_AXIS)
-        n_me, L_me = n_t[me], L_t[me]
-        flats = [
-            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
-        ]
-        outs = [
-            jnp.zeros(self.Lm * self.nslots + 1, dtype=p.dtype) for p in parts
-        ]
-
-        def make_chunk(flats, dst, b):
-            return self._stick_chunk(flats, b, n_me, L_t[dst], zo_t[dst])
-
-        def scatter(outs, chunks, src):
-            return self._scatter_planes(outs, chunks, src, n_t[src], L_me, yx)
-
-        return self._chain(
-            flats, outs, make_chunk, scatter, self._b_bwd, wire, real_dtype
-        )
-
     def forward(self, parts, wire=None, real_dtype=None):
-        """(Lm * nslots,) plane flats -> (S, Z) stick parts (padding rows zero)."""
-        n_t, L_t, zo_t, yx = self._tables()
+        """(nslots, Lm) slot-major plane-row parts -> (S, Z) stick parts
+        (padding rows zero)."""
+        P, S, Z, Lm = self.P, self.S, self.Z, self.Lm
+        n_t, L_t, zo_t, _ = self._tables()
         me = jax.lax.axis_index(FFT_AXIS)
-        n_me, L_me = n_t[me], L_t[me]
-        flats = [
-            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
+        L_me = L_t[me]
+        dtype = parts[0].dtype
+        maxn = self._maxn
+        zero = jnp.zeros((), jnp.int32)
+        # one static whole-row gather: every shard's stick rows from my planes
+        yx_rows = jnp.asarray(self._yx_rows)
+        rows = [
+            jnp.take(
+                jnp.concatenate([p, jnp.zeros((1, Lm), dtype)]), yx_rows, axis=0
+            ).reshape(P, S, Lm)
+            for p in parts
         ]
-        outs = [jnp.zeros(self.S * self.Z + 1, dtype=p.dtype) for p in parts]
-
-        def make_chunk(flats, dst, b):
-            return self._plane_chunk(flats, dst, b, n_t[dst], L_me, yx)
-
-        def scatter(outs, chunks, src):
-            return self._scatter_sticks(outs, chunks, n_me, L_t[src], zo_t[src])
-
-        sticks = self._chain(
-            flats, outs, make_chunk, scatter, self._b_fwd, wire, real_dtype
-        )
-        return [s[: self.S * self.Z].reshape(self.S, self.Z) for s in sticks]
+        cmask_me = jnp.arange(Lm, dtype=jnp.int32)[None, :] < L_me
+        stacks = [jnp.zeros((P, maxn, Lm), dtype) for _ in parts]
+        for k in range(P):
+            dst = (me + k) % P
+            src = (me - k) % P
+            chunks = [
+                jax.lax.dynamic_slice(rw, (dst, zero, zero), (1, maxn, Lm))[0]
+                for rw in rows
+            ]
+            # ship zeros beyond my plane count (sentinel rows are zero already)
+            chunks = [jnp.where(cmask_me, c, 0) for c in chunks]
+            if k:
+                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, real_dtype)
+            stacks = [
+                jax.lax.dynamic_update_slice(o, c[None], (src, zero, zero))
+                for o, c in zip(stacks, chunks)
+            ]
+        # static compaction: stick s's z-line = its per-source z-windows in
+        # slab order (the z-slabs tile [0, Z))
+        outs = []
+        for st in stacks:
+            pieces = [st[p_, :, : int(self._L[p_])] for p_ in range(P)]
+            full = jnp.concatenate(pieces, axis=-1)  # (maxn, Z)
+            outs.append(jnp.pad(full, ((0, S - maxn), (0, 0))))
+        return outs
 
 
 def _ragged_a2a_supported(mesh) -> bool:
@@ -418,10 +435,6 @@ class OneShotExchange:
             raise ValueError(f"unknown transport {transport!r}")
         self.transport = transport
 
-        # static owner map: which shard's slab holds global z
-        zmap = np.searchsorted(zo, np.arange(self.Z), side="right") - 1
-        self._z_L = L[zmap]  # L of the owner of each z
-        self._z_base = zo[zmap]  # zo of the owner of each z
         # compact global stick row -> plane slot (strip the padded rows of the
         # (P, S) stick tables; sentinel slots cannot occur on real sticks)
         yx = np.asarray(yx_flat, dtype=np.int64)
@@ -431,18 +444,33 @@ class OneShotExchange:
         self._yx_compact = (
             np.concatenate(rows) if rows else np.zeros(0, np.int64)
         ).astype(np.int32)
-        # compact row -> (owner shard, local row) for the forward send packing
+        # compact row -> (owner shard, owner-local row) for the forward send
         self._row_cumn = np.repeat(self._cumn, n).astype(np.int64)
-        # chain-transport per-step buffer sizes (shared rule with RaggedExchange)
-        self._b_bwd, self._b_fwd = _chain_step_sizes(n, L)
+        self._row_owner = np.repeat(np.arange(self.P), n).astype(np.int64)
+        # Row-granular transport geometry (round 5; see _chain_step_sizes):
+        # the ragged unit is one Lm-wide row, chain steps ship the constant
+        # (maxn, Lm) window.
+        self._maxn = max(1, int(n.max()))
+        # plane slot -> compact stick row (sentinel N -> zero row)
+        inv_compact = np.full(self.nslots, max(1, self.N), dtype=np.int32)
+        if self.N:
+            inv_compact[self._yx_compact] = np.arange(self.N, dtype=np.int32)
+        self._inv_compact = inv_compact
+        # compact row -> row in the chain transport's (P, maxn) receive stack
+        self._compact_stack_row = (
+            self._row_owner * self._maxn
+            + (np.arange(max(1, self.N))[: self.N] - self._row_cumn)
+        ).astype(np.int32)
 
     def offwire_elems(self) -> int:
-        """Exact off-shard element count per exchange direction, summed over
-        the mesh: sum over i != j of sticks_i * planes_j — the true Alltoallv
-        volume (the chain transport ships per-step maxima instead; this
-        accounting reports the ragged one-shot volume the discipline targets)."""
-        n, L = self._n, self._L
-        return int(n.sum() * L.sum() - (n * L).sum())
+        """Off-shard element count per exchange direction, summed over the
+        mesh: exact rows x the full Lm row width (the round-5 row-granular
+        wire form — rows ship whole, their invalid-cols tail zero; the chain
+        transport ships per-step (max rows x max cols) windows instead,
+        accounted by step_buffer_sizes... this reports the ragged one-shot
+        volume the discipline targets)."""
+        n = self._n
+        return int(n.sum()) * (self.P - 1) * self.Lm
 
     def rounds(self) -> int:
         """Sequential collective rounds per exchange under the active transport."""
@@ -463,113 +491,92 @@ class OneShotExchange:
     _split_complex = staticmethod(_split_complex)
     _join_complex = staticmethod(_join_complex)
 
-    def _transport_exchange(self, send, out, in_off, send_sizes, out_off,
-                            recv_sizes, recv_off, step_sizes, wire, dtype, rt):
-        """Move the one-shot buffers: one ragged-all-to-all, or the ppermute
-        chain over the same layout. ``out_off`` is sender-side (where my
-        segment lands on each receiver), ``recv_off`` receiver-side (where the
-        segment FROM each peer lands here) — the collective needs the former,
-        the chain the latter."""
-        P = self.P
-        wd = _wire_np_dtype(wire)
-        if self.transport == "ragged":
-            buf = send if wd is None else send.astype(wd)
-            obuf = out if wd is None else out.astype(wd)
-            res = jax.lax.ragged_all_to_all(
-                buf, obuf,
-                in_off.astype(jnp.int32), send_sizes.astype(jnp.int32),
-                out_off.astype(jnp.int32), recv_sizes.astype(jnp.int32),
-                axis_name=FFT_AXIS,
-            )
-            return res if wd is None else res.astype(dtype)
-        me = jax.lax.axis_index(FFT_AXIS)
-        k_parts = send.shape[-1]
-        sentinel_in = send.shape[0]
-        send_g = jnp.concatenate([send, jnp.zeros((1, k_parts), send.dtype)])
-        sentinel_out = out.shape[0]
-        out = jnp.concatenate([out, jnp.zeros((1, k_parts), out.dtype)])
-        for k in range(P):
-            dst = (me + k) % P
-            src = (me - k) % P
-            b = step_sizes[k]
-            idx = jnp.arange(b, dtype=jnp.int32)
-            gsrc = jnp.where(idx < send_sizes[dst], in_off[dst] + idx, sentinel_in)
-            chunks = [send_g[gsrc, j] for j in range(k_parts)]
-            if k:
-                chunks = _wire_step(chunks, k, P, FFT_AXIS, wire, dtype, rt)
-            gdst = jnp.where(idx < recv_sizes[src], recv_off[src] + idx, sentinel_out)
-            for j in range(k_parts):
-                out = out.at[gdst, j].set(chunks[j])
-        return out[:sentinel_out]
-
     # ---- public pipelines (called inside shard_map) ----
+    #
+    # ROW-GRANULAR buffers (round 5): the ragged-all-to-all unit is one
+    # Lm-wide row; the chain transport ships 2-D windows. Pack/unpack are
+    # whole-row gathers through STATIC maps plus static window slices --
+    # never element index math (measured ~20 ns/element through XLA:TPU's
+    # serialized gather/scatter, bench_results/round5_pencil_bisect2.json).
 
     def backward(self, parts, wire=None, real_dtype=None):
-        """(S, Z) stick parts -> (Lm * nslots + 1,) plane flats (padding slot
-        last). Same contract as :meth:`RaggedExchange.backward`."""
+        """(S, Z) stick parts -> (nslots, Lm) slot-major plane-row parts.
+        Same contract as :meth:`RaggedExchange.backward`."""
         parts, cdt = self._split_complex(parts)
-        P, S, Z, Lm, N = self.P, self.S, self.Z, self.Lm, max(1, self.N)
+        P, S, Lm, N = self.P, self.S, self.Lm, max(1, self.N)
         n_t, L_t, zo_t, cumn_t = self._tables()
         me = jax.lax.axis_index(FFT_AXIS)
-        n_me, L_me = n_t[me], L_t[me]
+        n_me = n_t[me]
         dtype = parts[0].dtype
         rt = real_dtype
+        maxn = self._maxn
+        zero = jnp.zeros((), jnp.int32)
 
-        # pack: (S, Z) -> one-shot send buffer (destination-contiguous)
-        z_i = jnp.arange(Z, dtype=jnp.int32)
-        col = jnp.asarray((np.arange(Z) - self._z_base).astype(np.int32))
-        zL = jnp.asarray(self._z_L.astype(np.int32))
-        zbase = jnp.asarray(self._z_base.astype(np.int32))
-        # dest(s, z) = n_me * zo(owner) + s * L(owner) + (z - zo(owner))
-        s_i = jnp.arange(S, dtype=jnp.int32)[:, None]
-        dest = n_me * zbase[None, :] + s_i * zL[None, :] + col[None, :]
-        dest = jnp.where(s_i < n_me, dest, S * Z).reshape(-1)
-        send = jnp.stack(
-            [
-                jnp.zeros(S * Z + 1, dtype=dtype).at[dest].set(p.reshape(-1))[
-                    : S * Z
-                ]
-                for p in parts
-            ],
-            axis=-1,
-        )
+        # pack: per-destination z-windows of my sticks, all offsets STATIC
+        # ((P, S, Lm) stack; window d holds cols [0, L_d) of slab d)
+        def window_stack(part):
+            wins = []
+            for d in range(P):
+                Ld, zod = int(self._L[d]), int(self._zo[d])
+                w = jax.lax.slice(part, (0, zod), (S, zod + Ld))
+                if Ld < Lm:
+                    w = jnp.pad(w, ((0, 0), (0, Lm - Ld)))
+                wins.append(w)
+            return jnp.stack(wins)  # (P, S, Lm)
 
-        out = jnp.zeros((N * Lm, len(parts)), dtype=dtype)
-        in_off = n_me * zo_t
-        send_sizes = n_me * L_t
-        out_off = jnp.full((P,), cumn_t[me] * Lm, dtype=jnp.int32)
-        recv_sizes = n_t * L_me
-        recv_off = cumn_t * Lm
-        res = self._transport_exchange(
-            send, out, in_off, send_sizes, out_off, recv_sizes, recv_off,
-            self._b_bwd, wire, dtype, rt,
-        )
+        stacks = [window_stack(part) for part in parts]
+        wd = _wire_np_dtype(wire)
 
-        # unpack: compact stick-row segments (rows packed at stride L_me within
-        # each peer's contiguous segment, segments spaced Lm rows apart) ->
-        # plane flats. One gather re-spreads rows, one scatter places them.
-        yx_c = jnp.asarray(self._yx_compact[: self.N])
-        l_i = jnp.arange(Lm, dtype=jnp.int32)[None, :]
-        if self.N:
-            r_i = jnp.arange(self.N, dtype=jnp.int32)[:, None]
-            cumn_r = jnp.asarray(self._row_cumn.astype(np.int32))[: self.N, None]
-            rsrc = cumn_r * Lm + (r_i - cumn_r) * L_me + l_i  # (N, Lm)
-            rsrc = jnp.where(l_i < L_me, rsrc, N * Lm)
-            pdest = l_i * self.nslots + yx_c[:, None]  # (N, Lm)
+        if self.transport == "ragged":
+            operand = jnp.stack(
+                [st.reshape(P * S, Lm) for st in stacks], axis=-1
+            )  # (P*S, Lm, parts): segment d at row offset d*S, n_me valid rows
+            buf = operand if wd is None else operand.astype(wd)
+            out = jnp.zeros((N, Lm, len(parts)), dtype=buf.dtype)
+            res = jax.lax.ragged_all_to_all(
+                buf, out,
+                (jnp.arange(P, dtype=jnp.int32) * S),
+                jnp.broadcast_to(n_me, (P,)).astype(jnp.int32),
+                jnp.broadcast_to(cumn_t[me], (P,)).astype(jnp.int32),
+                n_t.astype(jnp.int32),
+                axis_name=FFT_AXIS,
+            )
+            if wd is not None:
+                res = res.astype(dtype)
+            rows = [res[..., j] for j in range(len(parts))]  # (N, Lm) compact
         else:
-            rsrc = jnp.full((N, Lm), N * Lm, jnp.int32)
-            pdest = jnp.full((N, Lm), Lm * self.nslots, jnp.int32)
-        res_g = jnp.concatenate([res, jnp.zeros((1, len(parts)), dtype)])
-        rows = res_g[rsrc.reshape(-1)]  # (N * Lm, k); invalid slots read zero
+            recv = [jnp.zeros((P, maxn, Lm), dtype) for _ in parts]
+            for k in range(P):
+                dst = (me + k) % P
+                src = (me - k) % P
+                chunks = [
+                    jax.lax.dynamic_slice(st, (dst, zero, zero), (1, maxn, Lm))[0]
+                    for st in stacks
+                ]
+                if k:
+                    chunks = _wire_step(
+                        chunks, k, P, FFT_AXIS, wire, dtype, rt
+                    )
+                recv = [
+                    jax.lax.dynamic_update_slice(o, c[None], (src, zero, zero))
+                    for o, c in zip(recv, chunks)
+                ]
+            remap = jnp.asarray(self._compact_stack_row)  # (N,) static
+            rows = [
+                jnp.take(r.reshape(P * maxn, Lm), remap, axis=0) for r in recv
+            ]
+
+        # unpack: one static whole-row gather, plane slot -> compact row
+        inv = jnp.asarray(self._inv_compact)
         outs = []
-        for j in range(len(parts)):
-            flat = jnp.zeros(Lm * self.nslots + 1, dtype=dtype)
-            outs.append(flat.at[pdest.reshape(-1)].set(rows[:, j]))
+        for r in rows:
+            rg = jnp.concatenate([r, jnp.zeros((1, Lm), dtype)])
+            outs.append(jnp.take(rg, inv, axis=0))
         return self._join_complex(outs, cdt)
 
     def forward(self, parts, wire=None, real_dtype=None):
-        """(Lm * nslots,) plane flats -> (S, Z) stick parts (padding rows
-        zero). Same contract as :meth:`RaggedExchange.forward`."""
+        """(nslots, Lm) slot-major plane-row parts -> (S, Z) stick parts
+        (padding rows zero). Same contract as :meth:`RaggedExchange.forward`."""
         parts, cdt = self._split_complex(parts)
         P, S, Z, Lm, N = self.P, self.S, self.Z, self.Lm, max(1, self.N)
         n_t, L_t, zo_t, cumn_t = self._tables()
@@ -577,62 +584,73 @@ class OneShotExchange:
         n_me, L_me = n_t[me], L_t[me]
         dtype = parts[0].dtype
         rt = real_dtype
-        flats = [
-            jnp.concatenate([p.reshape(-1), jnp.zeros(1, p.dtype)]) for p in parts
-        ]
+        maxn = self._maxn
+        zero = jnp.zeros((), jnp.int32)
 
-        # pack: gather the compact (N, Lm) row table from my planes, then
-        # re-pack rows at stride L_me so each owner's segment is contiguous
-        yx_c = jnp.asarray(self._yx_compact[: self.N])
-        l_i = jnp.arange(Lm, dtype=jnp.int32)[None, :]
-        if self.N:
-            psrc = jnp.where(
-                l_i < L_me, l_i * self.nslots + yx_c[:, None], Lm * self.nslots
-            )  # (N, Lm); cols >= L_me read the zero sentinel
-        else:
-            psrc = jnp.full((N, Lm), Lm * self.nslots, jnp.int32)
-        cumn_r = jnp.asarray(self._row_cumn.astype(np.int32))[: self.N]
-        if self.N:
-            r_i = jnp.arange(self.N, dtype=jnp.int32)
-            sdest = cumn_r[:, None] * Lm + (r_i - cumn_r)[:, None] * L_me + l_i
-            sdest = jnp.where(l_i < L_me, sdest, N * Lm)  # (N, Lm)
-        else:
-            sdest = jnp.full((N, Lm), N * Lm, jnp.int32)
-        send_parts = []
-        for f in flats:
-            rows = f[psrc]  # (N, Lm)
-            send_parts.append(
-                jnp.zeros(N * Lm + 1, dtype=dtype)
-                .at[sdest.reshape(-1)]
-                .set(rows.reshape(-1))[: N * Lm]
-            )
-        send = jnp.stack(send_parts, axis=-1)
-
-        out = jnp.zeros((S * Z, len(parts)), dtype=dtype)
-        in_off = cumn_t * Lm
-        send_sizes = n_t * L_me
-        out_off = n_t * zo_t[me]
-        recv_sizes = n_me * L_t
-        recv_off = n_me * zo_t
-        res = self._transport_exchange(
-            send, out, in_off, send_sizes, out_off, recv_sizes, recv_off,
-            self._b_fwd, wire, dtype, rt,
+        # pack: compact (N, Lm) stick rows out of my planes (static map),
+        # zeros beyond my plane count
+        yx_c = jnp.asarray(
+            self._yx_compact if self.N else np.zeros(1, np.int32)
         )
+        cmask_me = jnp.arange(Lm, dtype=jnp.int32)[None, :] < L_me
+        rows = [
+            jnp.where(cmask_me, jnp.take(part, yx_c, axis=0), 0)
+            for part in parts
+        ]  # (N, Lm): row i = my planes' values for compact stick i
+        wd = _wire_np_dtype(wire)
 
-        # unpack: destination-contiguous segments -> (S, Z) sticks
-        col = jnp.asarray((np.arange(Z) - self._z_base).astype(np.int32))[None, :]
-        zL = jnp.asarray(self._z_L.astype(np.int32))[None, :]
-        zbase = jnp.asarray(self._z_base.astype(np.int32))[None, :]
-        s_i = jnp.arange(S, dtype=jnp.int32)[:, None]
-        src = n_me * zbase + s_i * zL + col
-        valid = jnp.broadcast_to(s_i < n_me, (S, Z))
-        src = jnp.where(valid, src, 0).reshape(-1)
-        outs = []
-        for j in range(len(parts)):
-            sticks = jnp.where(
-                valid.reshape(-1), res[src, j], jnp.zeros((), dtype)
+        if self.transport == "ragged":
+            operand = jnp.stack(rows, axis=-1)
+            buf = operand if wd is None else operand.astype(wd)
+            out = jnp.zeros((P * S, Lm, len(parts)), dtype=buf.dtype)
+            res = jax.lax.ragged_all_to_all(
+                buf, out,
+                cumn_t.astype(jnp.int32),
+                n_t.astype(jnp.int32),
+                jnp.broadcast_to(me * S, (P,)).astype(jnp.int32),
+                jnp.broadcast_to(n_me, (P,)).astype(jnp.int32),
+                axis_name=FFT_AXIS,
             )
-            outs.append(sticks.reshape(S, Z))
+            if wd is not None:
+                res = res.astype(dtype)
+            stacks = [res[..., j].reshape(P, S, Lm) for j in range(len(parts))]
+            pitch = S
+        else:
+            stacks = [jnp.zeros((P, maxn, Lm), dtype) for _ in parts]
+            # trailing zero rows keep the window slice clamp-free when
+            # cumn[dst] + bR overruns N (a clamped start silently shifts
+            # the window)
+            rows_pad = [jnp.pad(r, ((0, maxn), (0, 0))) for r in rows]
+            for k in range(P):
+                dst = (me + k) % P
+                src = (me - k) % P
+                rmask = jnp.arange(maxn, dtype=jnp.int32)[:, None] < n_t[dst]
+                chunks = [
+                    jnp.where(
+                        rmask,
+                        jax.lax.dynamic_slice(r, (cumn_t[dst], zero), (maxn, Lm)),
+                        0,
+                    )
+                    for r in rows_pad
+                ]
+                if k:
+                    chunks = _wire_step(
+                        chunks, k, P, FFT_AXIS, wire, dtype, rt
+                    )
+                stacks = [
+                    jax.lax.dynamic_update_slice(o, c[None], (src, zero, zero))
+                    for o, c in zip(stacks, chunks)
+                ]
+            pitch = maxn
+
+        # unpack: static per-source z-window compaction -> (S, Z)
+        outs = []
+        for st in stacks:
+            pieces = [st[p_, :, : int(self._L[p_])] for p_ in range(P)]
+            full = jnp.concatenate(pieces, axis=-1)  # (pitch, Z)
+            if pitch < S:
+                full = jnp.pad(full, ((0, S - pitch), (0, 0)))
+            outs.append(full)
         return self._join_complex(outs, cdt)
 
 

@@ -123,3 +123,57 @@ def test_mxu_pencil_pipelines_have_no_element_scatters(
             "element-granular data movement in the compiled pencil pipeline "
             f"({exchange}; the round-4/5 on-chip pathology, ROADMAP 8b): {bad}"
         )
+
+
+def _lowered_1d_texts(exchange, monkeypatch):
+    import jax
+
+    if exchange == ExchangeType.UNBUFFERED:
+        monkeypatch.setenv("SPFFT_TPU_ONESHOT_TRANSPORT", "ragged")
+    rng = np.random.default_rng(78)
+    dx, dy, dz = 16, 16, 16
+    trip = random_sparse_triplets(rng, dx, dy, dz, 0.5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = distribute_triplets(trip, 4, dy)
+    vps = split_values(per_shard, trip, values)
+    t = DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        dx,
+        dy,
+        dz,
+        per_shard,
+        mesh=sp.make_fft_mesh(4),
+        exchange_type=exchange,
+        engine="mxu",
+    )
+    ex = t._exec
+    pair = ex.pad_values(vps)
+    phase = ex._phase_args()
+    texts = [ex._backward.lower(*pair, *phase).as_text()]
+    out_shapes = jax.eval_shape(
+        ex._backward_sm, *(jax.typeof(x) for x in (*pair, *phase))
+    )
+    texts.append(
+        ex._forward[ScalingType.FULL]
+        .lower(out_shapes[0], out_shapes[1], *phase)
+        .as_text()
+    )
+    return texts
+
+
+@pytest.mark.parametrize(
+    "exchange", [ExchangeType.COMPACT_BUFFERED, ExchangeType.UNBUFFERED]
+)
+def test_mxu_1d_ragged_pipelines_have_no_element_scatters(exchange, monkeypatch):
+    """The 1-D slab engines' ragged exchange paths (RaggedExchange chain /
+    OneShotExchange) must stay row-granular too — the same pathology class
+    fixed for the pencil exchanges this round (pod-relevant: single-chip
+    P=1 plans specialize the exchange away, so only this lowering check sees
+    it off-pod)."""
+    for hlo in _lowered_1d_texts(exchange, monkeypatch):
+        bad = _element_granular_ops(hlo)
+        assert not bad, (
+            "element-granular data movement in the compiled 1-D ragged "
+            f"pipeline ({exchange}): {bad}"
+        )

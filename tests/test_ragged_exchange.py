@@ -197,8 +197,12 @@ def test_ragged_matches_padded_fuzz(seed):
 
 
 def test_exchange_wire_bytes_accounting():
-    """Balanced plans: chain volume == padded off-shard volume. Imbalanced:
-    strictly less (that is the point of the exact-counts discipline)."""
+    """Chain volume accounting under the round-5 row-granular transport: the
+    per-step 2-D windows are (max rows x max cols) over ALL shard pairs of
+    the step, and for P >= 2 every step faces some max-plane shard, so the
+    chain volume TIES the padded one (its remaining role is the portable
+    exact-rows transport; UNBUFFERED carries the byte savings — see
+    test_oneshot_wire_bytes_are_exact_alltoallv_volume)."""
     rng = np.random.default_rng(6)
     dims = (8, 8, 8)
     dx, dy, dz = dims
@@ -214,10 +218,9 @@ def test_exchange_wire_bytes_accounting():
     t_rag = build("xla", 4, dims, [p.copy() for p in per_shard], ExchangeType.COMPACT_BUFFERED)
     assert t_rag.exchange_wire_bytes() == t_pad.exchange_wire_bytes()
 
-    # imbalanced in BOTH sticks and planes: the chain's step maxima
-    # sum_k max_i(n_i * L_{(i+k)%P}) drop below the padded (P-1) * S_max * L_max
-    # whenever the heavy-stick shard doesn't always face the heavy-plane shard.
-    # (With uniform planes the two volumes tie — every step max is S_max * L.)
+    # imbalanced sticks AND planes: the row-granular chain still ships
+    # (max rows x max cols) windows, which tie the padded volume (every
+    # step has a shard pair hitting both maxima)
     triplets = random_sparse_triplets(rng, dx, dy, dz, 0.4)
     skew = [triplets] + [np.zeros((0, 3), dtype=np.int64)] * 3
     lz = [1, 1, 1, dz - 3]
@@ -229,7 +232,7 @@ def test_exchange_wire_bytes_accounting():
         "xla", 4, dims, [p.copy() for p in skew], ExchangeType.COMPACT_BUFFERED,
         local_z_lengths=lz,
     )
-    assert t_rag.exchange_wire_bytes() < t_pad.exchange_wire_bytes()
+    assert t_rag.exchange_wire_bytes() == t_pad.exchange_wire_bytes()
 
     # wire-dtype variants scale the byte count, not the element count
     t_bf16 = build(
