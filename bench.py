@@ -172,6 +172,13 @@ def main():
         np.fft.fftn(np.fft.ifftn(dense))
         dense_time = min(dense_time, time.perf_counter() - t0)
 
+    # decision provenance: the plan card rides in every BENCH_*.json so a
+    # perf diff across rounds always shows WHAT the plan chose (spfft_tpu.obs)
+    try:
+        plan_card = sp.obs.plan_card(t)
+    except Exception as e:  # a card bug must never cost a bench capture
+        plan_card = {"error": str(e).split("\n")[0]}
+
     print(
         json.dumps(
             {
@@ -179,6 +186,7 @@ def main():
                 "value": round(gflops, 2),
                 "unit": "GFLOP/s",
                 "vs_baseline": round(dense_time / best, 3),
+                "plan": plan_card,
             }
         )
     )

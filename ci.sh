@@ -8,8 +8,9 @@
 # multichip dryrun, and the native C/C++ build + API roundtrip.
 #
 # Usage:   ./ci.sh            # everything
-#          ./ci.sh lint       # import hygiene + env-knob docs consistency
+#          ./ci.sh lint       # import hygiene + env-knob docs + stage scopes
 #          ./ci.sh python     # Python suite only
+#          ./ci.sh report     # plan-card CLI + JSON schema validation only
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -28,6 +29,25 @@ run_lint() {
 run_python() {
   echo "== Python test suite (virtual 8-device CPU mesh) =="
   python -m pytest tests/ -q
+}
+
+run_report() {
+  echo "== Plan-card report (programs/report.py, CPU backend) =="
+  # Build a 32^3 plan on CPU, emit the plan card + metrics snapshot, and
+  # validate the JSON against the obs schema — missing keys fail (plan-card
+  # drift is caught here without TPU hardware).
+  JAX_PLATFORMS=cpu timeout 540 python programs/report.py -d 32 32 32 \
+    -o /tmp/spfft_ci_report.json > /dev/null
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from spfft_tpu import obs
+
+doc = json.loads(open("/tmp/spfft_ci_report.json").read())
+missing = obs.validate_report(doc)
+assert not missing, f"report schema incomplete: {missing}"
+print(f"report schema ok ({len(doc['plan'])} plan keys, "
+      f"{len(doc['metrics']['counters'])} counters)")
+EOF
 }
 
 run_dryrun() {
@@ -52,17 +72,19 @@ run_native() {
 case "$stage" in
   lint) run_lint ;;
   python) run_python ;;
+  report) run_report ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
     run_lint
     run_python
+    run_report
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -331,6 +331,8 @@ def main(argv=None):
             "wall_s_total": elapsed,
             "wall_s_per_transform_pair": pair_seconds,
             "gflops_per_pair": flops / pair_seconds / 1e9,
+            # decision provenance: what this plan chose (spfft_tpu.obs)
+            "plan": transforms[0].report(),
         }
         if args.shards > 1:
             # off-shard interconnect bytes per repartition under this discipline

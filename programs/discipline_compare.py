@@ -47,7 +47,11 @@ def main(argv=None):
     max_p = max(args.shards)
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max_p)
+        # version-portable device-count knob (jax_num_cpu_devices or the
+        # older XLA flag — parallel/mesh.configure_virtual_devices)
+        from spfft_tpu.parallel.mesh import configure_virtual_devices
+
+        configure_virtual_devices(max_p, warn=True)
     except Exception as e:
         print(f"late platform config ({e}); using visible devices", file=sys.stderr)
 

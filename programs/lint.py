@@ -1,4 +1,4 @@
-"""Project lint: import hygiene + env-knob/docs consistency.
+"""Project lint: import hygiene + env-knob/docs + stage-scope consistency.
 
 No third-party linter exists in this environment, so the checks the advisor
 kept flagging are enforced here with the stdlib ast module:
@@ -9,7 +9,11 @@ kept flagging are enforced here with the stdlib ast module:
    (``# noqa: F401`` on the import line exempts re-exports),
 3. env-knob consistency — every ``SPFFT_TPU_*`` knob read by the package
    must be documented in docs/details.md, and every documented knob must
-   still exist in code (dead-doc detection).
+   still exist in code (dead-doc detection),
+4. stage-scope consistency — every ``jax.named_scope`` label in an engine
+   pipeline comes from the canonical ``spfft_tpu.obs.STAGES`` list, and every
+   listed stage appears in at least one engine (same both-ways style as the
+   env-knob rule; keeps profiler traces attributable against one vocabulary).
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -171,6 +175,66 @@ def check_env_knobs(findings: list):
         )
 
 
+# The engine pipeline modules: every named_scope label inside them must come
+# from obs.STAGES, and every STAGES entry must appear in at least one of them.
+ENGINE_FILES = (
+    "spfft_tpu/execution.py",
+    "spfft_tpu/execution_mxu.py",
+    "spfft_tpu/parallel/execution.py",
+    "spfft_tpu/parallel/execution_mxu.py",
+    "spfft_tpu/parallel/pencil2.py",
+    "spfft_tpu/parallel/pencil2_mxu.py",
+)
+STAGES_FILE = "spfft_tpu/obs/stages.py"
+
+
+def _canonical_stages() -> tuple:
+    """STAGES from obs/stages.py via ast (import-free: lint must not pull jax)."""
+    tree = ast.parse((ROOT / STAGES_FILE).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "STAGES" for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise AssertionError(f"no STAGES assignment in {STAGES_FILE}")
+
+
+def check_stage_scopes(findings: list):
+    stages = _canonical_stages()
+    if len(set(stages)) != len(stages):
+        findings.append(f"{STAGES_FILE}: duplicate entries in STAGES")
+    used: dict = {}  # literal named_scope labels -> first file:line
+    strings: set = set()  # every string constant in engine files (covers
+    # labels selected dynamically, e.g. _y_stage_scope's variants)
+    for rel in ENGINE_FILES:
+        path = ROOT / rel
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                strings.add(node.value)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "named_scope"
+            ):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant):
+                label = node.args[0].value
+                used.setdefault(label, f"{rel}:{node.args[0].lineno}")
+    for label, where in sorted(used.items()):
+        if label not in stages:
+            findings.append(
+                f"{where}: named_scope {label!r} is not in the canonical "
+                f"stage list ({STAGES_FILE})"
+            )
+    for stage in stages:
+        if stage not in strings:
+            findings.append(
+                f"{STAGES_FILE}: stage {stage!r} appears in no engine "
+                f"pipeline ({', '.join(ENGINE_FILES)})"
+            )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -178,6 +242,7 @@ def main() -> int:
             continue
         check_imports(path, findings)
     check_env_knobs(findings)
+    check_stage_scopes(findings)
     for f in findings:
         print(f)
     if findings:

@@ -24,7 +24,10 @@ nprocs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # jax < 0.4.38: 1 CPU device is already the default
+    pass
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np

@@ -3,7 +3,9 @@
 The TPU-side analogue of the reference's rt_graph timing tree (reference:
 src/timing/rt_graph.hpp, stages tagged in src/execution/execution_host.cpp:
 249-293): every engine wraps its stages in ``jax.named_scope`` using the
-reference's stage names ("compression", "z transform", "exchange", ...), so a
+canonical ``spfft_tpu.obs.STAGES`` labels (the reference's stage names plus
+the disambiguated sparse/blocked y-variants and the pencil engine's A/B
+exchange tags — ``programs/lint.py`` enforces the list both ways), so a
 captured trace reads like the reference's timing output, but with XLA fusion
 boundaries and DMA activity visible.
 
@@ -86,6 +88,8 @@ def main(argv=None):
             print(f"trace written to {args.o}")
             print(f"  view: tensorboard --logdir {args.o}  (Profile tab)")
             print(f"  or open {args.o}/plugins/profile/*/…trace.json.gz in Perfetto")
+            # the canonical scope vocabulary to search for in the trace
+            print(f"  stage scopes (spfft_tpu.obs.STAGES): {', '.join(sp.obs.STAGES)}")
 
     print()
     print(timing.process())

@@ -1,0 +1,125 @@
+"""Build a plan, print its plan card + a run-metrics snapshot, write JSON.
+
+The observability CLI (spfft_tpu.obs): the card records every plan-time
+decision — geometry, sparsity, engine choices, and for distributed plans the
+exchange discipline's wire bytes / rounds / transport plus the cost-model
+table of the alternatives the DEFAULT policy weighed — and the snapshot
+records what one roundtrip actually did (transforms executed, bytes staged,
+dispatch/wait latencies). The emitted JSON is schema-validated
+(obs.validate_report) before it is written; a missing key exits nonzero, so
+ci.sh catches plan-card drift without TPU hardware.
+
+Usage:
+    python programs/report.py -d 32 32 32                       # local plan
+    python programs/report.py -d 64 64 64 --shards 4 --engine mxu
+    python programs/report.py -d 64 64 64 --pencil 2 2 -o card.json
+    python programs/report.py -d 32 32 32 --no-compiled         # skip compile
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def build_plan(args):
+    import spfft_tpu as sp
+    from spfft_tpu import ExchangeType, ProcessingUnit, TransformType
+
+    dx, dy, dz = args.d
+    radius = sp.spherical_radius_for_fraction(args.s)
+    trip = sp.create_spherical_cutoff_triplets(
+        dx, dy, dz, min(radius, 1.0), hermitian_symmetry=args.r2c
+    )
+    ttype = TransformType.R2C if args.r2c else TransformType.C2C
+    if args.pencil:
+        from spfft_tpu.parallel import make_fft_mesh2
+
+        mesh = make_fft_mesh2(*args.pencil)
+        return sp.DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz, trip, mesh=mesh,
+            engine=args.engine, exchange_type=ExchangeType[args.exchange],
+        )
+    if args.shards > 1:
+        from spfft_tpu.parallel import make_fft_mesh
+
+        mesh = make_fft_mesh(args.shards)
+        return sp.DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz, trip, mesh=mesh,
+            engine=args.engine, exchange_type=ExchangeType[args.exchange],
+        )
+    return sp.Transform(
+        ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip,
+        engine=args.engine,
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-d", nargs=3, type=int, default=[32, 32, 32],
+                    metavar=("X", "Y", "Z"))
+    ap.add_argument("-s", type=float, default=0.15, help="nonzero fraction")
+    ap.add_argument("--r2c", action="store_true", help="R2C instead of C2C")
+    ap.add_argument("--engine", default="auto", choices=["auto", "xla", "mxu"])
+    ap.add_argument("--shards", type=int, default=1,
+                    help="1-D slab mesh width (1 = local plan)")
+    ap.add_argument("--pencil", nargs=2, type=int, metavar=("P1", "P2"),
+                    help="2-D pencil mesh (overrides --shards)")
+    ap.add_argument("--exchange", default="DEFAULT",
+                    help="exchange discipline name (distributed plans)")
+    ap.add_argument("--no-compiled", action="store_true",
+                    help="skip compiled-program stats (compile can dominate)")
+    ap.add_argument("--no-roundtrip", action="store_true",
+                    help="emit the card without executing a transform pair")
+    ap.add_argument("-o", default=None, help="write the report JSON here")
+    args = ap.parse_args(argv)
+
+    # mesh-width CPU devices must exist before the first backend touch
+    shards = args.pencil[0] * args.pencil[1] if args.pencil else args.shards
+    if shards > 1:
+        from spfft_tpu.parallel.mesh import ensure_virtual_devices
+
+        ensure_virtual_devices(shards, warn=True, platform="cpu")
+
+    from spfft_tpu import ScalingType, obs
+
+    plan = build_plan(args)
+    card = plan.report(include_compiled=not args.no_compiled)
+
+    if not args.no_roundtrip:
+        # one roundtrip so the snapshot carries real run counters
+        rng = np.random.default_rng(0)
+        if args.shards > 1 or args.pencil:
+            values = [
+                rng.standard_normal(plan.num_local_elements(r))
+                + 1j * rng.standard_normal(plan.num_local_elements(r))
+                for r in range(plan.num_shards)
+            ]
+        else:
+            n = plan.num_local_elements
+            values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+        plan.backward(values)
+        plan.forward(scaling=ScalingType.FULL)
+
+    report = {"plan": card, "metrics": obs.snapshot()}
+    missing = obs.validate_report(report)
+
+    print(json.dumps(card, indent=2))
+    print()
+    print(obs.prometheus_text(report["metrics"]))
+    if args.o:
+        Path(args.o).write_text(json.dumps(report, indent=2) + "\n")
+        print(f"report written to {args.o}")
+    if missing:
+        print(f"report schema INCOMPLETE, missing: {missing}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

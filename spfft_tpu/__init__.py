@@ -31,6 +31,7 @@ from .errors import (  # noqa: F401
     MPISupportError,
     OverflowError_,
 )
+from . import obs  # noqa: F401
 from . import timing  # noqa: F401
 from .distributed import DistributedTransform  # noqa: F401
 from .grid import Grid  # noqa: F401

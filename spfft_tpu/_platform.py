@@ -60,7 +60,9 @@ def cpu_devices(n: int | None = None):
     global _private_cpu_client
     import jax._src.xla_bridge as xb
 
-    num_cfg = int(jax.config.jax_num_cpu_devices or 1)
+    # jax < 0.4.38 has no jax_num_cpu_devices option; the XLA flag (read by
+    # the CPU client factory at creation) is the only knob there.
+    num_cfg = int(getattr(jax.config, "jax_num_cpu_devices", None) or 1)
     if _private_cpu_client is None or _private_cpu_client[0] != num_cfg:
         if global_init_is_safe():
             try:

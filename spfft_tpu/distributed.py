@@ -12,7 +12,7 @@ import numpy as np
 
 import jax
 
-from . import timing
+from . import obs, timing
 from .errors import InvalidParameterError
 from .sync import fence
 from .grid import Grid
@@ -181,6 +181,9 @@ class DistributedTransform:
             )
             self._engine = engine
         self._space_data = None
+        # Plan-constant; cached lazily so the metrics-off path never pays the
+        # per-step numpy accounting in exchange_wire_bytes().
+        self._wire_bytes_cache = None
 
     # ---- transforms -----------------------------------------------------------
 
@@ -190,20 +193,37 @@ class DistributedTransform:
         ``values``: list of per-shard complex arrays (lengths must match
         ``num_local_elements_per_shard``).
         """
+        obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
         with timing.scoped("backward"):
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"):
+                with timing.scoped("wait"), obs.phase_timer(
+                    "wait_seconds", direction="backward"
+                ):
                     fence(out)
             with timing.scoped("output staging"):
                 return self._finalize_backward(out)
+
+    def _record_wire_bytes(self):
+        """Count the exchange's per-dispatch wire bytes (plan-constant) into
+        the run registry; a no-op when metrics are disabled."""
+        if not obs.is_enabled():
+            return
+        if self._wire_bytes_cache is None:
+            self._wire_bytes_cache = self.exchange_wire_bytes()
+        obs.counter("exchange_wire_bytes_total", engine=self._engine).inc(
+            self._wire_bytes_cache
+        )
 
     def _dispatch_backward(self, values):
         """Stage per-shard inputs and enqueue the backward pipeline without
         waiting (split-phase hook used by multi-transform pipelining)."""
         with timing.scoped("input staging"):
             pair = self._exec.pad_values(values)
-        with timing.scoped("dispatch"):
+        self._record_wire_bytes()
+        with timing.scoped("dispatch"), obs.phase_timer(
+            "dispatch_seconds", direction="backward"
+        ):
             out = self._exec.backward_pair(*pair)
         self._space_data = out
         return out
@@ -221,10 +241,13 @@ class DistributedTransform:
         input_location: ProcessingUnit | None = None,
     ):
         """Space -> per-shard packed freq values (list of complex arrays)."""
+        obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
         with timing.scoped("forward"):
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"):
+                with timing.scoped("wait"), obs.phase_timer(
+                    "wait_seconds", direction="forward"
+                ):
                     fence(pair)
             with timing.scoped("output staging"):
                 return self._finalize_forward(pair)
@@ -245,7 +268,10 @@ class DistributedTransform:
             with timing.scoped("input staging"):
                 re, im = self._exec.pad_space(np.asarray(space))
                 self._space_data = re if self._exec.is_r2c else (re, im)
-        with timing.scoped("dispatch"):
+        self._record_wire_bytes()
+        with timing.scoped("dispatch"), obs.phase_timer(
+            "dispatch_seconds", direction="forward"
+        ):
             return self._exec.forward_pair(re, im, ScalingType(scaling))
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
@@ -332,6 +358,19 @@ class DistributedTransform:
         return (
             np.asarray(re[shard])[:l, :ly] + 1j * np.asarray(im[shard])[:l, :ly]
         )
+
+    # ---- introspection --------------------------------------------------------
+
+    def report(self, *, include_compiled: bool = False) -> dict:
+        """Plan card: the machine-readable record of this plan's decisions —
+        grid geometry, sparsity, engine, decomposition, and the exchange
+        discipline's wire bytes / rounds / transport PLUS the cost-model table
+        of the alternatives the DEFAULT policy weighed (chosen and rejected,
+        ``parallel/policy.py`` accounting). ``include_compiled=True``
+        additionally compiles the backward pipeline and adds compile wall
+        time, memory analysis and HLO op-class counts. See
+        :mod:`spfft_tpu.obs`."""
+        return obs.plan_card(self, include_compiled=include_compiled)
 
     # ---- accessors ------------------------------------------------------------
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import obs
 from .ops import compression, symmetry
 from .parameters import LocalParameters
 from .types import ScalingType, TransformType
@@ -109,6 +110,9 @@ class ExecutionBase:
         if isinstance(array, jax.Array):
             return jax.device_put(array, self.device)
         array = np.asarray(array)
+        obs.counter("staged_bytes_total", direction="host_to_device").inc(
+            array.nbytes
+        )
         rows = self._stage_rows(array.nbytes, array.shape[0] if array.ndim else 1)
         if rows is None:
             return jax.device_put(array, self.device)
@@ -120,6 +124,9 @@ class ExecutionBase:
 
     def fetch(self, arr):
         """Device -> host fetch, chunked above the same threshold as put()."""
+        obs.counter("staged_bytes_total", direction="device_to_host").inc(
+            arr.size * arr.dtype.itemsize
+        )
         rows = self._stage_rows(
             arr.size * arr.dtype.itemsize, arr.shape[0] if arr.ndim else 1
         )
@@ -187,56 +194,86 @@ class LocalExecution(ExecutionBase):
             for s in (ScalingType.NONE, ScalingType.FULL)
         }
 
+    # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
+
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard): this engine makes
+        no measured decisions — jnp.fft (pocketfft on CPU) plus scatter/gather
+        pack/unpack, chosen where that is the fast path."""
+        return {"pipeline": "jnp.fft + scatter/gather"}
+
+    def lowered_backward(self):
+        """Lower (without compiling) the backward pipeline — the obs layer's
+        hook for compiled-program stats (obs.hlo.compiled_stats)."""
+        v = jax.ShapeDtypeStruct((self.params.num_values,), self.real_dtype)
+        return self._backward.lower(v, v)
+
     # ---- pipelines (traced; complex internal, real pairs at the boundary) -----
 
     def _backward_impl(self, values_re, values_im):
         p = self.params
-        values = jax.lax.complex(
-            values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
-        )
-
-        sticks = compression.decompress(values, self._value_indices, p.num_sticks, p.dim_z)
+        # stage scopes: canonical obs.STAGES labels (profiler attribution)
+        with jax.named_scope("compression"):
+            values = jax.lax.complex(
+                values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
+            )
+            sticks = compression.decompress(
+                values, self._value_indices, p.num_sticks, p.dim_z
+            )
         if self.is_r2c:
-            sticks = symmetry.apply_stick_symmetry(sticks, self._zero_stick_id)
-        sticks = jnp.fft.ifft(sticks, axis=1)
+            with jax.named_scope("stick symmetry"):
+                sticks = symmetry.apply_stick_symmetry(sticks, self._zero_stick_id)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.ifft(sticks, axis=1)
 
         # Stick -> plane relayout: scatter each z-stick into its (y, x) column of the
         # dense slab (the local transpose, reference: src/transpose/transpose_host.hpp:50-161).
-        grid = jnp.zeros((p.dim_z, p.dim_y, p.dim_x_freq), dtype=self.complex_dtype)
-        grid = grid.at[:, self._stick_y, self._stick_x].set(
-            sticks.T, mode="drop", unique_indices=True
-        )
+        with jax.named_scope("expand"):
+            grid = jnp.zeros((p.dim_z, p.dim_y, p.dim_x_freq), dtype=self.complex_dtype)
+            grid = grid.at[:, self._stick_y, self._stick_x].set(
+                sticks.T, mode="drop", unique_indices=True
+            )
 
         if self.is_r2c:
-            grid = symmetry.apply_plane_symmetry(grid)
-        grid = jnp.fft.ifft(grid, axis=1)
+            with jax.named_scope("plane symmetry"):
+                grid = symmetry.apply_plane_symmetry(grid)
+        with jax.named_scope("y transform"):
+            grid = jnp.fft.ifft(grid, axis=1)
         # Undo ifft's 1/N normalization: the backward transform is unnormalized
         # (reference: docs/source/details.rst:42-44).
         total = np.asarray(p.total_size, dtype=self.real_dtype)
-        if self.is_r2c:
-            out = jnp.fft.irfft(grid, n=p.dim_x, axis=2).astype(self.real_dtype)
-            return out * total
-        out = jnp.fft.ifft(grid, axis=2) * total
-        return out.real, out.imag
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                out = jnp.fft.irfft(grid, n=p.dim_x, axis=2).astype(self.real_dtype)
+                return out * total
+            out = jnp.fft.ifft(grid, axis=2) * total
+            return out.real, out.imag
 
     def _forward_impl(self, space_re, space_im, scale):
         p = self.params
-        if self.is_r2c:
-            grid = jnp.fft.rfft(space_re.astype(self.real_dtype), n=p.dim_x, axis=2)
-            grid = grid.astype(self.complex_dtype)
-        else:
-            space = jax.lax.complex(
-                space_re.astype(self.real_dtype), space_im.astype(self.real_dtype)
-            )
-            grid = jnp.fft.fft(space, axis=2)
-        grid = jnp.fft.fft(grid, axis=1)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                grid = jnp.fft.rfft(space_re.astype(self.real_dtype), n=p.dim_x, axis=2)
+                grid = grid.astype(self.complex_dtype)
+            else:
+                space = jax.lax.complex(
+                    space_re.astype(self.real_dtype), space_im.astype(self.real_dtype)
+                )
+                grid = jnp.fft.fft(space, axis=2)
+        with jax.named_scope("y transform"):
+            grid = jnp.fft.fft(grid, axis=1)
 
         # Plane -> stick gather (forward local transpose).
-        sticks = grid[:, self._stick_y, self._stick_x].T
+        with jax.named_scope("pack"):
+            sticks = grid[:, self._stick_y, self._stick_x].T
 
-        sticks = jnp.fft.fft(sticks, axis=1)
-        values = compression.compress(sticks, self._value_indices, scale)
-        return values.real.astype(self.real_dtype), values.imag.astype(self.real_dtype)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.fft(sticks, axis=1)
+        with jax.named_scope("compression"):
+            values = compression.compress(sticks, self._value_indices, scale)
+            return values.real.astype(self.real_dtype), values.imag.astype(
+                self.real_dtype
+            )
 
     # ---- device-side entry points (pair-form, no host transfers) --------------
 

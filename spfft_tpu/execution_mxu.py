@@ -226,6 +226,40 @@ class MxuLocalExecution(ExecutionBase):
         # when the alias can actually engage.
         self._backward_consume = jax.jit(self._backward_impl, donate_argnums=(0, 1))
 
+    # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
+
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard): the MXU engine's
+        measured decisions — active-x compaction, the engaged sparse-y variant
+        with its thresholds, alignment rotations, copy-plan engagement, and
+        f64 stage chunking."""
+        p = self.params
+        sparse_y = offt.describe_sparse_y(
+            self._sparse_y,
+            self._sparse_y_blocked,
+            self._sy if self._sparse_y else 0,
+        )
+        return {
+            "pipeline": "matmul DFT stages + lane-copy value plans",
+            "matmul_precision": str(self._precision).rsplit(".", 1)[-1],
+            "num_x_active": int(self._num_x_active),
+            "dim_x_freq": int(p.dim_x_freq),
+            "sparse_y": sparse_y,
+            "alignment_rotations": self._phase is not None,
+            "copy_plans": {
+                "decompress": self._decompress_plan is not None,
+                "compress": self._compress_plan is not None,
+            },
+            "x_stage_chunks": int(self._x_stage_chunks),
+        }
+
+    def lowered_backward(self):
+        """Lower (without compiling) the backward pipeline — the obs layer's
+        hook for compiled-program stats (obs.hlo.compiled_stats). Threaded
+        plan operands ride as their concrete device arrays."""
+        v = jax.ShapeDtypeStruct((self.params.num_values,), self.real_dtype)
+        return self._backward.lower(v, v, *self.phase_operands)
+
     # ---- stages ---------------------------------------------------------------
 
     def _decompress(self, values_re, values_im):
@@ -371,14 +405,14 @@ class MxuLocalExecution(ExecutionBase):
         if self._sparse_y:
             # per-slot y contraction straight off the stick table: no expand,
             # y-DFT rows gathered per slot into the matrix constants
-            with jax.named_scope("y transform"):
+            with jax.named_scope("y transform sparse"):
                 A, Sy, Z = self._num_x_active, self._sy, p.dim_z
                 gre, gim = offt.complex_matmul(
                     sre.reshape(A, Sy, Z), sim.reshape(A, Sy, Z),
                     *self._wy_b_sp, "ajz,ajk->kaz", prec,
                 )
         elif self._sparse_y_blocked is not None:
-            with jax.named_scope("y transform"):
+            with jax.named_scope("y transform blocked"):
                 gre, gim = self._blocked_y_backward(sre, sim, mat_ops)
         else:
             with jax.named_scope("expand"):
@@ -430,7 +464,7 @@ class MxuLocalExecution(ExecutionBase):
         if self._sparse_y:
             # per-slot y contraction straight into the stick table: the pack
             # gather disappears (output rows ARE the table rows)
-            with jax.named_scope("y transform"):
+            with jax.named_scope("y transform sparse"):
                 sre, sim = offt.complex_matmul(
                     gre, gim, *self._wy_f_sp, "yaz,ajy->ajz", prec
                 )
@@ -440,7 +474,7 @@ class MxuLocalExecution(ExecutionBase):
         elif self._sparse_y_blocked is not None:
             # blocked sparse-y: per-bucket contractions into bucket flats, one
             # regather to exact stick rows (replacing the pack gather)
-            with jax.named_scope("y transform"):
+            with jax.named_scope("y transform blocked"):
                 Z = p.dim_z
                 flats_re, flats_im = [], []
                 col = 0

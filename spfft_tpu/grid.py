@@ -153,6 +153,30 @@ class Grid:
 
         return fft_mesh_size(self._mesh)
 
+    def report(self) -> dict:
+        """Grid card: the capacity envelope and bindings transforms created
+        from this grid inherit (the grid-level slice of the plan cards
+        :meth:`Transform.report` returns — see :mod:`spfft_tpu.obs`)."""
+        card = {
+            "kind": "grid",
+            "max_dims": [self._max_dim_x, self._max_dim_y, self._max_dim_z],
+            "max_num_local_z_columns": self._max_num_local_z_columns,
+            "max_local_z_length": self._max_local_z_length,
+            "processing_unit": self._processing_unit.name,
+            "num_shards": self.num_shards,
+            "exchange_type": self._exchange_type.name,
+        }
+        if self._mesh is None:
+            card["device"] = str(self._device)
+        else:
+            card["mesh"] = {
+                str(name): int(size)
+                for name, size in zip(
+                    self._mesh.axis_names, self._mesh.devices.shape
+                )
+            }
+        return card
+
     def create_transform(
         self,
         processing_unit,

@@ -1,0 +1,73 @@
+"""spfft_tpu.obs — unified metrics and plan introspection.
+
+Three observability layers, coarse to fine (docs/details.md "Observability"):
+
+1. **Host timing tree** (:mod:`spfft_tpu.timing`): rt_graph-parity nested wall
+   -clock statistics of the host-visible phases (init, staging, dispatch,
+   wait).
+2. **This module**: *plan cards* — ``plan.report()`` /
+   :func:`plan_card`, the machine-readable record of every plan-time decision
+   (exchange discipline chosen AND the cost-model table of rejected
+   alternatives, sparse-y engagement, compiled-program stats) — and *run
+   metrics* — a process-global counter/gauge/histogram registry
+   (:func:`counter`/:func:`gauge`/:func:`histogram`) recording what the
+   host-facing paths did, exported via :func:`snapshot` (JSON) and
+   :func:`prometheus_text`. ``SPFFT_TPU_METRICS=0`` turns the registry into
+   shared no-ops.
+3. **Device traces** (``jax.profiler`` via ``programs/profile.py``): per-stage
+   attribution inside the compiled programs, tagged with the canonical
+   :data:`STAGES` scope names every engine uses (``programs/lint.py`` enforces
+   the list both ways).
+"""
+from .registry import (  # noqa: F401
+    HISTOGRAM_BUCKETS,
+    METRICS_ENV,
+    SNAPSHOT_SCHEMA,
+    clear,
+    counter,
+    disable,
+    enable,
+    gauge,
+    histogram,
+    is_enabled,
+    phase_timer,
+    prometheus_text,
+    snapshot,
+    validate_snapshot,
+)
+from .stages import STAGES  # noqa: F401
+
+# Heavier pieces (plan cards pull in engine/parallel modules, hlo pulls
+# compile machinery) resolve lazily so importing the package — which the
+# engines themselves do for the registry — cannot cycle.
+
+
+def plan_card(transform, *, include_compiled: bool = False) -> dict:
+    """Structured record of a plan's decisions (see obs.plancard)."""
+    from .plancard import plan_card as _plan_card
+
+    return _plan_card(transform, include_compiled=include_compiled)
+
+
+def validate_plan_card(card: dict) -> list:
+    """Missing-key paths of a plan card ([] when schema-complete)."""
+    from .plancard import validate_plan_card as _validate
+
+    return _validate(card)
+
+
+def validate_report(report: dict) -> list:
+    """Validate a ``programs/report.py`` JSON document: a ``plan`` card plus
+    a ``metrics`` snapshot. Returns the combined missing-key paths."""
+    missing = []
+    if "plan" not in report:
+        missing.append("plan")
+    else:
+        missing.extend(f"plan.{m}" for m in validate_plan_card(report["plan"]))
+    if "metrics" not in report:
+        missing.append("metrics")
+    else:
+        missing.extend(
+            f"metrics.{m}" for m in validate_snapshot(report["metrics"])
+        )
+    return missing

@@ -1,0 +1,107 @@
+"""Compiled-program introspection: StableHLO op-class counts, the
+element-granular gather/scatter detector, and compiled-executable stats.
+
+The detector is the library home of the guard first written in
+``tests/test_pencil2_rowgranular.py`` (the round-4/5 on-chip finding: element
+scatters cost ~20 ns/element through XLA:TPU's serialized scatter, turning a
+1x1-mesh pencil plan ~230x slower than the local engine while every CPU oracle
+test stayed green). Promoted here so plan cards carry the same signal the
+regression tests assert on — a plan whose card reports
+``element_granular_ops > 0`` has reintroduced the pathology.
+"""
+from __future__ import annotations
+
+import re
+import time
+
+# Metadata lookups (branch tables, shard geometry) legitimately gather single
+# elements out of tiny operands; data arrays are far larger.
+METADATA_ELEMS = 4096
+
+
+def operand_elems(shape_str: str) -> int:
+    """Element count of a StableHLO tensor type like ``'16385xf32'``."""
+    dims = re.findall(r"(\d+)x", shape_str)
+    n = 1
+    for d in dims:
+        n *= int(d)
+    return n
+
+
+def element_granular_ops(hlo: str, metadata_elems: int = METADATA_ELEMS):
+    """``(op, operand, detail)`` rows for every gather/scatter in ``hlo``
+    (StableHLO text) that moves single elements out of/into an operand larger
+    than ``metadata_elems`` elements."""
+    bad = []
+    # gathers: slice_sizes all-1 means one element per index row
+    for m in re.finditer(
+        r'"stablehlo\.gather"[^\n]*?slice_sizes\s*=\s*array<i64([^>]*)>'
+        r"[^\n]*?:\s*\(tensor<([^>]+)>",
+        hlo,
+    ):
+        sizes = [int(x) for x in re.findall(r"-?\d+", m.group(1))]
+        if sizes and all(s == 1 for s in sizes):
+            if operand_elems(m.group(2)) > metadata_elems:
+                bad.append(("gather", m.group(2), sizes))
+    # scatters: no update_window_dims (StableHLO omits the attribute when
+    # empty) means element updates
+    for m in re.finditer(
+        r'"stablehlo\.scatter"\(.*?\}\)\s*:\s*\(tensor<([^>]+)>', hlo, re.DOTALL
+    ):
+        mw = re.search(r"update_window_dims = \[([^\]]*)\]", m.group(0))
+        window = re.findall(r"\d+", mw.group(1)) if mw else []
+        if not window and operand_elems(m.group(1)) > metadata_elems:
+            bad.append(("scatter", m.group(1), []))
+    return bad
+
+
+_OP_RE = re.compile(r"\bstablehlo\.([a-z_0-9]+)")
+
+
+def hlo_op_class_counts(hlo: str) -> dict:
+    """``{op_class: count}`` over a StableHLO module text — the coarse
+    "what does this program spend its ops on" summary plan cards embed
+    (dot_general vs gather vs collective counts is the shape of most TPU
+    perf diffs here)."""
+    counts: dict = {}
+    for m in _OP_RE.finditer(hlo):
+        op = m.group(1)
+        counts[op] = counts.get(op, 0) + 1
+    return dict(sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
+def compiled_stats(lowered) -> dict:
+    """Compile a ``jax.stages.Lowered`` and report program statistics.
+
+    Returns ``compile_seconds`` (wall clock of ``.compile()``),
+    ``hlo_op_classes`` and ``element_granular_ops`` from the lowered StableHLO
+    text, and whatever ``compiled.memory_analysis()`` exposes on this backend
+    (peak/argument/output/temp/code bytes; every field is best-effort — some
+    runtimes return nothing).
+    """
+    hlo = lowered.as_text()
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    stats = {
+        "compile_seconds": time.perf_counter() - t0,
+        "hlo_op_classes": hlo_op_class_counts(hlo),
+        "element_granular_ops": len(element_granular_ops(hlo)),
+    }
+    mem = {}
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:
+        analysis = None
+    for field in (
+        "temp_size_in_bytes",
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+        "peak_memory_in_bytes",
+    ):
+        value = getattr(analysis, field, None)
+        if value is not None:
+            mem[field] = int(value)
+    stats["memory_analysis"] = mem
+    return stats

@@ -1,0 +1,234 @@
+"""Plan cards: one structured, JSON-stable record of what a plan chose and why.
+
+Every ``Transform`` / ``DistributedTransform`` exposes ``plan.report()`` ->
+this card: grid geometry and sparsity, engine and precision, the engine's
+measured decisions (active-x compaction, sparse-y variant and its thresholds,
+alignment rotations), and — for distributed plans — the exchange discipline's
+actual wire bytes / rounds / transport PLUS the cost-model table of every
+alternative the ``ExchangeType.DEFAULT`` policy would weigh (chosen and
+rejected, from ``parallel/policy.py`` — the same single-sourced accounting the
+resolver reads, so card and resolver cannot diverge). With
+``include_compiled=True`` the backward pipeline is lowered and compiled and
+the card adds compile wall time, ``memory_analysis()`` bytes, StableHLO
+op-class counts and the element-granular scatter count
+(:mod:`spfft_tpu.obs.hlo`).
+
+Cards are plain ``str``/``int``/``float``/``bool`` containers: they embed
+directly into benchmark JSON (``bench.py``, ``programs/benchmark.py``) and the
+``programs/report.py`` CLI, and :func:`validate_plan_card` pins the schema so
+drift fails CI instead of silently shipping.
+"""
+from __future__ import annotations
+
+PLAN_CARD_SCHEMA = "spfft_tpu.obs.plan_card/1"
+
+# Schema floor: keys every card must carry / keys distributed cards add.
+REQUIRED_KEYS = (
+    "schema",
+    "kind",
+    "engine",
+    "transform_type",
+    "dims",
+    "num_elements",
+    "num_sticks",
+    "nnz_fraction",
+    "dtype",
+    "precision",
+    "platform",
+    "execution",
+)
+DISTRIBUTED_KEYS = ("num_shards", "mesh", "decomposition", "exchange")
+EXCHANGE_KEYS = ("discipline", "wire_dtype", "wire_bytes", "rounds", "transport")
+POLICY_KEYS = ("round_cost_bytes", "one_shot_supported", "chosen", "alternatives")
+ALTERNATIVE_KEYS = ("discipline", "wire_bytes", "rounds", "cost_bytes", "chosen")
+COMPILED_KEYS = (
+    "compile_seconds",
+    "hlo_op_classes",
+    "element_granular_ops",
+    "memory_analysis",
+)
+
+
+def base_discipline(exchange_type):
+    """Map a wire-format variant (*_FLOAT / *_BF16) onto its base discipline
+    — the granularity the DEFAULT cost model reasons at."""
+    from ..types import BF16_EXCHANGES, FLOAT_EXCHANGES, ExchangeType
+
+    if exchange_type in (ExchangeType.BUFFERED_FLOAT, ExchangeType.BUFFERED_BF16):
+        return ExchangeType.BUFFERED
+    if exchange_type in FLOAT_EXCHANGES + BF16_EXCHANGES:
+        return ExchangeType.COMPACT_BUFFERED
+    return ExchangeType(exchange_type)
+
+
+def _exchange_policy_1d(transform) -> dict:
+    """The ``exchange_policy`` card section for 1-D slab plans: the DEFAULT
+    cost model's full table (parallel/policy.py) evaluated for THIS plan's
+    geometry and wire width, with the active discipline flagged chosen."""
+    from ..parallel.policy import alternative_costs, round_cost_bytes
+    from ..parallel.ragged import OneShotExchange, _ragged_a2a_supported
+    from ..types import wire_scalar_bytes
+
+    p = transform._params
+    ex = transform._exec
+    ragged = getattr(ex, "_ragged", None)
+    if isinstance(ragged, OneShotExchange):
+        one_shot = ragged.transport == "ragged"
+    elif p.num_shards > 1:
+        # compile-only probe, cached per platform/mesh-size (parallel/ragged.py)
+        one_shot = _ragged_a2a_supported(transform.mesh)
+    else:
+        one_shot = False
+    table = alternative_costs(
+        p.num_sticks_per_shard,
+        p.local_z_lengths,
+        one_shot_supported=one_shot,
+        wire_scalar_bytes=wire_scalar_bytes(
+            transform.exchange_type, transform.dtype
+        ),
+    )
+    chosen = base_discipline(transform.exchange_type)
+    return {
+        "round_cost_bytes": round_cost_bytes(),
+        "one_shot_supported": bool(one_shot),
+        "chosen": transform.exchange_type.name,
+        "alternatives": [
+            {
+                "discipline": d.name,
+                "wire_bytes": int(row["wire_bytes"]),
+                "rounds": int(row["rounds"]),
+                "cost_bytes": int(row["cost_bytes"]),
+                "chosen": d == chosen,
+            }
+            for d, row in table.items()
+        ],
+    }
+
+
+def _exchange_policy_pencil(transform):
+    """The ``exchange_policy`` section for 2-D pencil plans: the two cost
+    tables the DEFAULT resolver weighed (stashed at plan time,
+    pencil2._resolve_pencil2_default), with the backend's one-shot support
+    resolved HERE — lazily, like the 1-D path — so plans whose resolver
+    short-circuited never pay the probe compile at construction. ``None``
+    for explicit disciplines (the cost model never ran)."""
+    ex = transform._exec
+    tables = getattr(ex, "_policy_tables", None)
+    if tables is None:
+        return None
+    one_shot = ex._policy_probed_one_shot
+    if one_shot is None:
+        from ..parallel.ragged import _ragged_a2a_supported
+
+        # compile-only probe, cached per platform/mesh-size (parallel/ragged.py)
+        one_shot = (
+            transform._params.num_shards > 1
+            and _ragged_a2a_supported(transform.mesh)
+        )
+    costs = dict(tables[bool(one_shot)])
+    chosen = transform.exchange_type.name
+    costs["chosen"] = chosen
+    costs["alternatives"] = [
+        dict(alt, chosen=alt["discipline"] == chosen)
+        for alt in costs["alternatives"]
+    ]
+    return costs
+
+
+def plan_card(transform, *, include_compiled: bool = False) -> dict:
+    """Build the card for a local or distributed plan (see module docstring)."""
+    from ..types import TransformType, wire_dtype
+
+    ex = transform._exec
+    distributed = getattr(transform, "_mesh", None) is not None
+    dims = [int(transform.dim_x), int(transform.dim_y), int(transform.dim_z)]
+    if distributed:
+        p = transform._params
+        num_elements = int(transform.num_global_elements)
+        num_sticks = int(sum(int(n) for n in p.num_sticks_per_shard))
+    else:
+        num_elements = int(transform.num_local_elements)
+        num_sticks = int(transform._params.num_sticks)
+    card = {
+        "schema": PLAN_CARD_SCHEMA,
+        "kind": "distributed" if distributed else "local",
+        "engine": transform._engine,
+        "transform_type": TransformType(transform.transform_type).name,
+        "dims": dims,
+        "num_elements": num_elements,
+        "num_sticks": num_sticks,
+        "nnz_fraction": num_elements / float(transform.global_size),
+        "dtype": str(transform.dtype),
+        "precision": str(transform._precision),
+        "platform": _platform_of(transform),
+        "execution": ex.describe(),
+    }
+    if distributed:
+        p = transform._params
+        mesh = transform.mesh
+        card["num_shards"] = int(p.num_shards)
+        card["mesh"] = {
+            str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        }
+        pencil = transform._engine.startswith("pencil2")
+        card["decomposition"] = "pencil2" if pencil else "slab"
+        card["num_sticks_per_shard"] = [int(n) for n in p.num_sticks_per_shard]
+        card["local_z_lengths"] = [int(n) for n in p.local_z_lengths]
+        card["exchange"] = {
+            "discipline": transform.exchange_type.name,
+            "wire_dtype": str(wire_dtype(transform.exchange_type, transform.dtype)),
+            "wire_bytes": int(transform.exchange_wire_bytes()),
+            "rounds": int(transform.exchange_rounds()),
+            "transport": ex.exchange_transport(),
+        }
+        if pencil:
+            costs = _exchange_policy_pencil(transform)
+            if costs is not None:
+                card["exchange_policy"] = costs
+        else:
+            card["exchange_policy"] = _exchange_policy_1d(transform)
+    if include_compiled:
+        from .hlo import compiled_stats
+
+        card["compiled"] = compiled_stats(ex.lowered_backward())
+    return card
+
+
+def _platform_of(transform) -> str:
+    mesh = getattr(transform, "_mesh", None)
+    if mesh is not None:
+        return str(mesh.devices.flat[0].platform)
+    return str(transform.device.platform)
+
+
+def validate_plan_card(card: dict) -> list:
+    """Missing/malformed key paths of a plan card ([] when valid)."""
+    missing = [k for k in REQUIRED_KEYS if k not in card]
+    if card.get("schema") not in (None, PLAN_CARD_SCHEMA):
+        missing.append(f"schema (unknown: {card['schema']!r})")
+    if card.get("kind") == "distributed":
+        missing.extend(k for k in DISTRIBUTED_KEYS if k not in card)
+        missing.extend(
+            f"exchange.{k}"
+            for k in EXCHANGE_KEYS
+            if k not in card.get("exchange", {})
+        )
+        policy = card.get("exchange_policy")
+        if policy is not None:
+            missing.extend(
+                f"exchange_policy.{k}" for k in POLICY_KEYS if k not in policy
+            )
+            for i, alt in enumerate(policy.get("alternatives", ())):
+                missing.extend(
+                    f"exchange_policy.alternatives[{i}].{k}"
+                    for k in ALTERNATIVE_KEYS
+                    if k not in alt
+                )
+        elif card.get("decomposition") == "slab":
+            missing.append("exchange_policy")
+    if "compiled" in card:
+        missing.extend(
+            f"compiled.{k}" for k in COMPILED_KEYS if k not in card["compiled"]
+        )
+    return missing

@@ -1,0 +1,46 @@
+"""Canonical pipeline stage names — the single source for ``jax.named_scope``
+labels across every engine.
+
+Every engine wraps its pipeline stages in ``jax.named_scope`` so
+``jax.profiler`` traces read like the reference's rt_graph timing tree
+(reference: src/execution/execution_host.cpp:249-293). The labels live here so
+that (1) profiler traces attribute stages unambiguously — e.g. the sparse,
+blocked and dense y-DFT variants carry distinct names instead of three
+colliding "y transform" scopes, and the 2-D pencil engine's two exchanges are
+tagged A/B — and (2) ``programs/lint.py`` can enforce consistency both ways:
+every engine scope label must come from this list, and every listed stage must
+appear in at least one engine.
+
+``STAGES`` is a pure literal tuple (lint reads it with ``ast.literal_eval``
+so the check stays import-free).
+"""
+from __future__ import annotations
+
+STAGES = (
+    # sparse value pack/unpack (reference: compression_host.hpp)
+    "compression",
+    # R2C hermitian completions (reference: symmetry_host.hpp)
+    "stick symmetry",
+    "plane symmetry",
+    # DFT stages
+    "z transform",
+    "y transform",          # dense y-DFT
+    "y transform sparse",   # per-slot sparse-y contraction (ops/fft.plan_sparse_y)
+    "y transform blocked",  # blocked sparse-y buckets (ops/fft.plan_sparse_y_blocked)
+    "x transform",
+    # local stick -> plane relayout (MXU local engine)
+    "expand",
+    # 1-D slab exchange phases (reference: transpose_mpi_*_host.cpp)
+    "pack",
+    "exchange",
+    "unpack",
+    # 2-D pencil engine: exchange A (sticks -> y-pencils, over both mesh axes)
+    # and exchange B (y-pencils -> 2-D slabs, over "fft" only) are distinct
+    # pipeline points and carry distinct labels
+    "pack A",
+    "exchange A",
+    "unpack A",
+    "pack B",
+    "exchange B",
+    "unpack B",
+)

@@ -175,6 +175,37 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
     return wx_b, wx_f
 
 
+# Measured per-slot sparse-y engagement crossover: the variant wins below
+# Sy/Y = 0.6 (BASELINE.md `sparse_y_crossover_256`). The engagement test in
+# plan_sparse_y uses the exact integer form (5 * Sy < 3 * Y); this constant is
+# the documented value plan cards report (obs.plancard).
+SPARSE_Y_CROSSOVER = 0.6
+
+
+def sparse_y_blocked_frac() -> float:
+    """Blocked sparse-y engagement threshold: engage when padded bucket rows
+    stay under this fraction of the dense extent
+    (``SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC``, default 0.8 — measured sweep in
+    BASELINE.md). Single source for plan_sparse_y_blocked and plan cards."""
+    return float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
+
+
+def describe_sparse_y(per_slot: bool, blocked_buckets, sy: int = 0) -> dict:
+    """Sparse-y fragment of the MXU engine plan cards (obs.plancard): the
+    engaged variant plus the measured thresholds that selected it. ONE home
+    shared by the local and distributed engines so their cards cannot drift.
+    """
+    if per_slot:
+        card = {"variant": "per-slot", "sy": int(sy)}
+    elif blocked_buckets is not None:
+        card = {"variant": "blocked", "num_buckets": len(blocked_buckets)}
+    else:
+        card = {"variant": "dense"}
+    card["crossover_sy_over_y"] = SPARSE_Y_CROSSOVER
+    card["blocked_engage_frac"] = sparse_y_blocked_frac()
+    return card
+
+
 def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
     """Shared sparse-y planning for the MXU engines (C2C only — callers gate).
 
@@ -300,7 +331,7 @@ def plan_sparse_y_blocked(
     ) + len(dense_slots) * dim_y
     # engagement: blocked y flops ~ padded_rows * Y * Z vs dense ~ A * Y * Y * Z,
     # so the row totals compare directly (dense_rows = A * dim_y)
-    frac = float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
+    frac = sparse_y_blocked_frac()
     if mode == "auto" and padded_rows >= frac * dense_rows:
         return None
     # callers that EMBED the bucket matrices as program constants (the SPMD

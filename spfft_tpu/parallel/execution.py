@@ -201,9 +201,40 @@ class PaddingHelpers:
             return self._ragged.rounds()
         return 1
 
+    def exchange_transport(self) -> str:
+        """Short name of the collective form that actually carries the
+        exchange — the discipline says what rides the wire, this says how
+        (plan-card vocabulary, obs.plancard): ``all_to_all`` (padded),
+        ``ragged_all_to_all`` (one-shot exact rows), ``one-shot chain``
+        (UNBUFFERED's ppermute fallback off-TPU), ``ppermute chain``
+        (COMPACT)."""
+        from .ragged import OneShotExchange
+
+        if self._ragged is None:
+            return "all_to_all"
+        if isinstance(self._ragged, OneShotExchange):
+            if self._ragged.transport == "ragged":
+                return "ragged_all_to_all"
+            return "one-shot chain"
+        return "ppermute chain"
+
+    def _num_staged_shards(self) -> int:
+        """Shards THIS process stages host<->device (all of them on a
+        single-process mesh) — the staged_bytes_total accounting unit, so
+        per-process snapshots aggregate across processes without double
+        counting."""
+        if mesh_process_span(self.mesh) == 1:
+            return int(self.params.num_shards)
+        return len(self._local_shard_ids())
+
     def pad_values(self, values_per_shard):
         """List of per-shard complex arrays -> sharded (P, V_max) (re, im) pair."""
+        from .. import obs
+
         p = self.params
+        obs.counter("staged_bytes_total", direction="host_to_device").inc(
+            2 * self._num_staged_shards() * self._V * self.real_dtype.itemsize
+        )
         if mesh_process_span(self.mesh) == 1:
             re = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
             im = np.zeros((p.num_shards, self._V), dtype=self.real_dtype)
@@ -249,6 +280,11 @@ class PaddingHelpers:
     def unpad_values(self, pair):
         """Sharded (P, V_max) pair -> list of per-shard complex numpy arrays
         (``None`` for shards owned by other processes)."""
+        from .. import obs
+
+        obs.counter("staged_bytes_total", direction="device_to_host").inc(
+            2 * self._num_staged_shards() * self._V * self.real_dtype.itemsize
+        )
         counts = [int(x) for x in self.params.num_values_per_shard]
         if mesh_process_span(self.mesh) == 1:
             re, im = np.asarray(pair[0]), np.asarray(pair[1])
@@ -265,7 +301,14 @@ class PaddingHelpers:
         """Global (Z, Y, X) array -> sharded (P, L, Y, X) real (re, im or re-only)
         arrays. On a multi-process mesh each process stages only its own shards
         (the global input array must still be supplied on every process)."""
+        from .. import obs
+
         p = self.params
+        obs.counter("staged_bytes_total", direction="host_to_device").inc(
+            (1 if self.is_r2c else 2)
+            * self._num_staged_shards() * self._L * p.dim_y * p.dim_x
+            * self.real_dtype.itemsize
+        )
         arrs = []
         parts = [np.asarray(space).real, None if self.is_r2c else np.asarray(space).imag]
         multihost = mesh_process_span(self.mesh) > 1
@@ -304,7 +347,14 @@ class PaddingHelpers:
         On a multi-process mesh, returns a per-shard list instead (local slab
         arrays of shape (local_z_length, Y, X); ``None`` for remote shards) —
         the reference's per-rank space-domain contract."""
+        from .. import obs
+
         p = self.params
+        obs.counter("staged_bytes_total", direction="device_to_host").inc(
+            (1 if self.is_r2c else 2)
+            * self._num_staged_shards() * self._L * p.dim_y * p.dim_x
+            * self.real_dtype.itemsize
+        )
         if mesh_process_span(self.mesh) == 1:
             if self.is_r2c:
                 full = np.asarray(out)
@@ -403,7 +453,9 @@ class DistributedExecution(PaddingHelpers):
         # ---- compiled pipelines ----
         specs_v = P(FFT_AXIS, None)  # global (P, V_max), per-shard blocks (1, V_max)
         specs_s = P(FFT_AXIS, None, None, None)  # global (P, L, Y, X) space slabs
-        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        from .mesh import shard_mapper
+
+        sm = shard_mapper(mesh)
 
         self._backward_sm = sm(
             self._backward_impl,
@@ -430,6 +482,28 @@ class DistributedExecution(PaddingHelpers):
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
+    # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
+
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard)."""
+        return {
+            "pipeline": "jnp.fft + scatter/gather (shard_map)",
+            "padded_geometry": {
+                "s_max": int(self._S),
+                "l_max": int(self._L),
+                "v_max": int(self._V),
+            },
+        }
+
+    def lowered_backward(self):
+        """Lower (without compiling) the backward pipeline — the obs layer's
+        hook for compiled-program stats (obs.hlo.compiled_stats)."""
+        p = self.params
+        v = jax.ShapeDtypeStruct(
+            (p.num_shards, self._V), self.real_dtype, sharding=self.value_sharding
+        )
+        return self._backward.lower(v, v, self._value_indices)
+
     # ---- wire-format casts (float exchange) -----------------------------------
 
     def _exchange(self, buffer):
@@ -441,107 +515,140 @@ class DistributedExecution(PaddingHelpers):
     def _backward_impl(self, values_re, values_im, value_indices):
         p = self.params
         S, L, Z = self._S, self._L, p.dim_z
-        values = jax.lax.complex(
-            values_re[0].astype(self.real_dtype), values_im[0].astype(self.real_dtype)
-        )
+        # stage scopes: canonical obs.STAGES labels (profiler attribution)
+        with jax.named_scope("compression"):
+            values = jax.lax.complex(
+                values_re[0].astype(self.real_dtype),
+                values_im[0].astype(self.real_dtype),
+            )
 
-        # decompress: scatter local packed values into padded local sticks. No
-        # unique_indices hint: padding slots share the same out-of-range sentinel.
-        flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-        flat = flat.at[value_indices[0]].set(values, mode="drop")
-        sticks = flat[: S * Z].reshape(S, Z)
+            # decompress: scatter local packed values into padded local sticks. No
+            # unique_indices hint: padding slots share the same out-of-range sentinel.
+            flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
+            flat = flat.at[value_indices[0]].set(values, mode="drop")
+            sticks = flat[: S * Z].reshape(S, Z)
 
         if self.is_r2c and p.zero_stick_shard >= 0:
-            row = sticks[p.zero_stick_row]
-            filled = symmetry.hermitian_fill_1d(row, axis=0)
-            is_owner = jax.lax.axis_index(FFT_AXIS) == p.zero_stick_shard
-            sticks = sticks.at[p.zero_stick_row].set(jnp.where(is_owner, filled, row))
+            with jax.named_scope("stick symmetry"):
+                row = sticks[p.zero_stick_row]
+                filled = symmetry.hermitian_fill_1d(row, axis=0)
+                is_owner = jax.lax.axis_index(FFT_AXIS) == p.zero_stick_shard
+                sticks = sticks.at[p.zero_stick_row].set(
+                    jnp.where(is_owner, filled, row)
+                )
 
-        sticks = jnp.fft.ifft(sticks, axis=1)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.ifft(sticks, axis=1)
 
         if self._ragged is not None:
             # exact-counts exchange: ppermute chain, blocks sized sticks_i x planes_j
             # (the reference's Alltoallv discipline, see parallel/ragged.py)
-            planes = self._ragged.backward(
-                (sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
-            )[0]  # (Y*Xf, L) slot-major plane rows
-            slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
+            with jax.named_scope("exchange"):
+                planes = self._ragged.backward(
+                    (sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
+                )[0]  # (Y*Xf, L) slot-major plane rows
+            with jax.named_scope("unpack"):
+                slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
         else:
             # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
-            sticks_z = sticks.T
-            buffer = jnp.take(
-                sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill", fill_value=0
-            )
-            buffer = buffer.reshape(p.num_shards, L, S)
+            with jax.named_scope("pack"):
+                sticks_z = sticks.T
+                buffer = jnp.take(
+                    sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill",
+                    fill_value=0,
+                )
+                buffer = buffer.reshape(p.num_shards, L, S)
 
             # exchange: shard r receives every shard's sticks on r's planes
             #   (the MPI_Alltoall of the reference's BUFFERED transpose,
             #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
-            recv = self._exchange(buffer)
+            with jax.named_scope("exchange"):
+                recv = self._exchange(buffer)
 
             # unpack: scatter all sticks into the local slab planes
-            planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
-            slab = jnp.zeros((L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype)
-            slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
-            slab = slab[:, : p.dim_y * p.dim_x_freq].reshape(L, p.dim_y, p.dim_x_freq)
+            with jax.named_scope("unpack"):
+                planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
+                slab = jnp.zeros(
+                    (L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype
+                )
+                slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
+                slab = slab[:, : p.dim_y * p.dim_x_freq].reshape(
+                    L, p.dim_y, p.dim_x_freq
+                )
 
         if self.is_r2c:
-            slab = symmetry.apply_plane_symmetry(slab)
-        slab = jnp.fft.ifft(slab, axis=1)
+            with jax.named_scope("plane symmetry"):
+                slab = symmetry.apply_plane_symmetry(slab)
+        with jax.named_scope("y transform"):
+            slab = jnp.fft.ifft(slab, axis=1)
         total = np.asarray(p.total_size, dtype=self.real_dtype)
-        if self.is_r2c:
-            out = jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype) * total
-            return out[None]
-        out = jnp.fft.ifft(slab, axis=2) * total
-        return out.real[None], out.imag[None]
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                out = (
+                    jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype)
+                    * total
+                )
+                return out[None]
+            out = jnp.fft.ifft(slab, axis=2) * total
+            return out.real[None], out.imag[None]
 
     def _forward_impl(self, space_re, *rest, scale):
         p = self.params
         S, L = self._S, self._L
-        if self.is_r2c:
-            (value_indices,) = rest
-            slab = space_re[0].astype(self.real_dtype)
-            grid = jnp.fft.rfft(slab, n=p.dim_x, axis=2).astype(self.complex_dtype)
-        else:
-            space_im, value_indices = rest
-            slab = jax.lax.complex(
-                space_re[0].astype(self.real_dtype), space_im[0].astype(self.real_dtype)
-            )
-            grid = jnp.fft.fft(slab, axis=2)
-        grid = jnp.fft.fft(grid, axis=1)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                (value_indices,) = rest
+                slab = space_re[0].astype(self.real_dtype)
+                grid = jnp.fft.rfft(slab, n=p.dim_x, axis=2).astype(self.complex_dtype)
+            else:
+                space_im, value_indices = rest
+                slab = jax.lax.complex(
+                    space_re[0].astype(self.real_dtype),
+                    space_im[0].astype(self.real_dtype),
+                )
+                grid = jnp.fft.fft(slab, axis=2)
+        with jax.named_scope("y transform"):
+            grid = jnp.fft.fft(grid, axis=1)
 
         if self._ragged is not None:
-            sticks = self._ragged.forward(
-                (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
-                wire=self._ragged_wire, real_dtype=self.real_dtype,
-            )[0]
+            with jax.named_scope("exchange"):
+                sticks = self._ragged.forward(
+                    (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
+                    wire=self._ragged_wire, real_dtype=self.real_dtype,
+                )[0]
         else:
             # pack: gather every shard's stick columns from my planes -> (P, L, S)
-            flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
-            planes = jnp.take(
-                flat_grid, jnp.asarray(self._yx_flat), axis=1, mode="fill", fill_value=0
-            )
-            buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
+            with jax.named_scope("pack"):
+                flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
+                planes = jnp.take(
+                    flat_grid, jnp.asarray(self._yx_flat), axis=1, mode="fill",
+                    fill_value=0,
+                )
+                buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
 
             # exchange: shard r receives its own sticks' values on every shard's planes
-            recv = self._exchange(buffer)
+            with jax.named_scope("exchange"):
+                recv = self._exchange(buffer)
 
             # unpack: (P, L, S) -> (S, Z) via the global-z map
-            sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)
-            sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
+            with jax.named_scope("unpack"):
+                sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)
+                sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
 
-        sticks = jnp.fft.fft(sticks, axis=1)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.fft(sticks, axis=1)
 
         # compress: gather local packed values (+ optional scaling)
-        values = jnp.take(
-            sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0
-        )
-        if scale is not None:
-            values = values * np.asarray(scale, dtype=self.real_dtype)
-        return (
-            values.real.astype(self.real_dtype)[None],
-            values.imag.astype(self.real_dtype)[None],
-        )
+        with jax.named_scope("compression"):
+            values = jnp.take(
+                sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0
+            )
+            if scale is not None:
+                values = values * np.asarray(scale, dtype=self.real_dtype)
+            return (
+                values.real.astype(self.real_dtype)[None],
+                values.imag.astype(self.real_dtype)[None],
+            )
 
     # ---- device-side entry points ---------------------------------------------
 

@@ -415,7 +415,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             self._align_phase = None
         specs_v = P(FFT_AXIS, None)
         specs_s = P(FFT_AXIS, None, None, None)
-        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        from .mesh import shard_mapper
+
+        sm = shard_mapper(mesh)
 
         specs_p = P(FFT_AXIS, None, None)
         phase_specs = () if self._align_phase is None else (specs_p, specs_p)
@@ -443,6 +445,54 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
     @property
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
+
+    # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
+
+    def _y_stage_scope(self) -> str:
+        """The canonical named-scope label of the engaged y-DFT variant
+        (obs.STAGES): sparse, blocked and dense pipelines carry distinct
+        labels so profiler traces attribute them unambiguously."""
+        if self._sparse_y:
+            return "y transform sparse"
+        if self._sparse_y_blocked is not None:
+            return "y transform blocked"
+        return "y transform"
+
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard): the distributed
+        MXU engine's measured decisions."""
+        from ..ops.fft import describe_sparse_y
+
+        sparse_y = describe_sparse_y(
+            self._sparse_y,
+            self._sparse_y_blocked,
+            self._sy if self._sparse_y else 0,
+        )
+        return {
+            "pipeline": "matmul DFT stages + lane-copy value plans (shard_map)",
+            "matmul_precision": str(self._precision).rsplit(".", 1)[-1],
+            "num_x_active": int(self._num_x_active),
+            "dim_x_freq": int(self.params.dim_x_freq),
+            "sparse_y": sparse_y,
+            "plane_slots": int(self._plane_slots),
+            "alignment_rotations": self._align_rep is not None,
+            "value_plan_branches": len(self._decompress_branches),
+            "padded_geometry": {
+                "s_max": int(self._S),
+                "l_max": int(self._L),
+                "v_max": int(self._V),
+            },
+            "uniform_z": bool(self._uniform_z),
+        }
+
+    def lowered_backward(self):
+        """Lower (without compiling) the backward pipeline — the obs layer's
+        hook for compiled-program stats (obs.hlo.compiled_stats)."""
+        p = self.params
+        v = jax.ShapeDtypeStruct(
+            (p.num_shards, self._V), self.real_dtype, sharding=self.value_sharding
+        )
+        return self._backward.lower(v, v, *self._phase_args())
 
     # ---- wire + exchange (shared machinery in MxuValuePlans) ------------------
 
@@ -556,7 +606,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     gre = gre.at[:, :, 0].set(pre)
                     gim = gim.at[:, :, 0].set(pim)
 
-        with jax.named_scope("y transform"):
+        with jax.named_scope(self._y_stage_scope()):
             if self._sparse_y:
                 # per-slot y contraction straight off the stick table (both
                 # exchange paths deliver the same (A, Sy, L) orientation)
@@ -630,7 +680,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     space_re[0].astype(rt), space_im[0].astype(rt),
                     *self._wx_f, "lyx,xk->lyk", prec,
                 )
-        with jax.named_scope("y transform"):
+        with jax.named_scope(self._y_stage_scope()):
             if self._sparse_y:
                 # per-slot y contraction straight into the stick table (both
                 # exchange paths consume the same (A, Sy, L) orientation)

@@ -45,6 +45,21 @@ def fft_axis_size(mesh) -> int:
     return int(mesh.shape[FFT_AXIS])
 
 
+def shard_mapper(mesh):
+    """``jax.shard_map`` bound to ``mesh`` with replication checking off,
+    across jax versions: the top-level ``jax.shard_map`` (``check_vma=``) where
+    it exists, the ``jax.experimental.shard_map`` form (``check_rep=``) on
+    older runtimes. The single shard_map entry point for every engine, so a
+    jax API move is one edit here."""
+    import functools
+
+    if hasattr(jax, "shard_map"):
+        return functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return functools.partial(_shard_map, mesh=mesh, check_rep=False)
+
+
 def configure_virtual_devices(n_devices: int, *, warn: bool = False) -> None:
     """Request an ``n_devices``-wide virtual CPU backend, without touching devices.
 
@@ -60,6 +75,18 @@ def configure_virtual_devices(n_devices: int, *, warn: bool = False) -> None:
             import sys
 
             print(f"spfft_tpu: jax_num_cpu_devices ignored ({e})", file=sys.stderr)
+    except AttributeError:
+        # jax < 0.4.38: same knob spelled as an XLA flag, honored at CPU
+        # client creation (both the global backend and the private client of
+        # _platform.cpu_devices read it)
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{max(int(n_devices), 1)}"
+            ).strip()
 
 
 def ensure_virtual_devices(n_devices: int, *, warn: bool = False, platform=None):

@@ -115,6 +115,11 @@ def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
     discipline with the balanced assignment, the exact-counts disciplines
     with the ownership-aligned one (see _x_group_assignment). The backend's
     one-shot ragged-a2a support is probed only when the answer depends on it.
+
+    Returns ``(choice, policy_tables)``: the resolved discipline plus the
+    full per-alternative accounting (one table per one-shot-support flag, in
+    the plan-card ``exchange_policy`` shape minus the ``chosen`` marks —
+    obs.plancard) so the engine can stash what the resolver actually weighed.
     """
     from .policy import round_cost_bytes
     from ..types import ExchangeType as ET
@@ -169,11 +174,39 @@ def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
         cands.append((c_chain, 2, ET.COMPACT_BUFFERED))
         return min(cands)[2]
 
+    def policy_table(one_shot_supported):
+        chain_rounds = (Pn - 1) + (P1 - 1)
+        rows = {
+            ET.BUFFERED: (a_pad + b_pad, 2, c_buffered),
+            ET.UNBUFFERED: (
+                (a_exact + b_exact, 2, c_oneshot)
+                if one_shot_supported
+                # without the HLO the one-shot buffers ride the block chain —
+                # cost what actually rides the wire (same rule as policy.py)
+                else (a_chain + b_chain, chain_rounds, c_chain)
+            ),
+            ET.COMPACT_BUFFERED: (a_chain + b_chain, chain_rounds, c_chain),
+        }
+        return {
+            "round_cost_bytes": per_round,
+            "one_shot_supported": bool(one_shot_supported),
+            "alternatives": [
+                {
+                    "discipline": d.name,
+                    "wire_bytes": int(vol * 2 * wire_scalar_bytes),
+                    "rounds": int(rounds),
+                    "cost_bytes": int(c),
+                }
+                for d, (vol, rounds, c) in rows.items()
+            ],
+        }
+
+    tables = {flag: policy_table(flag) for flag in (False, True)}
     if pick(False) == pick(True) or Pn <= 1:
-        return pick(False)
+        return pick(False), tables
     from .ragged import _ragged_a2a_supported
 
-    return pick(_ragged_a2a_supported(mesh))
+    return pick(_ragged_a2a_supported(mesh)), tables
 
 
 class Pencil2Execution(PaddingHelpers):
@@ -269,14 +302,16 @@ class Pencil2Execution(PaddingHelpers):
 
         if self.exchange_type == ExchangeType.DEFAULT:
             get_assign(False), get_assign(True)
-            self.exchange_type = _resolve_pencil2_default(
+            self.exchange_type, policy_tables = _resolve_pencil2_default(
                 assign, lz, ly, Lz, Ly, P1, P2, mesh,
                 wire_scalar_bytes=self.real_dtype.itemsize,
             )
+        else:
+            policy_tables = None
+
+        from .ragged import _ragged_a2a_supported
 
         if self.exchange_type in _RAGGED:
-            from .ragged import _ragged_a2a_supported
-
             # resolved here once: drives both the assignment pick below and
             # the transport class choice (one-shot where the backend compiles
             # ragged-all-to-all, the rotation chain elsewhere / for COMPACT_*)
@@ -294,6 +329,19 @@ class Pencil2Execution(PaddingHelpers):
         else:
             one_shot = False
             aligned = False
+        # Plan-card provenance (obs.plancard): when the DEFAULT cost model
+        # ran, stash BOTH tables it weighed; report() resolves the backend's
+        # actual one-shot support lazily (obs/plancard._exchange_policy_pencil)
+        # so plan construction never pays a probe compile the resolver
+        # deliberately skipped. For UNBUFFERED the transport choice above
+        # already IS the probe result — record it so the card never re-probes.
+        self._policy_tables = policy_tables
+        self._policy_probed_one_shot = (
+            bool(one_shot)
+            if self.exchange_type == ExchangeType.UNBUFFERED
+            else None
+        )
+        self._aligned_x_groups = bool(aligned)
         group_of_ux, slot_of_ux, Ax, counts = get_assign(aligned)
         group_of_x = np.full(Xf, P1, dtype=np.int64)  # sentinel P1
         slot_of_x = np.zeros(Xf, dtype=np.int64)
@@ -385,7 +433,9 @@ class Pencil2Execution(PaddingHelpers):
         specs_v = P(both, None)
         specs_s = P(both, None, None, None)
         r2c = self.is_r2c
-        sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+        from .mesh import shard_mapper
+
+        sm = shard_mapper(mesh)
         self._backward_sm = sm(
             self._backward_impl,
             in_specs=(specs_v, specs_v, specs_v),
@@ -437,6 +487,44 @@ class Pencil2Execution(PaddingHelpers):
             )
         return 2
 
+    def exchange_transport(self) -> str:
+        """Plan-card transport vocabulary for the pencil exchanges (A + B) —
+        see PaddingHelpers.exchange_transport."""
+        if self._ragged2 is None:
+            return "all_to_all"
+        from .ragged import OneShotBlockExchange
+
+        if isinstance(self._ragged2[(AX1, AX2)], OneShotBlockExchange):
+            return "ragged_all_to_all blocks"
+        return "block chain"
+
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard): the 2-D pencil
+        geometry and the x-group strategy the discipline selected."""
+        return {
+            "pipeline": "jnp.fft + scatter/gather (pencil shard_map)",
+            "pencil_geometry": {
+                "p1": int(self.P1),
+                "p2": int(self.P2),
+                "lz_max": int(self._Lz),
+                "ly_max": int(self._Ly),
+                "ax": int(self._Ax),
+                "sg_max": int(self._SG),
+            },
+            "x_group_strategy": (
+                "ownership-aligned" if self._aligned_x_groups else "balanced"
+            ),
+        }
+
+    def lowered_backward(self):
+        """Lower (without compiling) the backward pipeline — the obs layer's
+        hook for compiled-program stats (obs.hlo.compiled_stats)."""
+        p = self.params
+        v = jax.ShapeDtypeStruct(
+            (p.num_shards, self._V), self.real_dtype, sharding=self.value_sharding
+        )
+        return self._backward.lower(v, v, self._value_indices)
+
     def _exchange(self, buf, axes, reverse=False):
         """Padded all_to_all (BUFFERED) or exact-counts block chain
         (COMPACT/UNBUFFERED) with the configured wire format (single-sourced
@@ -468,7 +556,14 @@ class Pencil2Execution(PaddingHelpers):
     def pad_space(self, space):
         """Global (Z, Y, X) array -> sharded (P, Lz, Ly, X) real arrays
         ((re, im) pair for C2C; (re, None) for R2C)."""
+        from .. import obs
+
         p = self.params
+        obs.counter("staged_bytes_total", direction="host_to_device").inc(
+            (1 if self.is_r2c else 2)
+            * self._num_staged_shards() * self._Lz * self._Ly * p.dim_x
+            * self.real_dtype.itemsize
+        )
         space = np.asarray(space)
         out = []
         for part in (space.real, None if self.is_r2c else space.imag):
@@ -489,7 +584,14 @@ class Pencil2Execution(PaddingHelpers):
 
     def unpad_space(self, out):
         """Sharded (P, Lz, Ly, X) result -> global (Z, Y, X) numpy array."""
+        from .. import obs
+
         p = self.params
+        obs.counter("staged_bytes_total", direction="device_to_host").inc(
+            (1 if self.is_r2c else 2)
+            * self._num_staged_shards() * self._Lz * self._Ly * p.dim_x
+            * self.real_dtype.itemsize
+        )
         if self.is_r2c:
             full = np.asarray(out)
             dst = np.zeros((p.dim_z, p.dim_y, p.dim_x), dtype=self.real_dtype)
@@ -624,60 +726,75 @@ class Pencil2Execution(PaddingHelpers):
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
 
-        values = jax.lax.complex(
-            values_re[0].astype(self.real_dtype), values_im[0].astype(self.real_dtype)
-        )
-        flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-        flat = flat.at[value_indices[0]].set(values, mode="drop")
-        sticks = flat[: S * Z].reshape(S, Z)
+        # stage scopes: canonical obs.STAGES labels (profiler attribution;
+        # the two exchanges are tagged A/B so traces attribute them apart)
+        with jax.named_scope("compression"):
+            values = jax.lax.complex(
+                values_re[0].astype(self.real_dtype),
+                values_im[0].astype(self.real_dtype),
+            )
+            flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
+            flat = flat.at[value_indices[0]].set(values, mode="drop")
+            sticks = flat[: S * Z].reshape(S, Z)
 
         if self.is_r2c and p.zero_stick_shard >= 0:
             # (0,0)-stick hermitian fill on its owner, before the z transform
-            row = sticks[p.zero_stick_row]
-            filled = symmetry.hermitian_fill_1d(row, axis=0)
-            own = s_me == p.zero_stick_shard
-            sticks = sticks.at[p.zero_stick_row].set(jnp.where(own, filled, row))
+            with jax.named_scope("stick symmetry"):
+                row = sticks[p.zero_stick_row]
+                filled = symmetry.hermitian_fill_1d(row, axis=0)
+                own = s_me == p.zero_stick_shard
+                sticks = sticks.at[p.zero_stick_row].set(jnp.where(own, filled, row))
 
-        sticks = jnp.fft.ifft(sticks, axis=1)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.ifft(sticks, axis=1)
 
         # pack A: my sticks split by destination (x-group a', z-slab b')
-        buf = self._pack_a(sticks, s_me)
+        with jax.named_scope("pack A"):
+            buf = self._pack_a(sticks, s_me)
 
         # exchange A: one collective over BOTH mesh axes (flat row-major (a, b))
-        recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz): recv[s] = s's sticks here
+        with jax.named_scope("exchange A"):
+            recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz) = s's sticks here
 
         # unpack A -> y-pencil grid (Y, Ax, Lz): all sticks in my x-group, my z
-        grid = self._unpack_a(recv, a_me)
+        with jax.named_scope("unpack A"):
+            grid = self._unpack_a(recv, a_me)
 
         if self.is_r2c and self._have_x0:
             # x == 0 plane hermitian fill along y on its (group, slot) owner,
             # which has the FULL y extent here (z is space-domain)
-            g0, s0 = self._x0_group, self._x0_slot
-            col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
-            grid = grid.at[:, s0, :].set(
-                jnp.where(a_me == g0, col, grid[:, s0, :])
-            )
+            with jax.named_scope("plane symmetry"):
+                g0, s0 = self._x0_group, self._x0_slot
+                col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
+                grid = grid.at[:, s0, :].set(
+                    jnp.where(a_me == g0, col, grid[:, s0, :])
+                )
 
-        grid = jnp.fft.ifft(grid, axis=0)
+        with jax.named_scope("y transform"):
+            grid = jnp.fft.ifft(grid, axis=0)
 
         # pack B: gather each destination's y-rows (within my fixed z-slab)
-        bufb = self._pack_b(grid)
+        with jax.named_scope("pack B"):
+            bufb = self._pack_b(grid)
 
         # exchange B: within the row (fixed z-slab), over the x-group axis
-        recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, Lz): q's x-cols, my y
+        with jax.named_scope("exchange B"):
+            recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, Lz): q's x-cols, my y
 
         # assemble the full frequency-x extent and transform
-        h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
-        slab = jnp.zeros((Ly, Xf + 1, Lz), dtype=self.complex_dtype)
-        slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
-        slab = slab[:, :Xf, :]
+        with jax.named_scope("unpack B"):
+            h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
+            slab = jnp.zeros((Ly, Xf + 1, Lz), dtype=self.complex_dtype)
+            slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
+            slab = slab[:, :Xf, :]
         total = np.asarray(p.total_size, self.real_dtype)
-        if self.is_r2c:
-            out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(self.real_dtype)
-            return (out.transpose(2, 0, 1) * total)[None]
-        out = jnp.fft.ifft(slab, axis=1) * total
-        out = out.transpose(2, 0, 1)  # (Lz, Ly, X) space slab contract
-        return out.real[None], out.imag[None]
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(self.real_dtype)
+                return (out.transpose(2, 0, 1) * total)[None]
+            out = jnp.fft.ifft(slab, axis=1) * total
+            out = out.transpose(2, 0, 1)  # (Lz, Ly, X) space slab contract
+            return out.real[None], out.imag[None]
 
     def _forward_impl(self, space_re, *rest, scale):
         p = self.params
@@ -687,46 +804,60 @@ class Pencil2Execution(PaddingHelpers):
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
 
-        if self.is_r2c:
-            (value_indices,) = rest
-            slab = space_re[0].astype(self.real_dtype)
-            freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
-        else:
-            space_im, value_indices = rest
-            slab = jax.lax.complex(
-                space_re[0].astype(self.real_dtype), space_im[0].astype(self.real_dtype)
-            )
-            freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
+        with jax.named_scope("x transform"):
+            if self.is_r2c:
+                (value_indices,) = rest
+                slab = space_re[0].astype(self.real_dtype)
+                freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
+            else:
+                space_im, value_indices = rest
+                slab = jax.lax.complex(
+                    space_re[0].astype(self.real_dtype),
+                    space_im[0].astype(self.real_dtype),
+                )
+                freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
 
         # split into x-group columns and send each group home (exchange B rev)
-        fq = freq.transpose(1, 2, 0)  # (Ly, Xf, Lz) z-minor
-        hpad = jnp.concatenate(
-            [fq, jnp.zeros((Ly, 1, Lz), self.complex_dtype)], axis=1
-        )
-        h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)  # (Ly, P1*Ax, Lz)
-        bufb = h.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
+        with jax.named_scope("pack B"):
+            fq = freq.transpose(1, 2, 0)  # (Ly, Xf, Lz) z-minor
+            hpad = jnp.concatenate(
+                [fq, jnp.zeros((Ly, 1, Lz), self.complex_dtype)], axis=1
+            )
+            h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)  # (Ly, P1*Ax, Lz)
+            bufb = h.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
         # (P1, Ly, Ax, Lz): my x-group, q's y
-        recvb = self._exchange(bufb, (AX1,), reverse=True)
+        with jax.named_scope("exchange B"):
+            recvb = self._exchange(bufb, (AX1,), reverse=True)
 
         # reassemble the full y extent of my x-group
-        grid = self._unpack_b_rev(recvb)  # (Y, Ax, Lz)
-        grid = jnp.fft.fft(grid, axis=0)
+        with jax.named_scope("unpack B"):
+            grid = self._unpack_b_rev(recvb)  # (Y, Ax, Lz)
+        with jax.named_scope("y transform"):
+            grid = jnp.fft.fft(grid, axis=0)
 
         # exchange A reverse: each stick's z-chunk back to its owner
-        buf = self._pack_a_rev(grid, a_me, b_me)  # (P, SG, Lz)
+        with jax.named_scope("pack A"):
+            buf = self._pack_a_rev(grid, a_me, b_me)  # (P, SG, Lz)
         # (P, SG, Lz): my sticks, p's z
-        recv = self._exchange(buf, (AX1, AX2), reverse=True)
+        with jax.named_scope("exchange A"):
+            recv = self._exchange(buf, (AX1, AX2), reverse=True)
 
         # reassemble my (S, Z) stick table and transform
-        sticks = jnp.fft.fft(self._unpack_a_rev(recv, s_me), axis=1)
+        with jax.named_scope("unpack A"):
+            sticks = self._unpack_a_rev(recv, s_me)
+        with jax.named_scope("z transform"):
+            sticks = jnp.fft.fft(sticks, axis=1)
 
-        values = jnp.take(sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0)
-        if scale is not None:
-            values = values * np.asarray(scale, dtype=self.real_dtype)
-        return (
-            values.real.astype(self.real_dtype)[None],
-            values.imag.astype(self.real_dtype)[None],
-        )
+        with jax.named_scope("compression"):
+            values = jnp.take(
+                sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0
+            )
+            if scale is not None:
+                values = values * np.asarray(scale, dtype=self.real_dtype)
+            return (
+                values.real.astype(self.real_dtype)[None],
+                values.imag.astype(self.real_dtype)[None],
+            )
 
     # ---- device-side entry points ---------------------------------------------
 

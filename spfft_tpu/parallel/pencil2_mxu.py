@@ -56,6 +56,16 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         # (lanecopy.phase_rep_tables_at): tables below the budget are embedded
         # as constants, bigger plans generate in-trace
 
+    def describe(self) -> dict:
+        """Engine fragment of the plan card (obs.plancard): the pencil
+        geometry from the base class plus the MXU compute-stage decisions."""
+        card = super().describe()
+        card["pipeline"] = "matmul DFT stages + lane-copy value plans (pencil)"
+        card["matmul_precision"] = str(self._precision).rsplit(".", 1)[-1]
+        card["alignment_rotations"] = self._align_rep is not None
+        card["value_plan_branches"] = len(self._decompress_branches)
+        return card
+
     def _exchange_pair(self, bre, bim, axes, reverse=False):
         """(re, im) blocks through the configured discipline: the padded
         stacked-pair all_to_all (MxuValuePlans), or the exact-counts block
@@ -106,15 +116,15 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
         # pack A: my sticks split by destination (x-group, z-slab) — whole-row
         # gathers + static window slices (base-class helpers; z-minor layout)
-        with jax.named_scope("pack"):
+        with jax.named_scope("pack A"):
             bre = self._pack_a(sre, s_me)
             bim = self._pack_a(sim, s_me)
 
-        with jax.named_scope("exchange"):
+        with jax.named_scope("exchange A"):
             rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
 
         # unpack A -> (Y, Ax, Lz) y-pencil grid (one row gather per part)
-        with jax.named_scope("unpack"):
+        with jax.named_scope("unpack A"):
             gre = self._unpack_a(rre, a_me)
             gim = self._unpack_a(rim, a_me)
 
@@ -131,11 +141,11 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yal,yk->kal", prec)
 
         # pack B: each destination's y-rows (within my fixed z-slab)
-        with jax.named_scope("pack"):
+        with jax.named_scope("pack B"):
             bre = self._pack_b(gre)
             bim = self._pack_b(gim)
 
-        with jax.named_scope("exchange"):
+        with jax.named_scope("exchange B"):
             rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
 
         # x transform: the slot->x map is folded into the matrix (zero rows on
@@ -174,14 +184,14 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
                 )
 
         # exchange B reverse: send each x-group home (within my z-slab)
-        with jax.named_scope("pack"):
+        with jax.named_scope("pack B"):
             bre = hre.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
             bim = him.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
-        with jax.named_scope("exchange"):
+        with jax.named_scope("exchange B"):
             rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
 
         # reassemble the full y extent of my x-group (one row gather per part)
-        with jax.named_scope("unpack"):
+        with jax.named_scope("unpack B"):
             gre = self._unpack_b_rev(rbre)
             gim = self._unpack_b_rev(rbim)
 
@@ -189,13 +199,13 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "yal,yj->jal", prec)
 
         # exchange A reverse: each stick's z-chunk back to its owner
-        with jax.named_scope("pack"):
+        with jax.named_scope("pack A"):
             bre = self._pack_a_rev(gre, a_me, b_me)
             bim = self._pack_a_rev(gim, a_me, b_me)
-        with jax.named_scope("exchange"):
+        with jax.named_scope("exchange A"):
             rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
 
-        with jax.named_scope("unpack"):
+        with jax.named_scope("unpack A"):
             sre = self._unpack_a_rev(rre, s_me)
             sim = self._unpack_a_rev(rim, s_me)
 

@@ -80,6 +80,45 @@ def round_cost_bytes() -> int:
     return int(os.environ.get(ROUND_COST_ENV, "128")) << 10
 
 
+def alternative_costs(
+    num_sticks_per_shard,
+    local_z_lengths,
+    *,
+    one_shot_supported: bool,
+    wire_scalar_bytes: int = 4,
+) -> dict:
+    """The full accounting table behind :func:`resolve_default_exchange`:
+    ``{discipline: {"wire_bytes", "rounds", "cost_bytes"}}`` for the three
+    base disciplines under this plan geometry and wire width. This is what
+    plan cards embed as the chosen-vs-rejected exchange record (the
+    ``exchange_policy`` section, spfft_tpu/obs/plancard.py), so the card and
+    the resolver can never disagree — both read this one table.
+    """
+    s = np.asarray(num_sticks_per_shard)
+    P = int(s.size)
+    vols = discipline_volumes(num_sticks_per_shard, local_z_lengths)
+    per_round = round_cost_bytes()
+    rounds = {
+        ExchangeType.BUFFERED: 1,
+        ExchangeType.COMPACT_BUFFERED: max(1, P - 1),
+        ExchangeType.UNBUFFERED: 1 if one_shot_supported else max(1, P - 1),
+    }
+    if not one_shot_supported:
+        # The chain transport ships per-step-maxima buffers, not the exact
+        # Alltoallw volume — cost what actually rides the wire (ragged.py
+        # OneShotExchange falls back to the same _chain_step_sizes rule).
+        vols[ExchangeType.UNBUFFERED] = vols[ExchangeType.COMPACT_BUFFERED]
+    return {
+        d: {
+            "wire_bytes": vols[d] * 2 * wire_scalar_bytes,
+            "rounds": rounds[d],
+            "cost_bytes": vols[d] * 2 * wire_scalar_bytes
+            + rounds[d] * per_round,
+        }
+        for d in vols
+    }
+
+
 def resolve_default_exchange(
     num_sticks_per_shard,
     local_z_lengths,
@@ -99,21 +138,14 @@ def resolve_default_exchange(
     P = int(s.size)
     if P <= 1:
         return ExchangeType.BUFFERED
-    vols = discipline_volumes(num_sticks_per_shard, local_z_lengths)
-    per_round = round_cost_bytes()
-    rounds = {
-        ExchangeType.BUFFERED: 1,
-        ExchangeType.COMPACT_BUFFERED: P - 1,
-        ExchangeType.UNBUFFERED: 1 if one_shot_supported else P - 1,
-    }
-    if not one_shot_supported:
-        # The chain transport ships per-step-maxima buffers, not the exact
-        # Alltoallw volume — cost what actually rides the wire (ragged.py
-        # OneShotExchange falls back to the same _chain_step_sizes rule).
-        vols[ExchangeType.UNBUFFERED] = vols[ExchangeType.COMPACT_BUFFERED]
     costs = {
-        d: vols[d] * 2 * wire_scalar_bytes + rounds[d] * per_round
-        for d in vols
+        d: row["cost_bytes"]
+        for d, row in alternative_costs(
+            num_sticks_per_shard,
+            local_z_lengths,
+            one_shot_supported=one_shot_supported,
+            wire_scalar_bytes=wire_scalar_bytes,
+        ).items()
     }
     # Deterministic tie-break: the fused single collective is the ICI-native
     # shape; then the one-shot exact exchange — unless its transport would be

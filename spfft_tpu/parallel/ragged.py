@@ -361,13 +361,12 @@ def _ragged_a2a_supported(mesh) -> bool:
                 x, z, off, one, off, one, axis_name=names
             )
 
+        from .mesh import shard_mapper
+
         spec = PartitionSpec(names)
         try:
             jax.jit(
-                jax.shard_map(
-                    probe, mesh=mesh, in_specs=spec, out_specs=spec,
-                    check_vma=False,
-                )
+                shard_mapper(mesh)(probe, in_specs=spec, out_specs=spec)
             ).lower(jax.ShapeDtypeStruct((P * P,), np.float32)).compile()
             _RAGGED_A2A_PROBE_CACHE[key] = True
         except Exception:

@@ -20,6 +20,13 @@ median, quartiles, min, max, percentage of the top-level total and of the parent
 (reference: src/timing/rt_graph.hpp:44-56), printable or exportable as JSON in the
 shape the reference benchmark embeds in its report
 (reference: tests/programs/benchmark.cpp:283-289).
+
+This is layer 1 of the three observability layers (docs/details.md
+"Observability"): the timing tree measures what the host *paid*;
+:mod:`spfft_tpu.obs` records what the plan *decided* (plan cards) and counts
+what ran (run-metrics registry, gated by ``SPFFT_TPU_METRICS`` with the same
+shared-no-op pattern as :func:`enable`/:func:`disable` here); ``jax.profiler``
+traces show what the device *executed*, stage-tagged via ``obs.STAGES``.
 """
 from __future__ import annotations
 

@@ -12,7 +12,7 @@ import numpy as np
 
 import jax
 
-from . import timing
+from . import obs, timing
 from .errors import InvalidParameterError
 from .execution import LocalExecution, as_pair, from_pair
 from .sync import fence
@@ -172,10 +172,13 @@ class Transform:
         # Timing scopes mirror the reference's top-level "backward" plus the
         # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
         # stage-level attribution lives in profiler traces — see timing module doc).
+        obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
         with timing.scoped("backward"):
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"):
+                with timing.scoped("wait"), obs.phase_timer(
+                    "wait_seconds", direction="backward"
+                ):
                     fence(out)
             with timing.scoped("output staging"):
                 return self._finalize_backward(out)
@@ -196,7 +199,9 @@ class Transform:
         with timing.scoped("input staging"):
             re, im = as_pair(values, self._real_dtype)
             re, im = self._exec.put(re), self._exec.put(im)
-        with timing.scoped("dispatch"):
+        with timing.scoped("dispatch"), obs.phase_timer(
+            "dispatch_seconds", direction="backward"
+        ):
             # staged copies are dead after the call: donate them so XLA reuses
             # the allocations for pipeline temporaries
             out = self._exec.backward_pair_consuming(re, im)
@@ -233,10 +238,13 @@ class Transform:
 
         if input_location is not None:
             _validate_data_location(input_location)
+        obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
         with timing.scoped("forward"):
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"):
+                with timing.scoped("wait"), obs.phase_timer(
+                    "wait_seconds", direction="forward"
+                ):
                     fence(pair)
             with timing.scoped("output staging"):
                 return self._finalize_forward(pair)
@@ -271,7 +279,9 @@ class Transform:
                     re, im = as_pair(space, self._real_dtype)
                     re, im = self._exec.put(re), self._exec.put(im)
                     self._space_data = (re, im)
-        with timing.scoped("dispatch"):
+        with timing.scoped("dispatch"), obs.phase_timer(
+            "dispatch_seconds", direction="forward"
+        ):
             return self._exec.forward_pair(re, im, ScalingType(scaling))
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
@@ -352,6 +362,16 @@ class Transform:
             precision=self._precision,
             device=self._device,
         )
+
+    # ---- introspection --------------------------------------------------------
+
+    def report(self, *, include_compiled: bool = False) -> dict:
+        """Plan card: the machine-readable record of this plan's decisions
+        (grid geometry, sparsity, engine, the engine's measured choices).
+        ``include_compiled=True`` additionally lowers and compiles the backward
+        pipeline and adds compile wall time, memory analysis and HLO op-class
+        counts. See :mod:`spfft_tpu.obs`."""
+        return obs.plan_card(self, include_compiled=include_compiled)
 
     # ---- accessors, parity with include/spfft/transform.hpp:147-245 -----------
 

@@ -12,7 +12,16 @@ rather than env vars.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.4.38: same knob spelled as an XLA flag
+    import os
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 # Double precision is the reference's default precision; tests compare against the
 # dense oracle at the reference's 1e-6 bar (tests/test_util/test_check_values.hpp:46-78).
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,378 @@
+"""spfft_tpu.obs: run-metrics registry and plan cards.
+
+Three contract layers (ISSUE 1 acceptance):
+
+* registry — no-op-when-disabled (shared singletons, zero per-call
+  allocation on the hot path), snapshot schema stability (JSON round-trip +
+  validate_snapshot), Prometheus rendering;
+* plan cards — schema-complete across local/distributed, XLA/MXU, all three
+  SPMD exchange disciplines and the 2-D pencil decomposition, with the
+  rejected-alternative costs matching ``parallel/policy.py``'s accounting
+  exactly (card and resolver read the same table, so a mismatch here means
+  the card lies about what the policy weighed);
+* surfaces — ``programs/report.py`` emits a document that passes
+  ``obs.validate_report``.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    obs,
+)
+from spfft_tpu.obs.plancard import base_discipline
+from spfft_tpu.parallel.policy import alternative_costs, round_cost_bytes
+from spfft_tpu.parameters import distribute_triplets
+from spfft_tpu.types import wire_scalar_bytes
+from utils import random_sparse_triplets, split_values
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Each test sees an empty, enabled registry and leaves it that way."""
+    obs.clear()
+    obs.enable()
+    yield
+    obs.clear()
+    obs.enable()
+
+
+# ---- registry ----------------------------------------------------------------
+
+
+def test_disabled_instruments_are_shared_noops():
+    obs.disable()
+    try:
+        assert not obs.is_enabled()
+        # zero-allocation contract: every disabled instrument is THE shared
+        # singleton, regardless of name/labels, and records nothing
+        c1 = obs.counter("a_total")
+        c2 = obs.counter("b_total", direction="backward")
+        g = obs.gauge("c")
+        h = obs.histogram("d_seconds")
+        assert c1 is c2 is g is h
+        assert obs.phase_timer("d_seconds") is obs.phase_timer("e_seconds")
+        c1.inc(5)
+        g.set(2.0)
+        h.observe(0.1)
+        with obs.phase_timer("d_seconds"):
+            pass
+        snap = obs.snapshot()
+        assert snap["counters"] == {}
+        assert snap["gauges"] == {}
+        assert snap["histograms"] == {}
+        assert snap["enabled"] is False
+    finally:
+        obs.enable()
+
+
+def test_disabled_transform_path_records_nothing():
+    obs.disable()
+    try:
+        trip = random_sparse_triplets(np.random.default_rng(0), 8, 8, 8, 0.5)
+        t = Transform(
+            ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip
+        )
+        values = np.arange(len(trip)).astype(np.complex128)
+        t.backward(values)
+        t.forward(scaling=ScalingType.FULL)
+        snap = obs.snapshot()
+        assert snap["counters"] == {} and snap["histograms"] == {}
+    finally:
+        obs.enable()
+
+
+def test_metrics_env_knob_disables_at_import():
+    """SPFFT_TPU_METRICS=0 gates the registry at import, before any user
+    code runs (the compile-time analogue of the reference's SPFFT_TIMING)."""
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from spfft_tpu import obs\n"
+            "assert not obs.is_enabled()\n"
+            "assert obs.counter('a') is obs.counter('b', x='y')\n"
+            "obs.counter('a').inc()\n"
+            "assert obs.snapshot()['counters'] == {}\n"
+            "print('ok')\n",
+        ],
+        env={**os.environ, "SPFFT_TPU_METRICS": "0", "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert "ok" in r.stdout
+
+
+def test_snapshot_schema_and_json_roundtrip():
+    obs.counter("transforms_total", direction="backward", engine="xla").inc()
+    obs.gauge("capacity").set(3.5)
+    h = obs.histogram("wait_seconds", direction="backward")
+    for v in (1e-6, 5e-4, 2.0, 100.0):
+        h.observe(v)
+    snap = obs.snapshot()
+    # schema stability: exactly these top-level keys, tagged schema id
+    assert sorted(snap) == ["counters", "enabled", "gauges", "histograms", "schema"]
+    assert snap["schema"] == obs.SNAPSHOT_SCHEMA == "spfft_tpu.obs.snapshot/1"
+    assert obs.validate_snapshot(snap) == []
+    assert json.loads(json.dumps(snap)) == snap
+    key = 'transforms_total{direction="backward",engine="xla"}'
+    assert snap["counters"][key] == 1
+    hist = snap["histograms"]['wait_seconds{direction="backward"}']
+    assert hist["count"] == 4
+    assert hist["min"] == 1e-6 and hist["max"] == 100.0
+    # cumulative buckets end at the total count under +Inf
+    assert hist["buckets"]["+Inf"] == 4
+    assert obs.validate_snapshot({"schema": "bogus/9"})  # flags unknown schema
+
+
+def test_prometheus_text_renders_all_kinds():
+    obs.counter("transforms_total", engine="xla").inc(3)
+    obs.gauge("capacity").set(1.0)
+    obs.histogram("wait_seconds").observe(0.5)
+    text = obs.prometheus_text()
+    assert "# TYPE spfft_tpu_transforms_total counter" in text
+    assert 'spfft_tpu_transforms_total{engine="xla"} 3' in text
+    assert "# TYPE spfft_tpu_wait_seconds histogram" in text
+    assert 'spfft_tpu_wait_seconds_bucket{le="+Inf"} 1' in text
+    assert "spfft_tpu_wait_seconds_count 1" in text
+    # one TYPE line per metric name even with several label sets
+    obs.counter("transforms_total", engine="mxu").inc()
+    text = obs.prometheus_text()
+    assert text.count("# TYPE spfft_tpu_transforms_total counter") == 1
+
+
+def test_phase_timer_records_duration():
+    with obs.phase_timer("dispatch_seconds", direction="forward"):
+        pass
+    snap = obs.snapshot()
+    hist = snap["histograms"]['dispatch_seconds{direction="forward"}']
+    assert hist["count"] == 1 and hist["sum"] >= 0.0
+
+
+# ---- run counters through the public API ------------------------------------
+
+
+def test_local_transform_records_counters():
+    trip = random_sparse_triplets(np.random.default_rng(1), 8, 8, 8, 0.5)
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip)
+    values = np.arange(len(trip)).astype(np.complex128)
+    t.backward(values)
+    t.forward(scaling=ScalingType.FULL)
+    snap = obs.snapshot()
+    assert (
+        snap["counters"]['transforms_total{direction="backward",engine="xla"}'] == 1
+    )
+    assert (
+        snap["counters"]['transforms_total{direction="forward",engine="xla"}'] == 1
+    )
+    staged = [k for k in snap["counters"] if k.startswith("staged_bytes_total")]
+    assert staged and all(snap["counters"][k] > 0 for k in staged)
+    assert (
+        snap["histograms"]['wait_seconds{direction="backward"}']["count"] == 1
+    )
+    assert (
+        snap["histograms"]['dispatch_seconds{direction="forward"}']["count"] == 1
+    )
+
+
+def test_distributed_transform_records_wire_bytes():
+    rng = np.random.default_rng(2)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.5)
+    per_shard = distribute_triplets(trip, 4, 8)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, per_shard,
+        mesh=sp.make_fft_mesh(4),
+    )
+    vps = split_values(per_shard, trip, values)
+    t.backward(vps)
+    t.forward(scaling=ScalingType.FULL)
+    snap = obs.snapshot()
+    key = 'exchange_wire_bytes_total{engine="xla"}'
+    # one repartition per direction, both accounted at the plan's wire volume
+    assert snap["counters"][key] == 2 * t.exchange_wire_bytes()
+
+
+# ---- plan cards --------------------------------------------------------------
+
+
+def _local_plan(engine, dim=8):
+    trip = random_sparse_triplets(np.random.default_rng(3), dim, dim, dim, 0.5)
+    return Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dim, dim, dim,
+        indices=trip, engine=engine,
+    )
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_local_plan_card(engine):
+    card = _local_plan(engine).report()
+    assert obs.validate_plan_card(card) == []
+    assert card["kind"] == "local"
+    assert card["engine"] == engine
+    assert card["dims"] == [8, 8, 8]
+    assert 0 < card["nnz_fraction"] <= 1
+    assert json.loads(json.dumps(card)) == card
+    if engine == "mxu":
+        # the MXU engine's measured decisions ride in the card
+        assert card["execution"]["sparse_y"]["variant"] in (
+            "per-slot", "blocked", "dense"
+        )
+        assert "crossover_sy_over_y" in card["execution"]["sparse_y"]
+
+
+def test_local_plan_card_compiled_stats():
+    card = _local_plan("xla").report(include_compiled=True)
+    assert obs.validate_plan_card(card) == []
+    compiled = card["compiled"]
+    assert compiled["compile_seconds"] > 0
+    assert isinstance(compiled["hlo_op_classes"], dict) and compiled["hlo_op_classes"]
+    assert isinstance(compiled["element_granular_ops"], int)
+    assert json.loads(json.dumps(card)) == card
+
+
+def _distributed_plan(exchange, engine="mxu", shards=4):
+    rng = np.random.default_rng(4)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.6)
+    per_shard = distribute_triplets(trip, shards, 8)
+    return DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, per_shard,
+        mesh=sp.make_fft_mesh(shards), exchange_type=exchange, engine=engine,
+    )
+
+
+_DISCIPLINES = [
+    ExchangeType.BUFFERED,
+    ExchangeType.COMPACT_BUFFERED,
+    ExchangeType.UNBUFFERED,
+]
+
+
+@pytest.mark.parametrize("exchange", _DISCIPLINES + [ExchangeType.DEFAULT])
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_distributed_plan_card_matches_policy_accounting(exchange, engine):
+    """The card's exchange_policy table IS policy.py's accounting — chosen
+    and rejected alternatives carry the same bytes/rounds/cost the DEFAULT
+    resolver weighs for this geometry (ISSUE 1 acceptance)."""
+    t = _distributed_plan(exchange, engine)
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    assert card["kind"] == "distributed"
+    assert card["decomposition"] == "slab"
+    assert card["num_shards"] == 4
+    assert json.loads(json.dumps(card)) == card
+
+    # the active exchange section reflects the plan's real accounting
+    assert card["exchange"]["wire_bytes"] == t.exchange_wire_bytes()
+    assert card["exchange"]["rounds"] == t.exchange_rounds()
+    assert card["exchange"]["transport"] in (
+        "all_to_all", "ragged_all_to_all", "one-shot chain", "ppermute chain"
+    )
+
+    policy = card["exchange_policy"]
+    assert policy["round_cost_bytes"] == round_cost_bytes()
+    p = t._params
+    table = alternative_costs(
+        p.num_sticks_per_shard,
+        p.local_z_lengths,
+        one_shot_supported=policy["one_shot_supported"],
+        wire_scalar_bytes=wire_scalar_bytes(t.exchange_type, t.dtype),
+    )
+    assert len(policy["alternatives"]) == len(table) == 3
+    chosen_rows = 0
+    for alt in policy["alternatives"]:
+        row = table[ExchangeType[alt["discipline"]]]
+        assert alt["wire_bytes"] == row["wire_bytes"]
+        assert alt["rounds"] == row["rounds"]
+        assert alt["cost_bytes"] == row["cost_bytes"]
+        chosen_rows += alt["chosen"]
+    assert chosen_rows == 1
+    (chosen_alt,) = [a for a in policy["alternatives"] if a["chosen"]]
+    assert chosen_alt["discipline"] == base_discipline(t.exchange_type).name
+    rejected = [a for a in policy["alternatives"] if not a["chosen"]]
+    assert len(rejected) == 2  # >= 1 rejected alternative with full accounting
+    if exchange == ExchangeType.DEFAULT:
+        # the resolver picked the cheapest row of this very table
+        assert chosen_alt["cost_bytes"] == min(
+            a["cost_bytes"] for a in policy["alternatives"]
+        )
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_pencil_plan_card_carries_policy_table(engine):
+    """DEFAULT pencil plans stash the cost table the in-engine resolver
+    weighed (pencil2._resolve_pencil2_default), chosen marked, alternatives
+    priced per the same wire-bytes + round-cost model."""
+    rng = np.random.default_rng(5)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.6)
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, trip,
+        mesh=sp.make_fft_mesh2(2, 2), engine=engine,
+    )
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    assert card["decomposition"] == "pencil2"
+    assert card["mesh"] == {"fft": 2, "fft2": 2}
+    assert json.loads(json.dumps(card)) == card
+    policy = card["exchange_policy"]
+    assert policy["round_cost_bytes"] == round_cost_bytes()
+    assert policy["chosen"] == t.exchange_type.name
+    assert len(policy["alternatives"]) == 3
+    (chosen_alt,) = [a for a in policy["alternatives"] if a["chosen"]]
+    # the resolver minimizes cost_bytes over exactly this table
+    assert chosen_alt["cost_bytes"] == min(
+        a["cost_bytes"] for a in policy["alternatives"]
+    )
+    assert [a for a in policy["alternatives"] if not a["chosen"]]
+    # an explicit discipline skips the resolver: no policy table, still valid
+    t2 = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, trip,
+        mesh=sp.make_fft_mesh2(2, 2), engine=engine,
+        exchange_type=ExchangeType.BUFFERED,
+    )
+    card2 = t2.report()
+    assert obs.validate_plan_card(card2) == []
+    assert "exchange_policy" not in card2
+
+
+def test_grid_report():
+    g = sp.Grid(8, 8, 8, 64, ProcessingUnit.HOST)
+    card = g.report()
+    assert card["kind"] == "grid"
+    assert card["max_dims"] == [8, 8, 8]
+    assert card["num_shards"] == 1
+    assert json.loads(json.dumps(card)) == card
+
+
+# ---- report CLI surface ------------------------------------------------------
+
+
+def test_report_cli_emits_valid_document(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "report", Path(__file__).resolve().parent.parent / "programs" / "report.py"
+    )
+    report_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report_mod)
+    out = tmp_path / "report.json"
+    rc = report_mod.main(
+        ["-d", "8", "8", "8", "--no-compiled", "-o", str(out)]
+    )
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert obs.validate_report(doc) == []
+    assert doc["plan"]["dims"] == [8, 8, 8]
+    assert any(
+        k.startswith("transforms_total") for k in doc["metrics"]["counters"]
+    )

@@ -6,11 +6,11 @@ Round-4 on-chip finding (ROADMAP 8b): the pencil exchanges' pack/unpack ran as
 the CPU mesh where pocketfft costs dominate, so every oracle test stayed green.
 These tests make the regression visible off-chip: they lower the compiled MXU
 pencil pipelines to StableHLO and assert no gather/scatter moves data
-element-by-element. Reference pack/unpack being matched:
-src/transpose/transpose_mpi_compact_buffered_host.cpp:109-175.
+element-by-element. The detector itself lives in ``spfft_tpu.obs.hlo`` (it
+was promoted into library code so plan cards report the same
+``element_granular_ops`` signal these tests assert on). Reference pack/unpack
+being matched: src/transpose/transpose_mpi_compact_buffered_host.cpp:109-175.
 """
-import re
-
 import numpy as np
 import pytest
 
@@ -22,46 +22,9 @@ from spfft_tpu import (
     ScalingType,
     TransformType,
 )
+from spfft_tpu.obs.hlo import element_granular_ops as _element_granular_ops
 from spfft_tpu.parameters import distribute_triplets
 from utils import random_sparse_triplets, split_values
-
-# metadata lookups (branch tables, shard geometry) legitimately gather single
-# elements out of tiny operands; data arrays are far larger
-_METADATA_ELEMS = 4096
-
-
-def _operand_elems(shape_str: str) -> int:
-    """Element count of a StableHLO tensor type like 'tensor<16385xf32>'."""
-    dims = re.findall(r"(\d+)x", shape_str)
-    n = 1
-    for d in dims:
-        n *= int(d)
-    return n
-
-
-def _element_granular_ops(hlo: str):
-    """(op, operand, detail) rows for every gather/scatter that moves single
-    elements out of/into a non-metadata operand."""
-    bad = []
-    # gathers: slice_sizes all-1 means one element per index row
-    for m in re.finditer(
-        r'"stablehlo\.gather"[^\n]*?slice_sizes\s*=\s*array<i64([^>]*)>[^\n]*?:\s*\(tensor<([^>]+)>',
-        hlo,
-    ):
-        sizes = [int(x) for x in re.findall(r"-?\d+", m.group(1))]
-        if sizes and all(s == 1 for s in sizes):
-            if _operand_elems(m.group(2)) > _METADATA_ELEMS:
-                bad.append(("gather", m.group(2), sizes))
-    # scatters: no update_window_dims (StableHLO omits the attribute when
-    # empty) means element updates
-    for m in re.finditer(
-        r'"stablehlo\.scatter"\(.*?\}\)\s*:\s*\(tensor<([^>]+)>', hlo, re.DOTALL
-    ):
-        mw = re.search(r"update_window_dims = \[([^\]]*)\]", m.group(0))
-        window = re.findall(r"\d+", mw.group(1)) if mw else []
-        if not window and _operand_elems(m.group(1)) > _METADATA_ELEMS:
-            bad.append(("scatter", m.group(1), []))
-    return bad
 
 
 def _lowered_texts(p1, p2, exchange):
@@ -89,10 +52,10 @@ def _lowered_texts(p1, p2, exchange):
     pair = ex.pad_values(vps)
     texts = [ex._backward.lower(*pair, ex._value_indices).as_text()]
     # lowering only (no execution): the one-shot ragged transport lowers on
-    # every backend but compiles only where the HLO is implemented
-    out_shapes = jax.eval_shape(
-        ex._backward_sm, *(jax.typeof(x) for x in (*pair, ex._value_indices))
-    )
+    # every backend but compiles only where the HLO is implemented.
+    # eval_shape over the concrete arrays (jax.typeof is newer than the
+    # oldest supported runtime; only shape/dtype are consumed anyway)
+    out_shapes = jax.eval_shape(ex._backward_sm, *pair, ex._value_indices)
     texts.append(
         ex._forward[ScalingType.FULL]
         .lower(out_shapes[0], out_shapes[1], ex._value_indices)
@@ -114,6 +77,7 @@ def test_mxu_pencil_pipelines_have_no_element_scatters(
     p1, p2, exchange, monkeypatch
 ):
     if exchange == ExchangeType.UNBUFFERED:
+        _require_ragged_a2a()
         # force the one-shot transport (the CPU probe would fall back to the
         # chain and hide OneShotBlockExchange from the guard)
         monkeypatch.setenv("SPFFT_TPU_ONESHOT_TRANSPORT", "ragged")
@@ -123,6 +87,15 @@ def test_mxu_pencil_pipelines_have_no_element_scatters(
             "element-granular data movement in the compiled pencil pipeline "
             f"({exchange}; the round-4/5 on-chip pathology, ROADMAP 8b): {bad}"
         )
+
+
+def _require_ragged_a2a():
+    """Skip when the runtime predates the ragged-all-to-all HLO binding —
+    forcing the one-shot transport cannot even lower there."""
+    import jax
+
+    if not hasattr(jax.lax, "ragged_all_to_all"):
+        pytest.skip("jax.lax.ragged_all_to_all not available on this runtime")
 
 
 def _lowered_1d_texts(exchange, monkeypatch):
@@ -151,9 +124,7 @@ def _lowered_1d_texts(exchange, monkeypatch):
     pair = ex.pad_values(vps)
     phase = ex._phase_args()
     texts = [ex._backward.lower(*pair, *phase).as_text()]
-    out_shapes = jax.eval_shape(
-        ex._backward_sm, *(jax.typeof(x) for x in (*pair, *phase))
-    )
+    out_shapes = jax.eval_shape(ex._backward_sm, *pair, *phase)
     texts.append(
         ex._forward[ScalingType.FULL]
         .lower(out_shapes[0], out_shapes[1], *phase)
@@ -171,6 +142,8 @@ def test_mxu_1d_ragged_pipelines_have_no_element_scatters(exchange, monkeypatch)
     fixed for the pencil exchanges this round (pod-relevant: single-chip
     P=1 plans specialize the exchange away, so only this lowering check sees
     it off-pod)."""
+    if exchange == ExchangeType.UNBUFFERED:
+        _require_ragged_a2a()
     for hlo in _lowered_1d_texts(exchange, monkeypatch):
         bad = _element_granular_ops(hlo)
         assert not bad, (

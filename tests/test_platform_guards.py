@@ -11,6 +11,8 @@ import sys
 import time
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 
@@ -80,11 +82,19 @@ def test_cpu_devices_rebuilds_on_virtual_count_change():
         "import jax\n"
         "from spfft_tpu._platform import cpu_devices\n"
         "assert len(cpu_devices()) >= 1\n"
-        "jax.config.update('jax_num_cpu_devices', 6)\n"
+        "try:\n"
+        "    jax.config.update('jax_num_cpu_devices', 6)\n"
+        "except AttributeError:\n"
+        # jax < 0.4.38 has no late-rebind knob (XLA_FLAGS at client creation
+        # is the only control there) — nothing to guard
+        "    print('skip: no jax_num_cpu_devices on this runtime')\n"
+        "    raise SystemExit(0)\n"
         "assert len(cpu_devices()) == 6, cpu_devices()\n"
         "print('ok')\n",
         # non-cpu-only platform config forces the private-client path
         env_extra={"JAX_PLATFORMS": ""},
     )
     assert r.returncode == 0, r.stderr[-800:]
+    if "skip:" in r.stdout:
+        pytest.skip("jax_num_cpu_devices not available on this runtime")
     assert "ok" in r.stdout
