@@ -173,11 +173,18 @@ def main():
         dense_time = min(dense_time, time.perf_counter() - t0)
 
     # decision provenance: the plan card rides in every BENCH_*.json so a
-    # perf diff across rounds always shows WHAT the plan chose (spfft_tpu.obs)
+    # perf diff across rounds always shows WHAT the plan chose (spfft_tpu.obs),
+    # and the wisdom state records HOW it was decided (spfft_tpu.tuning:
+    # policy, model-vs-wisdom provenance, store path, hit/miss) so the number
+    # is reproducible against the same tuning inputs
     try:
         plan_card = sp.obs.plan_card(t)
     except Exception as e:  # a card bug must never cost a bench capture
         plan_card = {"error": str(e).split("\n")[0]}
+    try:
+        wisdom = sp.tuning.wisdom_state(t)
+    except Exception as e:
+        wisdom = {"error": str(e).split("\n")[0]}
 
     print(
         json.dumps(
@@ -187,6 +194,7 @@ def main():
                 "unit": "GFLOP/s",
                 "vs_baseline": round(dense_time / best, 3),
                 "plan": plan_card,
+                "wisdom": wisdom,
             }
         )
     )

@@ -11,6 +11,7 @@
 #          ./ci.sh lint       # import hygiene + env-knob docs + stage scopes
 #          ./ci.sh python     # Python suite only
 #          ./ci.sh report     # plan-card CLI + JSON schema validation only
+#          ./ci.sh tune       # autotuner smoke (trial + wisdom hit, CPU)
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -50,6 +51,35 @@ print(f"report schema ok ({len(doc['plan'])} plan keys, "
 EOF
 }
 
+run_tune() {
+  echo "== Tune smoke (programs/tune.py: trials then wisdom hit, CPU) =="
+  # Tiny grid, 1-repeat trial budget, tmpdir wisdom file, CPU trials allowed
+  # (SPFFT_TPU_TUNE_CPU via --allow-cpu-trials). Run twice: the first run
+  # must measure, the second must hit wisdom with ZERO trials — the whole
+  # tuned-policy loop exercised without accelerator hardware.
+  local wdir
+  wdir="$(mktemp -d)"
+  JAX_PLATFORMS=cpu SPFFT_TPU_WISDOM="$wdir/wisdom.json" timeout 540 \
+    python programs/tune.py -d 8 8 8 --shards 2 -s 0.6 --repeats 1 \
+    --allow-cpu-trials -o "$wdir/tune1.json" > /dev/null
+  JAX_PLATFORMS=cpu SPFFT_TPU_WISDOM="$wdir/wisdom.json" timeout 540 \
+    python programs/tune.py -d 8 8 8 --shards 2 -s 0.6 --repeats 1 \
+    --allow-cpu-trials -o "$wdir/tune2.json" > /dev/null
+  python - "$wdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+t1 = json.load(open(f"{d}/tune1.json"))["tuning"]
+t2 = json.load(open(f"{d}/tune2.json"))["tuning"]
+assert t1["hit"] is False and t1["trials"], t1
+assert t2["hit"] is True and t2["provenance"] == "wisdom", t2
+assert t2["choice"] == t1["choice"], (t1["choice"], t2["choice"])
+print(f"tune smoke ok: {t1['choice']} ({len(t1['trials'])} trials, "
+      "0 on the second construction)")
+EOF
+  rm -rf "$wdir"
+}
+
 run_dryrun() {
   echo "== Multichip dryrun (8-device CPU mesh, CPU forced) =="
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
@@ -73,18 +103,20 @@ case "$stage" in
   lint) run_lint ;;
   python) run_python ;;
   report) run_report ;;
+  tune) run_tune ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
     run_lint
     run_python
     run_report
+    run_tune
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -327,12 +327,17 @@ def main(argv=None):
         n_total = dim_x * dim_y * dim_z
         # Standard 5 N log2(N) flop model per 3D transform; x2 for fwd+bwd pair.
         flops = 2 * 5.0 * n_total * np.log2(n_total)
+        from spfft_tpu.tuning import wisdom_state
+
         out = {
             "wall_s_total": elapsed,
             "wall_s_per_transform_pair": pair_seconds,
             "gflops_per_pair": flops / pair_seconds / 1e9,
-            # decision provenance: what this plan chose (spfft_tpu.obs)
+            # decision provenance: what this plan chose (spfft_tpu.obs) and
+            # how — policy, model-vs-wisdom, store path, hit/miss
+            # (spfft_tpu.tuning) — so numbers are reproducible
             "plan": transforms[0].report(),
+            "wisdom": wisdom_state(transforms[0]),
         }
         if args.shards > 1:
             # off-shard interconnect bytes per repartition under this discipline

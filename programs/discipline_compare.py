@@ -16,9 +16,19 @@ transport. Run on a real pod slice for decision-grade timings.
 Usage:
     python programs/discipline_compare.py [--shards 8 16 32] [--dim 64]
         [--sparsity 0.3] [--imbalance 0.0] [--repeats 20] [--json out.json]
+        [--policy {default,tuned}]
 
 ``--imbalance w`` skews the per-shard stick weights linearly from 1 to 1+w,
 exercising the regime where exact-counts disciplines win on bytes.
+
+``--policy`` A/Bs the DEFAULT resolvers against the explicit disciplines: a
+fourth row per shard count measures the plan a bare ``ExchangeType.DEFAULT``
+produces under the selected policy — ``default`` (the analytic cost model,
+parallel/policy.py) or ``tuned`` (the empirical autotuner, spfft_tpu.tuning;
+CPU trials are auto-allowed here since this program measures on the virtual
+CPU mesh anyway). The row records which discipline the policy resolved to and
+its decision provenance, so model picks and wisdom picks can be compared
+against the exhaustive sweep they should have matched.
 """
 from __future__ import annotations
 
@@ -39,6 +49,10 @@ def main(argv=None):
     ap.add_argument("--imbalance", type=float, default=0.0)
     ap.add_argument("--repeats", type=int, default=20)
     ap.add_argument("--engine", default="mxu", choices=["xla", "mxu"])
+    ap.add_argument(
+        "--policy", default="default", choices=["default", "tuned"],
+        help="resolver measured for the extra DEFAULT row (see module doc)",
+    )
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
@@ -77,7 +91,15 @@ def main(argv=None):
         ("BUFFERED", ExchangeType.BUFFERED),
         ("COMPACT", ExchangeType.COMPACT_BUFFERED),
         ("UNBUFFERED", ExchangeType.UNBUFFERED),
+        # the A/B row: what a bare DEFAULT resolves to under --policy
+        (f"DEFAULT:{args.policy}", ExchangeType.DEFAULT),
     ]
+    if args.policy == "tuned":
+        # this program already measures on the (virtual CPU) mesh, so CPU
+        # trials cannot poison accelerator wisdom any more than the sweep does
+        import os
+
+        os.environ.setdefault("SPFFT_TPU_TUNE_CPU", "1")
     rows = []
     for P in args.shards:
         weights = 1.0 + args.imbalance * np.arange(P) / max(1, P - 1)
@@ -100,6 +122,9 @@ def main(argv=None):
                 dtype=np.float32,
                 engine=args.engine,
                 exchange_type=exchange,
+                # only the DEFAULT row resolves through a policy; explicit
+                # disciplines are never overridden by either resolver
+                policy=args.policy,
             )
             ex = t._exec
             pair = ex.pad_values(vps)
@@ -126,10 +151,21 @@ def main(argv=None):
                 }
             )
             r = rows[-1]
+            if exchange == ExchangeType.DEFAULT:
+                rec = t._tuning
+                r["resolved"] = t.exchange_type.name
+                r["provenance"] = rec["provenance"] if rec else "model"
+                if rec:
+                    r["wisdom_hit"] = rec["hit"]
             print(
-                f"P={P:3d} {name:10s} bytes={r['wire_bytes']:>12,} "
+                f"P={P:3d} {name:16s} bytes={r['wire_bytes']:>12,} "
                 f"rounds={r['rounds']:3d} {r['ms_per_pair']:8.2f} ms/pair"
                 + (f" (transport={transport})" if transport else "")
+                + (
+                    f" -> {r['resolved']} [{r['provenance']}]"
+                    if "resolved" in r
+                    else ""
+                )
             )
     if args.json:
         Path(args.json).write_text(json.dumps(

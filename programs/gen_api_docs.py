@@ -260,6 +260,7 @@ def index_page() -> str:
         - [Distributed transform](distributed.md)
         - [Multi-transforms](multi_transform.md)
         - [Index helpers and mesh utilities](utilities.md)
+        - [Autotuning and wisdom](tuning.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -273,7 +274,7 @@ def index_page() -> str:
 
 def generate(outdir: Path) -> None:
     import spfft_tpu as sp
-    from spfft_tpu import timing
+    from spfft_tpu import timing, tuning
     from spfft_tpu.parallel import mesh
 
     outdir.mkdir(parents=True, exist_ok=True)
@@ -320,6 +321,20 @@ def generate(outdir: Path) -> None:
                 mesh.ensure_virtual_devices,
                 timing.enable,
                 timing.scoped,
+            ],
+        ),
+        "tuning.md": class_page(
+            "Tuning",
+            doc(tuning),
+            [tuning.WisdomStore],
+            [
+                tuning.tuned_exchange,
+                tuning.tuned_local,
+                tuning.exchange_candidates,
+                tuning.local_candidates,
+                tuning.wisdom_state,
+                tuning.active_store,
+                tuning.clear_memory,
             ],
         ),
         "c_api.md": c_api_page(),

@@ -35,6 +35,8 @@ INTERNAL_KNOBS = {
     "SPFFT_TPU_DRYRUN_BUDGET_S",
     "SPFFT_TPU_MEASURE_INIT_BUDGET_S",
     "SPFFT_TPU_NATIVE_TEST_BUDGET_S",
+    "SPFFT_TPU_FUZZ_SEED",  # test-only: parity-fuzz seed offset (documented
+    # where it is read, tests/test_engine_parity_fuzz.py)
 }
 
 
@@ -185,6 +187,10 @@ ENGINE_FILES = (
     "spfft_tpu/parallel/pencil2.py",
     "spfft_tpu/parallel/pencil2_mxu.py",
 )
+# The autotuner's trial runner labels its phases from the same canonical
+# vocabulary (the "tune warmup"/"tune trial" stages), under the same
+# both-ways rule as the engines.
+TUNING_FILES = ("spfft_tpu/tuning/runner.py",)
 STAGES_FILE = "spfft_tpu/obs/stages.py"
 
 
@@ -206,7 +212,7 @@ def check_stage_scopes(findings: list):
     used: dict = {}  # literal named_scope labels -> first file:line
     strings: set = set()  # every string constant in engine files (covers
     # labels selected dynamically, e.g. _y_stage_scope's variants)
-    for rel in ENGINE_FILES:
+    for rel in ENGINE_FILES + TUNING_FILES:
         path = ROOT / rel
         tree = ast.parse(path.read_text())
         for node in ast.walk(tree):
@@ -230,8 +236,8 @@ def check_stage_scopes(findings: list):
     for stage in stages:
         if stage not in strings:
             findings.append(
-                f"{STAGES_FILE}: stage {stage!r} appears in no engine "
-                f"pipeline ({', '.join(ENGINE_FILES)})"
+                f"{STAGES_FILE}: stage {stage!r} appears in no engine or "
+                f"tuning pipeline ({', '.join(ENGINE_FILES + TUNING_FILES)})"
             )
 
 

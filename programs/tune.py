@@ -1,0 +1,157 @@
+"""Autotune a plan and persist its wisdom — the planner CLI of
+``spfft_tpu.tuning`` (the FFTW ``fftw-wisdom`` tool analogue).
+
+Builds the requested plan under ``policy="tuned"``: wisdom-store hit returns
+the remembered choice with zero trials; a miss measures every candidate
+(exchange disciplines for distributed plans, the engine axis for local ones)
+on the real geometry/mesh/dtype and records the winner in the store named by
+``SPFFT_TPU_WISDOM`` (``--wisdom`` sets it for the run). The JSON report
+carries the tuning record (provenance, hit/miss, per-candidate trial
+timings), the resulting plan card, and the wisdom state — everything a later
+benchmark needs to reproduce the decision.
+
+On CPU-only hosts trials are skipped (the model policy answers) unless
+``--allow-cpu-trials`` / ``SPFFT_TPU_TUNE_CPU=1`` — CPU collective timings
+must never poison wisdom an accelerator plan would read; the override exists
+for CI smoke and tests. ci.sh's ``tune`` stage runs this program twice on a
+tiny grid with a tmp wisdom file and asserts the second run hits.
+
+Usage:
+    python programs/tune.py -d 64 64 64 --shards 4 -o tuned.json
+    python programs/tune.py -d 32 32 32 --mesh2 2 2 --wisdom wisdom.json
+    python programs/tune.py -d 32 32 32 --repeats 3      # local engine axis
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="autotune a plan into wisdom")
+    ap.add_argument("-d", nargs=3, type=int, required=True, metavar=("X", "Y", "Z"))
+    ap.add_argument("-s", type=float, default=0.3, help="nonzero fraction")
+    ap.add_argument("--r2c", action="store_true")
+    ap.add_argument("--shards", type=int, default=1, help="1-D mesh size (1 = local)")
+    ap.add_argument(
+        "--mesh2", nargs=2, type=int, default=None, metavar=("P1", "P2"),
+        help="2-D pencil mesh factors (overrides --shards)",
+    )
+    ap.add_argument("--engine", choices=["auto", "mxu", "xla"], default="auto")
+    ap.add_argument("--dtype", choices=["float32", "float64"], default=None)
+    ap.add_argument("--wisdom", default=None, help="wisdom file (sets SPFFT_TPU_WISDOM)")
+    ap.add_argument("--repeats", type=int, default=None, help="timed repeats per trial")
+    ap.add_argument("--warmup", type=int, default=None, help="warmup roundtrips per trial")
+    ap.add_argument(
+        "--allow-cpu-trials", action="store_true",
+        help="run trials on CPU-only hosts (sets SPFFT_TPU_TUNE_CPU=1; CI/tests)",
+    )
+    ap.add_argument("-o", default=None, help="output JSON path")
+    args = ap.parse_args(argv)
+
+    import os
+
+    from spfft_tpu.tuning import (
+        TUNE_CPU_ENV,
+        TUNE_REPEATS_ENV,
+        TUNE_WARMUP_ENV,
+        WISDOM_ENV,
+        wisdom_state,
+    )
+
+    if args.wisdom:
+        os.environ[WISDOM_ENV] = args.wisdom
+    if args.repeats is not None:
+        os.environ[TUNE_REPEATS_ENV] = str(args.repeats)
+    if args.warmup is not None:
+        os.environ[TUNE_WARMUP_ENV] = str(args.warmup)
+    if args.allow_cpu_trials:
+        os.environ[TUNE_CPU_ENV] = "1"
+
+    if args.mesh2 is not None:
+        args.shards = args.mesh2[0] * args.mesh2[1]
+    if args.shards == 1 and args.engine != "auto":
+        # the local tuner's candidate space IS the engine axis; pinning the
+        # engine leaves nothing to tune (Transform only tunes engine="auto")
+        ap.error("local tuning explores the engine axis; use --engine auto "
+                 "(explicit engines apply to distributed exchange tuning only)")
+    if args.shards > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # virtual CPU mesh bootstrap (same as discipline_compare.py)
+        from spfft_tpu.parallel.mesh import configure_virtual_devices
+
+        configure_virtual_devices(args.shards, warn=True)
+
+    import numpy as np
+    import spfft_tpu as sp
+    from spfft_tpu import obs
+    from spfft_tpu.types import ProcessingUnit, TransformType
+
+    dx, dy, dz = args.d
+    radius = sp.spherical_radius_for_fraction(args.s)
+    trip = sp.create_spherical_cutoff_triplets(
+        dx, dy, dz, min(radius, 1.0), hermitian_symmetry=args.r2c
+    )
+    ttype = TransformType.R2C if args.r2c else TransformType.C2C
+    dtype = np.dtype(args.dtype) if args.dtype else None
+    import jax
+
+    pu = (
+        ProcessingUnit.HOST
+        if jax.devices()[0].platform == "cpu"
+        else ProcessingUnit.GPU
+    )
+    if args.shards > 1:
+        mesh = (
+            sp.make_fft_mesh2(*args.mesh2)
+            if args.mesh2 is not None
+            else sp.make_fft_mesh(args.shards)
+        )
+        plan = sp.DistributedTransform(
+            pu, ttype, dx, dy, dz, trip, mesh=mesh, dtype=dtype,
+            engine=args.engine, policy="tuned",
+        )
+    else:
+        plan = sp.Transform(
+            pu, ttype, dx, dy, dz, indices=trip, dtype=dtype,
+            engine=args.engine, policy="tuned",
+        )
+
+    rec = plan._tuning
+    if rec is None:
+        print("plan was not tuned (the TUNED policy did not engage)", file=sys.stderr)
+        return 1
+    print(
+        f"tune: provenance={rec['provenance']} hit={rec['hit']} "
+        f"choice={rec['choice']} ({rec['reason']})"
+    )
+    for row in rec["trials"]:
+        model = (
+            f"  model_cost={row['model_cost_bytes']:,}B"
+            if "model_cost_bytes" in row
+            else ""
+        )
+        if "ms" in row:
+            print(f"  {row['label']:20s} {row['ms']:9.3f} ms{model}")
+        else:  # isolated trial failure (runner.run_trials error row)
+            print(f"  {row['label']:20s}    FAILED: {row.get('error', '?')}")
+    doc = {
+        "tuning": rec,
+        "wisdom": wisdom_state(plan),
+        "plan": plan.report(),
+    }
+    missing = obs.validate_plan_card(doc["plan"])
+    if missing:
+        print(f"plan card schema incomplete: {missing}", file=sys.stderr)
+        return 1
+    if args.o:
+        Path(args.o).write_text(json.dumps(doc, indent=2))
+        print(f"wrote {args.o}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

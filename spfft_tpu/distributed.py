@@ -46,6 +46,7 @@ class DistributedTransform:
         dtype=None,
         engine: str = "auto",
         precision="highest",
+        policy: str | None = None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -106,37 +107,60 @@ class DistributedTransform:
             dtype = np.float64 if jax.config.read("jax_enable_x64") else np.float32
         self._real_dtype = np.dtype(dtype)
 
-        if ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
+        from .parallel.policy import resolve_policy
+
+        self._policy = resolve_policy(policy)
+        self._tuning = None
+        if (
+            ExchangeType(exchange_type) == ExchangeType.DEFAULT
+            and self._policy == "tuned"
+        ):
+            # TUNED policy (spfft_tpu.tuning): resolve DEFAULT empirically —
+            # wisdom-store hit, else on-device trials of the candidate
+            # disciplines on THIS geometry/mesh/dtype, else the model policy
+            # (CPU-only hosts / corrupt store). Trial plans are this same
+            # constructor with explicit disciplines and the model policy, so
+            # tuning cannot recurse. The record lands on the plan card.
+            from . import tuning
+
+            p = self._params
+
+            def build(cand):
+                return DistributedTransform(
+                    self._processing_unit,
+                    p.transform_type,
+                    p.dim_x,
+                    p.dim_y,
+                    p.dim_z,
+                    [t.copy() for t in indices_per_shard],
+                    mesh=mesh,
+                    local_z_lengths=np.asarray(p.local_z_lengths).copy(),
+                    exchange_type=ExchangeType[cand["exchange_type"]],
+                    dtype=self._real_dtype,
+                    engine=engine,
+                    precision=precision,
+                    policy="default",
+                )
+
+            exchange_type, self._tuning = tuning.tuned_exchange(
+                p, mesh, self._real_dtype, engine, precision, pencil2, build
+            )
+        elif ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
             # Measured auto-policy (parallel/policy.py): pick the discipline
             # from the plan's exact wire volumes + round counts + the
-            # backend's one-shot ragged-a2a support. The reference instead
-            # hardwires DEFAULT = COMPACT_BUFFERED
+            # backend's one-shot ragged-a2a support (probed compile-only,
+            # cached, and only when the answer depends on it). The reference
+            # instead hardwires DEFAULT = COMPACT_BUFFERED
             # (grid_internal.cpp:176-179); ported callers who want that exact
             # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil plans
             # resolve DEFAULT inside the engine (pencil2.py
             # _resolve_pencil2_default — the x-group strategy and the
             # discipline are chosen together there).
-            from .parallel.policy import resolve_default_exchange
+            from .parallel.policy import resolve_default_for_plan
 
-            p = self._params
-            picks = {
-                supported: resolve_default_exchange(
-                    p.num_sticks_per_shard,
-                    p.local_z_lengths,
-                    one_shot_supported=supported,
-                    wire_scalar_bytes=self._real_dtype.itemsize,
-                )
-                for supported in (False, True)
-            }
-            if picks[False] == picks[True] or p.num_shards <= 1:
-                exchange_type = picks[False]
-            else:
-                # Only when the answer depends on it: probe whether the
-                # backend compiles the one-shot ragged-all-to-all (compile-
-                # only, cached per platform/mesh-size — parallel/ragged.py).
-                from .parallel.ragged import _ragged_a2a_supported
-
-                exchange_type = picks[_ragged_a2a_supported(mesh)]
+            exchange_type = resolve_default_for_plan(
+                self._params, mesh, self._real_dtype
+            )
 
         from .ops.fft import resolve_precision
 

@@ -192,6 +192,7 @@ class Grid:
         engine: str = "auto",
         precision: str = "highest",
         device=None,
+        policy: str | None = None,
     ):
         """Create a transform bound to this grid.
 
@@ -222,6 +223,7 @@ class Grid:
                 dtype=dtype,
                 engine=engine,
                 precision=precision,
+                policy=policy,
             )
         from .transform import Transform
 
@@ -239,4 +241,5 @@ class Grid:
             engine=engine,
             precision=precision,
             device=device,
+            policy=policy,
         )

@@ -34,6 +34,7 @@ REQUIRED_KEYS = (
     "nnz_fraction",
     "dtype",
     "precision",
+    "policy",
     "platform",
     "execution",
 )
@@ -47,6 +48,21 @@ COMPILED_KEYS = (
     "element_granular_ops",
     "memory_analysis",
 )
+# TUNED-policy decision provenance (spfft_tpu.tuning._record): wisdom vs
+# model, hit/miss, the winning candidate, the per-candidate trial timings.
+TUNING_KEYS = (
+    "policy",
+    "provenance",
+    "hit",
+    "wisdom_path",
+    "key_digest",
+    "reason",
+    "choice",
+    "trials",
+)
+# a trial row is either measured ("ms") or isolated-failed ("error")
+TRIAL_KEYS = ("label",)
+TRIAL_RESULT_KEYS = ("ms", "error")
 
 
 def base_discipline(exchange_type):
@@ -160,9 +176,16 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         "nnz_fraction": num_elements / float(transform.global_size),
         "dtype": str(transform.dtype),
         "precision": str(transform._precision),
+        # plan-decision policy + TUNED provenance (spfft_tpu.tuning): whether
+        # decisions came from the analytic model or measured wisdom, with the
+        # trial table — the empirical counterpart of exchange_policy below
+        "policy": getattr(transform, "_policy", "default"),
         "platform": _platform_of(transform),
         "execution": ex.describe(),
     }
+    tuning_record = getattr(transform, "_tuning", None)
+    if tuning_record is not None:
+        card["tuning"] = tuning_record
     if distributed:
         p = transform._params
         mesh = transform.mesh
@@ -231,4 +254,17 @@ def validate_plan_card(card: dict) -> list:
         missing.extend(
             f"compiled.{k}" for k in COMPILED_KEYS if k not in card["compiled"]
         )
+    if "tuning" in card:
+        rec = card["tuning"]
+        missing.extend(f"tuning.{k}" for k in TUNING_KEYS if k not in rec)
+        if rec.get("provenance") not in ("wisdom", "model"):
+            missing.append(
+                f"tuning.provenance (unknown: {rec.get('provenance')!r})"
+            )
+        for i, trial in enumerate(rec.get("trials", ())):
+            missing.extend(
+                f"tuning.trials[{i}].{k}" for k in TRIAL_KEYS if k not in trial
+            )
+            if not any(k in trial for k in TRIAL_RESULT_KEYS):
+                missing.append(f"tuning.trials[{i}].ms|error")
     return missing

@@ -43,4 +43,8 @@ STAGES = (
     "pack B",
     "exchange B",
     "unpack B",
+    # autotuner trial phases (spfft_tpu/tuning/runner.py): warmup dispatches
+    # absorbing compilation, then the timed roundtrips wisdom records
+    "tune warmup",
+    "tune trial",
 )

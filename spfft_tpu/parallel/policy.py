@@ -34,6 +34,58 @@ from ..types import ExchangeType
 
 ROUND_COST_ENV = "SPFFT_TPU_EXCH_ROUND_COST_KB"
 
+# ---- plan-decision policies -------------------------------------------------
+#
+# "default": this module's analytic cost model resolves ExchangeType.DEFAULT
+#            and the engines' static auto rules pick everything else.
+# "tuned":   the spfft_tpu.tuning subsystem measures the alternatives on the
+#            caller's real geometry/mesh/dtype and remembers winners in the
+#            persistent wisdom store (SPFFT_TPU_WISDOM) — falling back to
+#            "default" where trials cannot run (see tuning module docstring).
+#
+# Selected per plan via the Transform/DistributedTransform ``policy=``
+# argument, or process-wide via SPFFT_TPU_POLICY.
+POLICY_ENV = "SPFFT_TPU_POLICY"
+POLICIES = ("default", "tuned")
+
+
+def resolve_policy(policy=None) -> str:
+    """The active plan-decision policy: explicit argument, else the
+    ``SPFFT_TPU_POLICY`` env knob, else ``"default"``."""
+    if policy is None:
+        policy = os.environ.get(POLICY_ENV) or "default"
+    policy = str(policy)
+    if policy not in POLICIES:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            f"unknown policy {policy!r}: expected one of {POLICIES}"
+        )
+    return policy
+
+
+def resolve_default_for_plan(params, mesh, real_dtype) -> ExchangeType:
+    """Full model resolution of ``ExchangeType.DEFAULT`` for a 1-D slab plan:
+    :func:`resolve_default_exchange` evaluated under both one-shot-support
+    answers, probing the backend (compile-only, cached — parallel/ragged.py)
+    only when the two disagree. The single home shared by plan construction
+    (distributed.py) and the TUNED policy's model fallback (spfft_tpu.tuning).
+    """
+    picks = {
+        supported: resolve_default_exchange(
+            params.num_sticks_per_shard,
+            params.local_z_lengths,
+            one_shot_supported=supported,
+            wire_scalar_bytes=np.dtype(real_dtype).itemsize,
+        )
+        for supported in (False, True)
+    }
+    if picks[False] == picks[True] or params.num_shards <= 1:
+        return picks[False]
+    from .ragged import _ragged_a2a_supported
+
+    return picks[_ragged_a2a_supported(mesh)]
+
 
 def discipline_volumes(num_sticks_per_shard, local_z_lengths):
     """Exchange-A complex-element volumes per repartition, self-blocks excluded.

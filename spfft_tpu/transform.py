@@ -13,6 +13,7 @@ import numpy as np
 import jax
 
 from . import obs, timing
+from .tuning import env_overrides
 from .errors import InvalidParameterError
 from .execution import LocalExecution, as_pair, from_pair
 from .sync import fence
@@ -50,6 +51,7 @@ class Transform:
         engine: str = "auto",
         precision: str = "highest",
         device=None,
+        policy: str | None = None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -132,6 +134,45 @@ class Transform:
                 device = gdev
         device = device_for_processing_unit(self._processing_unit, device)
         self._device = device
+
+        from .parallel.policy import resolve_policy
+
+        self._policy = resolve_policy(policy)
+        self._tuning = None
+        engine_env = {}
+        if engine == "auto" and self._policy == "tuned":
+            # TUNED policy (spfft_tpu.tuning): resolve the engine axis (MXU
+            # matmul DFTs vs jnp.fft, incl. the sparse-y knob variants)
+            # empirically — wisdom hit, else on-device trials on THIS plan's
+            # stick layout, else the static auto rule (CPU-only hosts /
+            # corrupt store). Trial plans use explicit engines and the model
+            # policy, so tuning cannot recurse.
+            from . import tuning
+
+            p = self._params
+            triplets = _storage_triplets(p)
+
+            def build(cand):
+                with tuning.env_overrides(cand.get("env") or {}):
+                    return Transform(
+                        self._processing_unit,
+                        p.transform_type,
+                        p.dim_x,
+                        p.dim_y,
+                        p.dim_z,
+                        indices=triplets,
+                        dtype=self._real_dtype,
+                        engine=cand["engine"],
+                        precision=precision,
+                        device=device,
+                        policy="default",
+                    )
+
+            choice, self._tuning = tuning.tuned_local(
+                p, device, self._real_dtype, precision, build
+            )
+            engine = choice["engine"]
+            engine_env = dict(choice.get("env") or {})
         # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
         # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
         # execution.py) wins on CPU where pocketfft is the fast path.
@@ -143,9 +184,12 @@ class Transform:
             if engine == "mxu":
                 from .execution_mxu import MxuLocalExecution
 
-                self._exec = MxuLocalExecution(
-                    self._params, self._real_dtype, device=device, precision=precision
-                )
+                # engine_env: a tuned candidate's knob overrides (empty ->
+                # os.environ untouched; see tuning.env_overrides)
+                with env_overrides(engine_env):
+                    self._exec = MxuLocalExecution(
+                        self._params, self._real_dtype, device=device, precision=precision
+                    )
                 self._native_transposed = True
             elif engine == "xla":
                 self._exec = LocalExecution(self._params, self._real_dtype, device=device)

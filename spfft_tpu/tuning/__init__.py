@@ -1,0 +1,384 @@
+"""spfft_tpu.tuning — empirical autotuner with persistent plan wisdom.
+
+Closes the loop the model-based ``ExchangeType.DEFAULT`` policy leaves open:
+instead of trusting analytic cost guesses (``parallel/policy.py``), a plan
+constructed with ``policy="tuned"`` (or ``SPFFT_TPU_POLICY=tuned``) measures
+the real alternatives on its own geometry/mesh/dtype and remembers the winner
+— the FFTW planner/wisdom shape, rebuilt for this system:
+
+1. **Candidates** (:mod:`.candidates`): the exchange disciplines the DEFAULT
+   cost model already tabulates, and the local engine axis (MXU vs ``jnp.fft``
+   with the sparse-y knob variants).
+2. **Trials** (:mod:`.runner`): each candidate built as a full transform and
+   timed on device (warmup + best-of repeats, fenced), instrumented through
+   the obs stage scopes and run-metrics registry.
+3. **Wisdom** (:mod:`.wisdom`): the measured choice persists in a JSON store
+   (``SPFFT_TPU_WISDOM``; process-memory fallback when unset) keyed by every
+   decision-relevant plan property, so the same plan constructed again runs
+   ZERO trials.
+
+Safety contract: tuning degrades, never fails — wisdom miss on a CPU-only
+host (trials skipped unless ``SPFFT_TPU_TUNE_CPU=1``), a corrupt store, or a
+schema-version mismatch all fall back to the model policy, and the plan card
+records the provenance either way (``plan.report()["tuning"]``: ``wisdom``
+vs ``model``, hit/miss, per-candidate trial timings).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .wisdom import (  # noqa: F401
+    PERF_ENV_KNOBS,
+    WISDOM_ENV,
+    WISDOM_SCHEMA,
+    MemoryStore,
+    WisdomStore,
+    active_store,
+    clear_memory,
+    env_signature,
+    key_digest,
+    make_entry,
+    sparsity_signature,
+)
+from .runner import (  # noqa: F401
+    TUNE_CPU_ENV,
+    TUNE_REPEATS_ENV,
+    TUNE_WARMUP_ENV,
+    run_trials,
+    trial_budget,
+    trials_allowed,
+)
+from .candidates import exchange_candidates, local_candidates  # noqa: F401
+
+
+@contextlib.contextmanager
+def env_overrides(overrides: dict):
+    """Temporarily apply a candidate's env knob overrides (sparse-y variants
+    etc.) around a trial or chosen-plan engine construction. The knobs are
+    read at plan-construction time only, so scoping the mutation to the
+    construction is exact. Empty overrides never touch ``os.environ``.
+
+    CAVEAT — process-global state: while a non-empty override is active,
+    concurrent plan construction in *other threads* would read the
+    overridden knobs (and ``env_signature`` would key wisdom under them).
+    Tuned plan construction is therefore NOT thread-safe against concurrent
+    plan construction — serialize plan creation when using
+    ``policy="tuned"`` (the documented exception to the otherwise lock-free
+    plan creation, docs/details.md "Thread safety")."""
+    if not overrides:
+        yield
+        return
+    saved = {k: os.environ.get(k) for k in overrides}
+    try:
+        os.environ.update({k: str(v) for k, v in overrides.items()})
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def _record(provenance, *, hit, store, choice, trials, reason, key):
+    """The JSON-plain tuning record a transform retains (``_tuning``) and
+    plan cards embed verbatim (obs.plancard TUNING_KEYS pins the shape)."""
+    return {
+        "policy": "tuned",
+        "provenance": provenance,  # "wisdom" (measured) | "model" (fallback)
+        "hit": bool(hit),
+        "wisdom_path": getattr(store, "path", None),
+        "key_digest": key_digest(key),
+        "reason": reason,
+        "choice": choice,
+        "trials": trials,
+    }
+
+
+def _base_key(kind, transform_type, dims, dtype, engine, precision) -> dict:
+    import jax
+
+    return {
+        "kind": kind,
+        "transform_type": transform_type.name,
+        "dims": [int(d) for d in dims],
+        "dtype": str(dtype),
+        "engine": str(engine),
+        "precision": str(precision),
+        "jax": jax.__version__,
+        # ambient perf knobs trials ran under (wisdom.PERF_ENV_KNOBS):
+        # changing a knob lands in a different entry instead of aliasing
+        "env": env_signature(),
+    }
+
+
+def exchange_key(params, mesh, dtype, engine, precision, pencil2) -> dict:
+    """Wisdom key for a distributed plan's exchange decision: geometry and
+    per-shard layout exactly (they set the wire volumes), mesh shape, dtype
+    and wire width, the requested engine, the platform the MESH lives on
+    (engine availability — CPU wisdom never answers for TPU plans), and the
+    jax version (a collective-lowering change invalidates timings)."""
+    key = _base_key(
+        "exchange",
+        params.transform_type,
+        (params.dim_x, params.dim_y, params.dim_z),
+        dtype,
+        engine,
+        precision,
+    )
+    key.update(
+        {
+            "decomposition": "pencil2" if pencil2 else "slab",
+            "mesh": {
+                str(name): int(size)
+                for name, size in zip(mesh.axis_names, mesh.devices.shape)
+            },
+            "platform": str(mesh.devices.flat[0].platform),
+            "sticks_per_shard": [int(n) for n in params.num_sticks_per_shard],
+            "local_z_lengths": [int(n) for n in params.local_z_lengths],
+            "values_per_shard": [int(n) for n in params.num_values_per_shard],
+        }
+    )
+    return key
+
+
+def local_key(params, device, dtype, precision) -> dict:
+    """Wisdom key for a local plan's engine decision: dims, the full stick
+    layout (hashed — it drives sparse-y engagement), value count, dtype,
+    precision, platform, jax version."""
+    key = _base_key(
+        "local",
+        params.transform_type,
+        (params.dim_x, params.dim_y, params.dim_z),
+        dtype,
+        "auto",
+        precision,
+    )
+    key.update(
+        {
+            "platform": str(device.platform),
+            "num_sticks": int(params.num_sticks),
+            "num_elements": int(params.num_values),
+            "sparsity_signature": sparsity_signature(
+                params.stick_x, params.stick_y, params.value_indices
+            ),
+        }
+    )
+    return key
+
+
+def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
+    """Resolve ``ExchangeType.DEFAULT`` under the TUNED policy.
+
+    Returns ``(ExchangeType, record)``. Wisdom hit -> the stored choice, zero
+    trials. Miss with trials allowed -> measure the candidate disciplines via
+    ``build`` (a caller closure constructing explicit-discipline trial plans
+    with the model policy), persist, return the winner. Miss with trials
+    skipped (CPU-only host, ``runner.trials_allowed``) -> the model policy's
+    pick (1-D slab: ``policy.resolve_default_for_plan``; 2-D pencil: DEFAULT
+    is left for the engine's internal model resolver), recorded as
+    ``provenance="model"`` with the skip reason.
+    """
+    from ..parallel.execution import mesh_process_span
+    from ..parallel.policy import resolve_default_for_plan
+    from ..types import ExchangeType
+
+    key = exchange_key(params, mesh, dtype, engine, precision, pencil2)
+    store = active_store()
+    if params.num_shards <= 1:
+        # no exchange happens on a single shard — the decision has zero
+        # effect, so never pay trials for it (mirrors the model path's
+        # num_shards <= 1 shortcut in resolve_default_for_plan)
+        pick = (
+            ExchangeType.DEFAULT if pencil2 else ExchangeType.BUFFERED
+        )
+        return pick, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice={"exchange_type": pick.name},
+            trials=[],
+            reason="single shard: exchange discipline has no effect",
+            key=key,
+        )
+    if mesh_process_span(mesh) > 1:
+        # Multi-host meshes: tuning is per-process, so one host hitting
+        # wisdom while another runs trial collectives — or two hosts'
+        # best-of-repeats disagreeing — would compile mismatched collective
+        # programs and deadlock the mesh. Every process must reach the same
+        # answer deterministically: the model policy (which depends only on
+        # replicated plan geometry), never wisdom or trials.
+        pick = (
+            ExchangeType.DEFAULT  # engine-internal model resolution
+            if pencil2
+            else resolve_default_for_plan(params, mesh, dtype)
+        )
+        return pick, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice={"exchange_type": pick.name},
+            trials=[],
+            reason="multi-host mesh: tuning requires cross-process agreement",
+            key=key,
+        )
+    entry = store.lookup(key)
+    if entry is not None:
+        choice = entry["choice"]
+        return ExchangeType[choice["exchange_type"]], _record(
+            "wisdom",
+            hit=True,
+            store=store,
+            choice=choice,
+            trials=entry.get("trials", []),
+            reason="wisdom hit",
+            key=key,
+        )
+    platform = str(mesh.devices.flat[0].platform)
+    if not trials_allowed(platform):
+        reason = store.fallback_reason or (
+            f"trials skipped on CPU-only host (set {TUNE_CPU_ENV}=1 to allow)"
+        )
+        if pencil2:
+            pick = ExchangeType.DEFAULT  # engine-internal model resolution
+        else:
+            pick = resolve_default_for_plan(params, mesh, dtype)
+        return pick, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice={"exchange_type": pick.name},
+            trials=[],
+            reason=reason,
+            key=key,
+        )
+    if pencil2:
+        cands = exchange_candidates(pencil2=True)
+    else:
+        from ..parallel.ragged import _ragged_a2a_supported
+        from ..types import wire_scalar_bytes
+
+        cands = exchange_candidates(
+            params.num_sticks_per_shard,
+            params.local_z_lengths,
+            one_shot_supported=params.num_shards > 1
+            and _ragged_a2a_supported(mesh),
+            wire_scalar_bytes=wire_scalar_bytes(ExchangeType.DEFAULT, dtype),
+        )
+    trials = run_trials(build, cands)
+    measured = [row for row in trials if "ms" in row]
+    if not measured:
+        # every candidate failed to build/compile/run: degrade to the model
+        # policy (tuning never fails plan construction); nothing is persisted
+        pick = (
+            ExchangeType.DEFAULT
+            if pencil2
+            else resolve_default_for_plan(params, mesh, dtype)
+        )
+        return pick, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice={"exchange_type": pick.name},
+            trials=trials,
+            reason="all trial candidates failed",
+            key=key,
+        )
+    choice = {"exchange_type": measured[0]["exchange_type"]}
+    store.record(key, make_entry(key, choice, trials))
+    return ExchangeType[choice["exchange_type"]], _record(
+        "wisdom",
+        hit=False,
+        store=store,
+        choice=choice,
+        trials=trials,
+        reason=store.fallback_reason or "measured",
+        key=key,
+    )
+
+
+def tuned_local(params, device, dtype, precision, build):
+    """Resolve a local plan's ``engine="auto"`` under the TUNED policy.
+
+    Returns ``(choice, record)`` where ``choice`` is a local candidate dict
+    (``engine`` + ``env`` overrides the caller applies around its engine
+    construction). Same hit/trial/model-fallback ladder as
+    :func:`tuned_exchange`; the model fallback is the static auto rule
+    (XLA on CPU, MXU elsewhere)."""
+    key = local_key(params, device, dtype, precision)
+    store = active_store()
+    entry = store.lookup(key)
+    if entry is not None:
+        return dict(entry["choice"]), _record(
+            "wisdom",
+            hit=True,
+            store=store,
+            choice=entry["choice"],
+            trials=entry.get("trials", []),
+            reason="wisdom hit",
+            key=key,
+        )
+    platform = str(device.platform)
+    if not trials_allowed(platform):
+        reason = store.fallback_reason or (
+            f"trials skipped on CPU-only host (set {TUNE_CPU_ENV}=1 to allow)"
+        )
+        choice = {
+            "label": "xla" if platform == "cpu" else "mxu",
+            "engine": "xla" if platform == "cpu" else "mxu",
+            "env": {},
+        }
+        return choice, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice=choice,
+            trials=[],
+            reason=reason,
+            key=key,
+        )
+    trials = run_trials(build, local_candidates(platform))
+    measured = [row for row in trials if "ms" in row]
+    if not measured:
+        choice = {
+            "label": "xla" if platform == "cpu" else "mxu",
+            "engine": "xla" if platform == "cpu" else "mxu",
+            "env": {},
+        }
+        return choice, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice=choice,
+            trials=trials,
+            reason="all trial candidates failed",
+            key=key,
+        )
+    best = measured[0]
+    choice = {"label": best["label"], "engine": best["engine"], "env": best["env"]}
+    store.record(key, make_entry(key, choice, trials))
+    return dict(choice), _record(
+        "wisdom",
+        hit=False,
+        store=store,
+        choice=choice,
+        trials=trials,
+        reason=store.fallback_reason or "measured",
+        key=key,
+    )
+
+
+def wisdom_state(transform=None) -> dict:
+    """Reproducibility stamp for benchmark JSON: where wisdom lives and what
+    the given plan's decision provenance was (bench.py /
+    programs/benchmark.py embed this so perf numbers are diffable against
+    HOW the plan was decided)."""
+    path = os.environ.get(WISDOM_ENV)
+    state = {"path": path, "configured": path is not None}
+    if transform is not None:
+        state["policy"] = getattr(transform, "_policy", "default")
+        rec = getattr(transform, "_tuning", None)
+        state["provenance"] = rec["provenance"] if rec else "model"
+        state["hit"] = rec["hit"] if rec else None
+    return state

@@ -1,0 +1,91 @@
+"""Candidate enumeration: what the autotuner is allowed to try.
+
+The candidate set is not invented here — it lifts the alternatives the system
+already structures elsewhere into trial plans:
+
+- **Exchange candidates** (distributed plans): the disciplines of the DEFAULT
+  cost model's table (``parallel/policy.alternative_costs`` — the same
+  accounting plan cards embed), ordered by model cost so the trial log reads
+  model-first and an early-exit budget would try the model's pick first.
+- **Local candidates**: the local engine axis — the MXU matmul-DFT engine
+  under its measured sparse-y auto knobs, the same engine with the sparse-y
+  variants forced dense (the regime where the auto thresholds mis-predict),
+  and the XLA engine (``jnp.fft``; pocketfft on CPU).
+
+Every candidate is a plain JSON-stable dict: ``label`` (stable id, what
+wisdom/trial tables store), plus the constructor-level facts a builder needs
+(``exchange_type`` for distributed, ``engine`` + ``env`` overrides for
+local).
+"""
+from __future__ import annotations
+
+
+def exchange_candidates(
+    num_sticks_per_shard=None,
+    local_z_lengths=None,
+    *,
+    one_shot_supported: bool = False,
+    wire_scalar_bytes: int = 4,
+    pencil2: bool = False,
+) -> list:
+    """Exchange-discipline candidates for a distributed plan.
+
+    For 1-D slab geometry the model's cost table orders the list (cheapest
+    modeled cost first) and each candidate carries its ``model_cost_bytes``
+    so tuned plan cards can show model-vs-measured side by side. 2-D pencil
+    plans get the same three base disciplines in enum order (their model
+    table lives inside the engine, ``pencil2._resolve_pencil2_default``).
+    ``one_shot_supported`` feeds the model table exactly as in
+    ``resolve_default_exchange`` (the caller probes the backend once before
+    trials — parallel/ragged.py ``_ragged_a2a_supported``).
+    """
+    from ..types import ExchangeType
+
+    disciplines = (
+        ExchangeType.BUFFERED,
+        ExchangeType.COMPACT_BUFFERED,
+        ExchangeType.UNBUFFERED,
+    )
+    if pencil2 or num_sticks_per_shard is None:
+        return [
+            {"label": d.name, "exchange_type": d.name} for d in disciplines
+        ]
+    from ..parallel.policy import alternative_costs
+
+    table = alternative_costs(
+        num_sticks_per_shard,
+        local_z_lengths,
+        one_shot_supported=one_shot_supported,
+        wire_scalar_bytes=wire_scalar_bytes,
+    )
+    cands = [
+        {
+            "label": d.name,
+            "exchange_type": d.name,
+            "model_cost_bytes": int(table[d]["cost_bytes"]),
+        }
+        for d in disciplines
+    ]
+    return sorted(cands, key=lambda c: c["model_cost_bytes"])
+
+
+def local_candidates(platform: str) -> list:
+    """Local-plan candidates: engine x sparse-y-knob variants.
+
+    The MXU candidates differ only in env overrides applied for the trial
+    (and for the chosen plan's engine construction) — the knobs are already
+    single-sourced in ``ops/fft.py``, so the tuner tries them rather than
+    re-modeling them. Platform only orders the list (likely winner first:
+    MXU on accelerators, XLA/pocketfft on CPU); every candidate is buildable
+    everywhere, and the platform is part of the wisdom key.
+    """
+    mxu = [
+        {"label": "mxu", "engine": "mxu", "env": {}},
+        {
+            "label": "mxu/dense-y",
+            "engine": "mxu",
+            "env": {"SPFFT_TPU_SPARSE_Y": "0", "SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
+        },
+    ]
+    xla = [{"label": "xla", "engine": "xla", "env": {}}]
+    return xla + mxu if platform == "cpu" else mxu + xla

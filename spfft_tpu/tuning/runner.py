@@ -1,0 +1,134 @@
+"""On-device trial runner: measure candidates on the caller's real plan.
+
+Each trial builds a full transform for one candidate (same geometry, mesh,
+dtype, precision as the plan being tuned — the trial IS the plan, not a
+proxy), runs warmup dispatches to absorb compilation, then timed
+backward+forward roundtrips fenced with the platform-correct completion fence
+(:mod:`spfft_tpu.sync`). Best-of-repeats is reported, matching every
+measurement harness in this repo (bench.py, programs/benchmark.py).
+
+Budget knobs: ``SPFFT_TPU_TUNE_WARMUP`` (default 1 untimed roundtrip) and
+``SPFFT_TPU_TUNE_REPEATS`` (default 5 timed roundtrips) per candidate.
+Trials never run on CPU-only hosts unless ``SPFFT_TPU_TUNE_CPU=1`` — CPU
+"collectives" are memory copies, so CPU timings would poison wisdom that a
+TPU plan later reads; the tuned policy falls back to the model there
+(``trials_allowed``). CI and the tests set the override, with a tmp wisdom
+file, to exercise the whole loop hardware-free.
+
+Instrumentation reuses the obs layers: each trial dispatch is wrapped in the
+canonical ``tune warmup`` / ``tune trial`` stage scopes (``obs.STAGES`` —
+``programs/lint.py`` enforces the vocabulary), and the run registry counts
+``tuning_trials_total`` per candidate label plus a ``tuning_trial_seconds``
+histogram, so a metrics snapshot shows exactly what tuning cost.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .. import obs
+
+TUNE_REPEATS_ENV = "SPFFT_TPU_TUNE_REPEATS"
+TUNE_WARMUP_ENV = "SPFFT_TPU_TUNE_WARMUP"
+TUNE_CPU_ENV = "SPFFT_TPU_TUNE_CPU"
+
+
+def trial_budget() -> tuple:
+    """(warmup, repeats) per candidate from the env knobs (floors: 0, 1)."""
+    warmup = max(0, int(os.environ.get(TUNE_WARMUP_ENV, "1")))
+    repeats = max(1, int(os.environ.get(TUNE_REPEATS_ENV, "5")))
+    return warmup, repeats
+
+
+def trials_allowed(platform: str) -> bool:
+    """Whether on-device trials may run for a plan on ``platform`` (see
+    module docstring — CPU-only hosts skip to the model fallback unless
+    ``SPFFT_TPU_TUNE_CPU=1``)."""
+    return platform != "cpu" or os.environ.get(TUNE_CPU_ENV, "0") == "1"
+
+
+def _roundtrip(transform, staged):
+    """One backward+forward device roundtrip over pre-staged inputs,
+    fenced to completion; returns the fenced result for reuse."""
+    from ..sync import fence
+    from ..types import ScalingType
+
+    transform.backward_pair(staged[0], staged[1])
+    out = transform.forward_pair(ScalingType.FULL)
+    fence(out)
+    return out
+
+
+def _stage_inputs(transform):
+    """Random frequency values of the plan's exact shape, staged on device
+    (trial timings must not bill host staging — the tuned decision is about
+    the device pipeline)."""
+    import numpy as np
+
+    from ..execution import as_pair
+
+    rng = np.random.default_rng(0)
+    if getattr(transform, "_mesh", None) is not None:
+        vps = [
+            rng.standard_normal(transform.num_local_elements(r))
+            + 1j * rng.standard_normal(transform.num_local_elements(r))
+            for r in range(transform.num_shards)
+        ]
+        return transform._exec.pad_values(vps)
+    n = transform.num_local_elements
+    values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    re, im = as_pair(values, transform.dtype)
+    return transform._exec.put(re), transform._exec.put(im)
+
+
+def measure_candidate(transform) -> float:
+    """Best-of-repeats seconds per backward+forward pair for one built
+    trial transform."""
+    import jax
+
+    warmup, repeats = trial_budget()
+    staged = _stage_inputs(transform)
+    with jax.named_scope("tune warmup"):
+        # warmup 0 is honored: compilation then bills to the first timed
+        # repeat (acceptable for smoke runs; best-of still softens it)
+        for _ in range(warmup):
+            _roundtrip(transform, staged)
+    best = float("inf")
+    for _ in range(repeats):
+        with jax.named_scope("tune trial"), obs.phase_timer(
+            "tuning_trial_seconds"
+        ):
+            t0 = time.perf_counter()
+            _roundtrip(transform, staged)
+            best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_trials(build, candidates: list) -> list:
+    """Measure every candidate; returns the trial table (one row per
+    candidate: its label, constructor facts, and best-of ms), measured rows
+    sorted fastest-first. ``build(candidate)`` constructs the trial
+    transform — the closure lives with the caller (transform.py /
+    distributed.py), which knows its own constructor; trial plans are built
+    with the model policy so tuning cannot recurse.
+
+    Per-candidate failures are isolated, not raised: a candidate that fails
+    to build, compile, or run (e.g. BUFFERED's padded blocks OOM-ing on the
+    imbalanced geometry the model rejects it for) yields an ``error`` row
+    instead of an ``ms`` row and sorts last — tuning degrades, never fails
+    plan construction (the caller falls back to the model policy when NO
+    candidate measured)."""
+    rows, failed = [], []
+    for cand in candidates:
+        try:
+            trial = build(cand)
+            seconds = measure_candidate(trial)
+        except Exception as e:
+            obs.counter("tuning_trial_failures_total", candidate=cand["label"]).inc()
+            failed.append(dict(cand, error=str(e).splitlines()[0][:200]))
+            continue
+        obs.counter("tuning_trials_total", candidate=cand["label"]).inc()
+        row = dict(cand)
+        row["ms"] = round(seconds * 1e3, 4)
+        rows.append(row)
+    return sorted(rows, key=lambda r: r["ms"]) + failed

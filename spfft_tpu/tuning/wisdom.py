@@ -1,0 +1,207 @@
+"""Persistent plan wisdom — the FFTW-wisdom analogue for this build.
+
+A wisdom store maps a *tuning key* (the plan properties that determine which
+candidate wins: grid dims, sparsity signature, mesh shape, dtype, requested
+engine, platform, jax version) to the measured choice and its trial table.
+Two stores exist behind one interface:
+
+- :class:`WisdomStore` — JSON on disk at the path named by the
+  ``SPFFT_TPU_WISDOM`` env knob. Versioned schema (:data:`WISDOM_SCHEMA`);
+  a corrupted file or a schema-version mismatch degrades to an empty store
+  (every lookup misses, ``fallback_reason`` says why) instead of raising —
+  plan construction must never fail because wisdom rotted. Writes are atomic
+  (tempfile + ``os.replace``) so concurrent tuners cannot tear the file.
+- :class:`MemoryStore` — the process-global fallback when ``SPFFT_TPU_WISDOM``
+  is unset: repeated constructions in one process still reuse trials, nothing
+  persists.
+
+Keying doubles as invalidation: any change to the key fields — including the
+jax version and the platform the mesh lives on — lands in a different entry,
+so stale wisdom is never *applied*, only bypassed (docs/details.md
+"Autotuning & wisdom").
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+
+WISDOM_ENV = "SPFFT_TPU_WISDOM"
+WISDOM_SCHEMA = "spfft_tpu.tuning.wisdom/1"
+
+# Ambient engine/exchange env knobs that change measured performance (the
+# docs/details.md engine-knob table, minus pure model/docs knobs). Their
+# values at tuning time ride in every wisdom key (:func:`env_signature`) —
+# trials run UNDER these settings, so an entry measured with, say,
+# SPFFT_TPU_ONESHOT_TRANSPORT=chain must not answer for a run without it.
+# Candidate-level overrides (tuning/candidates.py) sit on top of this ambient
+# state and are recorded in the choice itself.
+PERF_ENV_KNOBS = (
+    "SPFFT_TPU_GAUSS_MM",
+    "SPFFT_TPU_PAIR_COPY",
+    "SPFFT_TPU_SPARSE_Y",
+    "SPFFT_TPU_SPARSE_Y_BLOCKS",
+    "SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC",
+    "SPFFT_TPU_SPARSE_Y_MATRIX_MB",
+    "SPFFT_TPU_COPY_DENSE_FRAC",
+    "SPFFT_TPU_XPAD",
+    "SPFFT_TPU_F64_STAGE_MB",
+    "SPFFT_TPU_PHASE_TABLE_MB",
+    "SPFFT_TPU_PHASE_DEVICE_MB",
+    "SPFFT_TPU_ONESHOT_TRANSPORT",
+)
+
+_lock = threading.Lock()
+
+
+def env_signature() -> dict:
+    """The ambient values of :data:`PERF_ENV_KNOBS` (None = unset/default),
+    embedded in every tuning key so knob changes invalidate instead of
+    aliasing (kept inline, not hashed — small and debuggable)."""
+    return {k: os.environ.get(k) for k in PERF_ENV_KNOBS}
+
+
+def sparsity_signature(*arrays) -> str:
+    """Stable 16-hex digest of the stick/value layout arrays — the sparsity
+    part of a tuning key. Hashed (not stored raw) because a 512^3-class plan
+    carries millions of indices; two plans with the same digest share the
+    same measured trade-offs."""
+    import numpy as np
+
+    h = hashlib.sha1()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a, dtype=np.int64))
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Advisory exclusive lock on a sidecar file for cross-process
+    read-modify-write safety; degrades to no lock where ``fcntl`` is
+    unavailable (non-POSIX) — the module lock still covers threads."""
+    try:
+        import fcntl
+    except ImportError:
+        yield
+        return
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        os.close(fd)  # closing drops the flock
+
+
+def key_digest(key: dict) -> str:
+    """Canonical entry id of a tuning key (sorted-JSON sha256, 24 hex)."""
+    return hashlib.sha256(
+        json.dumps(key, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:24]
+
+
+def make_entry(key: dict, choice: dict, trials: list) -> dict:
+    """A store entry: the full key (debuggability — digests are one-way),
+    the winning candidate, and the measured trial table that picked it."""
+    return {
+        "key": key,
+        "choice": choice,
+        "trials": trials,
+        "created_unix": time.time(),
+    }
+
+
+class WisdomStore:
+    """JSON-file wisdom store (see module docstring for the contract)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.fallback_reason: str | None = None
+
+    def _load(self) -> dict:
+        """Parse the file into ``{digest: entry}``; empty on absence,
+        corruption, or schema mismatch (recording ``fallback_reason``)."""
+        self.fallback_reason = None
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return {}
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            self.fallback_reason = f"corrupt wisdom file: {str(e).splitlines()[0]}"
+            return {}
+        if not isinstance(doc, dict) or doc.get("schema") != WISDOM_SCHEMA:
+            self.fallback_reason = (
+                f"wisdom schema mismatch: {doc.get('schema') if isinstance(doc, dict) else type(doc).__name__!s}"
+                f" != {WISDOM_SCHEMA}"
+            )
+            return {}
+        entries = doc.get("entries")
+        return entries if isinstance(entries, dict) else {}
+
+    def lookup(self, key: dict) -> dict | None:
+        entry = self._load().get(key_digest(key))
+        # entries written by hand/a future version must at least carry a choice
+        if entry is not None and not isinstance(entry.get("choice"), dict):
+            return None
+        return entry
+
+    def record(self, key: dict, entry: dict) -> None:
+        """Read-modify-write under the module lock (threads) plus an
+        advisory ``flock`` on a sidecar lockfile (concurrent processes
+        sharing one wisdom file — without it, two tuners' load/replace
+        cycles would silently drop each other's entries), finished with an
+        atomic replace. A corrupt existing file is overwritten with a fresh
+        store — the FFTW-wisdom behavior (re-measure and move on, never
+        wedge)."""
+        with _lock:
+            d = os.path.dirname(os.path.abspath(self.path)) or "."
+            os.makedirs(d, exist_ok=True)
+            with _file_lock(self.path + ".lock"):
+                entries = self._load()
+                entries[key_digest(key)] = entry
+                doc = {"schema": WISDOM_SCHEMA, "entries": entries}
+                fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        json.dump(doc, f, indent=1, sort_keys=True)
+                    os.replace(tmp, self.path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+
+
+class MemoryStore:
+    """Process-global in-memory store (``SPFFT_TPU_WISDOM`` unset)."""
+
+    path = None
+    fallback_reason = None
+    _entries: dict = {}
+
+    def lookup(self, key: dict) -> dict | None:
+        return MemoryStore._entries.get(key_digest(key))
+
+    def record(self, key: dict, entry: dict) -> None:
+        with _lock:
+            MemoryStore._entries[key_digest(key)] = entry
+
+
+def active_store():
+    """The store tuned plans consult: the file store at ``SPFFT_TPU_WISDOM``
+    when set, else the process-global memory store."""
+    path = os.environ.get(WISDOM_ENV)
+    return WisdomStore(path) if path else MemoryStore()
+
+
+def clear_memory() -> None:
+    """Drop the process-global memory store (tests / fresh windows)."""
+    with _lock:
+        MemoryStore._entries.clear()

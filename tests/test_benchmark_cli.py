@@ -107,7 +107,8 @@ def test_discipline_compare_cli(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-1500:]
     rows = json.loads(out.read_text())["rows"]
-    assert len(rows) == 6  # 2 shard counts x 3 disciplines
+    # 2 shard counts x (3 explicit disciplines + the DEFAULT policy A/B row)
+    assert len(rows) == 8
     by = {(row["P"], row["discipline"]): row for row in rows}
     for P in (2, 4):
         assert by[(P, "BUFFERED")]["rounds"] == 1
@@ -119,5 +120,11 @@ def test_discipline_compare_cli(tmp_path):
             <= by[(P, "COMPACT")]["wire_bytes"]
             <= by[(P, "BUFFERED")]["wire_bytes"]
         )
-        for d in ("BUFFERED", "COMPACT", "UNBUFFERED"):
+        # the policy row records what DEFAULT resolved to and its provenance
+        default = by[(P, "DEFAULT:default")]
+        assert default["resolved"] in (
+            "BUFFERED", "COMPACT_BUFFERED", "UNBUFFERED",
+        )
+        assert default["provenance"] == "model"
+        for d in ("BUFFERED", "COMPACT", "UNBUFFERED", "DEFAULT:default"):
             assert by[(P, d)]["ms_per_pair"] > 0

@@ -3,8 +3,15 @@
 Randomized dims (odd / prime / mixed), sparsity patterns, value orders and
 transform types; both local engines run the same plan and must agree to f64
 accuracy, and the distributed engines must agree with the local result.
-Seeded for reproducibility.
+
+Seeding is deterministic AND reproducible from the environment: every case's
+seed is ``SPFFT_TPU_FUZZ_SEED`` (default 0) + a per-test base + the case
+index, and the seed is printed at the top of each test so pytest surfaces it
+with any failure's captured output — a tuner-exposed (or CI-exposed) parity
+failure replays exactly with ``SPFFT_TPU_FUZZ_SEED=<offset> pytest <nodeid>``.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -22,10 +29,21 @@ from utils import assert_close, random_sparse_triplets
 
 CASES = list(range(8))
 
+FUZZ_SEED = int(os.environ.get("SPFFT_TPU_FUZZ_SEED", "0"))
+
+
+def fuzz_rng(base: int, case: int) -> np.random.Generator:
+    """Per-case generator seeded ``FUZZ_SEED + base + case``; prints the
+    effective seed so a failing test's captured stdout names it (see module
+    docstring)."""
+    seed = FUZZ_SEED + base + case
+    print(f"fuzz seed = {seed} (SPFFT_TPU_FUZZ_SEED={FUZZ_SEED} + {base} + {case})")
+    return np.random.default_rng(seed)
+
 
 @pytest.mark.parametrize("case", CASES)
 def test_local_engine_parity(case):
-    rng = np.random.default_rng(1000 + case)
+    rng = fuzz_rng(1000, case)
     dims = tuple(int(rng.integers(3, 20)) for _ in range(3))
     dx, dy, dz = dims
     r2c = bool(case % 2)
@@ -56,7 +74,7 @@ def test_local_engine_parity(case):
 
 @pytest.mark.parametrize("case", [0, 1, 2])
 def test_distributed_engine_parity(case):
-    rng = np.random.default_rng(2000 + case)
+    rng = fuzz_rng(2000, case)
     dims = tuple(int(rng.integers(4, 16)) for _ in range(3))
     dx, dy, dz = dims
     shards = int(rng.choice([2, 3, 4]))
@@ -96,7 +114,7 @@ def test_distributed_discipline_fuzz(case):
     (reference: tests/mpi_tests/test_transform.cpp:173-191)."""
     from spfft_tpu import ExchangeType
 
-    rng = np.random.default_rng(3000 + case)
+    rng = fuzz_rng(3000, case)
     dims = tuple(int(rng.integers(4, 14)) for _ in range(3))
     dx, dy, dz = dims
     shards = int(rng.choice([2, 4]))
@@ -159,7 +177,7 @@ def test_pencil_mesh_fuzz(case):
     against the local oracle — fuzz for the beyond-reference decomposition."""
     from spfft_tpu import ExchangeType
 
-    rng = np.random.default_rng(4000 + case)
+    rng = fuzz_rng(4000, case)
     p1, p2 = (2, 2) if case == 0 else (2, 4)
     # pencil needs dim_z >= p1 and dim_y >= p2 slabs with content
     dx = int(rng.integers(4, 10))

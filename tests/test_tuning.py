@@ -1,0 +1,322 @@
+"""spfft_tpu.tuning: wisdom store contract + TUNED policy behavior.
+
+Covers the safety ladder the subsystem promises (tuning module docstring):
+serialization round-trip, corrupted-file and schema-version-mismatch
+fallback, CPU-only trial skip (model fallback), and the wisdom cache-hit
+guarantee — constructing the same plan twice runs trials exactly once, with
+``plan.report()`` recording provenance and per-candidate trial timings.
+
+CPU trials are explicitly allowed (``SPFFT_TPU_TUNE_CPU=1``) in the tests
+that need them; the skip test leaves the knob unset to assert the default.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    obs,
+    tuning,
+)
+from spfft_tpu.errors import InvalidParameterError
+from utils import assert_close
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_tuning(monkeypatch):
+    """Isolate every test: no ambient wisdom (env or process memory), a
+    1-repeat trial budget, and a clean metrics registry for trial counting."""
+    tuning.clear_memory()
+    monkeypatch.delenv(tuning.WISDOM_ENV, raising=False)
+    monkeypatch.delenv(tuning.TUNE_CPU_ENV, raising=False)
+    monkeypatch.delenv("SPFFT_TPU_POLICY", raising=False)
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_WARMUP_ENV, "1")
+    obs.enable()
+    obs.clear()
+    yield
+    tuning.clear_memory()
+
+
+def _triplets():
+    return sp.create_spherical_cutoff_triplets(DIM, DIM, DIM, 0.8)
+
+
+def _distributed(policy="tuned", **kwargs):
+    return DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        _triplets(),
+        mesh=sp.make_fft_mesh(2),
+        policy=policy,
+        **kwargs,
+    )
+
+
+def _trial_count() -> int:
+    snap = obs.snapshot()
+    return sum(
+        v
+        for k, v in snap["counters"].items()
+        if k.startswith("tuning_trials_total")
+    )
+
+
+# ---- wisdom store ----------------------------------------------------------
+
+
+def test_wisdom_roundtrip(tmp_path):
+    path = tmp_path / "wisdom.json"
+    store = tuning.WisdomStore(str(path))
+    key = {"kind": "exchange", "dims": [8, 8, 8], "platform": "cpu"}
+    entry = tuning.make_entry(
+        key, {"exchange_type": "BUFFERED"}, [{"label": "BUFFERED", "ms": 1.0}]
+    )
+    store.record(key, entry)
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == tuning.WISDOM_SCHEMA
+    got = tuning.WisdomStore(str(path)).lookup(key)
+    assert got["choice"] == {"exchange_type": "BUFFERED"}
+    assert got["trials"] == entry["trials"]
+    assert got["key"] == key
+    # a different key misses; recording it preserves the first entry
+    other = dict(key, dims=[16, 16, 16])
+    assert store.lookup(other) is None
+    store.record(other, tuning.make_entry(other, {"exchange_type": "UNBUFFERED"}, []))
+    assert tuning.WisdomStore(str(path)).lookup(key)["choice"] == {
+        "exchange_type": "BUFFERED"
+    }
+
+
+def test_corrupted_file_falls_back(tmp_path, monkeypatch):
+    path = tmp_path / "wisdom.json"
+    path.write_text("{not json")
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(path))
+    # trials disallowed (CPU, no override): corruption must degrade to the
+    # model policy, never raise out of plan construction
+    t = _distributed()
+    assert t._tuning["provenance"] == "model"
+    assert "corrupt" in t._tuning["reason"]
+    assert t._tuning["trials"] == []
+    # the model fallback picks exactly what the model policy would
+    assert t.exchange_type == _distributed(policy="default").exchange_type
+
+
+def test_schema_version_mismatch_falls_back(tmp_path, monkeypatch):
+    path = tmp_path / "wisdom.json"
+    path.write_text(json.dumps({"schema": "spfft_tpu.tuning.wisdom/999", "entries": {}}))
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(path))
+    t = _distributed()
+    assert t._tuning["provenance"] == "model"
+    assert "schema mismatch" in t._tuning["reason"]
+    # re-measuring over a mismatched store rewrites it at the current schema
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t2 = _distributed()
+    assert t2._tuning["provenance"] == "wisdom"
+    assert json.loads(path.read_text())["schema"] == tuning.WISDOM_SCHEMA
+
+
+def test_cpu_only_trial_skip_model_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    t = _distributed()  # SPFFT_TPU_TUNE_CPU unset -> no trials on CPU
+    rec = t._tuning
+    assert rec["policy"] == "tuned"
+    assert rec["provenance"] == "model"
+    assert rec["hit"] is False
+    assert rec["trials"] == []
+    assert _trial_count() == 0
+    assert t.exchange_type == _distributed(policy="default").exchange_type
+    # nothing was persisted: a skipped decision must not masquerade as wisdom
+    assert not (tmp_path / "wisdom.json").exists()
+
+
+# ---- cache-hit guarantee ---------------------------------------------------
+
+
+def test_cache_hit_runs_zero_trials(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t1 = _distributed()
+    rec1 = t1._tuning
+    assert rec1["provenance"] == "wisdom" and rec1["hit"] is False
+    n1 = _trial_count()
+    assert n1 >= 3  # one trial per candidate discipline
+    # second construction of the SAME plan: wisdom hit, ZERO new trials
+    t2 = _distributed()
+    rec2 = t2._tuning
+    assert rec2["provenance"] == "wisdom" and rec2["hit"] is True
+    assert _trial_count() == n1
+    assert t2.exchange_type == t1.exchange_type
+    assert rec2["choice"] == rec1["choice"]
+    # the hit still reports the persisted trial table
+    assert rec2["trials"] and all("ms" in row for row in rec2["trials"])
+    # plan card carries the full provenance record and stays schema-complete
+    card = t2.report()
+    assert card["policy"] == "tuned"
+    assert card["tuning"]["provenance"] == "wisdom"
+    assert card["tuning"]["trials"] == rec2["trials"]
+    assert obs.validate_plan_card(card) == []
+    # a tuned plan still transforms correctly (against the local oracle)
+    trip = _triplets()
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    per_shard = sp.distribute_triplets(trip, 2, DIM)
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(x)] for x in s]) for s in per_shard]
+    local = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    ).backward(values)
+    assert_close(t2.backward(vps), local)
+    back = t2.forward(scaling=ScalingType.FULL)
+    for r, v in enumerate(vps):
+        assert_close(back[r], v)
+
+
+def test_local_tuned_cache_hit(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    trip = _triplets()
+    t1 = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM,
+        indices=trip, policy="tuned",
+    )
+    rec1 = t1._tuning
+    assert rec1["provenance"] == "wisdom" and rec1["hit"] is False
+    assert t1._engine == rec1["choice"]["engine"]
+    labels = {row["label"] for row in rec1["trials"]}
+    assert {"xla", "mxu", "mxu/dense-y"} <= labels
+    n1 = _trial_count()
+    t2 = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM,
+        indices=trip, policy="tuned",
+    )
+    assert t2._tuning["hit"] is True
+    assert _trial_count() == n1
+    assert t2._engine == t1._engine
+    assert obs.validate_plan_card(t2.report()) == []
+    # tuned local plan keeps the numerics contract
+    rng = np.random.default_rng(1)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    oracle = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    ).backward(values)
+    assert_close(t2.backward(values), oracle)
+
+
+def test_perf_knob_change_invalidates(tmp_path, monkeypatch):
+    """Wisdom keyed under one ambient perf-knob state must not answer for
+    another (wisdom.PERF_ENV_KNOBS rides in every key)."""
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t1 = _distributed()
+    assert t1._tuning["hit"] is False
+    monkeypatch.setenv("SPFFT_TPU_ONESHOT_TRANSPORT", "chain")
+    t2 = _distributed()
+    assert t2._tuning["hit"] is False  # different key -> re-measured
+    monkeypatch.delenv("SPFFT_TPU_ONESHOT_TRANSPORT")
+    assert _distributed()._tuning["hit"] is True  # original key still hits
+
+
+def test_memory_store_when_env_unset(monkeypatch):
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t1 = _distributed()
+    assert t1._tuning["wisdom_path"] is None
+    n1 = _trial_count()
+    t2 = _distributed()
+    assert t2._tuning["hit"] is True
+    assert _trial_count() == n1
+
+
+def test_failed_candidate_is_isolated(tmp_path, monkeypatch):
+    """One candidate failing (build/compile/run) must not abort plan
+    construction: it becomes an ``error`` trial row and the winner comes
+    from the measured rest."""
+    from spfft_tpu.tuning import runner
+
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    real = runner.measure_candidate
+
+    def flaky(transform):
+        if transform.exchange_type == ExchangeType.BUFFERED:
+            raise RuntimeError("synthetic trial failure")
+        return real(transform)
+
+    monkeypatch.setattr(runner, "measure_candidate", flaky)
+    t = _distributed()
+    rec = t._tuning
+    assert rec["provenance"] == "wisdom" and rec["hit"] is False
+    assert t.exchange_type != ExchangeType.BUFFERED
+    errors = [row for row in rec["trials"] if "error" in row]
+    assert len(errors) == 1 and errors[0]["label"] == "BUFFERED"
+    assert obs.validate_plan_card(t.report()) == []
+
+
+def test_all_trials_failing_falls_back_to_model(monkeypatch):
+    from spfft_tpu.tuning import runner
+
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+
+    def boom(transform):
+        raise RuntimeError("synthetic trial failure")
+
+    monkeypatch.setattr(runner, "measure_candidate", boom)
+    t = _distributed()
+    rec = t._tuning
+    assert rec["provenance"] == "model"
+    assert rec["reason"] == "all trial candidates failed"
+    assert rec["trials"] and all("error" in row for row in rec["trials"])
+    assert t.exchange_type == _distributed(policy="default").exchange_type
+
+
+# ---- policy plumbing -------------------------------------------------------
+
+
+def test_explicit_discipline_never_tuned(monkeypatch):
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t = _distributed(exchange_type=ExchangeType.BUFFERED)
+    assert t._tuning is None
+    assert t.exchange_type == ExchangeType.BUFFERED
+    assert _trial_count() == 0
+    assert "tuning" not in t.report()
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(InvalidParameterError):
+        _distributed(policy="fastest")
+
+
+def test_policy_env_knob(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_POLICY", "tuned")
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t = _distributed(policy=None)
+    assert t._policy == "tuned"
+    assert t._tuning is not None
+    # explicit argument beats the env knob
+    assert _distributed(policy="default")._policy == "default"
+
+
+def test_wisdom_state_stamp(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    t = _distributed()
+    state = tuning.wisdom_state(t)
+    assert state["configured"] is True
+    assert state["path"] == str(tmp_path / "wisdom.json")
+    assert state["policy"] == "tuned"
+    assert state["provenance"] == "wisdom"
+    assert state["hit"] is False
+    untuned = tuning.wisdom_state(_distributed(policy="default"))
+    assert untuned["provenance"] == "model" and untuned["hit"] is None
