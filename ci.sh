@@ -12,6 +12,7 @@
 #          ./ci.sh python     # Python suite only
 #          ./ci.sh report     # plan-card CLI + JSON schema validation only
 #          ./ci.sh tune       # autotuner smoke (trial + wisdom hit, CPU)
+#          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -80,6 +81,19 @@ EOF
   rm -rf "$wdir"
 }
 
+run_chaos() {
+  echo "== Chaos (spfft_tpu.faults: every site armed at rate 1.0, CPU) =="
+  # The chaos invariant: with each registered fault site armed one-at-a-time,
+  # every transform either raises a typed spfft_tpu.errors exception or
+  # returns parity-correct output via a recorded fallback (plan-card
+  # degradations + obs metrics) — never a silent wrong answer.
+  timeout 540 python -m pytest tests/test_faults.py tests/test_degradation.py -q
+  echo "== Guard-mode parity fuzz (SPFFT_TPU_GUARD=1) =="
+  # Guard instrumentation must not perturb numerics: the engine-parity fuzzer
+  # runs with every pre/post check active and must stay bit-for-bar green.
+  SPFFT_TPU_GUARD=1 timeout 540 python -m pytest tests/test_engine_parity_fuzz.py -q
+}
+
 run_dryrun() {
   echo "== Multichip dryrun (8-device CPU mesh, CPU forced) =="
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
@@ -104,6 +118,7 @@ case "$stage" in
   python) run_python ;;
   report) run_report ;;
   tune) run_tune ;;
+  chaos) run_chaos ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
@@ -111,12 +126,13 @@ case "$stage" in
     run_python
     run_report
     run_tune
+    run_chaos
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | chaos | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

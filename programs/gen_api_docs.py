@@ -261,6 +261,7 @@ def index_page() -> str:
         - [Multi-transforms](multi_transform.md)
         - [Index helpers and mesh utilities](utilities.md)
         - [Autotuning and wisdom](tuning.md)
+        - [Fault injection, guard mode and degradation](faults.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -274,7 +275,7 @@ def index_page() -> str:
 
 def generate(outdir: Path) -> None:
     import spfft_tpu as sp
-    from spfft_tpu import timing, tuning
+    from spfft_tpu import faults, timing, tuning
     from spfft_tpu.parallel import mesh
 
     outdir.mkdir(parents=True, exist_ok=True)
@@ -335,6 +336,29 @@ def generate(outdir: Path) -> None:
                 tuning.wisdom_state,
                 tuning.active_store,
                 tuning.clear_memory,
+            ],
+        ),
+        "faults.md": class_page(
+            "Faults",
+            doc(faults),
+            [],
+            [
+                faults.arm,
+                faults.disarm,
+                faults.armed,
+                faults.inject,
+                faults.reseed,
+                faults.site,
+                faults.parse_spec,
+                faults.guard_enabled,
+                faults.check_array,
+                faults.check_device,
+                faults.execution_error,
+                faults.collecting,
+                faults.record_degradation,
+                faults.engine_fallback,
+                faults.summarize,
+                faults.typed_execution,
             ],
         ),
         "c_api.md": c_api_page(),

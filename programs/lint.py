@@ -13,7 +13,12 @@ kept flagging are enforced here with the stdlib ast module:
 4. stage-scope consistency — every ``jax.named_scope`` label in an engine
    pipeline comes from the canonical ``spfft_tpu.obs.STAGES`` list, and every
    listed stage appears in at least one engine (same both-ways style as the
-   env-knob rule; keeps profiler traces attributable against one vocabulary).
+   env-knob rule; keeps profiler traces attributable against one vocabulary),
+5. fault-site consistency — every ``faults.site(...)`` call in the package
+   names a site registered in the canonical ``spfft_tpu.faults.SITES``
+   vocabulary, every registered site is threaded through the package at
+   least once, and every site is documented in docs/details.md (the chaos
+   suite's arm-every-site sweep is only exhaustive if the vocabulary is).
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -241,6 +246,74 @@ def check_stage_scopes(findings: list):
             )
 
 
+# The fault-injection plane: every faults.site(...) call must name a site
+# registered in SITES (spfft_tpu/faults/plane.py), every registered site must
+# be threaded through the package, and every site must appear in the docs.
+FAULTS_PLANE_FILE = "spfft_tpu/faults/plane.py"
+
+
+def _canonical_sites() -> tuple:
+    """SITES from faults/plane.py via ast (import-free, like STAGES)."""
+    tree = ast.parse((ROOT / FAULTS_PLANE_FILE).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "SITES" for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise AssertionError(f"no SITES assignment in {FAULTS_PLANE_FILE}")
+
+
+def check_fault_sites(findings: list):
+    sites = _canonical_sites()
+    if len(set(sites)) != len(sites):
+        findings.append(f"{FAULTS_PLANE_FILE}: duplicate entries in SITES")
+    used: dict = {}  # site name -> first package file:line that arms it
+    for d in PACKAGE_DIRS:
+        for path in sorted((ROOT / d).rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(ROOT)
+            if str(rel) == FAULTS_PLANE_FILE:
+                continue  # the registry itself is not a threading site
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "site"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "faults"
+                ):
+                    continue
+                where = f"{rel}:{node.lineno}"
+                if not (node.args and isinstance(node.args[0], ast.Constant)):
+                    findings.append(
+                        f"{where}: faults.site(...) must take a literal site "
+                        "name (lint cannot check dynamic names)"
+                    )
+                    continue
+                name = node.args[0].value
+                if name not in sites:
+                    findings.append(
+                        f"{where}: fault site {name!r} is not registered in "
+                        f"the canonical vocabulary ({FAULTS_PLANE_FILE})"
+                    )
+                used.setdefault(name, where)
+    for name in sites:
+        if name not in used:
+            findings.append(
+                f"{FAULTS_PLANE_FILE}: site {name!r} is registered but "
+                "threaded through no package code path"
+            )
+    docs_text = DOCS.read_text()
+    for name in sites:
+        if name not in docs_text:
+            findings.append(
+                f"fault site {name!r} is not documented in "
+                f"{DOCS.relative_to(ROOT)}"
+            )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -249,6 +322,7 @@ def main() -> int:
         check_imports(path, findings)
     check_env_knobs(findings)
     check_stage_scopes(findings)
+    check_fault_sites(findings)
     for f in findings:
         print(f)
     if findings:

@@ -31,6 +31,7 @@ from .errors import (  # noqa: F401
     MPISupportError,
     OverflowError_,
 )
+from . import faults  # noqa: F401
 from . import obs  # noqa: F401
 from . import timing  # noqa: F401
 from . import tuning  # noqa: F401

@@ -12,8 +12,8 @@ import numpy as np
 
 import jax
 
-from . import obs, timing
-from .errors import InvalidParameterError
+from . import faults, obs, timing
+from .errors import InvalidParameterError, MPIError
 from .sync import fence
 from .grid import Grid
 from .parallel.execution import DistributedExecution
@@ -47,6 +47,7 @@ class DistributedTransform:
         engine: str = "auto",
         precision="highest",
         policy: str | None = None,
+        guard: bool | None = None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -78,6 +79,7 @@ class DistributedTransform:
         self._processing_unit = ProcessingUnit(processing_unit)
         self._grid = grid
         self._mesh = mesh
+        self._platform = str(mesh.devices.flat[0].platform)
         self._exec_mode = ExecType.SYNCHRONOUS
         self._params = make_distributed_parameters(
             TransformType(transform_type),
@@ -110,6 +112,11 @@ class DistributedTransform:
         from .parallel.policy import resolve_policy
 
         self._policy = resolve_policy(policy)
+        # Guard mode + degradation record, mirroring the local Transform
+        # (spfft_tpu.faults): fallbacks taken during construction land on
+        # _degradations and surface in the plan card.
+        self._guard = faults.guard_enabled(guard)
+        self._degradations: list = []
         self._tuning = None
         if (
             ExchangeType(exchange_type) == ExchangeType.DEFAULT
@@ -142,9 +149,10 @@ class DistributedTransform:
                     policy="default",
                 )
 
-            exchange_type, self._tuning = tuning.tuned_exchange(
-                p, mesh, self._real_dtype, engine, precision, pencil2, build
-            )
+            with faults.collecting(self._degradations):
+                exchange_type, self._tuning = tuning.tuned_exchange(
+                    p, mesh, self._real_dtype, engine, precision, pencil2, build
+                )
         elif ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
             # Measured auto-policy (parallel/policy.py): pick the discipline
             # from the plan's exact wire volumes + round counts + the
@@ -177,33 +185,70 @@ class DistributedTransform:
             engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
         if engine not in ("xla", "mxu"):
             raise InvalidParameterError(f"unknown engine {engine!r}")
-        if pencil2:
-            if engine == "mxu":
-                from .parallel.pencil2_mxu import MxuPencil2Execution
 
-                self._exec = MxuPencil2Execution(
-                    self._params, self._real_dtype, mesh, exchange_type, precision
-                )
-                self._engine = "pencil2-mxu"
-            else:
+        def _build(which: str):
+            """Construct the execution engine for ``which`` (fault site
+            ``engine.compile`` guards the MXU lowerings — ladder rung 1)."""
+            if pencil2:
+                if which == "mxu":
+                    from .parallel.pencil2_mxu import MxuPencil2Execution
+
+                    faults.site("engine.compile")
+                    return (
+                        MxuPencil2Execution(
+                            self._params, self._real_dtype, mesh, exchange_type, precision
+                        ),
+                        "pencil2-mxu",
+                    )
                 from .parallel.pencil2 import Pencil2Execution
 
-                self._exec = Pencil2Execution(
-                    self._params, self._real_dtype, mesh, exchange_type
+                return (
+                    Pencil2Execution(
+                        self._params, self._real_dtype, mesh, exchange_type
+                    ),
+                    "pencil2",
                 )
-                self._engine = "pencil2"
-        elif engine == "mxu":
-            from .parallel.execution_mxu import MxuDistributedExecution
+            if which == "mxu":
+                from .parallel.execution_mxu import MxuDistributedExecution
 
-            self._exec = MxuDistributedExecution(
-                self._params, self._real_dtype, mesh, exchange_type, precision
+                faults.site("engine.compile")
+                return (
+                    MxuDistributedExecution(
+                        self._params, self._real_dtype, mesh, exchange_type, precision
+                    ),
+                    "mxu",
+                )
+            return (
+                DistributedExecution(
+                    self._params, self._real_dtype, mesh, exchange_type
+                ),
+                "xla",
             )
-            self._engine = engine
-        else:
-            self._exec = DistributedExecution(
-                self._params, self._real_dtype, mesh, exchange_type
-            )
-            self._engine = engine
+
+        # Degradation ladder rung 1 (distributed): an MXU engine that fails
+        # to lower/compile falls back to the jnp.fft engine over the same
+        # mesh and discipline; a failure with no rung below it (the jnp.fft
+        # engine or the exchange machinery itself — fault site
+        # exchange.build) raises typed MPIError.
+        with faults.collecting(self._degradations):
+            try:
+                self._exec, self._engine = _build(engine)
+            except faults.ENGINE_BUILD_ERRORS as e:
+                if engine != "mxu":
+                    raise MPIError(
+                        f"distributed engine construction failed: {e}"
+                    ) from e
+                faults.engine_fallback(
+                    "pencil2-mxu" if pencil2 else "mxu",
+                    "pencil2" if pencil2 else "xla",
+                    faults.summarize(e),
+                )
+                try:
+                    self._exec, self._engine = _build("xla")
+                except faults.ENGINE_BUILD_ERRORS as e2:
+                    raise MPIError(
+                        f"distributed engine construction failed: {e2}"
+                    ) from e2
         self._space_data = None
         # Plan-constant; cached lazily so the metrics-off path never pays the
         # per-step numpy accounting in exchange_wire_bytes().
@@ -218,15 +263,33 @@ class DistributedTransform:
         ``num_local_elements_per_shard``).
         """
         obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
+        plat = self._platform
         with timing.scoped("backward"):
+            if self._guard:
+                faults.check_array(
+                    list(values), check="backward input", platform=plat
+                )
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"), obs.phase_timer(
                     "wait_seconds", direction="backward"
-                ):
+                ), faults.typed_execution(plat, "backward wait"):
                     fence(out)
             with timing.scoped("output staging"):
-                return self._finalize_backward(out)
+                result = self._finalize_backward(out)
+            if self._guard:
+                # single-controller meshes return the global slab; multi-
+                # process meshes return per-shard local z-slabs (unpad_space
+                # contract) whose shapes differ per shard — finite-scan only
+                faults.check_array(
+                    result,
+                    check="backward output",
+                    platform=plat,
+                    shape=None
+                    if isinstance(result, (list, tuple))
+                    else (self.dim_z, self.dim_y, self.dim_x),
+                )
+            return result
 
     def _record_wire_bytes(self):
         """Count the exchange's per-dispatch wire bytes (plan-constant) into
@@ -247,8 +310,9 @@ class DistributedTransform:
         self._record_wire_bytes()
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="backward"
-        ):
+        ), faults.typed_execution(self._platform, "backward dispatch"):
             out = self._exec.backward_pair(*pair)
+            out = faults.site("engine.execute", payload=out)
         self._space_data = out
         return out
 
@@ -266,15 +330,25 @@ class DistributedTransform:
     ):
         """Space -> per-shard packed freq values (list of complex arrays)."""
         obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
+        plat = self._platform
         with timing.scoped("forward"):
+            if self._guard and space is not None:
+                faults.check_array(
+                    np.asarray(space), check="forward input", platform=plat
+                )
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"), obs.phase_timer(
                     "wait_seconds", direction="forward"
-                ):
+                ), faults.typed_execution(plat, "forward wait"):
                     fence(pair)
             with timing.scoped("output staging"):
-                return self._finalize_forward(pair)
+                result = self._finalize_forward(pair)
+            if self._guard:
+                faults.check_array(
+                    result, check="forward output", platform=plat
+                )
+            return result
 
     def _dispatch_forward(self, space, scaling):
         """Stage the space-domain input (or reuse the retained slabs) and enqueue
@@ -295,8 +369,9 @@ class DistributedTransform:
         self._record_wire_bytes()
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="forward"
-        ):
-            return self._exec.forward_pair(re, im, ScalingType(scaling))
+        ), faults.typed_execution(self._platform, "forward dispatch"):
+            pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            return faults.site("engine.execute", payload=pair)
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained sharded space buffer."""
@@ -349,6 +424,7 @@ class DistributedTransform:
             dtype=self._real_dtype,
             engine=engine,
             precision=self._precision,
+            guard=self._guard,
         )
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
@@ -500,5 +576,8 @@ class DistributedTransform:
         self._exec_mode = ExecType(mode)
 
     def synchronize(self) -> None:
+        # typed conversion mirrors the in-transform waits (see
+        # Transform.synchronize)
         if self._space_data is not None:
-            fence(self._space_data)
+            with faults.typed_execution(self._platform, "synchronize"):
+                fence(self._space_data)

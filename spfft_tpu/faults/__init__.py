@@ -1,0 +1,56 @@
+"""spfft_tpu.faults — fault-injection plane, guard mode, degradation ladder.
+
+Three pieces that make failure a first-class, testable path (the robustness
+counterpart of :mod:`spfft_tpu.obs` making behavior a first-class, observable
+path):
+
+1. **Injection plane** (:mod:`.plane`): a registry of named fault sites
+   (:data:`SITES`) threaded through tuning, wisdom I/O, engine lowering,
+   exchange construction, execution dispatch, compiled-program introspection
+   and the completion fence; armed via ``SPFFT_TPU_FAULTS="site=kind[:rate]"``
+   or the :func:`inject` context manager, deterministic under
+   ``SPFFT_TPU_FAULTS_SEED``, one falsy-dict check when disarmed.
+2. **Guard mode** (:mod:`.guard`): ``SPFFT_TPU_GUARD=1`` / ``guard=`` kwarg
+   — NaN/Inf scans plus shape/dtype/device validation around every
+   host-facing transform, raising typed :mod:`spfft_tpu.errors` exceptions
+   with ``guard_checks_total``/``guard_failures_total`` metrics.
+3. **Degradation ladder** (:mod:`.ladder`): engine-compile failures fall back
+   to the ``jnp.fft`` engine, wisdom I/O retries/quarantines, execution
+   failures convert to the typed error surface — every fallback recorded in
+   the plan card's ``degradations`` section and the run-metrics registry.
+
+The chaos suites (``tests/test_faults.py``, ``tests/test_degradation.py``,
+``./ci.sh chaos``) arm each site at rate 1.0 and assert the invariant: every
+transform either raises a typed exception or returns a parity-correct result
+via a recorded fallback — never a silent wrong answer.
+"""
+from .plane import (  # noqa: F401
+    FAULTS_DELAY_ENV,
+    FAULTS_ENV,
+    FAULTS_SEED_ENV,
+    KINDS,
+    SITES,
+    InjectedFault,
+    arm,
+    armed,
+    disarm,
+    inject,
+    parse_spec,
+    reseed,
+    site,
+)
+from .guard import (  # noqa: F401
+    GUARD_ENV,
+    check_array,
+    check_device,
+    execution_error,
+    guard_enabled,
+)
+from .ladder import (  # noqa: F401
+    ENGINE_BUILD_ERRORS,
+    collecting,
+    engine_fallback,
+    record_degradation,
+    summarize,
+    typed_execution,
+)

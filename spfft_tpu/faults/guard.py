@@ -1,0 +1,109 @@
+"""Guard mode: pre/post execution validation on the host-facing paths.
+
+``SPFFT_TPU_GUARD=1`` (or ``guard=True`` on a Transform/DistributedTransform)
+turns on defensive checks around every host-facing ``backward``/``forward``:
+
+- **NaN/Inf scan** on inputs before staging and on outputs after fetch —
+  poisoned data raises a typed :mod:`spfft_tpu.errors` exception
+  (:class:`~spfft_tpu.errors.HostExecutionError` on CPU plans,
+  :class:`~spfft_tpu.errors.GPUFFTError` on accelerator plans) instead of
+  flowing silently into the caller's pipeline,
+- **shape/dtype validation** of outputs against the plan's contract
+  (the packed value count, the ``(dim_z, dim_y, dim_x)`` slab, the plan
+  dtype),
+- **device validation** of the device-resident result against the plan's
+  bound device (a result that migrated off the plan device means the
+  runtime broke the placement contract).
+
+Every check counts ``guard_checks_total{check=...}``; every failure counts
+``guard_failures_total{check=...}`` before raising, so a metrics snapshot
+shows guard coverage and hit rate. Guard mode is pure host-side
+instrumentation — it never changes what is compiled or dispatched, which is
+what the guard-mode run of the engine-parity fuzzer (``./ci.sh chaos``)
+proves.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import obs
+from ..errors import GPUFFTError, HostExecutionError
+
+GUARD_ENV = "SPFFT_TPU_GUARD"
+
+
+def guard_enabled(explicit: bool | None = None) -> bool:
+    """Whether guard mode is active: an explicit ``guard=`` argument wins,
+    else the ``SPFFT_TPU_GUARD`` env knob (default off)."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(GUARD_ENV, "0") == "1"
+
+
+def execution_error(platform: str):
+    """The typed exception class for an execution-level failure on
+    ``platform``: host plans raise :class:`HostExecutionError`, accelerator
+    plans :class:`GPUFFTError` (the reference's dual error surface)."""
+    return HostExecutionError if str(platform) == "cpu" else GPUFFTError
+
+
+def _fail(check: str, platform: str, message: str):
+    obs.counter("guard_failures_total", check=check).inc()
+    raise execution_error(platform)(f"guard [{check}]: {message}")
+
+
+def check_array(arr, *, check: str, platform: str, shape=None, dtype=None):
+    """Validate one array (or each array of a per-shard list): finite
+    values, and optionally an exact shape/dtype contract. Raises the
+    platform's typed execution error on the first violation; returns the
+    input unchanged so calls can be threaded inline."""
+    obs.counter("guard_checks_total", check=check).inc()
+    arrays = arr if isinstance(arr, (list, tuple)) else (arr,)
+    for i, a in enumerate(arrays):
+        if a is None:  # multi-host: remote shards are None by contract
+            continue
+        a = np.asarray(a)
+        tag = f"{check}[{i}]" if len(arrays) > 1 else check
+        if shape is not None and tuple(a.shape) != tuple(shape):
+            _fail(check, platform, f"{tag} shape {a.shape} != expected {tuple(shape)}")
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            _fail(check, platform, f"{tag} dtype {a.dtype} != expected {np.dtype(dtype)}")
+        if np.issubdtype(a.dtype, np.floating) or np.issubdtype(
+            a.dtype, np.complexfloating
+        ):
+            finite = np.isfinite(a)
+            if not finite.all():
+                bad = int(a.size - int(np.count_nonzero(finite)))
+                _fail(
+                    check,
+                    platform,
+                    f"{tag}: {bad} non-finite value(s) of {a.size}",
+                )
+    return arr
+
+
+def check_device(tree, device, *, check: str, platform: str):
+    """Validate that every device-resident array in ``tree`` still lives on
+    the plan's bound ``device`` — placement drift means a later dispatch
+    would silently recompile or cross-copy."""
+    import jax
+
+    obs.counter("guard_checks_total", check=check).inc()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        devices = getattr(leaf, "devices", None)
+        if not callable(devices):
+            continue
+        try:
+            devs = devices()
+        except (RuntimeError, ValueError):  # deleted/donated buffers: skip
+            continue
+        if device not in devs:
+            _fail(
+                check,
+                platform,
+                f"result on {sorted(str(d) for d in devs)} but the plan is "
+                f"bound to {device}",
+            )
+    return tree

@@ -1,0 +1,115 @@
+"""The graceful-degradation ladder: record fallbacks, type-convert failures.
+
+The runtime's ladder, in order (docs/details.md "Failure model & degradation
+ladder"):
+
+1. **Engine fallback** — an MXU/pallas engine that fails to lower or compile
+   (injectable via the ``engine.compile`` site) degrades to the ``jnp.fft``
+   engine instead of failing plan construction
+   (:func:`engine_fallback`, ``engine_fallbacks_total`` metric).
+2. **Wisdom resilience** — store corruption is quarantined once
+   (``*.corrupt``), transient write failures get bounded retry with backoff
+   (``wisdom_retries_total``), and a dead store degrades to the model policy
+   (:mod:`spfft_tpu.tuning.wisdom`).
+3. **Trial isolation** — a tuning candidate that fails becomes an ``error``
+   trial row; all candidates failing degrades to the model policy
+   (:mod:`spfft_tpu.tuning.runner`).
+4. **Typed execution errors** — dispatch/fence failures that cannot be
+   degraded raise :class:`~spfft_tpu.errors.HostExecutionError` /
+   :class:`~spfft_tpu.errors.GPUFFTError` (:func:`typed_execution`) instead
+   of leaking raw backend exceptions.
+5. **Optional introspection degrades silently-but-recorded** — compiled-stats
+   failure (``hlo.stats`` site) drops the ``compiled`` card section and
+   records the degradation instead of failing ``plan.report()``.
+
+Every rung records what it did: an entry in the owning plan's
+``degradations`` list (surfaced schema-pinned in the plan card) plus a
+``degradations_total{event=...}`` counter — a degraded plan is always
+diagnosable after the fact.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .. import obs
+from ..errors import GenericError
+from .guard import execution_error
+from .plane import InjectedFault
+
+# Failure classes the ladder treats as "the backend/engine blew up" and may
+# degrade: injected faults, XLA runtime/compile errors (RuntimeError
+# subclasses), and unimplemented-lowering holes. Deliberately excludes the
+# typed spfft_tpu.errors hierarchy (user/parameter errors must surface) and
+# Python programming errors (TypeError/AttributeError are bugs, not faults).
+ENGINE_BUILD_ERRORS = (InjectedFault, RuntimeError, NotImplementedError)
+
+_tls = threading.local()
+
+
+def summarize(exc: BaseException, limit: int = 200) -> str:
+    """One-line ``"Type: first message line"`` summary of an exception — the
+    single formatting rule for degradation reasons and trial error rows."""
+    first = str(exc).splitlines()[0] if str(exc) else ""
+    return f"{type(exc).__name__}: {first}"[:limit]
+
+
+@contextlib.contextmanager
+def collecting(sink: list):
+    """Route :func:`record_degradation` entries into ``sink`` for the scope —
+    plan constructors wrap their build so every fallback taken lands on the
+    plan's own ``degradations`` list (nested plan builds, e.g. tuning trials,
+    push their own sink and do not leak into the outer plan's)."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(sink)
+    try:
+        yield sink
+    finally:
+        stack.pop()
+
+
+def record_degradation(event: str, reason: str, **extra) -> dict:
+    """Record one degradation: count ``degradations_total{event=...}`` and
+    append ``{"event", "reason", **extra}`` to the innermost
+    :func:`collecting` sink (if any). Returns the entry so callers outside a
+    collecting scope (plan-card assembly) can place it themselves."""
+    entry = {"event": str(event), "reason": str(reason), **extra}
+    obs.counter("degradations_total", event=str(event)).inc()
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack[-1].append(entry)
+    return entry
+
+
+def engine_fallback(from_engine: str, to_engine: str, reason: str) -> dict:
+    """Record rung 1 of the ladder: an engine construction failure degraded
+    ``from_engine`` -> ``to_engine`` (``engine_fallbacks_total`` metric plus
+    a ``degradations`` entry on the plan being built)."""
+    obs.counter(
+        "engine_fallbacks_total",
+        **{"from": str(from_engine), "to": str(to_engine)},
+    ).inc()
+    return record_degradation(
+        "engine_fallback",
+        reason,
+        **{"from": str(from_engine), "to": str(to_engine)},
+    )
+
+
+@contextlib.contextmanager
+def typed_execution(platform: str, op: str):
+    """Convert backend execution failures inside the scope into the typed
+    error surface: :class:`HostExecutionError` on CPU plans,
+    :class:`GPUFFTError` on accelerator plans (rung 4). Typed
+    :mod:`spfft_tpu.errors` exceptions pass through untouched; the original
+    exception rides as ``__cause__``. Each conversion counts
+    ``execution_failures_total{op=...}``."""
+    try:
+        yield
+    except GenericError:
+        raise
+    except ENGINE_BUILD_ERRORS + (FloatingPointError,) as e:
+        obs.counter("execution_failures_total", op=str(op)).inc()
+        raise execution_error(platform)(f"{op} failed: {e}") from e

@@ -1,0 +1,258 @@
+"""Process-global fault-injection plane: named sites, armed kinds, rates.
+
+Failure paths are first-class, testable code here, the way distributed FFT
+stacks treat backend/scheduler failure (AccFFT's error plane, DaggerFFT's
+scheduler faults) rather than accidents: every fallback claim the runtime
+makes ("tuning degrades, never fails", "a corrupt wisdom store is bypassed",
+"an MXU lowering failure falls back to jnp.fft") is provable by arming the
+fault site that triggers it and asserting the ladder's response.
+
+**Sites** (:data:`SITES`) are named checkpoints threaded through the runtime
+— ``tuning.trial``, ``wisdom.load``, ``wisdom.save``, ``engine.compile``,
+``engine.execute``, ``exchange.build``, ``hlo.stats``, ``sync.fence`` — each
+a single :func:`site` call at the point where that operation can really
+fail. ``programs/lint.py`` enforces that every ``faults.site(...)`` call
+names a registered site and that every registered site is threaded through
+the package and documented.
+
+**Kinds** (:data:`KINDS`):
+
+- ``raise`` — raise :class:`InjectedFault` at the site (the generic
+  backend-blew-up case; the surrounding ladder must convert it to a typed
+  :mod:`spfft_tpu.errors` exception or degrade),
+- ``nan`` / ``corrupt`` — poison the site's data payload with NaN /
+  Inf-or-mangled-text (guard mode and the wisdom quarantine must catch it),
+- ``delay`` — sleep ``SPFFT_TPU_FAULTS_DELAY_S`` seconds (timeout/backoff
+  paths; the result must stay correct).
+
+**Arming**: the ``SPFFT_TPU_FAULTS`` env knob
+(``"site=kind[:rate],site=kind[:rate],..."``, parsed at import) or the
+:func:`inject` context manager / :func:`arm` programmatically. Sub-1.0 rates
+draw from one process-global ``random.Random`` seeded by
+``SPFFT_TPU_FAULTS_SEED`` (:func:`reseed`), so a chaos run replays
+deterministically. Disarmed, :func:`site` is one falsy-dict check — the same
+no-overhead-when-off discipline as ``SPFFT_TPU_METRICS=0``'s shared no-op
+instruments.
+
+Every fired injection counts into the run-metrics registry
+(``faults_injected_total{site,kind}``), so a chaos run's metrics snapshot
+shows exactly what was injected where.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+
+from .. import obs
+from ..errors import InvalidParameterError
+
+FAULTS_ENV = "SPFFT_TPU_FAULTS"
+FAULTS_SEED_ENV = "SPFFT_TPU_FAULTS_SEED"
+FAULTS_DELAY_ENV = "SPFFT_TPU_FAULTS_DELAY_S"
+
+# Canonical injection-site vocabulary. Each name is one faults.site(...) call
+# in the runtime; programs/lint.py enforces the list both ways (every call
+# registered, every registration threaded through the package + documented in
+# docs/details.md "Failure model & degradation ladder").
+SITES = (
+    "tuning.trial",
+    "wisdom.load",
+    "wisdom.save",
+    "engine.compile",
+    "engine.execute",
+    "exchange.build",
+    "hlo.stats",
+    "sync.fence",
+)
+
+KINDS = ("raise", "nan", "corrupt", "delay")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed ``raise`` fault site.
+
+    Deliberately a ``RuntimeError`` subclass: the degradation ladder treats an
+    injected failure exactly like a real backend failure (XLA's runtime
+    errors are ``RuntimeError`` subclasses too), so the same ``except`` arms
+    that catch production faults catch injected ones — chaos tests exercise
+    the real handlers, not injection-only shims."""
+
+
+_lock = threading.Lock()
+_armed: dict = {}  # site -> {"kind": str, "rate": float}
+_rng = random.Random(int(os.environ.get(FAULTS_SEED_ENV, "0") or "0"))
+
+
+def parse_spec(spec: str) -> dict:
+    """Parse a ``"site=kind[:rate],..."`` arming spec into
+    ``{site: {"kind", "rate"}}``; validates site names, kinds, and rates."""
+    table: dict = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, action = part.partition("=")
+        name = name.strip()
+        if not sep or not action:
+            raise InvalidParameterError(
+                f"malformed fault spec {part!r}: expected site=kind[:rate]"
+            )
+        kind, _, rate_s = action.strip().partition(":")
+        if name not in SITES:
+            raise InvalidParameterError(
+                f"unknown fault site {name!r}: expected one of {SITES}"
+            )
+        if kind not in KINDS:
+            raise InvalidParameterError(
+                f"unknown fault kind {kind!r}: expected one of {KINDS}"
+            )
+        try:
+            rate = float(rate_s) if rate_s else 1.0
+        except ValueError as e:
+            raise InvalidParameterError(
+                f"malformed fault rate {rate_s!r} in {part!r}"
+            ) from e
+        if not 0.0 <= rate <= 1.0:
+            raise InvalidParameterError(
+                f"fault rate must be in [0, 1], got {rate}"
+            )
+        table[name] = {"kind": kind, "rate": rate}
+    return table
+
+
+def arm(spec) -> None:
+    """Arm fault sites from a spec string (``"site=kind[:rate],..."``) or a
+    pre-parsed ``{site: {"kind", "rate"}}`` table (``rate`` optional,
+    defaulting to 1.0), merging over what is already armed."""
+    table = parse_spec(spec) if isinstance(spec, str) else dict(spec)
+    normalized = {}
+    for name, fault in table.items():
+        if name not in SITES:
+            raise InvalidParameterError(
+                f"unknown fault site {name!r}: expected one of {SITES}"
+            )
+        if fault.get("kind") not in KINDS:
+            raise InvalidParameterError(
+                f"unknown fault kind {fault.get('kind')!r}: expected one of {KINDS}"
+            )
+        rate = float(fault.get("rate", 1.0))
+        if not 0.0 <= rate <= 1.0:
+            raise InvalidParameterError(
+                f"fault rate must be in [0, 1], got {rate}"
+            )
+        normalized[name] = {"kind": fault["kind"], "rate": rate}
+    with _lock:
+        _armed.update(normalized)
+
+
+def disarm(site_name: str | None = None) -> None:
+    """Disarm one site, or every site when ``site_name`` is None."""
+    with _lock:
+        if site_name is None:
+            _armed.clear()
+        else:
+            _armed.pop(site_name, None)
+
+
+def armed() -> dict:
+    """Copy of the currently armed table (``{site: {"kind", "rate"}}``)."""
+    with _lock:
+        return {k: dict(v) for k, v in _armed.items()}
+
+
+def reseed(seed: int | None = None) -> None:
+    """Reseed the sub-1.0-rate draw stream (default: ``SPFFT_TPU_FAULTS_SEED``,
+    else 0) — a chaos run with fractional rates replays exactly."""
+    if seed is None:
+        seed = int(os.environ.get(FAULTS_SEED_ENV, "0") or "0")
+    with _lock:
+        _rng.seed(int(seed))
+
+
+@contextlib.contextmanager
+def inject(spec):
+    """Scoped arming: apply ``spec`` on top of the current table, restore the
+    previous table on exit (exception-safe) — the programmatic counterpart of
+    ``SPFFT_TPU_FAULTS`` for chaos tests."""
+    with _lock:
+        saved = {k: dict(v) for k, v in _armed.items()}
+    arm(spec)
+    try:
+        yield
+    finally:
+        with _lock:
+            _armed.clear()
+            _armed.update(saved)
+
+
+def _poison(payload, value: float):
+    """NaN/Inf-poison every array leaf of ``payload`` (jax or numpy; works
+    on device without a host roundtrip); non-array payloads pass through."""
+    import jax
+
+    def leaf(a):
+        if hasattr(a, "dtype") and hasattr(a, "shape"):
+            return a * value
+        return a
+
+    return jax.tree_util.tree_map(leaf, payload)
+
+
+def _corrupt(payload):
+    """Mangle a data payload: text/bytes get truncated + garbage appended
+    (downstream parsers must reject it); arrays get Inf-poisoned (guard mode
+    must catch it); anything else passes through unchanged."""
+    if isinstance(payload, str):
+        return payload[: len(payload) // 2] + "\x00<injected corruption>"
+    if isinstance(payload, (bytes, bytearray)):
+        return bytes(payload[: len(payload) // 2]) + b"\x00<injected corruption>"
+    return _poison(payload, float("inf"))
+
+
+def site(name: str, payload=None):
+    """Fault checkpoint ``name``; returns ``payload`` (possibly poisoned).
+
+    Disarmed (the common case) this is a single falsy-dict check. Armed, the
+    site fires with its configured probability: ``raise`` raises
+    :class:`InjectedFault`, ``delay`` sleeps, ``nan``/``corrupt`` return a
+    poisoned copy of ``payload``. Callers pass the data flowing through the
+    site as ``payload`` and use the return value in its place."""
+    if not _armed:
+        return payload
+    fault = _armed.get(name)
+    if fault is None:
+        return payload
+    rate = fault["rate"]
+    if rate <= 0.0:
+        return payload
+    if rate < 1.0:
+        with _lock:
+            draw = _rng.random()
+        if draw >= rate:
+            return payload
+    kind = fault["kind"]
+    if payload is None and kind in ("nan", "corrupt"):
+        # nothing flows through this site to poison: a genuine no-op, NOT
+        # counted — faults_injected_total must never claim injections that
+        # had zero effect
+        return payload
+    obs.counter("faults_injected_total", site=name, kind=kind).inc()
+    if kind == "raise":
+        raise InjectedFault(f"injected fault at site {name!r}")
+    if kind == "delay":
+        time.sleep(float(os.environ.get(FAULTS_DELAY_ENV, "0.005")))
+        return payload
+    if kind == "nan":
+        return _poison(payload, float("nan"))
+    return _corrupt(payload)
+
+
+# Env arming at import: the SPFFT_TPU_FAULTS knob makes whole test suites /
+# CLIs runnable under injection without code changes (ci.sh chaos stage).
+_env_spec = os.environ.get(FAULTS_ENV)
+if _env_spec:
+    arm(_env_spec)
+del _env_spec

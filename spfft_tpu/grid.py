@@ -193,6 +193,7 @@ class Grid:
         precision: str = "highest",
         device=None,
         policy: str | None = None,
+        guard: bool | None = None,
     ):
         """Create a transform bound to this grid.
 
@@ -224,6 +225,7 @@ class Grid:
                 engine=engine,
                 precision=precision,
                 policy=policy,
+                guard=guard,
             )
         from .transform import Transform
 
@@ -242,4 +244,5 @@ class Grid:
             precision=precision,
             device=device,
             policy=policy,
+            guard=guard,
         )

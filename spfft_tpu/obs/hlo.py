@@ -78,7 +78,15 @@ def compiled_stats(lowered) -> dict:
     text, and whatever ``compiled.memory_analysis()`` exposes on this backend
     (peak/argument/output/temp/code bytes; every field is best-effort — some
     runtimes return nothing).
+
+    Fault site ``hlo.stats`` fires before lowering text is read: compiled
+    introspection is an *optional* plan-card layer, so a failure here must
+    degrade ``plan.report(include_compiled=True)`` (card omits ``compiled``,
+    degradation recorded) rather than fail it — obs.plancard owns that catch.
     """
+    from .. import faults
+
+    faults.site("hlo.stats")
     hlo = lowered.as_text()
     t0 = time.perf_counter()
     compiled = lowered.compile()

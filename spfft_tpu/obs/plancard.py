@@ -37,7 +37,12 @@ REQUIRED_KEYS = (
     "policy",
     "platform",
     "execution",
+    "degradations",
 )
+# Every fallback the degradation ladder took for this plan
+# (spfft_tpu.faults.ladder): always present ([] on a healthy plan) so a
+# degraded plan is diagnosable from its card alone.
+DEGRADATION_KEYS = ("event", "reason")
 DISTRIBUTED_KEYS = ("num_shards", "mesh", "decomposition", "exchange")
 EXCHANGE_KEYS = ("discipline", "wire_dtype", "wire_bytes", "rounds", "transport")
 POLICY_KEYS = ("round_cost_bytes", "one_shot_supported", "chosen", "alternatives")
@@ -182,6 +187,10 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         "policy": getattr(transform, "_policy", "default"),
         "platform": _platform_of(transform),
         "execution": ex.describe(),
+        # fallbacks taken while building this plan (spfft_tpu.faults.ladder)
+        "degradations": [
+            dict(d) for d in getattr(transform, "_degradations", ())
+        ],
     }
     tuning_record = getattr(transform, "_tuning", None)
     if tuning_record is not None:
@@ -212,9 +221,18 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         else:
             card["exchange_policy"] = _exchange_policy_1d(transform)
     if include_compiled:
+        from ..faults import InjectedFault, record_degradation, summarize
         from .hlo import compiled_stats
 
-        card["compiled"] = compiled_stats(ex.lowered_backward())
+        # Compiled introspection is optional (ladder rung 5): a lowering/
+        # compile/stats failure (fault site hlo.stats) degrades to a card
+        # without the "compiled" section, recorded — never a failed report().
+        try:
+            card["compiled"] = compiled_stats(ex.lowered_backward())
+        except (InjectedFault, RuntimeError, OSError) as e:
+            card["degradations"].append(
+                record_degradation("hlo_stats_unavailable", summarize(e))
+            )
     return card
 
 
@@ -230,6 +248,10 @@ def validate_plan_card(card: dict) -> list:
     missing = [k for k in REQUIRED_KEYS if k not in card]
     if card.get("schema") not in (None, PLAN_CARD_SCHEMA):
         missing.append(f"schema (unknown: {card['schema']!r})")
+    for i, entry in enumerate(card.get("degradations", ())):
+        missing.extend(
+            f"degradations[{i}].{k}" for k in DEGRADATION_KEYS if k not in entry
+        )
     if card.get("kind") == "distributed":
         missing.extend(k for k in DISTRIBUTED_KEYS if k not in card)
         missing.extend(

@@ -53,6 +53,17 @@ def mesh_process_span(mesh) -> int:
     return len({d.process_index for d in mesh.devices.flat})
 
 
+def exchange_build_checkpoint() -> None:
+    """Fault checkpoint every distributed engine passes while constructing
+    its exchange machinery (site ``exchange.build`` — an injected failure
+    models the collective/transport layer refusing to build). Plan
+    construction converts a failure that survives the engine-fallback rung
+    into a typed :class:`~spfft_tpu.errors.MPIError` (distributed.py)."""
+    from .. import faults
+
+    faults.site("exchange.build")
+
+
 def _check_multihost_mesh(mesh) -> None:
     """Fail fast at plan creation: multi-process padding requires a dedicated
     1-D fft mesh (multi-axis meshes are single-controller only) — catching it
@@ -406,6 +417,7 @@ class DistributedExecution(PaddingHelpers):
                 f"has {fft_axis_size(mesh)} devices"
             )
         _check_multihost_mesh(mesh)
+        exchange_build_checkpoint()
 
         # ---- static exchange geometry (host-side, baked into the program) ----
         self._S = p.max_num_sticks

@@ -245,9 +245,10 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 f"plan has {p.num_shards} shards but the mesh {FFT_AXIS!r} axis "
                 f"has {fft_axis_size(mesh)} devices"
             )
-        from .execution import _check_multihost_mesh
+        from .execution import _check_multihost_mesh, exchange_build_checkpoint
 
         _check_multihost_mesh(mesh)
+        exchange_build_checkpoint()
         rt = self.real_dtype
         r2c = self.is_r2c
         S = p.max_num_sticks

@@ -53,7 +53,7 @@ from ..types import (
     ScalingType,
     TransformType,
 )
-from .execution import PaddingHelpers
+from .execution import PaddingHelpers, exchange_build_checkpoint
 
 AX1 = "fft"   # x-group / y-slab axis (size P1)
 AX2 = "fft2"  # z-slab axis (size P2)
@@ -228,6 +228,7 @@ class Pencil2Execution(PaddingHelpers):
                 f"plan has {p.num_shards} shards but the mesh is {P1}x{P2}"
             )
         self.P1, self.P2 = P1, P2
+        exchange_build_checkpoint()
         S, Z, Y, Xf = p.max_num_sticks, p.dim_z, p.dim_y, p.dim_x_freq
         self._S, self._V = S, p.max_num_values
 

@@ -23,6 +23,8 @@ import numpy as np
 
 import jax
 
+from . import obs
+
 # Runtimes whose block_until_ready is known not to wait for execution. The
 # tunneled TPU identifies as platform "tpu" with "axon" only in the client's
 # platform_version string, so both the platform name and the version string are
@@ -56,7 +58,10 @@ def _on_advisory_platform(leaf) -> bool:
         return False
     try:
         devs = devices()
-    except Exception:
+    except (RuntimeError, ValueError, AttributeError) as e:
+        # a leaf whose devices() dies (deleted buffer, torn-down backend) is
+        # treated as non-advisory — but counted, never silently dropped
+        obs.counter("sync_probe_failures_total", error=type(e).__name__).inc()
         return False
     return any(
         d.platform in ADVISORY_PLATFORMS or _client_is_advisory(d.client)
@@ -84,7 +89,15 @@ def fence(tree):
     across every leaf and shard are fetched in ONE batched ``jax.device_get``:
     on the tunneled platform each host fetch carries a fixed ~110 ms transport
     cost, so a per-shard loop would bill that cost P times per fence.
+
+    Fault site ``sync.fence`` fires before the wait: an injected failure here
+    models a runtime whose completion machinery died mid-transform — the
+    transform paths convert it to a typed execution error
+    (:func:`spfft_tpu.faults.typed_execution`).
     """
+    from . import faults
+
+    faults.site("sync.fence")
     jax.block_until_ready(tree)
     force = _advisory_override()
     if force is False:

@@ -12,10 +12,10 @@ import numpy as np
 
 import jax
 
-from . import obs, timing
+from . import faults, obs, timing
 from .tuning import env_overrides
-from .errors import InvalidParameterError
-from .execution import LocalExecution, as_pair, from_pair
+from .errors import FFTWError, InvalidParameterError
+from .execution import LocalExecution, _complex_dtype, as_pair, from_pair
 from .sync import fence
 from .grid import Grid, device_for_processing_unit
 from .parameters import make_local_parameters
@@ -52,6 +52,7 @@ class Transform:
         precision: str = "highest",
         device=None,
         policy: str | None = None,
+        guard: bool | None = None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -138,6 +139,12 @@ class Transform:
         from .parallel.policy import resolve_policy
 
         self._policy = resolve_policy(policy)
+        # Guard mode (spfft_tpu.faults.guard): explicit kwarg wins, else the
+        # SPFFT_TPU_GUARD env knob. Every fallback the construction or the
+        # degradation ladder takes lands on _degradations (surfaced
+        # schema-pinned in the plan card's "degradations" section).
+        self._guard = faults.guard_enabled(guard)
+        self._degradations: list = []
         self._tuning = None
         engine_env = {}
         if engine == "auto" and self._policy == "tuned":
@@ -168,9 +175,10 @@ class Transform:
                         policy="default",
                     )
 
-            choice, self._tuning = tuning.tuned_local(
-                p, device, self._real_dtype, precision, build
-            )
+            with faults.collecting(self._degradations):
+                choice, self._tuning = tuning.tuned_local(
+                    p, device, self._real_dtype, precision, build
+                )
             engine = choice["engine"]
             engine_env = dict(choice.get("env") or {})
         # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
@@ -178,24 +186,41 @@ class Transform:
         # execution.py) wins on CPU where pocketfft is the fast path.
         if engine == "auto":
             engine = "xla" if device.platform == "cpu" else "mxu"
+        if engine not in ("mxu", "xla"):
+            raise InvalidParameterError(f"unknown engine {engine!r}")
         # Plan-creation timing scope, parity with the reference's "Execution init"
-        # (reference: src/execution/execution_host.cpp:56).
-        with timing.scoped("Execution init"):
+        # (reference: src/execution/execution_host.cpp:56). Degradation ladder
+        # rung 1: an MXU engine that fails to lower/compile (fault site
+        # engine.compile) falls back to the jnp.fft engine instead of failing
+        # plan construction; the fallback is recorded on the plan card and in
+        # engine_fallbacks_total. A jnp.fft engine failure has no rung below
+        # it and raises typed FFTWError.
+        with timing.scoped("Execution init"), faults.collecting(self._degradations):
             if engine == "mxu":
                 from .execution_mxu import MxuLocalExecution
 
-                # engine_env: a tuned candidate's knob overrides (empty ->
-                # os.environ untouched; see tuning.env_overrides)
-                with env_overrides(engine_env):
-                    self._exec = MxuLocalExecution(
-                        self._params, self._real_dtype, device=device, precision=precision
+                try:
+                    faults.site("engine.compile")
+                    # engine_env: a tuned candidate's knob overrides (empty ->
+                    # os.environ untouched; see tuning.env_overrides)
+                    with env_overrides(engine_env):
+                        self._exec = MxuLocalExecution(
+                            self._params, self._real_dtype, device=device, precision=precision
+                        )
+                    self._native_transposed = True
+                except faults.ENGINE_BUILD_ERRORS as e:
+                    faults.engine_fallback("mxu", "xla", faults.summarize(e))
+                    engine = "xla"
+            if engine == "xla":
+                try:
+                    self._exec = LocalExecution(
+                        self._params, self._real_dtype, device=device
                     )
-                self._native_transposed = True
-            elif engine == "xla":
-                self._exec = LocalExecution(self._params, self._real_dtype, device=device)
+                except faults.ENGINE_BUILD_ERRORS as e:
+                    raise FFTWError(
+                        f"local engine construction failed: {e}"
+                    ) from e
                 self._native_transposed = False
-            else:
-                raise InvalidParameterError(f"unknown engine {engine!r}")
         self._engine = engine
         self._precision = precision
         self._space_data = None
@@ -217,15 +242,34 @@ class Transform:
         # host-visible phases (reference: src/spfft/transform_internal.cpp:255;
         # stage-level attribution lives in profiler traces — see timing module doc).
         obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
+        plat = self._device.platform
         with timing.scoped("backward"):
+            if self._guard:
+                faults.check_array(
+                    np.asarray(values), check="backward input", platform=plat
+                )
             out = self._dispatch_backward(values)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"), obs.phase_timer(
                     "wait_seconds", direction="backward"
-                ):
+                ), faults.typed_execution(plat, "backward wait"):
                     fence(out)
             with timing.scoped("output staging"):
-                return self._finalize_backward(out)
+                result = self._finalize_backward(out)
+            if self._guard:
+                faults.check_device(
+                    out, self._device, check="backward output", platform=plat
+                )
+                faults.check_array(
+                    result,
+                    check="backward output",
+                    platform=plat,
+                    shape=(self.dim_z, self.dim_y, self.dim_x),
+                    dtype=self._real_dtype
+                    if self._is_r2c
+                    else _complex_dtype(self._real_dtype),
+                )
+            return result
 
     def _dispatch_backward(self, values):
         """Stage inputs and enqueue the backward pipeline; returns the
@@ -245,10 +289,11 @@ class Transform:
             re, im = self._exec.put(re), self._exec.put(im)
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="backward"
-        ):
+        ), faults.typed_execution(self._device.platform, "backward dispatch"):
             # staged copies are dead after the call: donate them so XLA reuses
             # the allocations for pipeline temporaries
             out = self._exec.backward_pair_consuming(re, im)
+            out = faults.site("engine.execute", payload=out)
         self._space_data = out  # engine-native layout; pair for C2C, real for R2C
         return out
 
@@ -283,15 +328,32 @@ class Transform:
         if input_location is not None:
             _validate_data_location(input_location)
         obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
+        plat = self._device.platform
         with timing.scoped("forward"):
+            if self._guard and space is not None:
+                faults.check_array(
+                    np.asarray(space), check="forward input", platform=plat
+                )
             pair = self._dispatch_forward(space, scaling)
             if self._exec_mode == ExecType.SYNCHRONOUS:
                 with timing.scoped("wait"), obs.phase_timer(
                     "wait_seconds", direction="forward"
-                ):
+                ), faults.typed_execution(plat, "forward wait"):
                     fence(pair)
             with timing.scoped("output staging"):
-                return self._finalize_forward(pair)
+                result = self._finalize_forward(pair)
+            if self._guard:
+                faults.check_device(
+                    pair, self._device, check="forward output", platform=plat
+                )
+                faults.check_array(
+                    result,
+                    check="forward output",
+                    platform=plat,
+                    shape=(self.num_local_elements,),
+                    dtype=_complex_dtype(self._real_dtype),
+                )
+            return result
 
     def _dispatch_forward(self, space, scaling):
         """Stage the space-domain input (or reuse the retained buffer) and enqueue
@@ -325,8 +387,9 @@ class Transform:
                     self._space_data = (re, im)
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="forward"
-        ):
-            return self._exec.forward_pair(re, im, ScalingType(scaling))
+        ), faults.typed_execution(self._device.platform, "forward dispatch"):
+            pair = self._exec.forward_pair(re, im, ScalingType(scaling))
+            return faults.site("engine.execute", payload=pair)
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained space buffer; returns the (re, im)
@@ -405,6 +468,7 @@ class Transform:
             engine=self._engine,
             precision=self._precision,
             device=self._device,
+            guard=self._guard,
         )
 
     # ---- introspection --------------------------------------------------------
@@ -496,8 +560,11 @@ class Transform:
         self._exec_mode = ExecType(mode)
 
     def synchronize(self) -> None:
+        # typed conversion mirrors the in-transform waits: ASYNCHRONOUS-mode
+        # plans fence only here, and a fence failure must surface typed
         if self._space_data is not None:
-            fence(self._space_data)
+            with faults.typed_execution(self._device.platform, "synchronize"):
+                fence(self._space_data)
 
 
 def _validate_pu(pu) -> None:

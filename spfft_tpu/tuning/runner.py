@@ -26,11 +26,35 @@ from __future__ import annotations
 import os
 import time
 
-from .. import obs
+from .. import faults, obs
+from ..errors import GenericError
 
 TUNE_REPEATS_ENV = "SPFFT_TPU_TUNE_REPEATS"
 TUNE_WARMUP_ENV = "SPFFT_TPU_TUNE_WARMUP"
 TUNE_CPU_ENV = "SPFFT_TPU_TUNE_CPU"
+
+# Failure classes a trial may swallow into an ``error`` row: the typed
+# spfft_tpu.errors surface (a candidate whose geometry the engine rejects),
+# backend/compile blowups (XLA runtime errors are RuntimeError subclasses;
+# InjectedFault deliberately is too), missing lowerings, host OOM and I/O.
+# Anything else — TypeError, AttributeError, KeyboardInterrupt — is a bug or
+# an interrupt and must propagate, not become a quiet trial failure.
+TRIAL_ERRORS = (
+    GenericError,
+    RuntimeError,
+    NotImplementedError,
+    ValueError,
+    MemoryError,
+    OSError,
+)
+
+
+class TrialDegradedError(RuntimeError):
+    """A trial plan silently degraded away from its candidate (the engine
+    fallback rung fired inside the trial build): its timing would measure the
+    *fallback*, not the candidate, and persisting it would poison wisdom with
+    a mislabeled number. Raised inside the isolation scope so the candidate
+    becomes an honest ``error`` row instead."""
 
 
 def trial_budget() -> tuple:
@@ -117,15 +141,29 @@ def run_trials(build, candidates: list) -> list:
     imbalanced geometry the model rejects it for) yields an ``error`` row
     instead of an ``ms`` row and sorts last — tuning degrades, never fails
     plan construction (the caller falls back to the model policy when NO
-    candidate measured)."""
+    candidate measured). Only the failure classes in :data:`TRIAL_ERRORS`
+    are isolated (counted via ``tuning_trial_failures_total``); programming
+    errors propagate. Fault site ``tuning.trial`` fires inside the isolation
+    scope, so chaos runs prove the all-candidates-failed fallback."""
     rows, failed = [], []
     for cand in candidates:
         try:
+            faults.site("tuning.trial")
             trial = build(cand)
+            degraded = [
+                d["event"]
+                for d in getattr(trial, "_degradations", ())
+                if d.get("event") == "engine_fallback"
+            ]
+            if degraded:
+                raise TrialDegradedError(
+                    f"trial plan fell back ({degraded[0]}): timing would not "
+                    "measure the candidate"
+                )
             seconds = measure_candidate(trial)
-        except Exception as e:
+        except TRIAL_ERRORS as e:
             obs.counter("tuning_trial_failures_total", candidate=cand["label"]).inc()
-            failed.append(dict(cand, error=str(e).splitlines()[0][:200]))
+            failed.append(dict(cand, error=faults.summarize(e)))
             continue
         obs.counter("tuning_trials_total", candidate=cand["label"]).inc()
         row = dict(cand)

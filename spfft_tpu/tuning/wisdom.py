@@ -9,8 +9,16 @@ Two stores exist behind one interface:
   ``SPFFT_TPU_WISDOM`` env knob. Versioned schema (:data:`WISDOM_SCHEMA`);
   a corrupted file or a schema-version mismatch degrades to an empty store
   (every lookup misses, ``fallback_reason`` says why) instead of raising —
-  plan construction must never fail because wisdom rotted. Writes are atomic
-  (tempfile + ``os.replace``) so concurrent tuners cannot tear the file.
+  plan construction must never fail because wisdom rotted. A corrupt file is
+  additionally *quarantined*: renamed to ``*.corrupt`` and warned about once
+  per process (``wisdom_quarantined_total`` metric), so the broken JSON is
+  parsed once, not on every plan construction. Writes are atomic (tempfile +
+  ``os.replace``) so concurrent tuners cannot tear the file, and transient
+  write failures get bounded retry with exponential backoff
+  (``wisdom_retries_total``; exhausted retries degrade to a recorded
+  ``wisdom_save_failed`` event — the plan keeps its measured choice, only
+  persistence is lost). Fault sites ``wisdom.load`` / ``wisdom.save``
+  (:mod:`spfft_tpu.faults`) make both paths chaos-testable.
 - :class:`MemoryStore` — the process-global fallback when ``SPFFT_TPU_WISDOM``
   is unset: repeated constructions in one process still reuse trials, nothing
   persists.
@@ -29,9 +37,18 @@ import os
 import tempfile
 import threading
 import time
+import warnings
+
+from .. import faults, obs
 
 WISDOM_ENV = "SPFFT_TPU_WISDOM"
 WISDOM_SCHEMA = "spfft_tpu.tuning.wisdom/1"
+
+# Bounded retry for transient wisdom-write failures (NFS hiccups, lock
+# contention): attempts and base backoff of the exponential ladder
+# (0.01 s, 0.02 s between the three attempts).
+WISDOM_SAVE_ATTEMPTS = 3
+WISDOM_SAVE_BACKOFF_S = 0.01
 
 # Ambient engine/exchange env knobs that change measured performance (the
 # docs/details.md engine-knob table, minus pure model/docs knobs). Their
@@ -56,6 +73,9 @@ PERF_ENV_KNOBS = (
 )
 
 _lock = threading.Lock()
+_warn_lock = threading.Lock()  # guards _quarantine_warned (NOT _lock: the
+# quarantine path runs inside _load, which record() calls under _lock)
+_quarantine_warned: set = set()  # paths already warned about (once/process)
 
 
 def env_signature() -> dict:
@@ -123,17 +143,56 @@ class WisdomStore:
         self.path = str(path)
         self.fallback_reason: str | None = None
 
+    def _quarantine(self, why: str) -> None:
+        """Rename a corrupt store to ``<path>.corrupt`` so it is parsed once,
+        not on every plan construction; warn once per process and count
+        ``wisdom_quarantined_total``. A failing rename (permissions, races)
+        keeps the degrade-to-empty behavior without quarantine."""
+        target = self.path + ".corrupt"
+        try:
+            os.replace(self.path, target)
+        except OSError:
+            return
+        obs.counter("wisdom_quarantined_total").inc()
+        faults.record_degradation(
+            "wisdom_quarantined", why, path=self.path, quarantined_to=target
+        )
+        with _warn_lock:
+            first = self.path not in _quarantine_warned
+            _quarantine_warned.add(self.path)
+        if first:
+            warnings.warn(
+                f"corrupt wisdom store {self.path!r} quarantined to "
+                f"{target!r}: {why}",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+
     def _load(self) -> dict:
         """Parse the file into ``{digest: entry}``; empty on absence,
-        corruption, or schema mismatch (recording ``fallback_reason``)."""
+        corruption (which also quarantines the file — see
+        :meth:`_quarantine`), or schema mismatch (recording
+        ``fallback_reason``)."""
         self.fallback_reason = None
         try:
             with open(self.path) as f:
-                doc = json.load(f)
+                text = f.read()
+            # fault site wisdom.load: `corrupt` mangles the text (exercising
+            # the quarantine below), `raise` models an unreadable store
+            text = faults.site("wisdom.load", payload=text)
+            doc = json.loads(text)
         except FileNotFoundError:
             return {}
-        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
-            self.fallback_reason = f"corrupt wisdom file: {str(e).splitlines()[0]}"
+        except faults.InjectedFault as e:
+            self.fallback_reason = f"wisdom load fault: {e}"
+            faults.record_degradation("wisdom_load_failed", str(e), path=self.path)
+            return {}
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self.fallback_reason = f"corrupt wisdom file: {faults.summarize(e)}"
+            self._quarantine(faults.summarize(e))
+            return {}
+        except OSError as e:
+            self.fallback_reason = f"corrupt wisdom file: {faults.summarize(e)}"
             return {}
         if not isinstance(doc, dict) or doc.get("schema") != WISDOM_SCHEMA:
             self.fallback_reason = (
@@ -158,25 +217,52 @@ class WisdomStore:
         cycles would silently drop each other's entries), finished with an
         atomic replace. A corrupt existing file is overwritten with a fresh
         store — the FFTW-wisdom behavior (re-measure and move on, never
-        wedge)."""
-        with _lock:
-            d = os.path.dirname(os.path.abspath(self.path)) or "."
-            os.makedirs(d, exist_ok=True)
-            with _file_lock(self.path + ".lock"):
-                entries = self._load()
-                entries[key_digest(key)] = entry
-                doc = {"schema": WISDOM_SCHEMA, "entries": entries}
-                fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
-                try:
-                    with os.fdopen(fd, "w") as f:
-                        json.dump(doc, f, indent=1, sort_keys=True)
-                    os.replace(tmp, self.path)
-                except BaseException:
-                    try:
-                        os.unlink(tmp)
-                    except OSError:
-                        pass
-                    raise
+        wedge). Transient failures anywhere in the attempt — directory
+        creation, lockfile acquisition, the write itself (fault site
+        ``wisdom.save``) — are retried :data:`WISDOM_SAVE_ATTEMPTS` times
+        with exponential backoff (``wisdom_retries_total``); both locks are
+        re-acquired per attempt and the backoff sleeps OUTSIDE them, so a
+        failing saver never serializes other savers behind its backoff.
+        Exhausted retries degrade to a recorded ``wisdom_save_failed`` event
+        instead of raising — the caller's plan keeps its measured choice,
+        only persistence is lost."""
+        last: Exception | None = None
+        for attempt in range(WISDOM_SAVE_ATTEMPTS):
+            try:
+                faults.site("wisdom.save")
+                with _lock:
+                    d = os.path.dirname(os.path.abspath(self.path)) or "."
+                    os.makedirs(d, exist_ok=True)
+                    with _file_lock(self.path + ".lock"):
+                        entries = self._load()
+                        entries[key_digest(key)] = entry
+                        doc = {"schema": WISDOM_SCHEMA, "entries": entries}
+                        fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
+                        try:
+                            with os.fdopen(fd, "w") as f:
+                                json.dump(doc, f, indent=1, sort_keys=True)
+                            os.replace(tmp, self.path)
+                        except BaseException:
+                            try:
+                                os.unlink(tmp)
+                            except OSError:
+                                pass
+                            raise
+                return
+            except (OSError, faults.InjectedFault) as e:
+                last = e
+                obs.counter("wisdom_retries_total").inc()
+                if attempt < WISDOM_SAVE_ATTEMPTS - 1:
+                    time.sleep(WISDOM_SAVE_BACKOFF_S * (2**attempt))
+        self._save_failed(last)
+
+    def _save_failed(self, exc) -> None:
+        """Exhausted-retry terminal: count and record, never raise (ladder
+        rung 2 — a dead store must not fail plan construction)."""
+        obs.counter("wisdom_save_failures_total").inc()
+        faults.record_degradation(
+            "wisdom_save_failed", str(exc), path=self.path
+        )
 
 
 class MemoryStore:

@@ -1,0 +1,476 @@
+"""Degradation-ladder suite: every rung provable, every fallback recorded.
+
+Covers the ladder order documented in docs/details.md "Failure model &
+degradation ladder": MXU engine-compile failure -> jnp.fft engine fallback
+(parity-correct, recorded), wisdom corruption -> quarantine-once, wisdom
+write failure -> bounded retry with backoff then recorded degrade, trial
+failure -> model policy, plus the plan-card ``degradations`` schema pinning
+and the degradation metrics the obs registry must carry.
+"""
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    errors,
+    faults,
+    obs,
+    tuning,
+)
+from spfft_tpu.parameters import distribute_triplets
+from spfft_tpu.tuning import wisdom as wisdom_mod
+from utils import assert_close
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    faults.disarm()
+    obs.enable()
+    obs.clear()
+    tuning.clear_memory()
+    monkeypatch.delenv(tuning.WISDOM_ENV, raising=False)
+    monkeypatch.delenv(faults.GUARD_ENV, raising=False)
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_WARMUP_ENV, "0")
+    yield
+    faults.disarm()
+    tuning.clear_memory()
+
+
+def _triplets():
+    return sp.create_spherical_cutoff_triplets(DIM, DIM, DIM, 0.8)
+
+
+def _counter(name: str) -> int:
+    snap = obs.snapshot()
+    return sum(v for k, v in snap["counters"].items() if k.startswith(name))
+
+
+# ---- rung 1: engine fallback -------------------------------------------------
+
+
+def test_local_engine_fallback_parity_and_record():
+    trip = _triplets()
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    expect = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    ).backward(values)
+    with faults.inject("engine.compile=raise"):
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            engine="mxu",
+        )
+    assert t._engine == "xla"
+    assert_close(t.backward(values), expect)
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, values)
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    (entry,) = card["degradations"]
+    assert entry["event"] == "engine_fallback"
+    assert entry["from"] == "mxu" and entry["to"] == "xla"
+    assert "InjectedFault" in entry["reason"]
+    assert _counter("engine_fallbacks_total") == 1
+    # the clone of a degraded plan is already on the fallback engine
+    assert t.clone()._engine == "xla"
+
+
+def test_distributed_engine_fallback_keeps_discipline():
+    trip = _triplets()
+    per_shard = distribute_triplets(trip, 2, DIM)
+    with faults.inject("engine.compile=raise"):
+        t = DistributedTransform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            [p.copy() for p in per_shard],
+            mesh=sp.make_fft_mesh(2),
+            engine="mxu",
+            exchange_type=sp.ExchangeType.COMPACT_BUFFERED,
+        )
+    assert t._engine == "xla"
+    assert t.exchange_type == sp.ExchangeType.COMPACT_BUFFERED
+    assert t.report()["degradations"][0]["event"] == "engine_fallback"
+
+
+def test_xla_engine_failure_has_no_rung_below():
+    trip = _triplets()
+    with faults.inject("engine.compile=raise"):
+        # the site guards only MXU lowerings: the jnp.fft engine builds fine
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            engine="xla",
+        )
+    assert t._engine == "xla" and t.report()["degradations"] == []
+    # but a genuinely failing exchange build on the bottom engine is typed
+    per_shard = distribute_triplets(trip, 2, DIM)
+    with faults.inject("exchange.build=raise"):
+        with pytest.raises(errors.MPIError):
+            DistributedTransform(
+                ProcessingUnit.HOST,
+                TransformType.C2C,
+                DIM,
+                DIM,
+                DIM,
+                [p.copy() for p in per_shard],
+                mesh=sp.make_fft_mesh(2),
+                engine="xla",
+            )
+
+
+def test_degraded_trial_never_poisons_wisdom(monkeypatch, tmp_path):
+    """A trial plan that silently fell back (engine.compile dead inside the
+    trial build) must become an error row — its timing measures the fallback,
+    not the candidate — and the measured winner must be an honest label."""
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "w.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    trip = _triplets()
+    with faults.inject("engine.compile=raise"):
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            policy="tuned",
+        )
+    rec = t._tuning
+    # every mxu-flavored candidate failed honestly; xla measured and won
+    assert rec["provenance"] == "wisdom"
+    assert rec["choice"]["engine"] == "xla"
+    by_label = {row["label"]: row for row in rec["trials"]}
+    assert "ms" in by_label["xla"]
+    mxu_rows = [r for label, r in by_label.items() if label != "xla"]
+    assert mxu_rows and all("error" in r for r in mxu_rows)
+    assert all(r["error"].startswith("TrialDegradedError") for r in mxu_rows)
+    # the persisted store carries the honest choice, not a mislabeled mxu
+    stored = tuning.WisdomStore(str(tmp_path / "w.json"))._load()
+    (entry,) = stored.values()
+    assert entry["choice"]["engine"] == "xla"
+
+
+def test_trial_plans_do_not_leak_degradations(monkeypatch, tmp_path):
+    """Fallbacks inside tuning-trial plan builds stay on the trial plan's
+    sink — the outer plan's card records only its own rungs."""
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "w.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    trip = _triplets()
+    t = Transform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        indices=trip,
+        policy="tuned",
+    )
+    assert t._tuning["provenance"] == "wisdom"
+    assert t.report()["degradations"] == []
+
+
+# ---- rung 2: wisdom quarantine + save retry ---------------------------------
+
+
+def test_corrupt_wisdom_is_quarantined_once(monkeypatch, tmp_path):
+    path = tmp_path / "wisdom.json"
+    path.write_text("{definitely not json")
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(path))
+    store = tuning.WisdomStore(str(path))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert store.lookup({"k": 1}) is None
+    assert store.fallback_reason and "corrupt" in store.fallback_reason
+    # renamed, not re-parsed: original gone, *.corrupt holds the bad bytes
+    assert not path.exists()
+    quarantined = tmp_path / "wisdom.json.corrupt"
+    assert quarantined.read_text() == "{definitely not json"
+    assert _counter("wisdom_quarantined_total") == 1
+    warned = [w for w in caught if "quarantined" in str(w.message)]
+    assert len(warned) == 1
+    # subsequent constructions see a missing (not corrupt) store: no reparse,
+    # no second warning, no second quarantine
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        assert tuning.WisdomStore(str(path)).lookup({"k": 1}) is None
+    assert [w for w in caught2 if "quarantined" in str(w.message)] == []
+    assert _counter("wisdom_quarantined_total") == 1
+    # re-measuring writes a fresh healthy store at the original path
+    store.record({"k": 1}, tuning.make_entry({"k": 1}, {"engine": "xla"}, []))
+    assert json.loads(path.read_text())["schema"] == tuning.WISDOM_SCHEMA
+
+
+def test_quarantine_during_plan_construction(monkeypatch, tmp_path):
+    path = tmp_path / "wisdom.json"
+    path.write_text("{broken")
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(path))
+    trip = _triplets()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            policy="tuned",
+        )
+    assert t._tuning["provenance"] == "model"
+    assert "corrupt" in t._tuning["reason"]
+    assert (tmp_path / "wisdom.json.corrupt").exists()
+    assert [w for w in caught if "quarantined" in str(w.message)]
+    # the quarantine rung landed on the plan's own degradations section
+    events = [d["event"] for d in t.report()["degradations"]]
+    assert "wisdom_quarantined" in events
+
+
+def test_wisdom_save_retries_with_backoff(monkeypatch, tmp_path):
+    path = tmp_path / "wisdom.json"
+    store = tuning.WisdomStore(str(path))
+    entry = tuning.make_entry({"k": 2}, {"engine": "xla"}, [])
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    with faults.inject("wisdom.save=raise"):
+        store.record({"k": 2}, entry)  # must NOT raise
+    assert not path.exists()
+    assert _counter("wisdom_retries_total") == wisdom_mod.WISDOM_SAVE_ATTEMPTS
+    assert _counter("wisdom_save_failures_total") == 1
+    # exponential backoff between attempts (not after the last)
+    base = wisdom_mod.WISDOM_SAVE_BACKOFF_S
+    assert sleeps == [base, 2 * base]
+    # transient failure: one loss does not poison later saves
+    store.record({"k": 2}, entry)
+    assert tuning.WisdomStore(str(path)).lookup({"k": 2})["choice"] == {
+        "engine": "xla"
+    }
+
+
+def test_wisdom_save_failure_recorded_on_plan(monkeypatch, tmp_path):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "w.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    trip = _triplets()
+    with faults.inject("wisdom.save=raise"):
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            policy="tuned",
+        )
+    # the measured choice survives; only persistence was lost — and recorded
+    assert t._tuning["provenance"] == "wisdom"
+    events = [d["event"] for d in t.report()["degradations"]]
+    assert "wisdom_save_failed" in events
+    assert not (tmp_path / "w.json").exists()
+    assert obs.validate_plan_card(t.report()) == []
+
+
+def test_empty_exception_message_never_crashes_load(monkeypatch, tmp_path):
+    """A bare OSError() (empty str) from the filesystem must degrade, not
+    IndexError out of plan construction (faults.summarize guards it)."""
+    path = tmp_path / "w.json"
+    path.write_text("{}")
+
+    def broken_open(*a, **k):
+        raise OSError()
+
+    monkeypatch.setattr("builtins.open", broken_open)
+    store = tuning.WisdomStore(str(path))
+    assert store.lookup({"k": 1}) is None
+    assert store.fallback_reason == "corrupt wisdom file: OSError: "
+
+
+def test_lockfile_failure_degrades_not_raises(monkeypatch, tmp_path):
+    """An OSError from lockfile acquisition (read-only dir, ENOLCK on NFS)
+    rides the same retry/degrade path as a failing write — record() never
+    raises out of plan construction."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def broken_lock(path):
+        raise OSError("ENOLCK: no locks available")
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(wisdom_mod, "_file_lock", broken_lock)
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    store = tuning.WisdomStore(str(tmp_path / "w.json"))
+    store.record({"k": 9}, tuning.make_entry({"k": 9}, {"engine": "xla"}, []))
+    assert not (tmp_path / "w.json").exists()
+    assert _counter("wisdom_retries_total") == wisdom_mod.WISDOM_SAVE_ATTEMPTS
+    assert _counter("wisdom_save_failures_total") == 1
+
+
+def test_async_synchronize_failure_is_typed():
+    """ASYNCHRONOUS-mode plans fence only in synchronize(): a fence failure
+    there must surface as the typed execution error, like in-transform waits."""
+    trip = _triplets()
+    rng = np.random.default_rng(5)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    )
+    t.set_execution_mode(sp.ExecType.ASYNCHRONOUS)
+    t.backward(values)
+    with faults.inject("sync.fence=raise"):
+        with pytest.raises(errors.HostExecutionError):
+            t.synchronize()
+
+
+def test_wisdom_corrupt_injection_quarantines(monkeypatch, tmp_path):
+    """The wisdom.load corrupt kind mangles the in-memory text: the parser
+    must reject it and the quarantine rung must fire — chaos-proof that a
+    half-written store can never wedge plan construction."""
+    path = tmp_path / "wisdom.json"
+    store = tuning.WisdomStore(str(path))
+    store.record({"k": 3}, tuning.make_entry({"k": 3}, {"engine": "xla"}, []))
+    with faults.inject("wisdom.load=corrupt"):
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            assert store.lookup({"k": 3}) is None
+    assert "corrupt" in store.fallback_reason
+    assert (tmp_path / "wisdom.json.corrupt").exists()
+
+
+# ---- rung 3/4 metrics + card schema -----------------------------------------
+
+
+def test_degradations_section_always_present():
+    trip = _triplets()
+    card = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    ).report()
+    assert card["degradations"] == []
+    assert obs.validate_plan_card(card) == []
+    # schema pin: a malformed entry is a validation finding
+    bad = dict(card, degradations=[{"event": "x"}])
+    assert "degradations[0].reason" in obs.validate_plan_card(bad)
+    missing = dict(card)
+    del missing["degradations"]
+    assert "degradations" in obs.validate_plan_card(missing)
+
+
+def test_degradation_metrics_snapshot_roundtrip():
+    trip = _triplets()
+    with faults.inject("engine.compile=raise"):
+        Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            engine="mxu",
+        )
+    snap = obs.snapshot()
+    assert obs.validate_snapshot(snap) == []
+    text = obs.prometheus_text(snap)
+    assert "spfft_tpu_engine_fallbacks_total" in text
+    assert "spfft_tpu_degradations_total" in text
+    assert "spfft_tpu_faults_injected_total" in text
+
+
+def test_narrowed_trial_isolation_counts(monkeypatch, tmp_path):
+    """The narrowed TRIAL_ERRORS still isolates engine-layer failures (typed,
+    runtime, missing-lowering) but programming errors propagate."""
+    from spfft_tpu.tuning import runner
+
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    calls = {"n": 0}
+
+    def flaky(transform):
+        calls["n"] += 1
+        raise errors.GPUSupportError("no accelerator for this candidate")
+
+    monkeypatch.setattr(runner, "measure_candidate", flaky)
+    trip = _triplets()
+    t = Transform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        indices=trip,
+        policy="tuned",
+    )
+    assert t._tuning["provenance"] == "model"
+    assert calls["n"] >= 3
+    assert _counter("tuning_trial_failures_total") == calls["n"]
+    assert all(
+        row["error"].startswith("GPUSupportError") for row in t._tuning["trials"]
+    )
+
+    def buggy(transform):
+        raise AttributeError("a bug, not a fault")
+
+    monkeypatch.setattr(runner, "measure_candidate", buggy)
+    tuning.clear_memory()
+    with pytest.raises(AttributeError):
+        Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            policy="tuned",
+        )
+
+
+def test_sync_probe_failure_is_counted(monkeypatch):
+    """The narrowed sync.py handler counts swallowed probe failures."""
+    from spfft_tpu import sync
+
+    class Leaf:
+        def devices(self):
+            raise RuntimeError("backend torn down")
+
+    assert sync._on_advisory_platform(Leaf()) is False
+    assert _counter("sync_probe_failures_total") == 1
+
+
+def test_memory_store_unaffected_by_io_faults(monkeypatch):
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    trip = _triplets()
+    with faults.inject("wisdom.load=raise,wisdom.save=raise"):
+        t = Transform(
+            ProcessingUnit.HOST,
+            TransformType.C2C,
+            DIM,
+            DIM,
+            DIM,
+            indices=trip,
+            policy="tuned",
+        )
+    # the process-memory store does no file I/O: measured wisdom, no losses
+    assert t._tuning["provenance"] == "wisdom"
+    assert t.report()["degradations"] == []
+    assert os.environ.get(tuning.WISDOM_ENV) is None

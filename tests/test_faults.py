@@ -1,0 +1,461 @@
+"""Chaos suite for the fault-injection plane and guard mode (spfft_tpu.faults).
+
+The central invariant (ISSUE acceptance / faults module docstring): with any
+registered fault site armed at rate 1.0, every forward/backward transform
+either raises a *typed* ``spfft_tpu.errors`` exception or returns output
+matching the fault-free run with the fallback recorded (plan-card
+``degradations`` + obs metrics) — never a silent wrong answer.
+``test_chaos_invariant_every_site`` sweeps every site in
+``faults.SITES`` one-at-a-time; the targeted tests pin each site's exact
+ladder response. Guard-mode tests prove the NaN/shape/device checks raise the
+right typed errors, and the capi tests prove the whole errors taxonomy
+round-trips to C error codes (including guard/degradation failures).
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    capi,
+    errors,
+    faults,
+    obs,
+    tuning,
+)
+from spfft_tpu.parameters import distribute_triplets
+from utils import assert_close
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def clean_faults(monkeypatch):
+    """Disarm everything, fresh metrics, isolated tuning state, default
+    rate-draw seed — chaos must never leak between tests."""
+    faults.disarm()
+    faults.reseed(0)
+    obs.enable()
+    obs.clear()
+    tuning.clear_memory()
+    monkeypatch.delenv(tuning.WISDOM_ENV, raising=False)
+    monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+    monkeypatch.delenv(faults.GUARD_ENV, raising=False)
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_WARMUP_ENV, "0")
+    yield
+    faults.disarm()
+    tuning.clear_memory()
+
+
+def _triplets():
+    return sp.create_spherical_cutoff_triplets(DIM, DIM, DIM, 0.8)
+
+
+def _values(trip, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+
+def _local(trip, **kwargs):
+    return Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip, **kwargs
+    )
+
+
+def _dist(per_shard, **kwargs):
+    return DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        [p.copy() for p in per_shard],
+        mesh=sp.make_fft_mesh(2),
+        **kwargs,
+    )
+
+
+def _counter_sum(prefix: str) -> int:
+    snap = obs.snapshot()
+    return sum(v for k, v in snap["counters"].items() if k.startswith(prefix))
+
+
+# ---- plane mechanics ---------------------------------------------------------
+
+
+def test_spec_parsing_and_validation():
+    table = faults.parse_spec("engine.compile=raise, wisdom.load=corrupt:0.5")
+    assert table == {
+        "engine.compile": {"kind": "raise", "rate": 1.0},
+        "wisdom.load": {"kind": "corrupt", "rate": 0.5},
+    }
+    for bad in (
+        "nonsense",
+        "unknown.site=raise",
+        "engine.compile=explode",
+        "engine.compile=raise:2.0",
+        "engine.compile=raise:x",
+    ):
+        with pytest.raises(errors.InvalidParameterError):
+            faults.parse_spec(bad)
+
+
+def test_dict_arm_defaults_rate_and_validates():
+    faults.arm({"sync.fence": {"kind": "delay"}})  # rate omitted -> 1.0
+    assert faults.armed()["sync.fence"] == {"kind": "delay", "rate": 1.0}
+    faults.disarm()
+    with pytest.raises(errors.InvalidParameterError):
+        faults.arm({"sync.fence": {"kind": "delay", "rate": 7}})
+
+
+def test_poison_kind_on_payloadless_site_is_uncounted_noop():
+    with faults.inject("engine.compile=nan"):
+        trip = _triplets()
+        t = _local(trip, engine="mxu")  # site passes no payload: no-op
+    assert t._engine == "mxu"
+    assert t.report()["degradations"] == []
+    assert _counter_sum("faults_injected_total") == 0
+
+
+def test_inject_scoping_restores():
+    assert faults.armed() == {}
+    with faults.inject("sync.fence=delay"):
+        assert "sync.fence" in faults.armed()
+        with faults.inject("hlo.stats=raise"):
+            assert set(faults.armed()) == {"sync.fence", "hlo.stats"}
+        assert set(faults.armed()) == {"sync.fence"}
+    assert faults.armed() == {}
+
+
+def test_disarmed_site_is_noop():
+    payload = object()
+    assert faults.site("sync.fence", payload=payload) is payload
+    assert _counter_sum("faults_injected_total") == 0
+
+
+def test_fractional_rate_is_deterministic_under_seed():
+    def fire_pattern():
+        faults.reseed(1234)
+        pattern = []
+        with faults.inject("sync.fence=raise:0.5"):
+            for _ in range(32):
+                try:
+                    faults.site("sync.fence")
+                    pattern.append(False)
+                except faults.InjectedFault:
+                    pattern.append(True)
+        return pattern
+
+    a, b = fire_pattern(), fire_pattern()
+    assert a == b
+    assert any(a) and not all(a)  # ~half fire at rate 0.5
+
+
+def test_env_arming():
+    """SPFFT_TPU_FAULTS arms at import — proven in a fresh interpreter (the
+    in-process plane was imported long ago)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['SPFFT_TPU_FAULTS'] = 'engine.execute=raise:0.25';"
+        "from spfft_tpu import faults;"
+        "assert faults.armed() == {'engine.execute': {'kind': 'raise', 'rate': 0.25}},"
+        " faults.armed(); print('armed ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        check=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert "armed ok" in out.stdout
+
+
+def test_delay_kind_keeps_results_correct(monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_DELAY_ENV, "0.001")
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    with faults.inject("engine.execute=delay,sync.fence=delay"):
+        t = _local(trip)
+        assert_close(t.backward(values), expect)
+    assert _counter_sum("faults_injected_total") >= 2
+
+
+# ---- the chaos invariant, every site ----------------------------------------
+
+
+@pytest.mark.parametrize("site_name", faults.SITES)
+def test_chaos_invariant_every_site(site_name, tmp_path, monkeypatch):
+    """Arm each registered site at rate 1.0 (kind=raise): the transform pair
+    either raises typed spfft_tpu.errors or matches the fault-free run, with
+    any fallback recorded in the plan card's degradations section."""
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    trip = _triplets()
+    values = _values(trip)
+    # tuned policy + explicit mxu preference: construction exercises the
+    # tuning sites AND the engine.compile ladder in one sweep
+    baseline = _local(trip)
+    expect_b = baseline.backward(values)
+    expect_f = baseline.forward(scaling=ScalingType.FULL)
+    tuning.clear_memory()
+    (tmp_path / "wisdom.json").unlink(missing_ok=True)
+
+    kwargs = dict(policy="tuned") if site_name.startswith(("tuning", "wisdom")) else {}
+    if site_name == "engine.compile":
+        kwargs = dict(engine="mxu")
+    if site_name == "wisdom.load":
+        # populate the wisdom file first so the load site really fires
+        _local(trip, **kwargs)
+        tuning.clear_memory()
+    with faults.inject(f"{site_name}=raise"):
+        try:
+            t = _local(trip, **kwargs)
+            out = t.backward(values)
+            back = t.forward(scaling=ScalingType.FULL)
+        except errors.GenericError as e:
+            # typed failure arm of the invariant: the C shim can translate it
+            assert capi.error_code(e) == int(e.error_code) != int(
+                errors.ErrorCode.SUCCESS
+            )
+            return
+    # fallback arm: parity with the fault-free run, card schema-complete
+    assert_close(out, expect_b)
+    assert_close(back, expect_f)
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    if site_name == "engine.compile":
+        assert card["degradations"], "engine fallback must be recorded"
+
+
+@pytest.mark.parametrize(
+    "site_name", ["exchange.build", "engine.compile", "engine.execute", "sync.fence"]
+)
+def test_chaos_invariant_distributed(site_name):
+    trip = _triplets()
+    values = _values(trip)
+    per_shard = distribute_triplets(trip, 2, DIM)
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(x)] for x in s]) for s in per_shard]
+    expect = _local(trip).backward(values)
+
+    kwargs = dict(engine="mxu") if site_name == "engine.compile" else {}
+    with faults.inject(f"{site_name}=raise"):
+        try:
+            t = _dist(per_shard, **kwargs)
+            out = t.backward([v.copy() for v in vps])
+        except errors.GenericError as e:
+            assert capi.error_code(e) == int(e.error_code) != int(
+                errors.ErrorCode.SUCCESS
+            )
+            return
+    assert_close(out, expect)
+    assert obs.validate_plan_card(t.report()) == []
+    if site_name == "engine.compile":
+        assert t.report()["degradations"][0]["event"] == "engine_fallback"
+
+
+# ---- targeted site behavior --------------------------------------------------
+
+
+def test_engine_execute_raises_typed_error():
+    trip = _triplets()
+    t = _local(trip)
+    with faults.inject("engine.execute=raise"):
+        with pytest.raises(errors.HostExecutionError):
+            t.backward(_values(trip))
+    assert _counter_sum("execution_failures_total") == 1
+
+
+def test_sync_fence_raises_typed_error():
+    trip = _triplets()
+    t = _local(trip)
+    with faults.inject("sync.fence=raise"):
+        with pytest.raises(errors.HostExecutionError):
+            t.backward(_values(trip))
+
+
+def test_exchange_build_raises_mpi_error():
+    per_shard = distribute_triplets(_triplets(), 2, DIM)
+    with faults.inject("exchange.build=raise"):
+        with pytest.raises(errors.MPIError):
+            _dist(per_shard)
+
+
+def test_hlo_stats_degrades_report():
+    trip = _triplets()
+    t = _local(trip)
+    with faults.inject("hlo.stats=raise"):
+        card = t.report(include_compiled=True)
+    assert "compiled" not in card
+    assert card["degradations"][0]["event"] == "hlo_stats_unavailable"
+    assert obs.validate_plan_card(card) == []
+    # fault-free report still carries the compiled section
+    assert "compiled" in t.report(include_compiled=True)
+
+
+def test_tuning_trial_chaos_degrades_to_model(monkeypatch):
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    per_shard = distribute_triplets(_triplets(), 2, DIM)
+    with faults.inject("tuning.trial=raise"):
+        t = _dist(per_shard, policy="tuned")
+    rec = t._tuning
+    assert rec["provenance"] == "model"
+    assert rec["reason"] == "all trial candidates failed"
+    assert rec["trials"] and all(
+        row["error"].startswith("InjectedFault") for row in rec["trials"]
+    )
+    assert t.exchange_type == _dist(per_shard, policy="default").exchange_type
+
+
+# ---- guard mode --------------------------------------------------------------
+
+
+def test_guard_rejects_nonfinite_input():
+    trip = _triplets()
+    t = _local(trip, guard=True)
+    values = _values(trip)
+    values[3] = np.nan
+    with pytest.raises(errors.HostExecutionError) as ei:
+        t.backward(values)
+    assert "non-finite" in str(ei.value)
+    assert _counter_sum("guard_failures_total") == 1
+
+
+def test_guard_catches_nan_poisoned_output():
+    trip = _triplets()
+    t = _local(trip, guard=True)
+    with faults.inject("engine.execute=nan"):
+        with pytest.raises(errors.HostExecutionError) as ei:
+            t.backward(_values(trip))
+    assert "non-finite" in str(ei.value)
+
+
+def test_guard_catches_inf_corrupted_output():
+    trip = _triplets()
+    t = _local(trip, guard=True)
+    with faults.inject("engine.execute=corrupt"):
+        with pytest.raises(errors.HostExecutionError):
+            t.backward(_values(trip))
+
+
+def test_guard_env_knob(monkeypatch):
+    trip = _triplets()
+    monkeypatch.setenv(faults.GUARD_ENV, "1")
+    t = _local(trip)
+    assert t._guard is True
+    # explicit kwarg beats the env knob
+    assert _local(trip, guard=False)._guard is False
+    with faults.inject("engine.execute=nan"):
+        with pytest.raises(errors.HostExecutionError):
+            t.backward(_values(trip))
+
+
+def test_guard_off_lets_nan_flow():
+    """Without guard mode the NaN payload flows (documented: the chaos
+    invariant for data-poisoning kinds requires the guard) — this pins the
+    contract boundary rather than an accident."""
+    trip = _triplets()
+    t = _local(trip, guard=False)
+    with faults.inject("engine.execute=nan"):
+        out = t.backward(_values(trip))
+    assert np.isnan(np.asarray(out)).any()
+    assert _counter_sum("guard_checks_total") == 0
+
+
+def test_guard_counts_checks_and_preserves_numerics():
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    t = _local(trip, guard=True)
+    assert_close(t.backward(values), expect)
+    back = t.forward(scaling=ScalingType.FULL)
+    assert_close(back, values)
+    assert _counter_sum("guard_checks_total") >= 4  # in+out, both directions
+    assert _counter_sum("guard_failures_total") == 0
+
+
+def test_guard_distributed_rejects_poisoned_shard():
+    trip = _triplets()
+    values = _values(trip)
+    per_shard = distribute_triplets(trip, 2, DIM)
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(x)] for x in s]) for s in per_shard]
+    t = _dist(per_shard, guard=True)
+    vps[1] = vps[1].copy()
+    vps[1][0] = np.inf
+    with pytest.raises(errors.HostExecutionError):
+        t.backward(vps)
+
+
+# ---- errors taxonomy through capi -------------------------------------------
+
+
+def _error_classes():
+    return sorted(
+        (
+            cls
+            for cls in vars(errors).values()
+            if inspect.isclass(cls) and issubclass(cls, errors.GenericError)
+        ),
+        key=lambda c: c.__name__,
+    )
+
+
+def test_error_taxonomy_roundtrips_to_c_codes():
+    """Every exception class in the taxonomy carries a distinct enum value
+    and capi.error_code translates an instance back to exactly that value —
+    the C shim's catch-and-translate contract, machine-checked."""
+    classes = _error_classes()
+    assert len(classes) == 21  # GenericError + 20 typed subclasses
+    seen = {}
+    for cls in classes:
+        code = capi.error_code(cls("chaos"))
+        assert code == int(cls.error_code)
+        assert code not in seen, (cls, seen[code])
+        seen[code] = cls
+    # full enum coverage minus SUCCESS and the C-side-only INVALID_HANDLE
+    expected = set(int(c) for c in errors.ErrorCode) - {
+        int(errors.ErrorCode.SUCCESS),
+        int(errors.ErrorCode.INVALID_HANDLE),
+    }
+    assert set(seen) == expected
+
+
+def test_untyped_exceptions_map_to_fallback_codes():
+    assert capi.error_code(faults.InjectedFault("x")) == int(errors.ErrorCode.UNKNOWN)
+    assert capi.error_code(ValueError("x")) == int(
+        errors.ErrorCode.INVALID_PARAMETER
+    )
+    assert capi.error_code(MemoryError()) == int(errors.ErrorCode.ALLOCATION)
+
+
+def test_guard_and_ladder_failures_map_to_right_enums():
+    trip = _triplets()
+    t = _local(trip, guard=True)
+    with faults.inject("engine.execute=nan"):
+        with pytest.raises(errors.HostExecutionError) as ei:
+            t.backward(_values(trip))
+    assert capi.error_code(ei.value) == int(errors.ErrorCode.HOST_EXECUTION)
+
+    per_shard = distribute_triplets(trip, 2, DIM)
+    with faults.inject("exchange.build=raise"):
+        with pytest.raises(errors.MPIError) as ei:
+            _dist(per_shard)
+    assert capi.error_code(ei.value) == int(errors.ErrorCode.MPI)
+
+    # accelerator plans surface the GPU side of the dual error surface
+    assert faults.execution_error("tpu") is errors.GPUFFTError
+    assert capi.error_code(errors.GPUFFTError("x")) == int(
+        errors.ErrorCode.GPU_FFT
+    )
