@@ -195,6 +195,10 @@ def main():
                 "vs_baseline": round(dense_time / best, 3),
                 "plan": plan_card,
                 "wisdom": wisdom,
+                # trace join key (spfft_tpu.obs.trace): the plan's run ID, so
+                # a flight-recorder dump or snapshot from this process joins
+                # this capture on one key
+                "run_id": plan_card.get("run_id"),
             }
         )
     )

@@ -12,6 +12,7 @@
 #          ./ci.sh python     # Python suite only
 #          ./ci.sh report     # plan-card CLI + JSON schema validation only
 #          ./ci.sh tune       # autotuner smoke (trial + wisdom hit, CPU)
+#          ./ci.sh trace      # flight recorder: schema + Chrome export + dump
 #          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
@@ -81,6 +82,65 @@ EOF
   rm -rf "$wdir"
 }
 
+run_trace() {
+  echo "== Trace (spfft_tpu.obs.trace: flight recorder, Chrome export, dump-on-error, CPU) =="
+  # Traced roundtrip on the CPU backend: the snapshot must validate against
+  # its schema and the Chrome export must round-trip through json.load with
+  # begin/end pairs for every host phase — trace drift fails here without
+  # TPU hardware.
+  local tdir
+  tdir="$(mktemp -d)"
+  JAX_PLATFORMS=cpu SPFFT_TPU_TRACE=1 timeout 540 python programs/trace.py \
+    -d 16 16 16 --chrome "$tdir/chrome.json" -o "$tdir/snapshot.json" > /dev/null
+  JAX_PLATFORMS=cpu python - "$tdir" <<'EOF'
+import json, sys
+from spfft_tpu.obs import trace
+
+d = sys.argv[1]
+snap = json.load(open(f"{d}/snapshot.json"))
+missing = trace.validate_trace(snap)
+assert not missing, f"trace schema incomplete: {missing}"
+chrome = json.load(open(f"{d}/chrome.json"))
+events = chrome["traceEvents"]
+for phase in ("backward", "forward", "dispatch", "wait"):
+    b = [e for e in events if e["name"] == phase and e["ph"] == "B"]
+    e_ = [e for e in events if e["name"] == phase and e["ph"] == "E"]
+    assert b and len(b) == len(e_), f"unbalanced chrome track {phase!r}"
+print(f"trace schema ok ({len(snap['events'])} events, "
+      f"{len(events)} chrome entries)")
+EOF
+  # Dump-on-error: with a fault site armed to raise, the typed error the
+  # ladder converts it to must flush the recorder to SPFFT_TPU_TRACE_DUMP,
+  # and the dump's events must carry the failing plan's run ID.
+  JAX_PLATFORMS=cpu SPFFT_TPU_TRACE=1 SPFFT_TPU_TRACE_DUMP="$tdir/dumps" \
+    SPFFT_TPU_FAULTS="sync.fence=raise" timeout 540 python - "$tdir" <<'EOF'
+import glob, json, sys, warnings
+import numpy as np
+import spfft_tpu as sp
+from spfft_tpu import HostExecutionError, ProcessingUnit, Transform, TransformType
+
+d = sys.argv[1]
+trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.9)
+t = Transform(ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip)
+rid = t.report()["run_id"]
+try:
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t.backward(np.ones(len(trip), dtype=np.complex128))
+except HostExecutionError:
+    pass
+else:
+    raise AssertionError("armed sync.fence fault did not surface typed")
+dumps = sorted(glob.glob(f"{d}/dumps/trace-*.json"))
+assert dumps, "no dump file written"
+doc = json.load(open(dumps[-1]))
+runs = {ev["run"] for ev in doc["events"]}
+assert rid in runs, (rid, runs)
+print(f"dump-on-error ok ({dumps[-1].split('/')[-1]}, run {rid})")
+EOF
+  rm -rf "$tdir"
+}
+
 run_chaos() {
   echo "== Chaos (spfft_tpu.faults: every site armed at rate 1.0, CPU) =="
   # The chaos invariant: with each registered fault site armed one-at-a-time,
@@ -118,6 +178,7 @@ case "$stage" in
   python) run_python ;;
   report) run_report ;;
   tune) run_tune ;;
+  trace) run_trace ;;
   chaos) run_chaos ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
@@ -126,13 +187,14 @@ case "$stage" in
     run_python
     run_report
     run_tune
+    run_trace
     run_chaos
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | chaos | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -260,6 +260,7 @@ def index_page() -> str:
         - [Distributed transform](distributed.md)
         - [Multi-transforms](multi_transform.md)
         - [Index helpers and mesh utilities](utilities.md)
+        - [Observability: plan cards, metrics, execution trace](obs.md)
         - [Autotuning and wisdom](tuning.md)
         - [Fault injection, guard mode and degradation](faults.md)
         - [C API](c_api.md)
@@ -271,6 +272,58 @@ def index_page() -> str:
         [docs/MIGRATION.md](../MIGRATION.md).
         """
     )
+
+
+def obs_page() -> str:
+    """The observability page: the `spfft_tpu.obs` surface (plan cards +
+    run metrics) and the `spfft_tpu.obs.trace` flight recorder, one page —
+    they share the run-ID join key."""
+    from spfft_tpu import obs
+    from spfft_tpu.obs import trace
+
+    metrics = class_page(
+        "Observability",
+        doc(obs),
+        [],
+        [
+            obs.counter,
+            obs.gauge,
+            obs.histogram,
+            obs.phase_timer,
+            obs.enable,
+            obs.disable,
+            obs.is_enabled,
+            obs.clear,
+            obs.snapshot,
+            obs.validate_snapshot,
+            obs.prometheus_text,
+            obs.plan_card,
+            obs.validate_plan_card,
+            obs.validate_report,
+        ],
+    )
+    tracing = class_page(
+        "Execution trace (`spfft_tpu.obs.trace`)",
+        doc(trace),
+        [trace.TraceRecorder],
+        [
+            trace.enable,
+            trace.disable,
+            trace.enabled,
+            trace.clear,
+            trace.new_run_id,
+            trace.current_run_id,
+            trace.event,
+            trace.span,
+            trace.operation,
+            trace.snapshot,
+            trace.validate_trace,
+            trace.chrome_trace,
+            trace.dump,
+            trace.suppressed_dumps,
+        ],
+    )
+    return metrics + "\n" + tracing
 
 
 def generate(outdir: Path) -> None:
@@ -324,6 +377,7 @@ def generate(outdir: Path) -> None:
                 timing.scoped,
             ],
         ),
+        "obs.md": obs_page(),
         "tuning.md": class_page(
             "Tuning",
             doc(tuning),

@@ -18,7 +18,12 @@ kept flagging are enforced here with the stdlib ast module:
    names a site registered in the canonical ``spfft_tpu.faults.SITES``
    vocabulary, every registered site is threaded through the package at
    least once, and every site is documented in docs/details.md (the chaos
-   suite's arm-every-site sweep is only exhaustive if the vocabulary is).
+   suite's arm-every-site sweep is only exhaustive if the vocabulary is),
+6. trace-event consistency — every ``trace.event/span/operation(...)`` call
+   in the package names an event registered in the canonical
+   ``spfft_tpu.obs.trace.EVENTS`` vocabulary, and every registered event is
+   emitted by at least one package call site (same both-ways rule; keeps
+   flight-recorder streams and their consumers on one vocabulary).
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -314,6 +319,76 @@ def check_fault_sites(findings: list):
             )
 
 
+# The execution-trace event vocabulary (spfft_tpu/obs/trace.py EVENTS): every
+# trace.event/span/operation call in the package must name a registered
+# event, and every registered event must be emitted by at least one package
+# call site — the same both-ways contract as STAGES and SITES.
+TRACE_FILE = "spfft_tpu/obs/trace.py"
+TRACE_EMITTERS = ("event", "span", "operation")
+
+
+def _canonical_events() -> tuple:
+    """EVENTS from obs/trace.py via ast (import-free, like STAGES/SITES)."""
+    tree = ast.parse((ROOT / TRACE_FILE).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "EVENTS" for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise AssertionError(f"no EVENTS assignment in {TRACE_FILE}")
+
+
+def _is_trace_receiver(value) -> bool:
+    """Whether a call receiver is the trace module (``trace.x`` after a
+    ``from .obs import trace``, or a dotted ``obs.trace.x``)."""
+    if isinstance(value, ast.Name):
+        return value.id == "trace"
+    return isinstance(value, ast.Attribute) and value.attr == "trace"
+
+
+def check_trace_events(findings: list):
+    events = _canonical_events()
+    if len(set(events)) != len(events):
+        findings.append(f"{TRACE_FILE}: duplicate entries in EVENTS")
+    used: dict = {}  # event name -> first package file:line that emits it
+    for d in PACKAGE_DIRS:
+        for path in sorted((ROOT / d).rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(ROOT)
+            if str(rel) == TRACE_FILE:
+                continue  # the recorder itself is not an emission site
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in TRACE_EMITTERS
+                    and _is_trace_receiver(node.func.value)
+                ):
+                    continue
+                where = f"{rel}:{node.lineno}"
+                if not (node.args and isinstance(node.args[0], ast.Constant)):
+                    findings.append(
+                        f"{where}: trace.{node.func.attr}(...) must take a "
+                        "literal event name (lint cannot check dynamic names)"
+                    )
+                    continue
+                name = node.args[0].value
+                if name not in events:
+                    findings.append(
+                        f"{where}: trace event {name!r} is not registered in "
+                        f"the canonical vocabulary ({TRACE_FILE})"
+                    )
+                used.setdefault(name, where)
+    for name in events:
+        if name not in used:
+            findings.append(
+                f"{TRACE_FILE}: event {name!r} is registered but emitted by "
+                "no package code path"
+            )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -323,6 +398,7 @@ def main() -> int:
     check_env_knobs(findings)
     check_stage_scopes(findings)
     check_fault_sites(findings)
+    check_trace_events(findings)
     for f in findings:
         print(f)
     if findings:

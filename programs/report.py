@@ -106,7 +106,9 @@ def main(argv=None):
         plan.backward(values)
         plan.forward(scaling=ScalingType.FULL)
 
-    report = {"plan": card, "metrics": obs.snapshot()}
+    # run_id rides top-level too (it is also inside the card): the join key
+    # against a flight-recorder snapshot/dump from the same process
+    report = {"plan": card, "metrics": obs.snapshot(), "run_id": card.get("run_id")}
     missing = obs.validate_report(report)
 
     print(json.dumps(card, indent=2))

@@ -1,0 +1,135 @@
+"""Run a traced roundtrip and export the flight recorder.
+
+The execution-trace CLI (spfft_tpu.obs.trace): arms the flight recorder,
+builds a plan, runs one backward+forward roundtrip, and exports what the
+recorder saw — the event table on stdout (filterable with ``--last`` /
+``--run``), the schema-pinned snapshot JSON (``-o``), and Chrome trace-event
+format (``--chrome``) loadable in Perfetto / chrome://tracing, one track per
+host phase. The snapshot is validated (trace.validate_trace) before it is
+written; a malformed event exits nonzero, so ci.sh catches trace-schema
+drift without TPU hardware.
+
+Usage:
+    python programs/trace.py -d 32 32 32 --chrome trace.json
+    python programs/trace.py -d 16 16 16 --shards 2 --last 20
+    python programs/trace.py -d 16 16 16 --run r000001 -o snapshot.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def build_plan(args):
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, TransformType
+
+    dx, dy, dz = args.d
+    radius = sp.spherical_radius_for_fraction(args.s)
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, min(radius, 1.0))
+    if args.shards > 1:
+        from spfft_tpu.parallel import make_fft_mesh
+
+        mesh = make_fft_mesh(args.shards)
+        return sp.DistributedTransform(
+            ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, trip,
+            mesh=mesh, engine=args.engine,
+        )
+    return sp.Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dx, dy, dz, indices=trip,
+        engine=args.engine,
+    )
+
+
+def format_event(ev: dict) -> str:
+    args = dict(ev["args"])
+    label = args.pop("label", None)
+    name = f"{ev['name']}:{label}" if label else ev["name"]
+    rest = " ".join(f"{k}={v}" for k, v in args.items())
+    return (
+        f"{ev['seq']:>6d} {ev['ts'] * 1e3:>10.3f}ms {ev['run'] or '-':>8} "
+        f"{ev['ph']} {name:<24} {rest}"
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-d", nargs=3, type=int, default=[16, 16, 16],
+                    metavar=("X", "Y", "Z"))
+    ap.add_argument("-s", type=float, default=0.15, help="nonzero fraction")
+    ap.add_argument("--engine", default="auto", choices=["auto", "xla", "mxu"])
+    ap.add_argument("--shards", type=int, default=1,
+                    help="1-D slab mesh width (1 = local plan)")
+    ap.add_argument("--last", type=int, default=None, metavar="N",
+                    help="print only the last N events")
+    ap.add_argument("--run", default=None, metavar="ID",
+                    help="print only events of run ID (e.g. r000001)")
+    ap.add_argument("--chrome", default=None, metavar="PATH",
+                    help="write Chrome trace-event JSON here")
+    ap.add_argument("-o", default=None, help="write the snapshot JSON here")
+    args = ap.parse_args(argv)
+
+    # mesh-width CPU devices must exist before the first backend touch
+    if args.shards > 1:
+        from spfft_tpu.parallel.mesh import ensure_virtual_devices
+
+        ensure_virtual_devices(args.shards, warn=True, platform="cpu")
+
+    from spfft_tpu import ScalingType
+    from spfft_tpu.obs import trace
+
+    trace.enable()  # the CLI's whole point — arm regardless of SPFFT_TPU_TRACE
+
+    plan = build_plan(args)
+    rng = np.random.default_rng(0)
+    if args.shards > 1:
+        values = [
+            rng.standard_normal(plan.num_local_elements(r))
+            + 1j * rng.standard_normal(plan.num_local_elements(r))
+            for r in range(plan.num_shards)
+        ]
+    else:
+        n = plan.num_local_elements
+        values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    plan.backward(values)
+    plan.forward(scaling=ScalingType.FULL)
+
+    snap = trace.snapshot()
+    missing = trace.validate_trace(snap)
+
+    shown = snap["events"]
+    if args.run:
+        shown = [ev for ev in shown if ev["run"] == args.run]
+    if args.last is not None:
+        shown = shown[-args.last:]
+    print(
+        f"run {plan.report()['run_id']}: {len(snap['events'])} events "
+        f"recorded ({snap['dropped']} dropped, capacity {snap['capacity']}), "
+        f"{len(shown)} shown"
+    )
+    for ev in shown:
+        print(format_event(ev))
+
+    if args.o:
+        Path(args.o).write_text(json.dumps(snap, indent=1) + "\n")
+        print(f"snapshot written to {args.o}")
+    if args.chrome:
+        Path(args.chrome).write_text(
+            json.dumps(trace.chrome_trace(snap)) + "\n"
+        )
+        print(f"chrome trace written to {args.chrome} "
+              "(open in Perfetto / chrome://tracing)")
+    if missing:
+        print(f"trace schema INCOMPLETE, missing: {missing}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,137 +118,154 @@ class DistributedTransform:
         self._guard = faults.guard_enabled(guard)
         self._degradations: list = []
         self._tuning = None
-        if (
-            ExchangeType(exchange_type) == ExchangeType.DEFAULT
-            and self._policy == "tuned"
+        # Run ID (spfft_tpu.obs.trace): the correlation key joining this
+        # plan's card, metrics and flight-recorder events; the "plan"
+        # operation span keeps it active so tuning trials, ladder rungs and
+        # fault injections during construction stamp it.
+        self._run_id = obs.trace.new_run_id()
+        with obs.trace.operation(
+            "plan", run_id=self._run_id, kind="distributed"
         ):
-            # TUNED policy (spfft_tpu.tuning): resolve DEFAULT empirically —
-            # wisdom-store hit, else on-device trials of the candidate
-            # disciplines on THIS geometry/mesh/dtype, else the model policy
-            # (CPU-only hosts / corrupt store). Trial plans are this same
-            # constructor with explicit disciplines and the model policy, so
-            # tuning cannot recurse. The record lands on the plan card.
-            from . import tuning
+            if (
+                ExchangeType(exchange_type) == ExchangeType.DEFAULT
+                and self._policy == "tuned"
+            ):
+                # TUNED policy (spfft_tpu.tuning): resolve DEFAULT empirically —
+                # wisdom-store hit, else on-device trials of the candidate
+                # disciplines on THIS geometry/mesh/dtype, else the model policy
+                # (CPU-only hosts / corrupt store). Trial plans are this same
+                # constructor with explicit disciplines and the model policy, so
+                # tuning cannot recurse. The record lands on the plan card.
+                from . import tuning
 
-            p = self._params
+                p = self._params
 
-            def build(cand):
-                return DistributedTransform(
-                    self._processing_unit,
-                    p.transform_type,
-                    p.dim_x,
-                    p.dim_y,
-                    p.dim_z,
-                    [t.copy() for t in indices_per_shard],
-                    mesh=mesh,
-                    local_z_lengths=np.asarray(p.local_z_lengths).copy(),
-                    exchange_type=ExchangeType[cand["exchange_type"]],
-                    dtype=self._real_dtype,
-                    engine=engine,
-                    precision=precision,
-                    policy="default",
+                def build(cand):
+                    return DistributedTransform(
+                        self._processing_unit,
+                        p.transform_type,
+                        p.dim_x,
+                        p.dim_y,
+                        p.dim_z,
+                        [t.copy() for t in indices_per_shard],
+                        mesh=mesh,
+                        local_z_lengths=np.asarray(p.local_z_lengths).copy(),
+                        exchange_type=ExchangeType[cand["exchange_type"]],
+                        dtype=self._real_dtype,
+                        engine=engine,
+                        precision=precision,
+                        policy="default",
+                    )
+
+                with faults.collecting(self._degradations):
+                    exchange_type, self._tuning = tuning.tuned_exchange(
+                        p, mesh, self._real_dtype, engine, precision, pencil2, build
+                    )
+            elif ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
+                # Measured auto-policy (parallel/policy.py): pick the discipline
+                # from the plan's exact wire volumes + round counts + the
+                # backend's one-shot ragged-a2a support (probed compile-only,
+                # cached, and only when the answer depends on it). The reference
+                # instead hardwires DEFAULT = COMPACT_BUFFERED
+                # (grid_internal.cpp:176-179); ported callers who want that exact
+                # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil plans
+                # resolve DEFAULT inside the engine (pencil2.py
+                # _resolve_pencil2_default — the x-group strategy and the
+                # discipline are chosen together there).
+                from .parallel.policy import resolve_default_for_plan
+
+                exchange_type = resolve_default_for_plan(
+                    self._params, mesh, self._real_dtype
                 )
 
-            with faults.collecting(self._degradations):
-                exchange_type, self._tuning = tuning.tuned_exchange(
-                    p, mesh, self._real_dtype, engine, precision, pencil2, build
-                )
-        elif ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
-            # Measured auto-policy (parallel/policy.py): pick the discipline
-            # from the plan's exact wire volumes + round counts + the
-            # backend's one-shot ragged-a2a support (probed compile-only,
-            # cached, and only when the answer depends on it). The reference
-            # instead hardwires DEFAULT = COMPACT_BUFFERED
-            # (grid_internal.cpp:176-179); ported callers who want that exact
-            # behavior pass COMPACT_BUFFERED explicitly. 2-D pencil plans
-            # resolve DEFAULT inside the engine (pencil2.py
-            # _resolve_pencil2_default — the x-group strategy and the
-            # discipline are chosen together there).
-            from .parallel.policy import resolve_default_for_plan
+            from .ops.fft import resolve_precision
 
-            exchange_type = resolve_default_for_plan(
-                self._params, mesh, self._real_dtype
-            )
+            resolve_precision(precision)  # validate up front on every engine path
+            self._precision = precision
 
-        from .ops.fft import resolve_precision
+            # Engine selection mirrors the local Transform: the MXU engine (matmul
+            # DFT stages + lane-copy value plans) wins on accelerator meshes; the
+            # XLA engine (jnp.fft + scatter) wins on CPU meshes where pocketfft is
+            # the fast path. Selected by the platform the MESH lives on, not the
+            # process default backend. The decomposition (1-D slab vs 2-D pencil)
+            # comes from the mesh shape; the engine knob picks the compute path.
+            if engine == "auto":
+                engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
+            if engine not in ("xla", "mxu"):
+                raise InvalidParameterError(f"unknown engine {engine!r}")
 
-        resolve_precision(precision)  # validate up front on every engine path
-        self._precision = precision
+            def _build(which: str):
+                """Construct the execution engine for ``which`` (fault site
+                ``engine.compile`` guards the MXU lowerings — ladder rung 1)."""
+                if pencil2:
+                    if which == "mxu":
+                        from .parallel.pencil2_mxu import MxuPencil2Execution
 
-        # Engine selection mirrors the local Transform: the MXU engine (matmul
-        # DFT stages + lane-copy value plans) wins on accelerator meshes; the
-        # XLA engine (jnp.fft + scatter) wins on CPU meshes where pocketfft is
-        # the fast path. Selected by the platform the MESH lives on, not the
-        # process default backend. The decomposition (1-D slab vs 2-D pencil)
-        # comes from the mesh shape; the engine knob picks the compute path.
-        if engine == "auto":
-            engine = "xla" if mesh.devices.flat[0].platform == "cpu" else "mxu"
-        if engine not in ("xla", "mxu"):
-            raise InvalidParameterError(f"unknown engine {engine!r}")
+                        faults.site("engine.compile")
+                        return (
+                            MxuPencil2Execution(
+                                self._params, self._real_dtype, mesh, exchange_type, precision
+                            ),
+                            "pencil2-mxu",
+                        )
+                    from .parallel.pencil2 import Pencil2Execution
 
-        def _build(which: str):
-            """Construct the execution engine for ``which`` (fault site
-            ``engine.compile`` guards the MXU lowerings — ladder rung 1)."""
-            if pencil2:
+                    return (
+                        Pencil2Execution(
+                            self._params, self._real_dtype, mesh, exchange_type
+                        ),
+                        "pencil2",
+                    )
                 if which == "mxu":
-                    from .parallel.pencil2_mxu import MxuPencil2Execution
+                    from .parallel.execution_mxu import MxuDistributedExecution
 
                     faults.site("engine.compile")
                     return (
-                        MxuPencil2Execution(
+                        MxuDistributedExecution(
                             self._params, self._real_dtype, mesh, exchange_type, precision
                         ),
-                        "pencil2-mxu",
+                        "mxu",
                     )
-                from .parallel.pencil2 import Pencil2Execution
-
                 return (
-                    Pencil2Execution(
+                    DistributedExecution(
                         self._params, self._real_dtype, mesh, exchange_type
                     ),
-                    "pencil2",
+                    "xla",
                 )
-            if which == "mxu":
-                from .parallel.execution_mxu import MxuDistributedExecution
 
-                faults.site("engine.compile")
-                return (
-                    MxuDistributedExecution(
-                        self._params, self._real_dtype, mesh, exchange_type, precision
-                    ),
-                    "mxu",
-                )
-            return (
-                DistributedExecution(
-                    self._params, self._real_dtype, mesh, exchange_type
-                ),
-                "xla",
-            )
-
-        # Degradation ladder rung 1 (distributed): an MXU engine that fails
-        # to lower/compile falls back to the jnp.fft engine over the same
-        # mesh and discipline; a failure with no rung below it (the jnp.fft
-        # engine or the exchange machinery itself — fault site
-        # exchange.build) raises typed MPIError.
-        with faults.collecting(self._degradations):
-            try:
-                self._exec, self._engine = _build(engine)
-            except faults.ENGINE_BUILD_ERRORS as e:
-                if engine != "mxu":
-                    raise MPIError(
-                        f"distributed engine construction failed: {e}"
-                    ) from e
-                faults.engine_fallback(
-                    "pencil2-mxu" if pencil2 else "mxu",
-                    "pencil2" if pencil2 else "xla",
-                    faults.summarize(e),
-                )
+            # Degradation ladder rung 1 (distributed): an MXU engine that fails
+            # to lower/compile falls back to the jnp.fft engine over the same
+            # mesh and discipline; a failure with no rung below it (the jnp.fft
+            # engine or the exchange machinery itself — fault site
+            # exchange.build) raises typed MPIError.
+            with faults.collecting(self._degradations):
                 try:
-                    self._exec, self._engine = _build("xla")
-                except faults.ENGINE_BUILD_ERRORS as e2:
-                    raise MPIError(
-                        f"distributed engine construction failed: {e2}"
-                    ) from e2
+                    self._exec, self._engine = _build(engine)
+                except faults.ENGINE_BUILD_ERRORS as e:
+                    if engine != "mxu":
+                        raise MPIError(
+                            f"distributed engine construction failed: {e}"
+                        ) from e
+                    faults.engine_fallback(
+                        "pencil2-mxu" if pencil2 else "mxu",
+                        "pencil2" if pencil2 else "xla",
+                        faults.summarize(e),
+                    )
+                    try:
+                        self._exec, self._engine = _build("xla")
+                    except faults.ENGINE_BUILD_ERRORS as e2:
+                        raise MPIError(
+                            f"distributed engine construction failed: {e2}"
+                        ) from e2
+            obs.trace.event(
+                "decision",
+                what="engine",
+                choice=self._engine,
+                policy=self._policy,
+            )
+            obs.trace.event(
+                "decision", what="exchange", choice=self.exchange_type.name
+            )
         self._space_data = None
         # Plan-constant; cached lazily so the metrics-off path never pays the
         # per-step numpy accounting in exchange_wire_bytes().
@@ -264,7 +281,11 @@ class DistributedTransform:
         """
         obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
         plat = self._platform
-        with timing.scoped("backward"):
+        # "execute" operation span (spfft_tpu.obs.trace): runs under the
+        # plan's run ID, so the trace of this call joins the plan card.
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="backward"
+        ), timing.scoped("backward"):
             if self._guard:
                 faults.check_array(
                     list(values), check="backward input", platform=plat
@@ -331,7 +352,9 @@ class DistributedTransform:
         """Space -> per-shard packed freq values (list of complex arrays)."""
         obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
         plat = self._platform
-        with timing.scoped("forward"):
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="forward"
+        ), timing.scoped("forward"):
             if self._guard and space is not None:
                 faults.check_array(
                     np.asarray(space), check="forward input", platform=plat

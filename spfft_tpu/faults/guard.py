@@ -51,6 +51,7 @@ def execution_error(platform: str):
 
 def _fail(check: str, platform: str, message: str):
     obs.counter("guard_failures_total", check=check).inc()
+    obs.trace.event("guard", check=check, verdict="fail", message=message)
     raise execution_error(platform)(f"guard [{check}]: {message}")
 
 
@@ -81,6 +82,9 @@ def check_array(arr, *, check: str, platform: str, shape=None, dtype=None):
                     platform,
                     f"{tag}: {bad} non-finite value(s) of {a.size}",
                 )
+    # verdicts land in the flight recorder both ways: _fail records the
+    # failing one before raising, a clean pass is recorded here
+    obs.trace.event("guard", check=check, verdict="ok")
     return arr
 
 
@@ -106,4 +110,5 @@ def check_device(tree, device, *, check: str, platform: str):
                 f"result on {sorted(str(d) for d in devs)} but the plan is "
                 f"bound to {device}",
             )
+    obs.trace.event("guard", check=check, verdict="ok")
     return tree

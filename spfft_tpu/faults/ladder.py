@@ -77,6 +77,9 @@ def record_degradation(event: str, reason: str, **extra) -> dict:
     collecting scope (plan-card assembly) can place it themselves."""
     entry = {"event": str(event), "reason": str(reason), **extra}
     obs.counter("degradations_total", event=str(event)).inc()
+    # ladder rungs stamp the active run ID in the flight recorder, so a
+    # degraded plan's trace shows the rung among the events around it
+    obs.trace.event("degradation", event=str(event), reason=str(reason))
     stack = getattr(_tls, "stack", None)
     if stack:
         stack[-1].append(entry)

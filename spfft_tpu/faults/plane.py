@@ -240,6 +240,9 @@ def site(name: str, payload=None):
         # had zero effect
         return payload
     obs.counter("faults_injected_total", site=name, kind=kind).inc()
+    # flight-recorder instant (spfft_tpu.obs.trace): the injection lands in
+    # the active run's event stream, so a chaos trace shows what fired where
+    obs.trace.event("fault.injected", site=name, kind=kind)
     if kind == "raise":
         raise InjectedFault(f"injected fault at site {name!r}")
     if kind == "delay":

@@ -1,6 +1,6 @@
-"""spfft_tpu.obs — unified metrics and plan introspection.
+"""spfft_tpu.obs — unified metrics, plan introspection, and execution tracing.
 
-Three observability layers, coarse to fine (docs/details.md "Observability"):
+Four observability layers, coarse to fine (docs/details.md "Observability"):
 
 1. **Host timing tree** (:mod:`spfft_tpu.timing`): rt_graph-parity nested wall
    -clock statistics of the host-visible phases (init, staging, dispatch,
@@ -14,11 +14,20 @@ Three observability layers, coarse to fine (docs/details.md "Observability"):
    host-facing paths did, exported via :func:`snapshot` (JSON) and
    :func:`prometheus_text`. ``SPFFT_TPU_METRICS=0`` turns the registry into
    shared no-ops.
-3. **Device traces** (``jax.profiler`` via ``programs/profile.py``): per-stage
+3. **Execution trace** (:mod:`spfft_tpu.obs.trace`): per-execution typed
+   events — run-ID-correlated operation/phase spans, degradations, guard
+   verdicts, fault injections, decisions — in a bounded flight recorder
+   (``SPFFT_TPU_TRACE``), exported as schema-pinned JSON
+   (``trace.snapshot()``) and Chrome trace-event format
+   (``trace.chrome_trace()``), flushed to ``SPFFT_TPU_TRACE_DUMP`` when a
+   typed error fires. Plan cards embed their construction run ID, so card,
+   metrics and trace join on one key.
+4. **Device traces** (``jax.profiler`` via ``programs/profile.py``): per-stage
    attribution inside the compiled programs, tagged with the canonical
    :data:`STAGES` scope names every engine uses (``programs/lint.py`` enforces
    the list both ways).
 """
+from . import trace  # noqa: F401
 from .registry import (  # noqa: F401
     HISTOGRAM_BUCKETS,
     METRICS_ENV,

@@ -26,6 +26,9 @@ PLAN_CARD_SCHEMA = "spfft_tpu.obs.plan_card/1"
 REQUIRED_KEYS = (
     "schema",
     "kind",
+    # construction run ID (spfft_tpu.obs.trace): the join key between this
+    # card, the metrics window it ran under, and the flight-recorder events
+    "run_id",
     "engine",
     "transform_type",
     "dims",
@@ -173,6 +176,9 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
     card = {
         "schema": PLAN_CARD_SCHEMA,
         "kind": "distributed" if distributed else "local",
+        # the construction run ID (obs.trace) — flight-recorder events of
+        # this plan's construction and executions carry the same ID
+        "run_id": getattr(transform, "_run_id", None),
         "engine": transform._engine,
         "transform_type": TransformType(transform.transform_type).name,
         "dims": dims,

@@ -29,10 +29,22 @@ SNAPSHOT_SCHEMA = "spfft_tpu.obs.snapshot/1"
 HISTOGRAM_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
 
 
+def _escape_label(value) -> str:
+    """Prometheus label-value escaping (backslash, double-quote, newline) —
+    applied when keys are built, so snapshot keys and the exposition format
+    agree on one quoting rule."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _label_key(labels: tuple) -> str:
     if not labels:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels) + "}"
 
 
 class Counter:

@@ -94,28 +94,32 @@ def fence(tree):
     models a runtime whose completion machinery died mid-transform — the
     transform paths convert it to a typed execution error
     (:func:`spfft_tpu.faults.typed_execution`).
+
+    The whole fence is a ``fence`` trace span (:mod:`spfft_tpu.obs.trace`),
+    stamped with the run ID of the operation it completes.
     """
     from . import faults
 
-    faults.site("sync.fence")
-    jax.block_until_ready(tree)
-    force = _advisory_override()
-    if force is False:
+    with obs.trace.span("fence"):
+        faults.site("sync.fence")
+        jax.block_until_ready(tree)
+        force = _advisory_override()
+        if force is False:
+            return tree
+        probes = []
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if (
+                isinstance(leaf, jax.Array)
+                and leaf.size
+                and (force or _on_advisory_platform(leaf))
+            ):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards:
+                    for shard in shards:
+                        if shard.data is not None and shard.data.size:
+                            probes.append(_probe_scalar(shard.data))
+                else:
+                    probes.append(_probe_scalar(leaf))
+        if probes:
+            jax.device_get(probes)
         return tree
-    probes = []
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if (
-            isinstance(leaf, jax.Array)
-            and leaf.size
-            and (force or _on_advisory_platform(leaf))
-        ):
-            shards = getattr(leaf, "addressable_shards", None)
-            if shards:
-                for shard in shards:
-                    if shard.data is not None and shard.data.size:
-                        probes.append(_probe_scalar(shard.data))
-            else:
-                probes.append(_probe_scalar(leaf))
-    if probes:
-        jax.device_get(probes)
-    return tree

@@ -21,11 +21,15 @@ median, quartiles, min, max, percentage of the top-level total and of the parent
 shape the reference benchmark embeds in its report
 (reference: tests/programs/benchmark.cpp:283-289).
 
-This is layer 1 of the three observability layers (docs/details.md
+This is layer 1 of the four observability layers (docs/details.md
 "Observability"): the timing tree measures what the host *paid*;
 :mod:`spfft_tpu.obs` records what the plan *decided* (plan cards) and counts
 what ran (run-metrics registry, gated by ``SPFFT_TPU_METRICS`` with the same
-shared-no-op pattern as :func:`enable`/:func:`disable` here); ``jax.profiler``
+shared-no-op pattern as :func:`enable`/:func:`disable` here); the flight
+recorder (:mod:`spfft_tpu.obs.trace`) keeps the per-execution event log —
+every :func:`scoped` phase below doubles as a run-ID-stamped trace span when
+tracing is armed, so the nested timing nodes appear as Chrome-trace duration
+slices instead of living in a separate report-only tree; ``jax.profiler``
 traces show what the device *executed*, stage-tagged via ``obs.STAGES``.
 """
 from __future__ import annotations
@@ -36,6 +40,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from .obs import trace
 
 
 class _Node:
@@ -253,26 +259,64 @@ def is_enabled() -> bool:
     return _enabled
 
 
+class _JoinedScope:
+    """Compose the timing-tree scope with the trace phase span, so one
+    :func:`scoped` call feeds both layers (timing report AND flight
+    recorder) without the call sites knowing which are armed."""
+
+    __slots__ = ("_scopes",)
+
+    def __init__(self, *scopes):
+        self._scopes = scopes
+
+    def __enter__(self):
+        for s in self._scopes:
+            s.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        for s in reversed(self._scopes):
+            s.__exit__(*exc)
+        return False
+
+
 def scoped(label: str):
     """Scoped timing region (the HOST_TIMING_SCOPED macro,
-    reference: src/timing/timing.hpp:34-62). No-op when disabled."""
+    reference: src/timing/timing.hpp:34-62). No-op when disabled. When the
+    flight recorder is armed (:mod:`spfft_tpu.obs.trace`), the same scope
+    additionally emits a run-ID-stamped ``phase`` begin/end span — the host
+    timing tree and the execution trace share one instrumentation point."""
+    tspan = trace.span("phase", label=label) if trace.enabled() else None
     if not _enabled:
-        return _NOOP
-    return global_timer.scoped(label)
+        return _NOOP if tspan is None else tspan
+    scope = global_timer.scoped(label)
+    return scope if tspan is None else _JoinedScope(scope, tspan)
 
 
 # Each start() records whether it actually opened a scope, so a stop() after an
 # enable/disable toggle stays balanced instead of corrupting the global tree.
+# The parallel _trace_spans stack keeps the flight-recorder phase spans
+# balanced across toggles the same way.
 _start_flags: list[bool] = []
+_trace_spans: list = []
 
 
 def start(label: str) -> None:
     _start_flags.append(_enabled)
     if _enabled:
         global_timer.start(label)
+    if trace.enabled():
+        tspan = trace.span("phase", label=label)
+        tspan.__enter__()
+        _trace_spans.append(tspan)
+    else:
+        _trace_spans.append(None)
 
 
 def stop(label: str) -> None:
+    tspan = _trace_spans.pop() if _trace_spans else None
+    if tspan is not None:
+        tspan.__exit__(None, None, None)
     if _start_flags.pop() if _start_flags else False:
         global_timer.stop(label)
 

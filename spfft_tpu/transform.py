@@ -146,81 +146,91 @@ class Transform:
         self._guard = faults.guard_enabled(guard)
         self._degradations: list = []
         self._tuning = None
-        engine_env = {}
-        if engine == "auto" and self._policy == "tuned":
-            # TUNED policy (spfft_tpu.tuning): resolve the engine axis (MXU
-            # matmul DFTs vs jnp.fft, incl. the sparse-y knob variants)
-            # empirically — wisdom hit, else on-device trials on THIS plan's
-            # stick layout, else the static auto rule (CPU-only hosts /
-            # corrupt store). Trial plans use explicit engines and the model
-            # policy, so tuning cannot recurse.
-            from . import tuning
+        # Run ID (spfft_tpu.obs.trace): the correlation key joining this
+        # plan's card, metrics and flight-recorder events. The "plan"
+        # operation span keeps it active for the whole construction, so
+        # tuning trials, ladder rungs, fault injections and guard verdicts
+        # below stamp it.
+        self._run_id = obs.trace.new_run_id()
+        with obs.trace.operation("plan", run_id=self._run_id, kind="local"):
+            engine_env = {}
+            if engine == "auto" and self._policy == "tuned":
+                # TUNED policy (spfft_tpu.tuning): resolve the engine axis (MXU
+                # matmul DFTs vs jnp.fft, incl. the sparse-y knob variants)
+                # empirically — wisdom hit, else on-device trials on THIS plan's
+                # stick layout, else the static auto rule (CPU-only hosts /
+                # corrupt store). Trial plans use explicit engines and the model
+                # policy, so tuning cannot recurse.
+                from . import tuning
 
-            p = self._params
-            triplets = _storage_triplets(p)
+                p = self._params
+                triplets = _storage_triplets(p)
 
-            def build(cand):
-                with tuning.env_overrides(cand.get("env") or {}):
-                    return Transform(
-                        self._processing_unit,
-                        p.transform_type,
-                        p.dim_x,
-                        p.dim_y,
-                        p.dim_z,
-                        indices=triplets,
-                        dtype=self._real_dtype,
-                        engine=cand["engine"],
-                        precision=precision,
-                        device=device,
-                        policy="default",
-                    )
-
-            with faults.collecting(self._degradations):
-                choice, self._tuning = tuning.tuned_local(
-                    p, device, self._real_dtype, precision, build
-                )
-            engine = choice["engine"]
-            engine_env = dict(choice.get("env") or {})
-        # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
-        # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
-        # execution.py) wins on CPU where pocketfft is the fast path.
-        if engine == "auto":
-            engine = "xla" if device.platform == "cpu" else "mxu"
-        if engine not in ("mxu", "xla"):
-            raise InvalidParameterError(f"unknown engine {engine!r}")
-        # Plan-creation timing scope, parity with the reference's "Execution init"
-        # (reference: src/execution/execution_host.cpp:56). Degradation ladder
-        # rung 1: an MXU engine that fails to lower/compile (fault site
-        # engine.compile) falls back to the jnp.fft engine instead of failing
-        # plan construction; the fallback is recorded on the plan card and in
-        # engine_fallbacks_total. A jnp.fft engine failure has no rung below
-        # it and raises typed FFTWError.
-        with timing.scoped("Execution init"), faults.collecting(self._degradations):
-            if engine == "mxu":
-                from .execution_mxu import MxuLocalExecution
-
-                try:
-                    faults.site("engine.compile")
-                    # engine_env: a tuned candidate's knob overrides (empty ->
-                    # os.environ untouched; see tuning.env_overrides)
-                    with env_overrides(engine_env):
-                        self._exec = MxuLocalExecution(
-                            self._params, self._real_dtype, device=device, precision=precision
+                def build(cand):
+                    with tuning.env_overrides(cand.get("env") or {}):
+                        return Transform(
+                            self._processing_unit,
+                            p.transform_type,
+                            p.dim_x,
+                            p.dim_y,
+                            p.dim_z,
+                            indices=triplets,
+                            dtype=self._real_dtype,
+                            engine=cand["engine"],
+                            precision=precision,
+                            device=device,
+                            policy="default",
                         )
-                    self._native_transposed = True
-                except faults.ENGINE_BUILD_ERRORS as e:
-                    faults.engine_fallback("mxu", "xla", faults.summarize(e))
-                    engine = "xla"
-            if engine == "xla":
-                try:
-                    self._exec = LocalExecution(
-                        self._params, self._real_dtype, device=device
+
+                with faults.collecting(self._degradations):
+                    choice, self._tuning = tuning.tuned_local(
+                        p, device, self._real_dtype, precision, build
                     )
-                except faults.ENGINE_BUILD_ERRORS as e:
-                    raise FFTWError(
-                        f"local engine construction failed: {e}"
-                    ) from e
-                self._native_transposed = False
+                engine = choice["engine"]
+                engine_env = dict(choice.get("env") or {})
+            # Engine selection: the MXU engine (matmul DFTs + lane-copy pack/unpack,
+            # execution_mxu.py) wins on accelerators; the XLA engine (jnp.fft + scatter,
+            # execution.py) wins on CPU where pocketfft is the fast path.
+            if engine == "auto":
+                engine = "xla" if device.platform == "cpu" else "mxu"
+            if engine not in ("mxu", "xla"):
+                raise InvalidParameterError(f"unknown engine {engine!r}")
+            # Plan-creation timing scope, parity with the reference's "Execution init"
+            # (reference: src/execution/execution_host.cpp:56). Degradation ladder
+            # rung 1: an MXU engine that fails to lower/compile (fault site
+            # engine.compile) falls back to the jnp.fft engine instead of failing
+            # plan construction; the fallback is recorded on the plan card and in
+            # engine_fallbacks_total. A jnp.fft engine failure has no rung below
+            # it and raises typed FFTWError.
+            with timing.scoped("Execution init"), faults.collecting(self._degradations):
+                if engine == "mxu":
+                    from .execution_mxu import MxuLocalExecution
+
+                    try:
+                        faults.site("engine.compile")
+                        # engine_env: a tuned candidate's knob overrides (empty ->
+                        # os.environ untouched; see tuning.env_overrides)
+                        with env_overrides(engine_env):
+                            self._exec = MxuLocalExecution(
+                                self._params, self._real_dtype, device=device, precision=precision
+                            )
+                        self._native_transposed = True
+                    except faults.ENGINE_BUILD_ERRORS as e:
+                        faults.engine_fallback("mxu", "xla", faults.summarize(e))
+                        engine = "xla"
+                if engine == "xla":
+                    try:
+                        self._exec = LocalExecution(
+                            self._params, self._real_dtype, device=device
+                        )
+                    except faults.ENGINE_BUILD_ERRORS as e:
+                        raise FFTWError(
+                            f"local engine construction failed: {e}"
+                        ) from e
+                    self._native_transposed = False
+            obs.trace.event(
+                "decision", what="engine", choice=engine, policy=self._policy
+            )
         self._engine = engine
         self._precision = precision
         self._space_data = None
@@ -243,7 +253,11 @@ class Transform:
         # stage-level attribution lives in profiler traces — see timing module doc).
         obs.counter("transforms_total", direction="backward", engine=self._engine).inc()
         plat = self._device.platform
-        with timing.scoped("backward"):
+        # "execute" operation span (spfft_tpu.obs.trace): runs under the
+        # plan's run ID, so the trace of this call joins the plan card.
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="backward"
+        ), timing.scoped("backward"):
             if self._guard:
                 faults.check_array(
                     np.asarray(values), check="backward input", platform=plat
@@ -329,7 +343,9 @@ class Transform:
             _validate_data_location(input_location)
         obs.counter("transforms_total", direction="forward", engine=self._engine).inc()
         plat = self._device.platform
-        with timing.scoped("forward"):
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="forward"
+        ), timing.scoped("forward"):
             if self._guard and space is not None:
                 faults.check_array(
                     np.asarray(space), check="forward input", platform=plat

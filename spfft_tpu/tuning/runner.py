@@ -148,19 +148,27 @@ def run_trials(build, candidates: list) -> list:
     rows, failed = [], []
     for cand in candidates:
         try:
-            faults.site("tuning.trial")
-            trial = build(cand)
-            degraded = [
-                d["event"]
-                for d in getattr(trial, "_degradations", ())
-                if d.get("event") == "engine_fallback"
-            ]
-            if degraded:
-                raise TrialDegradedError(
-                    f"trial plan fell back ({degraded[0]}): timing would not "
-                    "measure the candidate"
-                )
-            seconds = measure_candidate(trial)
+            # each trial is its own "tune.trial" operation (child run of the
+            # plan construction being tuned — spfft_tpu.obs.trace), so a
+            # trace shows which candidate's build/roundtrips cost what;
+            # dumps are suppressed inside: a failing candidate is an
+            # EXPECTED, isolated error row, not a crash worth a dump file
+            with obs.trace.operation(
+                "tune.trial", label=cand["label"]
+            ), obs.trace.suppressed_dumps():
+                faults.site("tuning.trial")
+                trial = build(cand)
+                degraded = [
+                    d["event"]
+                    for d in getattr(trial, "_degradations", ())
+                    if d.get("event") == "engine_fallback"
+                ]
+                if degraded:
+                    raise TrialDegradedError(
+                        f"trial plan fell back ({degraded[0]}): timing would "
+                        "not measure the candidate"
+                    )
+                seconds = measure_candidate(trial)
         except TRIAL_ERRORS as e:
             obs.counter("tuning_trial_failures_total", candidate=cand["label"]).inc()
             failed.append(dict(cand, error=faults.summarize(e)))

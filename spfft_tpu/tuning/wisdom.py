@@ -207,7 +207,13 @@ class WisdomStore:
         entry = self._load().get(key_digest(key))
         # entries written by hand/a future version must at least carry a choice
         if entry is not None and not isinstance(entry.get("choice"), dict):
-            return None
+            entry = None
+        obs.trace.event(
+            "wisdom.load",
+            path=self.path,
+            outcome=self.fallback_reason or "ok",
+            hit=entry is not None,
+        )
         return entry
 
     def record(self, key: dict, entry: dict) -> None:
@@ -248,6 +254,10 @@ class WisdomStore:
                             except OSError:
                                 pass
                             raise
+                obs.trace.event(
+                    "wisdom.save", path=self.path, outcome="ok",
+                    attempt=attempt + 1,
+                )
                 return
             except (OSError, faults.InjectedFault) as e:
                 last = e
@@ -260,6 +270,9 @@ class WisdomStore:
         """Exhausted-retry terminal: count and record, never raise (ladder
         rung 2 — a dead store must not fail plan construction)."""
         obs.counter("wisdom_save_failures_total").inc()
+        obs.trace.event(
+            "wisdom.save", path=self.path, outcome="failed", reason=str(exc)
+        )
         faults.record_degradation(
             "wisdom_save_failed", str(exc), path=self.path
         )
@@ -273,11 +286,16 @@ class MemoryStore:
     _entries: dict = {}
 
     def lookup(self, key: dict) -> dict | None:
-        return MemoryStore._entries.get(key_digest(key))
+        entry = MemoryStore._entries.get(key_digest(key))
+        obs.trace.event(
+            "wisdom.load", path=None, outcome="ok", hit=entry is not None
+        )
+        return entry
 
     def record(self, key: dict, entry: dict) -> None:
         with _lock:
             MemoryStore._entries[key_digest(key)] = entry
+        obs.trace.event("wisdom.save", path=None, outcome="ok", attempt=1)
 
 
 def active_store():

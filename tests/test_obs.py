@@ -153,6 +153,46 @@ def test_prometheus_text_renders_all_kinds():
     assert text.count("# TYPE spfft_tpu_transforms_total counter") == 1
 
 
+def test_prometheus_text_golden():
+    """Golden exposition output: counter/gauge/histogram rendered byte-exact
+    — cumulative `le` buckets ending at the total count, one TYPE line per
+    metric, label values escaped per the Prometheus text format (backslash,
+    double-quote, newline)."""
+    obs.counter("transforms_total", direction="backward", engine="xla").inc(2)
+    obs.counter("transforms_total", direction="forward", engine="xla").inc()
+    # label-value escaping: quotes, backslashes and newlines must not break
+    # the exposition line
+    obs.counter("odd_labels_total", path='a"b', note="c\\d\ne").inc()
+    obs.gauge("capacity", unit="slots").set(3.5)
+    h = obs.histogram("wait_seconds", direction="backward")
+    for v in (5e-6, 5e-6, 2e-4, 0.5, 100.0):
+        h.observe(v)
+    golden = "\n".join(
+        [
+            "# TYPE spfft_tpu_odd_labels_total counter",
+            'spfft_tpu_odd_labels_total{note="c\\\\d\\ne",path="a\\"b"} 1',
+            "# TYPE spfft_tpu_transforms_total counter",
+            'spfft_tpu_transforms_total{direction="backward",engine="xla"} 2',
+            'spfft_tpu_transforms_total{direction="forward",engine="xla"} 1',
+            "# TYPE spfft_tpu_capacity gauge",
+            'spfft_tpu_capacity{unit="slots"} 3.5',
+            "# TYPE spfft_tpu_wait_seconds histogram",
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="1e-05"} 2',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="0.0001"} 2',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="0.001"} 3',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="0.01"} 3',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="0.1"} 3',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="1.0"} 4',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="10.0"} 4',
+            'spfft_tpu_wait_seconds_bucket{direction="backward",le="+Inf"} 5',
+            'spfft_tpu_wait_seconds_sum{direction="backward"} 100.50021',
+            'spfft_tpu_wait_seconds_count{direction="backward"} 5',
+            "",
+        ]
+    )
+    assert obs.prometheus_text() == golden
+
+
 def test_phase_timer_records_duration():
     with obs.phase_timer("dispatch_seconds", direction="forward"):
         pass

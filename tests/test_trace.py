@@ -1,0 +1,341 @@
+"""spfft_tpu.obs.trace: flight recorder, run IDs, Chrome export, dump-on-error.
+
+Contract layers (ISSUE 4 acceptance):
+
+* recorder — ring-buffer capacity/eviction honesty (``dropped``), the
+  disarmed no-op fast path (shared falsy singletons, zero allocation),
+  schema-pinned snapshots (``validate_trace`` + JSON round-trip);
+* correlation — one run ID joins the plan card, the metrics window and the
+  trace events of a plan's construction and executions, and event order is
+  deterministic under ``delay`` fault injection;
+* export — ``chrome_trace()`` loads as Chrome trace-event JSON with
+  balanced begin/end pairs for every host phase;
+* dump-on-error — a typed error (guard failure) flushes the recorder to
+  ``SPFFT_TPU_TRACE_DUMP`` with the failing plan's run ID in the events.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    HostExecutionError,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    faults,
+    obs,
+)
+from spfft_tpu.obs import trace
+from utils import random_sparse_triplets
+
+
+@pytest.fixture(autouse=True)
+def fresh_trace():
+    """Each test sees an armed, empty recorder and leaves tracing disarmed
+    (the process default) with clean metrics."""
+    obs.clear()
+    trace.enable(capacity=4096)
+    yield
+    trace.disable()
+    obs.clear()
+
+
+def _roundtrip(dim=8, guard=None, seed=0):
+    trip = random_sparse_triplets(np.random.default_rng(seed), dim, dim, dim, 0.5)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, dim, dim, dim,
+        indices=trip, guard=guard,
+    )
+    rng = np.random.default_rng(seed + 1)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    t.backward(values)
+    t.forward(scaling=ScalingType.FULL)
+    return t
+
+
+# ---- recorder ----------------------------------------------------------------
+
+
+def test_ring_buffer_capacity_and_eviction():
+    trace.enable(capacity=8)
+    for i in range(20):
+        trace.event("guard", check=f"c{i}", verdict="ok")
+    snap = trace.snapshot()
+    assert snap["capacity"] == 8
+    assert len(snap["events"]) == 8
+    # honesty about truncation: 12 evictions counted, the LAST 8 retained
+    assert snap["dropped"] == 12
+    assert [ev["seq"] for ev in snap["events"]] == list(range(13, 21))
+    assert [ev["args"]["check"] for ev in snap["events"]] == [
+        f"c{i}" for i in range(12, 20)
+    ]
+
+
+def test_disarmed_recorder_is_shared_noop():
+    trace.disable()
+    assert not trace.enabled()
+    # zero-allocation contract: every disarmed scope is THE shared
+    # singleton, and emitting records nothing
+    s1 = trace.span("phase", label="x")
+    s2 = trace.span("fence")
+    op = trace.operation("plan")
+    assert s1 is s2 is op
+    with s1:
+        trace.event("guard", check="noop", verdict="ok")
+    snap = trace.snapshot()
+    assert snap["enabled"] is False
+    assert snap["events"] == [] and snap["capacity"] == 0
+    # disarmed transform path records no trace events either
+    _roundtrip()
+    assert trace.snapshot()["events"] == []
+
+
+def test_snapshot_schema_and_json_roundtrip():
+    with trace.operation("plan", kind="local"):
+        trace.event("decision", what="engine", choice="xla")
+    snap = trace.snapshot()
+    assert snap["schema"] == trace.TRACE_SCHEMA == "spfft_tpu.obs.trace/1"
+    assert trace.validate_trace(snap) == []
+    assert json.loads(json.dumps(snap)) == snap
+    # the validator flags drift
+    assert trace.validate_trace({"schema": "bogus/9"})
+    bad = dict(snap, events=[{"seq": 1, "ts": 0.0, "run": None,
+                              "name": "nope", "ph": "Z"}])
+    findings = trace.validate_trace(bad)
+    assert any("ph" in f for f in findings)
+    assert any("name" in f for f in findings)
+    assert any("args" in f for f in findings)
+
+
+def test_operation_nesting_records_parent_run():
+    with trace.operation("plan", run_id="rP") as _:
+        assert trace.current_run_id() == "rP"
+        with trace.operation("tune.trial", label="cand"):
+            inner = trace.current_run_id()
+            assert inner != "rP"
+            trace.event("fault.injected", site="tuning.trial", kind="raise")
+        assert trace.current_run_id() == "rP"
+    assert trace.current_run_id() is None
+    events = trace.snapshot()["events"]
+    trial_b = [e for e in events if e["name"] == "tune.trial" and e["ph"] == "B"]
+    assert trial_b and trial_b[0]["args"]["parent"] == "rP"
+    assert trial_b[0]["run"] == inner
+    instant = [e for e in events if e["name"] == "fault.injected"]
+    assert instant[0]["run"] == inner
+
+
+def test_trace_env_knobs_arm_at_import():
+    """SPFFT_TPU_TRACE=1 arms the recorder at import with the
+    SPFFT_TPU_TRACE_CAP capacity, before any user code runs."""
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from spfft_tpu.obs import trace\n"
+            "assert trace.enabled()\n"
+            "snap = trace.snapshot()\n"
+            "assert snap['capacity'] == 4, snap['capacity']\n"
+            "print('ok')\n",
+        ],
+        env={
+            **os.environ,
+            "SPFFT_TPU_TRACE": "1",
+            "SPFFT_TPU_TRACE_CAP": "4",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert "ok" in r.stdout
+
+
+# ---- run-ID correlation ------------------------------------------------------
+
+
+def test_run_id_joins_card_metrics_and_trace():
+    t = _roundtrip()
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    rid = card["run_id"]
+    assert rid == t._run_id and rid
+    # every event of this plan's construction AND executions carries the
+    # card's run ID — the one join key across the three artifacts
+    events = trace.snapshot()["events"]
+    assert events and all(ev["run"] == rid for ev in events)
+    names = {ev["name"] for ev in events}
+    assert {"plan", "execute", "phase", "fence", "decision"} <= names
+    # the metrics window of the same process shows what ran
+    snap = obs.snapshot()
+    assert any(k.startswith("transforms_total") for k in snap["counters"])
+    doc = {"plan": card, "metrics": snap, "trace": trace.snapshot()}
+    assert json.loads(json.dumps(doc)) == doc
+    # a second plan gets a distinct run ID — runs do not alias
+    t2 = _roundtrip(seed=7)
+    assert t2.report()["run_id"] != rid
+
+
+def test_decision_event_matches_card():
+    t = _roundtrip()
+    card = t.report()
+    decisions = [
+        ev for ev in trace.snapshot()["events"] if ev["name"] == "decision"
+    ]
+    (engine_decision,) = [d for d in decisions if d["args"]["what"] == "engine"]
+    assert engine_decision["args"]["choice"] == card["engine"]
+    assert engine_decision["run"] == card["run_id"]
+
+
+def test_deterministic_ordering_under_delay_injection():
+    """With a delay fault armed at the fence, two identical runs record the
+    identical event sequence — injected latency shifts timestamps, never
+    order (the flight recorder's total order is seq, not ts)."""
+
+    def shape():
+        trace.clear()
+        _roundtrip()
+        return [
+            (ev["name"], ev["ph"], ev["args"].get("label"))
+            for ev in trace.snapshot()["events"]
+        ]
+
+    with faults.inject("sync.fence=delay"):
+        first = shape()
+        second = shape()
+    assert first == second
+    assert ("fault.injected", "i", None) in first
+    seqs = [ev["seq"] for ev in trace.snapshot()["events"]]
+    assert seqs == sorted(seqs)
+
+
+# ---- Chrome export -----------------------------------------------------------
+
+
+def test_chrome_trace_loads_with_balanced_host_phases():
+    """ISSUE 4 acceptance: the Chrome export of a traced forward+backward
+    loads as valid trace-event JSON and carries begin/end pairs for every
+    host phase, one named track per phase."""
+    _roundtrip()
+    chrome = json.loads(json.dumps(trace.chrome_trace()))
+    events = chrome["traceEvents"]
+    assert chrome["displayTimeUnit"] == "ms"
+    track_names = {
+        e["args"]["name"] for e in events if e["name"] == "thread_name"
+    }
+    for phase in (
+        "backward", "forward", "dispatch", "wait",
+        "input staging", "output staging", "Execution init",
+    ):
+        assert phase in track_names
+        begins = [e for e in events if e["name"] == phase and e["ph"] == "B"]
+        ends = [e for e in events if e["name"] == phase and e["ph"] == "E"]
+        assert begins, f"no begin event for host phase {phase!r}"
+        assert len(begins) == len(ends), f"unbalanced phase {phase!r}"
+    # spans carry their run ID into the viewer's args pane
+    assert all(
+        "run" in e["args"] for e in events if e["ph"] in ("B", "E", "i")
+    )
+    # timestamps are microseconds, non-decreasing per the seq order
+    ts = [e["ts"] for e in events if e["ph"] in ("B", "E", "i")]
+    assert ts == sorted(ts)
+
+
+def test_timing_tree_and_trace_share_scopes():
+    """Satellite: timing.scoped feeds BOTH the timing tree and the flight
+    recorder when both are armed — the nested timing nodes ARE the trace's
+    phase slices, not a separate report-only tree."""
+    from spfft_tpu import timing
+
+    timing.enable()
+    try:
+        timing.clear()
+        _roundtrip()
+        tree = timing.process()
+        labels = {
+            ev["args"]["label"]
+            for ev in trace.snapshot()["events"]
+            if ev["name"] == "phase"
+        }
+        for node in tree.sub:
+            assert node.label in labels
+    finally:
+        timing.disable()
+        timing.clear()
+
+
+# ---- dump-on-error -----------------------------------------------------------
+
+
+def test_guard_failure_dumps_flight_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv(trace.TRACE_DUMP_ENV, str(tmp_path))
+    trip = random_sparse_triplets(np.random.default_rng(3), 8, 8, 8, 0.5)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+        indices=trip, guard=True,
+    )
+    rid = t.report()["run_id"]
+    poisoned = np.full(len(trip), np.nan, dtype=np.complex128)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(HostExecutionError):
+            t.backward(poisoned)
+    dumps = sorted(glob.glob(str(tmp_path / "trace-*.json")))
+    assert dumps, "typed guard failure did not flush the flight recorder"
+    doc = json.loads(open(dumps[-1]).read())
+    assert doc["reason"] == "HostExecutionError"
+    assert trace.validate_trace(doc) == []
+    # the dump's events carry the failing plan's run ID (card join key)
+    assert rid in {ev["run"] for ev in doc["events"]}
+    names = {ev["name"] for ev in doc["events"]}
+    assert "error" in names and "guard" in names
+    (fail,) = [
+        ev for ev in doc["events"]
+        if ev["name"] == "guard" and ev["args"]["verdict"] == "fail"
+    ]
+    assert fail["run"] == rid
+
+
+def test_suppressed_dumps_and_rotation(tmp_path, monkeypatch):
+    """Expected-and-recovered typed errors must not flood the dump dir:
+    suppression scopes silence dump() (events still record), and dump files
+    rotate within DUMP_KEEP so disk stays bounded."""
+    monkeypatch.setenv(trace.TRACE_DUMP_ENV, str(tmp_path))
+    with trace.suppressed_dumps():
+        assert trace.dump("handled") is None
+        with pytest.raises(sp.InvalidParameterError):
+            Transform(
+                ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=None
+            )
+    assert not list(tmp_path.iterdir())
+    # the error event itself still recorded — suppression only gates files
+    assert any(
+        ev["name"] == "error" for ev in trace.snapshot()["events"]
+    )
+    # outside the scope dumps write, and the filename index wraps < DUMP_KEEP
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        path = trace.dump("manual")
+    assert path is not None and os.path.exists(path)
+    idx = int(Path(path).stem.rsplit("-", 1)[1])
+    assert 0 <= idx < trace.DUMP_KEEP
+
+
+def test_dump_disabled_without_env(tmp_path):
+    # no SPFFT_TPU_TRACE_DUMP: typed errors record the event but write nothing
+    assert os.environ.get(trace.TRACE_DUMP_ENV) is None
+    assert trace.dump("manual") is None
+    with pytest.raises(sp.InvalidParameterError):
+        Transform(
+            ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=None
+        )
+    errors = [
+        ev for ev in trace.snapshot()["events"] if ev["name"] == "error"
+    ]
+    assert errors and errors[-1]["args"]["type"] == "InvalidParameterError"
