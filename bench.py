@@ -199,6 +199,11 @@ def main():
                 # a flight-recorder dump or snapshot from this process joins
                 # this capture on one key
                 "run_id": plan_card.get("run_id"),
+                # verification setting (spfft_tpu.verify): perf rows under
+                # verification are never comparable to rows without it
+                "verify_mode": plan_card.get("verification", {}).get(
+                    "mode", "off"
+                ),
             }
         )
     )

@@ -14,6 +14,7 @@
 #          ./ci.sh tune       # autotuner smoke (trial + wisdom hit, CPU)
 #          ./ci.sh trace      # flight recorder: schema + Chrome export + dump
 #          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
+#          ./ci.sh verify     # ABFT checks, corrupt-injection recovery, breaker
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -154,6 +155,68 @@ run_chaos() {
   SPFFT_TPU_GUARD=1 timeout 540 python -m pytest tests/test_engine_parity_fuzz.py -q
 }
 
+run_verify() {
+  echo "== Verify (spfft_tpu.verify: ABFT checks + recovery supervisor + breaker, CPU) =="
+  timeout 540 python -m pytest tests/test_verify.py -q
+  local vdir
+  vdir="$(mktemp -d)"
+  # Clean verified roundtrip: every check passes, card schema-complete
+  # (verification section included), zero recoveries.
+  JAX_PLATFORMS=cpu timeout 540 python programs/verify.py -d 16 16 16 \
+    -o "$vdir/clean.json" > /dev/null
+  # SDC end-to-end: every dispatch corrupted, yet the roundtrip recovers via
+  # the jnp.fft reference rung with the recovery recorded — the acceptance
+  # invariant (a silently wrong result is impossible) exercised for real.
+  JAX_PLATFORMS=cpu timeout 540 python programs/verify.py -d 16 16 16 \
+    --inject "engine.execute=corrupt:1.0" -o "$vdir/corrupt.json" > /dev/null
+  python - "$vdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+clean = json.load(open(f"{d}/clean.json"))
+corrupt = json.load(open(f"{d}/corrupt.json"))
+assert clean["outcome"] == "verified" and not clean["degradations"], clean
+assert clean["roundtrip_residual"] < 1e-4, clean["roundtrip_residual"]
+assert not clean.get("card_schema_missing"), clean["card_schema_missing"]
+for k in ("mode", "checks", "rtol", "retries", "breaker"):
+    assert k in clean["verification"], (k, clean["verification"])
+assert corrupt["outcome"] == "verified", corrupt
+assert corrupt["roundtrip_residual"] < 1e-4, corrupt["roundtrip_residual"]
+recoveries = sum(v for k, v in corrupt["metrics"].items()
+                 if k.startswith("verify_recoveries_total"))
+assert recoveries > 0, corrupt["metrics"]
+assert any(e["event"] == "verify_demoted" for e in corrupt["degradations"])
+print(f"verify smoke ok (clean residual {clean['roundtrip_residual']:.2e}, "
+      f"{recoveries} recoveries under corrupt:1.0)")
+EOF
+  # Breaker trips at K: with K=2 and every dispatch corrupted, the third
+  # transform must find the engine breaker open and skip the primary path.
+  JAX_PLATFORMS=cpu SPFFT_TPU_VERIFY=1 SPFFT_TPU_VERIFY_BREAKER_K=2 \
+    SPFFT_TPU_FAULTS="engine.execute=corrupt:1.0" timeout 540 python - <<'EOF'
+import numpy as np
+import spfft_tpu as sp
+from spfft_tpu import ProcessingUnit, Transform, TransformType, obs, verify
+
+trip = sp.create_spherical_cutoff_triplets(12, 12, 12, 0.8)
+rng = np.random.default_rng(0)
+values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+expect = None
+for i in range(3):
+    t = Transform(ProcessingUnit.HOST, TransformType.C2C, 12, 12, 12, indices=trip)
+    out = t.backward(values)
+    expect = out if expect is None else expect
+    assert np.allclose(out, expect), f"roundtrip {i} diverged"
+state = verify.breaker.describe(t._engine)
+assert state["state"] == "open" and state["trips"] == 1, state
+assert any(e["event"] == "verify_breaker_open" for e in t.report()["degradations"]), \
+    t.report()["degradations"]
+gauges = obs.snapshot()["gauges"]
+assert any(k.startswith("verify_breaker_state") and v == 1 for k, v in gauges.items()), gauges
+print(f"breaker ok: open after K=2 verified failures, third call short-circuited")
+EOF
+  rm -rf "$vdir"
+}
+
 run_dryrun() {
   echo "== Multichip dryrun (8-device CPU mesh, CPU forced) =="
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
@@ -180,6 +243,7 @@ case "$stage" in
   tune) run_tune ;;
   trace) run_trace ;;
   chaos) run_chaos ;;
+  verify) run_verify ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
@@ -189,12 +253,13 @@ case "$stage" in
     run_tune
     run_trace
     run_chaos
+    run_verify
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -31,7 +31,10 @@ enum SpfftError {
   SPFFT_GPU_INVALID_VALUE_ERROR = 19,
   SPFFT_GPU_INVALID_DEVICE_PTR_ERROR = 20,
   SPFFT_GPU_COPY_ERROR = 21,
-  SPFFT_GPU_FFT_ERROR = 22
+  SPFFT_GPU_FFT_ERROR = 22,
+  /* TPU-build extension beyond the reference enum: algorithm-based
+   * self-verification (ABFT) failed and recovery was exhausted. */
+  SPFFT_VERIFICATION_ERROR = 23
 };
 
 #ifndef __cplusplus

@@ -72,6 +72,8 @@ SPFFT_TPU_DEFINE_ERROR(GPUInvalidDevicePointerError, SPFFT_GPU_INVALID_DEVICE_PT
 SPFFT_TPU_DEFINE_ERROR(GPUCopyError, SPFFT_GPU_COPY_ERROR, "spfft_tpu: device copy failed")
 SPFFT_TPU_DEFINE_ERROR(GPUFFTError, SPFFT_GPU_FFT_ERROR,
                        "spfft_tpu: accelerator FFT error")
+SPFFT_TPU_DEFINE_ERROR(VerificationError, SPFFT_VERIFICATION_ERROR,
+                       "spfft_tpu: self-verification failed, recovery exhausted")
 
 #undef SPFFT_TPU_DEFINE_ERROR
 

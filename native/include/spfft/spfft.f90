@@ -36,6 +36,8 @@ module spfft
   integer(c_int), parameter :: SPFFT_GPU_INVALID_DEVICE_PTR_ERROR = 20
   integer(c_int), parameter :: SPFFT_GPU_COPY_ERROR = 21
   integer(c_int), parameter :: SPFFT_GPU_FFT_ERROR = 22
+  ! TPU-build extension: self-verification (ABFT) failed, recovery exhausted
+  integer(c_int), parameter :: SPFFT_VERIFICATION_ERROR = 23
 
   ! --- SpfftExchangeType (spfft/types.h) ---
   integer(c_int), parameter :: SPFFT_EXCH_DEFAULT = 0

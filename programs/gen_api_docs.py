@@ -263,6 +263,7 @@ def index_page() -> str:
         - [Observability: plan cards, metrics, execution trace](obs.md)
         - [Autotuning and wisdom](tuning.md)
         - [Fault injection, guard mode and degradation](faults.md)
+        - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -324,6 +325,43 @@ def obs_page() -> str:
         ],
     )
     return metrics + "\n" + tracing
+
+
+def verify_page() -> str:
+    """The verification page: the `spfft_tpu.verify` surface (ABFT checks,
+    the recovery supervisor, the engine circuit breaker)."""
+    from spfft_tpu import verify
+    from spfft_tpu.verify import breaker
+
+    main = class_page(
+        "Verification",
+        doc(verify),
+        [verify.Supervisor],
+        [
+            verify.resolve_mode,
+            verify.resolve_rtol,
+            verify.resolve_retries,
+            verify.resolve_backoff_s,
+            verify.applicable_checks,
+            verify.run_checks,
+        ],
+    )
+    brk = class_page(
+        "Engine circuit breaker (`spfft_tpu.verify.breaker`)",
+        doc(breaker),
+        [],
+        [
+            breaker.allow,
+            breaker.record_success,
+            breaker.record_failure,
+            breaker.describe,
+            breaker.snapshot,
+            breaker.reset,
+            breaker.threshold,
+            breaker.cooldown_s,
+        ],
+    )
+    return main + "\n" + brk
 
 
 def generate(outdir: Path) -> None:
@@ -415,6 +453,7 @@ def generate(outdir: Path) -> None:
                 faults.typed_execution,
             ],
         ),
+        "verify.md": verify_page(),
         "c_api.md": c_api_page(),
         "fortran.md": fortran_page(),
         "examples.md": examples_page(),

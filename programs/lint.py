@@ -23,7 +23,12 @@ kept flagging are enforced here with the stdlib ast module:
    in the package names an event registered in the canonical
    ``spfft_tpu.obs.trace.EVENTS`` vocabulary, and every registered event is
    emitted by at least one package call site (same both-ways rule; keeps
-   flight-recorder streams and their consumers on one vocabulary).
+   flight-recorder streams and their consumers on one vocabulary),
+7. verify-check consistency — the canonical ``spfft_tpu.verify.CHECKS``
+   vocabulary matches the ``CHECK_FNS`` implementation registry exactly
+   (every registered check implemented, every implementation registered)
+   and every check is documented in docs/details.md — the ABFT layer's
+   instance of the same both-ways contract.
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -389,6 +394,65 @@ def check_trace_events(findings: list):
             )
 
 
+# The ABFT check vocabulary (spfft_tpu/verify/checks.py CHECKS): the tuple
+# and the CHECK_FNS implementation registry must agree exactly, and every
+# check must be documented — the verify layer's both-ways contract.
+VERIFY_CHECKS_FILE = "spfft_tpu/verify/checks.py"
+
+
+def _canonical_checks() -> tuple:
+    """CHECKS and CHECK_FNS keys from verify/checks.py via ast (import-free,
+    like STAGES/SITES/EVENTS)."""
+    tree = ast.parse((ROOT / VERIFY_CHECKS_FILE).read_text())
+    checks = fns = None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "CHECKS":
+                checks = tuple(ast.literal_eval(node.value))
+            if isinstance(t, ast.Name) and t.id == "CHECK_FNS":
+                if not isinstance(node.value, ast.Dict):
+                    raise AssertionError(
+                        f"CHECK_FNS in {VERIFY_CHECKS_FILE} must be a dict literal"
+                    )
+                fns = tuple(
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                )
+    if checks is None or fns is None:
+        raise AssertionError(
+            f"no CHECKS/CHECK_FNS assignments in {VERIFY_CHECKS_FILE}"
+        )
+    return checks, fns
+
+
+def check_verify_checks(findings: list):
+    checks, fns = _canonical_checks()
+    if len(set(checks)) != len(checks):
+        findings.append(f"{VERIFY_CHECKS_FILE}: duplicate entries in CHECKS")
+    for name in checks:
+        if name not in fns:
+            findings.append(
+                f"{VERIFY_CHECKS_FILE}: check {name!r} is registered in CHECKS "
+                "but has no CHECK_FNS implementation"
+            )
+    for name in fns:
+        if name not in checks:
+            findings.append(
+                f"{VERIFY_CHECKS_FILE}: CHECK_FNS implements {name!r} but it "
+                "is not registered in CHECKS"
+            )
+    docs_text = DOCS.read_text()
+    for name in checks:
+        if name not in docs_text:
+            findings.append(
+                f"verify check {name!r} is not documented in "
+                f"{DOCS.relative_to(ROOT)}"
+            )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -399,6 +463,7 @@ def main() -> int:
     check_stage_scopes(findings)
     check_fault_sites(findings)
     check_trace_events(findings)
+    check_verify_checks(findings)
     for f in findings:
         print(f)
     if findings:

@@ -107,8 +107,15 @@ def main(argv=None):
         plan.forward(scaling=ScalingType.FULL)
 
     # run_id rides top-level too (it is also inside the card): the join key
-    # against a flight-recorder snapshot/dump from the same process
-    report = {"plan": card, "metrics": obs.snapshot(), "run_id": card.get("run_id")}
+    # against a flight-recorder snapshot/dump from the same process;
+    # verify_mode stamps the verification setting so perf/metrics rows are
+    # never compared across unlike verification settings
+    report = {
+        "plan": card,
+        "metrics": obs.snapshot(),
+        "run_id": card.get("run_id"),
+        "verify_mode": card.get("verification", {}).get("mode", "off"),
+    }
     missing = obs.validate_report(report)
 
     print(json.dumps(card, indent=2))

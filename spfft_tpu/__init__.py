@@ -30,11 +30,13 @@ from .errors import (  # noqa: F401
     MPIParameterMismatchError,
     MPISupportError,
     OverflowError_,
+    VerificationError,
 )
 from . import faults  # noqa: F401
 from . import obs  # noqa: F401
 from . import timing  # noqa: F401
 from . import tuning  # noqa: F401
+from . import verify  # noqa: F401
 from .distributed import DistributedTransform  # noqa: F401
 from .grid import Grid  # noqa: F401
 from .indices import (  # noqa: F401

@@ -92,23 +92,33 @@ def cpu_device():
     return cpu_devices(1)[0]
 
 
-def hang_watchdog(label: str, budget_env: str, default_s: float, exit_code: int):
+def hang_watchdog(
+    label: str,
+    budget_env: str,
+    default_s: float,
+    exit_code: int,
+    budget_s: float | None = None,
+):
     """Arm a wall-clock budget against unkillable native hangs (a wedged
     accelerator-plugin init blocks forever and ignores signals delivered to
     the blocked thread). Returns a disarm callable; if not disarmed within the
-    budget (env ``budget_env``, default ``default_s`` seconds), prints a
-    one-line diagnostic plus all-thread stacks and ``os._exit``\\ s with
-    ``exit_code`` — a fast, capturable failure instead of a driver timeout.
+    budget (env ``budget_env``, default ``default_s`` seconds; an explicit
+    ``budget_s`` overrides both), prints a one-line diagnostic plus
+    all-thread stacks and ``os._exit``\\ s with ``exit_code`` — a fast,
+    capturable failure instead of a driver timeout.
 
-    Used by the driver entry points (bench.py, __graft_entry__.py); ordinary
-    library calls never arm it.
+    Armed by the driver entry points (bench.py, __graft_entry__.py) and — as
+    the last-resort backstop behind the typed fence deadline — by
+    :func:`spfft_tpu.sync.fence` when ``SPFFT_TPU_FENCE_BUDGET_S`` is set;
+    ordinary library calls never arm it.
     """
     import faulthandler
     import os
     import sys
     import threading
 
-    budget_s = float(os.environ.get(budget_env, default_s))
+    if budget_s is None:
+        budget_s = float(os.environ.get(budget_env, default_s))
     disarmed = threading.Event()
 
     def _watch():

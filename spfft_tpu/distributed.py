@@ -48,6 +48,7 @@ class DistributedTransform:
         precision="highest",
         policy: str | None = None,
         guard: bool | None = None,
+        verify=None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -270,6 +271,26 @@ class DistributedTransform:
         # Plan-constant; cached lazily so the metrics-off path never pays the
         # per-step numpy accounting in exchange_wire_bytes().
         self._wire_bytes_cache = None
+        # Self-verification (spfft_tpu.verify), mirroring the local Transform.
+        # Single-controller meshes only: the reference rung and the checks
+        # need every shard's data host-side, which a multi-process mesh
+        # cannot provide (remote shards are None by contract).
+        from .verify import resolve_mode
+
+        self._verify_mode = resolve_mode(verify)
+        self._verifier = None
+        self._reference_exec = None
+        if self._verify_mode != "off":
+            from .parallel.execution import mesh_process_span
+
+            if mesh_process_span(mesh) > 1:
+                raise InvalidParameterError(
+                    "verification requires a single-controller mesh: remote "
+                    "shards are not host-visible on multi-process meshes"
+                )
+            from .verify import Supervisor
+
+            self._verifier = Supervisor(self, self._verify_mode)
 
     # ---- transforms -----------------------------------------------------------
 
@@ -290,27 +311,38 @@ class DistributedTransform:
                 faults.check_array(
                     list(values), check="backward input", platform=plat
                 )
-            out = self._dispatch_backward(values)
-            if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"), obs.phase_timer(
-                    "wait_seconds", direction="backward"
-                ), faults.typed_execution(plat, "backward wait"):
-                    fence(out)
-            with timing.scoped("output staging"):
-                result = self._finalize_backward(out)
-            if self._guard:
-                # single-controller meshes return the global slab; multi-
-                # process meshes return per-shard local z-slabs (unpad_space
-                # contract) whose shapes differ per shard — finite-scan only
-                faults.check_array(
-                    result,
-                    check="backward output",
-                    platform=plat,
-                    shape=None
-                    if isinstance(result, (list, tuple))
-                    else (self.dim_z, self.dim_y, self.dim_x),
-                )
-            return result
+            if self._verifier is not None:
+                # supervised path (spfft_tpu.verify): check -> retry ->
+                # jnp.fft reference -> typed VerificationError
+                return self._verifier.backward(values)
+            return self._backward_attempt(values)
+
+    def _backward_attempt(self, values):
+        """One full backward execution (stage, exchange dispatch, fence,
+        finalize, guard post-checks) — the unit the verify supervisor
+        re-executes; identical to the whole unsupervised path."""
+        plat = self._platform
+        out = self._dispatch_backward(values)
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="backward"
+            ), faults.typed_execution(plat, "backward wait"):
+                fence(out)
+        with timing.scoped("output staging"):
+            result = self._finalize_backward(out)
+        if self._guard:
+            # single-controller meshes return the global slab; multi-
+            # process meshes return per-shard local z-slabs (unpad_space
+            # contract) whose shapes differ per shard — finite-scan only
+            faults.check_array(
+                result,
+                check="backward output",
+                platform=plat,
+                shape=None
+                if isinstance(result, (list, tuple))
+                else (self.dim_z, self.dim_y, self.dim_x),
+            )
+        return result
 
     def _record_wire_bytes(self):
         """Count the exchange's per-dispatch wire bytes (plan-constant) into
@@ -359,19 +391,27 @@ class DistributedTransform:
                 faults.check_array(
                     np.asarray(space), check="forward input", platform=plat
                 )
-            pair = self._dispatch_forward(space, scaling)
-            if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"), obs.phase_timer(
-                    "wait_seconds", direction="forward"
-                ), faults.typed_execution(plat, "forward wait"):
-                    fence(pair)
-            with timing.scoped("output staging"):
-                result = self._finalize_forward(pair)
-            if self._guard:
-                faults.check_array(
-                    result, check="forward output", platform=plat
-                )
-            return result
+            if self._verifier is not None:
+                return self._verifier.forward(space, scaling)
+            return self._forward_attempt(space, scaling)
+
+    def _forward_attempt(self, space, scaling):
+        """One full forward execution — the re-executable unit of the verify
+        supervisor (mirrors :meth:`_backward_attempt`)."""
+        plat = self._platform
+        pair = self._dispatch_forward(space, scaling)
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="forward"
+            ), faults.typed_execution(plat, "forward wait"):
+                fence(pair)
+        with timing.scoped("output staging"):
+            result = self._finalize_forward(pair)
+        if self._guard:
+            faults.check_array(
+                result, check="forward output", platform=plat
+            )
+        return result
 
     def _dispatch_forward(self, space, scaling):
         """Stage the space-domain input (or reuse the retained slabs) and enqueue
@@ -381,20 +421,27 @@ class DistributedTransform:
                 raise InvalidParameterError(
                     "no space domain data: run backward first or pass an array"
                 )
-            if self._exec.is_r2c:
-                re, im = self._space_data, None
-            else:
-                re, im = self._space_data
         else:
             with timing.scoped("input staging"):
-                re, im = self._exec.pad_space(np.asarray(space))
-                self._space_data = re if self._exec.is_r2c else (re, im)
+                self._retain_space(space)
+        if self._exec.is_r2c:
+            re, im = self._space_data, None
+        else:
+            re, im = self._space_data
         self._record_wire_bytes()
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="forward"
         ), faults.typed_execution(self._platform, "forward dispatch"):
             pair = self._exec.forward_pair(re, im, ScalingType(scaling))
             return faults.site("engine.execute", payload=pair)
+
+    def _retain_space(self, space) -> None:
+        """Stage a host global space array as the retained sharded buffer —
+        the staging half of :meth:`_dispatch_forward`, also used by the
+        verify supervisor to replace a failed primary result with the
+        verified recovery."""
+        re, im = self._exec.pad_space(np.asarray(space))
+        self._space_data = re if self._exec.is_r2c else (re, im)
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained sharded space buffer."""
@@ -413,17 +460,15 @@ class DistributedTransform:
         """Host-side completion of a dispatched forward (fetch + unpad)."""
         return self._exec.unpad_values(pair)
 
-    def clone(self) -> "DistributedTransform":
-        """Create an independent distributed transform with identical layout.
+    # ---- verification hooks (spfft_tpu.verify) --------------------------------
 
-        Reference: include/spfft/transform.hpp:133 — clone deep-copies the
-        grid so the clone never shares buffers; here the compiled pipelines
-        and retained space buffers are per-object already, so a clone is a
-        fresh plan over the same mesh/shard geometry and engine."""
+    def _per_shard_triplets(self) -> list:
+        """Storage-order triplet rows per shard, aligned with each shard's
+        packed value order (the clone()/verify decode)."""
         from .transform import storage_triplets_from
 
         p = self._params
-        per_shard = [
+        return [
             storage_triplets_from(
                 p.value_indices[r, : int(p.num_values_per_shard[r])],
                 p.stick_x_all[r],
@@ -432,6 +477,71 @@ class DistributedTransform:
             )
             for r in range(p.num_shards)
         ]
+
+    def _verify_triplets(self) -> np.ndarray:
+        """Concatenated storage-order triplets in shard order — aligned with
+        the concatenation of the per-shard packed value vectors."""
+        return np.concatenate(self._per_shard_triplets(), axis=0)
+
+    def _reference_engine(self):
+        """Lazily built LOCAL ``jnp.fft`` reference plan over the same global
+        geometry (every stick of every shard): the verify supervisor's
+        demotion rung. Single-device, exchange-free — a wedged collective or
+        a corrupting accelerator path cannot touch it — and single-controller
+        meshes hand backward the same global ``(Z, Y, X)`` slab this plan's
+        own ``unpad_space`` returns, so results are directly comparable."""
+        if self._reference_exec is None:
+            from .execution import LocalExecution
+            from .parameters import make_local_parameters
+
+            p = self._params
+            params = make_local_parameters(
+                p.transform_type,
+                p.dim_x,
+                p.dim_y,
+                p.dim_z,
+                self._verify_triplets(),
+            )
+            self._reference_exec = LocalExecution(
+                params, self._real_dtype, device=self._mesh.devices.flat[0]
+            )
+        return self._reference_exec
+
+    def _reference_backward(self, values):
+        """Reference backward: per-shard value lists concatenate in shard
+        order and run through the local jnp.fft plan -> global slab."""
+        ref = self._reference_engine()
+        flat = np.concatenate([np.asarray(v).reshape(-1) for v in values])
+        out = ref.backward(flat)
+        fence(out)
+        return ref.fetch(out) if self._exec.is_r2c else ref.fetch_space_complex(out)
+
+    def _reference_forward(self, space, scaling):
+        """Reference forward: global space slab -> packed values, split back
+        into the per-shard list contract."""
+        from .execution import from_pair
+
+        ref = self._reference_engine()
+        pair = ref.forward(
+            np.asarray(space).reshape(self.dim_z, self.dim_y, self.dim_x),
+            ScalingType(scaling),
+        )
+        fence(pair)
+        flat = from_pair(pair)
+        splits = np.cumsum(
+            [int(n) for n in self._params.num_values_per_shard]
+        )[:-1]
+        return [np.asarray(part) for part in np.split(flat, splits)]
+
+    def clone(self) -> "DistributedTransform":
+        """Create an independent distributed transform with identical layout.
+
+        Reference: include/spfft/transform.hpp:133 — clone deep-copies the
+        grid so the clone never shares buffers; here the compiled pipelines
+        and retained space buffers are per-object already, so a clone is a
+        fresh plan over the same mesh/shard geometry and engine."""
+        p = self._params
+        per_shard = self._per_shard_triplets()
         engine = "xla" if self._engine in ("xla", "pencil2") else "mxu"
         return DistributedTransform(
             self._processing_unit,
@@ -448,6 +558,7 @@ class DistributedTransform:
             engine=engine,
             precision=self._precision,
             guard=self._guard,
+            verify=self._verify_mode,
         )
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):

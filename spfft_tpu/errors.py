@@ -37,6 +37,10 @@ class ErrorCode(enum.IntEnum):
     GPU_INVALID_DEVICE_PTR = 20
     GPU_COPY = 21
     GPU_FFT = 22
+    # TPU-build extension beyond the reference enum (reference stops at 22):
+    # algorithm-based self-verification failed and recovery was exhausted
+    # (spfft_tpu.verify). Mirrored in native/include/spfft/errors.h.
+    VERIFICATION = 23
 
 
 class GenericError(Exception):
@@ -182,3 +186,15 @@ class GPUFFTError(GenericError):
     """Failure in the accelerator FFT path."""
 
     error_code = ErrorCode.GPU_FFT
+
+
+class VerificationError(GenericError):
+    """Self-verification (ABFT) failed and recovery was exhausted.
+
+    Raised by the :mod:`spfft_tpu.verify` supervisor when a transform's
+    result fails its algebraic checks on the primary engine, retries do not
+    heal it, and the ``jnp.fft`` reference rung cannot produce a verified
+    result either — the typed terminal of the detect -> retry -> demote
+    ladder. A silently corrupted output is never returned in its place."""
+
+    error_code = ErrorCode.VERIFICATION

@@ -66,6 +66,7 @@ SITES = (
     "exchange.build",
     "hlo.stats",
     "sync.fence",
+    "verify.check",
 )
 
 KINDS = ("raise", "nan", "corrupt", "delay")
@@ -88,7 +89,14 @@ _rng = random.Random(int(os.environ.get(FAULTS_SEED_ENV, "0") or "0"))
 
 def parse_spec(spec: str) -> dict:
     """Parse a ``"site=kind[:rate],..."`` arming spec into
-    ``{site: {"kind", "rate"}}``; validates site names, kinds, and rates."""
+    ``{site: {"kind", "rate"}}``.
+
+    Every malformed token raises a typed :class:`InvalidParameterError`
+    *naming the offending token* — a chaos configuration must never be
+    silently dropped or partially applied (a typo'd ``SPFFT_TPU_FAULTS``
+    that went unnoticed would make a chaos run vacuously green). Duplicate
+    site tokens in one spec raise too: last-wins would silently discard the
+    earlier arming."""
     table: dict = {}
     for part in str(spec).split(","):
         part = part.strip()
@@ -96,28 +104,35 @@ def parse_spec(spec: str) -> dict:
             continue
         name, sep, action = part.partition("=")
         name = name.strip()
-        if not sep or not action:
+        if not sep or not action.strip():
             raise InvalidParameterError(
-                f"malformed fault spec {part!r}: expected site=kind[:rate]"
+                f"malformed fault spec token {part!r}: expected site=kind[:rate]"
             )
         kind, _, rate_s = action.strip().partition(":")
         if name not in SITES:
             raise InvalidParameterError(
-                f"unknown fault site {name!r}: expected one of {SITES}"
+                f"unknown fault site {name!r} in token {part!r}: expected one "
+                f"of {SITES}"
             )
         if kind not in KINDS:
             raise InvalidParameterError(
-                f"unknown fault kind {kind!r}: expected one of {KINDS}"
+                f"unknown fault kind {kind!r} in token {part!r}: expected one "
+                f"of {KINDS}"
             )
         try:
             rate = float(rate_s) if rate_s else 1.0
         except ValueError as e:
             raise InvalidParameterError(
-                f"malformed fault rate {rate_s!r} in {part!r}"
+                f"malformed fault rate {rate_s!r} in token {part!r}"
             ) from e
         if not 0.0 <= rate <= 1.0:
             raise InvalidParameterError(
-                f"fault rate must be in [0, 1], got {rate}"
+                f"fault rate must be in [0, 1] in token {part!r}, got {rate}"
+            )
+        if name in table:
+            raise InvalidParameterError(
+                f"duplicate fault site {name!r} in token {part!r}: an earlier "
+                "token in the same spec already armed it"
             )
         table[name] = {"kind": kind, "rate": rate}
     return table

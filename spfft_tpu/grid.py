@@ -194,6 +194,7 @@ class Grid:
         device=None,
         policy: str | None = None,
         guard: bool | None = None,
+        verify=None,
     ):
         """Create a transform bound to this grid.
 
@@ -226,6 +227,7 @@ class Grid:
                 precision=precision,
                 policy=policy,
                 guard=guard,
+                verify=verify,
             )
         from .transform import Transform
 
@@ -245,4 +247,5 @@ class Grid:
             device=device,
             policy=policy,
             guard=guard,
+            verify=verify,
         )

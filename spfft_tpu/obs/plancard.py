@@ -41,11 +41,18 @@ REQUIRED_KEYS = (
     "platform",
     "execution",
     "degradations",
+    "verification",
 )
 # Every fallback the degradation ladder took for this plan
 # (spfft_tpu.faults.ladder): always present ([] on a healthy plan) so a
 # degraded plan is diagnosable from its card alone.
 DEGRADATION_KEYS = ("event", "reason")
+# Self-verification state (spfft_tpu.verify): always present ("mode": "off"
+# on unverified plans, with checks/rtol/retries nulled); armed plans add the
+# engine circuit breaker's live state so a demoted/broken engine is visible
+# from the card alone.
+VERIFICATION_KEYS = ("mode", "checks", "rtol", "retries", "breaker")
+BREAKER_KEYS = ("engine", "state", "consecutive_failures", "trips", "threshold")
 DISTRIBUTED_KEYS = ("num_shards", "mesh", "decomposition", "exchange")
 EXCHANGE_KEYS = ("discipline", "wire_dtype", "wire_bytes", "rounds", "transport")
 POLICY_KEYS = ("round_cost_bytes", "one_shot_supported", "chosen", "alternatives")
@@ -197,6 +204,9 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         "degradations": [
             dict(d) for d in getattr(transform, "_degradations", ())
         ],
+        # self-verification state (spfft_tpu.verify): mode, armed checks,
+        # tolerances, and the engine circuit breaker — schema-pinned
+        "verification": _verification_section(transform),
     }
     tuning_record = getattr(transform, "_tuning", None)
     if tuning_record is not None:
@@ -242,6 +252,25 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
     return card
 
 
+def _verification_section(transform) -> dict:
+    """The card's ``verification`` section: the supervisor's own description
+    when armed, an explicit "off" record (still schema-complete, breaker
+    state included — a broken engine matters even to unverified plans)
+    otherwise."""
+    verifier = getattr(transform, "_verifier", None)
+    if verifier is not None:
+        return verifier.describe()
+    from ..verify import breaker
+
+    return {
+        "mode": getattr(transform, "_verify_mode", "off"),
+        "checks": [],
+        "rtol": None,
+        "retries": 0,
+        "breaker": breaker.describe(getattr(transform, "_engine", "unknown")),
+    }
+
+
 def _platform_of(transform) -> str:
     mesh = getattr(transform, "_mesh", None)
     if mesh is not None:
@@ -257,6 +286,16 @@ def validate_plan_card(card: dict) -> list:
     for i, entry in enumerate(card.get("degradations", ())):
         missing.extend(
             f"degradations[{i}].{k}" for k in DEGRADATION_KEYS if k not in entry
+        )
+    ver = card.get("verification")
+    if isinstance(ver, dict):
+        missing.extend(
+            f"verification.{k}" for k in VERIFICATION_KEYS if k not in ver
+        )
+        missing.extend(
+            f"verification.breaker.{k}"
+            for k in BREAKER_KEYS
+            if k not in (ver.get("breaker") or {})
         )
     if card.get("kind") == "distributed":
         missing.extend(k for k in DISTRIBUTED_KEYS if k not in card)

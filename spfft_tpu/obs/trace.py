@@ -74,6 +74,8 @@ EVENTS = (
     "fault.injected",  # armed fault site fired (faults.plane)
     "wisdom.load",     # wisdom store consulted (tuning.wisdom)
     "wisdom.save",     # wisdom store write attempt (tuning.wisdom)
+    "verify",          # ABFT check verdict / retry / demotion / breaker
+    #                    transition (spfft_tpu.verify)
     "error",           # typed spfft_tpu.errors exception constructed
 )
 
@@ -296,6 +298,27 @@ class _Operation:
             return self._span.__exit__(exc_type, exc, tb)
         finally:
             _tls.runs.pop()
+
+
+@contextlib.contextmanager
+def with_run(run_id: str | None):
+    """Make ``run_id`` the active run for the scope WITHOUT emitting events —
+    the run-ID stack is thread-local, so code that hands work to a helper
+    thread (``sync.fence``'s budgeted wait) captures :func:`current_run_id`
+    in the caller and re-enters it in the worker with this scope, keeping
+    the card <-> metrics <-> trace join intact across threads. ``None`` is a
+    no-op scope."""
+    if run_id is None:
+        yield
+        return
+    stack = getattr(_tls, "runs", None)
+    if stack is None:
+        stack = _tls.runs = []
+    stack.append(run_id)
+    try:
+        yield
+    finally:
+        stack.pop()
 
 
 def span(name: str, **args):

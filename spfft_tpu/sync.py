@@ -18,12 +18,33 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 
 import numpy as np
 
 import jax
 
 from . import obs
+
+# Wall-clock budget for one completion fence (seconds; 0/unset = no deadline).
+# With a budget set, the wait runs in a worker thread and a wedged fence —
+# a dead collective, a hung tunnel — surfaces as a typed execution error in
+# FENCE_BUDGET_ENV seconds (the transform paths wrap fence() in
+# faults.typed_execution, which converts FenceTimeout and counts
+# execution_failures_total) instead of wedging until the driver timeout. A
+# hang_watchdog (_platform) at 2x the budget backstops the truly unkillable
+# case where even the deadline machinery cannot run.
+FENCE_BUDGET_ENV = "SPFFT_TPU_FENCE_BUDGET_S"
+
+
+class FenceTimeout(RuntimeError):
+    """A completion fence exceeded its ``SPFFT_TPU_FENCE_BUDGET_S`` deadline.
+
+    A ``RuntimeError`` subclass on purpose: the transform paths' surrounding
+    :func:`spfft_tpu.faults.typed_execution` scopes convert it to the typed
+    error surface (``HostExecutionError``/``GPUFFTError``) and count it in
+    ``execution_failures_total`` — the same arm that catches real backend
+    failures catches the deadline."""
 
 # Runtimes whose block_until_ready is known not to wait for execution. The
 # tunneled TPU identifies as platform "tpu" with "axon" only in the client's
@@ -97,29 +118,100 @@ def fence(tree):
 
     The whole fence is a ``fence`` trace span (:mod:`spfft_tpu.obs.trace`),
     stamped with the run ID of the operation it completes.
+
+    With ``SPFFT_TPU_FENCE_BUDGET_S`` set (> 0), the wait carries a deadline:
+    a wedged fence raises :class:`FenceTimeout` after the budget — converted
+    to the typed error surface and counted in ``execution_failures_total`` by
+    the callers' ``faults.typed_execution`` scopes — with a
+    ``_platform.hang_watchdog`` at twice the budget as the unkillable-native
+    backstop. Unset (the default), the wait is inline and unbudgeted.
     """
+    with obs.trace.span("fence"):
+        budget = _fence_budget_s()
+        if budget <= 0:
+            _wait_tree(tree)
+            return tree
+        # Deadline path: the blocking wait runs in a worker thread so a
+        # wedged runtime becomes a typed failure after `budget` seconds (the
+        # worker stays parked on the dead wait — daemon, reclaimed at exit).
+        # The hang_watchdog at 2x budget is the unkillable-native backstop:
+        # if even this thread machinery cannot make progress, the process
+        # exits fast and capturably instead of hitting the driver timeout.
+        from ._platform import hang_watchdog
+
+        disarm = hang_watchdog(
+            "sync.fence", FENCE_BUDGET_ENV, budget, exit_code=3,
+            budget_s=2.0 * budget,
+        )
+        try:
+            done = threading.Event()
+            err: list = []
+            # the run-ID stack is thread-local: capture the caller's active
+            # run and re-enter it in the worker, so the fault site's trace
+            # events keep the card <-> trace join even on the budgeted path
+            run = obs.trace.current_run_id()
+
+            def _wait():
+                try:
+                    with obs.trace.with_run(run):
+                        _wait_tree(tree)
+                except BaseException as e:  # re-raised in the caller thread
+                    err.append(e)
+                finally:
+                    done.set()
+
+            worker = threading.Thread(target=_wait, daemon=True)
+            worker.start()
+            if not done.wait(budget):
+                raise FenceTimeout(
+                    f"completion fence exceeded its {budget:.3g}s deadline "
+                    f"({FENCE_BUDGET_ENV}); runtime completion machinery "
+                    "wedged or collective dead"
+                )
+            if err:
+                raise err[0]
+        finally:
+            disarm()
+        return tree
+
+
+def _fence_budget_s() -> float:
+    raw = os.environ.get(FENCE_BUDGET_ENV, "0") or "0"
+    try:
+        return float(raw)
+    except ValueError as e:
+        # loud-config rule (same as faults.parse_spec / verify.resolve_mode):
+        # a typo'd deadline must never silently disable the deadline
+        from .errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            f"invalid {FENCE_BUDGET_ENV} value {raw!r}: expected seconds as a float"
+        ) from e
+
+
+def _wait_tree(tree) -> None:
+    """The actual blocking wait (fault site, block_until_ready, advisory
+    scalar probes) — shared by the inline path and the deadline worker."""
     from . import faults
 
-    with obs.trace.span("fence"):
-        faults.site("sync.fence")
-        jax.block_until_ready(tree)
-        force = _advisory_override()
-        if force is False:
-            return tree
-        probes = []
-        for leaf in jax.tree_util.tree_leaves(tree):
-            if (
-                isinstance(leaf, jax.Array)
-                and leaf.size
-                and (force or _on_advisory_platform(leaf))
-            ):
-                shards = getattr(leaf, "addressable_shards", None)
-                if shards:
-                    for shard in shards:
-                        if shard.data is not None and shard.data.size:
-                            probes.append(_probe_scalar(shard.data))
-                else:
-                    probes.append(_probe_scalar(leaf))
-        if probes:
-            jax.device_get(probes)
-        return tree
+    faults.site("sync.fence")
+    jax.block_until_ready(tree)
+    force = _advisory_override()
+    if force is False:
+        return
+    probes = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if (
+            isinstance(leaf, jax.Array)
+            and leaf.size
+            and (force or _on_advisory_platform(leaf))
+        ):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                for shard in shards:
+                    if shard.data is not None and shard.data.size:
+                        probes.append(_probe_scalar(shard.data))
+            else:
+                probes.append(_probe_scalar(leaf))
+    if probes:
+        jax.device_get(probes)

@@ -53,6 +53,7 @@ class Transform:
         device=None,
         policy: str | None = None,
         guard: bool | None = None,
+        verify=None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -234,6 +235,20 @@ class Transform:
         self._engine = engine
         self._precision = precision
         self._space_data = None
+        # Self-verification (spfft_tpu.verify): explicit verify= wins, else
+        # SPFFT_TPU_VERIFY. Armed, every host-facing backward/forward runs
+        # under the recovery supervisor (check -> retry -> jnp.fft reference
+        # -> typed VerificationError); disarmed, the hot path pays exactly
+        # one falsy attribute check.
+        from .verify import resolve_mode
+
+        self._verify_mode = resolve_mode(verify)
+        self._verifier = None
+        self._reference_exec = None
+        if self._verify_mode != "off":
+            from .verify import Supervisor
+
+            self._verifier = Supervisor(self, self._verify_mode)
 
     # ---- transforms -----------------------------------------------------------
 
@@ -262,28 +277,39 @@ class Transform:
                 faults.check_array(
                     np.asarray(values), check="backward input", platform=plat
                 )
-            out = self._dispatch_backward(values)
-            if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"), obs.phase_timer(
-                    "wait_seconds", direction="backward"
-                ), faults.typed_execution(plat, "backward wait"):
-                    fence(out)
-            with timing.scoped("output staging"):
-                result = self._finalize_backward(out)
-            if self._guard:
-                faults.check_device(
-                    out, self._device, check="backward output", platform=plat
-                )
-                faults.check_array(
-                    result,
-                    check="backward output",
-                    platform=plat,
-                    shape=(self.dim_z, self.dim_y, self.dim_x),
-                    dtype=self._real_dtype
-                    if self._is_r2c
-                    else _complex_dtype(self._real_dtype),
-                )
-            return result
+            if self._verifier is not None:
+                # supervised path (spfft_tpu.verify): check -> retry ->
+                # jnp.fft reference -> typed VerificationError
+                return self._verifier.backward(values)
+            return self._backward_attempt(values)
+
+    def _backward_attempt(self, values):
+        """One full backward execution (dispatch, fence, finalize, guard
+        post-checks) — the unit the verify supervisor re-executes on a
+        failed check; identical to the whole unsupervised path."""
+        plat = self._device.platform
+        out = self._dispatch_backward(values)
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="backward"
+            ), faults.typed_execution(plat, "backward wait"):
+                fence(out)
+        with timing.scoped("output staging"):
+            result = self._finalize_backward(out)
+        if self._guard:
+            faults.check_device(
+                out, self._device, check="backward output", platform=plat
+            )
+            faults.check_array(
+                result,
+                check="backward output",
+                platform=plat,
+                shape=(self.dim_z, self.dim_y, self.dim_x),
+                dtype=self._real_dtype
+                if self._is_r2c
+                else _complex_dtype(self._real_dtype),
+            )
+        return result
 
     def _dispatch_backward(self, values):
         """Stage inputs and enqueue the backward pipeline; returns the
@@ -350,62 +376,74 @@ class Transform:
                 faults.check_array(
                     np.asarray(space), check="forward input", platform=plat
                 )
-            pair = self._dispatch_forward(space, scaling)
-            if self._exec_mode == ExecType.SYNCHRONOUS:
-                with timing.scoped("wait"), obs.phase_timer(
-                    "wait_seconds", direction="forward"
-                ), faults.typed_execution(plat, "forward wait"):
-                    fence(pair)
-            with timing.scoped("output staging"):
-                result = self._finalize_forward(pair)
-            if self._guard:
-                faults.check_device(
-                    pair, self._device, check="forward output", platform=plat
-                )
-                faults.check_array(
-                    result,
-                    check="forward output",
-                    platform=plat,
-                    shape=(self.num_local_elements,),
-                    dtype=_complex_dtype(self._real_dtype),
-                )
-            return result
+            if self._verifier is not None:
+                return self._verifier.forward(space, scaling)
+            return self._forward_attempt(space, scaling)
+
+    def _forward_attempt(self, space, scaling):
+        """One full forward execution (dispatch, fence, finalize, guard
+        post-checks) — the re-executable unit of the verify supervisor."""
+        plat = self._device.platform
+        pair = self._dispatch_forward(space, scaling)
+        if self._exec_mode == ExecType.SYNCHRONOUS:
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="forward"
+            ), faults.typed_execution(plat, "forward wait"):
+                fence(pair)
+        with timing.scoped("output staging"):
+            result = self._finalize_forward(pair)
+        if self._guard:
+            faults.check_device(
+                pair, self._device, check="forward output", platform=plat
+            )
+            faults.check_array(
+                result,
+                check="forward output",
+                platform=plat,
+                shape=(self.num_local_elements,),
+                dtype=_complex_dtype(self._real_dtype),
+            )
+        return result
 
     def _dispatch_forward(self, space, scaling):
         """Stage the space-domain input (or reuse the retained buffer) and enqueue
         the forward pipeline; returns the device-resident (re, im) pair without
         waiting (split-phase counterpart of :meth:`_dispatch_backward`)."""
 
-        p = self._params
         if space is None:
             if self._space_data is None:
                 raise InvalidParameterError(
                     "no space domain data: run backward first or pass an array"
                 )
-            if self._is_r2c:
-                re, im = self._space_data, None
-            else:
-                re, im = self._space_data
         else:
             with timing.scoped("input staging"):
-                space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
-                if self._native_transposed:
-                    space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
-                if self._is_r2c:
-                    re = self._exec.put(
-                        np.ascontiguousarray(space.real, dtype=self._real_dtype)
-                    )
-                    im = None
-                    self._space_data = re
-                else:
-                    re, im = as_pair(space, self._real_dtype)
-                    re, im = self._exec.put(re), self._exec.put(im)
-                    self._space_data = (re, im)
+                self._retain_space(space)
+        if self._is_r2c:
+            re, im = self._space_data, None
+        else:
+            re, im = self._space_data
         with timing.scoped("dispatch"), obs.phase_timer(
             "dispatch_seconds", direction="forward"
         ), faults.typed_execution(self._device.platform, "forward dispatch"):
             pair = self._exec.forward_pair(re, im, ScalingType(scaling))
             return faults.site("engine.execute", payload=pair)
+
+    def _retain_space(self, space) -> None:
+        """Stage a host ``(Z, Y, X)`` space array as the retained
+        device-resident buffer (engine-native layout) — the staging half of
+        :meth:`_dispatch_forward`, also used by the verify supervisor to
+        replace a failed primary result with the verified recovery."""
+        p = self._params
+        space = np.asarray(space).reshape(p.dim_z, p.dim_y, p.dim_x)
+        if self._native_transposed:
+            space = space.transpose(1, 2, 0)  # public (Z,Y,X) -> native (Y,X,Z)
+        if self._is_r2c:
+            self._space_data = self._exec.put(
+                np.ascontiguousarray(space.real, dtype=self._real_dtype)
+            )
+        else:
+            re, im = as_pair(space, self._real_dtype)
+            self._space_data = (self._exec.put(re), self._exec.put(im))
 
     def forward_pair(self, scaling: ScalingType = ScalingType.NONE):
         """Device-side forward over the retained space buffer; returns the (re, im)
@@ -424,6 +462,45 @@ class Transform:
     def _finalize_forward(self, pair):
         """Host-side completion of a dispatched forward (fetch + recombine)."""
 
+        return from_pair(pair)
+
+    # ---- verification hooks (spfft_tpu.verify) --------------------------------
+
+    def _verify_triplets(self) -> np.ndarray:
+        """Storage-order index rows aligned with the packed value order — the
+        geometry the ABFT checks recompute invariants from."""
+        return _storage_triplets(self._params)
+
+    def _reference_engine(self):
+        """Lazily built ``jnp.fft`` reference pipeline (the verify
+        supervisor's demotion rung): a fresh :class:`LocalExecution` on the
+        plan's device and geometry — a code path disjoint from the primary
+        engine's dispatch (no ``engine.execute`` fault site, no shared
+        compiled programs), so a poisoned primary cannot poison it."""
+        if self._reference_exec is None:
+            self._reference_exec = LocalExecution(
+                self._params, self._real_dtype, device=self._device
+            )
+        return self._reference_exec
+
+    def _reference_backward(self, values):
+        """Reference backward: freq values -> host ``(Z, Y, X)`` slab via
+        the jnp.fft engine (hermitian completion included for R2C)."""
+        ref = self._reference_engine()
+        values = np.asarray(values).reshape(self._params.num_values)
+        out = ref.backward(values)
+        fence(out)
+        return ref.fetch(out) if self._is_r2c else ref.fetch_space_complex(out)
+
+    def _reference_forward(self, space, scaling):
+        """Reference forward: host space slab -> packed freq values via the
+        jnp.fft engine."""
+        ref = self._reference_engine()
+        pair = ref.forward(
+            np.asarray(space).reshape(self.dim_z, self.dim_y, self.dim_x),
+            ScalingType(scaling),
+        )
+        fence(pair)
         return from_pair(pair)
 
     @property
@@ -485,6 +562,7 @@ class Transform:
             precision=self._precision,
             device=self._device,
             guard=self._guard,
+            verify=self._verify_mode,
         )
 
     # ---- introspection --------------------------------------------------------

@@ -1,0 +1,47 @@
+"""spfft_tpu.verify — self-verifying transforms (ABFT) with recovery.
+
+The layer that closes the loop from *observe* (:mod:`spfft_tpu.obs`) and
+*inject* (:mod:`spfft_tpu.faults`) to *recover*. Three pieces:
+
+1. **Checks** (:mod:`.checks`): opt-in per-transform algebraic verification
+   — Parseval energy conservation, DC-component consistency, and a
+   deterministic random-probe linearity check — armed via
+   ``SPFFT_TPU_VERIFY=1|strict`` or ``verify=`` on any
+   Transform/DistributedTransform/``Grid.create_transform``. The canonical
+   :data:`CHECKS` vocabulary is enforced both ways by ``programs/lint.py``.
+2. **Supervisor** (:mod:`.supervisor`): a retry -> demote-to-``jnp.fft``
+   -> typed-:class:`~spfft_tpu.errors.VerificationError` recovery ladder
+   around every verified ``backward``/``forward``, with every rung recorded
+   in the plan card, the run metrics and the flight recorder.
+3. **Circuit breaker** (:mod:`.breaker`): a process-global breaker that
+   stops burning retry budget on an engine with K consecutive verified
+   failures (half-open probe after a cooldown).
+
+Guarantee (tested by ``tests/test_verify.py`` and ``./ci.sh verify``): with
+verification armed, a transform either returns a result consistent with the
+``jnp.fft`` reference or raises typed ``VerificationError`` — a silently
+corrupted output is impossible. Disarmed (the default), the whole layer is
+one falsy attribute check per call.
+"""
+from . import breaker  # noqa: F401
+from .checks import (  # noqa: F401
+    CHECK_FNS,
+    CHECKS,
+    VERIFY_ENV,
+    VERIFY_RTOL_ENV,
+    VERIFY_SEED_ENV,
+    applicable_checks,
+    resolve_mode,
+    resolve_rtol,
+    run_checks,
+)
+from .supervisor import (  # noqa: F401
+    DEFAULT_BACKOFF_S,
+    DEFAULT_RETRIES,
+    RETRYABLE_ERRORS,
+    VERIFY_BACKOFF_ENV,
+    VERIFY_RETRIES_ENV,
+    Supervisor,
+    resolve_backoff_s,
+    resolve_retries,
+)

@@ -1,0 +1,145 @@
+"""Process-global engine circuit breaker for verified execution.
+
+A dying accelerator path does not fail once — it fails every call, and
+without a breaker every verified transform on it would burn the full
+retry-then-demote ladder (N re-executions plus a reference run) before
+recovering. The breaker bounds that: after ``SPFFT_TPU_VERIFY_BREAKER_K``
+*consecutive* verified-failure episodes on one engine, the engine is **open**
+for the whole process — verified transforms skip the primary engine entirely
+and go straight to the ``jnp.fft`` reference rung. After
+``SPFFT_TPU_VERIFY_BREAKER_COOLDOWN_S`` the breaker moves to **half-open**
+and admits a single probe execution: a verified success closes it again
+(transient fault healed), a failure re-opens it and restarts the cooldown.
+
+State is per engine name (``mxu``, ``xla``, ``pencil2-mxu``, ...) and
+process-global like the fault plane and the metrics registry: one wedged MXU
+path should stop burning retry budget for *every* plan in the process, not
+per plan object. Exposure: the ``verify_breaker_state{engine}`` gauge
+(0 closed / 1 open / 2 half-open) rides in ``obs.snapshot()``,
+``verify_breaker_trips_total{engine}`` counts trips, every transition lands
+as a ``verify`` flight-recorder event, and :func:`describe` feeds the plan
+card's schema-pinned ``verification.breaker`` section.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import obs
+
+BREAKER_K_ENV = "SPFFT_TPU_VERIFY_BREAKER_K"
+BREAKER_COOLDOWN_ENV = "SPFFT_TPU_VERIFY_BREAKER_COOLDOWN_S"
+
+DEFAULT_K = 3
+DEFAULT_COOLDOWN_S = 30.0
+
+_STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
+
+_lock = threading.Lock()
+_states: dict = {}  # engine -> {"state", "consecutive_failures", "opened_at", "trips"}
+
+
+def threshold() -> int:
+    """Consecutive verified failures that trip the breaker (floor 1)."""
+    return max(1, int(os.environ.get(BREAKER_K_ENV, str(DEFAULT_K))))
+
+
+def cooldown_s() -> float:
+    """Open -> half-open probe delay in seconds (0 probes immediately)."""
+    return max(0.0, float(os.environ.get(BREAKER_COOLDOWN_ENV, str(DEFAULT_COOLDOWN_S))))
+
+
+def _entry(engine: str) -> dict:
+    entry = _states.get(engine)
+    if entry is None:
+        entry = _states[engine] = {
+            "state": "closed",
+            "consecutive_failures": 0,
+            "opened_at": 0.0,
+            "trips": 0,
+        }
+    return entry
+
+
+def _transition(engine: str, entry: dict, state: str) -> None:
+    entry["state"] = state
+    obs.gauge("verify_breaker_state", engine=engine).set(_STATE_CODES[state])
+    obs.trace.event("verify", what="breaker", engine=engine, state=state)
+
+
+def allow(engine: str) -> bool:
+    """Whether a verified transform may attempt the primary engine now.
+
+    Closed -> yes. Open -> no until the cooldown elapses, then the breaker
+    moves to half-open and THIS caller carries the probe. Half-open -> yes
+    (the probe's verdict settles the state)."""
+    with _lock:
+        entry = _entry(engine)
+        if entry["state"] == "open":
+            if time.monotonic() - entry["opened_at"] >= cooldown_s():
+                _transition(engine, entry, "half_open")
+                return True
+            return False
+        return True
+
+
+def record_success(engine: str) -> None:
+    """A verified execution on ``engine`` passed its checks: reset the
+    consecutive-failure count and close the breaker (half-open probe healed)."""
+    with _lock:
+        entry = _entry(engine)
+        entry["consecutive_failures"] = 0
+        if entry["state"] != "closed":
+            _transition(engine, entry, "closed")
+
+
+def record_failure(engine: str) -> None:
+    """One verified-failure episode (retries exhausted or a half-open probe
+    failed): trips the breaker at :func:`threshold` consecutive failures —
+    immediately when half-open, since the probe just proved the engine is
+    still bad."""
+    with _lock:
+        entry = _entry(engine)
+        entry["consecutive_failures"] += 1
+        tripped = (
+            entry["state"] == "half_open"
+            or entry["consecutive_failures"] >= threshold()
+        )
+        if tripped and entry["state"] != "open":
+            entry["opened_at"] = time.monotonic()
+            entry["trips"] += 1
+            obs.counter("verify_breaker_trips_total", engine=engine).inc()
+            _transition(engine, entry, "open")
+
+
+def describe(engine: str) -> dict:
+    """JSON-plain state of one engine's breaker (the plan card's
+    ``verification.breaker`` section)."""
+    with _lock:
+        entry = _entry(engine)
+        return {
+            "engine": engine,
+            "state": entry["state"],
+            "consecutive_failures": int(entry["consecutive_failures"]),
+            "trips": int(entry["trips"]),
+            "threshold": threshold(),
+        }
+
+
+def snapshot() -> dict:
+    """JSON-plain state of every engine the process has verified."""
+    with _lock:
+        return {engine: dict(entry) for engine, entry in _states.items()}
+
+
+def reset() -> None:
+    """Close every breaker and drop all counts (tests / fresh processes).
+    The ``verify_breaker_state`` gauges are zeroed too, so a metrics
+    snapshot never shows a tripped breaker that no longer exists."""
+    with _lock:
+        for engine in _states:
+            obs.gauge("verify_breaker_state", engine=engine).set(
+                _STATE_CODES["closed"]
+            )
+        _states.clear()

@@ -1,0 +1,281 @@
+"""Algebraic self-verification checks (ABFT) for sparse-FFT results.
+
+The transforms in this package are linear maps with cheap algebraic
+invariants — exactly the property algorithm-based fault tolerance exploits
+(AccFFT-style distributed FFT stacks lean on the same identities). PR 3's
+guard mode only detects *non-finite* corruption; these checks close the
+remaining hole: an accelerator or exchange that returns finite-but-wrong
+data. Every check recomputes an invariant on the host (numpy, accumulated in
+double precision) from the transform's *inputs* and compares it against the
+engine's *output*:
+
+- ``parseval`` — energy conservation: an unnormalized inverse DFT satisfies
+  ``sum|space|^2 == N * sum|freq|^2`` because the space array's full spectrum
+  is exactly the sparse value set (backward direction, C2C plans).
+- ``dc`` — DC-component consistency: only the zero-frequency term survives
+  summation over the grid, so ``sum(space) == N * F_(0,0,0)`` (backward) and
+  ``F_(0,0,0) == scale * sum(space)`` (forward, when the plan's index set
+  contains the origin).
+- ``probe`` — random-probe linearity: the output at one randomly chosen site
+  is a known linear functional of the input, recomputed directly from the DFT
+  definition — ``O(num_values)`` host work for a backward probe,
+  one separable ``O(N)`` contraction for a forward probe. The probe site is
+  drawn deterministically from ``SPFFT_TPU_VERIFY_SEED`` and the plan
+  geometry, so a failing run replays exactly.
+
+Applicability (:func:`applicable_checks`): C2C plans verify both directions;
+R2C plans verify the forward direction only — the backward R2C engine
+*completes* the hermitian-redundant half-spectrum internally, so the supplied
+values alone do not determine the invariants (documented in docs/details.md
+"Silent-data-corruption detection & recovery").
+
+Tolerances are relative (``SPFFT_TPU_VERIFY_RTOL``; default per dtype —
+:func:`resolve_rtol`), normalized by the natural magnitude of each invariant
+(the cancellation mass of a sum, not the possibly-tiny result), so the checks
+flag corruption rather than benign floating-point noise.
+
+The canonical check vocabulary (:data:`CHECKS`) is enforced both ways by
+``programs/lint.py`` — every registered name implemented and documented, same
+contract as ``obs.STAGES`` / ``faults.SITES`` / ``trace.EVENTS``. Fault site
+``verify.check`` fires at the top of :func:`run_checks`, so the detector
+itself is chaos-testable.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import faults, obs
+from ..errors import InvalidParameterError
+
+VERIFY_ENV = "SPFFT_TPU_VERIFY"
+VERIFY_RTOL_ENV = "SPFFT_TPU_VERIFY_RTOL"
+VERIFY_SEED_ENV = "SPFFT_TPU_VERIFY_SEED"
+
+# Canonical check vocabulary. Pure literal tuple (programs/lint.py reads it
+# with ast.literal_eval, import-free) enforced both ways: every entry has an
+# implementation registered in CHECK_FNS below and a row in docs/details.md.
+CHECKS = (
+    "parseval",
+    "dc",
+    "probe",
+)
+
+_TINY = 1e-300  # denominator floor: never divide by an exactly-zero scale
+
+
+def resolve_mode(explicit=None) -> str:
+    """The active verification mode: ``"off"``, ``"on"`` or ``"strict"``.
+
+    An explicit ``verify=`` plan argument wins (``True``/``"1"``/``"on"`` ->
+    on, ``"strict"`` -> strict, ``False``/``"0"``/``"off"``/``None``-env ->
+    off), else the ``SPFFT_TPU_VERIFY`` env knob with the same values. An
+    unrecognized value raises :class:`InvalidParameterError` naming it — a
+    verification request must never be silently dropped."""
+    value = os.environ.get(VERIFY_ENV, "0") if explicit is None else explicit
+    if value in (False, None, "0", "off", ""):
+        return "off"
+    if value in (True, "1", "on"):
+        return "on"
+    if value == "strict":
+        return "strict"
+    raise InvalidParameterError(
+        f"invalid verification mode {value!r}: expected 0/off, 1/on, or strict"
+    )
+
+
+def resolve_rtol(real_dtype) -> float:
+    """Relative check tolerance: ``SPFFT_TPU_VERIFY_RTOL`` when set, else a
+    default keyed on the *effective* execution precision — far above the
+    engines' parity error (f32 transforms land ~1e-6 relative; f64 ~1e-14)
+    and far below any real corruption. A plan declared ``float64`` while
+    ``jax_enable_x64`` is off actually executes in f32 (JAX silently
+    truncates), so it gets the f32 tolerance — a correct-but-f32 result must
+    not be condemned as corruption."""
+    env = os.environ.get(VERIFY_RTOL_ENV)
+    if env:
+        try:
+            rtol = float(env)
+        except ValueError as e:
+            raise InvalidParameterError(
+                f"invalid {VERIFY_RTOL_ENV} value {env!r}: expected a float"
+            ) from e
+        if rtol <= 0:
+            raise InvalidParameterError(
+                f"{VERIFY_RTOL_ENV} must be positive, got {rtol}"
+            )
+        return rtol
+    if np.dtype(real_dtype) == np.dtype(np.float64):
+        import jax
+
+        if jax.config.read("jax_enable_x64"):
+            return 1e-9
+    return 1e-4
+
+
+def applicable_checks(direction: str, transform_type) -> tuple:
+    """The subset of :data:`CHECKS` valid for one host-facing call. C2C
+    backward verifies all three; forward drops ``parseval`` (the space
+    input's spectrum is not generally contained in the sparse index set);
+    R2C backward verifies none (hermitian completion — module docstring)."""
+    from ..types import TransformType
+
+    r2c = TransformType(transform_type) == TransformType.R2C
+    if direction == "backward":
+        return () if r2c else ("parseval", "dc", "probe")
+    return ("dc", "probe")
+
+
+def _probe_rng(dims, num_values, direction: str):
+    """Deterministic probe-site stream: seeded by ``SPFFT_TPU_VERIFY_SEED``
+    plus the plan geometry and direction, so one plan's probe site is stable
+    across calls and a failure replays exactly."""
+    seed = int(os.environ.get(VERIFY_SEED_ENV, "0") or "0")
+    return np.random.default_rng(
+        [seed, *(int(d) for d in dims), int(num_values), direction == "forward"]
+    )
+
+
+def _verdict(check, measured, expected, denom, rtol):
+    rel = abs(measured - expected) / max(float(denom), _TINY)
+    return {
+        "check": check,
+        "verdict": "pass" if rel <= rtol else "fail",
+        "rel": float(rel),
+        "rtol": float(rtol),
+        "measured": str(measured),
+        "expected": str(expected),
+    }
+
+
+def _check_parseval(ctx):
+    """Backward energy conservation: ``sum|space|^2 == N * sum|freq|^2``."""
+    space, freq = ctx["space"], ctx["freq"]
+    measured = float(np.sum(np.abs(space) ** 2))
+    expected = float(space.size) * float(np.sum(np.abs(freq) ** 2))
+    return _verdict("parseval", measured, expected, expected, ctx["rtol"])
+
+
+def _origin_index(triplets) -> int | None:
+    hit = np.where(~triplets.any(axis=1))[0]
+    return int(hit[0]) if hit.size else None
+
+
+def _check_dc(ctx):
+    """DC consistency: only the zero-frequency term survives a grid sum."""
+    space, freq, triplets = ctx["space"], ctx["freq"], ctx["triplets"]
+    j = _origin_index(triplets)
+    # tolerance scale: the cancellation mass of the grid sum (sqrt(N) * l2 ==
+    # N * rms), not the possibly-zero DC value itself
+    mass = np.sqrt(space.size) * float(np.linalg.norm(space.reshape(-1)))
+    if ctx["direction"] == "backward":
+        f0 = complex(freq[j]) if j is not None else 0.0
+        measured = complex(np.sum(space))
+        expected = float(space.size) * f0
+        denom = max(abs(expected), mass)
+    else:
+        if j is None:
+            return None  # origin not in the sparse set: nothing to compare
+        scale = ctx["scale"]
+        measured = complex(freq[j])
+        expected = scale * complex(np.sum(space))
+        denom = max(abs(expected), scale * mass)
+    return _verdict("dc", measured, expected, denom, ctx["rtol"])
+
+
+def _check_probe(ctx):
+    """Random-probe linearity: recompute one output element from the DFT
+    definition (backward: ``O(num_values)`` phase sum at one space site;
+    forward: one separable contraction over the space grid)."""
+    space, freq, triplets = ctx["space"], ctx["freq"], ctx["triplets"]
+    if not len(freq):
+        return None
+    dz, dy, dx = space.shape
+    rng = _probe_rng((dx, dy, dz), len(freq), ctx["direction"])
+    kx = triplets[:, 0].astype(np.float64)
+    ky = triplets[:, 1].astype(np.float64)
+    kz = triplets[:, 2].astype(np.float64)
+    if ctx["direction"] == "backward":
+        zs, ys, xs = (
+            int(rng.integers(dz)),
+            int(rng.integers(dy)),
+            int(rng.integers(dx)),
+        )
+        phase = 2j * np.pi * (kx * xs / dx + ky * ys / dy + kz * zs / dz)
+        expected = complex(np.sum(freq * np.exp(phase)))
+        measured = complex(space[zs, ys, xs])
+        denom = max(abs(expected), float(np.sum(np.abs(freq))))
+    else:
+        j = int(rng.integers(len(freq)))
+        scale = ctx["scale"]
+        ex = np.exp(-2j * np.pi * kx[j] * np.arange(dx) / dx)
+        ey = np.exp(-2j * np.pi * ky[j] * np.arange(dy) / dy)
+        ez = np.exp(-2j * np.pi * kz[j] * np.arange(dz) / dz)
+        expected = scale * complex(ez @ ((space @ ex) @ ey))
+        measured = complex(freq[j])
+        denom = max(abs(expected), scale * float(np.sum(np.abs(space))))
+    return _verdict("probe", measured, expected, denom, ctx["rtol"])
+
+
+# name -> implementation; programs/lint.py pins CHECKS == CHECK_FNS keys, the
+# registry half of the both-ways vocabulary contract
+CHECK_FNS = {
+    "parseval": _check_parseval,
+    "dc": _check_dc,
+    "probe": _check_probe,
+}
+
+
+def run_checks(
+    *,
+    direction: str,
+    freq,
+    space,
+    triplets,
+    transform_type,
+    scale: float = 1.0,
+    rtol: float,
+) -> list:
+    """Run every applicable check for one host-facing call; returns the
+    verdict rows (``check``/``verdict``/``rel``/``rtol``, JSON-plain).
+
+    ``freq`` is the packed sparse value vector (input for backward, output
+    for forward), ``space`` the ``(Z, Y, X)`` slab (output for backward,
+    input for forward), ``triplets`` the storage-order index rows aligned
+    with ``freq``'s packing order, ``scale`` the forward scaling factor
+    (1/N under ``ScalingType.FULL``).
+
+    Every verdict counts ``verify_checks_total{check,verdict}`` and lands as
+    a ``verify`` flight-recorder event. Fault site ``verify.check`` fires
+    first: a ``raise`` injection models the detector itself dying — the
+    supervisor treats that as a failed verification episode (fail closed),
+    never as a pass."""
+    faults.site("verify.check")
+    freq = np.asarray(freq).reshape(-1).astype(np.complex128)
+    space = np.asarray(space).astype(np.complex128)
+    triplets = np.asarray(triplets).reshape(-1, 3)
+    ctx = {
+        "direction": direction,
+        "freq": freq,
+        "space": space,
+        "triplets": triplets,
+        "scale": float(scale),
+        "rtol": float(rtol),
+    }
+    verdicts = []
+    for name in applicable_checks(direction, transform_type):
+        row = CHECK_FNS[name](ctx)
+        if row is None:
+            continue
+        obs.counter("verify_checks_total", check=name, verdict=row["verdict"]).inc()
+        obs.trace.event(
+            "verify",
+            what="check",
+            check=name,
+            verdict=row["verdict"],
+            direction=direction,
+            rel=row["rel"],
+        )
+        verdicts.append(row)
+    return verdicts

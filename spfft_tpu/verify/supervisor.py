@@ -1,0 +1,290 @@
+"""Recovery supervisor: turn detection into bounded, observable self-healing.
+
+The supervisor wraps a plan's host-facing ``backward``/``forward`` execution
+(engine dispatch, exchange collectives, fence, staging — the whole attempt)
+in a recovery ladder. Each rung is recorded in the plan's ``degradations``
+(plan card), the run-metrics registry and the flight recorder, so a recovered
+transform is diagnosable after the fact:
+
+1. **Verify** — run the ABFT checks (:mod:`.checks`) on the attempt's result.
+   All pass -> return it (and close/reset the engine's circuit breaker).
+2. **Retry** — on a failed check, a detector fault, or a typed execution
+   error, re-execute up to ``SPFFT_TPU_VERIFY_RETRIES`` more times with
+   exponential backoff (``SPFFT_TPU_VERIFY_BACKOFF_S`` base; the sleep holds
+   no locks, mirroring ``tuning/wisdom.py``'s retry discipline). A transient
+   flip heals here; ``verify_retries_total`` counts the budget spent.
+3. **Demote** — retries exhausted (or the engine's breaker already open):
+   recompute through the ``jnp.fft`` reference engine — a freshly built
+   :class:`~spfft_tpu.execution.LocalExecution` pipeline on a disjoint code
+   path from the primary engine's dispatch — and verify *that*. A verified
+   reference result returns to the caller (``verify_recoveries_total``, a
+   ``verify_demoted`` degradation rung) and feeds the breaker's
+   consecutive-failure count.
+4. **Raise** — the reference fails verification too (or is unavailable):
+   typed :class:`~spfft_tpu.errors.VerificationError`, round-tripped to the
+   C error surface by ``capi.error_code`` like every other member of the
+   taxonomy. A silently wrong result is never returned.
+
+``strict`` mode (``SPFFT_TPU_VERIFY=strict``) is the fail-fast variant for
+debugging: the first failed check raises immediately, no retry or demotion —
+and no breaker short-circuit either (the primary engine is always attempted;
+strict episodes still feed the breaker's shared failure count).
+
+The per-process **circuit breaker** (:mod:`.breaker`) sits above rung 2: an
+engine with K consecutive verified-failure episodes is open for the whole
+process — verified calls skip the primary attempt entirely (a
+``verify_breaker_open`` degradation rung) until a half-open probe heals it.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .. import faults, obs
+from ..errors import (
+    FFTWError,
+    GPUFFTError,
+    HostExecutionError,
+    MPIError,
+    VerificationError,
+)
+from . import breaker, checks
+
+VERIFY_RETRIES_ENV = "SPFFT_TPU_VERIFY_RETRIES"
+VERIFY_BACKOFF_ENV = "SPFFT_TPU_VERIFY_BACKOFF_S"
+
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.01
+
+# Execution-level typed failures the retry rung may absorb: the dual error
+# surface's dispatch/fence conversions plus the distributed collective layer.
+# Deliberately excludes parameter/index errors (user errors must surface
+# immediately) and raw backend exceptions (faults.typed_execution already
+# converted anything retryable by the time it reaches the supervisor).
+RETRYABLE_ERRORS = (HostExecutionError, GPUFFTError, MPIError, FFTWError)
+
+# Failure classes tolerated from the *detector* itself (the verify.check
+# fault site raises InjectedFault, a RuntimeError): a broken checker means
+# the result is unverifiable, which the ladder treats as a failed episode —
+# fail closed, never "checker died so assume the data is fine".
+CHECKER_ERRORS = (RuntimeError,)
+
+
+def resolve_retries() -> int:
+    """Re-executions after the first attempt (``SPFFT_TPU_VERIFY_RETRIES``,
+    floor 0)."""
+    return max(0, int(os.environ.get(VERIFY_RETRIES_ENV, str(DEFAULT_RETRIES))))
+
+
+def resolve_backoff_s() -> float:
+    """Base of the exponential retry backoff (``SPFFT_TPU_VERIFY_BACKOFF_S``)."""
+    return max(0.0, float(os.environ.get(VERIFY_BACKOFF_ENV, str(DEFAULT_BACKOFF_S))))
+
+
+class Supervisor:
+    """Per-plan recovery supervisor (created only when verification is armed,
+    so the disarmed hot path stays one falsy attribute check).
+
+    The owning transform provides the engine-specific pieces: the attempt
+    callable (its full dispatch path, fault sites included) and the
+    reference callables (``_reference_backward`` / ``_reference_forward`` —
+    the ``jnp.fft`` rung). The supervisor owns policy: check selection,
+    retry budget, breaker interaction, recovery bookkeeping."""
+
+    def __init__(self, transform, mode: str):
+        self._t = transform
+        self.mode = mode
+        self.rtol = checks.resolve_rtol(transform.dtype)
+        self.retries = resolve_retries()
+        self._triplets = None  # lazy: storage-order rows aligned with packing
+
+    # ---- plan-facing entry points ------------------------------------------
+
+    def backward(self, values):
+        """Supervised backward: ``values`` (packed array, or per-shard list
+        for distributed plans) -> verified ``(Z, Y, X)`` space slab."""
+        freq = self._flat_values(values)
+        return self._supervise(
+            direction="backward",
+            attempt=lambda: self._t._backward_attempt(values),
+            reference=lambda: self._t._reference_backward(values),
+            check=lambda result: self._run_checks(
+                "backward", freq=freq, space=result, scale=1.0
+            ),
+        )
+
+    def forward(self, space, scaling):
+        """Supervised forward: space slab (or ``None`` for the retained
+        buffer) -> verified packed values (per-shard list for distributed
+        plans)."""
+        from ..types import ScalingType
+
+        t = self._t
+        if space is None:
+            # retries and the reference rung need the input host-side; the
+            # retained device buffer is fetched once through the plan's own
+            # accessor (engine-native relayout included)
+            space_host = np.asarray(t.space_domain_data())
+        else:
+            space_host = np.asarray(space).reshape(
+                t.dim_z, t.dim_y, t.dim_x
+            )
+        scale = (
+            1.0 / float(t.global_size)
+            if ScalingType(scaling) == ScalingType.FULL
+            else 1.0
+        )
+        return self._supervise(
+            direction="forward",
+            attempt=lambda: t._forward_attempt(space, scaling),
+            reference=lambda: t._reference_forward(space_host, scaling),
+            check=lambda result: self._run_checks(
+                "forward",
+                freq=self._flat_values(result),
+                space=space_host,
+                scale=scale,
+            ),
+        )
+
+    # ---- the ladder ---------------------------------------------------------
+
+    def _supervise(self, *, direction, attempt, reference, check):
+        t = self._t
+        engine = t._engine
+        strict = self.mode == "strict"
+        failures: list = []
+        # strict mode bypasses the breaker's short-circuit: its contract is
+        # "attempt the primary engine, fail fast on the first bad verdict" —
+        # a silent demotion to the reference would be exactly the recovery
+        # strict exists to forbid (it still FEEDS the breaker below, so
+        # strict episodes count toward the shared engine-health state)
+        if strict or breaker.allow(engine):
+            budget = 1 if strict else 1 + self.retries
+            backoff = resolve_backoff_s()
+            for i in range(budget):
+                if i:
+                    obs.counter("verify_retries_total", direction=direction).inc()
+                    obs.trace.event(
+                        "verify", what="retry", direction=direction, attempt=i
+                    )
+                    # backoff OUTSIDE any lock (the wisdom.py retry rule): a
+                    # backing-off transform must not serialize other threads
+                    time.sleep(backoff * (2 ** (i - 1)))
+                bad = None
+                try:
+                    result = attempt()
+                except RETRYABLE_ERRORS as e:
+                    bad = f"execution: {faults.summarize(e)}"
+                if bad is None:
+                    try:
+                        verdicts = check(result)
+                    except CHECKER_ERRORS as e:
+                        bad = f"checker: {faults.summarize(e)}"
+                    else:
+                        failed = [v for v in verdicts if v["verdict"] != "pass"]
+                        if not failed:
+                            breaker.record_success(engine)
+                            return result
+                        bad = "; ".join(
+                            f"{v['check']} rel={v['rel']:.3g} > rtol={v['rtol']:.3g}"
+                            for v in failed
+                        )
+                failures.append(bad)
+                if strict:
+                    obs.counter("verify_failures_total", direction=direction).inc()
+                    breaker.record_failure(engine)
+                    raise VerificationError(
+                        f"strict verification failed on {direction}: {bad}"
+                    )
+            breaker.record_failure(engine)
+            reason = failures[-1]
+        else:
+            reason = f"engine {engine!r} circuit breaker open"
+            with faults.collecting(t._degradations):
+                faults.record_degradation(
+                    "verify_breaker_open",
+                    reason,
+                    engine=engine,
+                    direction=direction,
+                )
+        # rung 3: the jnp.fft reference engine, itself verified
+        obs.trace.event("verify", what="demote", direction=direction, engine=engine)
+        try:
+            result = reference()
+            verdicts = check(result)
+        except CHECKER_ERRORS + RETRYABLE_ERRORS as e:
+            obs.counter("verify_failures_total", direction=direction).inc()
+            raise VerificationError(
+                f"{direction} failed verification and the reference rung could "
+                f"not verify either ({faults.summarize(e)}); attempts: "
+                f"{failures or [reason]}"
+            ) from e
+        failed = [v for v in verdicts if v["verdict"] != "pass"]
+        if failed:
+            obs.counter("verify_failures_total", direction=direction).inc()
+            raise VerificationError(
+                f"{direction} failed verification on engine {engine!r} AND on "
+                f"the jnp.fft reference: "
+                + "; ".join(f"{v['check']} rel={v['rel']:.3g}" for v in failed)
+            )
+        obs.counter("verify_recoveries_total", direction=direction).inc()
+        with faults.collecting(t._degradations):
+            faults.record_degradation(
+                "verify_demoted",
+                f"recovered via jnp.fft reference after: {reason}",
+                engine=engine,
+                direction=direction,
+            )
+        if direction == "backward":
+            # the retained space buffer holds the PRIMARY engine's (failed)
+            # result; a later forward(space=None) must not read it — replace
+            # it with the verified recovery so the backward-then-forward(None)
+            # idiom keeps working through a recovery
+            t._retain_space(result)
+        return result
+
+    # ---- helpers ------------------------------------------------------------
+
+    def _run_checks(self, direction, *, freq, space, scale):
+        return checks.run_checks(
+            direction=direction,
+            freq=freq,
+            space=space,
+            triplets=self.triplets(),
+            transform_type=self._t.transform_type,
+            scale=scale,
+            rtol=self.rtol,
+        )
+
+    def _flat_values(self, values):
+        """Packed complex vector in triplet order: per-shard lists
+        (distributed plans) concatenate in shard order, matching
+        :meth:`triplets`."""
+        if isinstance(values, (list, tuple)):
+            return np.concatenate([np.asarray(v).reshape(-1) for v in values])
+        return np.asarray(values).reshape(-1)
+
+    def triplets(self):
+        """Storage-order index rows aligned with the packed value order
+        (concatenated across shards for distributed plans); cached — the
+        decode is plan-constant."""
+        if self._triplets is None:
+            self._triplets = self._t._verify_triplets()
+        return self._triplets
+
+    def describe(self) -> dict:
+        """JSON-plain record for the plan card's ``verification`` section."""
+        return {
+            "mode": self.mode,
+            "checks": sorted(
+                set(
+                    checks.applicable_checks("backward", self._t.transform_type)
+                )
+                | set(checks.applicable_checks("forward", self._t.transform_type))
+            ),
+            "rtol": float(self.rtol),
+            "retries": int(self.retries),
+            "breaker": breaker.describe(self._t._engine),
+        }

@@ -41,6 +41,7 @@ def clean_faults(monkeypatch):
     rate-draw seed — chaos must never leak between tests."""
     faults.disarm()
     faults.reseed(0)
+    sp.verify.breaker.reset()  # the engine breaker is process-global
     obs.enable()
     obs.clear()
     tuning.clear_memory()
@@ -105,6 +106,30 @@ def test_spec_parsing_and_validation():
     ):
         with pytest.raises(errors.InvalidParameterError):
             faults.parse_spec(bad)
+
+
+def test_malformed_spec_errors_name_the_offending_token():
+    """A typo'd SPFFT_TPU_FAULTS must fail loudly, naming the exact token —
+    a silently dropped arming would make a chaos run vacuously green."""
+    cases = {
+        "sync.fence=raise,unknown.site=nan": "unknown.site=nan",
+        "sync.fence=raise,engine.compile=explode:0.5": "engine.compile=explode:0.5",
+        "sync.fence=raise,engine.compile=raise:lots": "engine.compile=raise:lots",
+        "sync.fence=raise,engine.compile=raise:7": "engine.compile=raise:7",
+        "sync.fence=raise,engine.compile=": "engine.compile=",
+    }
+    for spec, token in cases.items():
+        with pytest.raises(errors.InvalidParameterError) as ei:
+            faults.parse_spec(spec)
+        assert token in str(ei.value), (spec, str(ei.value))
+
+
+def test_duplicate_site_token_raises():
+    """Two tokens arming the same site would silently drop the first under
+    last-wins parsing — reject the spec instead, naming the duplicate."""
+    with pytest.raises(errors.InvalidParameterError) as ei:
+        faults.parse_spec("sync.fence=raise,sync.fence=delay")
+    assert "duplicate" in str(ei.value) and "sync.fence=delay" in str(ei.value)
 
 
 def test_dict_arm_defaults_rate_and_validates():
@@ -214,6 +239,11 @@ def test_chaos_invariant_every_site(site_name, tmp_path, monkeypatch):
     kwargs = dict(policy="tuned") if site_name.startswith(("tuning", "wisdom")) else {}
     if site_name == "engine.compile":
         kwargs = dict(engine="mxu")
+    if site_name == "verify.check":
+        # the detector's own fault site only fires on verified plans; with
+        # the checker raising on every call the supervisor must fail closed
+        # (typed VerificationError) — the typed arm of the invariant
+        kwargs = dict(verify="on")
     if site_name == "wisdom.load":
         # populate the wisdom file first so the load site really fires
         _local(trip, **kwargs)
@@ -283,6 +313,79 @@ def test_sync_fence_raises_typed_error():
     with faults.inject("sync.fence=raise"):
         with pytest.raises(errors.HostExecutionError):
             t.backward(_values(trip))
+
+
+def test_fence_deadline_turns_wedge_into_typed_error(monkeypatch):
+    """SPFFT_TPU_FENCE_BUDGET_S: a wedged fence (modeled by the delay kind
+    sleeping far past the budget inside the waited section) surfaces as a
+    fast typed HostExecutionError counted in execution_failures_total,
+    instead of blocking until a driver timeout."""
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+
+    monkeypatch.setenv(faults.FAULTS_DELAY_ENV, "3")
+    monkeypatch.setenv(FENCE_BUDGET_ENV, "0.2")
+    trip = _triplets()
+    t = _local(trip)
+    import time
+
+    t0 = time.monotonic()
+    with faults.inject("sync.fence=delay"):
+        with pytest.raises(errors.HostExecutionError) as ei:
+            t.backward(_values(trip))
+    assert time.monotonic() - t0 < 2.5, "deadline did not cut the wedge short"
+    assert "deadline" in str(ei.value)
+    assert _counter_sum("execution_failures_total") == 1
+
+
+def test_fence_budget_typo_raises_typed(monkeypatch):
+    """The loud-config rule applies to the fence deadline too: a typo'd
+    budget must raise, never silently disable the deadline it configures."""
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+
+    monkeypatch.setenv(FENCE_BUDGET_ENV, "30s")
+    t = _local(_triplets())
+    with pytest.raises(errors.InvalidParameterError) as ei:
+        t.backward(_values(_triplets()))
+    assert "30s" in str(ei.value)
+
+
+def test_fence_budget_preserves_trace_run_id(monkeypatch):
+    """The budgeted fence runs its wait in a worker thread; events emitted
+    inside (the sync.fence fault site) must still carry the caller's run ID
+    — the card <-> trace join must survive the thread hop (review finding)."""
+    from spfft_tpu.obs import trace
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+
+    monkeypatch.setenv(faults.FAULTS_DELAY_ENV, "0.001")
+    monkeypatch.setenv(FENCE_BUDGET_ENV, "30")
+    trace.enable(capacity=256)
+    try:
+        trip = _triplets()
+        t = _local(trip)
+        with faults.inject("sync.fence=delay"):
+            t.backward(_values(trip))
+        injected = [
+            e
+            for e in trace.snapshot()["events"]
+            if e["name"] == "fault.injected" and e["args"].get("site") == "sync.fence"
+        ]
+        assert injected, "the armed fence site did not record"
+        assert all(e["run"] == t._run_id for e in injected), injected
+    finally:
+        trace.disable()
+
+
+def test_fence_deadline_passthrough_when_healthy(monkeypatch):
+    """With a budget armed and a healthy runtime, fence results are
+    unchanged (the worker-thread wait is behavior-transparent)."""
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    monkeypatch.setenv(FENCE_BUDGET_ENV, "30")
+    assert_close(_local(trip).backward(values), expect)
+    assert _counter_sum("execution_failures_total") == 0
 
 
 def test_exchange_build_raises_mpi_error():
@@ -417,7 +520,7 @@ def test_error_taxonomy_roundtrips_to_c_codes():
     and capi.error_code translates an instance back to exactly that value —
     the C shim's catch-and-translate contract, machine-checked."""
     classes = _error_classes()
-    assert len(classes) == 21  # GenericError + 20 typed subclasses
+    assert len(classes) == 22  # GenericError + 21 typed subclasses
     seen = {}
     for cls in classes:
         code = capi.error_code(cls("chaos"))

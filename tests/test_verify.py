@@ -1,0 +1,536 @@
+"""Chaos suite for self-verification (spfft_tpu.verify): ABFT checks, the
+retry/demote recovery supervisor, and the engine circuit breaker.
+
+The central invariant (ISSUE 5 acceptance): with verification armed and
+``engine.execute`` corrupting every dispatch, a transform either returns a
+result matching the jnp.fft reference (recovered, with the recovery counted
+and a degradation rung recorded) or raises typed ``VerificationError`` — a
+silently corrupted output is impossible. The suite pins each rung of the
+detect -> retry -> demote -> break ladder, the check math itself, the strict
+mode, the new ``verify.check`` fault site, and the plan-card/metrics/trace
+exposure.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    capi,
+    errors,
+    faults,
+    obs,
+    verify,
+)
+from spfft_tpu.parameters import distribute_triplets
+from utils import assert_close
+
+DIM = 8
+
+VERIFY_ENV_KNOBS = (
+    verify.VERIFY_ENV,
+    verify.VERIFY_RTOL_ENV,
+    verify.VERIFY_SEED_ENV,
+    verify.VERIFY_RETRIES_ENV,
+    verify.VERIFY_BACKOFF_ENV,
+    verify.breaker.BREAKER_K_ENV,
+    verify.breaker.BREAKER_COOLDOWN_ENV,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_verify(monkeypatch):
+    """Disarm faults, reset the process-global breaker, fresh metrics, and
+    scrub every verify env knob — verification state must never leak between
+    tests (the breaker especially: it is process-global by design)."""
+    faults.disarm()
+    faults.reseed(0)
+    verify.breaker.reset()
+    obs.enable()
+    obs.clear()
+    for knob in VERIFY_ENV_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setenv(verify.VERIFY_BACKOFF_ENV, "0.001")
+    with warnings.catch_warnings():
+        # corrupted attempts legitimately emit invalid-value RuntimeWarnings
+        # while the poisoned result is fetched for checking
+        warnings.simplefilter("ignore", RuntimeWarning)
+        yield
+    faults.disarm()
+    verify.breaker.reset()
+
+
+def _triplets(dim=DIM):
+    return sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.8)
+
+
+def _values(trip, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+
+def _local(trip, **kwargs):
+    return Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip, **kwargs
+    )
+
+
+def _dist(per_shard, **kwargs):
+    return DistributedTransform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        [p.copy() for p in per_shard],
+        mesh=sp.make_fft_mesh(2),
+        **kwargs,
+    )
+
+
+def _counter_sum(prefix: str) -> int:
+    snap = obs.snapshot()
+    return sum(v for k, v in snap["counters"].items() if k.startswith(prefix))
+
+
+# ---- mode resolution ---------------------------------------------------------
+
+
+def test_mode_resolution(monkeypatch):
+    assert verify.resolve_mode(None) == "off"
+    assert verify.resolve_mode(True) == "on"
+    assert verify.resolve_mode(False) == "off"
+    assert verify.resolve_mode("strict") == "strict"
+    monkeypatch.setenv(verify.VERIFY_ENV, "1")
+    assert verify.resolve_mode(None) == "on"
+    assert verify.resolve_mode(False) == "off"  # explicit kwarg beats the env
+    monkeypatch.setenv(verify.VERIFY_ENV, "strict")
+    assert verify.resolve_mode(None) == "strict"
+    with pytest.raises(errors.InvalidParameterError):
+        verify.resolve_mode("sometimes")
+    monkeypatch.setenv(verify.VERIFY_ENV, "banana")
+    with pytest.raises(errors.InvalidParameterError):
+        verify.resolve_mode(None)
+
+
+def test_off_mode_is_one_falsy_check():
+    t = _local(_triplets())
+    assert t._verifier is None  # the entire off-mode overhead per call
+    t.backward(_values(_triplets()))
+    assert _counter_sum("verify_checks_total") == 0
+
+
+# ---- the checks themselves ---------------------------------------------------
+
+
+def _dense_reference(trip, values, dim=DIM):
+    grid = np.zeros((dim, dim, dim), dtype=np.complex128)
+    for (x, y, z), v in zip(trip, values):
+        grid[z, y, x] = v
+    return np.fft.ifftn(grid) * grid.size  # unnormalized inverse DFT
+
+
+def test_checks_pass_on_true_transform_pair():
+    trip = _triplets()
+    values = _values(trip)
+    space = _dense_reference(trip, values)
+    verdicts = verify.run_checks(
+        direction="backward",
+        freq=values,
+        space=space,
+        triplets=trip,
+        transform_type=TransformType.C2C,
+        rtol=1e-9,
+    )
+    assert [v["check"] for v in verdicts] == ["parseval", "dc", "probe"]
+    assert all(v["verdict"] == "pass" for v in verdicts)
+
+
+def test_checks_flag_corrupted_space():
+    trip = _triplets()
+    values = _values(trip)
+    space = _dense_reference(trip, values)
+    space[1, 2, 3] += 100.0  # finite-but-wrong: the case guard mode misses
+    verdicts = verify.run_checks(
+        direction="backward",
+        freq=values,
+        space=space,
+        triplets=trip,
+        transform_type=TransformType.C2C,
+        rtol=1e-6,
+    )
+    failed = {v["check"] for v in verdicts if v["verdict"] == "fail"}
+    assert "parseval" in failed or "dc" in failed, verdicts
+
+
+def test_forward_checks_and_scaling():
+    trip = _triplets()
+    values = _values(trip)
+    space = _dense_reference(trip, values)
+    n = float(space.size)
+    # a perfect FULL-scaled forward of `space` returns `values` at the
+    # sparse sites (the spectrum of `space` IS the sparse set)
+    verdicts = verify.run_checks(
+        direction="forward",
+        freq=values,
+        space=space,
+        triplets=trip,
+        transform_type=TransformType.C2C,
+        scale=1.0 / n,
+        rtol=1e-9,
+    )
+    assert [v["check"] for v in verdicts] == ["dc", "probe"]
+    assert all(v["verdict"] == "pass" for v in verdicts)
+    # corrupt one output value: the probe must be able to see it, so sweep
+    # the deterministic probe index onto the corrupted element via the seed
+    bad = values.copy()
+    bad[7] *= 3.0
+    failed_any = False
+    for seed in range(8):
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setenv(verify.VERIFY_SEED_ENV, str(seed))
+            verdicts = verify.run_checks(
+                direction="forward",
+                freq=bad,
+                space=space,
+                triplets=trip,
+                transform_type=TransformType.C2C,
+                scale=1.0 / n,
+                rtol=1e-6,
+            )
+        failed_any = failed_any or any(v["verdict"] == "fail" for v in verdicts)
+    assert failed_any, "no probe seed caught a 3x-corrupted output value"
+
+
+def test_r2c_applicability():
+    assert verify.applicable_checks("backward", TransformType.R2C) == ()
+    assert verify.applicable_checks("forward", TransformType.R2C) == ("dc", "probe")
+    assert verify.applicable_checks("backward", TransformType.C2C) == (
+        "parseval",
+        "dc",
+        "probe",
+    )
+
+
+def test_checks_vocabulary_is_registry():
+    assert set(verify.CHECKS) == set(verify.CHECK_FNS)
+
+
+# ---- supervised transforms: detect -> retry -> demote -> recover -------------
+
+
+def test_clean_verified_roundtrip_matches_unverified():
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    t = _local(trip, verify="on")
+    assert_close(t.backward(values), expect)
+    assert_close(t.forward(scaling=ScalingType.FULL), values)
+    assert _counter_sum("verify_checks_total") > 0
+    assert _counter_sum("verify_recoveries_total") == 0
+    assert t.report()["degradations"] == []
+
+
+def test_corrupt_dispatch_recovers_via_reference():
+    """The acceptance invariant: every dispatch corrupted, result still
+    matches the fault-free run, recovery counted and recorded."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t = _local(trip, verify="on")
+        out = t.backward(values)
+        back = t.forward(scaling=ScalingType.FULL)
+    assert_close(out, expect)
+    assert_close(back, values)
+    assert _counter_sum("verify_recoveries_total") >= 2  # both directions
+    assert _counter_sum("verify_retries_total") > 0
+    card = t.report()
+    assert any(d["event"] == "verify_demoted" for d in card["degradations"])
+    assert obs.validate_plan_card(card) == []
+
+
+def test_nan_dispatch_recovers_without_guard():
+    """NaN poisoning is caught by the checks alone (guard off): rel=nan
+    compares false against any rtol, which lands on the fail side."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    with faults.inject("engine.execute=nan:1.0"):
+        t = _local(trip, verify="on", guard=False)
+        out = t.backward(values)
+    assert_close(out, expect)
+    assert not np.isnan(np.asarray(out)).any()
+    assert _counter_sum("verify_recoveries_total") == 1
+
+
+def test_transient_fault_heals_within_retry_budget(monkeypatch):
+    """A fractional-rate fault heals by re-execution (rung 2) on some calls;
+    whatever path each call takes, the result is always parity-correct."""
+    monkeypatch.setenv(verify.VERIFY_RETRIES_ENV, "4")
+    faults.reseed(7)
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    t = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:0.5"):
+        for _ in range(4):
+            assert_close(t.backward(values), expect)
+    assert _counter_sum("verify_retries_total") > 0
+
+
+def test_forward_retained_buffer_safe_after_recovery():
+    """After a recovered backward, forward(space=None) must read the
+    *verified* space, not the failed primary's buffer."""
+    trip = _triplets()
+    values = _values(trip)
+    t = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t.backward(values)
+    # faults disarmed now: the forward runs clean off the retained buffer
+    assert_close(t.forward(scaling=ScalingType.FULL), values)
+
+
+def test_strict_mode_bypasses_open_breaker(monkeypatch):
+    """Strict's contract is attempt-primary-then-fail-fast: an open breaker
+    must not silently demote a strict plan to the reference (end-to-end
+    drive regression — earlier 'on'-mode failures in the process had tripped
+    the breaker and strict returned a recovered result instead of raising)."""
+    monkeypatch.setenv(verify.breaker.BREAKER_K_ENV, "1")
+    trip = _triplets()
+    values = _values(trip)
+    t_on = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t_on.backward(values)  # trips the engine breaker at K=1
+        assert verify.breaker.describe(t_on._engine)["state"] == "open"
+        t_strict = _local(trip, verify="strict")
+        with pytest.raises(errors.VerificationError):
+            t_strict.backward(values)
+
+
+def test_rtol_tracks_effective_precision(monkeypatch):
+    """A float64 plan with jax_enable_x64 off actually executes in f32
+    (silent truncation): the default tolerance must follow the effective
+    precision, or clean f32-accuracy results get condemned as corruption
+    (end-to-end drive regression)."""
+    import jax
+
+    assert verify.resolve_rtol(np.float32) == 1e-4
+    prev = jax.config.read("jax_enable_x64")
+    try:
+        jax.config.update("jax_enable_x64", True)
+        assert verify.resolve_rtol(np.float64) == 1e-9
+        jax.config.update("jax_enable_x64", False)
+        assert verify.resolve_rtol(np.float64) == 1e-4
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def test_strict_mode_raises_immediately_and_roundtrips_capi():
+    trip = _triplets()
+    t = _local(trip, verify="strict")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        with pytest.raises(errors.VerificationError) as ei:
+            t.backward(_values(trip))
+    assert _counter_sum("verify_retries_total") == 0
+    assert _counter_sum("verify_failures_total") == 1
+    # the new taxonomy member round-trips through the C error surface
+    assert capi.error_code(ei.value) == int(errors.ErrorCode.VERIFICATION) == 23
+
+
+def test_verify_check_site_fails_closed():
+    """Chaos on the detector itself (fault site verify.check): an
+    unverifiable result must end in typed VerificationError, never a pass."""
+    trip = _triplets()
+    t = _local(trip, verify="on")
+    with faults.inject("verify.check=raise"):
+        with pytest.raises(errors.VerificationError):
+            t.backward(_values(trip))
+
+
+def test_typed_execution_error_retries_then_raises():
+    """sync.fence raising on every attempt AND in the reference rung leaves
+    nothing verifiable: typed VerificationError with the cause chained."""
+    trip = _triplets()
+    t = _local(trip, verify="on")
+    with faults.inject("sync.fence=raise"):
+        with pytest.raises(errors.VerificationError) as ei:
+            t.backward(_values(trip))
+    assert ei.value.__cause__ is not None
+
+
+def test_distributed_corrupt_recovers():
+    trip = _triplets()
+    values = _values(trip)
+    per_shard = distribute_triplets(trip, 2, DIM)
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(x)] for x in s]) for s in per_shard]
+    expect = _local(trip).backward(values)
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t = _dist(per_shard, verify="on")
+        out = t.backward([v.copy() for v in vps])
+        back = t.forward(scaling=ScalingType.FULL)
+    assert_close(out, expect)
+    for got, want in zip(back, vps):
+        assert_close(got, want)
+    assert _counter_sum("verify_recoveries_total") >= 2
+    assert any(
+        d["event"] == "verify_demoted" for d in t.report()["degradations"]
+    )
+
+
+def test_multiprocess_mesh_rejects_verify(monkeypatch):
+    """Multi-process meshes cannot satisfy the reference rung (remote shards
+    are not host-visible): verify= must fail loudly at construction."""
+    from spfft_tpu.parallel import execution as pexec
+
+    per_shard = distribute_triplets(_triplets(), 2, DIM)
+    monkeypatch.setattr(pexec, "mesh_process_span", lambda mesh: 2)
+    with pytest.raises(errors.InvalidParameterError):
+        _dist(per_shard, verify="on")
+
+
+# ---- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_trips_at_k_and_short_circuits(monkeypatch):
+    monkeypatch.setenv(verify.breaker.BREAKER_K_ENV, "2")
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t = _local(trip, verify="on")
+        assert_close(t.backward(values), expect)  # episode 1
+        assert_close(t.backward(values), expect)  # episode 2 -> trips
+        assert verify.breaker.describe(t._engine)["state"] == "open"
+        injected_before = _counter_sum("faults_injected_total")
+        assert_close(t.backward(values), expect)  # short-circuit to reference
+    # the open breaker skipped the primary dispatch: no new injections fired
+    assert _counter_sum("faults_injected_total") == injected_before
+    assert any(
+        d["event"] == "verify_breaker_open" for d in t._degradations
+    )
+    # state is visible in obs.snapshot() and the plan card
+    gauges = obs.snapshot()["gauges"]
+    assert any(
+        k.startswith("verify_breaker_state") and v == 1 for k, v in gauges.items()
+    ), gauges
+    assert _counter_sum("verify_breaker_trips_total") == 1
+    card = t.report()
+    assert card["verification"]["breaker"]["state"] == "open"
+
+
+def test_breaker_reset_zeroes_state_gauge(monkeypatch):
+    """reset() must also zero the verify_breaker_state gauge: a snapshot
+    showing a tripped breaker that no longer exists would desynchronize the
+    metrics view from describe()/the plan card (review finding)."""
+    monkeypatch.setenv(verify.breaker.BREAKER_K_ENV, "1")
+    trip = _triplets()
+    t = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        t.backward(_values(trip))
+    gauges = obs.snapshot()["gauges"]
+    assert any(
+        k.startswith("verify_breaker_state") and v == 1 for k, v in gauges.items()
+    )
+    verify.breaker.reset()
+    gauges = obs.snapshot()["gauges"]
+    assert all(
+        v == 0 for k, v in gauges.items() if k.startswith("verify_breaker_state")
+    ), gauges
+
+
+def test_breaker_half_open_probe_heals(monkeypatch):
+    monkeypatch.setenv(verify.breaker.BREAKER_K_ENV, "1")
+    monkeypatch.setenv(verify.breaker.BREAKER_COOLDOWN_ENV, "0")
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    t = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        assert_close(t.backward(values), expect)  # trips at K=1
+    assert verify.breaker.describe(t._engine)["state"] == "open"
+    # cooldown 0: the next verified call probes half-open; faults are
+    # disarmed, so the probe passes and the breaker closes
+    assert_close(t.backward(values), expect)
+    state = verify.breaker.describe(t._engine)
+    assert state["state"] == "closed" and state["consecutive_failures"] == 0
+
+
+def test_breaker_half_open_failure_reopens(monkeypatch):
+    monkeypatch.setenv(verify.breaker.BREAKER_K_ENV, "1")
+    monkeypatch.setenv(verify.breaker.BREAKER_COOLDOWN_ENV, "0")
+    trip = _triplets()
+    values = _values(trip)
+    expect = _local(trip).backward(values)
+    t = _local(trip, verify="on")
+    with faults.inject("engine.execute=corrupt:1.0"):
+        assert_close(t.backward(values), expect)  # trips
+        assert_close(t.backward(values), expect)  # half-open probe fails
+    state = verify.breaker.describe(t._engine)
+    assert state["state"] == "open" and state["trips"] == 2
+
+
+# ---- exposure: cards, trace, CLI surfaces ------------------------------------
+
+
+def test_plan_card_verification_schema():
+    trip = _triplets()
+    for t in (_local(trip), _local(trip, verify="on"), _local(trip, verify="strict")):
+        card = t.report()
+        assert obs.validate_plan_card(card) == []
+        ver = card["verification"]
+        assert ver["mode"] == t._verify_mode
+        assert ver["breaker"]["engine"] == t._engine
+    assert _local(trip, verify="on").report()["verification"]["checks"] == [
+        "dc",
+        "parseval",
+        "probe",
+    ]
+
+
+def test_verify_events_in_trace():
+    from spfft_tpu.obs import trace
+
+    trace.enable(capacity=512)
+    try:
+        trip = _triplets()
+        with faults.inject("engine.execute=corrupt:1.0"):
+            t = _local(trip, verify="on")
+            t.backward(_values(trip))
+        events = [e for e in trace.snapshot()["events"] if e["name"] == "verify"]
+        whats = {e["args"].get("what") for e in events}
+        assert {"check", "retry", "demote"} <= whats, whats
+        # verify events carry the plan's run ID: card <-> trace join key
+        assert any(e["run"] == t._run_id for e in events)
+    finally:
+        trace.disable()
+
+
+def test_clone_preserves_verify_mode():
+    trip = _triplets()
+    t = _local(trip, verify="on")
+    c = t.clone()
+    assert c._verify_mode == "on" and c._verifier is not None
+    assert _local(trip).clone()._verifier is None
+
+
+def test_grid_create_transform_passes_verify():
+    trip = _triplets()
+    g = sp.Grid(DIM, DIM, DIM, DIM * DIM, ProcessingUnit.HOST, 1)
+    t = g.create_transform(
+        ProcessingUnit.HOST,
+        TransformType.C2C,
+        DIM,
+        DIM,
+        DIM,
+        indices=trip,
+        verify="on",
+    )
+    assert t._verify_mode == "on" and t._verifier is not None
