@@ -105,56 +105,32 @@ def _acquire_backend():
 
 
 def main():
-    import jax
     import spfft_tpu as sp
-    from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+    from spfft_tpu import ProcessingUnit, TransformType
 
     _acquire_backend()
 
     dim = 256
     rng = np.random.default_rng(0)
     triplets = sp.create_spherical_cutoff_triplets(dim, dim, dim, 0.659)  # ~15% nnz
-    n = len(triplets)
 
     t = sp.Transform(
         ProcessingUnit.GPU, TransformType.C2C, dim, dim, dim,
         indices=triplets, dtype=np.float32,
     )
-    ex = t._exec
 
-    def roundtrip(re, im, phase):
-        # trace_* (un-jitted impls): jit boundaries inside the scan body block
-        # cross-stage fusion (measured ~30% slower per pair)
-        space_re, space_im = ex.trace_backward(re, im, phase=phase)
-        return ex.trace_forward(space_re, space_im, ScalingType.FULL, phase=phase)
-
-    def chain(re, im, phase):
-        def body(carry, _):
-            return roundtrip(*carry, phase), None
-        out, _ = jax.lax.scan(body, (re, im), None, length=CHAIN)
-        return out
-
-    step = jax.jit(chain)
-
-    re = ex.put(rng.standard_normal(n).astype(np.float32))
-    im = ex.put(rng.standard_normal(n).astype(np.float32))
-    # rotation tables enter as jit operands, not embedded constants
-    # (ops/lanecopy.phase_rep_operands)
-    phase = getattr(ex, "phase_operands", ())
-
-    # warmup / compile
-    wre, wim = step(re, im, phase)
-    float(wre[0])
-
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cre, cim = step(re, im, phase)
-        float(cre[0])  # forces the whole chain to complete
-        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    # The ONE shared timing discipline (spfft_tpu.obs.perf): staged inputs,
+    # CHAIN dependent roundtrips in a single jitted lax.scan over the
+    # un-jitted trace_* impls (jit boundaries inside the scan body block
+    # cross-stage fusion — measured ~30% slower per pair), warmup absorbing
+    # compilation, best-of-3 fenced repeats. bench.py used to carry its own
+    # copy of this loop; dbench/profile/tuning and this harness now share it,
+    # so a fence or warmup fix lands in every trajectory number at once.
+    measured = sp.obs.perf.measure_pair_seconds(t, chain=CHAIN, repeats=3)
+    best = measured["seconds_per_pair"]
 
     # chain correctness guard: FULL-scaled roundtrip is the identity
-    err = float(np.abs(np.asarray(cre[:64]) - np.asarray(re[:64])).max())
+    err = measured["roundtrip_residual"]
     assert err < 1e-2, f"roundtrip chain diverged: {err}"
 
     ntot = dim**3
@@ -185,6 +161,14 @@ def main():
         wisdom = sp.tuning.wisdom_state(t)
     except Exception as e:
         wisdom = {"error": str(e).split("\n")[0]}
+    # per-stage perf report (spfft_tpu.obs.perf): the measured pair time
+    # attributed to the canonical stage vocabulary — same schema as the
+    # distributed dbench rows, so single-chip and multichip captures read
+    # with one decoder; device_count stamps the (single-chip) scope
+    try:
+        perf = sp.obs.perf.perf_report(t, best, repeats=3)
+    except Exception as e:  # a perf-model bug must never cost a capture
+        perf = {"error": str(e).split("\n")[0]}
 
     print(
         json.dumps(
@@ -195,6 +179,8 @@ def main():
                 "vs_baseline": round(dense_time / best, 3),
                 "plan": plan_card,
                 "wisdom": wisdom,
+                "perf": perf,
+                "device_count": perf.get("device_count", 1),
                 # trace join key (spfft_tpu.obs.trace): the plan's run ID, so
                 # a flight-recorder dump or snapshot from this process joins
                 # this capture on one key

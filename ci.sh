@@ -15,6 +15,7 @@
 #          ./ci.sh trace      # flight recorder: schema + Chrome export + dump
 #          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
 #          ./ci.sh verify     # ABFT checks, corrupt-injection recovery, breaker
+#          ./ci.sh perf       # dbench scaling rows + schema + regression gate
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -217,6 +218,63 @@ EOF
   rm -rf "$vdir"
 }
 
+run_perf() {
+  echo "== Perf (spfft_tpu.obs.perf: dbench rows + schema + regression gate, CPU) =="
+  # 8-virtual-device distributed bench: slab AND pencil meshes must emit
+  # validating spfft_tpu.obs.perf/1 reports (per-stage attribution summing
+  # to the measured pair time, geometry-exact exchange bytes, run-ID join).
+  local pdir
+  pdir="$(mktemp -d)"
+  JAX_PLATFORMS=cpu timeout 540 python programs/dbench.py --devices 8 \
+    --dim 8 --sparsity 0.9 --scaling strong --repeats 2 --chain 2 \
+    --engine xla --cpu -o "$pdir/dbench.json" > /dev/null
+  JAX_PLATFORMS=cpu python - "$pdir" <<'EOF'
+import json, sys
+from spfft_tpu.obs import perf
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/dbench.json"))
+missing = perf.validate_scaling_doc(doc)
+assert not missing, f"scaling doc incomplete: {missing}"
+kinds = {r["decomposition"] for r in doc["rows"]}
+assert kinds == {"slab", "pencil2"}, kinds
+for r in doc["rows"]:
+    total = sum(s["seconds"] for s in r["stages"])
+    assert abs(total - r["seconds_per_pair"]) < 1e-9, r["key"]
+    assert 0.0 < r["exchange_fraction"] < 1.0, r["key"]
+    assert r["run_id"], r["key"]
+print(f"dbench ok ({len(doc['rows'])} rows: {', '.join(sorted(kinds))})")
+EOF
+  # Regression gate: the committed baseline is CPU-noise-calibrated (wide
+  # tolerance — it exists to catch algorithmic slides, e.g. a collective
+  # degrading to serialized scatter, not scheduler jitter) ...
+  python programs/perf_gate.py "$pdir/dbench.json" \
+    bench_results/perf_baseline_cpu8.json --tolerance 0.85 > /dev/null
+  # ... a run gates green against itself ...
+  python programs/perf_gate.py "$pdir/dbench.json" "$pdir/dbench.json" > /dev/null
+  # ... and must trip (exit 3, the distinct regression code) against a
+  # doctored baseline claiming 10x the throughput.
+  python - "$pdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/dbench.json"))
+for r in doc["rows"]:
+    r["gflops"] *= 10
+    r["seconds_noise"] = 0.0
+json.dump(doc, open(f"{d}/doctored.json", "w"))
+EOF
+  local rc=0
+  python programs/perf_gate.py "$pdir/dbench.json" "$pdir/doctored.json" \
+    > /dev/null || rc=$?
+  if [ "$rc" -ne 3 ]; then
+    echo "perf gate did not trip on a doctored baseline (rc=$rc)" >&2
+    exit 1
+  fi
+  echo "perf gate ok (committed baseline green, doctored baseline trips)"
+  rm -rf "$pdir"
+}
+
 run_dryrun() {
   echo "== Multichip dryrun (8-device CPU mesh, CPU forced) =="
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
@@ -244,6 +302,7 @@ case "$stage" in
   trace) run_trace ;;
   chaos) run_chaos ;;
   verify) run_verify ;;
+  perf) run_perf ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
@@ -254,12 +313,13 @@ case "$stage" in
     run_trace
     run_chaos
     run_verify
+    run_perf
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | perf | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

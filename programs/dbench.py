@@ -1,0 +1,210 @@
+"""Distributed benchmark: multichip strong/weak-scaling perf rows.
+
+The distributed counterpart of ``bench.py`` (ROADMAP item 1: a multichip
+GFLOP/s number, not just a dryrun ok-flag): builds slab (1-D) and 2-D pencil
+plans across a device-count ladder (real accelerators when enough are
+attached, virtual CPU devices otherwise), measures each with the shared
+fenced best-of-R chained-roundtrip discipline
+(``spfft_tpu.obs.perf.measure_pair_seconds`` — the ``tuning/runner.py``
+warmup/best-of rules plus ``bench.py``'s dispatch-amortizing ``lax.scan``
+chain), and emits one ``spfft_tpu.obs.perf/1`` report per cell: per-stage
+seconds, GFLOP/s, GB/s and the ``exchange_fraction`` scoreboard, joined to
+the plan card and flight recorder by run ID.
+
+Strong-scaling rows keep the grid fixed as devices grow; weak-scaling rows
+grow ``dim_z`` with the device count (constant per-device volume). The
+multi-row JSON document (schema ``spfft_tpu.obs.perf.scaling/1``,
+``obs.perf.validate_scaling_doc``) is the format that replaces the bare
+ok-flag MULTICHIP captures, and is what ``programs/perf_gate.py`` gates
+against a committed baseline (``./ci.sh perf``).
+
+Usage:
+    python programs/dbench.py --devices 1 2 4 8 --dim 32 -o MULTICHIP.json
+    python programs/dbench.py --devices 8 --mesh pencil --scaling weak
+    python programs/dbench.py --devices 4 --r2c --dtype f64 --engine xla
+
+On a CPU mesh the wall-clock is indicative only (collectives are memory
+copies); run on a pod slice for decision-grade rows — the report schema and
+the gate are identical either way.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def row_key(report: dict, scaling: str) -> str:
+    """Stable scenario key a gate matches rows on: everything that defines
+    the cell except the measured numbers."""
+    dims = "x".join(str(d) for d in report["dims"])
+    return (
+        f"{scaling}:{report['decomposition']}:P{report['device_count']}"
+        f":{dims}:{report['transform_type']}:{report['dtype']}"
+        f":{report['exchange_discipline']}:{report['engine']}"
+        f":nnz{report['nnz_fraction']:.3f}"
+    )
+
+
+def build_transform(args, mesh_kind, devices, dims, mesh_devices):
+    """One plan for a scaling cell (slab or pencil over ``devices`` chips)."""
+    import numpy as np
+
+    import spfft_tpu as sp
+    from spfft_tpu import ExchangeType, ProcessingUnit, TransformType
+
+    dx, dy, dz = dims
+    radius = sp.spherical_radius_for_fraction(args.sparsity)
+    trip = sp.create_spherical_cutoff_triplets(
+        dx, dy, dz, min(radius, 1.0), hermitian_symmetry=args.r2c
+    )
+    ttype = TransformType.R2C if args.r2c else TransformType.C2C
+    dtype = np.float64 if args.dtype == "f64" else np.float32
+    pu = ProcessingUnit.GPU if args.engine == "mxu" else ProcessingUnit.HOST
+    if devices == 1 and mesh_kind == "slab" and not args.force_mesh:
+        # the P=1 rung is the local plan — the honest single-chip anchor of
+        # a strong-scaling curve (a 1-wide mesh adds sharding machinery)
+        return sp.Transform(
+            pu, ttype, dx, dy, dz, indices=trip, dtype=dtype,
+            engine=args.engine,
+        )
+    if mesh_kind == "pencil":
+        mesh = sp.make_fft_mesh2(2, devices // 2, devices=mesh_devices)
+    else:
+        mesh = sp.make_fft_mesh(devices=mesh_devices)
+    return sp.DistributedTransform(
+        pu, ttype, dx, dy, dz, trip, mesh=mesh, dtype=dtype,
+        engine=args.engine, exchange_type=ExchangeType[args.exchange],
+    )
+
+
+def measure_row(transform, args, scaling: str) -> dict:
+    """Measure one cell and wrap it as a keyed scaling row (a validating
+    perf report plus the scenario key and a noise figure for the gate)."""
+    from spfft_tpu.obs import perf
+
+    m = perf.measure_pair_seconds(
+        transform, chain=args.chain, repeats=args.repeats, warmup=args.warmup
+    )
+    if m["roundtrip_residual"] is not None and m["roundtrip_residual"] > 1e-2:
+        raise AssertionError(
+            f"roundtrip chain diverged: {m['roundtrip_residual']}"
+        )
+    row = perf.perf_report(
+        transform, m["seconds_per_pair"], repeats=m["repeats"]
+    )
+    best = m["seconds_per_pair"]
+    row["scaling"] = scaling
+    row["rep_seconds"] = m["rep_seconds"]
+    # relative spread of the timed repeats (median vs best — one outlier
+    # repeat must not blow the figure up; even counts average the middle
+    # pair, so repeats=2 records half the spread, not the full range): the
+    # gate widens its threshold by this, capped, so a noisy host cannot fake
+    # a regression
+    reps = sorted(m["rep_seconds"])
+    median = (reps[(len(reps) - 1) // 2] + reps[len(reps) // 2]) / 2.0
+    row["seconds_noise"] = (median - best) / best if best else 0.0
+    row["key"] = row_key(row, scaling)
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8],
+                    help="device-count ladder (virtual CPU devices stand in "
+                    "when the host has fewer real chips)")
+    ap.add_argument("--dim", type=int, default=32,
+                    help="strong-scaling grid edge (weak rows scale dim_z)")
+    ap.add_argument("--sparsity", type=float, default=0.15,
+                    help="nonzero fraction of the frequency ball")
+    ap.add_argument("--mesh", nargs="+", default=["slab", "pencil"],
+                    choices=["slab", "pencil"])
+    ap.add_argument("--scaling", nargs="+", default=["strong", "weak"],
+                    choices=["strong", "weak"])
+    ap.add_argument("--engine", default="mxu", choices=["xla", "mxu"])
+    ap.add_argument("--exchange", default="DEFAULT",
+                    help="exchange discipline name (DEFAULT = policy pick)")
+    ap.add_argument("--r2c", action="store_true")
+    ap.add_argument("--dtype", default="f32", choices=["f32", "f64"])
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--chain", type=int, default=4)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU platform (CI uses this; the "
+                    "default consults attached accelerators when safe)")
+    ap.add_argument("--force-mesh", action="store_true",
+                    help="run P=1 through the distributed machinery too")
+    ap.add_argument("-o", default=None, help="write the scaling JSON here")
+    args = ap.parse_args(argv)
+
+    if min(args.devices) < 1:
+        ap.error("--devices must be positive")
+
+    # device bootstrap before the first backend touch (virtual CPU fallback)
+    from spfft_tpu.parallel.mesh import ensure_virtual_devices
+
+    max_p = max(args.devices)
+    all_devices = ensure_virtual_devices(
+        max_p, warn=True, platform="cpu" if args.cpu else None
+    )
+
+    import jax
+
+    if args.dtype == "f64" and not jax.config.read("jax_enable_x64"):
+        jax.config.update("jax_enable_x64", True)
+
+    from spfft_tpu.obs import perf
+
+    rows = []
+    for scaling in args.scaling:
+        for P in sorted(set(args.devices)):
+            dims = (args.dim, args.dim, args.dim * P if scaling == "weak"
+                    else args.dim)
+            for mesh_kind in args.mesh:
+                if mesh_kind == "pencil" and (P < 4 or P % 2):
+                    # 2 x (P/2) pencil factorization needs P >= 4, even —
+                    # say so: a silently empty sweep must not look clean
+                    print(f"note: skipping pencil at P={P} "
+                          "(needs an even device count >= 4)", file=sys.stderr)
+                    continue
+                t = build_transform(args, mesh_kind, P, dims, all_devices[:P])
+                row = measure_row(t, args, scaling)
+                rows.append(row)
+                print(
+                    f"{scaling:6s} {mesh_kind:6s} P={P:2d} "
+                    f"{'x'.join(str(d) for d in dims):>12s} "
+                    f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
+                    f"{row['gflops']:9.2f} GFLOP/s "
+                    f"exch {row['exchange_fraction'] * 100:5.1f}% "
+                    f"({row['exchange_gbps']:.2f} GB/s wire)"
+                )
+
+    if not rows:
+        # every cell was skipped: exiting 0 with an empty document would
+        # read as a clean bench run that never happened
+        print("dbench: no measurable cells for the requested "
+              "devices/mesh/scaling combination", file=sys.stderr)
+        return 1
+
+    platform = str(all_devices[0].platform)
+    doc = {
+        "schema": perf.SCALING_SCHEMA,
+        "config": {k: v for k, v in vars(args).items() if k != "o"},
+        "platform": platform,
+        "rows": rows,
+    }
+    missing = perf.validate_scaling_doc(doc)
+    if args.o:
+        Path(args.o).write_text(json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {len(rows)} rows to {args.o}")
+    if missing:
+        print(f"scaling doc INCOMPLETE, missing: {missing}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,18 @@ CPU trials are auto-allowed here since this program measures on the virtual
 CPU mesh anyway). The row records which discipline the policy resolved to and
 its decision provenance, so model picks and wisdom picks can be compared
 against the exhaustive sweep they should have matched.
+
+``--matrix`` switches to the **scenario matrix** (the comparative-study
+format of arxiv.org/pdf/2506.08653: a grid of measured cells, not one
+headline number): the cross product of ``--matrix-dims`` x
+``--matrix-sparsity`` (extremes by default) x ``--matrix-types`` (c2c/r2c) x
+``--matrix-dtypes`` (f32/f64) x both wire disciplines (padded BUFFERED and
+exact-counts UNBUFFERED), each cell measured with the shared fenced
+chained-roundtrip discipline and emitted as a keyed
+``spfft_tpu.obs.perf/1`` row (per-stage attribution, GFLOP/s,
+exchange_fraction) — the same row format ``programs/dbench.py`` writes, so
+``programs/perf_gate.py`` gates matrix documents identically and a
+regression or win is visible *per scenario*.
 """
 from __future__ import annotations
 
@@ -39,6 +51,79 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# sibling programs (dbench) resolve even when this file is loaded by path
+# (tests import it via importlib, where the script dir is not on sys.path)
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def run_matrix(args):
+    """The scenario matrix (module docstring): dims x sparsity x c2c/r2c x
+    dtype x both wire disciplines, each cell a keyed perf row measured with
+    the shared fenced chained-roundtrip discipline (``dbench.measure_row``),
+    written as a gate-compatible ``spfft_tpu.obs.perf.scaling/1`` document."""
+    import jax
+    import numpy as np
+    import spfft_tpu as sp
+    from spfft_tpu import (
+        DistributedTransform,
+        ExchangeType,
+        ProcessingUnit,
+        TransformType,
+    )
+    from spfft_tpu.obs import perf
+
+    import dbench  # sibling program: one row/key format, one gate
+
+    P = args.shards[0]
+    if "f64" in args.matrix_dtypes and not jax.config.read("jax_enable_x64"):
+        jax.config.update("jax_enable_x64", True)
+    mesh = sp.make_fft_mesh(P)
+    pu = ProcessingUnit.GPU if args.engine == "mxu" else ProcessingUnit.HOST
+    rows = []
+    for dim in args.matrix_dims:
+        for sparsity in args.matrix_sparsity:
+            for ttype in args.matrix_types:
+                radius = sp.spherical_radius_for_fraction(sparsity)
+                trip = sp.create_spherical_cutoff_triplets(
+                    dim, dim, dim, min(radius, 1.0),
+                    hermitian_symmetry=ttype == "r2c",
+                )
+                for dt in args.matrix_dtypes:
+                    for disc in ("BUFFERED", "UNBUFFERED"):
+                        t = DistributedTransform(
+                            pu,
+                            TransformType.R2C if ttype == "r2c"
+                            else TransformType.C2C,
+                            dim, dim, dim,
+                            np.asarray(trip).copy(),
+                            mesh=mesh,
+                            dtype=np.float64 if dt == "f64" else np.float32,
+                            engine=args.engine,
+                            exchange_type=ExchangeType[disc],
+                        )
+                        row = dbench.measure_row(t, args, scaling="matrix")
+                        rows.append(row)
+                        print(
+                            f"{dim:4d}^3 nnz={row['nnz_fraction']:.3f} "
+                            f"{ttype} {dt} {disc:10s} "
+                            f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
+                            f"{row['gflops']:8.2f} GFLOP/s "
+                            f"exch {row['exchange_fraction'] * 100:5.1f}%"
+                        )
+    doc = {
+        "schema": perf.SCALING_SCHEMA,
+        "config": vars(args),
+        "platform": str(mesh.devices.flat[0].platform),
+        "rows": rows,
+    }
+    missing = perf.validate_scaling_doc(doc)
+    if args.json:
+        Path(args.json).write_text(json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {len(rows)} matrix rows to {args.json}")
+    if missing:
+        print(f"matrix doc INCOMPLETE, missing: {missing}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None):
@@ -53,6 +138,19 @@ def main(argv=None):
         "--policy", default="default", choices=["default", "tuned"],
         help="resolver measured for the extra DEFAULT row (see module doc)",
     )
+    ap.add_argument("--matrix", action="store_true",
+                    help="measure the scenario matrix instead of the "
+                    "per-shard-count discipline sweep (see module doc)")
+    ap.add_argument("--matrix-dims", type=int, nargs="+", default=[16, 32])
+    ap.add_argument("--matrix-sparsity", type=float, nargs="+",
+                    default=[0.05, 0.6], help="nnz-fraction extremes")
+    ap.add_argument("--matrix-types", nargs="+", default=["c2c", "r2c"],
+                    choices=["c2c", "r2c"])
+    ap.add_argument("--matrix-dtypes", nargs="+", default=["f32", "f64"],
+                    choices=["f32", "f64"])
+    ap.add_argument("--chain", type=int, default=2,
+                    help="chained roundtrips per dispatch (matrix mode)")
+    ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
@@ -79,6 +177,9 @@ def main(argv=None):
         TransformType,
     )
     from spfft_tpu.parameters import distribute_triplets
+
+    if args.matrix:
+        return run_matrix(args)
 
     dim = args.dim
     rng = np.random.default_rng(0)

@@ -261,6 +261,7 @@ def index_page() -> str:
         - [Multi-transforms](multi_transform.md)
         - [Index helpers and mesh utilities](utilities.md)
         - [Observability: plan cards, metrics, execution trace](obs.md)
+        - [Performance reports and the scaling bench](perf.md)
         - [Autotuning and wisdom](tuning.md)
         - [Fault injection, guard mode and degradation](faults.md)
         - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
@@ -325,6 +326,28 @@ def obs_page() -> str:
         ],
     )
     return metrics + "\n" + tracing
+
+
+def perf_page() -> str:
+    """The performance page: the `spfft_tpu.obs.perf` surface (measurement
+    discipline, stage attribution, report/scaling-doc schemas)."""
+    from spfft_tpu.obs import perf
+
+    return class_page(
+        "Performance reports (`spfft_tpu.obs.perf`)",
+        doc(perf),
+        [],
+        [
+            perf.measure_pair_seconds,
+            perf.perf_report,
+            perf.stage_model,
+            perf.fft_pass_flops,
+            perf.dense_pair_flops,
+            perf.flop_per_byte,
+            perf.validate_perf_report,
+            perf.validate_scaling_doc,
+        ],
+    )
 
 
 def verify_page() -> str:
@@ -416,6 +439,7 @@ def generate(outdir: Path) -> None:
             ],
         ),
         "obs.md": obs_page(),
+        "perf.md": perf_page(),
         "tuning.md": class_page(
             "Tuning",
             doc(tuning),

@@ -28,7 +28,14 @@ kept flagging are enforced here with the stdlib ast module:
    vocabulary matches the ``CHECK_FNS`` implementation registry exactly
    (every registered check implemented, every implementation registered)
    and every check is documented in docs/details.md — the ABFT layer's
-   instance of the same both-ways contract.
+   instance of the same both-ways contract,
+8. perf-stage consistency — the perf layer's ``MODELED_STAGES``
+   (``spfft_tpu/obs/perf.py``) matches the engine-pipeline subset of
+   ``obs.STAGES`` exactly both ways: every modeled stage is canonical and
+   appears in an engine pipeline, and every engine-pipeline stage carries a
+   flop/byte model — so perf reports can never emit or omit a stage the
+   engines disagree about (the tuning-only trial phases are exempt: they
+   are harness stages, not pipeline stages).
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -220,19 +227,42 @@ def _canonical_stages() -> tuple:
     raise AssertionError(f"no STAGES assignment in {STAGES_FILE}")
 
 
+def _pipeline_strings(tree) -> set:
+    """String constants of an engine/tuning file, EXCLUDING those inside the
+    ``stage_accounting`` perf hooks: the hooks restate every stage name for
+    the flop/byte model, so counting them would let the coverage directions
+    satisfy themselves — a stage deleted from every ``named_scope`` would
+    still look 'used' because its accounting row names it."""
+    skip: set = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "stage_accounting"
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    return {
+        node.value
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and id(node) not in skip
+    }
+
+
 def check_stage_scopes(findings: list):
     stages = _canonical_stages()
     if len(set(stages)) != len(stages):
         findings.append(f"{STAGES_FILE}: duplicate entries in STAGES")
     used: dict = {}  # literal named_scope labels -> first file:line
-    strings: set = set()  # every string constant in engine files (covers
-    # labels selected dynamically, e.g. _y_stage_scope's variants)
+    strings: set = set()  # pipeline string constants in engine files (covers
+    # labels selected dynamically, e.g. _y_stage_scope's variants; the
+    # stage_accounting hooks are excluded — see _pipeline_strings)
     for rel in ENGINE_FILES + TUNING_FILES:
         path = ROOT / rel
         tree = ast.parse(path.read_text())
+        strings |= _pipeline_strings(tree)
         for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                strings.add(node.value)
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -453,6 +483,56 @@ def check_verify_checks(findings: list):
             )
 
 
+# The perf layer's modeled-stage vocabulary (spfft_tpu/obs/perf.py
+# MODELED_STAGES): must equal the engine-pipeline subset of STAGES exactly —
+# both ways, like every other vocabulary here. Tuning-only stages (threaded
+# through TUNING_FILES, never an engine pipeline) are exempt.
+PERF_FILE = "spfft_tpu/obs/perf.py"
+
+
+def _canonical_modeled_stages() -> tuple:
+    """MODELED_STAGES from obs/perf.py via ast (import-free, like STAGES)."""
+    tree = ast.parse((ROOT / PERF_FILE).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "MODELED_STAGES"
+            for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise AssertionError(f"no MODELED_STAGES assignment in {PERF_FILE}")
+
+
+def check_perf_stages(findings: list):
+    stages = _canonical_stages()
+    modeled = _canonical_modeled_stages()
+    if len(set(modeled)) != len(modeled):
+        findings.append(f"{PERF_FILE}: duplicate entries in MODELED_STAGES")
+    engine_strings: set = set()
+    for rel in ENGINE_FILES:
+        # accounting hooks excluded (_pipeline_strings): membership here must
+        # mean "the compiled pipeline tags this stage", not "the perf model
+        # mentions it" — otherwise this check could never catch drift
+        engine_strings |= _pipeline_strings(ast.parse((ROOT / rel).read_text()))
+    engine_stages = [s for s in stages if s in engine_strings]
+    for name in modeled:
+        if name not in stages:
+            findings.append(
+                f"{PERF_FILE}: modeled stage {name!r} is not in the canonical "
+                f"stage list ({STAGES_FILE})"
+            )
+        elif name not in engine_stages:
+            findings.append(
+                f"{PERF_FILE}: modeled stage {name!r} appears in no engine "
+                f"pipeline ({', '.join(ENGINE_FILES)})"
+            )
+    for name in engine_stages:
+        if name not in modeled:
+            findings.append(
+                f"{STAGES_FILE}: engine stage {name!r} carries no flop/byte "
+                f"model in {PERF_FILE} (MODELED_STAGES)"
+            )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -464,6 +544,7 @@ def main() -> int:
     check_fault_sites(findings)
     check_trace_events(findings)
     check_verify_checks(findings)
+    check_perf_stages(findings)
     for f in findings:
         print(f)
     if findings:

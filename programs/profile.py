@@ -9,6 +9,14 @@ exchange tags — ``programs/lint.py`` enforces the list both ways), so a
 captured trace reads like the reference's timing output, but with XLA fusion
 boundaries and DMA activity visible.
 
+Timing rides the ONE shared discipline (``spfft_tpu.obs.perf``): warmup +
+best-of-R fenced chained roundtrips (``measure_pair_seconds`` — the same
+rules as ``tuning/runner.py``, ``bench.py`` and ``programs/dbench.py``), and
+the per-stage breakdown printed below is the perf layer's attributed report
+(``perf_report``, schema ``spfft_tpu.obs.perf/1``) — not a second ad-hoc
+stage-timer path. The host timing tree (layer 1) still prints as the
+portable fallback.
+
 Usage:
     python programs/profile.py -d 128 128 128 -s 0.15 --engine mxu -r 5 \
         -o /tmp/spfft_trace
@@ -18,11 +26,13 @@ Profile tab) or open the per-run `*.trace.json.gz` under
 `<outdir>/plugins/profile/` in Perfetto (ui.perfetto.dev). On backends where
 device trace collection is unsupported (e.g. tunneled devices), the capture
 degrades to host-side python/XLA events — the host timing tree
-(spfft_tpu.timing) stays the portable fallback and is printed either way.
+(spfft_tpu.timing) and the attributed perf report stay the portable fallback
+and are printed either way.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -37,6 +47,10 @@ def main(argv=None):
                     metavar=("X", "Y", "Z"))
     ap.add_argument("-s", type=float, default=0.15, help="nonzero fraction")
     ap.add_argument("-r", type=int, default=5, help="traced roundtrips")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timed best-of repeats (perf report)")
+    ap.add_argument("--chain", type=int, default=2,
+                    help="chained roundtrips per timed dispatch")
     ap.add_argument("--engine", default="auto", choices=["auto", "xla", "mxu"])
     ap.add_argument("-o", default="/tmp/spfft_trace", help="trace output dir")
     args = ap.parse_args(argv)
@@ -46,7 +60,7 @@ def main(argv=None):
 
     import jax
     import spfft_tpu as sp
-    from spfft_tpu import ProcessingUnit, ScalingType, TransformType, timing
+    from spfft_tpu import ProcessingUnit, ScalingType, TransformType, obs, timing
 
     timing.enable()
     dx, dy, dz = args.d
@@ -59,10 +73,24 @@ def main(argv=None):
             ProcessingUnit.GPU, TransformType.C2C, dx, dy, dz,
             indices=trip, dtype=np.float32, engine=args.engine,
         )
+
+    # The shared timing discipline (module docstring): warmup absorbs
+    # compilation, best-of-R fenced chained roundtrips, then the measured
+    # pair time attributed over the canonical stages.
+    measured = obs.perf.measure_pair_seconds(
+        t, chain=args.chain, repeats=args.repeats
+    )
+    report = obs.perf.perf_report(
+        t, measured["seconds_per_pair"], repeats=measured["repeats"]
+    )
+
     rng = np.random.default_rng(0)
     values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
 
-    # warm-up: compile outside the trace so the trace shows steady-state steps
+    # warm-up the jitted backward/forward entry points OUTSIDE the capture:
+    # measure_pair_seconds compiled its own scan-chained program, not these,
+    # so without this the first traced roundtrip would record compilation
+    # instead of steady-state steps
     with timing.scoped("warmup"):
         t.backward(values)
         t.forward(scaling=ScalingType.FULL)
@@ -73,7 +101,7 @@ def main(argv=None):
         capture = True
     except Exception as e:  # tunneled/experimental backends may refuse capture
         print(f"device trace capture unavailable on this backend: {e}")
-        print("host timing tree below is the fallback.")
+        print("host timing tree + perf report below are the fallback.")
         capture = False
     try:
         with timing.scoped("traced roundtrips"):
@@ -91,6 +119,16 @@ def main(argv=None):
             # the canonical scope vocabulary to search for in the trace
             print(f"  stage scopes (spfft_tpu.obs.STAGES): {', '.join(sp.obs.STAGES)}")
 
+    print()
+    print(f"perf report (spfft_tpu.obs.perf/1, best of {args.repeats} x "
+          f"chain {measured['chain']}): "
+          f"{report['seconds_per_pair'] * 1e3:.3f} ms/pair, "
+          f"{report['gflops']:.2f} GFLOP/s")
+    for row in report["stages"]:
+        print(f"  {row['stage']:<22s} {row['seconds'] * 1e6:12.1f} us "
+              f"{row['fraction'] * 100:6.2f}%  "
+              f"{row['gflops']:10.2f} GFLOP/s {row['gbps']:8.2f} GB/s")
+    print(json.dumps(report))
     print()
     print(timing.process())
 

@@ -82,6 +82,45 @@ class ExecutionBase:
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
+    def stage_accounting(self) -> list:
+        """Analytic per-stage flop/byte rows for one backward+forward pair —
+        the :mod:`spfft_tpu.obs.perf` hook for the single-device engines
+        (stage names from ``obs.STAGES``; same contract as the distributed
+        engines' ``PaddingHelpers.stage_accounting``). The common head/tail
+        rows come from the perf layer's shared builders
+        (``pipeline_head_rows``/``pipeline_tail_rows``); this hook supplies
+        only what the local pipelines add — the dense-y path's
+        ``expand``/``pack`` stick<->slab relayout rows (the sparse-y MXU
+        variants contract straight from sticks and carry neither)."""
+        from .obs.perf import pipeline_head_rows, pipeline_tail_rows
+
+        p = self.params
+        Z, Y, X, Xf = p.dim_z, p.dim_y, p.dim_x, p.dim_x_freq
+        c_item = 2 * self.real_dtype.itemsize
+        S = int(p.num_sticks)
+        x_active = int(getattr(self, "_num_x_active", Xf) or Xf)
+        grid_elems = Z * Y * x_active
+        rows = pipeline_head_rows(
+            int(p.num_values), S, Z, c_item,
+            # the fill is a no-op without a (0,0) stick (MXU skips the scope
+            # entirely) — no stage row for work the pipeline does not do
+            stick_symmetry=self.is_r2c and self._zero_stick_id is not None,
+        )
+        y_scope = getattr(self, "_y_stage_scope", lambda: "y transform")()
+        if y_scope == "y transform":
+            # dense path: stick -> slab relayout (backward "expand", forward
+            # "pack"), each reading the sticks and writing the dense grid
+            rows.append(
+                {"stage": "expand", "flops": 0, "bytes": (S * Z + grid_elems) * c_item}
+            )
+            rows.append(
+                {"stage": "pack", "flops": 0, "bytes": (S * Z + grid_elems) * c_item}
+            )
+        return rows + pipeline_tail_rows(
+            Z, Y, X, Z * x_active, c_item,
+            plane_symmetry=self.is_r2c, y_scope=y_scope,
+        )
+
     @staticmethod
     def _stage_rows(nbytes: int, dim0: int):
         """Leading-axis rows per staging chunk, or None for one-shot transfer.

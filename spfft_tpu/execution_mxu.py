@@ -228,6 +228,17 @@ class MxuLocalExecution(ExecutionBase):
 
     # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
 
+    def _y_stage_scope(self) -> str:
+        """The canonical named-scope label of the engaged y-DFT variant
+        (obs.STAGES) — the perf layer's ``stage_accounting`` keys the dense
+        relayout rows and the y-pass label off it (same rule as the
+        distributed MXU engine's helper)."""
+        if self._sparse_y:
+            return "y transform sparse"
+        if self._sparse_y_blocked is not None:
+            return "y transform blocked"
+        return "y transform"
+
     def describe(self) -> dict:
         """Engine fragment of the plan card (obs.plancard): the MXU engine's
         measured decisions — active-x compaction, the engaged sparse-y variant

@@ -1,6 +1,6 @@
 """spfft_tpu.obs — unified metrics, plan introspection, and execution tracing.
 
-Four observability layers, coarse to fine (docs/details.md "Observability"):
+Five observability layers, coarse to fine (docs/details.md "Observability"):
 
 1. **Host timing tree** (:mod:`spfft_tpu.timing`): rt_graph-parity nested wall
    -clock statistics of the host-visible phases (init, staging, dispatch,
@@ -26,8 +26,16 @@ Four observability layers, coarse to fine (docs/details.md "Observability"):
    attribution inside the compiled programs, tagged with the canonical
    :data:`STAGES` scope names every engine uses (``programs/lint.py`` enforces
    the list both ways).
+5. **Performance reports** (:mod:`spfft_tpu.obs.perf`): measured, fenced
+   seconds-per-pair attributed to the same :data:`STAGES` vocabulary via
+   analytic flop/byte models — per-stage GFLOP/s, GB/s and the
+   ``exchange_fraction`` scoreboard, schema-pinned
+   (:func:`perf.validate_perf_report`) and run-ID-joined like everything
+   above. Surfaces: ``programs/dbench.py`` (multichip scaling rows),
+   ``programs/perf_gate.py`` + ``./ci.sh perf`` (regression gate),
+   ``bench.py`` (embedded report).
 """
-from . import trace  # noqa: F401
+from . import perf, trace  # noqa: F401
 from .registry import (  # noqa: F401
     HISTOGRAM_BUCKETS,
     METRICS_ENV,

@@ -1,0 +1,487 @@
+"""Performance reports: fenced device time attributed to pipeline stages.
+
+The FIFTH observability layer (docs/details.md "Observability"): the timing
+tree measures what the host paid, plan cards record what the plan decided, the
+metrics registry counts what ran, the flight recorder logs what happened —
+none of them says how *fast* the device pipeline was, or where the time went.
+This module does: a **performance report** (schema :data:`PERF_SCHEMA`,
+:func:`validate_perf_report`) joins the existing run-ID key and attributes one
+measured, *fenced* seconds-per-pair figure to the canonical
+:data:`spfft_tpu.obs.STAGES` vocabulary.
+
+**Measurement** (:func:`measure_pair_seconds`): the one timing discipline
+every harness in this repo shares — warmup dispatches absorb compilation
+(``tuning/runner.py``), then best-of-R timed backward+forward roundtrips,
+chained inside a single jitted ``lax.scan`` so per-call dispatch latency is
+amortized instead of billed to every pair (``bench.py``'s chained-roundtrip
+trick), fenced with the platform-correct completion fence
+(:mod:`spfft_tpu.sync`).
+
+**Attribution**: under XLA the whole pipeline is one compiled program, so
+per-stage wall time is not separately measurable from the host. The report
+therefore distributes the measured total over the stages by an **analytic
+cost model** — the standard ``5 * N * log2(N)`` flops per 1-D FFT pass
+(sparse-aware: the z pass runs only on active sticks) and exact byte counts
+for the data-movement stages, with exchange bytes taken from the same
+stick/slab geometry accounting the plan card embeds
+(``exchange_wire_bytes``). Flops and bytes combine through one machine
+balance — :data:`DEFAULT_FLOP_PER_BYTE` flops per byte, override with
+``SPFFT_TPU_PERF_FLOP_PER_BYTE`` — and the report records the method and the
+balance used (``attribution``), so consumers know these per-stage seconds are
+*model-apportioned measurements*, not independent timings. Stage seconds sum
+to the measured wall time by construction.
+
+**The scoreboard numbers**: ``gflops`` (the dense ``5 N log2 N`` model over
+measured seconds — directly comparable to ``bench.py``'s headline and the
+BENCH_r0x trajectory), per-stage GFLOP/s and GB/s, and ``exchange_fraction``
+— the share of a pair attributed to the exchange stages
+(``exchange``/``exchange A``/``exchange B``). That fraction bounds what
+communication/compute overlap can win, which makes it the scoreboard for the
+planned exchange-overlap work (ROADMAP item 1).
+
+Every report also lands in the run registry (``perf_pair_seconds``,
+``perf_stage_seconds`` histograms, ``perf_gflops`` / ``perf_exchange_fraction``
+gauges) and emits a ``perf`` trace instant under the plan's run ID, so perf
+rows join cards, metrics and traces on one key.
+
+Surfaces: ``programs/dbench.py`` (multichip strong/weak scaling rows),
+``programs/perf_gate.py`` (+ ``./ci.sh perf``) regression gate,
+``programs/profile.py``, ``bench.py`` (embeds a report per capture).
+"""
+from __future__ import annotations
+
+import math
+import os
+
+from . import trace
+from .registry import gauge, histogram
+from .stages import STAGES
+
+PERF_SCHEMA = "spfft_tpu.obs.perf/1"
+SCALING_SCHEMA = "spfft_tpu.obs.perf.scaling/1"
+FLOP_PER_BYTE_ENV = "SPFFT_TPU_PERF_FLOP_PER_BYTE"
+
+# Machine balance used to mix flop-weighted compute stages and byte-weighted
+# movement stages into one attribution scale: flops that cost the same time
+# as moving one byte. The default comes from the same ICI-class numbers as
+# parallel/policy.round_cost_bytes (hundreds of GFLOP/s against ~100 GB/s).
+DEFAULT_FLOP_PER_BYTE = 8.0
+
+# The pipeline-stage vocabulary the perf model covers: exactly the engine
+# stages of obs.STAGES (the autotuner's "tune warmup"/"tune trial" phases are
+# trial harness stages, not pipeline stages, and carry no flop/byte model).
+# Pure literal tuple — programs/lint.py enforces it both ways against STAGES
+# (every modeled stage canonical, every engine stage modeled).
+MODELED_STAGES = (
+    "compression",
+    "stick symmetry",
+    "plane symmetry",
+    "z transform",
+    "y transform",
+    "y transform sparse",
+    "y transform blocked",
+    "x transform",
+    "expand",
+    "pack",
+    "exchange",
+    "unpack",
+    "pack A",
+    "exchange A",
+    "unpack A",
+    "pack B",
+    "exchange B",
+    "unpack B",
+)
+
+# The stages whose attributed seconds make up ``exchange_fraction`` — the
+# interconnect collectives, not their local pack/unpack bookends.
+EXCHANGE_STAGES = ("exchange", "exchange A", "exchange B")
+
+REQUIRED_KEYS = (
+    "schema",
+    # the plan's construction run ID (spfft_tpu.obs.trace): perf rows join
+    # plan cards, metrics windows and flight-recorder events on this key
+    "run_id",
+    "kind",
+    "engine",
+    "decomposition",
+    "transform_type",
+    "dims",
+    "num_elements",
+    "nnz_fraction",
+    "dtype",
+    "device_count",
+    "mesh",
+    "exchange_discipline",
+    "seconds_per_pair",
+    "repeats",
+    "gflops",
+    "model_gflops",
+    "dense_flops_per_pair",
+    "model_flops_per_pair",
+    "wire_bytes_per_pair",
+    "exchange_seconds",
+    "exchange_fraction",
+    "exchange_gbps",
+    "attribution",
+    "stages",
+)
+STAGE_KEYS = ("stage", "flops", "bytes", "seconds", "fraction", "gflops", "gbps")
+ATTRIBUTION_KEYS = ("method", "flop_per_byte")
+
+
+def flop_per_byte() -> float:
+    """The active flops-per-byte machine balance (env-overridable)."""
+    try:
+        return float(os.environ.get(FLOP_PER_BYTE_ENV, DEFAULT_FLOP_PER_BYTE))
+    except ValueError:
+        return DEFAULT_FLOP_PER_BYTE
+
+
+def fft_pass_flops(lines: int, length: int) -> int:
+    """Analytic flops of one 1-D FFT pass: ``5 * n * log2(n)`` per length-n
+    line (the standard FFT cost model every benchmark in this repo uses),
+    times the number of lines transformed. Zero for degenerate lengths."""
+    if length <= 1 or lines <= 0:
+        return 0
+    return int(round(5.0 * lines * length * math.log2(length)))
+
+
+def pipeline_head_rows(
+    total_values: int,
+    total_sticks: int,
+    dim_z: int,
+    c_item: int,
+    *,
+    stick_symmetry: bool,
+) -> list:
+    """Shared head of every engine's stage model — ``compression`` (packed
+    values <-> sticks), the optional (0,0)-stick hermitian fill, and the
+    sparse-aware z pass. One builder for all six engines so the common rows
+    cannot drift; each hook passes its own pipeline's guard for the
+    symmetry stage (the engines gate it differently)."""
+    rows = [
+        {
+            "stage": "compression",
+            "flops": 0,
+            "bytes": 2 * (total_values + total_sticks * dim_z) * c_item,
+        }
+    ]
+    if stick_symmetry:
+        rows.append(
+            {"stage": "stick symmetry", "flops": 0, "bytes": 2 * dim_z * c_item}
+        )
+    rows.append(
+        {
+            "stage": "z transform",
+            "flops": 2 * fft_pass_flops(total_sticks, dim_z),
+            "bytes": 0,
+        }
+    )
+    return rows
+
+
+def pipeline_tail_rows(
+    dim_z: int,
+    dim_y: int,
+    dim_x: int,
+    y_lines: int,
+    c_item: int,
+    *,
+    plane_symmetry: bool,
+    y_scope: str = "y transform",
+) -> list:
+    """Shared tail of every engine's stage model — the optional x=0 plane
+    hermitian fill, the y pass (label and line count supplied by the engine:
+    the sparse-y MXU variants carry their disambiguated scope and count only
+    active x columns), and the x pass. Counterpart of
+    :func:`pipeline_head_rows`."""
+    rows = []
+    if plane_symmetry:
+        rows.append(
+            {
+                "stage": "plane symmetry",
+                "flops": 0,
+                "bytes": 2 * dim_z * dim_y * c_item,
+            }
+        )
+    rows.append(
+        {"stage": y_scope, "flops": 2 * fft_pass_flops(y_lines, dim_y), "bytes": 0}
+    )
+    rows.append(
+        {
+            "stage": "x transform",
+            "flops": 2 * fft_pass_flops(dim_z * dim_y, dim_x),
+            "bytes": 0,
+        }
+    )
+    return rows
+
+
+def dense_pair_flops(dims) -> int:
+    """The dense-model flops of one backward+forward pair over the full
+    grid: ``2 * 5 * N * log2(N)`` — the same figure ``bench.py`` divides by
+    wall time, so report GFLOP/s and the BENCH trajectory are comparable."""
+    n = 1
+    for d in dims:
+        n *= int(d)
+    if n <= 1:
+        return 0
+    return int(round(2 * 5.0 * n * math.log2(n)))
+
+
+def _attribute(rows: list, seconds: float, balance: float) -> list:
+    """Distribute ``seconds`` over the stage rows by model weight
+    (``flops + bytes * balance``); equal split when the model is all-zero.
+    The attributed stage seconds sum to ``seconds`` by construction."""
+    weights = [r["flops"] + r["bytes"] * balance for r in rows]
+    total_w = sum(weights)
+    out = []
+    for r, w in zip(rows, weights):
+        frac = (w / total_w) if total_w > 0 else (1.0 / len(rows) if rows else 0.0)
+        sec = seconds * frac
+        out.append(
+            {
+                "stage": r["stage"],
+                "flops": int(r["flops"]),
+                "bytes": int(r["bytes"]),
+                "seconds": sec,
+                "fraction": frac,
+                "gflops": (r["flops"] / sec / 1e9) if sec > 0 else 0.0,
+                "gbps": (r["bytes"] / sec / 1e9) if sec > 0 else 0.0,
+            }
+        )
+    return out
+
+
+def _merge_rows(rows: list) -> list:
+    """Aggregate duplicate stage names (an engine hook may emit a stage once
+    per direction) into one row each, preserving first-seen order."""
+    order, table = [], {}
+    for r in rows:
+        name = r["stage"]
+        if name not in table:
+            table[name] = {"stage": name, "flops": 0, "bytes": 0}
+            order.append(name)
+        table[name]["flops"] += int(r.get("flops", 0))
+        table[name]["bytes"] += int(r.get("bytes", 0))
+    return [table[n] for n in order]
+
+
+def stage_model(transform) -> list:
+    """The analytic per-stage flop/byte model of one backward+forward pair
+    for ``transform``'s actual pipeline — the engine's ``stage_accounting()``
+    hook (every engine implements it; exchange bytes come from the same
+    geometry accounting the plan card embeds), duplicate stages merged and
+    names checked against :data:`MODELED_STAGES`."""
+    rows = _merge_rows(transform._exec.stage_accounting())
+    for r in rows:
+        if r["stage"] not in MODELED_STAGES:
+            raise AssertionError(
+                f"engine stage_accounting emitted unmodeled stage {r['stage']!r}"
+            )
+    return rows
+
+
+def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dict:
+    """Build the performance report for one measured ``transform`` pair.
+
+    ``seconds`` is the measured, fenced wall time of one backward+forward
+    pair (see :func:`measure_pair_seconds`); ``repeats`` records how many
+    timed repetitions the best-of came from. The report validates against
+    :func:`validate_perf_report`, feeds the run registry, and emits a
+    ``perf`` trace instant under the plan's run ID."""
+    seconds = float(seconds)
+    rows = _attribute(stage_model(transform), seconds, flop_per_byte())
+    dims = [int(transform.dim_x), int(transform.dim_y), int(transform.dim_z)]
+    distributed = getattr(transform, "_mesh", None) is not None
+    if distributed:
+        mesh = transform.mesh
+        mesh_card = {
+            str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        }
+        device_count = int(transform.num_shards)
+        decomposition = (
+            "pencil2" if transform._engine.startswith("pencil2") else "slab"
+        )
+        discipline = transform.exchange_type.name
+        wire_bytes = 2 * int(transform.exchange_wire_bytes())  # fwd + bwd
+        num_elements = int(transform.num_global_elements)
+    else:
+        mesh_card = None
+        device_count = 1
+        decomposition = "local"
+        discipline = None
+        wire_bytes = 0
+        num_elements = int(transform.num_local_elements)
+    model_flops = sum(r["flops"] for r in rows)
+    dense_flops = dense_pair_flops(dims)
+    exchange_seconds = sum(
+        r["seconds"] for r in rows if r["stage"] in EXCHANGE_STAGES
+    )
+    report = {
+        "schema": PERF_SCHEMA,
+        "run_id": getattr(transform, "_run_id", None),
+        "kind": "distributed" if distributed else "local",
+        "engine": transform._engine,
+        "decomposition": decomposition,
+        "transform_type": transform.transform_type.name,
+        "dims": dims,
+        "num_elements": num_elements,
+        "nnz_fraction": num_elements / float(transform.global_size),
+        "dtype": str(transform.dtype),
+        "device_count": device_count,
+        "mesh": mesh_card,
+        "exchange_discipline": discipline,
+        "seconds_per_pair": seconds,
+        "repeats": repeats,
+        "gflops": (dense_flops / seconds / 1e9) if seconds > 0 else 0.0,
+        "model_gflops": (model_flops / seconds / 1e9) if seconds > 0 else 0.0,
+        "dense_flops_per_pair": dense_flops,
+        "model_flops_per_pair": int(model_flops),
+        "wire_bytes_per_pair": wire_bytes,
+        "exchange_seconds": exchange_seconds,
+        "exchange_fraction": (exchange_seconds / seconds) if seconds > 0 else 0.0,
+        "exchange_gbps": (
+            wire_bytes / exchange_seconds / 1e9 if exchange_seconds > 0 else 0.0
+        ),
+        "attribution": {"method": "analytic", "flop_per_byte": flop_per_byte()},
+        "stages": rows,
+    }
+    _record(report)
+    return report
+
+
+def _record(report: dict) -> None:
+    """Feed the run registry + flight recorder from a finished report."""
+    labels = {
+        "engine": report["engine"],
+        "decomposition": report["decomposition"],
+    }
+    histogram("perf_pair_seconds", **labels).observe(report["seconds_per_pair"])
+    gauge("perf_gflops", **labels).set(report["gflops"])
+    gauge("perf_exchange_fraction", **labels).set(report["exchange_fraction"])
+    for row in report["stages"]:
+        histogram("perf_stage_seconds", stage=row["stage"]).observe(
+            row["seconds"]
+        )
+    with trace.with_run(report["run_id"]):
+        trace.event(
+            "perf",
+            gflops=round(report["gflops"], 3),
+            exchange_fraction=round(report["exchange_fraction"], 4),
+            devices=report["device_count"],
+            decomposition=report["decomposition"],
+        )
+
+
+def measure_pair_seconds(
+    transform, *, chain: int = 4, repeats: int = 3, warmup: int = 1
+) -> dict:
+    """Measure one fenced backward+forward pair on ``transform``.
+
+    The shared timing discipline (module docstring): random frequency inputs
+    of the plan's exact shape staged on device (host staging is not billed —
+    ``tuning/runner.py``'s rule), ``chain`` dependent roundtrips inside one
+    jitted ``lax.scan`` (FULL scaling makes each C2C pair the identity, so
+    the chain is exact; dispatch latency is amortized over the chain —
+    ``bench.py``'s trick), ``warmup`` untimed chain calls absorbing
+    compilation, then best-of-``repeats`` timed calls, each fenced with the
+    platform-correct completion fence before the clock stops.
+
+    Returns ``{"seconds_per_pair", "rep_seconds", "chain", "repeats",
+    "roundtrip_residual"}`` — ``rep_seconds`` is the full per-repeat list
+    (per pair), so consumers can derive a noise estimate
+    (``programs/perf_gate.py``'s noise-aware threshold); the residual is the
+    C2C chain-identity check (None for R2C, whose roundtrip projects onto
+    hermitian-consistent spectra rather than reproducing arbitrary input).
+    """
+    import time
+
+    import jax
+    import numpy as np
+
+    from ..sync import fence
+    from ..tuning.runner import _stage_inputs
+    from ..types import ScalingType, TransformType
+
+    chain = max(1, int(chain))
+    repeats = max(1, int(repeats))
+    ex = transform._exec
+    staged = _stage_inputs(transform)
+    phase = getattr(ex, "phase_operands", ())
+    is_r2c = transform.transform_type == TransformType.R2C
+
+    def roundtrip(re, im, ph):
+        space = ex.trace_backward(re, im, phase=ph)
+        sre, sim = (space, None) if is_r2c else space
+        return ex.trace_forward(sre, sim, ScalingType.FULL, phase=ph)
+
+    def chain_fn(re, im, ph):
+        def body(carry, _):
+            return roundtrip(*carry, ph), None
+
+        out, _ = jax.lax.scan(body, (re, im), None, length=chain)
+        return out
+
+    step = jax.jit(chain_fn)
+    for _ in range(max(0, int(warmup))):
+        fence(step(*staged, phase))
+    rep_seconds = []
+    out = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = step(*staged, phase)
+        fence(out)
+        rep_seconds.append((time.perf_counter() - t0) / chain)
+    residual = None
+    if not is_r2c:
+        # FULL-scaled C2C roundtrips are the identity; a diverged chain means
+        # the measurement ran a broken pipeline and must not become a row
+        got = np.asarray(out[0]).reshape(-1)[:64]
+        want = np.asarray(staged[0]).reshape(-1)[:64]
+        residual = float(np.abs(got - want).max())
+    return {
+        "seconds_per_pair": min(rep_seconds),
+        "rep_seconds": rep_seconds,
+        "chain": chain,
+        "repeats": repeats,
+        "roundtrip_residual": residual,
+    }
+
+
+def validate_perf_report(report: dict) -> list:
+    """Missing/malformed key paths of a perf report ([] when valid) — the
+    schema pin, same contract as ``obs.validate_plan_card`` /
+    ``trace.validate_trace``. Stage names must come from the canonical
+    ``obs.STAGES`` vocabulary."""
+    missing = [k for k in REQUIRED_KEYS if k not in report]
+    if report.get("schema") not in (None, PERF_SCHEMA):
+        missing.append(f"schema (unknown: {report['schema']!r})")
+    att = report.get("attribution")
+    if isinstance(att, dict):
+        missing.extend(
+            f"attribution.{k}" for k in ATTRIBUTION_KEYS if k not in att
+        )
+    for i, row in enumerate(report.get("stages", ())):
+        missing.extend(f"stages[{i}].{k}" for k in STAGE_KEYS if k not in row)
+        name = row.get("stage")
+        if name not in STAGES:
+            missing.append(f"stages[{i}].stage (unknown: {name!r})")
+    return missing
+
+
+def validate_scaling_doc(doc: dict) -> list:
+    """Missing-key paths of a ``programs/dbench.py`` scaling document
+    (schema :data:`SCALING_SCHEMA`): header keys plus every row's perf-report
+    schema. [] when valid."""
+    missing = [k for k in ("schema", "config", "rows") if k not in doc]
+    if doc.get("schema") not in (None, SCALING_SCHEMA):
+        missing.append(f"schema (unknown: {doc['schema']!r})")
+    for i, row in enumerate(doc.get("rows", ())):
+        for k in ("key", "scaling", "seconds_noise"):
+            if k not in row:
+                missing.append(f"rows[{i}].{k}")
+        missing.extend(f"rows[{i}].{m}" for m in validate_perf_report(row))
+    return missing

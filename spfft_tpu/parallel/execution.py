@@ -182,6 +182,63 @@ class PaddingHelpers:
             return recv.astype(self.complex_dtype)
         return jax.lax.all_to_all(buffer, axes, split_axis=0, concat_axis=0, tiled=True)
 
+    def stage_accounting(self) -> list:
+        """Analytic per-stage flop/byte rows for one backward+forward pair —
+        the :mod:`spfft_tpu.obs.perf` hook (stage names from ``obs.STAGES``).
+
+        Flops follow the ``5 n log2 n`` 1-D-pass model (the z pass is
+        sparse-aware: only the plan's active sticks transform); bytes count
+        the complex elements each data-movement stage touches (read+write)
+        and, for the ``exchange`` stage, the same off-shard wire volume the
+        plan card embeds (:meth:`exchange_wire_bytes`) — so perf attribution
+        and the card's exchange accounting cannot diverge. The common
+        head/tail rows come from the perf layer's shared builders; this hook
+        supplies the slab exchange middle, discipline-aware: the padded path
+        carries ``pack``/``unpack`` rows, the ragged chains (whose
+        pack/unpack ride inside the collective steps) only the backward slab
+        ``unpack``."""
+        from ..obs.perf import pipeline_head_rows, pipeline_tail_rows
+
+        p = self.params
+        P = int(p.num_shards)
+        Z, Y, X, Xf = p.dim_z, p.dim_y, p.dim_x, p.dim_x_freq
+        c_item = 2 * self.real_dtype.itemsize
+        total_sticks = int(np.asarray(p.num_sticks_per_shard).sum())
+        grid_elems = Z * Y * Xf  # global slab (padding excluded: sum L == Z)
+        rows = pipeline_head_rows(
+            int(np.asarray(p.num_values_per_shard).sum()), total_sticks, Z,
+            c_item,
+            stick_symmetry=self.is_r2c and p.zero_stick_shard >= 0,
+        )
+        if P > 1:
+            if self._ragged is None:
+                buf = P * P * self._L * self._S  # padded buffers, all shards
+                ends = P * (self._S * Z + self._L * Y * Xf)  # stage endpoints
+                rows.append(
+                    {"stage": "pack", "flops": 0, "bytes": (2 * buf + ends) * c_item}
+                )
+                rows.append(
+                    {"stage": "unpack", "flops": 0, "bytes": (2 * buf + ends) * c_item}
+                )
+            else:
+                rows.append(
+                    {"stage": "unpack", "flops": 0, "bytes": grid_elems * c_item}
+                )
+            rows.append(
+                {
+                    "stage": "exchange",
+                    "flops": 0,
+                    # per pair (fwd + bwd volumes are equal)
+                    "bytes": 2 * self.exchange_wire_bytes(),
+                }
+            )
+        y_lines = Z * int(getattr(self, "_num_x_active", Xf) or Xf)
+        return rows + pipeline_tail_rows(
+            Z, Y, X, y_lines, c_item,
+            plane_symmetry=self.is_r2c,
+            y_scope=getattr(self, "_y_stage_scope", lambda: "y transform")(),
+        )
+
     def exchange_wire_bytes(self) -> int:
         """Off-shard bytes one slab<->pencil repartition puts on the
         interconnect (self-blocks excluded for all disciplines; per direction

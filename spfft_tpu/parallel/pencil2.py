@@ -462,10 +462,11 @@ class Pencil2Execution(PaddingHelpers):
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
 
-    def exchange_wire_bytes(self) -> int:
-        """Off-shard bytes per repartition pair (exchange A + exchange B).
-        Bytes only — the exact-counts chains add P-1 (A) and P1-1 (B)
-        sequential rounds (see parallel/ragged.py's LATENCY note)."""
+    def _exchange_elems(self) -> tuple:
+        """(exchange A, exchange B) off-shard complex-element volumes per
+        repartition — the single-sourced split behind
+        :meth:`exchange_wire_bytes` and the per-stage perf accounting
+        (:meth:`stage_accounting`), so the two can never disagree."""
         p = self.params
         if self._ragged2 is not None:
             # exchange A spans the whole mesh (its offwire_elems covers every
@@ -475,7 +476,62 @@ class Pencil2Execution(PaddingHelpers):
         else:
             a_elems = p.num_shards * (p.num_shards - 1) * self._SG * self._Lz
             b_elems = p.num_shards * (self.P1 - 1) * self._Lz * self._Ly * self._Ax
+        return int(a_elems), int(b_elems)
+
+    def exchange_wire_bytes(self) -> int:
+        """Off-shard bytes per repartition pair (exchange A + exchange B).
+        Bytes only — the exact-counts chains add P-1 (A) and P1-1 (B)
+        sequential rounds (see parallel/ragged.py's LATENCY note)."""
+        a_elems, b_elems = self._exchange_elems()
         return (a_elems + b_elems) * 2 * self._wire_scalar_bytes()
+
+    def stage_accounting(self) -> list:
+        """Analytic per-stage flop/byte rows for one backward+forward pair —
+        the :mod:`spfft_tpu.obs.perf` hook for the 2-D pencil engines (same
+        contract as ``PaddingHelpers.stage_accounting``). The two exchanges
+        carry distinct A/B rows whose byte volumes come from
+        :meth:`_exchange_elems` — the same single-sourced split as
+        :meth:`exchange_wire_bytes` — so the PR-7 overlap work can score the
+        stick->y-pencil and y-pencil->slab hops separately. The common
+        head/tail rows come from the perf layer's shared builders; this hook
+        supplies the A/B exchange middle."""
+        from ..obs.perf import pipeline_head_rows, pipeline_tail_rows
+
+        p = self.params
+        P = int(p.num_shards)
+        Z, Y, X, Xf = p.dim_z, p.dim_y, p.dim_x, p.dim_x_freq
+        c_item = 2 * self.real_dtype.itemsize
+        total_sticks = int(np.asarray(p.num_sticks_per_shard).sum())
+        a_elems, b_elems = self._exchange_elems()
+        wire_scalar = self._wire_scalar_bytes()
+        buf_a = P * P * self._SG * self._Lz  # A-block buffers, all shards
+        buf_b = P * self.P1 * self._Lz * self._Ly * self._Ax  # B buffers
+        rows = pipeline_head_rows(
+            int(np.asarray(p.num_values_per_shard).sum()), total_sticks, Z,
+            c_item,
+            stick_symmetry=self.is_r2c and p.zero_stick_shard >= 0,
+        )
+        for tag, buf, elems in (
+            ("A", buf_a, a_elems),
+            ("B", buf_b, b_elems),
+        ):
+            rows.append(
+                {"stage": f"pack {tag}", "flops": 0, "bytes": 2 * 2 * buf * c_item}
+            )
+            rows.append(
+                {
+                    "stage": f"exchange {tag}",
+                    "flops": 0,
+                    "bytes": 2 * elems * 2 * wire_scalar,  # pair; 2 scalars/elem
+                }
+            )
+            rows.append(
+                {"stage": f"unpack {tag}", "flops": 0, "bytes": 2 * 2 * buf * c_item}
+            )
+        return rows + pipeline_tail_rows(
+            Z, Y, X, Z * min(Xf, self._Ax * self.P1), c_item,
+            plane_symmetry=self.is_r2c,
+        )
 
     def exchange_rounds(self) -> int:
         """Sequential collective rounds per repartition pair (exchange A +

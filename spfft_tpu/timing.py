@@ -21,7 +21,7 @@ median, quartiles, min, max, percentage of the top-level total and of the parent
 shape the reference benchmark embeds in its report
 (reference: tests/programs/benchmark.cpp:283-289).
 
-This is layer 1 of the four observability layers (docs/details.md
+This is layer 1 of the five observability layers (docs/details.md
 "Observability"): the timing tree measures what the host *paid*;
 :mod:`spfft_tpu.obs` records what the plan *decided* (plan cards) and counts
 what ran (run-metrics registry, gated by ``SPFFT_TPU_METRICS`` with the same
@@ -30,7 +30,9 @@ recorder (:mod:`spfft_tpu.obs.trace`) keeps the per-execution event log —
 every :func:`scoped` phase below doubles as a run-ID-stamped trace span when
 tracing is armed, so the nested timing nodes appear as Chrome-trace duration
 slices instead of living in a separate report-only tree; ``jax.profiler``
-traces show what the device *executed*, stage-tagged via ``obs.STAGES``.
+traces show what the device *executed*, stage-tagged via ``obs.STAGES``;
+performance reports (:mod:`spfft_tpu.obs.perf`) say how *fast* it was,
+attributing fenced pair time to those same stages.
 """
 from __future__ import annotations
 

@@ -1,0 +1,250 @@
+"""spfft_tpu.obs.perf: schema, stage attribution, dbench CLI, regression gate.
+
+Runs entirely on the conftest's virtual 8-device CPU mesh — the perf layer's
+acceptance surface (ISSUE 6): 8-device slab AND pencil runs emit validating
+``spfft_tpu.obs.perf/1`` reports whose stage seconds sum to the measured
+wall time and whose exchange bytes match the plan geometry, and the
+regression gate trips on a doctored baseline.
+"""
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ProcessingUnit,
+    Transform,
+    TransformType,
+    obs,
+)
+from spfft_tpu.obs import perf
+
+PROGRAMS = Path(__file__).resolve().parent.parent / "programs"
+
+
+def load_program(name):
+    spec = importlib.util.spec_from_file_location(name, PROGRAMS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def clean_registries():
+    obs.clear()
+    yield
+    obs.clear()
+    obs.trace.disable()
+    obs.trace.clear()
+
+
+def small_triplets(dim=8, fraction=0.9, r2c=False):
+    radius = sp.spherical_radius_for_fraction(fraction)
+    return sp.create_spherical_cutoff_triplets(
+        dim, dim, dim, min(radius, 1.0), hermitian_symmetry=r2c
+    )
+
+
+def measured_report(t, **kw):
+    m = perf.measure_pair_seconds(t, chain=kw.pop("chain", 2), repeats=2)
+    return perf.perf_report(t, m["seconds_per_pair"], repeats=2), m
+
+
+# ---- report schema + attribution invariants ---------------------------------
+
+
+def test_local_report_validates_and_sums():
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+        indices=small_triplets(), dtype=np.float32,
+    )
+    report, measured = measured_report(t)
+    assert perf.validate_perf_report(report) == []
+    assert report["kind"] == "local"
+    assert report["device_count"] == 1
+    assert report["mesh"] is None
+    assert report["exchange_fraction"] == 0.0
+    assert report["wire_bytes_per_pair"] == 0
+    total = sum(row["seconds"] for row in report["stages"])
+    assert total == pytest.approx(report["seconds_per_pair"], rel=1e-9)
+    assert measured["roundtrip_residual"] < 1e-2
+    assert len(measured["rep_seconds"]) == 2
+    # the report joins the plan card on the run ID
+    assert report["run_id"] == t.report()["run_id"]
+
+
+@pytest.mark.parametrize("mesh_kind", ["slab", "pencil"])
+def test_8device_report_validates(mesh_kind):
+    trip = small_triplets()
+    mesh = sp.make_fft_mesh(8) if mesh_kind == "slab" else sp.make_fft_mesh2(2, 4)
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, trip,
+        mesh=mesh, dtype=np.float32, engine="xla",
+    )
+    report, measured = measured_report(t)
+    assert perf.validate_perf_report(report) == []
+    assert report["device_count"] == 8
+    assert report["decomposition"] == ("slab" if mesh_kind == "slab" else "pencil2")
+    # stage seconds sum ~= wall time (attribution is exact by construction)
+    total = sum(row["seconds"] for row in report["stages"])
+    assert total == pytest.approx(report["seconds_per_pair"], rel=1e-9)
+    # exchange bytes match the plan geometry (one pair = fwd + bwd)
+    assert report["wire_bytes_per_pair"] == 2 * t.exchange_wire_bytes()
+    stage_wire = sum(
+        row["bytes"]
+        for row in report["stages"]
+        if row["stage"] in perf.EXCHANGE_STAGES
+    )
+    assert stage_wire == report["wire_bytes_per_pair"]
+    assert 0.0 < report["exchange_fraction"] < 1.0
+    assert measured["roundtrip_residual"] < 1e-2
+    # every attributed stage is canonical
+    for row in report["stages"]:
+        assert row["stage"] in obs.STAGES
+    if mesh_kind == "pencil":
+        names = {row["stage"] for row in report["stages"]}
+        assert {"exchange A", "exchange B"} <= names
+
+
+def test_r2c_and_sparse_variants_stay_canonical():
+    trip = small_triplets(r2c=True)
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.R2C, 8, 8, 8, trip,
+        mesh=sp.make_fft_mesh(4), dtype=np.float32, engine="xla",
+    )
+    report, measured = measured_report(t)
+    assert perf.validate_perf_report(report) == []
+    names = {row["stage"] for row in report["stages"]}
+    assert "plane symmetry" in names
+    assert measured["roundtrip_residual"] is None  # R2C roundtrip projects
+    # sparse-y MXU local variant carries its disambiguated label
+    tm = Transform(
+        ProcessingUnit.GPU, TransformType.C2C, 8, 8, 8,
+        indices=small_triplets(fraction=0.3), dtype=np.float32, engine="mxu",
+    )
+    rows = perf.stage_model(tm)
+    y_rows = [r for r in rows if r["stage"].startswith("y transform")]
+    assert len(y_rows) == 1
+    assert y_rows[0]["stage"] == tm._exec._y_stage_scope()
+
+
+def test_modeled_stages_are_the_engine_subset():
+    assert set(perf.MODELED_STAGES) <= set(obs.STAGES)
+    assert set(obs.STAGES) - set(perf.MODELED_STAGES) == {
+        "tune warmup",
+        "tune trial",
+    }
+
+
+def test_report_feeds_registry_and_trace():
+    obs.trace.enable(capacity=256)
+    try:
+        t = Transform(
+            ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+            indices=small_triplets(), dtype=np.float32,
+        )
+        report, _ = measured_report(t)
+        snap = obs.snapshot()
+        assert any(
+            k.startswith("perf_pair_seconds") for k in snap["histograms"]
+        )
+        assert any(
+            k.startswith("perf_stage_seconds") for k in snap["histograms"]
+        )
+        assert any(k.startswith("perf_gflops") for k in snap["gauges"])
+        events = [
+            e for e in obs.trace.snapshot()["events"] if e["name"] == "perf"
+        ]
+        assert events and events[-1]["run"] == report["run_id"]
+    finally:
+        obs.trace.disable()
+
+
+def test_attribution_balance_env_knob(monkeypatch):
+    monkeypatch.setenv(perf.FLOP_PER_BYTE_ENV, "0")
+    t = DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, small_triplets(),
+        mesh=sp.make_fft_mesh(2), dtype=np.float32, engine="xla",
+    )
+    # balance 0: byte-only stages get zero weight -> zero attributed time
+    report = perf.perf_report(t, 1e-3)
+    assert report["attribution"]["flop_per_byte"] == 0.0
+    assert report["exchange_fraction"] == 0.0
+    monkeypatch.setenv(perf.FLOP_PER_BYTE_ENV, "1e9")
+    report = perf.perf_report(t, 1e-3)
+    # balance huge: movement dominates, exchange fraction becomes visible
+    assert report["exchange_fraction"] > 0.0
+
+
+# ---- dbench CLI --------------------------------------------------------------
+
+
+def test_dbench_cli_writes_validating_scaling_doc(tmp_path):
+    dbench = load_program("dbench")
+    out = tmp_path / "scaling.json"
+    rc = dbench.main([
+        "--devices", "2", "--dim", "8", "--sparsity", "0.9",
+        "--mesh", "slab", "--scaling", "strong", "--repeats", "1",
+        "--chain", "2", "--engine", "xla", "--cpu", "-o", str(out),
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert perf.validate_scaling_doc(doc) == []
+    (row,) = doc["rows"]
+    assert row["scaling"] == "strong"
+    assert row["device_count"] == 2
+    assert row["key"].startswith("strong:slab:P2:8x8x8:C2C:")
+    assert row["seconds_noise"] >= 0.0
+
+
+# ---- regression gate ---------------------------------------------------------
+
+
+def _doc(rows):
+    return {"schema": perf.SCALING_SCHEMA, "config": {}, "rows": rows}
+
+
+def _row(key, gflops, noise=0.0):
+    return {"key": key, "gflops": gflops, "seconds_noise": noise}
+
+
+def test_perf_gate_trips_on_doctored_baseline(tmp_path, capsys):
+    gate = load_program("perf_gate")
+    cur = tmp_path / "cur.json"
+    base = tmp_path / "base.json"
+    cur.write_text(json.dumps(_doc([_row("a", 1.0), _row("b", 2.0)])))
+    base.write_text(json.dumps(_doc([_row("a", 1.0), _row("b", 2.0)])))
+    assert gate.main([str(cur), str(base)]) == 0
+    # doctored baseline: the past claims 10x the throughput -> exit 3
+    base.write_text(json.dumps(_doc([_row("a", 10.0), _row("b", 20.0)])))
+    assert gate.main([str(cur), str(base)]) == 3
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_perf_gate_noise_widens_but_caps(tmp_path):
+    gate = load_program("perf_gate")
+    cur = tmp_path / "cur.json"
+    base = tmp_path / "base.json"
+    # 40% slower but the rows were measured 50% noisy: allowance widens, ok
+    cur.write_text(json.dumps(_doc([_row("a", 0.6, noise=0.25)])))
+    base.write_text(json.dumps(_doc([_row("a", 1.0, noise=0.25)])))
+    assert gate.main([str(cur), str(base), "--tolerance", "0.1"]) == 0
+    # noise cannot unbound the gate: even absurd recorded spread is capped,
+    # so a 10x slide still trips
+    cur.write_text(json.dumps(_doc([_row("a", 0.1, noise=5.0)])))
+    base.write_text(json.dumps(_doc([_row("a", 1.0, noise=5.0)])))
+    assert gate.main([str(cur), str(base), "--tolerance", "0.1"]) == 3
+
+
+def test_perf_gate_guards_empty_intersection(tmp_path):
+    gate = load_program("perf_gate")
+    cur = tmp_path / "cur.json"
+    base = tmp_path / "base.json"
+    cur.write_text(json.dumps(_doc([_row("a", 1.0)])))
+    base.write_text(json.dumps(_doc([_row("zzz", 1.0)])))
+    # zero matched rows must not pass vacuously
+    assert gate.main([str(cur), str(base)]) == 1

@@ -1,10 +1,12 @@
 """Smoke test for the profiler-capture recipe (programs/profile.py)."""
 import importlib.util
+import json
 from pathlib import Path
 
 
 def test_profile_cli_captures_trace(tmp_path, capsys):
     from spfft_tpu import timing
+    from spfft_tpu.obs import perf
 
     spec = importlib.util.spec_from_file_location(
         "profile_cli", Path(__file__).resolve().parent.parent / "programs" / "profile.py"
@@ -22,5 +24,17 @@ def test_profile_cli_captures_trace(tmp_path, capsys):
     # host timing tree always prints; the reference stage scopes must appear
     assert "traced roundtrips" in printed
     assert "backward" in printed and "forward" in printed
+    # the per-stage breakdown is the perf layer's attributed report (one
+    # timing discipline — no ad-hoc stage timers), emitted as a JSON line
+    # that validates against the spfft_tpu.obs.perf/1 schema
+    report = next(
+        json.loads(line)
+        for line in printed.splitlines()
+        if line.startswith("{") and '"spfft_tpu.obs.perf/1"' in line
+    )
+    assert perf.validate_perf_report(report) == []
+    assert report["device_count"] == 1
+    total = sum(row["seconds"] for row in report["stages"])
+    assert abs(total - report["seconds_per_pair"]) < 1e-9
     # CPU backend supports device capture: a profile run directory appears
     assert (out / "plugins" / "profile").exists()
