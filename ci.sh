@@ -222,12 +222,15 @@ run_perf() {
   echo "== Perf (spfft_tpu.obs.perf: dbench rows + schema + regression gate, CPU) =="
   # 8-virtual-device distributed bench: slab AND pencil meshes must emit
   # validating spfft_tpu.obs.perf/1 reports (per-stage attribution summing
-  # to the measured pair time, geometry-exact exchange bytes, run-ID join).
+  # to the measured pair time, geometry-exact exchange bytes, run-ID join)
+  # for BOTH exchange disciplines — bulk-synchronous (ov1) and OVERLAPPED
+  # (ov4 chunked double-buffered) — and the overlapped rows must show a
+  # strictly lower exposed exchange_fraction than their ov1 siblings.
   local pdir
   pdir="$(mktemp -d)"
   JAX_PLATFORMS=cpu timeout 540 python programs/dbench.py --devices 8 \
     --dim 8 --sparsity 0.9 --scaling strong --repeats 2 --chain 2 \
-    --engine xla --cpu -o "$pdir/dbench.json" > /dev/null
+    --engine xla --cpu --overlap 1 4 -o "$pdir/dbench.json" > /dev/null
   JAX_PLATFORMS=cpu python - "$pdir" <<'EOF'
 import json, sys
 from spfft_tpu.obs import perf
@@ -243,7 +246,22 @@ for r in doc["rows"]:
     assert abs(total - r["seconds_per_pair"]) < 1e-9, r["key"]
     assert 0.0 < r["exchange_fraction"] < 1.0, r["key"]
     assert r["run_id"], r["key"]
-print(f"dbench ok ({len(doc['rows'])} rows: {', '.join(sorted(kinds))})")
+by_ov = {}
+for r in doc["rows"]:
+    if r["decomposition"] == "local":
+        continue
+    by_ov.setdefault(r["key"].rsplit(":ov", 1)[0], {})[r["overlap_chunks"]] = r
+paired = 0
+for base, cells in by_ov.items():
+    if len(cells) < 2:
+        continue
+    paired += 1
+    ov1, ovc = cells[1], cells[max(cells)]
+    assert ovc["exchange_fraction"] < ov1["exchange_fraction"], (
+        base, ov1["exchange_fraction"], ovc["exchange_fraction"])
+    assert any("overlapped" in s["stage"] for s in ovc["stages"]), base
+assert paired >= 2, f"expected overlapped/bulk row pairs, got {paired}"
+print(f"dbench ok ({len(doc['rows'])} rows incl. {paired} overlap pairs)")
 EOF
   # Regression gate: the committed baseline is CPU-noise-calibrated (wide
   # tolerance — it exists to catch algorithmic slides, e.g. a collective

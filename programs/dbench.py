@@ -22,6 +22,13 @@ Usage:
     python programs/dbench.py --devices 1 2 4 8 --dim 32 -o MULTICHIP.json
     python programs/dbench.py --devices 8 --mesh pencil --scaling weak
     python programs/dbench.py --devices 4 --r2c --dtype f64 --engine xla
+    python programs/dbench.py --devices 8 --overlap 1 4   # OVERLAPPED rows
+
+``--overlap`` measures each cell once per requested OVERLAPPED-discipline
+chunk count (keys carry an ``ovC`` token); the stdout table prints each
+row's best-vs-median repeat spread (the ``±`` column — the same
+``seconds_noise`` the gate widens its threshold by), so a single bad repeat
+is visible at capture time instead of poisoning a committed baseline.
 
 On a CPU mesh the wall-clock is indicative only (collectives are memory
 copies); run on a pod slice for decision-grade rows — the report schema and
@@ -39,17 +46,19 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 def row_key(report: dict, scaling: str) -> str:
     """Stable scenario key a gate matches rows on: everything that defines
-    the cell except the measured numbers."""
+    the cell except the measured numbers (the effective overlap chunk count
+    included, so overlapped and bulk-synchronous rows gate side by side)."""
     dims = "x".join(str(d) for d in report["dims"])
     return (
         f"{scaling}:{report['decomposition']}:P{report['device_count']}"
         f":{dims}:{report['transform_type']}:{report['dtype']}"
         f":{report['exchange_discipline']}:{report['engine']}"
         f":nnz{report['nnz_fraction']:.3f}"
+        f":ov{report.get('overlap_chunks', 1)}"
     )
 
 
-def build_transform(args, mesh_kind, devices, dims, mesh_devices):
+def build_transform(args, mesh_kind, devices, dims, mesh_devices, overlap=1):
     """One plan for a scaling cell (slab or pencil over ``devices`` chips)."""
     import numpy as np
 
@@ -78,6 +87,7 @@ def build_transform(args, mesh_kind, devices, dims, mesh_devices):
     return sp.DistributedTransform(
         pu, ttype, dx, dy, dz, trip, mesh=mesh, dtype=dtype,
         engine=args.engine, exchange_type=ExchangeType[args.exchange],
+        overlap=overlap,
     )
 
 
@@ -107,6 +117,10 @@ def measure_row(transform, args, scaling: str) -> dict:
     reps = sorted(m["rep_seconds"])
     median = (reps[(len(reps) - 1) // 2] + reps[len(reps) // 2]) / 2.0
     row["seconds_noise"] = (median - best) / best if best else 0.0
+    # the per-row parity check: a diverged chain never becomes a row (the
+    # assertion above); the residual itself rides along so a committed
+    # capture shows each row's roundtrip health (None for R2C)
+    row["roundtrip_residual"] = m["roundtrip_residual"]
     row["key"] = row_key(row, scaling)
     return row
 
@@ -129,6 +143,10 @@ def main(argv=None):
                     help="exchange discipline name (DEFAULT = policy pick)")
     ap.add_argument("--r2c", action="store_true")
     ap.add_argument("--dtype", default="f32", choices=["f32", "f64"])
+    ap.add_argument("--overlap", type=int, nargs="+", default=[1],
+                    help="OVERLAPPED-discipline chunk counts to measure per "
+                    "cell (1 = bulk-synchronous; engines clamp infeasible "
+                    "requests and duplicate-clamped cells are skipped)")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--chain", type=int, default=4)
     ap.add_argument("--warmup", type=int, default=1)
@@ -170,17 +188,30 @@ def main(argv=None):
                     print(f"note: skipping pencil at P={P} "
                           "(needs an even device count >= 4)", file=sys.stderr)
                     continue
-                t = build_transform(args, mesh_kind, P, dims, all_devices[:P])
-                row = measure_row(t, args, scaling)
-                rows.append(row)
-                print(
-                    f"{scaling:6s} {mesh_kind:6s} P={P:2d} "
-                    f"{'x'.join(str(d) for d in dims):>12s} "
-                    f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
-                    f"{row['gflops']:9.2f} GFLOP/s "
-                    f"exch {row['exchange_fraction'] * 100:5.1f}% "
-                    f"({row['exchange_gbps']:.2f} GB/s wire)"
-                )
+                seen_ov = set()
+                for overlap in sorted(set(args.overlap)):
+                    t = build_transform(
+                        args, mesh_kind, P, dims, all_devices[:P],
+                        overlap=overlap,
+                    )
+                    effective = int(getattr(t, "overlap_chunks", 1))
+                    if effective in seen_ov:
+                        # the engine clamped this request onto a chunk count
+                        # already measured (P=1 local rung, tiny extents) —
+                        # a duplicate key row would shadow the first
+                        continue
+                    seen_ov.add(effective)
+                    row = measure_row(t, args, scaling)
+                    rows.append(row)
+                    print(
+                        f"{scaling:6s} {mesh_kind:6s} P={P:2d} "
+                        f"{'x'.join(str(d) for d in dims):>12s} ov={effective:2d} "
+                        f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
+                        f"±{row['seconds_noise'] * 100:5.1f}% "
+                        f"{row['gflops']:9.2f} GFLOP/s "
+                        f"exch {row['exchange_fraction'] * 100:5.1f}% "
+                        f"({row['exchange_gbps']:.2f} GB/s wire)"
+                    )
 
     if not rows:
         # every cell was skipped: exiting 0 with an empty document would

@@ -35,12 +35,15 @@ format of arxiv.org/pdf/2506.08653: a grid of measured cells, not one
 headline number): the cross product of ``--matrix-dims`` x
 ``--matrix-sparsity`` (extremes by default) x ``--matrix-types`` (c2c/r2c) x
 ``--matrix-dtypes`` (f32/f64) x both wire disciplines (padded BUFFERED and
-exact-counts UNBUFFERED), each cell measured with the shared fenced
-chained-roundtrip discipline and emitted as a keyed
-``spfft_tpu.obs.perf/1`` row (per-stage attribution, GFLOP/s,
-exchange_fraction) — the same row format ``programs/dbench.py`` writes, so
-``programs/perf_gate.py`` gates matrix documents identically and a
-regression or win is visible *per scenario*.
+exact-counts UNBUFFERED) x the **overlap axis** (``--matrix-overlap``,
+default ``1 tuned``: bulk-synchronous, plus one autotuner-resolved cell per
+scenario where the TUNED policy picks the discipline AND the OVERLAPPED
+chunk count), each cell measured with the shared fenced chained-roundtrip
+discipline and emitted as a keyed ``spfft_tpu.obs.perf/1`` row (per-stage
+attribution, GFLOP/s, exchange_fraction) — the same row format
+``programs/dbench.py`` writes, so ``programs/perf_gate.py`` gates matrix
+documents identically and a per-scenario overlap win or regression is an
+ordinary gate row.
 """
 from __future__ import annotations
 
@@ -58,9 +61,21 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 def run_matrix(args):
     """The scenario matrix (module docstring): dims x sparsity x c2c/r2c x
-    dtype x both wire disciplines, each cell a keyed perf row measured with
-    the shared fenced chained-roundtrip discipline (``dbench.measure_row``),
-    written as a gate-compatible ``spfft_tpu.obs.perf.scaling/1`` document."""
+    dtype x both wire disciplines x the overlap axis, each cell a keyed perf
+    row measured with the shared fenced chained-roundtrip discipline
+    (``dbench.measure_row``), written as a gate-compatible
+    ``spfft_tpu.obs.perf.scaling/1`` document.
+
+    The overlap axis (``--matrix-overlap``, default ``1 tuned``): integer
+    chunk counts measure the padded BUFFERED discipline under the OVERLAPPED
+    pipeline (UNBUFFERED's ragged transport clamps the knob, so it only
+    carries the ``1`` cell); the literal ``tuned`` adds one cell per
+    scenario whose plan resolves ``ExchangeType.DEFAULT`` under
+    ``policy="tuned"`` with the overlap knob left to the autotuner — its key
+    records whatever discipline/chunk count the trials picked, so
+    per-scenario overlap wins and regressions land as ordinary gate rows."""
+    import os
+
     import jax
     import numpy as np
     import spfft_tpu as sp
@@ -77,6 +92,11 @@ def run_matrix(args):
     P = args.shards[0]
     if "f64" in args.matrix_dtypes and not jax.config.read("jax_enable_x64"):
         jax.config.update("jax_enable_x64", True)
+    if "tuned" in args.matrix_overlap:
+        # tuned cells measure on this same virtual CPU mesh, so CPU trials
+        # cannot poison accelerator wisdom any more than the sweep does
+        os.environ.setdefault("SPFFT_TPU_TUNE_CPU", "1")
+    int_overlaps = sorted({int(o) for o in args.matrix_overlap if o != "tuned"})
     mesh = sp.make_fft_mesh(P)
     pu = ProcessingUnit.GPU if args.engine == "mxu" else ProcessingUnit.HOST
     rows = []
@@ -89,7 +109,12 @@ def run_matrix(args):
                     hermitian_symmetry=ttype == "r2c",
                 )
                 for dt in args.matrix_dtypes:
-                    for disc in ("BUFFERED", "UNBUFFERED"):
+                    cells = [
+                        ("UNBUFFERED", "default", 1)
+                    ] + [("BUFFERED", "default", ov) for ov in int_overlaps]
+                    if "tuned" in args.matrix_overlap:
+                        cells.append(("DEFAULT", "tuned", None))
+                    for disc, policy, overlap in cells:
                         t = DistributedTransform(
                             pu,
                             TransformType.R2C if ttype == "r2c"
@@ -100,12 +125,16 @@ def run_matrix(args):
                             dtype=np.float64 if dt == "f64" else np.float32,
                             engine=args.engine,
                             exchange_type=ExchangeType[disc],
+                            policy=policy,
+                            overlap=overlap,
                         )
                         row = dbench.measure_row(t, args, scaling="matrix")
                         rows.append(row)
+                        label = disc if policy == "default" else "TUNED"
                         print(
                             f"{dim:4d}^3 nnz={row['nnz_fraction']:.3f} "
-                            f"{ttype} {dt} {disc:10s} "
+                            f"{ttype} {dt} {label:10s} "
+                            f"ov={row['overlap_chunks']:2d} "
                             f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
                             f"{row['gflops']:8.2f} GFLOP/s "
                             f"exch {row['exchange_fraction'] * 100:5.1f}%"
@@ -148,6 +177,11 @@ def main(argv=None):
                     choices=["c2c", "r2c"])
     ap.add_argument("--matrix-dtypes", nargs="+", default=["f32", "f64"],
                     choices=["f32", "f64"])
+    ap.add_argument("--matrix-overlap", nargs="+", default=["1", "tuned"],
+                    help="overlap axis of the matrix: integer OVERLAPPED "
+                    "chunk counts for the padded discipline, plus the "
+                    "literal 'tuned' for an autotuner-resolved cell per "
+                    "scenario (see run_matrix)")
     ap.add_argument("--chain", type=int, default=2,
                     help="chained roundtrips per dispatch (matrix mode)")
     ap.add_argument("--warmup", type=int, default=1)

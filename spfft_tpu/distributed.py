@@ -49,6 +49,7 @@ class DistributedTransform:
         policy: str | None = None,
         guard: bool | None = None,
         verify=None,
+        overlap: int | None = None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -110,9 +111,16 @@ class DistributedTransform:
             dtype = np.float64 if jax.config.read("jax_enable_x64") else np.float32
         self._real_dtype = np.dtype(dtype)
 
-        from .parallel.policy import resolve_policy
+        from .parallel.policy import resolve_overlap_chunks, resolve_policy
 
         self._policy = resolve_policy(policy)
+        # Exchange-overlap chunk count (the OVERLAPPED discipline): explicit
+        # argument or SPFFT_TPU_OVERLAP_CHUNKS; under the TUNED policy an
+        # unset knob is owned by the autotuner below (overlap candidates are
+        # trialed with the disciplines and the measured pick lands in
+        # wisdom). Engines clamp the request to what their geometry supports.
+        self._overlap_requested = overlap
+        overlap_chunks = resolve_overlap_chunks(overlap)
         # Guard mode + degradation record, mirroring the local Transform
         # (spfft_tpu.faults): fallbacks taken during construction land on
         # _degradations and surface in the plan card.
@@ -156,11 +164,15 @@ class DistributedTransform:
                         engine=engine,
                         precision=precision,
                         policy="default",
+                        overlap=cand.get("overlap", 1),
                     )
 
                 with faults.collecting(self._degradations):
-                    exchange_type, self._tuning = tuning.tuned_exchange(
-                        p, mesh, self._real_dtype, engine, precision, pencil2, build
+                    exchange_type, overlap_chunks, self._tuning = (
+                        tuning.tuned_exchange(
+                            p, mesh, self._real_dtype, engine, precision,
+                            pencil2, build, overlap=overlap,
+                        )
                     )
             elif ExchangeType(exchange_type) == ExchangeType.DEFAULT and not pencil2:
                 # Measured auto-policy (parallel/policy.py): pick the discipline
@@ -205,7 +217,9 @@ class DistributedTransform:
                         faults.site("engine.compile")
                         return (
                             MxuPencil2Execution(
-                                self._params, self._real_dtype, mesh, exchange_type, precision
+                                self._params, self._real_dtype, mesh,
+                                exchange_type, precision,
+                                overlap=overlap_chunks,
                             ),
                             "pencil2-mxu",
                         )
@@ -213,7 +227,8 @@ class DistributedTransform:
 
                     return (
                         Pencil2Execution(
-                            self._params, self._real_dtype, mesh, exchange_type
+                            self._params, self._real_dtype, mesh, exchange_type,
+                            overlap=overlap_chunks,
                         ),
                         "pencil2",
                     )
@@ -223,13 +238,15 @@ class DistributedTransform:
                     faults.site("engine.compile")
                     return (
                         MxuDistributedExecution(
-                            self._params, self._real_dtype, mesh, exchange_type, precision
+                            self._params, self._real_dtype, mesh, exchange_type,
+                            precision, overlap=overlap_chunks,
                         ),
                         "mxu",
                     )
                 return (
                     DistributedExecution(
-                        self._params, self._real_dtype, mesh, exchange_type
+                        self._params, self._real_dtype, mesh, exchange_type,
+                        overlap=overlap_chunks,
                     ),
                     "xla",
                 )
@@ -265,7 +282,10 @@ class DistributedTransform:
                 policy=self._policy,
             )
             obs.trace.event(
-                "decision", what="exchange", choice=self.exchange_type.name
+                "decision",
+                what="exchange",
+                choice=self.exchange_type.name,
+                overlap=self.overlap_chunks,
             )
         self._space_data = None
         # Plan-constant; cached lazily so the metrics-off path never pays the
@@ -559,6 +579,7 @@ class DistributedTransform:
             precision=self._precision,
             guard=self._guard,
             verify=self._verify_mode,
+            overlap=self.overlap_chunks,
         )
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
@@ -678,6 +699,14 @@ class DistributedTransform:
     @property
     def exchange_type(self) -> ExchangeType:
         return self._exec.exchange_type
+
+    @property
+    def overlap_chunks(self) -> int:
+        """Effective exchange-overlap chunk count of the compiled pipelines
+        (the OVERLAPPED discipline): 1 means bulk-synchronous. May be lower
+        than requested — engines clamp to the chunkable extent and the
+        ragged disciplines (whose chains already round-pipeline) ignore it."""
+        return int(getattr(self._exec, "_overlap", 1))
 
     def exchange_wire_bytes(self) -> int:
         """Off-shard interconnect bytes per slab<->pencil repartition under the

@@ -195,6 +195,7 @@ class Grid:
         policy: str | None = None,
         guard: bool | None = None,
         verify=None,
+        overlap: int | None = None,
     ):
         """Create a transform bound to this grid.
 
@@ -228,6 +229,12 @@ class Grid:
                 policy=policy,
                 guard=guard,
                 verify=verify,
+                overlap=overlap,
+            )
+        if overlap is not None:
+            raise InvalidParameterError(
+                "overlap= applies to distributed plans only (local "
+                "transforms have no exchange to chunk)"
             )
         from .transform import Transform
 

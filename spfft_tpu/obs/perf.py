@@ -35,9 +35,14 @@ to the measured wall time by construction.
 measured seconds — directly comparable to ``bench.py``'s headline and the
 BENCH_r0x trajectory), per-stage GFLOP/s and GB/s, and ``exchange_fraction``
 — the share of a pair attributed to the exchange stages
-(``exchange``/``exchange A``/``exchange B``). That fraction bounds what
-communication/compute overlap can win, which makes it the scoreboard for the
-planned exchange-overlap work (ROADMAP item 1).
+(:data:`EXCHANGE_STAGES`). For bulk-synchronous plans that fraction bounds
+what communication/compute overlap can win; under the OVERLAPPED discipline
+(``overlap_chunks`` > 1) the chunked exchange rows are scored on their
+**exposed** (non-hidden) time — :func:`_exposed_weight` subtracts the
+``(C-1)/C · min(exchange, hiding compute)`` the double-buffer pipelines
+away, while the rows' modeled ``bytes`` remain the exact geometry wire
+volume — so the scoreboard shows what communication actually costs, not
+what rides the wire (docs/details.md "Hiding the exchange").
 
 Every report also lands in the run registry (``perf_pair_seconds``,
 ``perf_stage_seconds`` histograms, ``perf_gflops`` / ``perf_exchange_fraction``
@@ -91,11 +96,23 @@ MODELED_STAGES = (
     "pack B",
     "exchange B",
     "unpack B",
+    "exchange overlapped",
+    "exchange A overlapped",
+    "exchange B overlapped",
 )
 
 # The stages whose attributed seconds make up ``exchange_fraction`` — the
-# interconnect collectives, not their local pack/unpack bookends.
-EXCHANGE_STAGES = ("exchange", "exchange A", "exchange B")
+# interconnect collectives, not their local pack/unpack bookends. The
+# overlapped variants contribute their EXPOSED (non-hidden) seconds, so the
+# fraction is the share of wall time communication actually costs.
+EXCHANGE_STAGES = (
+    "exchange",
+    "exchange A",
+    "exchange B",
+    "exchange overlapped",
+    "exchange A overlapped",
+    "exchange B overlapped",
+)
 
 REQUIRED_KEYS = (
     "schema",
@@ -230,33 +247,65 @@ def dense_pair_flops(dims) -> int:
     return int(round(2 * 5.0 * n * math.log2(n)))
 
 
+def _exposed_weight(row: dict, base: dict, balance: float) -> float:
+    """Attribution weight of one stage row, overlap-aware.
+
+    Plain rows weigh ``flops + bytes * balance``. An OVERLAPPED exchange row
+    (carrying an ``overlap`` record from the engine's ``stage_accounting``)
+    weighs only its **exposed** wire time: with C chunks double-buffered
+    against the compute stage it hides behind, at most ``(C-1)/C`` of
+    ``min(exchange, compute)`` overlaps — the classic software-pipeline
+    bound (arxiv.org/pdf/1804.09536) — so
+
+        exposed = full - min(full, hidden_stage_weight) * (C - 1) / C.
+
+    The row's modeled ``bytes`` stay the exact geometry wire volume either
+    way; only the time attribution changes. The hiding compute stage keeps
+    its full weight (it IS the pipeline's critical path)."""
+    w = row["flops"] + row["bytes"] * balance
+    ov = row.get("overlap")
+    if not ov:
+        return w
+    chunks = max(1, int(ov.get("chunks", 1)))
+    if chunks == 1:
+        return w
+    hide_w = base.get(ov.get("hides"), 0.0)
+    return max(w - min(w, hide_w) * (chunks - 1) / chunks, 0.0)
+
+
 def _attribute(rows: list, seconds: float, balance: float) -> list:
     """Distribute ``seconds`` over the stage rows by model weight
-    (``flops + bytes * balance``); equal split when the model is all-zero.
-    The attributed stage seconds sum to ``seconds`` by construction."""
-    weights = [r["flops"] + r["bytes"] * balance for r in rows]
+    (``flops + bytes * balance``; overlapped exchange rows by their exposed
+    share — :func:`_exposed_weight`); equal split when the model is
+    all-zero. The attributed stage seconds sum to ``seconds`` by
+    construction."""
+    base = {r["stage"]: r["flops"] + r["bytes"] * balance for r in rows}
+    weights = [_exposed_weight(r, base, balance) for r in rows]
     total_w = sum(weights)
     out = []
     for r, w in zip(rows, weights):
         frac = (w / total_w) if total_w > 0 else (1.0 / len(rows) if rows else 0.0)
         sec = seconds * frac
-        out.append(
-            {
-                "stage": r["stage"],
-                "flops": int(r["flops"]),
-                "bytes": int(r["bytes"]),
-                "seconds": sec,
-                "fraction": frac,
-                "gflops": (r["flops"] / sec / 1e9) if sec > 0 else 0.0,
-                "gbps": (r["bytes"] / sec / 1e9) if sec > 0 else 0.0,
-            }
-        )
+        row = {
+            "stage": r["stage"],
+            "flops": int(r["flops"]),
+            "bytes": int(r["bytes"]),
+            "seconds": sec,
+            "fraction": frac,
+            "gflops": (r["flops"] / sec / 1e9) if sec > 0 else 0.0,
+            "gbps": (r["bytes"] / sec / 1e9) if sec > 0 else 0.0,
+        }
+        if r.get("overlap"):
+            row["overlap"] = dict(r["overlap"])
+        out.append(row)
     return out
 
 
 def _merge_rows(rows: list) -> list:
     """Aggregate duplicate stage names (an engine hook may emit a stage once
-    per direction) into one row each, preserving first-seen order."""
+    per direction) into one row each, preserving first-seen order and any
+    ``overlap`` record (first occurrence wins — the engines emit one
+    consistent record per overlapped exchange)."""
     order, table = [], {}
     for r in rows:
         name = r["stage"]
@@ -265,6 +314,8 @@ def _merge_rows(rows: list) -> list:
             order.append(name)
         table[name]["flops"] += int(r.get("flops", 0))
         table[name]["bytes"] += int(r.get("bytes", 0))
+        if r.get("overlap") and "overlap" not in table[name]:
+            table[name]["overlap"] = dict(r["overlap"])
     return [table[n] for n in order]
 
 
@@ -306,6 +357,7 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
             "pencil2" if transform._engine.startswith("pencil2") else "slab"
         )
         discipline = transform.exchange_type.name
+        overlap_chunks = int(getattr(transform, "overlap_chunks", 1))
         wire_bytes = 2 * int(transform.exchange_wire_bytes())  # fwd + bwd
         num_elements = int(transform.num_global_elements)
     else:
@@ -313,6 +365,7 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
         device_count = 1
         decomposition = "local"
         discipline = None
+        overlap_chunks = 1
         wire_bytes = 0
         num_elements = int(transform.num_local_elements)
     model_flops = sum(r["flops"] for r in rows)
@@ -334,6 +387,13 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
         "device_count": device_count,
         "mesh": mesh_card,
         "exchange_discipline": discipline,
+        # effective OVERLAPPED-discipline chunk count (1 = bulk-synchronous);
+        # part of the scenario identity, so dbench keys and the perf gate
+        # hold overlapped and unoverlapped rows side by side. Deliberately
+        # NOT in REQUIRED_KEYS: schema-/1 documents captured before the
+        # overlap work (MULTICHIP_r06 and older baselines) stay valid —
+        # consumers read a missing value as 1
+        "overlap_chunks": overlap_chunks,
         "seconds_per_pair": seconds,
         "repeats": repeats,
         "gflops": (dense_flops / seconds / 1e9) if seconds > 0 else 0.0,

@@ -54,7 +54,15 @@ DEGRADATION_KEYS = ("event", "reason")
 VERIFICATION_KEYS = ("mode", "checks", "rtol", "retries", "breaker")
 BREAKER_KEYS = ("engine", "state", "consecutive_failures", "trips", "threshold")
 DISTRIBUTED_KEYS = ("num_shards", "mesh", "decomposition", "exchange")
-EXCHANGE_KEYS = ("discipline", "wire_dtype", "wire_bytes", "rounds", "transport")
+EXCHANGE_KEYS = (
+    "discipline",
+    "wire_dtype",
+    "wire_bytes",
+    "rounds",
+    "transport",
+    # effective OVERLAPPED-discipline chunk count (1 = bulk-synchronous)
+    "overlap_chunks",
+)
 POLICY_KEYS = ("round_cost_bytes", "one_shot_supported", "chosen", "alternatives")
 ALTERNATIVE_KEYS = ("discipline", "wire_bytes", "rounds", "cost_bytes", "chosen")
 COMPILED_KEYS = (
@@ -119,20 +127,39 @@ def _exchange_policy_1d(transform) -> dict:
         ),
     )
     chosen = base_discipline(transform.exchange_type)
+    ov = int(getattr(transform, "overlap_chunks", 1))
+    alternatives = [
+        {
+            "discipline": d.name,
+            "wire_bytes": int(row["wire_bytes"]),
+            "rounds": int(row["rounds"]),
+            "cost_bytes": int(row["cost_bytes"]),
+            "chosen": d == chosen and ov == 1,
+        }
+        for d, row in table.items()
+    ]
+    chosen_name = transform.exchange_type.name
+    if ov > 1:
+        # the OVERLAPPED variant the plan actually runs: same exact wire
+        # bytes as its padded base discipline, C chunk-collective rounds —
+        # the cost-table provenance row of the overlap knob
+        chosen_name = f"{chosen_name}/ov{ov}"
+        base_row = table[chosen]
+        alternatives.append(
+            {
+                "discipline": chosen_name,
+                "wire_bytes": int(base_row["wire_bytes"]),
+                "rounds": ov,
+                "cost_bytes": int(base_row["wire_bytes"])
+                + ov * round_cost_bytes(),
+                "chosen": True,
+            }
+        )
     return {
         "round_cost_bytes": round_cost_bytes(),
         "one_shot_supported": bool(one_shot),
-        "chosen": transform.exchange_type.name,
-        "alternatives": [
-            {
-                "discipline": d.name,
-                "wire_bytes": int(row["wire_bytes"]),
-                "rounds": int(row["rounds"]),
-                "cost_bytes": int(row["cost_bytes"]),
-                "chosen": d == chosen,
-            }
-            for d, row in table.items()
-        ],
+        "chosen": chosen_name,
+        "alternatives": alternatives,
     }
 
 
@@ -158,11 +185,29 @@ def _exchange_policy_pencil(transform):
         )
     costs = dict(tables[bool(one_shot)])
     chosen = transform.exchange_type.name
-    costs["chosen"] = chosen
+    ov = int(getattr(transform, "overlap_chunks", 1))
     costs["alternatives"] = [
-        dict(alt, chosen=alt["discipline"] == chosen)
+        dict(alt, chosen=alt["discipline"] == chosen and ov == 1)
         for alt in costs["alternatives"]
     ]
+    if ov > 1:
+        # the OVERLAPPED variant actually running: exact wire bytes of the
+        # padded base, 2C chunk-collective rounds (A + B per z-window chunk)
+        base = next(
+            alt for alt in costs["alternatives"] if alt["discipline"] == chosen
+        )
+        chosen = f"{chosen}/ov{ov}"
+        costs["alternatives"].append(
+            {
+                "discipline": chosen,
+                "wire_bytes": int(base["wire_bytes"]),
+                "rounds": 2 * ov,
+                "cost_bytes": int(base["wire_bytes"])
+                + 2 * ov * int(costs["round_cost_bytes"]),
+                "chosen": True,
+            }
+        )
+    costs["chosen"] = chosen
     return costs
 
 
@@ -229,6 +274,7 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
             "wire_bytes": int(transform.exchange_wire_bytes()),
             "rounds": int(transform.exchange_rounds()),
             "transport": ex.exchange_transport(),
+            "overlap_chunks": int(getattr(transform, "overlap_chunks", 1)),
         }
         if pencil:
             costs = _exchange_policy_pencil(transform)

@@ -43,6 +43,13 @@ STAGES = (
     "pack B",
     "exchange B",
     "unpack B",
+    # OVERLAPPED exchange discipline (overlap chunks > 1): the chunked,
+    # double-buffered collectives carry distinct labels so traces and perf
+    # attribution can tell pipelined wire time from bulk-synchronous wire
+    # time — the perf layer scores these on EXPOSED (non-hidden) time
+    "exchange overlapped",
+    "exchange A overlapped",
+    "exchange B overlapped",
     # autotuner trial phases (spfft_tpu/tuning/runner.py): warmup dispatches
     # absorbing compilation, then the timed roundtrips wisdom records
     "tune warmup",

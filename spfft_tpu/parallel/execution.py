@@ -14,7 +14,14 @@ src/transpose/transpose_mpi_buffered_gpu.cpp) — rebuilt TPU-first:
   gather/scatter index maps XLA fuses into the surrounding stages,
 * ``*_FLOAT`` exchange variants cast the wire payload to complex64 around the
   collective, halving ICI bytes for f64 transforms
-  (reference: src/gpu_util/complex_conversion.cuh:37-56).
+  (reference: src/gpu_util/complex_conversion.cuh:37-56),
+* the OVERLAPPED discipline (``overlap`` chunks > 1, padded wire formats
+  only) splits the stick batch into C chunks, each with its own
+  z-FFT -> pack -> all_to_all chain and no cross-chunk dependence, so chunk
+  k's collective can hide behind chunk k+1's FFTs — the pipelined all-to-all
+  of "Fast parallel multidimensional FFT using advanced MPI"
+  (arxiv.org/pdf/1804.09536); the autotuner owns the chunk count
+  (tuning/candidates.py).
 
 Frequency-domain per-shard data is padded to uniform (V_max values, S_max sticks);
 space-domain slabs to L_max planes. Padded slots carry out-of-bounds sentinels and are
@@ -40,6 +47,21 @@ from ..types import (
 )
 from .mesh import FFT_AXIS, fft_axis_size
 from .ragged import OneShotExchange, RaggedExchange
+
+
+def chunk_ranges(n: int, chunks: int) -> list:
+    """``chunks`` contiguous, near-equal ``(start, stop)`` ranges covering
+    ``[0, n)`` — the chunk split of the OVERLAPPED exchange discipline (first
+    ``n % chunks`` ranges get one extra element). Callers clamp ``chunks`` to
+    ``[1, n]`` first, so no range is ever empty."""
+    chunks = max(1, min(int(chunks), int(n)))
+    base, extra = divmod(int(n), chunks)
+    out, start = [], 0
+    for i in range(chunks):
+        stop = start + base + (1 if i < extra else 0)
+        out.append((start, stop))
+        start = stop
+    return out
 
 
 def mesh_process_span(mesh) -> int:
@@ -196,7 +218,14 @@ class PaddingHelpers:
         supplies the slab exchange middle, discipline-aware: the padded path
         carries ``pack``/``unpack`` rows, the ragged chains (whose
         pack/unpack ride inside the collective steps) only the backward slab
-        ``unpack``."""
+        ``unpack``.
+
+        Under the OVERLAPPED discipline (``_overlap`` chunks > 1) the
+        exchange row carries the ``exchange overlapped`` label and an
+        ``overlap`` record naming the compute stage its chunks hide behind
+        (the z pass) — the perf layer attributes only the *exposed*
+        (non-hidden) share of its wire time to it, while the row's ``bytes``
+        stay the exact geometry wire volume (obs/perf.py ``_attribute``)."""
         from ..obs.perf import pipeline_head_rows, pipeline_tail_rows
 
         p = self.params
@@ -224,14 +253,18 @@ class PaddingHelpers:
                 rows.append(
                     {"stage": "unpack", "flops": 0, "bytes": grid_elems * c_item}
                 )
-            rows.append(
-                {
-                    "stage": "exchange",
-                    "flops": 0,
-                    # per pair (fwd + bwd volumes are equal)
-                    "bytes": 2 * self.exchange_wire_bytes(),
-                }
-            )
+            ov = getattr(self, "_overlap", 1)
+            row = {
+                "stage": "exchange" if ov == 1 else "exchange overlapped",
+                "flops": 0,
+                # per pair (fwd + bwd volumes are equal) — exact geometry
+                # wire bytes under BOTH labels; overlap changes exposure,
+                # never the modeled volume
+                "bytes": 2 * self.exchange_wire_bytes(),
+            }
+            if ov > 1:
+                row["overlap"] = {"chunks": int(ov), "hides": "z transform"}
+            rows.append(row)
         y_lines = Z * int(getattr(self, "_num_x_active", Xf) or Xf)
         return rows + pipeline_tail_rows(
             Z, Y, X, y_lines, c_item,
@@ -263,11 +296,13 @@ class PaddingHelpers:
     def exchange_rounds(self) -> int:
         """Sequential collective rounds one repartition takes under the plan's
         discipline: 1 for the padded all_to_all and the one-shot UNBUFFERED
-        exchange, P-1 for the COMPACT ppermute chain (and for UNBUFFERED's
+        exchange (C chunk collectives under the OVERLAPPED discipline — each
+        chunk is its own wire round, pipelined against the neighbor chunks'
+        FFTs), P-1 for the COMPACT ppermute chain (and for UNBUFFERED's
         chain-transport fallback on backends without ragged-all-to-all)."""
         if self._ragged is not None:
             return self._ragged.rounds()
-        return 1
+        return int(getattr(self, "_overlap", 1))
 
     def exchange_transport(self) -> str:
         """Short name of the collective form that actually carries the
@@ -279,6 +314,8 @@ class PaddingHelpers:
         from .ragged import OneShotExchange
 
         if self._ragged is None:
+            if getattr(self, "_overlap", 1) > 1:
+                return "chunked all_to_all"
             return "all_to_all"
         if isinstance(self._ragged, OneShotExchange):
             if self._ragged.transport == "ragged":
@@ -459,6 +496,7 @@ class DistributedExecution(PaddingHelpers):
         real_dtype,
         mesh,
         exchange_type: ExchangeType = ExchangeType.DEFAULT,
+        overlap: int = 1,
     ):
         self.params = params
         self.mesh = mesh
@@ -511,6 +549,17 @@ class DistributedExecution(PaddingHelpers):
             )
         self._ragged_wire = self._ragged_wire_format()
 
+        # OVERLAPPED discipline: the padded single-collective exchange is
+        # split into C chunk collectives along the stick axis, each chunk's
+        # wire time pipelined against its neighbor chunks' z-FFTs. Feasible
+        # only for the padded disciplines (the ragged chains already round-
+        # pipeline) and clamped to the stick extent; P=1 plans have no wire.
+        if self._ragged is not None or p.num_shards <= 1:
+            self._overlap = 1
+        else:
+            self._overlap = max(1, min(int(overlap), self._S))
+        self._chunks = chunk_ranges(self._S, self._overlap)
+
         # ---- sharded per-shard constants ----
         vi_sharding = NamedSharding(mesh, P(FFT_AXIS, None))
         self._value_indices = jax.device_put(
@@ -557,6 +606,7 @@ class DistributedExecution(PaddingHelpers):
         """Engine fragment of the plan card (obs.plancard)."""
         return {
             "pipeline": "jnp.fft + scatter/gather (shard_map)",
+            "overlap_chunks": int(self._overlap),
             "padded_geometry": {
                 "s_max": int(self._S),
                 "l_max": int(self._L),
@@ -580,6 +630,21 @@ class DistributedExecution(PaddingHelpers):
         return self._complex_wire_exchange(buffer, FFT_AXIS)
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
+
+    def _unpack_slab(self, recv):
+        """(P, L, S) received blocks -> (L, Y, Xf) slab: scatter every stick
+        into the local planes through the flat (y, x) slot table. Shared by
+        the bulk-synchronous padded path and the OVERLAPPED chunk path (whose
+        concatenated chunk receives reassemble the same (P, L, S) layout)."""
+        p = self.params
+        planes = recv.transpose(1, 0, 2).reshape(self._L, p.num_shards * self._S)
+        slab = jnp.zeros(
+            (self._L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype
+        )
+        slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
+        return slab[:, : p.dim_y * p.dim_x_freq].reshape(
+            self._L, p.dim_y, p.dim_x_freq
+        )
 
     def _backward_impl(self, values_re, values_im, value_indices):
         p = self.params
@@ -606,44 +671,61 @@ class DistributedExecution(PaddingHelpers):
                     jnp.where(is_owner, filled, row)
                 )
 
-        with jax.named_scope("z transform"):
-            sticks = jnp.fft.ifft(sticks, axis=1)
-
-        if self._ragged is not None:
-            # exact-counts exchange: ppermute chain, blocks sized sticks_i x planes_j
-            # (the reference's Alltoallv discipline, see parallel/ragged.py)
-            with jax.named_scope("exchange"):
-                planes = self._ragged.backward(
-                    (sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
-                )[0]  # (Y*Xf, L) slot-major plane rows
+        if self._overlap > 1:
+            # OVERLAPPED discipline: each stick chunk runs its own
+            # z-FFT -> pack -> all_to_all chain with no cross-chunk data
+            # dependence, so chunk k's collective can fly while chunk k+1's
+            # z-FFTs compute (the pipelined all-to-all of
+            # arxiv.org/pdf/1804.09536; XLA's latency-hiding scheduler does
+            # the interleaving — the dataflow here only has to permit it)
+            recvs = []
+            for c0, c1 in self._chunks:
+                with jax.named_scope("z transform"):
+                    zc = jnp.fft.ifft(sticks[c0:c1], axis=1)
+                with jax.named_scope("pack"):
+                    buf = jnp.take(
+                        zc.T, jnp.asarray(self._pack_z), axis=0, mode="fill",
+                        fill_value=0,
+                    ).reshape(p.num_shards, L, c1 - c0)
+                with jax.named_scope("exchange overlapped"):
+                    recvs.append(self._exchange(buf))
+            recv = jnp.concatenate(recvs, axis=2)
             with jax.named_scope("unpack"):
-                slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
+                slab = self._unpack_slab(recv)
         else:
-            # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
-            with jax.named_scope("pack"):
-                sticks_z = sticks.T
-                buffer = jnp.take(
-                    sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill",
-                    fill_value=0,
-                )
-                buffer = buffer.reshape(p.num_shards, L, S)
+            with jax.named_scope("z transform"):
+                sticks = jnp.fft.ifft(sticks, axis=1)
 
-            # exchange: shard r receives every shard's sticks on r's planes
-            #   (the MPI_Alltoall of the reference's BUFFERED transpose,
-            #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
-            with jax.named_scope("exchange"):
-                recv = self._exchange(buffer)
+            if self._ragged is not None:
+                # exact-counts exchange: ppermute chain, blocks sized
+                # sticks_i x planes_j (the reference's Alltoallv discipline,
+                # see parallel/ragged.py)
+                with jax.named_scope("exchange"):
+                    planes = self._ragged.backward(
+                        (sticks,), wire=self._ragged_wire,
+                        real_dtype=self.real_dtype,
+                    )[0]  # (Y*Xf, L) slot-major plane rows
+                with jax.named_scope("unpack"):
+                    slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
+            else:
+                # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
+                with jax.named_scope("pack"):
+                    sticks_z = sticks.T
+                    buffer = jnp.take(
+                        sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill",
+                        fill_value=0,
+                    )
+                    buffer = buffer.reshape(p.num_shards, L, S)
 
-            # unpack: scatter all sticks into the local slab planes
-            with jax.named_scope("unpack"):
-                planes = recv.transpose(1, 0, 2).reshape(L, p.num_shards * S)
-                slab = jnp.zeros(
-                    (L, p.dim_y * p.dim_x_freq + 1), dtype=self.complex_dtype
-                )
-                slab = slab.at[:, jnp.asarray(self._yx_flat)].set(planes, mode="drop")
-                slab = slab[:, : p.dim_y * p.dim_x_freq].reshape(
-                    L, p.dim_y, p.dim_x_freq
-                )
+                # exchange: shard r receives every shard's sticks on r's planes
+                #   (the MPI_Alltoall of the reference's BUFFERED transpose,
+                #    reference: src/transpose/transpose_mpi_buffered_host.cpp:162-173)
+                with jax.named_scope("exchange"):
+                    recv = self._exchange(buffer)
+
+                # unpack: scatter all sticks into the local slab planes
+                with jax.named_scope("unpack"):
+                    slab = self._unpack_slab(recv)
 
         if self.is_r2c:
             with jax.named_scope("plane symmetry"):
@@ -679,33 +761,63 @@ class DistributedExecution(PaddingHelpers):
         with jax.named_scope("y transform"):
             grid = jnp.fft.fft(grid, axis=1)
 
-        if self._ragged is not None:
-            with jax.named_scope("exchange"):
-                sticks = self._ragged.forward(
-                    (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
-                    wire=self._ragged_wire, real_dtype=self.real_dtype,
-                )[0]
+        if self._overlap > 1:
+            # OVERLAPPED discipline (forward direction): chunk k's received
+            # sticks run their z-FFTs while chunk k+1's collective is in
+            # flight — the mirror of the backward chunk pipeline
+            flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
+            yx_by_shard = self._yx_flat.reshape(p.num_shards, S)
+            parts = []
+            for c0, c1 in self._chunks:
+                with jax.named_scope("pack"):
+                    planes = jnp.take(
+                        flat_grid,
+                        jnp.asarray(yx_by_shard[:, c0:c1].reshape(-1)),
+                        axis=1, mode="fill", fill_value=0,
+                    )
+                    buf = planes.reshape(L, p.num_shards, c1 - c0).transpose(
+                        1, 0, 2
+                    )
+                with jax.named_scope("exchange overlapped"):
+                    rc = self._exchange(buf)
+                with jax.named_scope("unpack"):
+                    sz = rc.transpose(2, 0, 1).reshape(c1 - c0, p.num_shards * L)
+                    sz = jnp.take(sz, jnp.asarray(self._unpack_z), axis=1)
+                with jax.named_scope("z transform"):
+                    parts.append(jnp.fft.fft(sz, axis=1))
+            sticks = jnp.concatenate(parts, axis=0)
         else:
-            # pack: gather every shard's stick columns from my planes -> (P, L, S)
-            with jax.named_scope("pack"):
-                flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
-                planes = jnp.take(
-                    flat_grid, jnp.asarray(self._yx_flat), axis=1, mode="fill",
-                    fill_value=0,
-                )
-                buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
+            if self._ragged is not None:
+                with jax.named_scope("exchange"):
+                    sticks = self._ragged.forward(
+                        (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
+                        wire=self._ragged_wire, real_dtype=self.real_dtype,
+                    )[0]
+            else:
+                # pack: gather every shard's stick columns from my planes
+                # -> (P, L, S)
+                with jax.named_scope("pack"):
+                    flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
+                    planes = jnp.take(
+                        flat_grid, jnp.asarray(self._yx_flat), axis=1,
+                        mode="fill", fill_value=0,
+                    )
+                    buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
 
-            # exchange: shard r receives its own sticks' values on every shard's planes
-            with jax.named_scope("exchange"):
-                recv = self._exchange(buffer)
+                # exchange: shard r receives its own sticks' values on every
+                # shard's planes
+                with jax.named_scope("exchange"):
+                    recv = self._exchange(buffer)
 
-            # unpack: (P, L, S) -> (S, Z) via the global-z map
-            with jax.named_scope("unpack"):
-                sticks_z = recv.transpose(2, 0, 1).reshape(S, p.num_shards * L)
-                sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
+                # unpack: (P, L, S) -> (S, Z) via the global-z map
+                with jax.named_scope("unpack"):
+                    sticks_z = recv.transpose(2, 0, 1).reshape(
+                        S, p.num_shards * L
+                    )
+                    sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
 
-        with jax.named_scope("z transform"):
-            sticks = jnp.fft.fft(sticks, axis=1)
+            with jax.named_scope("z transform"):
+                sticks = jnp.fft.fft(sticks, axis=1)
 
         # compress: gather local packed values (+ optional scaling)
         with jax.named_scope("compression"):

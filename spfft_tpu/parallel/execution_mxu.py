@@ -230,6 +230,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         mesh,
         exchange_type: ExchangeType = ExchangeType.DEFAULT,
         precision="highest",
+        overlap: int = 1,
     ):
         self.params = params
         self.mesh = mesh
@@ -398,6 +399,17 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             )
         self._ragged_wire = self._ragged_wire_format()
 
+        # OVERLAPPED discipline (see DistributedExecution): C stick-chunk
+        # collectives pipelined against the neighbor chunks' z matmuls —
+        # padded wire formats only, clamped to the stick extent.
+        from .execution import chunk_ranges
+
+        if self._ragged is not None or p.num_shards <= 1:
+            self._overlap = 1
+        else:
+            self._overlap = max(1, min(int(overlap), S))
+        self._chunks = chunk_ranges(S, self._overlap)
+
         # ---- per-shard value copy plans (deduped lax.switch branches) ----
         self._build_value_branches()
 
@@ -471,6 +483,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         )
         return {
             "pipeline": "matmul DFT stages + lane-copy value plans (shard_map)",
+            "overlap_chunks": int(self._overlap),
             "matmul_precision": str(self._precision).rsplit(".", 1)[-1],
             "num_x_active": int(self._num_x_active),
             "dim_x_freq": int(self.params.dim_x_freq),
@@ -501,6 +514,66 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         """(P, S, L) pair -> all_to_all over the mesh axis, one collective."""
         return self._exchange_pair(bre, bim, FFT_AXIS)
 
+    def _phase_tables(self, phase_re, phase_im, shard, rt):
+        """Resolve this shard's (cos, sin) alignment-phase tables — staged
+        runtime operands, in-trace delta generation, or (None, None) when no
+        shard rotates. Hoisted out of the OVERLAPPED chunk loop so the delta
+        rep's tables are generated once per direction, not per chunk."""
+        if phase_re is not None:
+            return phase_re[0], phase_im[0]
+        if self._align_rep is not None and self._align_rep[0] == "delta":
+            return lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
+        return None, None
+
+    def _unpack_freq(self, rre, rim):
+        """(P, S, L) received stick blocks -> the compact frequency planes
+        ((L, Y, A), the sparse-y (A, Sy, L) table, or the blocked (rb, L)
+        bucket flats) through the global stick slot tables — the padded
+        unpack shared by the bulk-synchronous and OVERLAPPED chunk paths."""
+        L, Y, A = self._L, self.params.dim_y, self._num_x_active
+        rt = self.real_dtype
+        rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
+        rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
+        if self._sparse_y:
+            m = jnp.asarray(self._row_stick)
+            gre = jnp.take(rows_re, m, axis=0).reshape(A, self._sy, L)
+            gim = jnp.take(rows_im, m, axis=0).reshape(A, self._sy, L)
+        elif self._sparse_y_blocked is not None:
+            gre, gim = rows_re, rows_im  # bucket gathers follow per bucket
+        else:
+            m = jnp.asarray(self._yx_stick)
+            gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
+            gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
+        return gre, gim
+
+    def _forward_flats(self, gre, gim):
+        """Flattened plane rows (+ the zero sentinel row) and the per-stick
+        slot map the forward pack gathers through — shared by the bulk pack
+        and the OVERLAPPED per-chunk packs."""
+        L, Y, A = self._L, self.params.dim_y, self._num_x_active
+        rt = self.real_dtype
+        if self._sparse_y:
+            flat_re = jnp.concatenate(
+                [gre.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
+            )
+            flat_im = jnp.concatenate(
+                [gim.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
+            )
+            m = self._stick_row
+        elif self._sparse_y_blocked is not None:
+            flat_re = jnp.concatenate([gre, jnp.zeros((1, L), rt)])
+            flat_im = jnp.concatenate([gim, jnp.zeros((1, L), rt)])
+            m = self._stick_row_b
+        else:
+            flat_re = jnp.concatenate(
+                [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+            )
+            flat_im = jnp.concatenate(
+                [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
+            )
+            m = self._stick_yx
+        return flat_re, flat_im, m
+
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _backward_impl(self, values_re, values_im, phase_re=None, phase_im=None):
@@ -527,15 +600,43 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                 sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
                 sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
 
+        if self._overlap > 1:
+            # OVERLAPPED discipline: per-chunk z matmul -> pack -> collective
+            # with no cross-chunk dependence, so chunk k's wire time can hide
+            # behind chunk k+1's matmuls (see DistributedExecution)
+            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
+            zmap = None if self._uniform_z else jnp.asarray(self._pack_z)
+            rres, rims = [], []
+            for c0, c1 in self._chunks:
+                with jax.named_scope("z transform"):
+                    cre, cim = offt.complex_matmul(
+                        sre[c0:c1], sim[c0:c1], *self._wz_b, "sz,zk->sk", prec
+                    )
+                    if cos_t is not None:
+                        cre, cim = lanecopy.apply_alignment_phase(
+                            cre, cim, cos_t[c0:c1], sin_t[c0:c1], -1
+                        )
+                with jax.named_scope("pack"):
+                    if zmap is not None:
+                        cre = jnp.take(cre, zmap, axis=1, mode="fill", fill_value=0)
+                        cim = jnp.take(cim, zmap, axis=1, mode="fill", fill_value=0)
+                    bre = cre.reshape(c1 - c0, p.num_shards, L).transpose(1, 0, 2)
+                    bim = cim.reshape(c1 - c0, p.num_shards, L).transpose(1, 0, 2)
+                with jax.named_scope("exchange overlapped"):
+                    rc_re, rc_im = self._exchange(bre, bim)
+                rres.append(rc_re)
+                rims.append(rc_im)
+            with jax.named_scope("unpack"):
+                gre, gim = self._unpack_freq(
+                    jnp.concatenate(rres, axis=1), jnp.concatenate(rims, axis=1)
+                )
+            return self._backward_tail(gre, gim, prec)
+
         with jax.named_scope("z transform"):
             sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-            if phase_re is not None:
+            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
+            if cos_t is not None:
                 # undo the alignment rotations (fused multiply)
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim, phase_re[0], phase_im[0], -1
-                )
-            elif self._align_rep is not None and self._align_rep[0] == "delta":
-                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
         if self._ragged is not None:
@@ -572,18 +673,18 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             # expand: (P*S, L) global stick rows -> compact freq planes
             # ((L, Y, A), or the (A, Sy, L) table when sparse-y is engaged)
             with jax.named_scope("unpack"):
-                rows_re = jnp.concatenate([rre.reshape(-1, L), jnp.zeros((1, L), rt)])
-                rows_im = jnp.concatenate([rim.reshape(-1, L), jnp.zeros((1, L), rt)])
-                if self._sparse_y:
-                    m = jnp.asarray(self._row_stick)
-                    gre = jnp.take(rows_re, m, axis=0).reshape(A, self._sy, L)
-                    gim = jnp.take(rows_im, m, axis=0).reshape(A, self._sy, L)
-                elif self._sparse_y_blocked is not None:
-                    gre, gim = rows_re, rows_im  # bucket gathers follow per bucket
-                else:
-                    m = jnp.asarray(self._yx_stick)
-                    gre = jnp.take(rows_re, m, axis=0).T.reshape(L, Y, A)
-                    gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
+                gre, gim = self._unpack_freq(rre, rim)
+
+        return self._backward_tail(gre, gim, prec)
+
+    def _backward_tail(self, gre, gim, prec):
+        """Plane symmetry + y/x DFT stages of the backward pipeline over the
+        compact frequency planes — shared by the bulk-synchronous paths and
+        the OVERLAPPED chunk path (all of which deliver the same plane
+        orientation; the ragged/padded distinction below only matters for
+        the blocked sparse-y layout, where the OVERLAPPED path follows the
+        padded convention by construction)."""
+        L, Y, A = self._L, self.params.dim_y, self._num_x_active
 
         if self.is_r2c and self._have_x0:
             with jax.named_scope("plane symmetry"):
@@ -709,7 +810,46 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     gre, gim, *self._wy_f, "lyk,yj->ljk", prec
                 )
 
-        if self._ragged is not None:
+        if self._overlap > 1:
+            # OVERLAPPED discipline (forward direction): chunk k's received
+            # stick z-chunks run their z matmuls while chunk k+1's collective
+            # is in flight — the mirror of the backward chunk pipeline
+            flat_re, flat_im, m = self._forward_flats(gre, gim)
+            m_by_shard = m.reshape(p.num_shards, S)
+            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
+            parts_re, parts_im = [], []
+            for c0, c1 in self._chunks:
+                with jax.named_scope("pack"):
+                    mc = jnp.asarray(m_by_shard[:, c0:c1].reshape(-1))
+                    bre = jnp.take(flat_re, mc, axis=0).reshape(
+                        p.num_shards, c1 - c0, L
+                    )
+                    bim = jnp.take(flat_im, mc, axis=0).reshape(
+                        p.num_shards, c1 - c0, L
+                    )
+                with jax.named_scope("exchange overlapped"):
+                    rre, rim = self._exchange(bre, bim)
+                with jax.named_scope("unpack"):
+                    cre = rre.transpose(1, 0, 2).reshape(c1 - c0, p.num_shards * L)
+                    cim = rim.transpose(1, 0, 2).reshape(c1 - c0, p.num_shards * L)
+                    if not self._uniform_z:
+                        zmap = jnp.asarray(self._unpack_z)
+                        cre = jnp.take(cre, zmap, axis=1)
+                        cim = jnp.take(cim, zmap, axis=1)
+                with jax.named_scope("z transform"):
+                    if cos_t is not None:
+                        cre, cim = lanecopy.apply_alignment_phase(
+                            cre, cim, cos_t[c0:c1], sin_t[c0:c1], +1
+                        )
+                    cre, cim = offt.complex_matmul(
+                        cre, cim, *self._wz_f[ScalingType(scaling)],
+                        "sz,zk->sk", prec,
+                    )
+                parts_re.append(cre)
+                parts_im.append(cim)
+            sre = jnp.concatenate(parts_re, axis=0)
+            sim = jnp.concatenate(parts_im, axis=0)
+        elif self._ragged is not None:
             with jax.named_scope("exchange"):
                 # (nslots, L) slot-major rows (round-5 row-granular contract)
                 if self._sparse_y:
@@ -727,28 +867,10 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             # pack: gather every global stick's compact plane slot (or sparse-y
             # table row) from my planes
             with jax.named_scope("pack"):
-                if self._sparse_y:
-                    flat_re = jnp.concatenate(
-                        [gre.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
-                    )
-                    flat_im = jnp.concatenate(
-                        [gim.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
-                    )
-                    m = jnp.asarray(self._stick_row)
-                elif self._sparse_y_blocked is not None:
-                    flat_re = jnp.concatenate([gre, jnp.zeros((1, L), rt)])
-                    flat_im = jnp.concatenate([gim, jnp.zeros((1, L), rt)])
-                    m = jnp.asarray(self._stick_row_b)
-                else:
-                    flat_re = jnp.concatenate(
-                        [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-                    )
-                    flat_im = jnp.concatenate(
-                        [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
-                    )
-                    m = jnp.asarray(self._stick_yx)
-                bre = jnp.take(flat_re, m, axis=0).reshape(p.num_shards, S, L)
-                bim = jnp.take(flat_im, m, axis=0).reshape(p.num_shards, S, L)
+                flat_re, flat_im, m = self._forward_flats(gre, gim)
+                mj = jnp.asarray(m)
+                bre = jnp.take(flat_re, mj, axis=0).reshape(p.num_shards, S, L)
+                bim = jnp.take(flat_im, mj, axis=0).reshape(p.num_shards, S, L)
 
             with jax.named_scope("exchange"):
                 rre, rim = self._exchange(bre, bim)
@@ -762,18 +884,17 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
                     sre = jnp.take(sre, zmap, axis=1)
                     sim = jnp.take(sim, zmap, axis=1)
 
-        with jax.named_scope("z transform"):
-            if phase_re is not None:
-                # enter the rotated layout on the space side (fused multiply)
-                sre, sim = lanecopy.apply_alignment_phase(
-                    sre, sim, phase_re[0], phase_im[0], +1
+        if self._overlap == 1:
+            with jax.named_scope("z transform"):
+                cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
+                if cos_t is not None:
+                    # enter the rotated layout on the space side (fused multiply)
+                    sre, sim = lanecopy.apply_alignment_phase(
+                        sre, sim, cos_t, sin_t, +1
+                    )
+                sre, sim = offt.complex_matmul(
+                    sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
                 )
-            elif self._align_rep is not None and self._align_rep[0] == "delta":
-                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
-            sre, sim = offt.complex_matmul(
-                sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
-            )
 
         with jax.named_scope("compression"):
             vre, vim = jax.lax.switch(

@@ -53,7 +53,7 @@ from ..types import (
     ScalingType,
     TransformType,
 )
-from .execution import PaddingHelpers, exchange_build_checkpoint
+from .execution import PaddingHelpers, chunk_ranges, exchange_build_checkpoint
 
 AX1 = "fft"   # x-group / y-slab axis (size P1)
 AX2 = "fft2"  # z-slab axis (size P2)
@@ -212,7 +212,8 @@ def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
 class Pencil2Execution(PaddingHelpers):
     """Compiled 2-D-pencil distributed pipelines for one plan (C2C or R2C)."""
 
-    def __init__(self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT):
+    def __init__(self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT,
+                 overlap: int = 1):
         self.params = params
         self.mesh = mesh
         self.real_dtype = np.dtype(real_dtype)
@@ -424,6 +425,20 @@ class Pencil2Execution(PaddingHelpers):
                 (AX1,): cls((AX1,), (P1,), rows_b, cols_b, Ly, int(Ax) * Lz),
             }
 
+        # OVERLAPPED discipline: the whole post-z pipeline (exchange A ->
+        # y-FFT -> exchange B -> x-FFT and its forward mirror) chunks along
+        # the local-z axis — each Lz sub-window runs its own A and B
+        # collectives, so chunk k's exchange A can fly while chunk k-1's
+        # y-FFTs compute and chunk k-1's exchange B while chunk k unpacks:
+        # the two collectives on disjoint mesh axes stop serializing.
+        # Padded wire formats only (the block chains already round-pipeline);
+        # clamped to the z-window extent.
+        if self._ragged2 is not None or p.num_shards <= 1:
+            self._overlap = 1
+        else:
+            self._overlap = max(1, min(int(overlap), Lz))
+        self._chunks = chunk_ranges(Lz, self._overlap)
+
         # ---- sharded constants + compiled pipelines ----
         both = (AX1, AX2)
         self.value_sharding = NamedSharding(mesh, P(both, None))
@@ -511,20 +526,30 @@ class Pencil2Execution(PaddingHelpers):
             c_item,
             stick_symmetry=self.is_r2c and p.zero_stick_shard >= 0,
         )
-        for tag, buf, elems in (
-            ("A", buf_a, a_elems),
-            ("B", buf_b, b_elems),
+        ov = getattr(self, "_overlap", 1)
+        for tag, buf, elems, hides in (
+            # backward: A chunks fly while neighbor chunks y-transform, B
+            # chunks while neighbor chunks x-transform (forward mirrors) —
+            # the compute stage each overlapped exchange hides behind, for
+            # the perf layer's exposed-time attribution (obs/perf.py)
+            ("A", buf_a, a_elems, "y transform"),
+            ("B", buf_b, b_elems, "x transform"),
         ):
             rows.append(
                 {"stage": f"pack {tag}", "flops": 0, "bytes": 2 * 2 * buf * c_item}
             )
-            rows.append(
-                {
-                    "stage": f"exchange {tag}",
-                    "flops": 0,
-                    "bytes": 2 * elems * 2 * wire_scalar,  # pair; 2 scalars/elem
-                }
-            )
+            xrow = {
+                "stage": (
+                    f"exchange {tag}" if ov == 1 else f"exchange {tag} overlapped"
+                ),
+                "flops": 0,
+                # pair; 2 scalars/elem — exact geometry wire bytes under
+                # BOTH labels (overlap changes exposure, never the volume)
+                "bytes": 2 * elems * 2 * wire_scalar,
+            }
+            if ov > 1:
+                xrow["overlap"] = {"chunks": int(ov), "hides": hides}
+            rows.append(xrow)
             rows.append(
                 {"stage": f"unpack {tag}", "flops": 0, "bytes": 2 * 2 * buf * c_item}
             )
@@ -535,19 +560,22 @@ class Pencil2Execution(PaddingHelpers):
 
     def exchange_rounds(self) -> int:
         """Sequential collective rounds per repartition pair (exchange A +
-        exchange B): 2 padded all_to_alls, the block chains' (P-1) + (P1-1)
-        rotations, or 2 one-shot ragged collectives for UNBUFFERED on
-        backends with the HLO."""
+        exchange B): 2 padded all_to_alls (2C chunk collectives under the
+        OVERLAPPED discipline — each z-window chunk runs its own A and B),
+        the block chains' (P-1) + (P1-1) rotations, or 2 one-shot ragged
+        collectives for UNBUFFERED on backends with the HLO."""
         if self._ragged2 is not None:
             return (
                 self._ragged2[(AX1, AX2)].rounds() + self._ragged2[(AX1,)].rounds()
             )
-        return 2
+        return 2 * int(getattr(self, "_overlap", 1))
 
     def exchange_transport(self) -> str:
         """Plan-card transport vocabulary for the pencil exchanges (A + B) —
         see PaddingHelpers.exchange_transport."""
         if self._ragged2 is None:
+            if getattr(self, "_overlap", 1) > 1:
+                return "chunked all_to_all"
             return "all_to_all"
         from .ragged import OneShotBlockExchange
 
@@ -560,6 +588,7 @@ class Pencil2Execution(PaddingHelpers):
         geometry and the x-group strategy the discipline selected."""
         return {
             "pipeline": "jnp.fft + scatter/gather (pencil shard_map)",
+            "overlap_chunks": int(self._overlap),
             "pencil_geometry": {
                 "p1": int(self.P1),
                 "p2": int(self.P2),
@@ -692,48 +721,61 @@ class Pencil2Execution(PaddingHelpers):
     # Reference pack/unpack being matched:
     # src/transpose/transpose_mpi_compact_buffered_host.cpp:109-175.
 
-    def _pack_a(self, sticks, s_me):
-        """(S, Z) stick table -> (P, SG, Lz) exchange-A blocks: one whole-row
+    def _pack_a(self, sticks, s_me, zwin=None):
+        """(S, Z) stick table -> (P, SG, W) exchange-A blocks: one whole-row
         gather of my sticks (sentinel rows -> zeros), then one static z-window
-        slice per destination z-slab (zero-padded to Lz)."""
+        slice per destination z-slab (zero-padded to the window width).
+        ``zwin``: the ``(c0, c1)`` sub-window of the padded Lz extent this
+        chunk ships (the OVERLAPPED discipline's unit; default the full
+        window)."""
         S, Z = self._S, self.params.dim_z
         P1, P2, SG, Lz = self.P1, self.P2, self._SG, self._Lz
+        c0, c1 = (0, Lz) if zwin is None else zwin
+        W = c1 - c0
         rows = jnp.asarray(self._rows)[s_me].reshape(-1)  # (P1*SG,), sentinel S
         padded = jnp.concatenate([sticks, jnp.zeros((1, Z), sticks.dtype)])
         g = jnp.take(padded, rows, axis=0)  # (P1*SG, Z)
         wins = []
         for b in range(P2):
             lz, zo = int(self._lz[b]), int(self._zo[b])
-            w = jax.lax.slice(g, (0, zo), (P1 * SG, zo + lz))
-            if lz < Lz:
-                w = jnp.pad(w, ((0, 0), (0, Lz - lz)))
+            lo, hi = min(c0, lz), min(c1, lz)
+            w = jax.lax.slice(g, (0, zo + lo), (P1 * SG, zo + hi))
+            if hi - lo < W:
+                w = jnp.pad(w, ((0, 0), (0, W - (hi - lo))))
             wins.append(w)
-        buf = jnp.stack(wins, axis=1)  # (P1*SG, P2, Lz)
-        return buf.reshape(P1, SG, P2, Lz).transpose(0, 2, 1, 3).reshape(
-            P1 * P2, SG, Lz
+        buf = jnp.stack(wins, axis=1)  # (P1*SG, P2, W)
+        return buf.reshape(P1, SG, P2, W).transpose(0, 2, 1, 3).reshape(
+            P1 * P2, SG, W
         )
 
     def _unpack_a(self, recv, a_me):
-        """(P, SG, Lz) received blocks -> (Y, Ax, Lz) y-pencil grid: one
-        whole-row gather through the per-group inverse row table."""
-        Y, Ax, Lz = self.params.dim_y, self._Ax, self._Lz
-        flat = recv.reshape(self.P1 * self.P2 * self._SG, Lz)
-        flat = jnp.concatenate([flat, jnp.zeros((1, Lz), recv.dtype)])
+        """(P, SG, W) received blocks -> (Y, Ax, W) y-pencil grid: one
+        whole-row gather through the per-group inverse row table (any
+        z-window width W <= Lz)."""
+        Y, Ax = self.params.dim_y, self._Ax
+        W = recv.shape[-1]
+        flat = recv.reshape(self.P1 * self.P2 * self._SG, W)
+        flat = jnp.concatenate([flat, jnp.zeros((1, W), recv.dtype)])
         inv = jnp.asarray(self._inv_rows)[a_me]  # (Y*Ax,), sentinel -> zero row
-        return jnp.take(flat, inv, axis=0).reshape(Y, Ax, Lz)
+        return jnp.take(flat, inv, axis=0).reshape(Y, Ax, W)
 
-    def _pack_a_rev(self, grid, a_me, b_me):
-        """(Y, Ax, Lz) grid -> (P, SG, Lz) blocks (forward direction): one
-        whole-row gather of each destination's stick rows."""
-        Y, Ax, Lz = self.params.dim_y, self._Ax, self._Lz
+    def _pack_a_rev(self, grid, a_me, b_me, z0=0):
+        """(Y, Ax, W) grid -> (P, SG, W) blocks (forward direction): one
+        whole-row gather of each destination's stick rows. ``z0``: the
+        window's offset inside the padded Lz extent (chunked forward packs
+        mask validity against absolute z positions)."""
+        Y, Ax = self.params.dim_y, self._Ax
         Pn, SG = self.P1 * self.P2, self._SG
-        g2 = grid.reshape(Y * Ax, Lz)
-        g2 = jnp.concatenate([g2, jnp.zeros((1, Lz), grid.dtype)])
+        W = grid.shape[-1]
+        g2 = grid.reshape(Y * Ax, W)
+        g2 = jnp.concatenate([g2, jnp.zeros((1, W), grid.dtype)])
         cols = jnp.asarray(self._cols)[:, a_me, :].reshape(-1)  # (P*SG,)
-        buf = jnp.take(g2, cols, axis=0).reshape(Pn, SG, Lz)
+        buf = jnp.take(g2, cols, axis=0).reshape(Pn, SG, W)
         # ship zeros beyond my z-length (padded windows must stay clean)
         lz_me = jnp.asarray(self._lz.astype(np.int32))[b_me]
-        return jnp.where(jnp.arange(Lz)[None, None, :] < lz_me, buf, 0)
+        return jnp.where(
+            (z0 + jnp.arange(W))[None, None, :] < lz_me, buf, 0
+        )
 
     def _unpack_a_rev(self, recv, s_me):
         """(P, SG, Lz) received z-windows -> (S, Z) stick table (forward
@@ -756,21 +798,23 @@ class Pencil2Execution(PaddingHelpers):
         return jnp.take(rows, src, axis=0)
 
     def _pack_b(self, grid):
-        """(Y, Ax, Lz) grid -> (P1, Ly, Ax, Lz) exchange-B blocks: one
-        whole-row gather of each destination's y-rows."""
-        Ax, Lz, Ly, P1 = self._Ax, self._Lz, self._Ly, self.P1
+        """(Y, Ax, W) grid -> (P1, Ly, Ax, W) exchange-B blocks: one
+        whole-row gather of each destination's y-rows (any z-window width)."""
+        Ly, P1 = self._Ly, self.P1
+        Ax, W = grid.shape[1], grid.shape[2]
         gp = jnp.concatenate(
-            [grid, jnp.zeros((1, Ax, Lz), grid.dtype)], axis=0
+            [grid, jnp.zeros((1, Ax, W), grid.dtype)], axis=0
         )
         return jnp.take(gp, jnp.asarray(self._ymap), axis=0).reshape(
-            P1, Ly, Ax, Lz
+            P1, Ly, Ax, W
         )
 
     def _unpack_b_rev(self, recvb):
-        """(P1, Ly, Ax, Lz) received blocks -> (Y, Ax, Lz) grid (forward
+        """(P1, Ly, Ax, W) received blocks -> (Y, Ax, W) grid (forward
         direction): one whole-row gather through the y inverse map."""
-        Ax, Lz, Ly, P1 = self._Ax, self._Lz, self._Ly, self.P1
-        rows = recvb.reshape(P1 * Ly, Ax, Lz)
+        Ly, P1 = self._Ly, self.P1
+        Ax, W = recvb.shape[2], recvb.shape[3]
+        rows = recvb.reshape(P1 * Ly, Ax, W)
         return jnp.take(rows, jnp.asarray(self._yinv), axis=0)
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
@@ -805,53 +849,68 @@ class Pencil2Execution(PaddingHelpers):
         with jax.named_scope("z transform"):
             sticks = jnp.fft.ifft(sticks, axis=1)
 
-        # pack A: my sticks split by destination (x-group a', z-slab b')
-        with jax.named_scope("pack A"):
-            buf = self._pack_a(sticks, s_me)
+        # The post-z pipeline runs once per z-window chunk (one full-window
+        # chunk bulk-synchronously; C chunks under the OVERLAPPED discipline,
+        # where chunk k's exchange A can fly while chunk k-1 y-transforms and
+        # chunk k-1's exchange B while chunk k unpacks — the two collectives
+        # on disjoint mesh axes stop serializing).
+        ov = self._overlap > 1
+        parts = []
+        for c0, c1 in self._chunks:
+            # pack A: my sticks split by destination (x-group a', z-slab b')
+            with jax.named_scope("pack A"):
+                buf = self._pack_a(sticks, s_me, zwin=(c0, c1))
 
-        # exchange A: one collective over BOTH mesh axes (flat row-major (a, b))
-        with jax.named_scope("exchange A"):
-            recv = self._exchange(buf, (AX1, AX2))  # (P, SG, Lz) = s's sticks here
+            # exchange A: one collective over BOTH mesh axes (row-major (a, b))
+            with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
+                recv = self._exchange(buf, (AX1, AX2))  # (P, SG, W): s's sticks
 
-        # unpack A -> y-pencil grid (Y, Ax, Lz): all sticks in my x-group, my z
-        with jax.named_scope("unpack A"):
-            grid = self._unpack_a(recv, a_me)
+            # unpack A -> y-pencil grid (Y, Ax, W): my x-group's sticks, my z
+            with jax.named_scope("unpack A"):
+                grid = self._unpack_a(recv, a_me)
 
-        if self.is_r2c and self._have_x0:
-            # x == 0 plane hermitian fill along y on its (group, slot) owner,
-            # which has the FULL y extent here (z is space-domain)
-            with jax.named_scope("plane symmetry"):
-                g0, s0 = self._x0_group, self._x0_slot
-                col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
-                grid = grid.at[:, s0, :].set(
-                    jnp.where(a_me == g0, col, grid[:, s0, :])
-                )
+            if self.is_r2c and self._have_x0:
+                # x == 0 plane hermitian fill along y on its (group, slot)
+                # owner, which has the FULL y extent here (z is space-domain)
+                with jax.named_scope("plane symmetry"):
+                    g0, s0 = self._x0_group, self._x0_slot
+                    col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
+                    grid = grid.at[:, s0, :].set(
+                        jnp.where(a_me == g0, col, grid[:, s0, :])
+                    )
 
-        with jax.named_scope("y transform"):
-            grid = jnp.fft.ifft(grid, axis=0)
+            with jax.named_scope("y transform"):
+                grid = jnp.fft.ifft(grid, axis=0)
 
-        # pack B: gather each destination's y-rows (within my fixed z-slab)
-        with jax.named_scope("pack B"):
-            bufb = self._pack_b(grid)
+            # pack B: gather each destination's y-rows (within my z-window)
+            with jax.named_scope("pack B"):
+                bufb = self._pack_b(grid)
 
-        # exchange B: within the row (fixed z-slab), over the x-group axis
-        with jax.named_scope("exchange B"):
-            recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, Lz): q's x-cols, my y
+            # exchange B: within the row (fixed z-slab), over the x-group axis
+            with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
+                recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, W)
 
-        # assemble the full frequency-x extent and transform
-        with jax.named_scope("unpack B"):
-            h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
-            slab = jnp.zeros((Ly, Xf + 1, Lz), dtype=self.complex_dtype)
-            slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
-            slab = slab[:, :Xf, :]
+            # assemble the full frequency-x extent and transform
+            with jax.named_scope("unpack B"):
+                h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
+                slab = jnp.zeros((Ly, Xf + 1, c1 - c0), dtype=self.complex_dtype)
+                slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
+                slab = slab[:, :Xf, :]
+            with jax.named_scope("x transform"):
+                if self.is_r2c:
+                    out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(
+                        self.real_dtype
+                    )
+                else:
+                    out = jnp.fft.ifft(slab, axis=1)
+                # (W, Ly, X) slice of the space slab contract
+                parts.append(out.transpose(2, 0, 1))
         total = np.asarray(p.total_size, self.real_dtype)
-        with jax.named_scope("x transform"):
-            if self.is_r2c:
-                out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(self.real_dtype)
-                return (out.transpose(2, 0, 1) * total)[None]
-            out = jnp.fft.ifft(slab, axis=1) * total
-            out = out.transpose(2, 0, 1)  # (Lz, Ly, X) space slab contract
-            return out.real[None], out.imag[None]
+        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        if self.is_r2c:
+            return (out * total)[None]
+        out = out * total
+        return out.real[None], out.imag[None]
 
     def _forward_impl(self, space_re, *rest, scale):
         p = self.params
@@ -861,43 +920,55 @@ class Pencil2Execution(PaddingHelpers):
         b_me = jax.lax.axis_index(AX2)
         s_me = a_me * P2 + b_me
 
-        with jax.named_scope("x transform"):
-            if self.is_r2c:
-                (value_indices,) = rest
-                slab = space_re[0].astype(self.real_dtype)
-                freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
-            else:
-                space_im, value_indices = rest
-                slab = jax.lax.complex(
-                    space_re[0].astype(self.real_dtype),
-                    space_im[0].astype(self.real_dtype),
+        if self.is_r2c:
+            (value_indices,) = rest
+            space_im = None
+        else:
+            space_im, value_indices = rest
+
+        # Forward mirror of the backward chunk loop: each z-window chunk
+        # x-transforms, ships its exchange B, y-transforms, and ships its
+        # exchange A — under the OVERLAPPED discipline chunk k's collectives
+        # fly while the neighbor chunks' FFTs compute.
+        ov = self._overlap > 1
+        recvs = []
+        for c0, c1 in self._chunks:
+            with jax.named_scope("x transform"):
+                if self.is_r2c:
+                    slab = space_re[0][c0:c1].astype(self.real_dtype)
+                    freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
+                else:
+                    slab = jax.lax.complex(
+                        space_re[0][c0:c1].astype(self.real_dtype),
+                        space_im[0][c0:c1].astype(self.real_dtype),
+                    )
+                    freq = jnp.fft.fft(slab, axis=2)  # (W, Ly, Xf)
+
+            # split into x-group columns, send each group home (exchange B rev)
+            with jax.named_scope("pack B"):
+                fq = freq.transpose(1, 2, 0)  # (Ly, Xf, W) z-minor
+                hpad = jnp.concatenate(
+                    [fq, jnp.zeros((Ly, 1, c1 - c0), self.complex_dtype)], axis=1
                 )
-                freq = jnp.fft.fft(slab, axis=2)  # (Lz, Ly, Xf)
+                h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)
+                bufb = h.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
+            # (P1, Ly, Ax, W): my x-group, q's y
+            with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
+                recvb = self._exchange(bufb, (AX1,), reverse=True)
 
-        # split into x-group columns and send each group home (exchange B rev)
-        with jax.named_scope("pack B"):
-            fq = freq.transpose(1, 2, 0)  # (Ly, Xf, Lz) z-minor
-            hpad = jnp.concatenate(
-                [fq, jnp.zeros((Ly, 1, Lz), self.complex_dtype)], axis=1
-            )
-            h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)  # (Ly, P1*Ax, Lz)
-            bufb = h.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
-        # (P1, Ly, Ax, Lz): my x-group, q's y
-        with jax.named_scope("exchange B"):
-            recvb = self._exchange(bufb, (AX1,), reverse=True)
+            # reassemble the full y extent of my x-group
+            with jax.named_scope("unpack B"):
+                grid = self._unpack_b_rev(recvb)  # (Y, Ax, W)
+            with jax.named_scope("y transform"):
+                grid = jnp.fft.fft(grid, axis=0)
 
-        # reassemble the full y extent of my x-group
-        with jax.named_scope("unpack B"):
-            grid = self._unpack_b_rev(recvb)  # (Y, Ax, Lz)
-        with jax.named_scope("y transform"):
-            grid = jnp.fft.fft(grid, axis=0)
-
-        # exchange A reverse: each stick's z-chunk back to its owner
-        with jax.named_scope("pack A"):
-            buf = self._pack_a_rev(grid, a_me, b_me)  # (P, SG, Lz)
-        # (P, SG, Lz): my sticks, p's z
-        with jax.named_scope("exchange A"):
-            recv = self._exchange(buf, (AX1, AX2), reverse=True)
+            # exchange A reverse: each stick's z-chunk back to its owner
+            with jax.named_scope("pack A"):
+                buf = self._pack_a_rev(grid, a_me, b_me, z0=c0)  # (P, SG, W)
+            # (P, SG, W): my sticks, p's z
+            with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
+                recvs.append(self._exchange(buf, (AX1, AX2), reverse=True))
+        recv = recvs[0] if len(recvs) == 1 else jnp.concatenate(recvs, axis=-1)
 
         # reassemble my (S, Z) stick table and transform
         with jax.named_scope("unpack A"):

@@ -36,10 +36,10 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
     def __init__(
         self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT,
-        precision="highest",
+        precision="highest", overlap: int = 1,
     ):
         self._precision = offt.resolve_precision(precision)
-        super().__init__(params, real_dtype, mesh, exchange_type)
+        super().__init__(params, real_dtype, mesh, exchange_type, overlap=overlap)
         p = params
         rt = self.real_dtype
         self._wz_b, self._wy_b, self._wy_f, self._wz_f = offt.zy_stage_matrices(
@@ -114,50 +114,79 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
                 cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
                 sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
 
-        # pack A: my sticks split by destination (x-group, z-slab) — whole-row
-        # gathers + static window slices (base-class helpers; z-minor layout)
-        with jax.named_scope("pack A"):
-            bre = self._pack_a(sre, s_me)
-            bim = self._pack_a(sim, s_me)
+        # Post-z chunk loop (see Pencil2Execution._backward_impl): one
+        # full-window chunk bulk-synchronously, C z-window chunks under the
+        # OVERLAPPED discipline so the A/B collectives pipeline against the
+        # neighbor chunks' matmuls.
+        ov = self._overlap > 1
+        parts_re, parts_im = [], []
+        for c0, c1 in self._chunks:
+            # pack A: my sticks split by destination (x-group, z-slab) —
+            # whole-row gathers + static window slices (base-class helpers)
+            with jax.named_scope("pack A"):
+                bre = self._pack_a(sre, s_me, zwin=(c0, c1))
+                bim = self._pack_a(sim, s_me, zwin=(c0, c1))
 
-        with jax.named_scope("exchange A"):
-            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
+            with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
+                rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
 
-        # unpack A -> (Y, Ax, Lz) y-pencil grid (one row gather per part)
-        with jax.named_scope("unpack A"):
-            gre = self._unpack_a(rre, a_me)
-            gim = self._unpack_a(rim, a_me)
+            # unpack A -> (Y, Ax, W) y-pencil grid (one row gather per part)
+            with jax.named_scope("unpack A"):
+                gre = self._unpack_a(rre, a_me)
+                gim = self._unpack_a(rim, a_me)
 
-        if self.is_r2c and self._have_x0:
-            with jax.named_scope("plane symmetry"):
-                g0, s0 = self._x0_group, self._x0_slot
-                pre, pim = symmetry.hermitian_fill_1d_pair(
-                    gre[:, s0, :], gim[:, s0, :], axis=0
+            if self.is_r2c and self._have_x0:
+                with jax.named_scope("plane symmetry"):
+                    g0, s0 = self._x0_group, self._x0_slot
+                    pre, pim = symmetry.hermitian_fill_1d_pair(
+                        gre[:, s0, :], gim[:, s0, :], axis=0
+                    )
+                    gre = gre.at[:, s0, :].set(
+                        jnp.where(a_me == g0, pre, gre[:, s0, :])
+                    )
+                    gim = gim.at[:, s0, :].set(
+                        jnp.where(a_me == g0, pim, gim[:, s0, :])
+                    )
+
+            with jax.named_scope("y transform"):
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_b, "yal,yk->kal", prec
                 )
-                gre = gre.at[:, s0, :].set(jnp.where(a_me == g0, pre, gre[:, s0, :]))
-                gim = gim.at[:, s0, :].set(jnp.where(a_me == g0, pim, gim[:, s0, :]))
 
-        with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_b, "yal,yk->kal", prec)
+            # pack B: each destination's y-rows (within my z-window)
+            with jax.named_scope("pack B"):
+                bre = self._pack_b(gre)
+                bim = self._pack_b(gim)
 
-        # pack B: each destination's y-rows (within my fixed z-slab)
-        with jax.named_scope("pack B"):
-            bre = self._pack_b(gre)
-            bim = self._pack_b(gim)
+            with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
+                rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
 
-        with jax.named_scope("exchange B"):
-            rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
-
-        # x transform: the slot->x map is folded into the matrix (zero rows on
-        # sentinel slots), so assembly is a pure reshape + matmul
-        with jax.named_scope("x transform"):
-            hre = rbre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
-            him = rbim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, Lz)
-            if self.is_r2c:
-                out = offt.real_out_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
-                return out[None]
-            ore, oim = offt.complex_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
-            return ore[None], oim[None]
+            # x transform: the slot->x map is folded into the matrix (zero
+            # rows on sentinel slots), so assembly is a reshape + matmul
+            with jax.named_scope("x transform"):
+                hre = rbre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
+                him = rbim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
+                if self.is_r2c:
+                    parts_re.append(
+                        offt.real_out_matmul(
+                            hre, him, *self._wx_b, "ycl,cx->lyx", prec
+                        )
+                    )
+                else:
+                    ore, oim = offt.complex_matmul(
+                        hre, him, *self._wx_b, "ycl,cx->lyx", prec
+                    )
+                    parts_re.append(ore)
+                    parts_im.append(oim)
+        if self.is_r2c:
+            out = (
+                parts_re[0] if len(parts_re) == 1
+                else jnp.concatenate(parts_re, axis=0)
+            )
+            return out[None]
+        ore = parts_re[0] if len(parts_re) == 1 else jnp.concatenate(parts_re, axis=0)
+        oim = parts_im[0] if len(parts_im) == 1 else jnp.concatenate(parts_im, axis=0)
+        return ore[None], oim[None]
 
     def _forward_impl(self, space_re, *rest, scale):
         p = self.params
@@ -170,40 +199,63 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         s_me = a_me * P2 + b_me
         scaling = ScalingType.NONE if scale is None else ScalingType.FULL
 
-        with jax.named_scope("x transform"):
-            if self.is_r2c:
-                (_,) = rest  # value_indices unused (lane-copy branches)
-                hre, him = offt.real_in_matmul(
-                    space_re[0].astype(rt), *self._wx_f, "lyx,xc->ycl", prec
+        if self.is_r2c:
+            (_,) = rest  # value_indices unused (lane-copy branches)
+            space_im = None
+        else:
+            space_im, _ = rest
+
+        # Forward mirror of the backward chunk loop (see
+        # Pencil2Execution._forward_impl).
+        ov = self._overlap > 1
+        recvs_re, recvs_im = [], []
+        for c0, c1 in self._chunks:
+            with jax.named_scope("x transform"):
+                if self.is_r2c:
+                    hre, him = offt.real_in_matmul(
+                        space_re[0][c0:c1].astype(rt), *self._wx_f,
+                        "lyx,xc->ycl", prec,
+                    )
+                else:
+                    hre, him = offt.complex_matmul(
+                        space_re[0][c0:c1].astype(rt),
+                        space_im[0][c0:c1].astype(rt),
+                        *self._wx_f, "lyx,xc->ycl", prec,
+                    )
+
+            # exchange B reverse: send each x-group home (within my z-window)
+            with jax.named_scope("pack B"):
+                bre = hre.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
+                bim = him.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
+            with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
+                rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
+
+            # reassemble the full y extent of my x-group (one row gather each)
+            with jax.named_scope("unpack B"):
+                gre = self._unpack_b_rev(rbre)
+                gim = self._unpack_b_rev(rbim)
+
+            with jax.named_scope("y transform"):
+                gre, gim = offt.complex_matmul(
+                    gre, gim, *self._wy_f, "yal,yj->jal", prec
                 )
-            else:
-                space_im, _ = rest
-                hre, him = offt.complex_matmul(
-                    space_re[0].astype(rt), space_im[0].astype(rt),
-                    *self._wx_f, "lyx,xc->ycl", prec,
-                )
 
-        # exchange B reverse: send each x-group home (within my z-slab)
-        with jax.named_scope("pack B"):
-            bre = hre.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
-            bim = him.reshape(Ly, P1, Ax, Lz).transpose(1, 0, 2, 3)
-        with jax.named_scope("exchange B"):
-            rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
-
-        # reassemble the full y extent of my x-group (one row gather per part)
-        with jax.named_scope("unpack B"):
-            gre = self._unpack_b_rev(rbre)
-            gim = self._unpack_b_rev(rbim)
-
-        with jax.named_scope("y transform"):
-            gre, gim = offt.complex_matmul(gre, gim, *self._wy_f, "yal,yj->jal", prec)
-
-        # exchange A reverse: each stick's z-chunk back to its owner
-        with jax.named_scope("pack A"):
-            bre = self._pack_a_rev(gre, a_me, b_me)
-            bim = self._pack_a_rev(gim, a_me, b_me)
-        with jax.named_scope("exchange A"):
-            rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
+            # exchange A reverse: each stick's z-chunk back to its owner
+            with jax.named_scope("pack A"):
+                bre = self._pack_a_rev(gre, a_me, b_me, z0=c0)
+                bim = self._pack_a_rev(gim, a_me, b_me, z0=c0)
+            with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
+                rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
+            recvs_re.append(rre)
+            recvs_im.append(rim)
+        rre = (
+            recvs_re[0] if len(recvs_re) == 1
+            else jnp.concatenate(recvs_re, axis=-1)
+        )
+        rim = (
+            recvs_im[0] if len(recvs_im) == 1
+            else jnp.concatenate(recvs_im, axis=-1)
+        )
 
         with jax.named_scope("unpack A"):
             sre = self._unpack_a_rev(rre, s_me)

@@ -34,6 +34,42 @@ from ..types import ExchangeType
 
 ROUND_COST_ENV = "SPFFT_TPU_EXCH_ROUND_COST_KB"
 
+# ---- communication/compute overlap (the OVERLAPPED exchange discipline) -----
+#
+# Chunk count of the chunked, double-buffered exchange pipelines: the padded
+# single-collective disciplines (BUFFERED and its *_FLOAT/*_BF16 wire
+# variants) split each repartition into C independent chunk collectives so
+# chunk k's wire time can hide behind chunk k+1's FFTs (the pipelined
+# all-to-all designs of arxiv.org/pdf/1804.09536 / arxiv.org/pdf/2306.16589).
+# 1 = the classic bulk-synchronous exchange. Resolved per plan: explicit
+# ``overlap=`` argument, else SPFFT_TPU_OVERLAP_CHUNKS, else 1 — and under
+# ``policy="tuned"`` the autotuner owns the knob (tuning/candidates.py
+# enumerates overlap variants and wisdom remembers the measured winner).
+OVERLAP_ENV = "SPFFT_TPU_OVERLAP_CHUNKS"
+
+
+def resolve_overlap_chunks(overlap=None) -> int:
+    """The requested exchange-overlap chunk count: explicit argument, else
+    the ``SPFFT_TPU_OVERLAP_CHUNKS`` env knob, else 1 (no chunking). Engines
+    clamp the request to what their geometry supports (chunkable extent,
+    padded discipline, P > 1) — this resolves intent, not feasibility."""
+    from ..errors import InvalidParameterError
+
+    if overlap is None:
+        raw = os.environ.get(OVERLAP_ENV, "1")
+        try:
+            overlap = int(raw)
+        except ValueError:
+            raise InvalidParameterError(
+                f"{OVERLAP_ENV} must be a positive integer, got {raw!r}"
+            ) from None
+    overlap = int(overlap)
+    if overlap < 1:
+        raise InvalidParameterError(
+            f"overlap chunk count must be >= 1, got {overlap}"
+        )
+    return overlap
+
 # ---- plan-decision policies -------------------------------------------------
 #
 # "default": this module's analytic cost model resolves ExchangeType.DEFAULT

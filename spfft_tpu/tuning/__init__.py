@@ -168,24 +168,54 @@ def local_key(params, device, dtype, precision) -> dict:
     return key
 
 
-def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
+def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build,
+                   overlap=None):
     """Resolve ``ExchangeType.DEFAULT`` under the TUNED policy.
 
-    Returns ``(ExchangeType, record)``. Wisdom hit -> the stored choice, zero
-    trials. Miss with trials allowed -> measure the candidate disciplines via
-    ``build`` (a caller closure constructing explicit-discipline trial plans
-    with the model policy), persist, return the winner. Miss with trials
-    skipped (CPU-only host, ``runner.trials_allowed``) -> the model policy's
-    pick (1-D slab: ``policy.resolve_default_for_plan``; 2-D pencil: DEFAULT
-    is left for the engine's internal model resolver), recorded as
-    ``provenance="model"`` with the skip reason.
+    Returns ``(ExchangeType, overlap_chunks, record)``. Wisdom hit -> the
+    stored choice, zero trials. Miss with trials allowed -> measure the
+    candidate disciplines via ``build`` (a caller closure constructing
+    explicit-discipline trial plans with the model policy), persist, return
+    the winner. Miss with trials skipped (CPU-only host,
+    ``runner.trials_allowed``) -> the model policy's pick (1-D slab:
+    ``policy.resolve_default_for_plan``; 2-D pencil: DEFAULT is left for the
+    engine's internal model resolver), recorded as ``provenance="model"``
+    with the skip reason.
+
+    ``overlap``: the caller's explicit exchange-overlap chunk count, or
+    ``None`` to hand the knob to the tuner — candidates then include the
+    OVERLAPPED chunk variants (``candidates.exchange_candidates``) and the
+    measured chunk count persists in wisdom alongside the discipline. The
+    model fallbacks resolve an unset knob through
+    ``policy.resolve_overlap_chunks`` (the env default), never a constant
+    the tuner cannot revisit.
     """
     from ..parallel.execution import mesh_process_span
-    from ..parallel.policy import resolve_default_for_plan
+    from ..parallel.policy import (
+        resolve_default_for_plan,
+        resolve_overlap_chunks,
+    )
     from ..types import ExchangeType
 
     key = exchange_key(params, mesh, dtype, engine, precision, pencil2)
+    # an explicit pin and the tuner-owned axis are different decision
+    # problems — keying them apart stops a tuner-resolved entry from
+    # answering (and silently overriding) a pinned construction
+    key["overlap"] = "tuned" if overlap is None else int(overlap)
     store = active_store()
+    fallback_overlap = resolve_overlap_chunks(overlap)
+
+    def model(pick, trials, reason):
+        return pick, fallback_overlap, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice={"exchange_type": pick.name, "overlap": fallback_overlap},
+            trials=trials,
+            reason=reason,
+            key=key,
+        )
+
     if params.num_shards <= 1:
         # no exchange happens on a single shard — the decision has zero
         # effect, so never pay trials for it (mirrors the model path's
@@ -193,15 +223,7 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
         pick = (
             ExchangeType.DEFAULT if pencil2 else ExchangeType.BUFFERED
         )
-        return pick, _record(
-            "model",
-            hit=False,
-            store=store,
-            choice={"exchange_type": pick.name},
-            trials=[],
-            reason="single shard: exchange discipline has no effect",
-            key=key,
-        )
+        return model(pick, [], "single shard: exchange discipline has no effect")
     if mesh_process_span(mesh) > 1:
         # Multi-host meshes: tuning is per-process, so one host hitting
         # wisdom while another runs trial collectives — or two hosts'
@@ -214,26 +236,28 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
             if pencil2
             else resolve_default_for_plan(params, mesh, dtype)
         )
-        return pick, _record(
-            "model",
-            hit=False,
-            store=store,
-            choice={"exchange_type": pick.name},
-            trials=[],
-            reason="multi-host mesh: tuning requires cross-process agreement",
-            key=key,
+        return model(
+            pick, [], "multi-host mesh: tuning requires cross-process agreement"
         )
     entry = store.lookup(key)
     if entry is not None:
         choice = entry["choice"]
-        return ExchangeType[choice["exchange_type"]], _record(
-            "wisdom",
-            hit=True,
-            store=store,
-            choice=choice,
-            trials=entry.get("trials", []),
-            reason="wisdom hit",
-            key=key,
+        return (
+            ExchangeType[choice["exchange_type"]],
+            # the key separates pinned and tuner-owned entries, so the
+            # stored count matches this construction's pin context; the
+            # explicit pin still wins outright for defense in depth
+            int(choice.get("overlap", 1)) if overlap is None
+            else fallback_overlap,
+            _record(
+                "wisdom",
+                hit=True,
+                store=store,
+                choice=choice,
+                trials=entry.get("trials", []),
+                reason="wisdom hit",
+                key=key,
+            ),
         )
     platform = str(mesh.devices.flat[0].platform)
     if not trials_allowed(platform):
@@ -244,17 +268,9 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
             pick = ExchangeType.DEFAULT  # engine-internal model resolution
         else:
             pick = resolve_default_for_plan(params, mesh, dtype)
-        return pick, _record(
-            "model",
-            hit=False,
-            store=store,
-            choice={"exchange_type": pick.name},
-            trials=[],
-            reason=reason,
-            key=key,
-        )
+        return model(pick, [], reason)
     if pencil2:
-        cands = exchange_candidates(pencil2=True)
+        cands = exchange_candidates(pencil2=True, overlap=overlap)
     else:
         from ..parallel.ragged import _ragged_a2a_supported
         from ..types import wire_scalar_bytes
@@ -265,6 +281,7 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
             one_shot_supported=params.num_shards > 1
             and _ragged_a2a_supported(mesh),
             wire_scalar_bytes=wire_scalar_bytes(ExchangeType.DEFAULT, dtype),
+            overlap=overlap,
         )
     trials = run_trials(build, cands)
     measured = [row for row in trials if "ms" in row]
@@ -276,18 +293,13 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build):
             if pencil2
             else resolve_default_for_plan(params, mesh, dtype)
         )
-        return pick, _record(
-            "model",
-            hit=False,
-            store=store,
-            choice={"exchange_type": pick.name},
-            trials=trials,
-            reason="all trial candidates failed",
-            key=key,
-        )
-    choice = {"exchange_type": measured[0]["exchange_type"]}
+        return model(pick, trials, "all trial candidates failed")
+    choice = {
+        "exchange_type": measured[0]["exchange_type"],
+        "overlap": int(measured[0].get("overlap", 1)),
+    }
     store.record(key, make_entry(key, choice, trials))
-    return ExchangeType[choice["exchange_type"]], _record(
+    return ExchangeType[choice["exchange_type"]], choice["overlap"], _record(
         "wisdom",
         hit=False,
         store=store,

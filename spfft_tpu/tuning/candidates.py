@@ -7,6 +7,12 @@ already structures elsewhere into trial plans:
   cost model's table (``parallel/policy.alternative_costs`` — the same
   accounting plan cards embed), ordered by model cost so the trial log reads
   model-first and an early-exit budget would try the model's pick first.
+- **Overlap candidates** (distributed plans): the chunk counts of the
+  OVERLAPPED exchange discipline (chunked, double-buffered padded
+  collectives — parallel/execution.py) as ``BUFFERED/ovC`` variants of the
+  padded discipline, so the autotuner — not a constant — owns the
+  communication/compute-overlap knob. An explicit ``overlap=`` pin disables
+  the axis (every candidate is then trialed at the pinned chunk count).
 - **Local candidates**: the local engine axis — the MXU matmul-DFT engine
   under its measured sparse-y auto knobs, the same engine with the sparse-y
   variants forced dense (the regime where the auto thresholds mis-predict),
@@ -14,10 +20,16 @@ already structures elsewhere into trial plans:
 
 Every candidate is a plain JSON-stable dict: ``label`` (stable id, what
 wisdom/trial tables store), plus the constructor-level facts a builder needs
-(``exchange_type`` for distributed, ``engine`` + ``env`` overrides for
-local).
+(``exchange_type`` + ``overlap`` for distributed, ``engine`` + ``env``
+overrides for local).
 """
 from __future__ import annotations
+
+# Chunk counts the OVERLAPPED-discipline axis trials when the caller leaves
+# the knob to the tuner (overlap=None). Small powers of two: chunking past
+# a handful of chunks trades per-collective efficiency for no extra hiding
+# (the hideable wire time saturates at (C-1)/C of min(exchange, compute)).
+OVERLAP_CANDIDATE_CHUNKS = (2, 4)
 
 
 def exchange_candidates(
@@ -27,6 +39,7 @@ def exchange_candidates(
     one_shot_supported: bool = False,
     wire_scalar_bytes: int = 4,
     pencil2: bool = False,
+    overlap=None,
 ) -> list:
     """Exchange-discipline candidates for a distributed plan.
 
@@ -38,7 +51,14 @@ def exchange_candidates(
     ``one_shot_supported`` feeds the model table exactly as in
     ``resolve_default_exchange`` (the caller probes the backend once before
     trials — parallel/ragged.py ``_ragged_a2a_supported``).
-    """
+
+    ``overlap=None`` adds the OVERLAPPED chunk variants of the padded
+    discipline (``BUFFERED/ovC`` for C in :data:`OVERLAP_CANDIDATE_CHUNKS`)
+    — modeled cost = the padded wire bytes plus C collective rounds, so the
+    model ranks them behind plain BUFFERED and the measurement decides
+    whether the hiding wins. An explicit integer pins every candidate at
+    that chunk count instead (the caller fixed the knob; only the
+    discipline axis is trialed)."""
     from ..types import ExchangeType
 
     disciplines = (
@@ -46,11 +66,23 @@ def exchange_candidates(
         ExchangeType.COMPACT_BUFFERED,
         ExchangeType.UNBUFFERED,
     )
+    pinned = int(overlap) if overlap is not None else None
     if pencil2 or num_sticks_per_shard is None:
-        return [
-            {"label": d.name, "exchange_type": d.name} for d in disciplines
+        cands = [
+            {"label": d.name, "exchange_type": d.name, "overlap": pinned or 1}
+            for d in disciplines
         ]
-    from ..parallel.policy import alternative_costs
+        if pinned is None:
+            cands.extend(
+                {
+                    "label": f"BUFFERED/ov{c}",
+                    "exchange_type": ExchangeType.BUFFERED.name,
+                    "overlap": int(c),
+                }
+                for c in OVERLAP_CANDIDATE_CHUNKS
+            )
+        return cands
+    from ..parallel.policy import alternative_costs, round_cost_bytes
 
     table = alternative_costs(
         num_sticks_per_shard,
@@ -62,10 +94,23 @@ def exchange_candidates(
         {
             "label": d.name,
             "exchange_type": d.name,
+            "overlap": pinned or 1,
             "model_cost_bytes": int(table[d]["cost_bytes"]),
         }
         for d in disciplines
     ]
+    if pinned is None:
+        wire = int(table[ExchangeType.BUFFERED]["wire_bytes"])
+        per_round = round_cost_bytes()
+        cands.extend(
+            {
+                "label": f"BUFFERED/ov{c}",
+                "exchange_type": ExchangeType.BUFFERED.name,
+                "overlap": int(c),
+                "model_cost_bytes": int(wire + c * per_round),
+            }
+            for c in OVERLAP_CANDIDATE_CHUNKS
+        )
     return sorted(cands, key=lambda c: c["model_cost_bytes"])
 
 

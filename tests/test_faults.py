@@ -268,10 +268,15 @@ def test_chaos_invariant_every_site(site_name, tmp_path, monkeypatch):
         assert card["degradations"], "engine fallback must be recorded"
 
 
+@pytest.mark.parametrize("overlap", [1, 2])
 @pytest.mark.parametrize(
     "site_name", ["exchange.build", "engine.compile", "engine.execute", "sync.fence"]
 )
-def test_chaos_invariant_distributed(site_name):
+def test_chaos_invariant_distributed(site_name, overlap):
+    """The distributed chaos invariant, for the bulk-synchronous AND the
+    OVERLAPPED (chunked double-buffered) exchange pipelines: a mid-pipeline
+    injection must land a typed error or a recorded degradation rung — the
+    chunk loop adds collectives, never a new silent-failure surface."""
     trip = _triplets()
     values = _values(trip)
     per_shard = distribute_triplets(trip, 2, DIM)
@@ -282,17 +287,47 @@ def test_chaos_invariant_distributed(site_name):
     kwargs = dict(engine="mxu") if site_name == "engine.compile" else {}
     with faults.inject(f"{site_name}=raise"):
         try:
-            t = _dist(per_shard, **kwargs)
+            t = _dist(per_shard, overlap=overlap, **kwargs)
             out = t.backward([v.copy() for v in vps])
         except errors.GenericError as e:
             assert capi.error_code(e) == int(e.error_code) != int(
                 errors.ErrorCode.SUCCESS
             )
             return
+    assert t.overlap_chunks == overlap
     assert_close(out, expect)
     assert obs.validate_plan_card(t.report()) == []
     if site_name == "engine.compile":
         assert t.report()["degradations"][0]["event"] == "engine_fallback"
+
+
+@pytest.mark.parametrize("site_name", ["exchange.build", "sync.fence"])
+def test_chaos_invariant_pencil_overlapped(site_name):
+    """The same invariant for the chunked pencil pipelines (exchange A and
+    B both overlapped) on a 2-D mesh."""
+    trip = _triplets()
+    values = _values(trip)
+    per_shard = distribute_triplets(trip, 4, DIM)
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    vps = [np.asarray([lut[tuple(x)] for x in s]) for s in per_shard]
+    expect = _local(trip).backward(values)
+
+    with faults.inject(f"{site_name}=raise"):
+        try:
+            t = DistributedTransform(
+                ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM,
+                [p.copy() for p in per_shard], mesh=sp.make_fft_mesh2(2, 2),
+                overlap=2,
+            )
+            out = t.backward([v.copy() for v in vps])
+        except errors.GenericError as e:
+            assert capi.error_code(e) == int(e.error_code) != int(
+                errors.ErrorCode.SUCCESS
+            )
+            return
+    assert t.overlap_chunks == 2
+    assert_close(out, expect)
+    assert obs.validate_plan_card(t.report()) == []
 
 
 # ---- targeted site behavior --------------------------------------------------

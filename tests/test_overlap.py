@@ -1,0 +1,355 @@
+"""OVERLAPPED exchange discipline: chunked double-buffered exchange parity.
+
+The chunk count must never change results: overlapped plans (every chunk
+count, both slab engines, the 2-D pencil path, C2C and R2C, f32 and f64,
+padded and ``*_FLOAT`` wire formats) must agree with their bulk-synchronous
+(``overlap=1``) twin and the local oracle. Seeding follows the
+``SPFFT_TPU_FUZZ_SEED`` machinery of tests/test_engine_parity_fuzz.py: each
+case prints its effective seed, so a failure replays exactly with
+``SPFFT_TPU_FUZZ_SEED=<offset> pytest <nodeid>``.
+
+Also pins the knob's behavior surface: the ragged disciplines ignore the
+knob (their chains already round-pipeline), requests clamp to the chunkable
+extent, the env knob and plan cards carry it, the perf layer scores
+overlapped rows on exposed time while keeping exact wire bytes, and the
+TUNED policy owns the knob end to end (candidates -> trials -> wisdom).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    DistributedTransform,
+    ExchangeType,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    obs,
+)
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.obs import perf
+from spfft_tpu.parameters import distribute_triplets
+from utils import assert_close, random_sparse_triplets
+
+FUZZ_SEED = int(os.environ.get("SPFFT_TPU_FUZZ_SEED", "0"))
+
+
+def fuzz_rng(base: int, case: int) -> np.random.Generator:
+    seed = FUZZ_SEED + base + case
+    print(f"fuzz seed = {seed} (SPFFT_TPU_FUZZ_SEED={FUZZ_SEED} + {base} + {case})")
+    return np.random.default_rng(seed)
+
+
+def _case_plan(rng, r2c, dtype, p_y=None):
+    """Random dims/triplets/values for one parity case (hermitian-consistent
+    values for R2C so forward(backward(v)) reproduces v)."""
+    dx = int(rng.integers(5, 12))
+    dy = int(rng.integers(6, 12) if p_y is None else rng.integers(p_y + 2, 12))
+    dz = int(rng.integers(6, 13))
+    trip = random_sparse_triplets(
+        rng, dx, dy, dz, float(rng.uniform(0.4, 0.9)), hermitian=r2c
+    )
+    n = len(trip)
+    if r2c:
+        real = rng.standard_normal((dz, dy, dx))
+        freq = np.fft.fftn(real) / (dx * dy * dz)
+        values = freq[trip[:, 2], trip[:, 1], trip[:, 0]]
+    else:
+        values = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    return (dx, dy, dz), trip, values.astype(
+        np.complex64 if dtype == np.float32 else np.complex128
+    )
+
+
+def _shard(trip, values, shards, dy):
+    per_shard = distribute_triplets(trip, shards, dy)
+    lut = {tuple(t): v for t, v in zip(map(tuple, trip), values)}
+    return per_shard, [
+        np.asarray([lut[tuple(t)] for t in s]) for s in per_shard
+    ]
+
+
+def _roundtrip(t, vps):
+    out = np.asarray(t.backward([v.copy() for v in vps]))
+    back = np.concatenate(t.forward(scaling=ScalingType.FULL))
+    return out, back
+
+
+# ---- parity fuzz: overlapped vs unchunked ------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+@pytest.mark.parametrize("case", [0, 1, 2, 3])
+def test_slab_overlap_parity(engine, case):
+    """Chunk counts {2, 7, P} x {C2C, R2C} x {f32, f64} x padded/_FLOAT wire
+    against the overlap=1 twin and the local oracle, per slab engine."""
+    rng = fuzz_rng(7000, case)
+    r2c = bool(case % 2)
+    dtype = np.float64 if case // 2 % 2 else np.float32
+    exchange = (
+        ExchangeType.BUFFERED_FLOAT if dtype == np.float64 and case % 2 == 0
+        else ExchangeType.BUFFERED
+    )
+    dims, trip, values = _case_plan(rng, r2c, dtype)
+    dx, dy, dz = dims
+    shards = int(rng.choice([2, 4]))
+    per_shard, vps = _shard(trip, values, shards, dy)
+    ttype = TransformType.R2C if r2c else TransformType.C2C
+    tol = dict(dtype=np.float32) if dtype == np.float32 else {}
+
+    local = Transform(
+        ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip, dtype=dtype
+    ).backward(values)
+
+    ref = None
+    for overlap in (1, 2, 7, shards):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz,
+            [p.copy() for p in per_shard],
+            mesh=sp.make_fft_mesh(shards), dtype=dtype, engine=engine,
+            exchange_type=exchange, overlap=overlap,
+        )
+        out, back = _roundtrip(t, vps)
+        assert_close(out, local, **tol)
+        if ref is None:
+            ref = (out, back)
+            assert t.overlap_chunks == 1
+        else:
+            # the chunked pipeline is the same arithmetic regrouped; parity
+            # with the unchunked twin is exact on CPU
+            np.testing.assert_allclose(out, ref[0], rtol=1e-6, atol=1e-8)
+            np.testing.assert_allclose(back, ref[1], rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+@pytest.mark.parametrize("case", [0, 1])
+def test_pencil_overlap_parity(engine, case):
+    """Chunked pencil pipelines (exchange A against y, exchange B against x)
+    must match the bulk-synchronous twin and the local oracle."""
+    rng = fuzz_rng(8000, 2 * case + (engine == "mxu"))
+    r2c = bool(case % 2)
+    dtype = np.float32 if case % 2 else np.float64
+    p1, p2 = 2, 2
+    dims, trip, values = _case_plan(rng, r2c, dtype, p_y=p1)
+    dx, dy, dz = dims
+    per_shard, vps = _shard(trip, values, p1 * p2, dy)
+    ttype = TransformType.R2C if r2c else TransformType.C2C
+    tol = dict(dtype=np.float32) if dtype == np.float32 else {}
+
+    local = Transform(
+        ProcessingUnit.HOST, ttype, dx, dy, dz, indices=trip, dtype=dtype
+    ).backward(values)
+
+    ref = None
+    for overlap in (1, 2, 7):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, ttype, dx, dy, dz,
+            [p.copy() for p in per_shard],
+            mesh=sp.make_fft_mesh2(p1, p2), dtype=dtype, engine=engine,
+            exchange_type=ExchangeType.BUFFERED, overlap=overlap,
+        )
+        out, back = _roundtrip(t, vps)
+        assert_close(out, local, **tol)
+        if ref is None:
+            ref = (out, back)
+        else:
+            assert 1 < t.overlap_chunks <= -(-dz // p2)
+            np.testing.assert_allclose(out, ref[0], rtol=1e-6, atol=1e-8)
+            np.testing.assert_allclose(back, ref[1], rtol=1e-6, atol=1e-8)
+
+
+# ---- knob behavior -----------------------------------------------------------
+
+
+def _small_dist(overlap=None, exchange=ExchangeType.BUFFERED, mesh=None,
+                policy=None, **kw):
+    trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.9)
+    return DistributedTransform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+        np.asarray(trip).copy(),
+        mesh=mesh if mesh is not None else sp.make_fft_mesh(4),
+        dtype=np.float32, engine="xla", exchange_type=exchange,
+        overlap=overlap, policy=policy, **kw,
+    )
+
+
+def test_ragged_disciplines_ignore_overlap():
+    """COMPACT/UNBUFFERED chains already pipeline in rounds — the knob
+    clamps to 1 instead of building a second pipelining layer."""
+    for exchange in (ExchangeType.COMPACT_BUFFERED, ExchangeType.UNBUFFERED):
+        t = _small_dist(overlap=6, exchange=exchange)
+        assert t.overlap_chunks == 1
+        assert "overlapped" not in t._exec.exchange_transport()
+
+
+def test_overlap_clamps_to_chunkable_extent():
+    t = _small_dist(overlap=10_000)
+    assert 1 < t.overlap_chunks <= t._exec._S
+    assert t.exchange_rounds() == t.overlap_chunks
+    assert t._exec.exchange_transport() == "chunked all_to_all"
+
+
+def test_overlap_env_knob(monkeypatch):
+    from spfft_tpu.parallel.policy import OVERLAP_ENV
+
+    monkeypatch.setenv(OVERLAP_ENV, "3")
+    t = _small_dist()  # overlap=None -> env
+    assert t.overlap_chunks == 3
+    monkeypatch.setenv(OVERLAP_ENV, "banana")
+    with pytest.raises(InvalidParameterError):
+        _small_dist()
+    with pytest.raises(InvalidParameterError):
+        _small_dist(overlap=0)
+
+
+def test_plan_card_records_overlap_provenance():
+    t = _small_dist(overlap=4)
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    assert card["exchange"]["overlap_chunks"] == t.overlap_chunks
+    assert card["exchange"]["transport"] == "chunked all_to_all"
+    assert card["execution"]["overlap_chunks"] == t.overlap_chunks
+    policy = card["exchange_policy"]
+    assert policy["chosen"] == f"BUFFERED/ov{t.overlap_chunks}"
+    chosen = [a for a in policy["alternatives"] if a["chosen"]]
+    assert len(chosen) == 1
+    assert chosen[0]["discipline"] == policy["chosen"]
+    assert chosen[0]["rounds"] == t.overlap_chunks
+    # the overlapped row costs the same exact wire bytes as its padded base
+    base = next(
+        a for a in policy["alternatives"] if a["discipline"] == "BUFFERED"
+    )
+    assert chosen[0]["wire_bytes"] == base["wire_bytes"]
+
+
+def test_grid_create_transform_threads_overlap():
+    grid = sp.Grid(8, 8, 8, 64, ProcessingUnit.HOST, mesh=sp.make_fft_mesh(4))
+    trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.9)
+    t = grid.create_transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+        overlap=2,
+    )
+    assert t.overlap_chunks == 2
+    local_grid = sp.Grid(8, 8, 8, 64, ProcessingUnit.HOST)
+    with pytest.raises(InvalidParameterError):
+        local_grid.create_transform(
+            ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+            overlap=2,
+        )
+
+
+# ---- perf accounting: exposed-time attribution -------------------------------
+
+
+def test_perf_scores_overlap_on_exposed_time():
+    """The overlapped report keeps the exact geometry wire bytes but
+    attributes less time to the exchange — exchange_fraction is computed on
+    the exposed (non-hidden) share."""
+    reports = {}
+    for overlap in (1, 4):
+        t = _small_dist(overlap=overlap)
+        seconds = 1e-3  # fixed wall time: attribution is deterministic
+        reports[overlap] = perf.perf_report(t, seconds, repeats=1)
+    for rep in reports.values():
+        assert perf.validate_perf_report(rep) == []
+    r1, r4 = reports[1], reports[4]
+    names4 = {r["stage"] for r in r4["stages"]}
+    assert "exchange overlapped" in names4
+    assert "exchange" not in names4
+    # modeled bytes equal the exact geometry wire volume under BOTH labels
+    def wire(rep):
+        return sum(
+            r["bytes"] for r in rep["stages"]
+            if r["stage"] in perf.EXCHANGE_STAGES
+        )
+
+    assert wire(r1) == wire(r4) == r1["wire_bytes_per_pair"]
+    assert r4["overlap_chunks"] > 1 and r1["overlap_chunks"] == 1
+    assert r4["exchange_fraction"] < r1["exchange_fraction"]
+    # the overlapped row advertises what it hides behind
+    (row,) = [r for r in r4["stages"] if r["stage"] == "exchange overlapped"]
+    assert row["overlap"]["chunks"] == r4["overlap_chunks"]
+    assert row["overlap"]["hides"] == "z transform"
+    # stage seconds still sum to wall time by construction
+    assert sum(r["seconds"] for r in r4["stages"]) == pytest.approx(1e-3)
+
+
+def test_pencil_perf_overlap_rows():
+    trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.9)
+    fr = {}
+    for overlap in (1, 2):
+        t = DistributedTransform(
+            ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+            np.asarray(trip).copy(), mesh=sp.make_fft_mesh2(2, 4),
+            dtype=np.float32, engine="xla",
+            exchange_type=ExchangeType.BUFFERED, overlap=overlap,
+        )
+        rep = perf.perf_report(t, 1e-3, repeats=1)
+        assert perf.validate_perf_report(rep) == []
+        fr[overlap] = rep["exchange_fraction"]
+        names = {r["stage"] for r in rep["stages"]}
+        if overlap > 1:
+            assert {"exchange A overlapped", "exchange B overlapped"} <= names
+            rows = {
+                r["stage"]: r for r in rep["stages"] if "overlapped" in r["stage"]
+            }
+            assert rows["exchange A overlapped"]["overlap"]["hides"] == "y transform"
+            assert rows["exchange B overlapped"]["overlap"]["hides"] == "x transform"
+        else:
+            assert {"exchange A", "exchange B"} <= names
+    assert fr[2] < fr[1]
+
+
+# ---- tuner ownership ---------------------------------------------------------
+
+
+def test_tuned_policy_owns_overlap_knob(tmp_path, monkeypatch):
+    import spfft_tpu.tuning as tuning
+
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    tuning.clear_memory()
+    t = _small_dist(exchange=ExchangeType.DEFAULT, policy="tuned")
+    rec = t._tuning
+    labels = [r["label"] for r in rec["trials"]]
+    assert any("/ov" in label for label in labels), labels
+    assert "overlap" in rec["choice"]
+    # overlapped trial rows are visible in the plan card's TUNED trial table
+    card = t.report()
+    assert any("/ov" in r["label"] for r in card["tuning"]["trials"])
+    # wisdom hit reproduces discipline AND chunk count with zero trials
+    t2 = _small_dist(exchange=ExchangeType.DEFAULT, policy="tuned")
+    assert t2._tuning["hit"] is True
+    assert t2.overlap_chunks == t.overlap_chunks
+    assert t2.exchange_type == t.exchange_type
+    # an explicit overlap pin removes the axis from the trial set
+    tuning.clear_memory()
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom2.json"))
+    t3 = _small_dist(exchange=ExchangeType.DEFAULT, policy="tuned", overlap=2)
+    assert not any("/ov" in r["label"] for r in t3._tuning["trials"])
+
+
+def test_overlap_candidates_shape():
+    from spfft_tpu.tuning.candidates import (
+        OVERLAP_CANDIDATE_CHUNKS,
+        exchange_candidates,
+    )
+
+    cands = exchange_candidates([4, 4], [4, 4], one_shot_supported=False)
+    ov_rows = [c for c in cands if "/ov" in c["label"]]
+    assert {c["overlap"] for c in ov_rows} == set(OVERLAP_CANDIDATE_CHUNKS)
+    assert all(c["exchange_type"] == "BUFFERED" for c in ov_rows)
+    # model cost ranks overlapped rows behind plain BUFFERED (extra rounds,
+    # same bytes): the measurement, not the model, decides if hiding wins
+    base = next(c for c in cands if c["label"] == "BUFFERED")
+    assert all(c["model_cost_bytes"] > base["model_cost_bytes"] for c in ov_rows)
+    pinned = exchange_candidates([4, 4], [4, 4], one_shot_supported=False,
+                                 overlap=3)
+    assert not any("/ov" in c["label"] for c in pinned)
+    assert all(c["overlap"] == 3 for c in pinned)
+    pencil = exchange_candidates(pencil2=True)
+    assert any("/ov" in c["label"] for c in pencil)
