@@ -15,6 +15,7 @@
 #          ./ci.sh trace      # flight recorder: schema + Chrome export + dump
 #          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
 #          ./ci.sh verify     # ABFT checks, corrupt-injection recovery, breaker
+#          ./ci.sh serve      # serving layer: loadgen smoke + overload chaos
 #          ./ci.sh perf       # dbench scaling rows + schema + regression gate
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
@@ -218,6 +219,88 @@ EOF
   rm -rf "$vdir"
 }
 
+run_serve() {
+  echo "== Serve (spfft_tpu.serve: admission, coalescing, shedding, CPU) =="
+  # The suite carries the arm-every-serve-site overload chaos sweep.
+  timeout 540 python -m pytest tests/test_serve.py -q
+  local sdir
+  sdir="$(mktemp -d)"
+  # Loadgen smoke: sustained open-loop traffic, gate-compatible rows.
+  JAX_PLATFORMS=cpu timeout 540 python programs/loadgen.py -d 12 12 12 \
+    -s 0.8 --tenants 2 --rate 40 --ramp 1 2 --duration 1.5 \
+    -o "$sdir/loadgen.json" > /dev/null
+  # Overload run under chaos: tiny queue, offered load far beyond capacity,
+  # every serve.* site armed at a fractional rate — the service must keep a
+  # bounded queue, shed/reject typed, and resolve every accepted ticket
+  # (no deadlock: the run finishing inside its timeout IS the evidence).
+  JAX_PLATFORMS=cpu SPFFT_TPU_SERVE_QUEUE_CAP=8 \
+    SPFFT_TPU_FAULTS="serve.admit=raise:0.1,serve.batch=raise:0.1,serve.dispatch=raise:0.1" \
+    timeout 540 python programs/loadgen.py -d 12 12 12 -s 0.8 --tenants 3 \
+    --rate 2000 --ramp 1 --duration 2 --timeout-s 1.0 \
+    -o "$sdir/overload.json" > /dev/null
+  JAX_PLATFORMS=cpu python - "$sdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+smoke = json.load(open(f"{d}/loadgen.json"))
+assert smoke["schema"] == "spfft_tpu.serve.loadgen/1", smoke["schema"]
+for row in smoke["rows"]:
+    for k in ("key", "gflops", "seconds_noise", "transforms_per_sec",
+              "p50_ms", "p99_ms", "rejected", "shed", "deadline_miss"):
+        assert k in row, (k, row)
+    assert row["completed"] > 0, row
+    assert row["failed"] == 0, row
+over = json.load(open(f"{d}/overload.json"))
+row = over["rows"][0]
+svc = over["service"]["stats"]
+assert svc["queue_high_water"] <= svc["queue_capacity"], svc
+# offered >= 2x what got through: this WAS overload, and the excess
+# became typed rejections/sheds/deadline-misses, not latency or a wedge
+refused = row["rejected"] + row["shed"] + row["deadline_miss"]
+assert row["offered"] >= 2 * max(1, row["completed"]), row
+assert refused > 0, row
+assert row["completed"] + refused + row["failed"] == row["offered"], row
+print(f"serve smoke ok ({len(smoke['rows'])} rows; overload: "
+      f"{row['offered']} offered -> {row['completed']} completed, "
+      f"{refused} typed refusals, high water "
+      f"{svc['queue_high_water']}/{svc['queue_capacity']})")
+EOF
+  # Breaker-tripped degradation: with the engine breaker open, the service
+  # demotes to the jnp.fft reference rung (results stay correct) instead of
+  # queueing into the dead engine.
+  JAX_PLATFORMS=cpu timeout 540 python - <<'EOF'
+import numpy as np
+import spfft_tpu as sp
+from spfft_tpu import TransformType, obs, verify
+from spfft_tpu.serve import TransformService
+
+trip = sp.create_spherical_cutoff_triplets(12, 12, 12, 0.8)
+rng = np.random.default_rng(0)
+vals = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+svc = TransformService(start=False, queue_capacity=8)
+tk = svc.submit(TransformType.C2C, (12, 12, 12), trip, vals)
+svc.pump()
+expect = tk.result(timeout=30)
+engine = svc.plans.describe()[0]["engine"]
+for _ in range(verify.breaker.threshold()):
+    verify.breaker.record_failure(engine)
+assert verify.breaker.describe(engine)["state"] == "open"
+tk = svc.submit(TransformType.C2C, (12, 12, 12), trip, vals)
+svc.pump()
+out = tk.result(timeout=30)
+assert np.allclose(out, expect), "demoted result diverged"
+counters = obs.snapshot()["counters"]
+demoted = sum(v for k, v in counters.items()
+              if k.startswith("serve_demotions_total"))
+assert demoted == 1, counters
+svc.close()
+verify.breaker.reset()
+print(f"serve breaker ok: open breaker on {engine!r} -> 1 demotion, "
+      "result parity held")
+EOF
+  rm -rf "$sdir"
+}
+
 run_perf() {
   echo "== Perf (spfft_tpu.obs.perf: dbench rows + schema + regression gate, CPU) =="
   # 8-virtual-device distributed bench: slab AND pencil meshes must emit
@@ -320,6 +403,7 @@ case "$stage" in
   trace) run_trace ;;
   chaos) run_chaos ;;
   verify) run_verify ;;
+  serve) run_serve ;;
   perf) run_perf ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
@@ -331,13 +415,14 @@ case "$stage" in
     run_trace
     run_chaos
     run_verify
+    run_serve
     run_perf
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | perf | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | perf | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

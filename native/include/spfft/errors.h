@@ -34,7 +34,12 @@ enum SpfftError {
   SPFFT_GPU_FFT_ERROR = 22,
   /* TPU-build extension beyond the reference enum: algorithm-based
    * self-verification (ABFT) failed and recovery was exhausted. */
-  SPFFT_VERIFICATION_ERROR = 23
+  SPFFT_VERIFICATION_ERROR = 23,
+  /* Serving-layer extensions (spfft_tpu.serve): admission refused under
+   * overload (bounded queue full, tenant quota, load shedding) ... */
+  SPFFT_SERVICE_OVERLOAD_ERROR = 24,
+  /* ... and a request deadline expired at admission or pre-dispatch. */
+  SPFFT_DEADLINE_EXCEEDED_ERROR = 25
 };
 
 #ifndef __cplusplus

@@ -74,6 +74,10 @@ SPFFT_TPU_DEFINE_ERROR(GPUFFTError, SPFFT_GPU_FFT_ERROR,
                        "spfft_tpu: accelerator FFT error")
 SPFFT_TPU_DEFINE_ERROR(VerificationError, SPFFT_VERIFICATION_ERROR,
                        "spfft_tpu: self-verification failed, recovery exhausted")
+SPFFT_TPU_DEFINE_ERROR(ServiceOverloadError, SPFFT_SERVICE_OVERLOAD_ERROR,
+                       "spfft_tpu: service overloaded, admission refused")
+SPFFT_TPU_DEFINE_ERROR(DeadlineExceededError, SPFFT_DEADLINE_EXCEEDED_ERROR,
+                       "spfft_tpu: request deadline exceeded")
 
 #undef SPFFT_TPU_DEFINE_ERROR
 

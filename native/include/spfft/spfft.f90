@@ -38,6 +38,10 @@ module spfft
   integer(c_int), parameter :: SPFFT_GPU_FFT_ERROR = 22
   ! TPU-build extension: self-verification (ABFT) failed, recovery exhausted
   integer(c_int), parameter :: SPFFT_VERIFICATION_ERROR = 23
+  ! Serving-layer extensions (spfft_tpu.serve): admission refused under
+  ! overload, and a request deadline expired at admission or pre-dispatch
+  integer(c_int), parameter :: SPFFT_SERVICE_OVERLOAD_ERROR = 24
+  integer(c_int), parameter :: SPFFT_DEADLINE_EXCEEDED_ERROR = 25
 
   ! --- SpfftExchangeType (spfft/types.h) ---
   integer(c_int), parameter :: SPFFT_EXCH_DEFAULT = 0

@@ -265,6 +265,7 @@ def index_page() -> str:
         - [Autotuning and wisdom](tuning.md)
         - [Fault injection, guard mode and degradation](faults.md)
         - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
+        - [Serving: admission, coalesced batching, load shedding](serve.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -365,6 +366,7 @@ def verify_page() -> str:
             verify.resolve_rtol,
             verify.resolve_retries,
             verify.resolve_backoff_s,
+            verify.jitter_rng,
             verify.applicable_checks,
             verify.run_checks,
         ],
@@ -385,6 +387,25 @@ def verify_page() -> str:
         ],
     )
     return main + "\n" + brk
+
+
+def serve_page() -> str:
+    """The serving page: the `spfft_tpu.serve` surface (admission queue,
+    plan cache + coalescing, the overload-safe service)."""
+    from spfft_tpu import serve
+
+    return class_page(
+        "Serving (`spfft_tpu.serve`)",
+        doc(serve),
+        [serve.TransformService, serve.Ticket, serve.AdmissionQueue,
+         serve.PlanCache],
+        [
+            serve.canonical_triplets,
+            serve.wrap_triplets,
+            serve.resolve_on_breaker,
+            serve.as_typed,
+        ],
+    )
 
 
 def generate(outdir: Path) -> None:
@@ -416,9 +437,18 @@ def generate(outdir: Path) -> None:
         ),
         "multi_transform.md": class_page(
             "Multi-transforms",
-            "Batched pipelined execution of independent transforms.",
+            "Batched pipelined execution of independent transforms "
+            "(the split-phase dispatch/finalize halves are public for batch "
+            "owners like the serving layer).",
             [],
-            [sp.multi_transform_backward, sp.multi_transform_forward],
+            [
+                sp.multi_transform_backward,
+                sp.multi_transform_forward,
+                sp.multi_transform.dispatch_backward,
+                sp.multi_transform.finalize_backward,
+                sp.multi_transform.dispatch_forward,
+                sp.multi_transform.finalize_forward,
+            ],
         ),
         "utilities.md": class_page(
             "Utilities",
@@ -452,6 +482,7 @@ def generate(outdir: Path) -> None:
                 tuning.wisdom_state,
                 tuning.active_store,
                 tuning.clear_memory,
+                tuning.trial_deadline_s,
             ],
         ),
         "faults.md": class_page(
@@ -475,9 +506,11 @@ def generate(outdir: Path) -> None:
                 faults.engine_fallback,
                 faults.summarize,
                 faults.typed_execution,
+                faults.backoff_s,
             ],
         ),
         "verify.md": verify_page(),
+        "serve.md": serve_page(),
         "c_api.md": c_api_page(),
         "fortran.md": fortran_page(),
         "examples.md": examples_page(),

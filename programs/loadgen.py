@@ -1,0 +1,257 @@
+"""Open-loop load generator for the serving layer (`spfft_tpu.serve`).
+
+Drives sustained multi-tenant traffic against a :class:`TransformService`
+the way a fleet of independent callers would: arrivals are scheduled on a
+fixed offered-rate clock and submitted WITHOUT waiting for completions
+(open-loop — offered load does not slow down when the service does, which
+is exactly what makes overload visible; a closed loop self-throttles and
+hides it). Each ramp step multiplies the offered rate, so one run sweeps
+from comfortable load into deliberate overload and records how the service
+degrades: typed rejections and sheds instead of latency collapse.
+
+Output: a JSON report (schema ``spfft_tpu.serve.loadgen/1``) whose rows are
+**gate-compatible** with ``programs/perf_gate.py`` (``key`` / ``gflops`` /
+``seconds_noise``, like dbench scaling rows) plus the serving scoreboard
+fields: offered/accepted/completed/rejected/shed/deadline-miss counts,
+completed transforms/sec, and p50/p99 latency ms. ``SERVE_r08.json`` is the
+first committed capture; ``./ci.sh serve`` runs a smoke and an overload
+configuration of this CLI.
+
+GFLOP/s accounting: each completed transform is billed the dense one-
+direction flop count (``perf.dense_pair_flops(dims) / 2``) — comparable
+across loadgen rows with the same key, which is all the regression gate
+compares. ``seconds_noise`` is the relative p50→p99 latency spread, capped
+at 0.5, so the gate's noise-aware allowance widens on jittery hosts the
+same way dbench's repeat spread does.
+
+Usage:
+    python programs/loadgen.py -d 16 16 16 -s 0.8 --tenants 2 \
+        --rate 50 --ramp 1 2 4 --duration 2 -o loadgen.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+LOADGEN_SCHEMA = "spfft_tpu.serve.loadgen/1"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-d", "--dims", type=int, nargs=3, default=[16, 16, 16],
+                   metavar=("X", "Y", "Z"))
+    p.add_argument("-s", "--sparsity", type=float, default=0.8,
+                   help="spherical-cutoff radius fraction (triplet density)")
+    p.add_argument("--tenants", type=int, default=2)
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="offered requests/sec at ramp multiplier 1")
+    p.add_argument("--ramp", type=float, nargs="+", default=[1.0, 2.0],
+                   help="offered-rate multipliers, one measured row each")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds of offered traffic per ramp step")
+    p.add_argument("--timeout-s", type=float, default=0.0,
+                   help="per-request deadline (0 = none)")
+    p.add_argument("--queue-cap", type=int, default=None)
+    p.add_argument("--batch-max", type=int, default=None)
+    p.add_argument("--retries", type=int, default=None)
+    p.add_argument("--verify", default=None,
+                   help="verify mode for the service's plans (e.g. 'on')")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--settle-s", type=float, default=30.0,
+                   help="max wait for outstanding tickets after each step")
+    p.add_argument("-o", "--output", default=None, help="write JSON report here")
+    return p
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
+             transform_type, timeout_s, flops_per_transform, settle_s, rng):
+    """One measured open-loop step at ``rate`` requests/sec; returns the
+    gate-compatible row."""
+    from spfft_tpu.errors import (
+        DeadlineExceededError,
+        GenericError,
+        ServiceOverloadError,
+    )
+
+    n_requests = max(1, int(round(rate * duration)))
+    spacing = duration / n_requests
+    tickets = []
+    counts = {"offered": n_requests, "rejected": 0, "shed": 0,
+              "deadline_miss": 0, "failed": 0}
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        target = t0 + i * spacing
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        tenant = f"tenant{i % tenants}"
+        # per-request value perturbation: payloads differ per request the
+        # way real traffic's do (coalescing must not depend on equal data)
+        vals = values * (1.0 + 0.01 * rng.standard_normal())
+        try:
+            tickets.append(
+                service.submit(
+                    transform_type, dims, trip, vals, tenant=tenant,
+                    timeout_s=timeout_s if timeout_s > 0 else None,
+                )
+            )
+        except (ServiceOverloadError, DeadlineExceededError):
+            counts["rejected"] += 1
+        except GenericError:
+            counts["failed"] += 1
+    offered_wall = time.perf_counter() - t0
+
+    latencies = []
+    settle_deadline = time.time() + settle_s
+    for t in tickets:
+        try:
+            t.result(timeout=max(0.05, settle_deadline - time.time()))
+            latencies.append(t.latency_s())
+        except DeadlineExceededError:
+            counts["deadline_miss"] += 1
+        except ServiceOverloadError:
+            counts["shed"] += 1
+        except (GenericError, TimeoutError):
+            counts["failed"] += 1
+    wall = time.perf_counter() - t0
+    completed = len(latencies)
+    latencies.sort()
+    p50 = _percentile(latencies, 0.50)
+    p99 = _percentile(latencies, 0.99)
+    noise = min(0.5, (p99 - p50) / p50) if p50 > 0 else 0.0
+    return {
+        "key": key,
+        "offered": n_requests,
+        "offered_rate": round(n_requests / max(offered_wall, 1e-9), 3),
+        "accepted": len(tickets),
+        "completed": completed,
+        "rejected": counts["rejected"],
+        "shed": counts["shed"],
+        "deadline_miss": counts["deadline_miss"],
+        "failed": counts["failed"],
+        "transforms_per_sec": round(completed / max(wall, 1e-9), 3),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+        "gflops": round(completed * flops_per_transform / max(wall, 1e-9) / 1e9, 6),
+        "seconds_noise": round(noise, 4),
+        "wall_seconds": round(wall, 4),
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import spfft_tpu as sp
+    from spfft_tpu import TransformType, obs
+    from spfft_tpu.obs import perf
+    from spfft_tpu.serve import TransformService
+
+    dx, dy, dz = args.dims
+    trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, args.sparsity)
+    rng = np.random.default_rng(args.seed)
+    values = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    flops_per_transform = perf.dense_pair_flops((dx, dy, dz)) / 2.0
+    dtype = "f64" if values.real.dtype == np.float64 else "f32"
+
+    service = TransformService(
+        queue_capacity=args.queue_cap, batch_max=args.batch_max,
+        retries=args.retries, verify=args.verify,
+    )
+    rows = []
+    try:
+        # warmup outside the measured window: plan build, first compile, and
+        # the clone pool (a batch_max burst forces the per-batch plan clones
+        # to exist before any measured request can pay for them). Spread
+        # across tenants and tolerate quota refusals: with a tiny queue the
+        # admission rules apply to the warmup too, and a partially warmed
+        # pool just grows lazily.
+        from spfft_tpu.errors import ServiceOverloadError as _Overload
+
+        warm = []
+        for i in range(service.batch_max):
+            try:
+                warm.append(
+                    service.submit(
+                        TransformType.C2C, (dx, dy, dz), trip, values,
+                        tenant=f"warmup{i % max(1, args.tenants)}",
+                    )
+                )
+            except _Overload:
+                break
+        for tk in warm:
+            tk.result(timeout=args.settle_s)
+        # unmeasured preflight at the base rate: exercises the whole
+        # dispatcher path (batch shapes, allocator, scheduler) under load
+        # before the first recorded row, so row 1 measures steady state
+        run_step(
+            service, key="preflight", rate=args.rate,
+            duration=min(1.0, args.duration), tenants=args.tenants,
+            trip=trip, values=values, dims=(dx, dy, dz),
+            transform_type=TransformType.C2C, timeout_s=0.0,
+            flops_per_transform=flops_per_transform,
+            settle_s=args.settle_s, rng=rng,
+        )
+        for mult in args.ramp:
+            rate = args.rate * mult
+            key = (
+                f"serve:{dx}x{dy}x{dz}:s{int(round(args.sparsity * 100))}"
+                f":c2c:{dtype}:t{args.tenants}:x{mult:g}"
+            )
+            row = run_step(
+                service, key=key, rate=rate, duration=args.duration,
+                tenants=args.tenants, trip=trip, values=values,
+                dims=(dx, dy, dz), transform_type=TransformType.C2C,
+                timeout_s=args.timeout_s,
+                flops_per_transform=flops_per_transform,
+                settle_s=args.settle_s, rng=rng,
+            )
+            rows.append(row)
+            print(
+                f"{row['key']}: offered {row['offered_rate']:.0f}/s -> "
+                f"{row['transforms_per_sec']:.0f} done/s "
+                f"(p50 {row['p50_ms']:.1f} ms, p99 {row['p99_ms']:.1f} ms, "
+                f"rejected {row['rejected']}, shed {row['shed']}, "
+                f"deadline {row['deadline_miss']}, failed {row['failed']})"
+            )
+    finally:
+        service.close()
+
+    doc = {
+        "schema": LOADGEN_SCHEMA,
+        "run_unix": time.time(),
+        "config": {
+            "dims": [dx, dy, dz], "sparsity": args.sparsity,
+            "tenants": args.tenants, "base_rate": args.rate,
+            "ramp": list(args.ramp), "duration_s": args.duration,
+            "timeout_s": args.timeout_s, "num_values": int(len(trip)),
+            "flops_per_transform": flops_per_transform, "dtype": dtype,
+            "seed": args.seed,
+        },
+        "rows": rows,
+        "service": service.describe(),
+        "metrics": obs.snapshot(),
+    }
+    if args.output:
+        Path(args.output).write_text(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"wrote {args.output}")
+    else:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ exchanges, grids, batched multi-transforms, and a C/C++/Fortran shim.
 """
 from .errors import (  # noqa: F401
     AllocationError,
+    DeadlineExceededError,
     DuplicateIndicesError,
     ErrorCode,
     FFTWError,
@@ -30,10 +31,12 @@ from .errors import (  # noqa: F401
     MPIParameterMismatchError,
     MPISupportError,
     OverflowError_,
+    ServiceOverloadError,
     VerificationError,
 )
 from . import faults  # noqa: F401
 from . import obs  # noqa: F401
+from . import serve  # noqa: F401
 from . import timing  # noqa: F401
 from . import tuning  # noqa: F401
 from . import verify  # noqa: F401

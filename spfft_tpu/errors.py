@@ -41,6 +41,12 @@ class ErrorCode(enum.IntEnum):
     # algorithm-based self-verification failed and recovery was exhausted
     # (spfft_tpu.verify). Mirrored in native/include/spfft/errors.h.
     VERIFICATION = 23
+    # Serving-layer extensions (spfft_tpu.serve), mirrored the same way:
+    # admission refused under overload (bounded queue full, tenant quota,
+    # or load shedding) ...
+    SERVICE_OVERLOAD = 24
+    # ... and a request deadline expired (at admission or pre-dispatch).
+    DEADLINE_EXCEEDED = 25
 
 
 class GenericError(Exception):
@@ -198,3 +204,25 @@ class VerificationError(GenericError):
     ladder. A silently corrupted output is never returned in its place."""
 
     error_code = ErrorCode.VERIFICATION
+
+
+class ServiceOverloadError(GenericError):
+    """The serving layer refused admission under overload.
+
+    Raised by :mod:`spfft_tpu.serve` when the bounded admission queue is
+    full, a tenant exceeded its quota, or a queued request was shed
+    (fair-share eviction, breaker-open shedding). The typed form of
+    backpressure: a caller sees this error immediately instead of unbounded
+    queueing latency, and can back off and retry."""
+
+    error_code = ErrorCode.SERVICE_OVERLOAD
+
+
+class DeadlineExceededError(GenericError):
+    """A request's deadline expired before its result was produced.
+
+    Raised by :mod:`spfft_tpu.serve` at admission (the deadline was already
+    in the past) or pre-dispatch (the request expired while queued — shed
+    before burning device time on an answer nobody is waiting for)."""
+
+    error_code = ErrorCode.DEADLINE_EXCEEDED

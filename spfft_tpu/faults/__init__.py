@@ -48,6 +48,7 @@ from .guard import (  # noqa: F401
 )
 from .ladder import (  # noqa: F401
     ENGINE_BUILD_ERRORS,
+    backoff_s,
     collecting,
     engine_fallback,
     record_degradation,

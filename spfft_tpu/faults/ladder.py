@@ -47,6 +47,24 @@ ENGINE_BUILD_ERRORS = (InjectedFault, RuntimeError, NotImplementedError)
 _tls = threading.local()
 
 
+def backoff_s(base: float, attempt: int, rng=None) -> float:
+    """Jittered exponential backoff delay for re-attempt ``attempt``
+    (1-based): ``base * 2**(attempt-1)``, scaled by a uniform draw in
+    [0.5, 1.5) when ``rng`` (a ``random.Random``) is given.
+
+    The jitter is the thundering-herd guard shared by every retry loop in
+    the package (the verify supervisor's re-executions, the serving layer's
+    transient-failure retries): N concurrent callers that failed on the same
+    engine at the same moment must not all re-hit it on the same schedule —
+    deterministic exponential backoff synchronizes the herd instead of
+    spreading it. Pass ``rng=None`` for the legacy deterministic delay
+    (tests that pin exact sleep values)."""
+    delay = float(base) * (2.0 ** (max(1, int(attempt)) - 1))
+    if rng is not None:
+        delay *= 0.5 + rng.random()
+    return delay
+
+
 def summarize(exc: BaseException, limit: int = 200) -> str:
     """One-line ``"Type: first message line"`` summary of an exception — the
     single formatting rule for degradation reasons and trial error rows."""

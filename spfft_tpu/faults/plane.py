@@ -67,6 +67,9 @@ SITES = (
     "hlo.stats",
     "sync.fence",
     "verify.check",
+    "serve.admit",
+    "serve.batch",
+    "serve.dispatch",
 )
 
 KINDS = ("raise", "nan", "corrupt", "delay")

@@ -54,6 +54,52 @@ def _broadcast_scaling(scaling_types, n):
     return scaling_types
 
 
+def dispatch_backward(transforms, values_list):
+    """Stage and enqueue every backward without waiting; returns the list of
+    device-resident pending results (finalize with :func:`finalize_backward`).
+
+    The split-phase half of :func:`multi_transform_backward`, exposed so
+    batch owners that interleave work between dispatch and finalize — the
+    serving layer sheds deadline-expired requests pre-dispatch and resolves
+    tickets per-request (:mod:`spfft_tpu.serve`) — share the exact pipelined
+    dispatch path instead of reimplementing it. Validates like the one-shot
+    form: a length mismatch or a duplicate transform object raises typed
+    (silent zip truncation would drop work)."""
+    transforms, values_list = list(transforms), list(values_list)
+    _check_batch(transforms, values_list, "dispatch_backward")
+    return [t._dispatch_backward(v) for t, v in zip(transforms, values_list)]
+
+
+def finalize_backward(transforms, pending):
+    """Wait for and fetch the results of a :func:`dispatch_backward` batch,
+    in order (host staging of result i overlaps device execution of i+1)."""
+    return [t._finalize_backward(o) for t, o in zip(transforms, pending)]
+
+
+def dispatch_forward(transforms, spaces_list, scalings):
+    """Split-phase forward dispatch (counterpart of :func:`dispatch_backward`;
+    ``scalings`` must already be one :class:`ScalingType` per transform —
+    length-checked, like the batch itself)."""
+    transforms, spaces_list = list(transforms), list(spaces_list)
+    scalings = list(scalings)
+    _check_batch(transforms, spaces_list, "dispatch_forward")
+    if len(scalings) != len(transforms):
+        raise InvalidParameterError(
+            f"dispatch_forward: got {len(transforms)} transforms but "
+            f"{len(scalings)} scaling types"
+        )
+    return [
+        t._dispatch_forward(s, sc)
+        for t, s, sc in zip(transforms, spaces_list, scalings)
+    ]
+
+
+def finalize_forward(transforms, pending):
+    """Wait for and fetch the packed results of a :func:`dispatch_forward`
+    batch, in order."""
+    return [t._finalize_forward(p) for t, p in zip(transforms, pending)]
+
+
 def multi_transform_backward(transforms, values_list):
     """Execute independent backward transforms with pipelined dispatch.
 
@@ -63,14 +109,13 @@ def multi_transform_backward(transforms, values_list):
     """
     transforms = list(transforms)
     values_list = list(values_list)
-    _check_batch(transforms, values_list, "multi_transform_backward")
+    # validation (lengths, duplicate transform objects) lives in the
+    # split-phase halves — one rule for both entry forms
     with timing.scoped("multi backward"):
         with timing.scoped("dispatch all"):
-            pending = [
-                t._dispatch_backward(v) for t, v in zip(transforms, values_list)
-            ]
+            pending = dispatch_backward(transforms, values_list)
         with timing.scoped("finalize all"):
-            return [t._finalize_backward(o) for t, o in zip(transforms, pending)]
+            return finalize_backward(transforms, pending)
 
 
 def multi_transform_forward(transforms, spaces_list=None, scaling_types=None):
@@ -86,13 +131,10 @@ def multi_transform_forward(transforms, spaces_list=None, scaling_types=None):
         spaces_list = [None] * len(transforms)
     else:
         spaces_list = list(spaces_list)
-    _check_batch(transforms, spaces_list, "multi_transform_forward")
+    # batch validation lives in dispatch_forward (one rule for both forms)
     scalings = _broadcast_scaling(scaling_types, len(transforms))
     with timing.scoped("multi forward"):
         with timing.scoped("dispatch all"):
-            pending = [
-                t._dispatch_forward(s, sc)
-                for t, s, sc in zip(transforms, spaces_list, scalings)
-            ]
+            pending = dispatch_forward(transforms, spaces_list, scalings)
         with timing.scoped("finalize all"):
-            return [t._finalize_forward(p) for t, p in zip(transforms, pending)]
+            return finalize_forward(transforms, pending)

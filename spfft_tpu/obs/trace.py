@@ -76,6 +76,9 @@ EVENTS = (
     "wisdom.save",     # wisdom store write attempt (tuning.wisdom)
     "verify",          # ABFT check verdict / retry / demotion / breaker
     #                    transition (spfft_tpu.verify)
+    "serve",           # serving-layer transition (spfft_tpu.serve): admit /
+    #                    reject / shed / coalesce / dispatch / complete
+
     "perf",            # performance report built (spfft_tpu.obs.perf):
     #                    measured GFLOP/s + exchange_fraction, run-ID-joined
     "error",           # typed spfft_tpu.errors exception constructed

@@ -122,6 +122,39 @@ def _join_complex(outs, cdtype):
     ]
 
 
+def value_order_map(plan_triplets, request_triplets):
+    """Static permutation aligning a caller's packed value order with a
+    plan's storage order — the coalescing map of the serving layer
+    (:mod:`spfft_tpu.serve`).
+
+    Two requests "share a stick layout" when their sparse index TRIPLET sets
+    are equal; their packed value vectors may still be permutations of each
+    other (each caller packs in its own submission order, the plan packs in
+    storage order). This computes the whole-row static map ``src`` with
+
+        ``plan_packed[i] == request_values[src[i]]``
+
+    so a request's values scatter into a cached plan's order (backward
+    input: ``request_values[src]``) and a plan's packed result scatters back
+    (forward output: ``out[src] = plan_result``) — the same
+    static-map-over-whole-rows discipline as every exchange in this module,
+    applied to the request axis instead of the shard axis. Returns ``None``
+    when the triplet sets differ (the geometries do not coalesce). Both
+    inputs are ``(V, 3)`` (or flat ``3V``) integer arrays; duplicate rows
+    cannot occur on either side (plans reject duplicate indices)."""
+    a = np.asarray(plan_triplets, dtype=np.int64).reshape(-1, 3)
+    b = np.asarray(request_triplets, dtype=np.int64).reshape(-1, 3)
+    if a.shape != b.shape:
+        return None
+    oa = np.lexsort((a[:, 2], a[:, 1], a[:, 0]))
+    ob = np.lexsort((b[:, 2], b[:, 1], b[:, 0]))
+    if not np.array_equal(a[oa], b[ob]):
+        return None
+    src = np.empty(a.shape[0], dtype=np.int64)
+    src[oa] = ob
+    return src
+
+
 def _chain_step_sizes(n, L):
     """Per-rotation static buffer sizes for an exact-counts chain over
     per-shard stick counts ``n`` and plane counts ``L``.

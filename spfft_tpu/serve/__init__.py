@@ -1,0 +1,63 @@
+"""spfft_tpu.serve — overload-safe multi-tenant transform serving.
+
+The serving layer (ROADMAP item 2): millions of users means floods of
+concurrent small/medium transforms, not one giant one — and a library that
+falls over the moment two callers contend is not a production system. This
+package turns the plan/execute machinery into a *service* whose defining
+property is graceful behavior under overload:
+
+1. **Admission queue** (:mod:`.queue`): bounded, per-tenant accounted.
+   Overload becomes immediate typed :class:`ServiceOverloadError`
+   backpressure (queue full, tenant quota) or fair-share shedding — never
+   unbounded latency. Deadlines are enforced at admission AND pre-dispatch.
+2. **Coalesced batching** (:mod:`.batcher`): requests whose sparse index
+   sets share a stick layout resolve to one cached plan (keyed like the
+   tuning wisdom store) and execute as batches through the pipelined
+   split-phase dispatch of :mod:`spfft_tpu.multi_transform`, with
+   per-caller value orders bridged by static maps
+   (:func:`spfft_tpu.parallel.ragged.value_order_map`) — the AccFFT
+   amortize-the-dispatch discipline (arxiv 1506.07933).
+3. **Service** (:mod:`.service`): the dispatcher — retry with jittered
+   backoff for transient typed failures, the verify circuit breaker wired
+   into a shed-or-demote ladder, per-tenant metrics/histograms on the obs
+   registry, ``serve`` flight-recorder events, and fault sites
+   ``serve.admit`` / ``serve.batch`` / ``serve.dispatch`` making the whole
+   admission→coalesce→execute→respond path chaos-testable.
+
+Guarantee (``tests/test_serve.py``, ``./ci.sh serve``): at offered load
+beyond capacity, with faults armed on every ``serve.*`` site, the queue
+stays bounded, refusals are typed, the dispatcher never deadlocks, and
+every accepted request's ticket resolves — completed (verified, when
+``verify=`` is armed) or failed with a typed :mod:`spfft_tpu.errors`
+member. ``programs/loadgen.py`` drives sustained open-loop traffic against
+it and emits the gate-compatible throughput/latency report
+(``SERVE_r08.json``).
+"""
+from .errors import (  # noqa: F401
+    OUTCOMES,
+    SHED_REASONS,
+    DeadlineExceededError,
+    ServiceOverloadError,
+    as_typed,
+)
+from .queue import AdmissionQueue, Request, Ticket  # noqa: F401
+from .batcher import PlanCache, canonical_triplets, wrap_triplets  # noqa: F401
+from .service import (  # noqa: F401
+    DEFAULT_BACKOFF_S,
+    DEFAULT_BATCH_MAX,
+    DEFAULT_PLANS,
+    DEFAULT_QUEUE_CAP,
+    DEFAULT_RETRIES,
+    DEFAULT_TENANT_QUOTA,
+    RETRYABLE_ERRORS,
+    SERVE_BACKOFF_ENV,
+    SERVE_BATCH_MAX_ENV,
+    SERVE_ON_BREAKER_ENV,
+    SERVE_PLANS_ENV,
+    SERVE_QUEUE_CAP_ENV,
+    SERVE_RETRIES_ENV,
+    SERVE_TENANT_QUOTA_ENV,
+    SERVE_TIMEOUT_ENV,
+    TransformService,
+    resolve_on_breaker,
+)

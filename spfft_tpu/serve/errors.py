@@ -1,0 +1,62 @@
+"""Typed serving-layer failure surface: vocabularies and conversion helpers.
+
+The serving layer never fails untyped and never fails silently: every
+admission refusal, shed, deadline miss, and execution failure resolves as a
+member of the :mod:`spfft_tpu.errors` taxonomy (C-translatable through
+``capi.error_code`` like the rest of the package), tagged with a reason from
+the canonical vocabularies below, counted in the run-metrics registry, and
+stamped into the flight recorder. The acceptance invariant of the whole
+layer — *every accepted request either completes or fails typed* — rests on
+these being the only ways out of the service.
+"""
+from __future__ import annotations
+
+from ..errors import (  # noqa: F401  (the serving layer's error surface)
+    DeadlineExceededError,
+    GenericError,
+    ServiceOverloadError,
+)
+from ..faults import execution_error, summarize
+
+# Terminal outcomes a submitted request can reach (the ``outcome`` label of
+# ``serve_requests_total{tenant,outcome}``). ``rejected`` happens at admission
+# (the caller's submit raises, nothing was queued); the rest happen to
+# admitted requests and resolve their tickets.
+OUTCOMES = ("completed", "rejected", "shed", "deadline_miss", "failed")
+
+# Why a request was refused or shed (the ``reason`` label of
+# ``serve_sheds_total{reason}``):
+#   queue_full    — bounded admission queue at capacity, no sheddable peer
+#   tenant_quota  — the submitting tenant is over its per-tenant queue quota
+#   fair_share    — a queued request of an over-share tenant was evicted to
+#                   admit an under-share tenant (noisy-neighbor protection)
+#   deadline      — the request expired while queued (shed pre-dispatch)
+#   breaker_open  — the engine circuit breaker is open and the service is
+#                   configured to shed instead of demote
+#   plan_evicted  — the request's plan-cache entry was LRU-evicted while it
+#                   sat queued (cache thrash under many cold geometries)
+#   closing       — the service is shutting down
+SHED_REASONS = (
+    "queue_full",
+    "tenant_quota",
+    "fair_share",
+    "deadline",
+    "breaker_open",
+    "plan_evicted",
+    "closing",
+)
+
+
+def as_typed(exc: BaseException, platform: str) -> GenericError:
+    """Convert any execution failure into the typed error surface: typed
+    :mod:`spfft_tpu.errors` exceptions pass through, anything else becomes
+    the platform's execution error (``HostExecutionError`` on CPU plans,
+    ``GPUFFTError`` on accelerators) with the original as ``__cause__`` —
+    the same conversion rule as :func:`spfft_tpu.faults.typed_execution`,
+    usable where the failure is held as a value (ticket resolution) rather
+    than raised through a scope."""
+    if isinstance(exc, GenericError):
+        return exc
+    err = execution_error(platform)(f"serving execution failed: {summarize(exc)}")
+    err.__cause__ = exc
+    return err

@@ -1,0 +1,664 @@
+"""`TransformService`: a multi-tenant, overload-safe transform service.
+
+The serving layer's owner object (ROADMAP item 2): callers submit sparse
+transforms (triplets + payload) from any thread and get back a
+:class:`~spfft_tpu.serve.queue.Ticket`; a single dispatcher (a background
+thread, or the caller via :meth:`TransformService.pump`) pops same-geometry
+coalesced batches from the bounded admission queue and executes them through
+the plan cache. Robustness is the headline — the service's behavior *under
+overload* is its contract:
+
+- **Backpressure, not latency**: the bounded queue refuses admission with
+  typed :class:`ServiceOverloadError` (queue full / tenant quota) — offered
+  load beyond capacity is rejected in O(1), never absorbed as unbounded
+  queueing delay.
+- **Deadlines, twice**: an expired deadline is refused at admission and shed
+  pre-dispatch — including between retry attempts — so device time is never
+  burned on an answer nobody is waiting for
+  (:class:`DeadlineExceededError`, ``deadline_miss``).
+- **Fair-share shedding**: one noisy tenant cannot starve the rest (see
+  :mod:`spfft_tpu.serve.queue`).
+- **Retry with jittered backoff**: transient typed execution failures
+  (``RETRYABLE_ERRORS``) re-dispatch up to ``SPFFT_TPU_SERVE_RETRIES`` times
+  with :func:`spfft_tpu.faults.backoff_s` jitter — concurrent batches
+  retrying one flaky engine spread out instead of herding.
+- **Breaker ladder**: a tripped verify circuit breaker
+  (:mod:`spfft_tpu.verify.breaker`) on the batch's engine flips the service
+  to shed-or-demote (``SPFFT_TPU_SERVE_ON_BREAKER``): ``demote`` reroutes
+  requests through the plan's ``jnp.fft`` reference rung, ``shed`` fails
+  them typed — never queue-and-die behind a dead engine.
+- **No silent exits**: every admitted request's ticket resolves — completed,
+  or failed with a typed :mod:`spfft_tpu.errors` member — on every path,
+  chaos included (``./ci.sh serve``, ``tests/test_serve.py`` arm every
+  ``serve.*`` fault site at 2x offered overload and assert it).
+
+Observability rides the existing registries: per-tenant counters and latency
+histograms, queue-depth gauges, batch-occupancy histograms
+(``serve_*`` metrics in ``obs.snapshot()``), and ``serve`` flight-recorder
+events for admit/shed/dispatch/complete transitions.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import random
+import threading
+import time
+
+import numpy as np
+
+from .. import faults, obs
+from ..errors import (
+    FFTWError,
+    GPUFFTError,
+    HostExecutionError,
+    InvalidParameterError,
+    MPIError,
+)
+from ..types import ProcessingUnit, ScalingType, TransformType
+from ..verify import breaker
+from .batcher import (
+    PlanCache,
+    run_batch,
+    run_reference,
+    sort_triplets,
+    wrap_triplets,
+)
+from .errors import DeadlineExceededError, ServiceOverloadError, as_typed
+from .queue import AdmissionQueue, Request
+
+SERVE_QUEUE_CAP_ENV = "SPFFT_TPU_SERVE_QUEUE_CAP"
+SERVE_BATCH_MAX_ENV = "SPFFT_TPU_SERVE_BATCH_MAX"
+SERVE_TENANT_QUOTA_ENV = "SPFFT_TPU_SERVE_TENANT_QUOTA"
+SERVE_TIMEOUT_ENV = "SPFFT_TPU_SERVE_TIMEOUT_S"
+SERVE_RETRIES_ENV = "SPFFT_TPU_SERVE_RETRIES"
+SERVE_BACKOFF_ENV = "SPFFT_TPU_SERVE_BACKOFF_S"
+SERVE_ON_BREAKER_ENV = "SPFFT_TPU_SERVE_ON_BREAKER"
+SERVE_PLANS_ENV = "SPFFT_TPU_SERVE_PLANS"
+
+DEFAULT_QUEUE_CAP = 256
+DEFAULT_BATCH_MAX = 8
+DEFAULT_TENANT_QUOTA = 0.5
+DEFAULT_RETRIES = 1
+DEFAULT_BACKOFF_S = 0.005
+DEFAULT_PLANS = 16
+
+# Typed execution failures one re-dispatch may heal (the verify supervisor's
+# retry rule): the dual error surface's dispatch/fence conversions plus the
+# collective layer. Parameter/index errors and overload/deadline refusals
+# are NOT retryable — they would fail identically.
+RETRYABLE_ERRORS = (HostExecutionError, GPUFFTError, MPIError, FFTWError)
+
+
+def _env_int(name: str, default: int, floor: int) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, str(default)) or default))
+    except ValueError as e:
+        raise InvalidParameterError(f"invalid {name}: expected an integer") from e
+
+
+def _env_float(name: str, default: float, floor: float) -> float:
+    try:
+        return max(floor, float(os.environ.get(name, str(default)) or default))
+    except ValueError as e:
+        raise InvalidParameterError(f"invalid {name}: expected a float") from e
+
+
+def resolve_on_breaker(value: str | None = None) -> str:
+    """``demote`` (reroute through the jnp.fft reference rung) or ``shed``
+    (typed refusal) — what the service does with a batch whose engine's
+    circuit breaker is open (``SPFFT_TPU_SERVE_ON_BREAKER``)."""
+    mode = value if value is not None else os.environ.get(
+        SERVE_ON_BREAKER_ENV, "demote"
+    )
+    if mode not in ("demote", "shed"):
+        raise InvalidParameterError(
+            f"invalid breaker response {mode!r}: expected 'demote' or 'shed'"
+        )
+    return mode
+
+
+class TransformService:
+    """Multi-tenant transform service over a bounded admission queue.
+
+    One service instance owns one plan cache, one admission queue and one
+    dispatcher. ``start=True`` (default) runs the dispatcher as a daemon
+    thread; ``start=False`` leaves dispatch to explicit :meth:`pump` calls
+    (deterministic tests, caller-owned event loops). Close with
+    :meth:`close` or a ``with`` block — pending tickets are drained or
+    failed typed, never leaked.
+
+    Plan-construction keyword arguments (``engine``, ``precision``,
+    ``policy``, ``guard``, ``verify``, ``dtype``, ``device``) pass through
+    to every cached :class:`~spfft_tpu.transform.Transform`, so a verified
+    service (``verify="on"``) runs every request under the ABFT recovery
+    supervisor and a tuned one (``policy="tuned"``) resolves engines through
+    wisdom."""
+
+    def __init__(
+        self,
+        processing_unit=ProcessingUnit.HOST,
+        *,
+        dtype=None,
+        engine: str = "auto",
+        precision: str = "highest",
+        policy: str | None = None,
+        guard: bool | None = None,
+        verify=None,
+        device=None,
+        queue_capacity: int | None = None,
+        batch_max: int | None = None,
+        tenant_quota: float | None = None,
+        default_timeout_s: float | None = None,
+        retries: int | None = None,
+        backoff_s: float | None = None,
+        on_breaker: str | None = None,
+        plan_cache_size: int | None = None,
+        start: bool = True,
+    ):
+        self._pu = ProcessingUnit(processing_unit)
+        self._plan_kwargs = dict(
+            dtype=dtype, engine=engine, precision=precision, policy=policy,
+            guard=guard, verify=verify, device=device,
+        )
+        self.queue_capacity = (
+            int(queue_capacity) if queue_capacity is not None
+            else _env_int(SERVE_QUEUE_CAP_ENV, DEFAULT_QUEUE_CAP, 1)
+        )
+        self.batch_max = (
+            max(1, int(batch_max)) if batch_max is not None
+            else _env_int(SERVE_BATCH_MAX_ENV, DEFAULT_BATCH_MAX, 1)
+        )
+        quota = (
+            float(tenant_quota) if tenant_quota is not None
+            else _env_float(SERVE_TENANT_QUOTA_ENV, DEFAULT_TENANT_QUOTA, 0.0)
+        )
+        self.default_timeout_s = (
+            float(default_timeout_s) if default_timeout_s is not None
+            else _env_float(SERVE_TIMEOUT_ENV, 0.0, 0.0)
+        )
+        self.retries = (
+            max(0, int(retries)) if retries is not None
+            else _env_int(SERVE_RETRIES_ENV, DEFAULT_RETRIES, 0)
+        )
+        self.backoff_s = (
+            max(0.0, float(backoff_s)) if backoff_s is not None
+            else _env_float(SERVE_BACKOFF_ENV, DEFAULT_BACKOFF_S, 0.0)
+        )
+        self.on_breaker = resolve_on_breaker(on_breaker)
+        cache_cap = (
+            int(plan_cache_size) if plan_cache_size is not None
+            else _env_int(SERVE_PLANS_ENV, DEFAULT_PLANS, 1)
+        )
+        self.queue = AdmissionQueue(self.queue_capacity, quota)
+        self.queue.on_shed = lambda tenant: self._count("shed", tenant)
+        self.plans = PlanCache(self._build_plan, cache_cap)
+        self._retry_rng = random.Random()
+        self._counts: collections.Counter = collections.Counter()
+        self._counts_lock = threading.Lock()
+        self._closing = False
+        self._worker = None
+        if start:
+            self._worker = threading.Thread(
+                target=self._dispatch_loop, name="spfft-serve-dispatch",
+                daemon=True,
+            )
+            self._worker.start()
+
+    # ---- plan construction ---------------------------------------------------
+
+    def _build_plan(self, canonical, key):
+        """Build the canonical plan of one cache entry (runs under the
+        cache lock — one build per geometry key, ever)."""
+        from ..transform import Transform
+
+        return Transform(
+            self._pu,
+            TransformType[key["type"]],
+            key["dims"][0], key["dims"][1], key["dims"][2],
+            indices=canonical,
+            **self._plan_kwargs,
+        )
+
+    def _clone_plan(self, plan):
+        return plan.clone()
+
+    def _platform(self) -> str:
+        return "gpu" if self._pu == ProcessingUnit.GPU else "cpu"
+
+    # ---- submission ----------------------------------------------------------
+
+    def submit(
+        self,
+        transform_type,
+        dims,
+        indices,
+        payload,
+        *,
+        direction: str = "backward",
+        tenant: str = "default",
+        timeout_s: float | None = None,
+        scaling: ScalingType = ScalingType.NONE,
+    ):
+        """Admit one request; returns its ticket without waiting.
+
+        ``indices`` are the caller's (V, 3) index triplets in the caller's
+        packing order; ``payload`` is the packed frequency values
+        (``direction="backward"``) or the ``(Z, Y, X)`` space slab
+        (``direction="forward"``). Raises typed
+        :class:`ServiceOverloadError` / :class:`DeadlineExceededError` on
+        refusal — admission is the backpressure surface."""
+        tenant = str(tenant)
+        try:
+            if self._closing:
+                obs.counter("serve_sheds_total", reason="closing").inc()
+                raise ServiceOverloadError("service is closing")
+            if direction not in ("backward", "forward"):
+                raise InvalidParameterError(
+                    f"unknown direction {direction!r}: expected backward/forward"
+                )
+            # cheap refusals BEFORE plan resolution: a request destined for
+            # a typed rejection must not pay a plan build (seconds of JAX
+            # trace/compile) or thrash the LRU cache on its way out — the
+            # O(1)-backpressure half of the admission contract. The queue
+            # re-checks both authoritatively under its own lock.
+            deadline = self._resolve_deadline(timeout_s)
+            if deadline is not None and deadline <= time.monotonic():
+                raise DeadlineExceededError(
+                    "request deadline expired before admission"
+                )
+            if self.queue.tenant_depth(tenant) >= self.queue.quota:
+                obs.counter("serve_sheds_total", reason="tenant_quota").inc()
+                raise ServiceOverloadError(
+                    f"tenant {tenant!r} is over its queue quota "
+                    f"({self.queue.quota} of {self.queue.capacity} slots)"
+                )
+            ttype = TransformType(transform_type)
+            dims = tuple(int(d) for d in dims)
+            if len(dims) != 3:
+                raise InvalidParameterError("dims must be (dim_x, dim_y, dim_z)")
+            request_triplets = wrap_triplets(indices, dims)
+            canonical = sort_triplets(request_triplets)
+            plan = self._plan_kwargs
+            digest, key = self.plans.key(
+                ttype, dims, canonical,
+                dtype=plan["dtype"] if plan["dtype"] is not None else _default_dtype(),
+                precision=plan["precision"], engine=plan["engine"],
+                platform=self._platform(),
+            )
+            entry, src = self.plans.ensure(digest, key, canonical, request_triplets)
+            payload = self._stage_payload(
+                entry.plan, direction, payload, src, len(request_triplets)
+            )
+            request = Request(
+                tenant=tenant, direction=direction,
+                scaling=ScalingType(scaling), plan_key=digest,
+                payload=payload,
+                order_map=src if direction == "forward" else None,
+                deadline=deadline,
+            )
+            try:
+                self.queue.admit(request)
+            except faults.InjectedFault as e:
+                # the serve.admit chaos site: admission machinery death is
+                # an overload-class refusal, typed like every other one
+                raise ServiceOverloadError(
+                    f"admission machinery failed: {faults.summarize(e)}"
+                ) from e
+        except Exception:
+            self._count("rejected", tenant)
+            obs.trace.event("serve", what="reject", tenant=tenant)
+            raise
+        obs.trace.event(
+            "serve", what="admit", tenant=tenant, direction=direction
+        )
+        self._count("admitted", tenant)
+        return request.ticket
+
+    def backward(self, transform_type, dims, indices, values, **kw):
+        """Submit one backward request and wait for its result."""
+        return self.submit(
+            transform_type, dims, indices, values, direction="backward", **kw
+        ).result()
+
+    def forward(self, transform_type, dims, indices, space,
+                scaling: ScalingType = ScalingType.NONE, **kw):
+        """Submit one forward request and wait for its packed result (in the
+        caller's index order)."""
+        return self.submit(
+            transform_type, dims, indices, space, direction="forward",
+            scaling=scaling, **kw
+        ).result()
+
+    def _resolve_deadline(self, timeout_s):
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
+        timeout_s = float(timeout_s)
+        if timeout_s <= 0:
+            return None
+        return time.monotonic() + timeout_s
+
+    def _stage_payload(self, plan, direction, payload, src, num_values):
+        """Validate + reorder the caller's payload into plan order (backward
+        values gather through the value-order map; forward slabs pass
+        through shape-checked)."""
+        if direction == "backward":
+            values = np.asarray(payload).reshape(-1)
+            if values.size != num_values:
+                raise InvalidParameterError(
+                    f"expected {num_values} frequency values, got {values.size}"
+                )
+            return values[src]
+        space = np.asarray(payload)
+        expect = plan.dim_z * plan.dim_y * plan.dim_x
+        if space.size != expect:
+            raise InvalidParameterError(
+                f"expected a {plan.dim_z}x{plan.dim_y}x{plan.dim_x} space "
+                f"slab ({expect} elements), got {space.size}"
+            )
+        return space.reshape(plan.dim_z, plan.dim_y, plan.dim_x)
+
+    # ---- dispatch ------------------------------------------------------------
+
+    def pump(self, max_batches: int | None = None) -> int:
+        """Drain coalesced batches synchronously (``start=False`` services);
+        returns the number of batches processed. Single consumer only — a
+        service with a live dispatcher thread refuses."""
+        if self._worker is not None and self._worker.is_alive():
+            raise InvalidParameterError(
+                "pump() on a threaded service: the dispatcher owns the queue"
+            )
+        processed = 0
+        while max_batches is None or processed < max_batches:
+            batch = self.queue.pop_batch(self.batch_max, timeout=0.0)
+            if not batch:
+                break
+            self._process_batch(batch)
+            processed += 1
+        return processed
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self.queue.pop_batch(self.batch_max, timeout=0.05)
+            if not batch:
+                if self._closing:
+                    return
+                continue
+            self._process_batch(batch)
+
+    def _process_batch(self, batch: list) -> None:
+        """Execute one coalesced batch end-to-end, resolving every ticket.
+
+        The catch-all is deliberate and narrow in effect: a dispatcher that
+        dies mid-batch would leave tickets pending forever (the queue-and-
+        die failure mode this layer exists to remove), so ANY failure here
+        resolves the whole batch's tickets with the typed conversion of the
+        cause and the loop survives — the no-deadlock half of the chaos
+        invariant."""
+        try:
+            self._process_batch_inner(batch)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            err = as_typed(e, self._platform())
+            for req in batch:
+                # count only tickets THIS failure resolved: requests the
+                # inner path already shed/resolved keep their first outcome
+                if req.ticket.fail(err):
+                    self._count("failed", req.tenant)
+
+    def _process_batch_inner(self, batch: list) -> None:
+        obs.counter("serve_batches_total").inc()
+        platform = self._platform()
+        entry = self.plans.get(batch[0].plan_key)
+        survivors = self._shed_expired(batch)
+        if not survivors:
+            return
+        if entry is None:  # evicted between admit and dispatch: rebuild-free shed
+            err = ServiceOverloadError("plan cache entry evicted while queued")
+            for req in survivors:
+                obs.counter("serve_sheds_total", reason="plan_evicted").inc()
+                if req.ticket.fail(err, outcome="shed"):
+                    self._count("shed", req.tenant)
+            return
+        engine = entry.plan._engine
+        supervised = entry.plan._verifier is not None
+        # breaker ladder: an open breaker on this batch's engine means the
+        # primary path is known-bad — shed or demote instead of queueing
+        # into a dead engine. Supervised plans skip this: their recovery
+        # supervisor owns the whole ladder, half-open probes included.
+        # Unsupervised batches consult allow() — which performs the
+        # open→half-open cooldown transition and grants THIS dispatcher the
+        # probe slot — and report the execution verdict back below, so serve
+        # traffic alone can heal (or re-open) a tripped breaker instead of
+        # demoting forever.
+        if not supervised and not breaker.allow(engine):
+            self._breaker_response(survivors, engine, entry)
+            return
+        # From here an unsupervised dispatcher MAY hold the breaker's single
+        # half-open probe slot (allow() just granted it). Every exit path
+        # must settle it: success/exhaustion report verdicts inline; the
+        # finally releases a verdict-carrying or verdict-less probe on the
+        # remaining exits (batch fully deadline-shed mid-retry, a
+        # non-retryable escape to the catch-all) so the breaker can never
+        # wedge in half-open behind a lost probe.
+        settled = supervised
+        observed_failure = False
+        try:
+            attempt = 0
+            while True:
+                survivors = self._shed_expired(survivors)
+                if not survivors:
+                    return
+                plans = entry.lease(len(survivors), self._clone_plan)
+                obs.trace.event(
+                    "serve", what="dispatch", engine=engine,
+                    occupancy=len(survivors), attempt=attempt,
+                )
+                try:
+                    with faults.typed_execution(platform, "serve dispatch"):
+                        faults.site("serve.dispatch")
+                        results = run_batch(plans[: len(survivors)], survivors)
+                except RETRYABLE_ERRORS as e:
+                    observed_failure = True
+                    attempt += 1
+                    if attempt > self.retries:
+                        if not supervised:
+                            # an exhausted-retries episode is an engine-
+                            # health signal: feed the breaker's consecutive-
+                            # failure count (and settle a held probe)
+                            breaker.record_failure(engine)
+                            settled = True
+                        err = as_typed(e, platform)
+                        for req in survivors:
+                            if req.ticket.fail(err):
+                                self._count("failed", req.tenant)
+                        return
+                    obs.counter("serve_retries_total").inc()
+                    self._count_only("retries")
+                    # jittered exponential backoff (faults.backoff_s):
+                    # concurrent batches retrying one flaky engine spread
+                    # out, not herd
+                    time.sleep(
+                        faults.backoff_s(self.backoff_s, attempt, self._retry_rng)
+                    )
+                    continue
+                if not supervised:
+                    # execution succeeded: settle a half-open probe / reset
+                    # the consecutive-failure count (supervised plans'
+                    # supervisors already reported their verified verdicts)
+                    breaker.record_success(engine)
+                    settled = True
+                for req, result in zip(survivors, results):
+                    if req.ticket.resolve(result):
+                        self._observe_completion(req)
+                return
+        finally:
+            if not settled:
+                if observed_failure:
+                    breaker.record_failure(engine)
+                else:
+                    breaker.release_probe(engine)
+
+    def _shed_expired(self, batch: list) -> list:
+        now = time.monotonic()
+        survivors = []
+        for req in batch:
+            if req.expired(now):
+                obs.counter(
+                    "serve_deadline_misses_total", tenant=req.tenant
+                ).inc()
+                obs.counter("serve_sheds_total", reason="deadline").inc()
+                obs.trace.event("serve", what="shed", reason="deadline",
+                                tenant=req.tenant)
+                if req.ticket.fail(
+                    DeadlineExceededError(
+                        "request expired while queued; shed pre-dispatch"
+                    ),
+                    outcome="deadline_miss",
+                ):
+                    self._count("deadline_miss", req.tenant)
+            else:
+                survivors.append(req)
+        return survivors
+
+    def _breaker_response(self, batch: list, engine: str, entry) -> None:
+        if self.on_breaker == "shed":
+            obs.counter("serve_sheds_total", reason="breaker_open").inc()
+            err = ServiceOverloadError(
+                f"engine {engine!r} circuit breaker open; shedding"
+            )
+            for req in batch:
+                obs.trace.event("serve", what="shed", reason="breaker_open",
+                                tenant=req.tenant)
+                if req.ticket.fail(err, outcome="shed"):
+                    self._count("shed", req.tenant)
+            return
+        # demote: the jnp.fft reference rung, per request (correctness over
+        # batching on the degraded path), mirroring the verify supervisor
+        platform = self._platform()
+        for req in batch:
+            obs.trace.event("serve", what="demote", engine=engine,
+                            tenant=req.tenant)
+            self._count_only("demoted")
+            obs.counter("serve_demotions_total", engine=engine).inc()
+            try:
+                with faults.typed_execution(platform, "serve demote"):
+                    result = run_reference(entry.plan, req)
+            except Exception as e:  # noqa: BLE001 — ticket must resolve
+                if req.ticket.fail(as_typed(e, platform)):
+                    self._count("failed", req.tenant)
+                continue
+            if req.ticket.resolve(result):
+                self._observe_completion(req)
+
+    def _observe_completion(self, req) -> None:
+        self._count("completed", req.tenant)
+        obs.counter(
+            "serve_requests_total", tenant=req.tenant, outcome="completed"
+        ).inc()
+        latency = req.ticket.latency_s()
+        if latency is not None:
+            obs.histogram("serve_latency_seconds", tenant=req.tenant).observe(
+                latency
+            )
+        obs.trace.event("serve", what="complete", tenant=req.tenant)
+
+    # ---- bookkeeping ---------------------------------------------------------
+
+    def _count(self, outcome: str, tenant: str) -> None:
+        with self._counts_lock:
+            self._counts[outcome] += 1
+        if outcome != "admitted":
+            obs.counter(
+                "serve_requests_total", tenant=tenant, outcome=outcome
+            ).inc()
+
+    def _count_only(self, key: str) -> None:
+        with self._counts_lock:
+            self._counts[key] += 1
+
+    def stats(self) -> dict:
+        """JSON-plain service counters + queue state (the loadgen/CI
+        surface; the obs registry carries the per-tenant breakdown)."""
+        with self._counts_lock:
+            counts = dict(self._counts)
+        return {
+            "counts": counts,
+            "queue_depth": self.queue.depth(),
+            "queue_high_water": self.queue.high_water,
+            "queue_capacity": self.queue.capacity,
+            "tenant_quota_slots": self.queue.quota,
+            "batch_max": self.batch_max,
+            "plan_cache_entries": len(self.plans),
+            "on_breaker": self.on_breaker,
+        }
+
+    def describe(self) -> dict:
+        """Service configuration + plan-cache inventory (each entry carries
+        its plan's card run ID — the join key into metrics and traces) +
+        the breaker state of every cached engine."""
+        cache = self.plans.describe()
+        engines = sorted({row["engine"] for row in cache})
+        return {
+            "config": {
+                "queue_capacity": self.queue_capacity,
+                "batch_max": self.batch_max,
+                "tenant_quota_slots": self.queue.quota,
+                "default_timeout_s": self.default_timeout_s,
+                "retries": self.retries,
+                "backoff_s": self.backoff_s,
+                "on_breaker": self.on_breaker,
+                "verify": str(self._plan_kwargs.get("verify")),
+                "threaded": self._worker is not None,
+            },
+            "plan_cache": cache,
+            "breakers": {e: breaker.describe(e) for e in engines},
+            "stats": self.stats(),
+        }
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the service. ``drain=True`` lets the dispatcher finish the
+        queue first; ``drain=False`` fails every pending ticket typed
+        (``ServiceOverloadError``, reason ``closing``). Idempotent; pending
+        tickets are never leaked either way."""
+        self._closing = True
+        # refuse further admissions under the queue's own lock FIRST: a
+        # submit racing this close either enqueued before the flag (drained
+        # below or finished by the worker) or fails typed — no ticket leaks
+        self.queue.shut()
+        if not drain:
+            self._shed_closing()
+        if self._worker is not None:
+            self.queue.wake()
+            self._worker.join(timeout)
+            self._worker = None
+        elif drain:
+            self.pump()
+        # whatever survived a non-draining close or a wedged worker fails
+        # typed — the no-leaked-ticket contract
+        self._shed_closing()
+
+    def _shed_closing(self) -> None:
+        for req in self.queue.drain():
+            obs.counter("serve_sheds_total", reason="closing").inc()
+            if req.ticket.fail(
+                ServiceOverloadError("service closed before dispatch"),
+                outcome="shed",
+            ):
+                self._count("shed", req.tenant)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _default_dtype():
+    import jax
+
+    return np.dtype(
+        np.float64 if jax.config.read("jax_enable_x64") else np.float32
+    )

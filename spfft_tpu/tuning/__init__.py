@@ -45,8 +45,10 @@ from .runner import (  # noqa: F401
     TUNE_CPU_ENV,
     TUNE_REPEATS_ENV,
     TUNE_WARMUP_ENV,
+    TrialTimeout,
     run_trials,
     trial_budget,
+    trial_deadline_s,
     trials_allowed,
 )
 from .candidates import exchange_candidates, local_candidates  # noqa: F401

@@ -24,10 +24,12 @@ histogram, so a metrics snapshot shows exactly what tuning cost.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from .. import faults, obs
 from ..errors import GenericError
+from ..sync import FENCE_BUDGET_ENV, _fence_budget_s
 
 TUNE_REPEATS_ENV = "SPFFT_TPU_TUNE_REPEATS"
 TUNE_WARMUP_ENV = "SPFFT_TPU_TUNE_WARMUP"
@@ -49,6 +51,15 @@ TRIAL_ERRORS = (
 )
 
 
+class TrialTimeout(RuntimeError):
+    """A tuning trial exceeded its wall-clock deadline (the
+    ``SPFFT_TPU_FENCE_BUDGET_S`` discipline extended over the whole trial —
+    build + warmup + timed repeats). A ``RuntimeError`` subclass on purpose:
+    it is a member of :data:`TRIAL_ERRORS`, so a hung candidate becomes an
+    honest ``error`` row and ``policy="tuned"`` planning degrades to the
+    model instead of stalling forever on one wedged compile or dispatch."""
+
+
 class TrialDegradedError(RuntimeError):
     """A trial plan silently degraded away from its candidate (the engine
     fallback rung fired inside the trial build): its timing would measure the
@@ -62,6 +73,59 @@ def trial_budget() -> tuple:
     warmup = max(0, int(os.environ.get(TUNE_WARMUP_ENV, "1")))
     repeats = max(1, int(os.environ.get(TUNE_REPEATS_ENV, "5")))
     return warmup, repeats
+
+
+def trial_deadline_s() -> float:
+    """Wall-clock budget for ONE whole candidate trial (build + warmup +
+    timed repeats), derived from the fence deadline discipline:
+    ``SPFFT_TPU_FENCE_BUDGET_S x (warmup + repeats + 1)`` — each roundtrip
+    gets one fence budget's worth, plus one for the trial plan's build.
+    0 (the default, budget unset) means no deadline."""
+    budget = _fence_budget_s()
+    if budget <= 0:
+        return 0.0
+    warmup, repeats = trial_budget()
+    return budget * (warmup + repeats + 1)
+
+
+def _run_deadlined(fn, budget_s: float, label: str):
+    """Run ``fn`` under a wall-clock deadline in a worker thread (the
+    ``sync.fence`` budget pattern): a wedge — hung compile, dead dispatch —
+    raises :class:`TrialTimeout` after ``budget_s`` instead of stalling the
+    tuned-policy plan construction. The worker re-enters the caller's trace
+    run so the trial's events keep their run-ID join; it stays parked on the
+    dead call (daemon, reclaimed at exit) if the deadline fires."""
+    if budget_s <= 0:
+        return fn()
+    done = threading.Event()
+    result: list = []
+    err: list = []
+    run = obs.trace.current_run_id()
+
+    def _work():
+        try:
+            # re-enter the caller's run ID AND its dump suppression: both
+            # are thread-local, and a failing candidate is an EXPECTED,
+            # isolated error row — it must not flood SPFFT_TPU_TRACE_DUMP
+            # with dumps of handled errors just because a deadline is set
+            with obs.trace.with_run(run), obs.trace.suppressed_dumps():
+                result.append(fn())
+        except BaseException as e:  # re-raised in the caller thread
+            err.append(e)
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_work, daemon=True)
+    worker.start()
+    if not done.wait(budget_s):
+        raise TrialTimeout(
+            f"tuning trial {label!r} exceeded its {budget_s:.3g}s deadline "
+            f"({FENCE_BUDGET_ENV} x (warmup + repeats + 1)); candidate "
+            "recorded as an error row, planning falls back"
+        )
+    if err:
+        raise err[0]
+    return result[0]
 
 
 def trials_allowed(platform: str) -> bool:
@@ -156,19 +220,29 @@ def run_trials(build, candidates: list) -> list:
             with obs.trace.operation(
                 "tune.trial", label=cand["label"]
             ), obs.trace.suppressed_dumps():
-                faults.site("tuning.trial")
-                trial = build(cand)
-                degraded = [
-                    d["event"]
-                    for d in getattr(trial, "_degradations", ())
-                    if d.get("event") == "engine_fallback"
-                ]
-                if degraded:
-                    raise TrialDegradedError(
-                        f"trial plan fell back ({degraded[0]}): timing would "
-                        "not measure the candidate"
-                    )
-                seconds = measure_candidate(trial)
+
+                def _trial(cand=cand):
+                    faults.site("tuning.trial")
+                    trial = build(cand)
+                    degraded = [
+                        d["event"]
+                        for d in getattr(trial, "_degradations", ())
+                        if d.get("event") == "engine_fallback"
+                    ]
+                    if degraded:
+                        raise TrialDegradedError(
+                            f"trial plan fell back ({degraded[0]}): timing "
+                            "would not measure the candidate"
+                        )
+                    return measure_candidate(trial)
+
+                # the whole trial runs under the SPFFT_TPU_FENCE_BUDGET_S
+                # deadline discipline (see trial_deadline_s): a hung
+                # candidate fails typed into TRIAL_ERRORS instead of
+                # stalling policy="tuned" planning forever
+                seconds = _run_deadlined(
+                    _trial, trial_deadline_s(), cand["label"]
+                )
         except TRIAL_ERRORS as e:
             obs.counter("tuning_trial_failures_total", candidate=cand["label"]).inc()
             failed.append(dict(cand, error=faults.summarize(e)))

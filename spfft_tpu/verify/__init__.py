@@ -40,8 +40,10 @@ from .supervisor import (  # noqa: F401
     DEFAULT_RETRIES,
     RETRYABLE_ERRORS,
     VERIFY_BACKOFF_ENV,
+    VERIFY_JITTER_SEED_ENV,
     VERIFY_RETRIES_ENV,
     Supervisor,
+    jitter_rng,
     resolve_backoff_s,
     resolve_retries,
 )

@@ -58,8 +58,24 @@ def _entry(engine: str) -> dict:
             "consecutive_failures": 0,
             "opened_at": 0.0,
             "trips": 0,
+            # half-open admits exactly ONE in-flight probe: concurrent
+            # verified callers racing the cooldown must not all hammer a
+            # possibly-still-bad engine at once — losers fail fast to the
+            # reference rung while the winner's verdict settles the state
+            "probing": False,
+            "probe_at": 0.0,
         }
     return entry
+
+
+def _probe_takeover_s() -> float:
+    """How long an in-flight half-open probe may go verdict-less before
+    another caller may take over the slot. A probe whose carrier died
+    without reporting (a non-retryable escape, a killed thread) must not
+    wedge the breaker in half-open forever — the slot self-heals after the
+    cooldown (floored at 1 s so a zero cooldown still admits exactly one
+    probe per instant under a thread race)."""
+    return max(1.0, cooldown_s())
 
 
 def _transition(engine: str, entry: dict, state: str) -> None:
@@ -73,15 +89,41 @@ def allow(engine: str) -> bool:
 
     Closed -> yes. Open -> no until the cooldown elapses, then the breaker
     moves to half-open and THIS caller carries the probe. Half-open -> yes
-    (the probe's verdict settles the state)."""
+    for exactly ONE caller at a time: while a probe is in flight every other
+    caller is refused (straight to the reference rung) — N threads racing an
+    elapsed cooldown must not multiply the probe load on an engine the
+    breaker just declared unhealthy. The probe's verdict
+    (:func:`record_success` / :func:`record_failure`) settles the state and
+    releases the probe slot."""
     with _lock:
         entry = _entry(engine)
+        now = time.monotonic()
         if entry["state"] == "open":
-            if time.monotonic() - entry["opened_at"] >= cooldown_s():
+            if now - entry["opened_at"] >= cooldown_s():
                 _transition(engine, entry, "half_open")
+                entry["probing"] = True
+                entry["probe_at"] = now
                 return True
             return False
+        if entry["state"] == "half_open":
+            # a verdict-less probe (carrier escaped without record_*) frees
+            # its slot after the takeover interval — see _probe_takeover_s
+            if entry["probing"] and now - entry["probe_at"] < _probe_takeover_s():
+                return False
+            entry["probing"] = True
+            entry["probe_at"] = now
+            return True
         return True
+
+
+def release_probe(engine: str) -> None:
+    """Release a held half-open probe slot WITHOUT a verdict — the probe
+    never actually executed (e.g. the serving layer's probe batch was fully
+    deadline-shed before dispatch). The state stays half-open and the next
+    :func:`allow` grants a fresh probe immediately instead of waiting out
+    the takeover interval. No-op when no probe is held."""
+    with _lock:
+        _entry(engine)["probing"] = False
 
 
 def record_success(engine: str) -> None:
@@ -90,6 +132,7 @@ def record_success(engine: str) -> None:
     with _lock:
         entry = _entry(engine)
         entry["consecutive_failures"] = 0
+        entry["probing"] = False
         if entry["state"] != "closed":
             _transition(engine, entry, "closed")
 
@@ -102,6 +145,7 @@ def record_failure(engine: str) -> None:
     with _lock:
         entry = _entry(engine)
         entry["consecutive_failures"] += 1
+        entry["probing"] = False
         tripped = (
             entry["state"] == "half_open"
             or entry["consecutive_failures"] >= threshold()

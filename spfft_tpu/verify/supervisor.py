@@ -38,6 +38,7 @@ process — verified calls skip the primary attempt entirely (a
 from __future__ import annotations
 
 import os
+import random
 import time
 
 import numpy as np
@@ -54,6 +55,7 @@ from . import breaker, checks
 
 VERIFY_RETRIES_ENV = "SPFFT_TPU_VERIFY_RETRIES"
 VERIFY_BACKOFF_ENV = "SPFFT_TPU_VERIFY_BACKOFF_S"
+VERIFY_JITTER_SEED_ENV = "SPFFT_TPU_VERIFY_JITTER_SEED"
 
 DEFAULT_RETRIES = 2
 DEFAULT_BACKOFF_S = 0.01
@@ -83,6 +85,16 @@ def resolve_backoff_s() -> float:
     return max(0.0, float(os.environ.get(VERIFY_BACKOFF_ENV, str(DEFAULT_BACKOFF_S))))
 
 
+def jitter_rng() -> random.Random:
+    """Per-supervisor jitter stream for the retry backoff
+    (:func:`spfft_tpu.faults.backoff_s`): concurrent callers retrying the
+    same failed engine must not thundering-herd it on a synchronized
+    schedule. Seeded from ``SPFFT_TPU_VERIFY_JITTER_SEED`` when set (a chaos
+    run's sleep sequence replays exactly), system entropy otherwise."""
+    seed = os.environ.get(VERIFY_JITTER_SEED_ENV)
+    return random.Random(int(seed)) if seed not in (None, "") else random.Random()
+
+
 class Supervisor:
     """Per-plan recovery supervisor (created only when verification is armed,
     so the disarmed hot path stays one falsy attribute check).
@@ -98,6 +110,7 @@ class Supervisor:
         self.mode = mode
         self.rtol = checks.resolve_rtol(transform.dtype)
         self.retries = resolve_retries()
+        self._jitter = jitter_rng()
         self._triplets = None  # lazy: storage-order rows aligned with packing
 
     # ---- plan-facing entry points ------------------------------------------
@@ -170,8 +183,10 @@ class Supervisor:
                         "verify", what="retry", direction=direction, attempt=i
                     )
                     # backoff OUTSIDE any lock (the wisdom.py retry rule): a
-                    # backing-off transform must not serialize other threads
-                    time.sleep(backoff * (2 ** (i - 1)))
+                    # backing-off transform must not serialize other threads;
+                    # jittered so concurrent retriers of one failed engine
+                    # spread out instead of re-hitting it in lockstep
+                    time.sleep(faults.backoff_s(backoff, i, self._jitter))
                 bad = None
                 try:
                     result = attempt()

@@ -130,3 +130,27 @@ def test_length_mismatch_rejected():
         multi_transform_backward([t], [])
     with pytest.raises(InvalidParameterError):
         multi_transform_forward([t], None, [ScalingType.FULL, ScalingType.NONE])
+
+
+def test_split_phase_api_matches_one_shot():
+    """The public dispatch_*/finalize_* halves (the serving layer's batch
+    path) produce exactly what the one-shot functions produce — they ARE the
+    one-shot functions' implementation, exposed for batch owners that
+    interleave work between the phases."""
+    from spfft_tpu import multi_transform as mt
+
+    rng = np.random.default_rng(9)
+    ts = [_make_local(4), _make_local(6)]
+    vals = [_rand_values(t, rng) for t in ts]
+    expect = multi_transform_backward(
+        [t.clone() for t in ts], [v.copy() for v in vals]
+    )
+    pending = mt.dispatch_backward(ts, vals)
+    spaces = mt.finalize_backward(ts, pending)
+    for got, want in zip(spaces, expect):
+        np.testing.assert_allclose(got, want, atol=1e-12)
+    scalings = [ScalingType.FULL] * len(ts)
+    fp = mt.dispatch_forward(ts, [None] * len(ts), scalings)
+    freqs = mt.finalize_forward(ts, fp)
+    for got, want in zip(freqs, vals):
+        np.testing.assert_allclose(got, want, atol=1e-10)

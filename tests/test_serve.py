@@ -1,0 +1,620 @@
+"""Chaos suite for the serving layer (spfft_tpu.serve).
+
+The acceptance invariant (ISSUE 8): at offered load beyond capacity, with
+faults armed on every ``serve.*`` site, the service keeps a bounded queue,
+rejects/sheds with typed errors, never deadlocks, and every accepted request
+either completes (verified, when armed) or fails typed. The suite pins the
+admission rules (backpressure, quota, fair share, deadlines at admission AND
+pre-dispatch), same-geometry coalescing with per-caller value orders, the
+plan cache, retry-with-jitter, the breaker shed-or-demote ladder, and the
+obs exposure (metrics + trace + describe join).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import (
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    errors,
+    faults,
+    obs,
+    serve,
+    verify,
+)
+from spfft_tpu.parallel.ragged import value_order_map
+from utils import assert_close
+
+DIM = 8
+DIMS = (DIM, DIM, DIM)
+
+SERVE_ENV_KNOBS = (
+    serve.SERVE_QUEUE_CAP_ENV,
+    serve.SERVE_BATCH_MAX_ENV,
+    serve.SERVE_TENANT_QUOTA_ENV,
+    serve.SERVE_TIMEOUT_ENV,
+    serve.SERVE_RETRIES_ENV,
+    serve.SERVE_BACKOFF_ENV,
+    serve.SERVE_ON_BREAKER_ENV,
+    serve.SERVE_PLANS_ENV,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_serve(monkeypatch):
+    """Serving state must never leak between tests: disarm faults, reset the
+    process-global breaker and metrics, scrub the serve env knobs."""
+    faults.disarm()
+    faults.reseed(0)
+    verify.breaker.reset()
+    obs.enable()
+    obs.clear()
+    for knob in SERVE_ENV_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+    yield
+    faults.disarm()
+    verify.breaker.reset()
+
+
+def _triplets(dim=DIM, frac=0.8):
+    return sp.create_spherical_cutoff_triplets(dim, dim, dim, frac)
+
+
+def _values(trip, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+
+
+def _expect_backward(trip, values):
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, DIM, DIM, DIM, indices=trip
+    )
+    return t.backward(values)
+
+
+def _service(**kw):
+    kw.setdefault("start", False)
+    kw.setdefault("queue_capacity", 16)
+    kw.setdefault("batch_max", 4)
+    return serve.TransformService(**kw)
+
+
+def _counter_sum(snapshot_counters, prefix):
+    return sum(v for k, v in snapshot_counters.items() if k.startswith(prefix))
+
+
+# ---- coalescing and parity ---------------------------------------------------
+
+
+def test_coalesced_backward_parity_across_value_orders():
+    """Requests sharing a stick layout but packing values in different
+    orders coalesce into ONE batch and each gets its own correct result."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    rng = np.random.default_rng(3)
+    perm = rng.permutation(len(trip))
+    svc = _service()
+    t1 = svc.submit(TransformType.C2C, DIMS, trip, values, tenant="a")
+    t2 = svc.submit(TransformType.C2C, DIMS, trip[perm], values[perm], tenant="b")
+    t3 = svc.submit(TransformType.C2C, DIMS, trip, values, tenant="a")
+    assert svc.pump() == 1  # one coalesced batch, not three
+    for t in (t1, t2, t3):
+        assert_close(t.result(timeout=10), expect)
+    snap = obs.snapshot()
+    occ = snap["histograms"]["serve_batch_occupancy"]
+    assert occ["count"] == 1 and occ["sum"] == 3.0
+    svc.close()
+
+
+def test_forward_results_return_in_caller_order():
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    rng = np.random.default_rng(4)
+    perm = rng.permutation(len(trip))
+    svc = _service()
+    tk = svc.submit(
+        TransformType.C2C, DIMS, trip[perm], expect, direction="forward",
+        scaling=ScalingType.FULL,
+    )
+    svc.pump()
+    assert_close(tk.result(timeout=10), values[perm])
+    svc.close()
+
+
+def test_centered_and_wrapped_indexing_share_a_plan():
+    """Centered (negative-frequency) triplets and their wrapped storage form
+    are the same geometry: one plan-cache entry, coalesced batches."""
+    trip = _triplets()
+    wrapped = serve.wrap_triplets(trip, DIMS)
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = _service()
+    t1 = svc.submit(TransformType.C2C, DIMS, trip, values)
+    t2 = svc.submit(TransformType.C2C, DIMS, wrapped, values)
+    assert svc.pump() == 1
+    assert_close(t1.result(timeout=10), expect)
+    assert_close(t2.result(timeout=10), expect)
+    assert svc.stats()["plan_cache_entries"] == 1
+    svc.close()
+
+
+def test_plan_cache_hit_miss_and_eviction_counts():
+    trip_a = _triplets(frac=0.8)
+    trip_b = _triplets(frac=0.5)
+    values_a, values_b = _values(trip_a), _values(trip_b)
+    svc = _service(plan_cache_size=1)
+    svc.submit(TransformType.C2C, DIMS, trip_a, values_a)
+    svc.submit(TransformType.C2C, DIMS, trip_a, values_a)
+    svc.submit(TransformType.C2C, DIMS, trip_b, values_b)  # evicts trip_a
+    svc.pump()
+    counters = obs.snapshot()["counters"]
+    assert counters['serve_plan_cache_total{event="miss"}'] == 2
+    assert counters['serve_plan_cache_total{event="hit"}'] == 1
+    assert counters['serve_plan_cache_total{event="evict"}'] == 1
+    assert svc.stats()["plan_cache_entries"] == 1
+    svc.close()
+
+
+def test_distinct_geometries_do_not_coalesce():
+    trip_a = _triplets(frac=0.8)
+    trip_b = _triplets(frac=0.5)
+    svc = _service()
+    ta = svc.submit(TransformType.C2C, DIMS, trip_a, _values(trip_a))
+    tb = svc.submit(TransformType.C2C, DIMS, trip_b, _values(trip_b))
+    assert svc.pump() == 2  # two batches: the geometries differ
+    assert ta.outcome == "completed" and tb.outcome == "completed"
+    svc.close()
+
+
+def test_value_order_map_identity_permutation_and_mismatch():
+    trip = np.asarray(_triplets(), dtype=np.int64).reshape(-1, 3) % DIM
+    ident = value_order_map(trip, trip)
+    assert np.array_equal(ident, np.arange(len(trip)))
+    perm = np.random.default_rng(5).permutation(len(trip))
+    src = value_order_map(trip, trip[perm])
+    values = _values(trip)
+    assert np.allclose(values[perm][src], values)
+    assert value_order_map(trip, trip[: len(trip) - 1]) is None
+    other = trip.copy()
+    other[0] = [(other[0][0] + 1) % DIM, other[0][1], other[0][2]]
+    assert value_order_map(trip, other) is None or not np.array_equal(
+        np.sort(trip.view("i8,i8,i8"), axis=0), np.sort(other.view("i8,i8,i8"), axis=0)
+    )
+
+
+# ---- admission: backpressure, quotas, deadlines ------------------------------
+
+
+def test_bounded_queue_rejects_typed_when_full():
+    trip = _triplets()
+    values = _values(trip)
+    svc = _service(queue_capacity=3, tenant_quota=1.0)
+    for _ in range(3):
+        svc.submit(TransformType.C2C, DIMS, trip, values, tenant="a")
+    with pytest.raises(errors.ServiceOverloadError):
+        svc.submit(TransformType.C2C, DIMS, trip, values, tenant="a")
+    assert svc.queue.depth() == 3  # bounded: the refusal did not enqueue
+    svc.close(drain=False)
+
+
+def test_tenant_quota_rejects_before_queue_full():
+    trip = _triplets()
+    values = _values(trip)
+    svc = _service(queue_capacity=10, tenant_quota=0.2)  # 2 slots/tenant
+    svc.submit(TransformType.C2C, DIMS, trip, values, tenant="noisy")
+    svc.submit(TransformType.C2C, DIMS, trip, values, tenant="noisy")
+    with pytest.raises(errors.ServiceOverloadError):
+        svc.submit(TransformType.C2C, DIMS, trip, values, tenant="noisy")
+    # other tenants unaffected
+    svc.submit(TransformType.C2C, DIMS, trip, values, tenant="quiet")
+    svc.close(drain=False)
+
+
+def test_fair_share_shed_protects_quiet_tenant():
+    """A full queue held by one noisy tenant sheds the noisy tenant's newest
+    request (typed, recorded) to admit an under-share tenant."""
+    trip = _triplets()
+    values = _values(trip)
+    svc = _service(queue_capacity=4, tenant_quota=1.0)
+    noisy = [
+        svc.submit(TransformType.C2C, DIMS, trip, values, tenant="noisy")
+        for _ in range(4)
+    ]
+    quiet = svc.submit(TransformType.C2C, DIMS, trip, values, tenant="quiet")
+    assert noisy[-1].done() and noisy[-1].outcome == "shed"
+    with pytest.raises(errors.ServiceOverloadError):
+        noisy[-1].result(timeout=0)
+    assert svc.queue.depth() == 4  # still bounded
+    svc.pump()
+    assert quiet.outcome == "completed"
+    counters = obs.snapshot()["counters"]
+    assert counters['serve_sheds_total{reason="fair_share"}'] == 1
+    svc.close()
+
+
+def test_expired_deadline_refused_at_admission():
+    trip = _triplets()
+    svc = _service()
+    with pytest.raises(errors.DeadlineExceededError):
+        svc.submit(
+            TransformType.C2C, DIMS, trip, _values(trip), timeout_s=1e-9
+        )
+    svc.close()
+
+
+def test_deadline_shed_pre_dispatch():
+    """A request that expires while queued is shed BEFORE dispatch: its
+    ticket fails typed and no device time is burned on it."""
+    trip = _triplets()
+    values = _values(trip)
+    svc = _service()
+    ok = svc.submit(TransformType.C2C, DIMS, trip, values)
+    doomed = svc.submit(
+        TransformType.C2C, DIMS, trip, values, timeout_s=0.005, tenant="late"
+    )
+    time.sleep(0.02)
+    svc.pump()
+    assert ok.outcome == "completed"
+    assert doomed.outcome == "deadline_miss"
+    with pytest.raises(errors.DeadlineExceededError):
+        doomed.result(timeout=0)
+    counters = obs.snapshot()["counters"]
+    assert counters['serve_deadline_misses_total{tenant="late"}'] == 1
+    svc.close()
+
+
+# ---- retries, breaker ladder, verification -----------------------------------
+
+
+def test_transient_failure_retries_with_jitter_then_completes(monkeypatch):
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    from spfft_tpu.serve import service as service_mod
+
+    real_run_batch = service_mod.run_batch
+    calls = {"n": 0}
+
+    def flaky_run_batch(plans, requests):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise errors.HostExecutionError("transient dispatch failure")
+        return real_run_batch(plans, requests)
+
+    monkeypatch.setattr(service_mod, "run_batch", flaky_run_batch)
+    svc = _service(retries=2, backoff_s=0.001)
+    tk = svc.submit(TransformType.C2C, DIMS, trip, values)
+    svc.pump()
+    assert_close(tk.result(timeout=10), expect)
+    assert calls["n"] == 2
+    assert obs.snapshot()["counters"]["serve_retries_total"] == 1
+    svc.close()
+
+
+def test_retry_exhaustion_fails_typed():
+    trip = _triplets()
+    svc = _service(retries=1, backoff_s=0.001)
+    with faults.inject("serve.dispatch=raise"):
+        tk = svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+        svc.pump()
+    assert tk.outcome == "failed"
+    with pytest.raises(errors.HostExecutionError):
+        tk.result(timeout=0)
+    assert obs.snapshot()["counters"]["serve_retries_total"] == 1
+    svc.close()
+
+
+def test_breaker_open_flips_service_to_demote():
+    """A tripped verify breaker on the batch's engine reroutes requests
+    through the jnp.fft reference rung — results stay correct, the demotion
+    is counted, and the service never queues into the dead engine."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = _service(on_breaker="demote")
+    warm = svc.submit(TransformType.C2C, DIMS, trip, values)
+    svc.pump()
+    assert_close(warm.result(timeout=10), expect)
+    engine = svc.plans.describe()[0]["engine"]
+    for _ in range(verify.breaker.threshold()):
+        verify.breaker.record_failure(engine)
+    assert verify.breaker.describe(engine)["state"] == "open"
+    tk = svc.submit(TransformType.C2C, DIMS, trip, values)
+    svc.pump()
+    assert_close(tk.result(timeout=10), expect)
+    counters = obs.snapshot()["counters"]
+    assert counters[f'serve_demotions_total{{engine="{engine}"}}'] == 1
+    svc.close()
+
+
+def test_breaker_open_shed_mode_fails_typed():
+    trip = _triplets()
+    svc = _service(on_breaker="shed")
+    warm = svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+    svc.pump()
+    warm.result(timeout=10)
+    engine = svc.plans.describe()[0]["engine"]
+    for _ in range(verify.breaker.threshold()):
+        verify.breaker.record_failure(engine)
+    tk = svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+    svc.pump()
+    assert tk.outcome == "shed"
+    with pytest.raises(errors.ServiceOverloadError):
+        tk.result(timeout=0)
+    counters = obs.snapshot()["counters"]
+    assert counters['serve_sheds_total{reason="breaker_open"}'] == 1
+    svc.close()
+
+
+def test_breaker_heals_through_serve_traffic(monkeypatch):
+    """An unverified service's own successful dispatch settles a half-open
+    probe: after the cooldown the dispatcher carries the probe through
+    allow(), a healthy batch closes the breaker, and traffic returns to the
+    primary engine — a tripped breaker never demotes forever."""
+    monkeypatch.setenv(verify.breaker.BREAKER_COOLDOWN_ENV, "0")
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = _service(on_breaker="demote")
+    warm = svc.submit(TransformType.C2C, DIMS, trip, values)
+    svc.pump()
+    assert_close(warm.result(timeout=10), expect)
+    engine = svc.plans.describe()[0]["engine"]
+    for _ in range(verify.breaker.threshold()):
+        verify.breaker.record_failure(engine)
+    assert verify.breaker.describe(engine)["state"] == "open"
+    # cooldown 0: the next batch carries the half-open probe on the primary
+    tk = svc.submit(TransformType.C2C, DIMS, trip, values)
+    svc.pump()
+    assert_close(tk.result(timeout=10), expect)
+    assert verify.breaker.describe(engine)["state"] == "closed"
+    counters = obs.snapshot()["counters"]
+    assert "serve_demotions_total" not in str(counters) or not any(
+        k.startswith("serve_demotions_total") for k in counters
+    )
+    svc.close()
+
+
+def test_out_of_range_indices_rejected_typed():
+    """A typo'd out-of-range triplet must raise typed InvalidIndicesError
+    at submit — never silently alias onto the wrong frequency through the
+    wrap-to-storage canonicalization."""
+    trip = np.asarray(_triplets(), dtype=np.int64).reshape(-1, 3).copy()
+    trip[0] = [DIM, 0, 0]  # == dim_x: out of both conventions' bounds
+    svc = _service()
+    with pytest.raises(errors.InvalidIndicesError):
+        svc.submit(TransformType.C2C, DIMS, trip, np.zeros(len(trip)))
+    svc.close()
+
+
+def test_verified_service_recovers_under_corruption():
+    """verify="on" service + every dispatch corrupted: requests still
+    complete (recovered via the supervisor's reference rung) — the
+    'every accepted request completes verified or fails typed' half of the
+    acceptance invariant, exercised through the serving path."""
+    import warnings
+
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = _service(verify="on")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with faults.inject("engine.execute=corrupt:1.0"):
+            tk = svc.submit(TransformType.C2C, DIMS, trip, values)
+            svc.pump()
+            result = tk.result(timeout=30)
+    assert_close(result, expect)
+    counters = obs.snapshot()["counters"]
+    assert _counter_sum(counters, "verify_recoveries_total") >= 1
+    svc.close()
+
+
+# ---- the overload chaos invariant --------------------------------------------
+
+
+@pytest.mark.parametrize("site_name", ["serve.admit", "serve.batch", "serve.dispatch"])
+def test_chaos_invariant_serve_sites_at_overload(site_name):
+    """Arm each serve.* site at rate 1.0 and offer 4x the queue capacity:
+    the queue stays bounded, every refusal is typed, every ACCEPTED ticket
+    resolves (typed failure here — the site kills its stage every time), and
+    the pump terminates (no deadlock)."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = _service(queue_capacity=4, batch_max=2, retries=1, backoff_s=0.001,
+                   tenant_quota=1.0)
+    accepted, rejected = [], 0
+    with faults.inject(f"{site_name}=raise"):
+        for i in range(16):  # 4x capacity
+            try:
+                accepted.append(
+                    svc.submit(
+                        TransformType.C2C, DIMS, trip, values,
+                        tenant=f"t{i % 3}",
+                    )
+                )
+            except errors.GenericError as e:
+                assert isinstance(e, errors.ServiceOverloadError), type(e)
+                rejected += 1
+        assert svc.queue.high_water <= 4  # bounded under overload
+        svc.pump()
+    typed = 0
+    for tk in accepted:
+        assert tk.done(), "accepted ticket left unresolved (deadlock arm)"
+        try:
+            # completed is legal only with a parity-correct result (e.g.
+            # the breaker tripping mid-sweep demotes to the reference rung)
+            assert_close(tk.result(timeout=0), expect)
+        except errors.GenericError:
+            typed += 1
+    if site_name == "serve.admit":
+        assert rejected == 16 and not accepted
+    else:
+        assert rejected >= 12  # the queue bound refused the overload excess
+        assert typed > 0  # the armed site really fired
+    svc.close()
+
+
+@pytest.mark.slow
+def test_chaos_all_serve_sites_fractional_under_threaded_overload():
+    """Every serve.* site armed at a fractional rate, threaded dispatcher,
+    offered load far beyond capacity: no deadlock, bounded queue, every
+    accepted ticket resolves completed-or-typed within the budget."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    faults.reseed(7)
+    svc = serve.TransformService(
+        queue_capacity=8, batch_max=4, retries=1, backoff_s=0.001,
+    )
+    accepted, rejected = [], 0
+    with faults.inject(
+        "serve.admit=raise:0.2,serve.batch=raise:0.2,serve.dispatch=raise:0.2"
+    ):
+        for i in range(64):
+            try:
+                accepted.append(
+                    svc.submit(
+                        TransformType.C2C, DIMS, trip, values,
+                        tenant=f"t{i % 4}",
+                    )
+                )
+            except errors.GenericError:
+                rejected += 1
+        deadline = time.time() + 60
+        completed = failed = 0
+        for tk in accepted:
+            try:
+                out = tk.result(timeout=max(0.1, deadline - time.time()))
+                assert_close(out, expect)
+                completed += 1
+            except errors.GenericError:
+                failed += 1
+    assert completed + failed == len(accepted)  # every ticket resolved
+    assert svc.queue.high_water <= 8
+    assert completed > 0  # the service made progress through the chaos
+    svc.close()
+
+
+# ---- lifecycle and exposure --------------------------------------------------
+
+
+def test_close_fails_pending_tickets_typed():
+    trip = _triplets()
+    svc = _service()
+    tickets = [
+        svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+        for _ in range(3)
+    ]
+    svc.close(drain=False)
+    for tk in tickets:
+        assert tk.outcome == "shed"
+        with pytest.raises(errors.ServiceOverloadError):
+            tk.result(timeout=0)
+    with pytest.raises(errors.ServiceOverloadError):
+        svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+
+
+def test_drain_close_completes_queued_work_threaded():
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = serve.TransformService(queue_capacity=16, batch_max=4)
+    tickets = [
+        svc.submit(TransformType.C2C, DIMS, trip, values) for _ in range(6)
+    ]
+    svc.close(drain=True)
+    for tk in tickets:
+        assert_close(tk.result(timeout=10), expect)
+
+
+def test_describe_joins_plan_cards_and_breakers():
+    trip = _triplets()
+    svc = _service()
+    tk = svc.submit(TransformType.C2C, DIMS, trip, _values(trip))
+    svc.pump()
+    tk.result(timeout=10)
+    desc = svc.describe()
+    assert desc["config"]["queue_capacity"] == 16
+    assert len(desc["plan_cache"]) == 1
+    row = desc["plan_cache"][0]
+    assert row["run_id"] and row["plans"] >= 1
+    assert row["engine"] in desc["breakers"]
+    assert desc["breakers"][row["engine"]]["state"] == "closed"
+    assert desc["stats"]["counts"]["completed"] == 1
+    svc.close()
+
+
+def test_submit_rejects_malformed_requests_typed():
+    trip = _triplets()
+    svc = _service()
+    with pytest.raises(errors.InvalidParameterError):
+        svc.submit(TransformType.C2C, DIMS, trip, _values(trip)[:-1])
+    with pytest.raises(errors.InvalidParameterError):
+        svc.submit(TransformType.C2C, DIMS, trip, _values(trip), direction="sideways")
+    with pytest.raises(errors.InvalidParameterError):
+        svc.submit(
+            TransformType.C2C, DIMS, trip, np.zeros(7), direction="forward"
+        )
+    svc.close()
+
+
+def test_serve_latency_histogram_and_trace_events():
+    trip = _triplets()
+    obs.trace.enable()
+    try:
+        svc = _service()
+        tk = svc.submit(TransformType.C2C, DIMS, trip, _values(trip), tenant="t")
+        svc.pump()
+        tk.result(timeout=10)
+        snap = obs.snapshot()
+        hist = snap["histograms"]['serve_latency_seconds{tenant="t"}']
+        assert hist["count"] == 1 and hist["sum"] > 0
+        events = [
+            e for e in obs.trace.snapshot()["events"] if e["name"] == "serve"
+        ]
+        whats = {e["args"]["what"] for e in events}
+        assert {"admit", "coalesce", "dispatch", "complete"} <= whats
+        svc.close()
+    finally:
+        obs.trace.disable()
+        obs.trace.clear()
+
+
+@pytest.mark.slow
+def test_concurrent_submitters_threaded_service():
+    """Multiple submitter threads + the dispatcher thread: results all
+    correct, no lost tickets (the lock discipline of queue + cache)."""
+    trip = _triplets()
+    values = _values(trip)
+    expect = _expect_backward(trip, values)
+    svc = serve.TransformService(queue_capacity=32, batch_max=4)
+    results = [None] * 4
+
+    def submitter(slot):
+        tks = [
+            svc.submit(TransformType.C2C, DIMS, trip, values, tenant=f"s{slot}")
+            for _ in range(4)
+        ]
+        results[slot] = [tk.result(timeout=30) for tk in tks]
+
+    threads = [
+        threading.Thread(target=submitter, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    svc.close()
+    for outs in results:
+        assert outs is not None
+        for out in outs:
+            assert_close(out, expect)

@@ -324,3 +324,44 @@ def test_wisdom_state_stamp(tmp_path, monkeypatch):
     assert state["hit"] is False
     untuned = tuning.wisdom_state(_distributed(policy="default"))
     assert untuned["provenance"] == "model" and untuned["hit"] is None
+
+
+def test_trial_deadline_turns_hung_candidate_into_error_row(monkeypatch):
+    """SPFFT_TPU_FENCE_BUDGET_S extends to whole tuning trials: a candidate
+    that hangs (build or dispatch) fails typed TrialTimeout inside
+    TRIAL_ERRORS and becomes an error row — tuned planning degrades to the
+    model instead of stalling forever."""
+    import time as _time
+
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+    from spfft_tpu.tuning import runner
+
+    monkeypatch.setenv(FENCE_BUDGET_ENV, "0.05")
+    monkeypatch.setenv(tuning.TUNE_WARMUP_ENV, "0")
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    assert runner.trial_deadline_s() == pytest.approx(0.05 * 2)
+
+    def build(cand):
+        if cand["label"] == "hung":
+            _time.sleep(5.0)  # a wedged compile/dispatch
+        raise ValueError("fast candidate fails honestly")
+
+    t0 = _time.perf_counter()
+    rows = runner.run_trials(
+        build, [{"label": "hung"}, {"label": "fast"}]
+    )
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 2.0, "deadline did not bound the hung trial"
+    by_label = {r["label"]: r for r in rows}
+    assert "TrialTimeout" in by_label["hung"]["error"]
+    assert "ValueError" in by_label["fast"]["error"]
+
+
+def test_trial_deadline_unset_means_no_deadline(monkeypatch):
+    from spfft_tpu.sync import FENCE_BUDGET_ENV
+    from spfft_tpu.tuning import runner
+
+    monkeypatch.delenv(FENCE_BUDGET_ENV, raising=False)
+    assert runner.trial_deadline_s() == 0.0
+    # and _run_deadlined with budget 0 runs inline
+    assert runner._run_deadlined(lambda: 42, 0.0, "x") == 42

@@ -39,6 +39,7 @@ VERIFY_ENV_KNOBS = (
     verify.VERIFY_SEED_ENV,
     verify.VERIFY_RETRIES_ENV,
     verify.VERIFY_BACKOFF_ENV,
+    verify.VERIFY_JITTER_SEED_ENV,
     verify.breaker.BREAKER_K_ENV,
     verify.breaker.BREAKER_COOLDOWN_ENV,
 )
@@ -475,6 +476,121 @@ def test_breaker_half_open_failure_reopens(monkeypatch):
         assert_close(t.backward(values), expect)  # half-open probe fails
     state = verify.breaker.describe(t._engine)
     assert state["state"] == "open" and state["trips"] == 2
+
+
+def test_breaker_half_open_admits_exactly_one_probe(monkeypatch):
+    """Concurrent callers racing an elapsed cooldown: exactly one wins the
+    half-open probe slot, the losers fail fast (allow() False, straight to
+    the reference rung), and the state gauge stays consistent through the
+    race and the probe's verdict."""
+    import threading
+
+    monkeypatch.setenv(verify.breaker.BREAKER_COOLDOWN_ENV, "0")
+    engine = "race-engine"
+    for _ in range(verify.breaker.threshold()):
+        verify.breaker.record_failure(engine)
+    assert verify.breaker.describe(engine)["state"] == "open"
+
+    barrier = threading.Barrier(8)
+    verdicts = [None] * 8
+
+    def contender(slot):
+        barrier.wait()
+        verdicts[slot] = verify.breaker.allow(engine)
+
+    threads = [threading.Thread(target=contender, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert sum(1 for v in verdicts if v) == 1, verdicts
+    state = verify.breaker.describe(engine)
+    assert state["state"] == "half_open"
+    gauges = obs.snapshot()["gauges"]
+    assert gauges[f'verify_breaker_state{{engine="{engine}"}}'] == 2
+    # while the probe is in flight, further callers keep losing
+    assert verify.breaker.allow(engine) is False
+    # a failed probe reopens and a later cooldown admits exactly one again
+    verify.breaker.record_failure(engine)
+    assert verify.breaker.describe(engine)["state"] == "open"
+    assert verify.breaker.allow(engine) is True  # cooldown 0, new probe
+    # a healed probe closes and lifts the single-probe gate
+    verify.breaker.record_success(engine)
+    state = verify.breaker.describe(engine)
+    assert state["state"] == "closed" and state["consecutive_failures"] == 0
+    assert verify.breaker.allow(engine) and verify.breaker.allow(engine)
+    gauges = obs.snapshot()["gauges"]
+    assert gauges[f'verify_breaker_state{{engine="{engine}"}}'] == 0
+
+
+def test_breaker_lost_probe_slot_self_heals(monkeypatch):
+    """A probe whose carrier dies without reporting a verdict must not wedge
+    the breaker in half-open forever: after the takeover interval another
+    caller may claim the slot."""
+    monkeypatch.setenv(verify.breaker.BREAKER_COOLDOWN_ENV, "0")
+    engine = "leaky-engine"
+    for _ in range(verify.breaker.threshold()):
+        verify.breaker.record_failure(engine)
+    assert verify.breaker.allow(engine) is True  # probe granted...
+    assert verify.breaker.allow(engine) is False  # ...slot held
+    # the carrier dies silently; past the takeover interval the slot frees
+    from spfft_tpu.verify import breaker as breaker_mod
+
+    real_monotonic = breaker_mod.time.monotonic
+    monkeypatch.setattr(
+        breaker_mod.time, "monotonic", lambda: real_monotonic() + 2.0
+    )
+    assert verify.breaker.allow(engine) is True
+    verify.breaker.record_success(engine)
+    assert verify.breaker.describe(engine)["state"] == "closed"
+
+
+def test_retry_backoff_jitter_differs_across_seeds(monkeypatch):
+    """The supervisor's retry backoff is jittered (faults.backoff_s):
+    recorded sleep sequences differ across SPFFT_TPU_VERIFY_JITTER_SEED
+    values and replay exactly for one seed — concurrent retriers of one
+    failed engine must not herd on a synchronized schedule."""
+    from spfft_tpu.verify import supervisor as sup_mod
+
+    def sleeps_for(seed):
+        monkeypatch.setenv(verify.VERIFY_JITTER_SEED_ENV, str(seed))
+        monkeypatch.setenv(verify.VERIFY_RETRIES_ENV, "2")
+        recorded = []
+        monkeypatch.setattr(sup_mod.time, "sleep", recorded.append)
+        trip = _triplets()
+        values = _values(trip)
+        t = _local(trip, verify="on")
+        with faults.inject("engine.execute=corrupt:1.0"):
+            t.backward(values)  # retries exhaust, reference rung recovers
+        return recorded
+
+    seq_a = sleeps_for(11)
+    seq_b = sleeps_for(23)
+    seq_a2 = sleeps_for(11)
+    assert len(seq_a) == 2 and len(seq_b) == 2
+    assert seq_a != seq_b, "jitter must decorrelate retry schedules"
+    assert seq_a == seq_a2, "one seed must replay its sleep sequence exactly"
+    base = verify.resolve_backoff_s()
+    for i, s in enumerate(seq_a, start=1):
+        lo, hi = 0.5 * base * 2 ** (i - 1), 1.5 * base * 2 ** (i - 1)
+        assert lo <= s < hi, (i, s, lo, hi)
+
+
+def test_backoff_s_jitter_bounds_and_determinism():
+    import random
+
+    from spfft_tpu import faults as f
+
+    assert f.backoff_s(0.01, 1) == pytest.approx(0.01)
+    assert f.backoff_s(0.01, 3) == pytest.approx(0.04)
+    seq = [f.backoff_s(0.01, i, random.Random(5)) for i in range(1, 4)]
+    seq2 = [f.backoff_s(0.01, i, random.Random(5)) for i in range(1, 4)]
+    assert seq == seq2  # same seed, same schedule
+    rng = random.Random(5)
+    chained = [f.backoff_s(0.01, i, rng) for i in range(1, 4)]
+    assert len(set(chained)) == 3  # one stream, distinct draws
+    for i, s in enumerate(chained, start=1):
+        assert 0.5 * 0.01 * 2 ** (i - 1) <= s < 1.5 * 0.01 * 2 ** (i - 1)
 
 
 # ---- exposure: cards, trace, CLI surfaces ------------------------------------
