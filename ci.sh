@@ -16,6 +16,7 @@
 #          ./ci.sh chaos      # fault sites armed one-at-a-time + guard fuzz
 #          ./ci.sh verify     # ABFT checks, corrupt-injection recovery, breaker
 #          ./ci.sh serve      # serving layer: loadgen smoke + overload chaos
+#          ./ci.sh sched      # task-graph scheduler: gbench + gate + chaos
 #          ./ci.sh perf       # dbench scaling rows + schema + regression gate
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
@@ -301,6 +302,82 @@ EOF
   rm -rf "$sdir"
 }
 
+run_sched() {
+  echo "== Sched (spfft_tpu.sched: graph executor, placement, gbench gate, CPU) =="
+  # The suite carries graph semantics, tuned-placement reproducibility, and
+  # the arm-every-sched-site chaos sweep (typed-or-parity, no graph stall).
+  timeout 540 python -m pytest tests/test_sched.py -q
+  local gdir
+  gdir="$(mktemp -d)"
+  # Scheduled-vs-serial on the 8-device CPU mesh: the same mixed-geometry
+  # workload one-at-a-time and through the graph executor. The sched row
+  # must be strictly above the serial row (the overlap is real, not a
+  # measurement artifact), rows are gate-compatible, and placement
+  # provenance must ride in the plan cards.
+  JAX_PLATFORMS=cpu timeout 540 python programs/gbench.py --devices 8 \
+    --dims 12 16 --sparsity 0.8 --tasks 16 --chain 1 --repeats 4 \
+    -o "$gdir/gbench.json" > /dev/null
+  JAX_PLATFORMS=cpu python - "$gdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/gbench.json"))
+assert doc["schema"] == "spfft_tpu.sched.gbench/1", doc["schema"]
+rows = {r["key"].rsplit(":", 1)[-1]: r for r in doc["rows"]}
+for row in doc["rows"]:
+    for k in ("key", "gflops", "seconds_noise", "transforms_per_sec",
+              "p50_ms", "p99_ms", "overlap_vs_serial"):
+        assert k in row, (k, row)
+assert rows["sched"]["transforms_per_sec"] > rows["serial"]["transforms_per_sec"], (
+    "scheduled graph throughput not above one-at-a-time", rows)
+for card in doc["plan_cards"]:
+    p = card["placement"]
+    assert p and p["provenance"] in ("wisdom", "model", "pinned"), card
+    assert "hit" in p and "device" in p, card
+assert any(k.startswith("sched_tasks_total") for k in doc["metrics"]), doc["metrics"]
+print(f"gbench ok: serial {rows['serial']['transforms_per_sec']:.0f} -> "
+      f"sched {rows['sched']['transforms_per_sec']:.0f} transforms/s "
+      f"(x{rows['sched']['overlap_vs_serial']:.2f}, placement "
+      f"{doc['plan_cards'][0]['placement']['provenance']})")
+EOF
+  # Regression gate over the committed gbench baseline (wide tolerance: an
+  # algorithmic slide in the executor, not scheduler jitter) ...
+  python programs/perf_gate.py "$gdir/gbench.json" \
+    bench_results/gbench_baseline_cpu8.json --tolerance 0.85 \
+    --require-matches 2 > /dev/null
+  # ... green against itself ...
+  python programs/perf_gate.py "$gdir/gbench.json" "$gdir/gbench.json" \
+    --require-matches 2 > /dev/null
+  # ... and must trip (exit 3) on a doctored baseline claiming 10x.
+  python - "$gdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/gbench.json"))
+for r in doc["rows"]:
+    r["gflops"] *= 10
+    r["seconds_noise"] = 0.0
+json.dump(doc, open(f"{d}/doctored.json", "w"))
+EOF
+  local rc=0
+  python programs/perf_gate.py "$gdir/gbench.json" "$gdir/doctored.json" \
+    > /dev/null || rc=$?
+  if [ "$rc" -ne 3 ]; then
+    echo "sched gate did not trip on a doctored baseline (rc=$rc)" >&2
+    exit 1
+  fi
+  # Chaos over the scheduler's own sites at fractional rates: the workload
+  # must still finish with every task completed-or-demoted (gbench asserts
+  # it) — the no-graph-stall half of the chaos invariant, end to end.
+  JAX_PLATFORMS=cpu \
+    SPFFT_TPU_FAULTS="sched.place=raise:0.3,sched.run=raise:0.2" \
+    timeout 540 python programs/gbench.py --devices 8 --dims 12 \
+    --sparsity 0.8 --tasks 6 --chain 1 --repeats 1 \
+    -o "$gdir/gbench_chaos.json" > /dev/null
+  echo "sched gate ok (baseline green, doctored trips, chaos run clean)"
+  rm -rf "$gdir"
+}
+
 run_perf() {
   echo "== Perf (spfft_tpu.obs.perf: dbench rows + schema + regression gate, CPU) =="
   # 8-virtual-device distributed bench: slab AND pencil meshes must emit
@@ -404,6 +481,7 @@ case "$stage" in
   chaos) run_chaos ;;
   verify) run_verify ;;
   serve) run_serve ;;
+  sched) run_sched ;;
   perf) run_perf ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
@@ -416,13 +494,14 @@ case "$stage" in
     run_chaos
     run_verify
     run_serve
+    run_sched
     run_perf
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | perf | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | sched | perf | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

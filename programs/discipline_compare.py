@@ -139,6 +139,13 @@ def run_matrix(args):
                             f"{row['gflops']:8.2f} GFLOP/s "
                             f"exch {row['exchange_fraction'] * 100:5.1f}%"
                         )
+                    if args.matrix_batch > 0:
+                        rows.extend(
+                            measure_batch_rows(
+                                dim, ttype, dt, trip, args,
+                                args.matrix_batch,
+                            )
+                        )
     doc = {
         "schema": perf.SCALING_SCHEMA,
         "config": vars(args),
@@ -153,6 +160,92 @@ def run_matrix(args):
         print(f"matrix doc INCOMPLETE, missing: {missing}", file=sys.stderr)
         return 1
     return 0
+
+
+def measure_batch_rows(dim, ttype, dt, trip, args, B) -> list:
+    """Two extra gate rows per scenario: a batch of ``B`` independent local
+    transforms of this geometry executing full backward+forward pairs (a)
+    one-at-a-time (``batchB:serial``) and (b) through the task-graph
+    scheduler (``batchB:sched`` — :mod:`spfft_tpu.sched`: windowed
+    dispatch, completion-order finalize). Effective seconds-per-pair =
+    batch wall / B, reported as an ordinary perf row, so the scheduler's
+    batched-multi-transform win (or a regression in it) is a per-scenario
+    gate cell like every other matrix cell."""
+    import time
+
+    import numpy as np
+    from spfft_tpu import ProcessingUnit, ScalingType, Transform, TransformType
+    from spfft_tpu import sched
+    from spfft_tpu.obs import perf
+
+    import dbench
+
+    dtype = np.float64 if dt == "f64" else np.float32
+    pu = ProcessingUnit.GPU if args.engine == "mxu" else ProcessingUnit.HOST
+    plans = [
+        Transform(
+            pu,
+            TransformType.R2C if ttype == "r2c" else TransformType.C2C,
+            dim, dim, dim, indices=np.asarray(trip).copy(), dtype=dtype,
+            engine=args.engine,
+        )
+        for _ in range(B)
+    ]
+    rng = np.random.default_rng(0)
+    if ttype == "r2c":
+        # hermitian-consistent inputs: derive per-plan spectra from real fields
+        values = [
+            p.forward(rng.standard_normal((dim, dim, dim))) for p in plans
+        ]
+    else:
+        values = [
+            rng.standard_normal(p.num_local_elements)
+            + 1j * rng.standard_normal(p.num_local_elements)
+            for p in plans
+        ]
+
+    def serial_pairs():
+        t0 = time.perf_counter()
+        for p, v in zip(plans, values):
+            p.backward(v)
+            p.forward(None, ScalingType.FULL)
+        return time.perf_counter() - t0
+
+    def sched_pairs():
+        graph = sched.TaskGraph()
+        for p, v in zip(plans, values):
+            graph.add("backward", payload=v, transform=p)
+            graph.add("forward", scaling=ScalingType.FULL, transform=p)
+        t0 = time.perf_counter()
+        report = sched.run_graph(graph, max_inflight=2 * B)
+        wall = time.perf_counter() - t0
+        bad = {
+            t: o for t, o in report.outcomes.items() if o != "completed"
+        }
+        assert not bad, f"scheduled batch cell degraded: {bad}"
+        return wall
+
+    rows = []
+    repeats = max(2, min(3, args.repeats))
+    for mode, run in (("serial", serial_pairs), ("sched", sched_pairs)):
+        run()  # warmup (compilation, scheduler pool)
+        walls = sorted(run() for _ in range(repeats))
+        best = walls[0]
+        median = (walls[(len(walls) - 1) // 2] + walls[len(walls) // 2]) / 2.0
+        row = perf.perf_report(plans[0], best / B, repeats=repeats)
+        row["scaling"] = "matrix"
+        row["seconds_noise"] = (median - best) / best if best else 0.0
+        row["batch"] = int(B)
+        row["batch_mode"] = mode
+        row["key"] = f"{dbench.row_key(row, 'matrix')}:batch{B}:{mode}"
+        rows.append(row)
+        print(
+            f"{dim:4d}^3 nnz={row['nnz_fraction']:.3f} {ttype} {dt} "
+            f"BATCH{B}/{mode:6s} "
+            f"{row['seconds_per_pair'] * 1e3:9.3f} ms/pair "
+            f"{row['gflops']:8.2f} GFLOP/s"
+        )
+    return rows
 
 
 def main(argv=None):
@@ -177,6 +270,11 @@ def main(argv=None):
                     choices=["c2c", "r2c"])
     ap.add_argument("--matrix-dtypes", nargs="+", default=["f32", "f64"],
                     choices=["f32", "f64"])
+    ap.add_argument("--matrix-batch", type=int, default=4,
+                    help="batched multi-transform rows per scenario: a "
+                    "batch of this many local plans measured one-at-a-time "
+                    "vs through the task-graph scheduler (serial vs sched "
+                    "cells; 0 disables)")
     ap.add_argument("--matrix-overlap", nargs="+", default=["1", "tuned"],
                     help="overlap axis of the matrix: integer OVERLAPPED "
                     "chunk counts for the padded discipline, plus the "

@@ -1,0 +1,316 @@
+"""Graph-scheduling benchmark: scheduled vs one-at-a-time transform graphs.
+
+The measurement surface of :mod:`spfft_tpu.sched` (ROADMAP item 4): builds a
+**mixed-geometry graph workload** — several distinct sparse-FFT geometries,
+multiple independent executions each, plus one backward->forward dependency
+chain per geometry — and runs it on the multichip mesh (virtual CPU devices
+stand in off-pod) two ways:
+
+- ``serial`` — one-at-a-time submission: every task pays its own dispatch,
+  completion fence and host fetch before the next starts (the baseline the
+  acceptance bar compares against);
+- ``sched`` — the task-graph executor: windowed dispatch keeps transforms in
+  flight, host staging of one task hides behind device execution of others,
+  finalize runs in completion order, and placement spreads plans across the
+  mesh (model round-robin by default; ``--policy tuned`` resolves the width
+  through wisdom trials).
+
+Both modes execute the *same* task list through the *same* plan objects'
+code paths, so the ratio is the scheduler's contribution alone. Output rows
+are **gate-compatible** with ``programs/perf_gate.py`` (``key`` /
+``gflops`` / ``seconds_noise``, like dbench and loadgen rows) plus the
+scheduling scoreboard: completed transforms/sec, p50/p99 per-task
+completion latency within the cycle, and ``overlap_vs_serial`` (scheduled
+throughput over serial throughput — the headline; >1 means the graph
+overlap is real). ``GBENCH_r09.json`` is the first committed capture;
+``./ci.sh sched`` runs a smoke configuration and gates it against
+``bench_results/gbench_baseline_cpu8.json``.
+
+On a CPU mesh the wall-clock is indicative (devices share host cores); the
+serial-vs-scheduled *ratio* is the robust figure — both modes see the same
+host.
+
+Usage:
+    python programs/gbench.py --devices 8 -o GBENCH.json
+    python programs/gbench.py --devices 8 --dims 12 16 --tasks 6 --policy tuned
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+GBENCH_SCHEMA = "spfft_tpu.sched.gbench/1"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--devices", type=int, default=8,
+                   help="mesh width (virtual CPU devices stand in off-pod)")
+    p.add_argument("--dims", type=int, nargs="+", default=[12, 16, 20],
+                   help="grid edges of the mixed geometries")
+    p.add_argument("--sparsity", type=float, nargs="+", default=[0.5, 0.9],
+                   help="nnz sphere radii paired round-robin with --dims")
+    p.add_argument("--tasks", type=int, default=8,
+                   help="independent backward tasks per geometry")
+    p.add_argument("--chain", type=int, default=1,
+                   help="backward->forward dependency chains per geometry "
+                   "(exercises graph edges; 0 = flat batch)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed repeats per mode (best-of, spread recorded)")
+    p.add_argument("--inflight", type=int, default=16,
+                   help="scheduler window (~2 per device keeps queues fed; "
+                   "None defers to SPFFT_TPU_SCHED_INFLIGHT)")
+    p.add_argument("--policy", choices=["default", "tuned"], default="default",
+                   help="placement policy: model round-robin or wisdom-"
+                   "tuned width (tuned allows CPU trials implicitly here)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-o", "--output", default=None, help="write JSON here")
+    return p
+
+
+def build_workload(args):
+    """The task list: per geometry, ``--tasks`` independent backwards plus
+    ``--chain`` backward->forward chains. Returns (geometries, tasks) where
+    each task is a JSON-plain dict the two run modes share."""
+    import numpy as np
+    import spfft_tpu as sp
+
+    rng = np.random.default_rng(args.seed)
+    geometries = []
+    for i, dim in enumerate(args.dims):
+        sparsity = args.sparsity[i % len(args.sparsity)]
+        trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, sparsity)
+        vals = (
+            rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+        )
+        geometries.append({
+            "dim": dim, "sparsity": sparsity, "triplets": trip,
+            "values": vals,
+            "spec": {
+                "transform_type": "C2C",
+                "dims": (dim, dim, dim),
+                "indices": trip,
+            },
+        })
+    tasks = []
+    for gi, g in enumerate(geometries):
+        for t in range(args.tasks):
+            tasks.append({"geom": gi, "direction": "backward", "chain": None,
+                          "id": f"g{gi}b{t}"})
+        for c in range(args.chain):
+            bid = f"g{gi}cb{c}"
+            tasks.append({"geom": gi, "direction": "backward", "chain": None,
+                          "id": bid})
+            tasks.append({"geom": gi, "direction": "forward", "chain": bid,
+                          "id": f"g{gi}cf{c}"})
+    return geometries, tasks
+
+
+def run_serial(geometries, tasks, plans) -> dict:
+    """One-at-a-time submission: each task is a full host-facing
+    ``backward``/``forward`` call (dispatch + fence + fetch) before the next
+    starts. ``plans[geom]`` is the single per-geometry plan, all on one
+    device — exactly how a caller without the scheduler would submit."""
+    from spfft_tpu.types import ScalingType
+
+    t0 = time.perf_counter()
+    latencies = []
+    chained = {}
+    for task in tasks:
+        g = geometries[task["geom"]]
+        plan = plans[task["geom"]]
+        s0 = time.perf_counter()
+        if task["direction"] == "backward":
+            out = plan.backward(g["values"])
+            chained[task["id"]] = out
+        else:
+            plan.forward(chained[task["chain"]], ScalingType.FULL)
+        latencies.append(time.perf_counter() - s0)
+    return {"wall": time.perf_counter() - t0, "latencies": latencies}
+
+
+def run_sched(geometries, tasks, devices, pool, args) -> tuple:
+    """The same task list as one :class:`~spfft_tpu.sched.TaskGraph`."""
+    from spfft_tpu import sched
+    from spfft_tpu.types import ScalingType
+
+    graph = sched.TaskGraph()
+    for task in tasks:
+        g = geometries[task["geom"]]
+        if task["direction"] == "backward":
+            graph.add("backward", id=task["id"], payload=g["values"],
+                      spec=g["spec"])
+        else:
+            graph.add("forward", id=task["id"], scaling=ScalingType.FULL,
+                      spec=g["spec"], input_from=task["chain"])
+    # monotonic, NOT perf_counter: per-task latencies subtract this origin
+    # from Task.finished_at, which the executor stamps with time.monotonic()
+    t0 = time.monotonic()
+    report = sched.run_graph(
+        graph, devices=devices, pool=pool,
+        policy=args.policy if args.policy == "tuned" else None,
+        max_inflight=args.inflight,
+    )
+    wall = time.monotonic() - t0
+    bad = {
+        tid: out for tid, out in report.outcomes.items()
+        if out not in ("completed", "demoted")
+    }
+    if bad:
+        raise AssertionError(f"scheduled tasks did not complete: {bad}")
+    latencies = [
+        graph.task(t["id"]).finished_at - t0 for t in tasks
+    ]
+    return {"wall": wall, "latencies": latencies}, report, graph
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def make_row(key, measures, tasks, flops_total, depth) -> dict:
+    """Gate-compatible row from best-of-repeat measures of one mode.
+    Latencies come from the best-WALL repeat, so p50/p99 and the
+    throughput figure describe the same run."""
+    walls = sorted(m["wall"] for m in measures)
+    best = walls[0]
+    median = (walls[(len(walls) - 1) // 2] + walls[len(walls) // 2]) / 2.0
+    lat = sorted(min(measures, key=lambda m: m["wall"])["latencies"])
+    return {
+        "key": key,
+        "tasks": len(lat),
+        "graph_depth": depth,
+        "wall_seconds": round(best, 6),
+        "transforms_per_sec": round(len(lat) / best, 3) if best else 0.0,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+        "gflops": round(flops_total / best / 1e9, 6) if best else 0.0,
+        "seconds_noise": round((median - best) / best, 4) if best else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import os
+
+    if args.policy == "tuned":
+        # tuned placement measures on this same mesh; CPU trials cannot
+        # poison accelerator wisdom (platform is in the key) — same rule as
+        # discipline_compare's tuned cells
+        os.environ.setdefault("SPFFT_TPU_TUNE_CPU", "1")
+    from spfft_tpu.parallel.mesh import ensure_virtual_devices
+
+    devices = list(ensure_virtual_devices(max(1, args.devices), warn=True))
+    devices = devices[: max(1, args.devices)]
+
+    from spfft_tpu import obs, sched
+    from spfft_tpu.obs import perf
+    from spfft_tpu.sched.placement import build_plan
+
+    geometries, tasks = build_workload(args)
+    flops_total = sum(
+        perf.dense_pair_flops([geometries[t["geom"]]["dim"]] * 3) / 2.0
+        for t in tasks
+    )
+    # plan pools built OUTSIDE the measured window (both modes measure
+    # execution, not construction): serial gets one plan per geometry on
+    # device 0; sched resolves through the placement pass + pool
+    serial_plans = [build_plan(g["spec"], devices[0]) for g in geometries]
+    pool = sched.PlanPool()
+
+    # warmup: one untimed pass per mode absorbs compilation everywhere
+    run_serial(geometries, tasks, serial_plans)
+    _, report, last_graph = run_sched(geometries, tasks, devices, pool, args)
+
+    serial_measures = [
+        run_serial(geometries, tasks, serial_plans)
+        for _ in range(max(1, args.repeats))
+    ]
+    sched_measures = []
+    for _ in range(max(1, args.repeats)):
+        m, report, last_graph = run_sched(geometries, tasks, devices, pool, args)
+        sched_measures.append(m)
+
+    sig = "+".join(
+        f"{g['dim']}s{int(round(g['sparsity'] * 100))}" for g in geometries
+    )
+    base = f"gbench:{sig}:t{args.tasks}:c{args.chain}:P{len(devices)}"
+    depth = 2 if args.chain else 1
+    serial_row = make_row(
+        f"{base}:serial", serial_measures, tasks, flops_total, depth
+    )
+    sched_row = make_row(
+        f"{base}:sched", sched_measures, tasks, flops_total, depth
+    )
+    serial_row["overlap_vs_serial"] = 1.0
+    sched_row["overlap_vs_serial"] = round(
+        sched_row["transforms_per_sec"]
+        / max(serial_row["transforms_per_sec"], 1e-9),
+        4,
+    )
+    for row in (serial_row, sched_row):
+        print(
+            f"{row['key']}: {row['transforms_per_sec']:8.1f} transforms/s "
+            f"(p50 {row['p50_ms']:.2f} ms, p99 {row['p99_ms']:.2f} ms, "
+            f"±{row['seconds_noise'] * 100:.1f}%, "
+            f"x{row['overlap_vs_serial']:.2f} vs serial)"
+        )
+
+    doc = {
+        "schema": GBENCH_SCHEMA,
+        "run_unix": time.time(),
+        "platform": str(devices[0].platform),
+        "config": {
+            "devices": len(devices), "dims": list(args.dims),
+            "sparsity": list(args.sparsity), "tasks": args.tasks,
+            "chain": args.chain, "repeats": args.repeats,
+            "policy": args.policy, "inflight": args.inflight,
+            "seed": args.seed, "total_tasks": len(tasks),
+        },
+        "rows": [serial_row, sched_row],
+        # full provenance: the placement record of the measured graph run,
+        # plus one PLACED plan's card extract per geometry, taken from the
+        # measured graph itself (run-ID join + the card's schema-pinned
+        # placement section; pool.plan_for here could build a fresh,
+        # never-placed plan and report a null section)
+        "placement": report.placement,
+        "plan_cards": [
+            {
+                "run_id": c["run_id"],
+                "engine": c["engine"],
+                "dims": c["dims"],
+                "placement": c.get("placement"),
+            }
+            for c in (
+                last_graph.task(
+                    next(t["id"] for t in tasks if t["geom"] == gi)
+                ).plan.report()
+                for gi in range(len(geometries))
+                if any(t["geom"] == gi for t in tasks)
+            )
+        ],
+        "metrics": {
+            k: v for k, v in obs.snapshot()["counters"].items()
+            if k.startswith("sched_")
+        },
+    }
+
+    if args.output:
+        Path(args.output).write_text(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"wrote {args.output}")
+    else:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

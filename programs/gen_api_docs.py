@@ -266,6 +266,7 @@ def index_page() -> str:
         - [Fault injection, guard mode and degradation](faults.md)
         - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
         - [Serving: admission, coalesced batching, load shedding](serve.md)
+        - [Task-graph scheduling: placement, overlap, completion order](sched.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -408,6 +409,26 @@ def serve_page() -> str:
     )
 
 
+def sched_page() -> str:
+    """The scheduling page: the `spfft_tpu.sched` surface (task graphs,
+    the tuned placement pass, the completion-order executor)."""
+    from spfft_tpu import sched
+
+    return class_page(
+        "Task-graph scheduling (`spfft_tpu.sched`)",
+        doc(sched),
+        [sched.TaskGraph, sched.Task, sched.PlanPool, sched.GraphReport],
+        [
+            sched.run_graph,
+            sched.run_tasks,
+            sched.resolve_inflight,
+            sched.resolve_width,
+            sched.workload_key,
+            sched.build_plan,
+        ],
+    )
+
+
 def generate(outdir: Path) -> None:
     import spfft_tpu as sp
     from spfft_tpu import faults, timing, tuning
@@ -479,8 +500,11 @@ def generate(outdir: Path) -> None:
                 tuning.tuned_local,
                 tuning.exchange_candidates,
                 tuning.local_candidates,
+                tuning.sched_candidates,
                 tuning.wisdom_state,
                 tuning.active_store,
+                tuning.best_measured_ms,
+                tuning.merge_entries,
                 tuning.clear_memory,
                 tuning.trial_deadline_s,
             ],
@@ -511,6 +535,7 @@ def generate(outdir: Path) -> None:
         ),
         "verify.md": verify_page(),
         "serve.md": serve_page(),
+        "sched.md": sched_page(),
         "c_api.md": c_api_page(),
         "fortran.md": fortran_page(),
         "examples.md": examples_page(),

@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retries", type=int, default=None)
     p.add_argument("--verify", default=None,
                    help="verify mode for the service's plans (e.g. 'on')")
+    p.add_argument("--sched", type=int, choices=[0, 1], default=0,
+                   help="A/B the task-graph scheduler (spfft_tpu.sched): 1 "
+                   "dispatches mixed-geometry batches as one graph per "
+                   "cycle; stamped into the report config either way")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--settle-s", type=float, default=30.0,
                    help="max wait for outstanding tickets after each step")
@@ -168,7 +172,7 @@ def main(argv=None) -> int:
 
     service = TransformService(
         queue_capacity=args.queue_cap, batch_max=args.batch_max,
-        retries=args.retries, verify=args.verify,
+        retries=args.retries, verify=args.verify, sched=bool(args.sched),
     )
     rows = []
     try:
@@ -238,7 +242,7 @@ def main(argv=None) -> int:
             "ramp": list(args.ramp), "duration_s": args.duration,
             "timeout_s": args.timeout_s, "num_values": int(len(trip)),
             "flops_per_transform": flops_per_transform, "dtype": dtype,
-            "seed": args.seed,
+            "seed": args.seed, "sched": bool(args.sched),
         },
         "rows": rows,
         "service": service.describe(),

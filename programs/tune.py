@@ -33,7 +33,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="autotune a plan into wisdom")
-    ap.add_argument("-d", nargs=3, type=int, required=True, metavar=("X", "Y", "Z"))
+    ap.add_argument("-d", nargs=3, type=int, default=None, metavar=("X", "Y", "Z"))
     ap.add_argument("-s", type=float, default=0.3, help="nonzero fraction")
     ap.add_argument("--r2c", action="store_true")
     ap.add_argument("--shards", type=int, default=1, help="1-D mesh size (1 = local)")
@@ -49,6 +49,18 @@ def main(argv=None):
     ap.add_argument(
         "--allow-cpu-trials", action="store_true",
         help="run trials on CPU-only hosts (sets SPFFT_TPU_TUNE_CPU=1; CI/tests)",
+    )
+    ap.add_argument(
+        "--export", default=None, metavar="BUNDLE",
+        help="after tuning (or alone, without -d), export the active wisdom "
+        "store as a fleet bundle at BUNDLE — a new host --merge'd from it "
+        "(or pointed at it via SPFFT_TPU_WISDOM) warm-starts pre-tuned",
+    )
+    ap.add_argument(
+        "--merge", default=None, metavar="BUNDLE",
+        help="before tuning (or alone, without -d), merge the fleet bundle "
+        "at BUNDLE into the active wisdom store (best-measured-wins on key "
+        "conflict, version-checked, corrupt bundles quarantined)",
     )
     ap.add_argument("-o", default=None, help="output JSON path")
     args = ap.parse_args(argv)
@@ -71,6 +83,28 @@ def main(argv=None):
         os.environ[TUNE_WARMUP_ENV] = str(args.warmup)
     if args.allow_cpu_trials:
         os.environ[TUNE_CPU_ENV] = "1"
+
+    if args.d is None and not (args.export or args.merge):
+        ap.error("-d is required unless --export/--merge runs bundle-only")
+    from spfft_tpu.tuning import active_store
+
+    if args.merge:
+        from spfft_tpu.errors import InvalidParameterError
+
+        try:
+            added, replaced = active_store().merge(args.merge)
+        except InvalidParameterError as e:
+            print(f"tune: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"merged bundle {args.merge}: {added} added, {replaced} replaced "
+            "(best-measured-wins)"
+        )
+    if args.d is None:
+        if args.export:
+            count = active_store().export(args.export)
+            print(f"exported {count} wisdom entries to {args.export}")
+        return 0
 
     if args.mesh2 is not None:
         args.shards = args.mesh2[0] * args.mesh2[1]
@@ -138,6 +172,9 @@ def main(argv=None):
             print(f"  {row['label']:20s} {row['ms']:9.3f} ms{model}")
         else:  # isolated trial failure (runner.run_trials error row)
             print(f"  {row['label']:20s}    FAILED: {row.get('error', '?')}")
+    if args.export:
+        count = active_store().export(args.export)
+        print(f"exported {count} wisdom entries to {args.export}")
     doc = {
         "tuning": rec,
         "wisdom": wisdom_state(plan),

@@ -36,6 +36,7 @@ from .errors import (  # noqa: F401
 )
 from . import faults  # noqa: F401
 from . import obs  # noqa: F401
+from . import sched  # noqa: F401
 from . import serve  # noqa: F401
 from . import timing  # noqa: F401
 from . import tuning  # noqa: F401

@@ -70,6 +70,8 @@ SITES = (
     "serve.admit",
     "serve.batch",
     "serve.dispatch",
+    "sched.place",
+    "sched.run",
 )
 
 KINDS = ("raise", "nan", "corrupt", "delay")

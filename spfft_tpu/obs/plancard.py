@@ -86,6 +86,18 @@ TUNING_KEYS = (
 # a trial row is either measured ("ms") or isolated-failed ("error")
 TRIAL_KEYS = ("label",)
 TRIAL_RESULT_KEYS = ("ms", "error")
+# Scheduler-placement provenance (spfft_tpu.sched.placement): present on
+# plans the task-graph placement pass built; pins the decision record so a
+# placed plan's card alone answers "which device, decided how" — wisdom
+# hit/miss included, the same contract as the tuning section.
+PLACEMENT_KEYS = (
+    "provenance",
+    "hit",
+    "reason",
+    "choice",
+    "device",
+    "device_index",
+)
 
 
 def base_discipline(exchange_type):
@@ -256,6 +268,11 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
     tuning_record = getattr(transform, "_tuning", None)
     if tuning_record is not None:
         card["tuning"] = tuning_record
+    placement = getattr(transform, "_placement", None)
+    if placement is not None:
+        # scheduler-placement provenance (spfft_tpu.sched): which device the
+        # placement pass bound this plan to and how the width was decided
+        card["placement"] = placement
     if distributed:
         p = transform._params
         mesh = transform.mesh
@@ -367,6 +384,13 @@ def validate_plan_card(card: dict) -> list:
         missing.extend(
             f"compiled.{k}" for k in COMPILED_KEYS if k not in card["compiled"]
         )
+    if "placement" in card:
+        rec = card["placement"]
+        missing.extend(f"placement.{k}" for k in PLACEMENT_KEYS if k not in rec)
+        if rec.get("provenance") not in ("wisdom", "model", "pinned"):
+            missing.append(
+                f"placement.provenance (unknown: {rec.get('provenance')!r})"
+            )
     if "tuning" in card:
         rec = card["tuning"]
         missing.extend(f"tuning.{k}" for k in TUNING_KEYS if k not in rec)

@@ -78,6 +78,8 @@ EVENTS = (
     #                    transition (spfft_tpu.verify)
     "serve",           # serving-layer transition (spfft_tpu.serve): admit /
     #                    reject / shed / coalesce / dispatch / complete
+    "sched",           # task-graph scheduler transition (spfft_tpu.sched):
+    #                    graph / place / dispatch / finalize / demote / fail
 
     "perf",            # performance report built (spfft_tpu.obs.perf):
     #                    measured GFLOP/s + exchange_fraction, run-ID-joined

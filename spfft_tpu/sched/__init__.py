@@ -1,0 +1,58 @@
+"""spfft_tpu.sched — task-graph scheduling across transforms and devices.
+
+The generalization of :mod:`spfft_tpu.multi_transform` from one homogeneous
+batch to arbitrary graphs of transform executions (ROADMAP item 4; the
+DaggerFFT task-scheduled-FFT shape, arxiv 2601.12209): independent
+transforms overlap — one transform's host staging and fetch hide behind
+another's FFTs — while dependent ones chain through explicit edges. Three
+pieces:
+
+1. **Graphs** (:mod:`.graph`): :class:`TaskGraph` nodes are single
+   split-phase transform executions (the ``multi_transform``
+   dispatch/finalize halves plus host staging); edges are data dependencies
+   (``after=`` / ``input_from=``) and the per-plan retained-buffer
+   constraint (tasks sharing a transform object serialize automatically —
+   the rule that makes duplicate plans illegal in ``multi_transform_*``
+   becomes an edge here). Cycles and dangling deps fail typed before
+   anything dispatches.
+2. **Placement** (:mod:`.placement`): spec'd tasks (geometry, no plan) are
+   assigned an engine/device by a TUNED pass — round-robin width candidates
+   (``tuning.candidates.sched_candidates``) measured on the real workload
+   and persisted in the wisdom store (``kind: "sched"`` keys), with the
+   model fallback (spread across every visible device) on cold CPU-only
+   hosts — and resolved through a :class:`PlanPool` (one build per geometry
+   per device). Every placed plan's card carries the decision provenance
+   (``placement`` section: wisdom hit/miss, width, device).
+3. **Execution** (:mod:`.executor`): :func:`run_graph` keeps up to
+   ``SPFFT_TPU_SCHED_INFLIGHT`` tasks dispatched at once and finalizes them
+   in **completion order** (``jax.Array.is_ready`` polling), not submission
+   order; a failed task retries, demotes through the plan's ``jnp.fft``
+   reference rung, then resolves typed — dependents resolve typed too
+   (``upstream_failed``) — so a failure never stalls the graph. Fault sites
+   ``sched.place`` / ``sched.run`` chaos-test both passes; the ``sched``
+   trace event and ``sched_tasks_total{outcome}`` / ``sched_inflight`` /
+   ``sched_graph_depth`` metrics land on the obs registries.
+
+Surfaces: the serving layer dispatches its coalesced batches through
+:func:`run_tasks` and (``sched=True``) whole mixed-geometry graphs through
+:func:`run_graph` (:mod:`spfft_tpu.serve`); ``programs/gbench.py`` measures
+scheduled-vs-serial graph throughput on the multichip mesh and ``./ci.sh
+sched`` gates it.
+"""
+from .graph import Task, TaskGraph  # noqa: F401
+from .placement import (  # noqa: F401
+    PlanPool,
+    build_plan,
+    resolve_width,
+    workload_key,
+)
+from .executor import (  # noqa: F401
+    DEFAULT_INFLIGHT,
+    LADDER_ERRORS,
+    OUTCOMES,
+    SCHED_INFLIGHT_ENV,
+    GraphReport,
+    resolve_inflight,
+    run_graph,
+    run_tasks,
+)

@@ -1,0 +1,571 @@
+"""Task-graph executor: keep transforms in flight, finalize on completion.
+
+The execution half of the scheduler (module doc: :mod:`spfft_tpu.sched`).
+One-at-a-time submission leaves the device idle during every host staging
+and fetch; ``multi_transform`` pipelines one homogeneous batch; this
+executor generalizes both to arbitrary graphs:
+
+- **Windowed dispatch** — up to ``max_inflight`` tasks
+  (``SPFFT_TPU_SCHED_INFLIGHT``) are dispatched and device-resident at once,
+  in topological order, so device queues never drain between batches: while
+  one task's FFTs run, the next task's host staging and dispatch proceed,
+  and another's results are fetched.
+- **Completion-order finalize** — in-flight results are polled for device
+  completion (``jax.Array.is_ready``) and finalized as they finish, not in
+  submission order: a small transform behind a large one is fetched the
+  moment it completes instead of queueing behind the large one's fetch.
+- **Per-task failure ladder** — a failed task (fault site ``sched.run``,
+  real dispatch/fence failures, guard-caught poison) is retried, then
+  demoted through the plan's ``jnp.fft`` reference rung (the verify
+  supervisor's demotion path), then resolved with a typed error — and its
+  dependents resolve typed (``upstream_failed``) — so a failed task never
+  stalls the rest of the graph (the chaos contract: remaining tasks
+  complete or resolve typed).
+
+Observability: ``sched_tasks_total{outcome}`` / ``sched_inflight`` /
+``sched_graph_depth`` on the run-metrics registry, ``sched`` flight-recorder
+events for place/dispatch/finalize/demote/fail transitions, and placement
+provenance on every pool-built plan's card.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .. import faults, obs
+from ..errors import (
+    DeadlineExceededError,
+    FFTWError,
+    GenericError,
+    GPUFFTError,
+    HostExecutionError,
+    InvalidParameterError,
+    MPIError,
+)
+from ..types import ScalingType
+from .graph import TaskGraph
+from .placement import PlanPool, place
+
+SCHED_INFLIGHT_ENV = "SPFFT_TPU_SCHED_INFLIGHT"
+DEFAULT_INFLIGHT = 8
+
+# Completion-poll cadence and patience: between polls the executor sleeps
+# _POLL_S; after _POLL_PATIENCE_S without any task completing it stops
+# polling and blocking-finalizes the oldest in-flight task (progress is
+# guaranteed even where is_ready never flips — the fence-budget discipline
+# still bounds a truly wedged dispatch).
+_POLL_S = 0.0002
+_POLL_PATIENCE_S = 0.05
+
+# Task outcomes (the ``outcome`` label of ``sched_tasks_total``).
+OUTCOMES = ("completed", "demoted", "failed", "upstream_failed")
+
+# Typed execution failures the per-task ladder may retry/demote: the same
+# classes the serving layer retries (dispatch/fence conversions + the
+# collective layer) — parameter errors fail fast.
+LADDER_ERRORS = (HostExecutionError, GPUFFTError, MPIError, FFTWError)
+
+
+def resolve_inflight(value=None) -> int:
+    """The in-flight window (``SPFFT_TPU_SCHED_INFLIGHT``, floor 1)."""
+    if value is not None:
+        return max(1, int(value))
+    try:
+        return max(1, int(
+            os.environ.get(SCHED_INFLIGHT_ENV, str(DEFAULT_INFLIGHT))
+            or DEFAULT_INFLIGHT
+        ))
+    except ValueError as e:
+        raise InvalidParameterError(
+            f"invalid {SCHED_INFLIGHT_ENV}: expected an integer"
+        ) from e
+
+
+class GraphReport:
+    """Outcome of one :func:`run_graph` call."""
+
+    __slots__ = (
+        "results", "outcomes", "errors", "depth", "tasks", "placement",
+        "wall_seconds",
+    )
+
+    def __init__(self, graph: TaskGraph, placement, wall_seconds, depth=None):
+        self.results = {
+            t.id: t.result for t in graph if t.outcome in ("completed", "demoted")
+        }
+        self.outcomes = {t.id: t.outcome for t in graph}
+        self.errors = {t.id: t.error for t in graph if t.error is not None}
+        self.depth = graph.depth() if depth is None else int(depth)
+        self.tasks = len(graph)
+        self.placement = placement
+        self.wall_seconds = wall_seconds
+
+    def result(self, task_id: str):
+        """The task's result; raises its typed error if it did not complete."""
+        tid = str(task_id)
+        if tid in self.errors:
+            raise self.errors[tid]
+        if tid not in self.results:
+            raise InvalidParameterError(f"unknown task id {task_id!r}")
+        return self.results[tid]
+
+    def describe(self) -> dict:
+        from collections import Counter
+
+        return {
+            "tasks": self.tasks,
+            "depth": self.depth,
+            "outcomes": dict(Counter(self.outcomes.values())),
+            "wall_seconds": self.wall_seconds,
+            "placement": self.placement,
+        }
+
+
+def _pending_ready(pending) -> bool:
+    """Whether every device leaf of a dispatched result has completed
+    (host-side leaves and backends without ``is_ready`` count as ready)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(pending):
+        probe = getattr(leaf, "is_ready", None)
+        if probe is not None and not probe():
+            return False
+    return True
+
+
+class _Run:
+    """One graph execution (state shared by the dispatch/finalize loop)."""
+
+    def __init__(self, graph, *, retries, demote, on_error, poll_patience_s,
+                 backoff_s=0.0, backoff_rng=None):
+        self.graph = graph
+        self.retries = max(0, int(retries))
+        self.demote = bool(demote)
+        if on_error not in ("resolve", "raise"):
+            raise InvalidParameterError(
+                f"on_error must be 'resolve' or 'raise', got {on_error!r}"
+            )
+        self.on_error = on_error
+        self.poll_patience_s = float(poll_patience_s)
+        # jittered exponential backoff between a task's retry attempts (the
+        # serving layer's thundering-herd rule; 0 = retry immediately)
+        self.backoff_s = max(0.0, float(backoff_s))
+        self.backoff_rng = backoff_rng
+
+    # ---- per-task execution -------------------------------------------------
+
+    def _platform(self, task) -> str:
+        dev = getattr(task.plan, "device", None)
+        return str(getattr(dev, "platform", "cpu"))
+
+    def _payload(self, task):
+        if task.input_from is not None:
+            return self.graph.task(task.input_from).result
+        return task.payload
+
+    def _dispatch(self, task) -> None:
+        """Stage + enqueue one task (no waiting). Supervised plans execute
+        whole under their recovery supervisor (the supervisor owns the
+        retry/demote ladder for them) and complete immediately."""
+        plan = task.plan
+        task.attempts += 1
+        task.dispatched_at = time.monotonic()
+        payload = self._payload(task)
+        obs.trace.event(
+            "sched", what="dispatch", task=task.id,
+            direction=task.direction, attempt=task.attempts,
+        )
+        with faults.typed_execution(self._platform(task), "sched dispatch"):
+            if plan._verifier is not None:
+                if task.direction == "backward":
+                    task.result = plan.backward(payload)
+                else:
+                    task.result = plan.forward(payload, task.scaling)
+                task.pending = ()
+                return
+            if task.direction == "backward":
+                pending = plan._dispatch_backward(payload)
+            else:
+                pending = plan._dispatch_forward(payload, task.scaling)
+            # the scheduler's execution fault site, engine.execute-style:
+            # `raise` surfaces here (typed via the scope), nan/corrupt
+            # poison the in-flight payload so the guard check at finalize
+            # must catch it — chaos runs prove the whole ladder
+            task.pending = faults.site("sched.run", payload=pending)
+
+    def _finalize(self, task) -> None:
+        """Fetch + complete one dispatched task; guard-scan the result when
+        the plan runs in guard mode (a poisoned in-flight payload surfaces
+        typed here, feeding the retry/demote ladder)."""
+        plan = task.plan
+        if task.result is not None or plan._verifier is not None:
+            return  # supervised: completed at dispatch
+        import jax
+
+        with faults.typed_execution(self._platform(task), "sched finalize"):
+            if task.direction == "backward":
+                result = plan._finalize_backward(task.pending)
+            else:
+                result = plan._finalize_forward(task.pending)
+            if plan._guard:
+                for leaf in jax.tree_util.tree_leaves(result):
+                    faults.check_array(
+                        np.asarray(leaf),
+                        check="sched output",
+                        platform=self._platform(task),
+                    )
+        task.result = result
+
+    def _reference(self, task):
+        """The demotion rung: re-execute through the plan's ``jnp.fft``
+        reference pipeline — a code path disjoint from the primary engine's
+        dispatch (no ``sched.run`` site, no shared compiled programs)."""
+        plan = task.plan
+        payload = self._payload(task)
+        with faults.typed_execution(self._platform(task), "sched demote"):
+            if task.direction == "backward":
+                return plan._reference_backward(payload)
+            if payload is None:
+                payload = plan.space_domain_data()
+            return plan._reference_forward(payload, task.scaling)
+
+    def _expired(self, task) -> bool:
+        """Deadline gate, applied before EVERY dispatch — first attempts and
+        retries alike (the serving layer's between-retries shedding rule):
+        an expired task resolves typed without burning device time."""
+        if task.deadline is None or time.monotonic() < task.deadline:
+            return False
+        self._fail(
+            task,
+            DeadlineExceededError(
+                f"sched task {task.id!r} deadline expired before "
+                f"{'retry' if task.attempts else 'dispatch'}"
+            ),
+        )
+        return True
+
+    def _retry_pause(self, task) -> None:
+        obs.counter("sched_retries_total").inc()
+        if self.backoff_s > 0.0:
+            time.sleep(
+                faults.backoff_s(self.backoff_s, task.attempts, self.backoff_rng)
+            )
+
+    def _attempt(self, task) -> bool:
+        """One dispatch of ``task`` with the failure ladder applied; returns
+        True when the task is in flight (or already resolved)."""
+        while True:
+            if self._expired(task):
+                return False
+            try:
+                self._dispatch(task)
+                return True
+            except LADDER_ERRORS as e:
+                if task.attempts <= self.retries:
+                    self._retry_pause(task)
+                    continue
+                self._demote_or_fail(task, e)
+                return False
+            except GenericError as e:
+                # non-retryable typed failures (parameter errors, an
+                # exhausted supervisor's VerificationError): they would fail
+                # identically on retry or the reference rung — resolve the
+                # TASK typed; the graph keeps running (on_error governs)
+                self._fail(task, e)
+                return False
+
+    def _finalize_ladder(self, task) -> None:
+        """Finalize with the same ladder: a finalize/guard failure re-runs
+        the whole attempt (dispatch included — the in-flight payload is
+        spent), then demotes, then resolves typed."""
+        while True:
+            try:
+                self._finalize(task)
+            except LADDER_ERRORS as e:
+                task.pending = None
+                if task.attempts <= self.retries:
+                    self._retry_pause(task)
+                    if self._attempt(task):
+                        continue  # re-dispatched: finalize the new attempt
+                    return  # ladder already resolved the task
+                self._demote_or_fail(task, e)
+                return
+            except GenericError as e:
+                task.pending = None
+                self._fail(task, e)  # non-retryable typed: see _attempt
+                return
+            self._resolve(task, "completed")
+            return
+
+    def _demote_or_fail(self, task, error) -> None:
+        if self.demote:
+            obs.trace.event("sched", what="demote", task=task.id)
+            try:
+                task.result = self._reference(task)
+            except GenericError as demote_err:
+                self._fail(task, demote_err)
+                return
+            task.error = None
+            self._resolve(task, "demoted")
+            return
+        self._fail(task, error)
+
+    def _fail(self, task, error) -> None:
+        task.error = error
+        obs.trace.event(
+            "sched", what="fail", task=task.id,
+            error=type(error).__name__,
+        )
+        self._resolve(task, "failed")
+        if self.on_error == "raise":
+            raise error
+
+    def _resolve(self, task, outcome: str) -> None:
+        task.outcome = outcome
+        task.finished_at = time.monotonic()
+        obs.counter("sched_tasks_total", outcome=outcome).inc()
+        if outcome in ("completed", "demoted"):
+            obs.trace.event("sched", what="finalize", task=task.id)
+
+    def _cascade(self, task) -> None:
+        """Resolve a task whose dependency failed: typed, never stalled."""
+        causes = [
+            d for d in task.deps
+            if self.graph.task(d).outcome in ("failed", "upstream_failed")
+        ]
+        cause = self.graph.task(causes[0]).error if causes else None
+        err = HostExecutionError(
+            f"sched task {task.id!r} not run: upstream task "
+            f"{causes[0] if causes else '?'!r} failed"
+        )
+        err.__cause__ = cause
+        task.error = err
+        self._resolve(task, "upstream_failed")
+
+    # ---- the loop -----------------------------------------------------------
+
+    def execute(self, order: list, max_inflight: int) -> None:
+        def gauge(n):
+            obs.gauge("sched_inflight").set(n)
+
+        try:
+            self._execute(order, max_inflight, gauge)
+        finally:
+            gauge(0)  # drained OR aborted (on_error="raise"): never stuck
+
+    def _execute(self, order: list, max_inflight: int, gauge) -> None:
+        waiting = list(order)
+        inflight: list = []
+        last_progress = time.monotonic()
+
+        while waiting or inflight:
+            progressed = False
+            # dispatch while the window has room and a task is ready
+            while waiting and len(inflight) < max_inflight:
+                task = self._next_ready(waiting)
+                if task is None:
+                    break
+                waiting.remove(task)
+                if any(
+                    self.graph.task(d).outcome
+                    in ("failed", "upstream_failed")
+                    for d in task.deps
+                ):
+                    self._cascade(task)
+                    progressed = True
+                    continue
+                if self._attempt(task):
+                    if task.result is not None:  # supervised: done already
+                        self._resolve(task, "completed")
+                    else:
+                        inflight.append(task)
+                        gauge(len(inflight))
+                progressed = True
+            # finalize in completion order: poll the window, take whichever
+            # finished; after the patience window, fall back to the oldest
+            if inflight:
+                ready = next(
+                    (t for t in inflight if _pending_ready(t.pending)), None
+                )
+                if ready is None and (
+                    time.monotonic() - last_progress > self.poll_patience_s
+                    or (not waiting and len(inflight) == 1)
+                ):
+                    ready = inflight[0]
+                if ready is not None:
+                    inflight.remove(ready)
+                    gauge(len(inflight))
+                    self._finalize_ladder(ready)
+                    progressed = True
+                elif not progressed:
+                    time.sleep(_POLL_S)
+            if progressed:
+                last_progress = time.monotonic()
+
+    def _next_ready(self, waiting: list):
+        """First task (topological order) whose deps are all resolved."""
+        for task in waiting:
+            states = [self.graph.task(d).outcome for d in task.deps]
+            if all(s is not None for s in states):
+                return task
+        return None
+
+
+def run_graph(
+    graph: TaskGraph,
+    *,
+    devices=None,
+    pool: PlanPool | None = None,
+    policy: str | None = None,
+    width: int | None = None,
+    max_inflight=None,
+    retries: int = 1,
+    demote: bool = True,
+    on_error: str = "resolve",
+    backoff_s: float = 0.0,
+    backoff_rng=None,
+    _poll_patience_s: float = _POLL_PATIENCE_S,
+) -> GraphReport:
+    """Execute a :class:`TaskGraph`; returns a :class:`GraphReport`.
+
+    ``devices`` (default: all visible jax devices) and ``policy`` feed the
+    placement pass for spec'd tasks (``policy="tuned"`` resolves the
+    round-robin width through wisdom/trials — :mod:`.placement`; ``width=``
+    pins it outright). ``pool`` reuses plan builds across calls. ``retries``
+    / ``demote`` configure the per-task failure ladder; ``on_error="raise"``
+    aborts on the first task failure instead of resolving it (the serving
+    layer's batch semantics — its own retry loop owns recovery there).
+    """
+    from ..parallel.policy import resolve_policy
+
+    order = graph.order()  # validates (cycles) before anything dispatches
+    if not order:
+        return GraphReport(graph, None, 0.0)
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    pool = pool if pool is not None else PlanPool()
+    policy = resolve_policy(policy)
+    t0 = time.monotonic()
+    depth = graph.depth()
+    obs.gauge("sched_graph_depth").set(depth)
+    obs.trace.event(
+        "sched", what="graph", tasks=len(order), depth=depth,
+        policy=str(policy),
+    )
+    if width is not None:
+        # record the EFFECTIVE width: a pin wider than the device list is
+        # clamped for assignment, and provenance must state what actually
+        # happened, not what was asked for
+        w = max(1, min(int(width), len(devices)))
+        placement = {
+            "provenance": "pinned",
+            "hit": None,
+            "wisdom_path": None,
+            "key_digest": None,
+            "choice": {"label": f"rr{w}", "width": w},
+            "trials": [],
+            "reason": "explicit width"
+            + (f" (clamped from {int(width)})" if w != int(width) else ""),
+        }
+        specd = [t for t in order if t.spec is not None]
+        for i, task in enumerate(specd):
+            task.plan = pool.plan_for(task.spec, devices[i % w])
+            task.plan._placement = dict(
+                placement, device=str(devices[i % w]), device_index=i % w
+            )
+    else:
+        placement = place(
+            graph, devices, pool, policy,
+            measure=lambda cand: _measure_width(
+                graph, devices, pool, cand["width"], max_inflight,
+            ),
+        )
+    run = _Run(
+        graph, retries=retries, demote=demote, on_error=on_error,
+        poll_patience_s=_poll_patience_s, backoff_s=backoff_s,
+        backoff_rng=backoff_rng,
+    )
+    run.execute(order, resolve_inflight(max_inflight))
+    return GraphReport(graph, placement, time.monotonic() - t0, depth=depth)
+
+
+def _measure_width(graph, devices, pool, width, max_inflight):
+    """One placement trial: execute a fresh copy of the workload with the
+    candidate width pinned. Trial runs are idempotent re-executions of the
+    graph (same payloads, same deps); their results are discarded — only the
+    wall clock is kept (the caller times this call). The trial runs WITHOUT
+    the retry/demote ladder (``on_error="raise"``): a width whose tasks fail
+    or demote must become an ``error`` trial row, never a fast-looking
+    winner timing the failure path (the ``TrialDegradedError`` rule)."""
+    run_graph(
+        _copy_graph(graph), devices=devices, pool=pool, width=int(width),
+        max_inflight=max_inflight, retries=0, demote=False, on_error="raise",
+    )
+
+
+def _copy_graph(graph: TaskGraph) -> TaskGraph:
+    """Fresh execution state over the same tasks (payloads shared read-only;
+    pinned transforms shared — a trial re-executes them idempotently)."""
+    copy = TaskGraph()
+    for task in graph:
+        copy.add(
+            task.direction, id=task.id, payload=task.payload,
+            scaling=task.scaling, after=task.deps, input_from=task.input_from,
+            transform=task.transform, spec=task.spec, deadline=task.deadline,
+        )
+    return copy
+
+
+def run_tasks(
+    plans: list,
+    directions,
+    payloads: list,
+    scalings=None,
+    *,
+    max_inflight=None,
+    retries: int = 0,
+    demote: bool = False,
+    on_error: str = "raise",
+) -> list:
+    """Flat-batch convenience: execute ``plans[i]`` on ``payloads[i]`` as one
+    dependency-free graph (completion-order finalize, windowed dispatch) and
+    return results in batch order — the scheduler-backed replacement for a
+    dispatch-all/finalize-all loop (the serving layer's batch path).
+
+    ``directions`` is one direction or a per-task list; defaults mirror the
+    serving batch contract: no internal retries or demotion (the caller owns
+    recovery), first failure raises typed."""
+    plans = list(plans)
+    payloads = list(payloads)
+    if len(plans) != len(payloads):
+        raise InvalidParameterError(
+            f"run_tasks: got {len(plans)} plans but {len(payloads)} payloads"
+        )
+    if isinstance(directions, str):
+        directions = [directions] * len(plans)
+    directions = list(directions)
+    if len(directions) != len(plans):
+        raise InvalidParameterError(
+            f"run_tasks: got {len(plans)} plans but {len(directions)} directions"
+        )
+    if scalings is None:
+        scalings = [ScalingType.NONE] * len(plans)
+    scalings = list(scalings)
+    if len(scalings) != len(plans):
+        raise InvalidParameterError(
+            f"run_tasks: got {len(plans)} plans but {len(scalings)} scalings"
+        )
+    graph = TaskGraph()
+    ids = [
+        graph.add(d, payload=v, scaling=s, transform=p)
+        for p, d, v, s in zip(plans, directions, payloads, scalings)
+    ]
+    report = run_graph(
+        graph, max_inflight=max_inflight, retries=retries, demote=demote,
+        on_error=on_error,
+    )
+    return [report.result(tid) for tid in ids]

@@ -1,0 +1,208 @@
+"""Task graphs over split-phase transform executions.
+
+A :class:`TaskGraph` is the unit the scheduler executes: nodes are single
+transform executions — the same split-phase halves ``multi_transform``
+pipelines (``_dispatch_backward`` / ``_finalize_backward`` and the forward
+pair) plus their host staging — and edges are the two dependency kinds the
+runtime actually has:
+
+- **data dependencies** — an explicit ``after=[...]`` list, optionally with
+  ``input_from=<task id>`` so a task's payload IS an upstream result (a
+  forward chained on a backward, a backward consuming a produced spectrum);
+- **retained-buffer constraints** — two tasks naming the same transform
+  *object* are implicitly serialized in submission order, because a plan's
+  retained space-domain buffer is per-object state (the same rule that makes
+  ``multi_transform_*`` reject duplicate transform objects; here the graph
+  encodes the constraint as an edge instead of refusing the batch).
+
+Nodes carry either a pre-built ``transform`` (the plan is pinned — the
+placement pass leaves it where it is) or a ``spec`` dict (geometry only —
+the placement pass assigns a device and resolves the plan through the
+scheduler's plan pool). Validation is eager and typed: unknown ids,
+duplicate ids, dangling dependencies and cycles raise
+:class:`~spfft_tpu.errors.InvalidParameterError` before anything dispatches.
+"""
+from __future__ import annotations
+
+from ..errors import InvalidParameterError
+from ..types import ScalingType
+
+DIRECTIONS = ("backward", "forward")
+
+_obj_id = id  # the builtin; shadowed by the public ``id=`` task-id kwarg
+
+
+class Task:
+    """One transform execution in a :class:`TaskGraph` (see module doc)."""
+
+    __slots__ = (
+        "id", "direction", "payload", "scaling", "deps", "input_from",
+        "transform", "spec", "deadline",
+        # execution state (owned by sched.executor)
+        "plan", "pending", "result", "error", "outcome", "attempts",
+        "dispatched_at", "finished_at",
+    )
+
+    def __init__(
+        self, id, direction, *, payload=None, scaling=ScalingType.NONE,
+        deps=(), input_from=None, transform=None, spec=None, deadline=None,
+    ):
+        if direction not in DIRECTIONS:
+            raise InvalidParameterError(
+                f"task {id!r}: unknown direction {direction!r} "
+                f"(expected one of {DIRECTIONS})"
+            )
+        if (transform is None) == (spec is None):
+            raise InvalidParameterError(
+                f"task {id!r}: exactly one of transform= (pinned plan) or "
+                "spec= (placed through the plan pool) is required"
+            )
+        if (
+            spec is not None and direction == "forward"
+            and payload is None and input_from is None
+        ):
+            raise InvalidParameterError(
+                f"task {id!r}: a spec'd forward task needs an explicit "
+                "payload or input_from= — pool-resolved plans are shared "
+                "per (geometry, device), so their retained space buffers "
+                "are not task-addressable"
+            )
+        self.id = str(id)
+        self.direction = direction
+        self.payload = payload
+        self.scaling = ScalingType(scaling)
+        self.deps = tuple(str(d) for d in deps)
+        self.input_from = None if input_from is None else str(input_from)
+        self.transform = transform
+        self.spec = dict(spec) if spec is not None else None
+        # absolute monotonic deadline, or None: the executor refuses to
+        # dispatch (or re-dispatch) an expired task — typed
+        # DeadlineExceededError, device time never burned on it
+        self.deadline = None if deadline is None else float(deadline)
+        self.plan = transform
+        self.pending = None
+        self.result = None
+        self.error = None
+        self.outcome = None  # one of executor.OUTCOMES once resolved
+        self.attempts = 0
+        self.dispatched_at = None
+        self.finished_at = None
+
+    def describe(self) -> dict:
+        """JSON-plain record of this task's identity and outcome."""
+        return {
+            "id": self.id,
+            "direction": self.direction,
+            "deps": list(self.deps),
+            "outcome": self.outcome,
+            "attempts": self.attempts,
+            "error": None if self.error is None else type(self.error).__name__,
+        }
+
+
+class TaskGraph:
+    """Ordered collection of :class:`Task` nodes with dependency edges."""
+
+    def __init__(self):
+        self._tasks: dict = {}
+        self._last_user: dict = {}  # id(transform) -> last task id (buffer edge)
+        self._auto_id = 0
+
+    def add(
+        self, direction, *, id=None, payload=None, scaling=ScalingType.NONE,
+        after=(), input_from=None, transform=None, spec=None, deadline=None,
+    ) -> str:
+        """Add one task; returns its id (generated when not given).
+
+        ``after`` lists upstream task ids; ``input_from`` names one of them
+        whose result becomes this task's payload (it is added to the
+        dependency set automatically). Tasks sharing a ``transform`` object
+        are additionally serialized in submission order (the retained-buffer
+        constraint — see module doc)."""
+        if id is not None:
+            tid = str(id)
+        else:
+            # skip over caller-supplied ids of the same shape: an auto id
+            # must never collide with a name the caller chose
+            while f"t{self._auto_id}" in self._tasks:
+                self._auto_id += 1
+            tid = f"t{self._auto_id}"
+            self._auto_id += 1
+        if tid in self._tasks:
+            raise InvalidParameterError(f"duplicate task id {tid!r}")
+        deps = [str(a) for a in after]
+        if input_from is not None and str(input_from) not in deps:
+            deps.append(str(input_from))
+        if transform is not None:
+            prev = self._last_user.get(_obj_id(transform))
+            if prev is not None and prev not in deps:
+                # per-object retained-buffer state: serialize, don't reject
+                deps.append(prev)
+            self._last_user[_obj_id(transform)] = tid
+        for d in deps:
+            if d not in self._tasks:
+                raise InvalidParameterError(
+                    f"task {tid!r} depends on unknown task {d!r} "
+                    "(dependencies must be added first)"
+                )
+        task = Task(
+            tid, direction, payload=payload, scaling=scaling, deps=deps,
+            input_from=input_from, transform=transform, spec=spec,
+            deadline=deadline,
+        )
+        self._tasks[tid] = task
+        return tid
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    def __iter__(self):
+        return iter(self._tasks.values())
+
+    def task(self, tid: str) -> Task:
+        try:
+            return self._tasks[str(tid)]
+        except KeyError:
+            raise InvalidParameterError(f"unknown task id {tid!r}") from None
+
+    def order(self) -> list:
+        """Topological order (submission order among ready peers) — Kahn's
+        algorithm; a cycle raises typed (the graph would deadlock)."""
+        indeg = {t.id: len(t.deps) for t in self._tasks.values()}
+        children: dict = {t.id: [] for t in self._tasks.values()}
+        for t in self._tasks.values():
+            for d in t.deps:
+                children[d].append(t.id)
+        ready = [tid for tid, n in indeg.items() if n == 0]
+        out = []
+        while ready:
+            tid = ready.pop(0)
+            out.append(tid)
+            for c in children[tid]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        if len(out) != len(self._tasks):
+            stuck = sorted(tid for tid, n in indeg.items() if n > 0)
+            raise InvalidParameterError(
+                f"task graph has a dependency cycle through {stuck}"
+            )
+        return [self._tasks[tid] for tid in out]
+
+    def depth(self) -> int:
+        """Longest dependency chain (1 for a flat batch, 0 when empty) —
+        the ``sched_graph_depth`` gauge the executor reports."""
+        depth: dict = {}
+        for task in self.order():
+            depth[task.id] = 1 + max(
+                (depth[d] for d in task.deps), default=0
+            )
+        return max(depth.values(), default=0)
+
+    def describe(self) -> dict:
+        """JSON-plain graph summary (size, depth, per-task outcomes)."""
+        return {
+            "tasks": len(self._tasks),
+            "depth": self.depth(),
+            "nodes": [t.describe() for t in self._tasks.values()],
+        }

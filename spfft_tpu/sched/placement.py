@@ -1,0 +1,270 @@
+"""Placement pass: assign every spec'd task an engine/device, TUNED.
+
+Placement is a plan decision like the exchange discipline, and it is resolved
+the same way (:mod:`spfft_tpu.tuning`): the candidate set — round-robin
+widths over the visible devices, ``tuning.candidates.sched_candidates`` — is
+measured by running the *actual graph workload* once per candidate width,
+the winner persists in the wisdom store under a ``kind: "sched"`` key (the
+workload's geometry signature, device count, platform, jax version), and a
+warm store answers with zero trials, so the same graph placed twice gets the
+same placement (the reproducibility half of the provenance contract). Hosts
+where trials are disallowed (CPU-only unless ``SPFFT_TPU_TUNE_CPU=1``) and
+non-tuned policies fall back to the **model placement**: round-robin across
+every visible device (independent transforms spread; DaggerFFT's default).
+
+The ``sched.place`` fault site fires at the head of the pass: an injected
+placement failure degrades to the model placement with a recorded
+``sched_place_failed`` degradation — placement never fails a graph run.
+
+Every pool-built plan carries its decision as ``plan._placement`` (surfaced
+as the plan card's ``placement`` section): provenance (``wisdom`` /
+``model`` / ``pinned``), wisdom hit/miss, key digest, the chosen width and
+the assigned device.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import faults, obs
+from ..errors import InvalidParameterError
+from ..tuning import wisdom as _wisdom
+from ..tuning.candidates import sched_candidates
+from ..tuning.runner import TRIAL_ERRORS, trials_allowed
+
+SPEC_KEYS = ("transform_type", "dims", "indices")
+
+
+def _spec_digest(spec: dict) -> str:
+    """Stable identity of one task spec (geometry + construction knobs)."""
+    for k in SPEC_KEYS:
+        if k not in spec:
+            raise InvalidParameterError(
+                f"task spec is missing {k!r} (required: {SPEC_KEYS})"
+            )
+    ttype = spec["transform_type"]
+    ttype = ttype.name if hasattr(ttype, "name") else str(ttype)
+    key = {
+        "type": ttype,
+        "dims": [int(d) for d in spec["dims"]],
+        "dtype": str(np.dtype(spec["dtype"])) if spec.get("dtype") is not None
+        else None,
+        "engine": str(spec.get("engine", "auto")),
+        "precision": str(spec.get("precision", "highest")),
+        "sticks": _wisdom.sparsity_signature(np.asarray(spec["indices"])),
+    }
+    return _wisdom.key_digest(key)
+
+
+def build_plan(spec: dict, device):
+    """Default plan builder of the pool: a local :class:`Transform` of the
+    spec's geometry bound to ``device`` (HOST plans on CPU devices, GPU
+    plans elsewhere — the device IS the placement decision)."""
+    from ..transform import Transform
+    from ..types import ProcessingUnit, TransformType
+
+    ttype = spec["transform_type"]
+    if not hasattr(ttype, "name"):
+        ttype = TransformType[str(ttype)]
+    dx, dy, dz = (int(d) for d in spec["dims"])
+    pu = (
+        ProcessingUnit.HOST
+        if getattr(device, "platform", "cpu") == "cpu"
+        else ProcessingUnit.GPU
+    )
+    return Transform(
+        pu, ttype, dx, dy, dz,
+        indices=spec["indices"],
+        dtype=spec.get("dtype"),
+        engine=spec.get("engine", "auto"),
+        precision=spec.get("precision", "highest"),
+        device=device,
+        policy=spec.get("policy"),
+        guard=spec.get("guard"),
+        verify=spec.get("verify"),
+    )
+
+
+class PlanPool:
+    """Plans keyed by (spec digest, device): one build per geometry per
+    placement target, reused across graphs — the scheduler's analogue of the
+    serving layer's plan cache (unbounded here; the pool's owner scopes its
+    lifetime)."""
+
+    def __init__(self, build=None):
+        self._build = build or build_plan
+        self._plans: dict = {}
+
+    def plan_for(self, spec: dict, device):
+        key = (_spec_digest(spec), getattr(device, "id", str(device)))
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = self._plans[key] = self._build(spec, device)
+        return plan
+
+    def __len__(self) -> int:
+        return len(self._plans)
+
+
+def workload_key(graph, num_devices: int, platform: str) -> dict:
+    """Wisdom key of one graph workload: the multiset of spec geometries
+    (digest -> count), graph shape (size, depth), device count, platform and
+    jax version — everything that changes which width wins."""
+    import jax
+
+    counts: dict = {}
+    pinned = 0
+    for task in graph:
+        if task.spec is None:
+            pinned += 1
+            continue
+        d = _spec_digest(task.spec)
+        counts[d] = counts.get(d, 0) + 1
+    return {
+        "kind": "sched",
+        "workload": sorted(counts.items()),
+        "pinned_tasks": pinned,
+        "tasks": len(graph),
+        "depth": graph.depth(),
+        "num_devices": int(num_devices),
+        "platform": str(platform),
+        "jax": jax.__version__,
+        "env": _wisdom.env_signature(),
+    }
+
+
+def _record(provenance, *, hit, store, choice, trials, reason, key) -> dict:
+    """The placement half of ``tuning._record`` — same JSON shape, so plan
+    cards and tests read one format for both decision kinds."""
+    return {
+        "policy": "tuned" if provenance == "wisdom" else provenance,
+        "provenance": provenance,
+        "hit": hit,
+        "wisdom_path": getattr(store, "path", None),
+        "key_digest": _wisdom.key_digest(key),
+        "reason": reason,
+        "choice": choice,
+        "trials": trials,
+    }
+
+
+def resolve_width(graph, devices, policy, measure) -> dict:
+    """Resolve the placement width (how many devices the round-robin pass
+    spreads spec'd tasks over) for one graph.
+
+    ``measure(candidate)`` runs the graph at the candidate's width and
+    returns wall seconds (the executor provides it — the trial IS the
+    workload). Returns the placement record (:func:`_record` shape) with the
+    chosen width in ``choice["width"]``. Ladder: wisdom hit -> zero trials;
+    miss with trials allowed -> measure every candidate, persist the winner;
+    otherwise the model placement (width = device count)."""
+    num = len(devices)
+    platform = str(getattr(devices[0], "platform", "cpu")) if num else "cpu"
+    key = workload_key(graph, num, platform)
+    store = _wisdom.active_store()
+    model_choice = {"label": f"rr{num}", "width": num}
+
+    def model(reason, trials=()):
+        return _record(
+            "model", hit=False, store=store, choice=dict(model_choice),
+            trials=list(trials), reason=reason, key=key,
+        )
+
+    if policy != "tuned":
+        return model(f"policy={policy!r}: model placement (round-robin)")
+    entry = store.lookup(key)
+    if entry is not None:
+        return _record(
+            "wisdom", hit=True, store=store, choice=dict(entry["choice"]),
+            trials=entry.get("trials", []), reason="wisdom hit", key=key,
+        )
+    if not trials_allowed(platform):
+        return model(
+            store.fallback_reason
+            or "trials skipped on CPU-only host "
+            "(set SPFFT_TPU_TUNE_CPU=1 to allow)"
+        )
+    rows, failed = [], []
+    for cand in sched_candidates(num):
+        try:
+            with obs.trace.operation("tune.trial", label=cand["label"]), \
+                    obs.trace.suppressed_dumps():
+                faults.site("tuning.trial")
+                t0 = time.perf_counter()
+                measure(cand)
+                seconds = time.perf_counter() - t0
+        except TRIAL_ERRORS as e:
+            obs.counter(
+                "tuning_trial_failures_total", candidate=cand["label"]
+            ).inc()
+            failed.append(dict(cand, error=faults.summarize(e)))
+            continue
+        obs.counter("tuning_trials_total", candidate=cand["label"]).inc()
+        rows.append(dict(cand, ms=round(seconds * 1e3, 4)))
+    rows.sort(key=lambda r: r["ms"])
+    trials = rows + failed
+    if not rows:
+        return model("all placement trial candidates failed", trials)
+    choice = {"label": rows[0]["label"], "width": int(rows[0]["width"])}
+    store.record(key, _wisdom.make_entry(key, choice, trials))
+    return _record(
+        "wisdom", hit=False, store=store, choice=choice, trials=trials,
+        reason=store.fallback_reason or "measured", key=key,
+    )
+
+
+def place(graph, devices, pool: PlanPool, policy, measure) -> dict:
+    """The placement pass: resolve the width (:func:`resolve_width`), then
+    assign each spec'd task a device round-robin in topological order and
+    resolve its plan through the pool. Pinned tasks keep their transforms.
+
+    Fault site ``sched.place`` fires first: an injected failure degrades to
+    the model placement (recorded), never a failed run. Returns the
+    placement record; every pool-built plan gets it (plus its own device) as
+    ``plan._placement`` — the plan card's ``placement`` section."""
+    specd = [t for t in graph if t.spec is not None]
+    if not specd:
+        return {"provenance": "pinned", "reason": "all tasks carry plans"}
+    if not devices:
+        raise InvalidParameterError("placement needs at least one device")
+    try:
+        faults.site("sched.place")
+        record = resolve_width(graph, devices, policy, measure)
+    except faults.InjectedFault as e:
+        faults.record_degradation("sched_place_failed", faults.summarize(e))
+        num = len(devices)
+        record = _record(
+            "model", hit=False, store=_wisdom.active_store(),
+            choice={"label": f"rr{num}", "width": num}, trials=[],
+            reason=f"placement fault: {faults.summarize(e)}",
+            key=workload_key(graph, num, str(
+                getattr(devices[0], "platform", "cpu"))),
+        )
+    width = max(1, min(int(record["choice"]["width"]), len(devices)))
+    if width != int(record["choice"]["width"]):
+        # a wisdom entry from a wider host clamps here: the record (and
+        # every card it lands on) must state the spread that actually ran
+        record = dict(
+            record,
+            choice={"label": f"rr{width}", "width": width},
+            reason=record["reason"]
+            + f" (clamped from rr{record['choice']['width']}: "
+            f"{len(devices)} devices visible)",
+        )
+    obs.counter(
+        "sched_place_total", provenance=record["provenance"]
+    ).inc()
+    obs.trace.event(
+        "sched", what="place", width=width,
+        provenance=record["provenance"], tasks=len(specd),
+    )
+    for i, task in enumerate(specd):
+        device = devices[i % width]
+        task.plan = pool.plan_for(task.spec, device)
+        task.plan._placement = dict(
+            record,
+            device=str(device),
+            device_index=int(i % width),
+        )
+    return record

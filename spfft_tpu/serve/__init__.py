@@ -12,17 +12,23 @@ property is graceful behavior under overload:
    unbounded latency. Deadlines are enforced at admission AND pre-dispatch.
 2. **Coalesced batching** (:mod:`.batcher`): requests whose sparse index
    sets share a stick layout resolve to one cached plan (keyed like the
-   tuning wisdom store) and execute as batches through the pipelined
-   split-phase dispatch of :mod:`spfft_tpu.multi_transform`, with
-   per-caller value orders bridged by static maps
-   (:func:`spfft_tpu.parallel.ragged.value_order_map`) — the AccFFT
+   tuning wisdom store) and execute as batches through the task-graph
+   scheduler (:func:`spfft_tpu.sched.run_tasks` over the split-phase
+   ``multi_transform`` halves — dispatches enqueued back-to-back, finalized
+   in completion order), with per-caller value orders bridged by static
+   maps (:func:`spfft_tpu.parallel.ragged.value_order_map`) — the AccFFT
    amortize-the-dispatch discipline (arxiv 1506.07933).
 3. **Service** (:mod:`.service`): the dispatcher — retry with jittered
    backoff for transient typed failures, the verify circuit breaker wired
    into a shed-or-demote ladder, per-tenant metrics/histograms on the obs
    registry, ``serve`` flight-recorder events, and fault sites
    ``serve.admit`` / ``serve.batch`` / ``serve.dispatch`` making the whole
-   admission→coalesce→execute→respond path chaos-testable.
+   admission→coalesce→execute→respond path chaos-testable. With
+   ``sched=True`` (``SPFFT_TPU_SERVE_SCHED``) one dispatch cycle pops up to
+   ``SPFFT_TPU_SERVE_SCHED_BATCHES`` coalesced batches — mixed geometries
+   included — and runs them as ONE task graph
+   (:func:`spfft_tpu.sched.run_graph`), so a flood across many plan-cache
+   entries stops serializing per entry.
 
 Guarantee (``tests/test_serve.py``, ``./ci.sh serve``): at offered load
 beyond capacity, with faults armed on every ``serve.*`` site, the queue
@@ -48,6 +54,7 @@ from .service import (  # noqa: F401
     DEFAULT_PLANS,
     DEFAULT_QUEUE_CAP,
     DEFAULT_RETRIES,
+    DEFAULT_SCHED_BATCHES,
     DEFAULT_TENANT_QUOTA,
     RETRYABLE_ERRORS,
     SERVE_BACKOFF_ENV,
@@ -56,6 +63,8 @@ from .service import (  # noqa: F401
     SERVE_PLANS_ENV,
     SERVE_QUEUE_CAP_ENV,
     SERVE_RETRIES_ENV,
+    SERVE_SCHED_BATCHES_ENV,
+    SERVE_SCHED_ENV,
     SERVE_TENANT_QUOTA_ENV,
     SERVE_TIMEOUT_ENV,
     TransformService,

@@ -6,10 +6,10 @@ whose sparse index sets share a stick layout resolve to ONE cached plan —
 keyed like the tuning wisdom store (dims / transform type / dtype /
 precision / platform / sparsity-signature digest,
 :func:`spfft_tpu.tuning.wisdom.key_digest`) — and a coalesced batch of them
-executes through the pipelined split-phase dispatch of
-:mod:`spfft_tpu.multi_transform` (all dispatches enqueued back-to-back, then
-finalized in order), so B small transforms pay ~one dispatch latency instead
-of B.
+executes through the task-graph scheduler (:func:`spfft_tpu.sched.run_tasks`
+over the same split-phase halves ``multi_transform`` pipelines: all
+dispatches enqueued back-to-back, then finalized in completion order), so B
+small transforms pay ~one dispatch latency instead of B.
 
 Raggedness is handled at the *value-order* level: two callers with the same
 index-triplet set pack their values in their own submission orders, so each
@@ -37,7 +37,7 @@ import threading
 
 import numpy as np
 
-from .. import faults, multi_transform, obs
+from .. import faults, obs, sched
 from ..tuning.wisdom import key_digest, sparsity_signature
 
 # Bound on remembered per-caller value orderings per plan entry (each is one
@@ -248,8 +248,14 @@ def run_batch(plans: list, requests: list) -> list:
     value order. Verified plans (``verify=`` armed) execute supervised
     per-request — the ABFT checks are host-side anyway, and the recovery
     ladder (retry -> jnp.fft reference -> typed ``VerificationError``) must
-    own each request's attempt; unverified plans use the pipelined
-    split-phase dispatch (all enqueued, then finalized in order)."""
+    own each request's attempt; unverified plans dispatch through the
+    task-graph scheduler (:func:`spfft_tpu.sched.run_tasks`): every request
+    enqueued back-to-back like the split-phase ``multi_transform`` path, but
+    finalized in **completion order** — a fast request behind a slow one is
+    fetched the moment its device work finishes. Failure semantics are
+    unchanged: the scheduler runs without its own retry/demote rungs here
+    (``on_error="raise"``) because the service's retry loop and breaker
+    ladder own batch recovery."""
     faults.site("serve.batch")
     direction = requests[0].direction
     obs.histogram("serve_batch_occupancy").observe(len(requests))
@@ -261,18 +267,21 @@ def run_batch(plans: list, requests: list) -> list:
         if supervised:
             outs = [p.backward(r.payload) for p, r in zip(plans, requests)]
         else:
-            pending = multi_transform.dispatch_backward(
-                plans, [r.payload for r in requests]
+            # window = whole batch: every dispatch enqueues back-to-back
+            # before any finalize (the one-dispatch-latency contract), even
+            # when batch_max exceeds the scheduler's default window
+            outs = sched.run_tasks(
+                plans, "backward", [r.payload for r in requests],
+                max_inflight=len(plans),
             )
-            outs = multi_transform.finalize_backward(plans, pending)
         return outs
     if supervised:
         outs = [p.forward(r.payload, r.scaling) for p, r in zip(plans, requests)]
     else:
-        pending = multi_transform.dispatch_forward(
-            plans, [r.payload for r in requests], [r.scaling for r in requests]
+        outs = sched.run_tasks(
+            plans, "forward", [r.payload for r in requests],
+            [r.scaling for r in requests], max_inflight=len(plans),
         )
-        outs = multi_transform.finalize_forward(plans, pending)
     return [_to_request_order(r, out) for r, out in zip(requests, outs)]
 
 

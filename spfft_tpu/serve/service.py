@@ -47,7 +47,7 @@ import time
 
 import numpy as np
 
-from .. import faults, obs
+from .. import faults, obs, sched
 from ..errors import (
     FFTWError,
     GPUFFTError,
@@ -59,6 +59,7 @@ from ..types import ProcessingUnit, ScalingType, TransformType
 from ..verify import breaker
 from .batcher import (
     PlanCache,
+    _to_request_order,
     run_batch,
     run_reference,
     sort_triplets,
@@ -75,6 +76,8 @@ SERVE_RETRIES_ENV = "SPFFT_TPU_SERVE_RETRIES"
 SERVE_BACKOFF_ENV = "SPFFT_TPU_SERVE_BACKOFF_S"
 SERVE_ON_BREAKER_ENV = "SPFFT_TPU_SERVE_ON_BREAKER"
 SERVE_PLANS_ENV = "SPFFT_TPU_SERVE_PLANS"
+SERVE_SCHED_ENV = "SPFFT_TPU_SERVE_SCHED"
+SERVE_SCHED_BATCHES_ENV = "SPFFT_TPU_SERVE_SCHED_BATCHES"
 
 DEFAULT_QUEUE_CAP = 256
 DEFAULT_BATCH_MAX = 8
@@ -82,6 +85,7 @@ DEFAULT_TENANT_QUOTA = 0.5
 DEFAULT_RETRIES = 1
 DEFAULT_BACKOFF_S = 0.005
 DEFAULT_PLANS = 16
+DEFAULT_SCHED_BATCHES = 4
 
 # Typed execution failures one re-dispatch may heal (the verify supervisor's
 # retry rule): the dual error surface's dispatch/fence conversions plus the
@@ -154,6 +158,8 @@ class TransformService:
         backoff_s: float | None = None,
         on_breaker: str | None = None,
         plan_cache_size: int | None = None,
+        sched: bool | None = None,
+        sched_batches: int | None = None,
         start: bool = True,
     ):
         self._pu = ProcessingUnit(processing_unit)
@@ -186,6 +192,19 @@ class TransformService:
             else _env_float(SERVE_BACKOFF_ENV, DEFAULT_BACKOFF_S, 0.0)
         )
         self.on_breaker = resolve_on_breaker(on_breaker)
+        # graph-scheduled dispatch (spfft_tpu.sched): one dispatch cycle pops
+        # up to sched_batches coalesced batches — mixed geometries included —
+        # and runs them as ONE task graph, so a flood across many plan-cache
+        # entries stops serializing per entry (SPFFT_TPU_SERVE_SCHED;
+        # programs/loadgen.py --sched A/Bs it)
+        self.sched = (
+            bool(sched) if sched is not None
+            else os.environ.get(SERVE_SCHED_ENV, "0") == "1"
+        )
+        self.sched_batches = (
+            max(1, int(sched_batches)) if sched_batches is not None
+            else _env_int(SERVE_SCHED_BATCHES_ENV, DEFAULT_SCHED_BATCHES, 1)
+        )
         cache_cap = (
             int(plan_cache_size) if plan_cache_size is not None
             else _env_int(SERVE_PLANS_ENV, DEFAULT_PLANS, 1)
@@ -370,6 +389,16 @@ class TransformService:
             )
         processed = 0
         while max_batches is None or processed < max_batches:
+            if self.sched:
+                limit = self.sched_batches
+                if max_batches is not None:
+                    limit = min(limit, max_batches - processed)
+                batches = self._pop_batches(limit, timeout=0.0)
+                if not batches:
+                    break
+                self._process_graph(batches)
+                processed += len(batches)
+                continue
             batch = self.queue.pop_batch(self.batch_max, timeout=0.0)
             if not batch:
                 break
@@ -379,12 +408,36 @@ class TransformService:
 
     def _dispatch_loop(self) -> None:
         while True:
+            if self.sched:
+                batches = self._pop_batches(self.sched_batches, timeout=0.05)
+                if not batches:
+                    if self._closing:
+                        return
+                    continue
+                self._process_graph(batches)
+                continue
             batch = self.queue.pop_batch(self.batch_max, timeout=0.05)
             if not batch:
                 if self._closing:
                     return
                 continue
             self._process_batch(batch)
+
+    def _pop_batches(self, limit: int, timeout: float) -> list:
+        """Up to ``limit`` coalesced batches for one graph-scheduled dispatch
+        cycle: block up to ``timeout`` for the first, then drain whatever
+        other groups are immediately available (mixed geometries included —
+        that is the point: they stop serializing per plan-cache entry)."""
+        batch = self.queue.pop_batch(self.batch_max, timeout=timeout)
+        if not batch:
+            return []
+        batches = [batch]
+        while len(batches) < max(1, int(limit)):
+            more = self.queue.pop_batch(self.batch_max, timeout=0.0)
+            if not more:
+                break
+            batches.append(more)
+        return batches
 
     def _process_batch(self, batch: list) -> None:
         """Execute one coalesced batch end-to-end, resolving every ticket.
@@ -498,6 +551,157 @@ class TransformService:
                 else:
                     breaker.release_probe(engine)
 
+    def _process_graph(self, batches: list) -> None:
+        """Execute one graph-scheduled dispatch cycle end-to-end, resolving
+        every ticket of every batch (the same catch-all no-deadlock contract
+        as :meth:`_process_batch`, over the whole cycle)."""
+        try:
+            self._process_graph_inner(batches)
+        except Exception as e:  # noqa: BLE001 — see _process_batch docstring
+            err = as_typed(e, self._platform())
+            for batch in batches:
+                for req in batch:
+                    if req.ticket.fail(err):
+                        self._count("failed", req.tenant)
+
+    def _process_graph_inner(self, batches: list) -> None:
+        """Admit each batch through the same gates as the per-batch path
+        (deadline shed, evicted-entry shed, breaker ladder), then run every
+        surviving request of every geometry as ONE task graph
+        (:func:`spfft_tpu.sched.run_graph`): mixed-geometry dispatches
+        overlap instead of serializing per plan-cache entry, finalize runs
+        in completion order, and a failed task demotes through the
+        scheduler's reference rung without stalling the rest of the cycle.
+        The scheduler owns per-task retries here (``retries=self.retries``);
+        engine breakers settle from the cycle's per-engine verdicts."""
+        platform = self._platform()
+        graph = sched.TaskGraph()
+        jobs = []  # (task_id, request, engine, supervised)
+        engines: dict = {}  # engine -> {"supervised", "failed"}
+        settled = False
+        # From the first allow() below this cycle MAY hold an engine
+        # breaker's single half-open probe slot. Every exit — the normal
+        # verdict loop included — must settle each engine's probe, so the
+        # finally releases verdict-less probes on the exceptional exits (a
+        # serve.batch fault on a later batch, a graph-build error): the
+        # breaker must never wedge in half-open behind a lost probe (the
+        # same contract as _process_batch_inner's finally).
+        try:
+            for batch in batches:
+                obs.counter("serve_batches_total").inc()
+                entry = self.plans.get(batch[0].plan_key)
+                survivors = self._shed_expired(batch)
+                if not survivors:
+                    continue
+                if entry is None:  # evicted between admit and dispatch
+                    err = ServiceOverloadError(
+                        "plan cache entry evicted while queued"
+                    )
+                    for req in survivors:
+                        obs.counter(
+                            "serve_sheds_total", reason="plan_evicted"
+                        ).inc()
+                        if req.ticket.fail(err, outcome="shed"):
+                            self._count("shed", req.tenant)
+                    continue
+                engine = entry.plan._engine
+                supervised = entry.plan._verifier is not None
+                if not supervised and not breaker.allow(engine):
+                    self._breaker_response(survivors, engine, entry)
+                    continue
+                state = engines.setdefault(
+                    engine, {"supervised": supervised, "failed": False}
+                )
+                state["supervised"] = state["supervised"] and supervised
+                faults.site("serve.batch")
+                obs.histogram("serve_batch_occupancy").observe(len(survivors))
+                obs.trace.event(
+                    "serve", what="coalesce",
+                    direction=survivors[0].direction,
+                    occupancy=len(survivors),
+                )
+                plans = entry.lease(len(survivors), self._clone_plan)
+                for plan, req in zip(plans, survivors):
+                    tid = graph.add(
+                        req.direction, payload=req.payload,
+                        scaling=req.scaling, transform=plan,
+                        deadline=req.deadline,
+                    )
+                    jobs.append((tid, req, engine, supervised))
+            if not jobs:
+                return  # the finally releases any held probes verdict-less
+            obs.trace.event(
+                "serve", what="dispatch", engine="sched",
+                occupancy=len(jobs), attempt=0,
+            )
+            with faults.typed_execution(platform, "serve dispatch"):
+                faults.site("serve.dispatch")
+                report = sched.run_graph(
+                    graph, retries=self.retries, demote=True,
+                    on_error="resolve", backoff_s=self.backoff_s,
+                    backoff_rng=self._retry_rng,
+                )
+            for tid, req, engine, supervised in jobs:
+                outcome = report.outcomes[tid]
+                err = report.errors.get(tid)
+                if outcome in ("completed", "demoted"):
+                    result = report.results[tid]
+                    if req.direction == "forward":
+                        result = _to_request_order(req, result)
+                    if outcome == "demoted":
+                        # the scheduler's reference rung answered: correct
+                        # data over a failed primary — an engine-health signal
+                        self._count_only("demoted")
+                        obs.counter(
+                            "serve_demotions_total", engine=engine
+                        ).inc()
+                        obs.trace.event(
+                            "serve", what="demote", engine=engine,
+                            tenant=req.tenant,
+                        )
+                        if not supervised:
+                            engines[engine]["failed"] = True
+                    if req.ticket.resolve(result):
+                        self._observe_completion(req)
+                elif isinstance(err, DeadlineExceededError):
+                    # expired between retry attempts inside the executor:
+                    # the same accounting as a pre-dispatch shed — and NOT
+                    # an engine-health failure
+                    obs.counter(
+                        "serve_deadline_misses_total", tenant=req.tenant
+                    ).inc()
+                    obs.counter("serve_sheds_total", reason="deadline").inc()
+                    obs.trace.event(
+                        "serve", what="shed", reason="deadline",
+                        tenant=req.tenant,
+                    )
+                    if req.ticket.fail(err, outcome="deadline_miss"):
+                        self._count("deadline_miss", req.tenant)
+                else:
+                    if not supervised:
+                        engines[engine]["failed"] = True
+                    err = (
+                        as_typed(err, platform) if err is not None
+                        else ServiceOverloadError("scheduled task unresolved")
+                    )
+                    if req.ticket.fail(err):
+                        self._count("failed", req.tenant)
+            # settle the breakers with this cycle's verdicts (supervised
+            # plans' supervisors already reported theirs)
+            settled = True
+            for engine, state in engines.items():
+                if state["supervised"]:
+                    continue
+                if state["failed"]:
+                    breaker.record_failure(engine)
+                else:
+                    breaker.record_success(engine)
+        finally:
+            if not settled:
+                for engine, state in engines.items():
+                    if not state["supervised"]:
+                        breaker.release_probe(engine)
+
     def _shed_expired(self, batch: list) -> list:
         now = time.monotonic()
         survivors = []
@@ -590,6 +794,8 @@ class TransformService:
             "batch_max": self.batch_max,
             "plan_cache_entries": len(self.plans),
             "on_breaker": self.on_breaker,
+            "sched": self.sched,
+            "sched_batches": self.sched_batches,
         }
 
     def describe(self) -> dict:
@@ -609,6 +815,8 @@ class TransformService:
                 "on_breaker": self.on_breaker,
                 "verify": str(self._plan_kwargs.get("verify")),
                 "threaded": self._worker is not None,
+                "sched": self.sched,
+                "sched_batches": self.sched_batches,
             },
             "plan_cache": cache,
             "breakers": {e: breaker.describe(e) for e in engines},

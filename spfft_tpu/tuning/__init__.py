@@ -35,10 +35,12 @@ from .wisdom import (  # noqa: F401
     MemoryStore,
     WisdomStore,
     active_store,
+    best_measured_ms,
     clear_memory,
     env_signature,
     key_digest,
     make_entry,
+    merge_entries,
     sparsity_signature,
 )
 from .runner import (  # noqa: F401
@@ -51,7 +53,11 @@ from .runner import (  # noqa: F401
     trial_deadline_s,
     trials_allowed,
 )
-from .candidates import exchange_candidates, local_candidates  # noqa: F401
+from .candidates import (  # noqa: F401
+    exchange_candidates,
+    local_candidates,
+    sched_candidates,
+)
 
 
 @contextlib.contextmanager

@@ -114,6 +114,29 @@ def exchange_candidates(
     return sorted(cands, key=lambda c: c["model_cost_bytes"])
 
 
+def sched_candidates(num_devices: int) -> list:
+    """Placement-width candidates for the task-graph scheduler
+    (:mod:`spfft_tpu.sched.placement`): how many devices the round-robin
+    placement pass spreads independent transforms over.
+
+    Powers of two up to the device count (plus the full count itself):
+    width 1 is the everything-on-one-device pipeline (dispatch overlap
+    only), the full width is the DaggerFFT-style spread (one transform's
+    exchange/fence hides another's FFTs on a different device), and the
+    measurement decides where the host's dispatch threads and memory
+    bandwidth actually peak — on CPU meshes the devices share cores, so
+    wider is routinely slower and the tuner must be allowed to say so."""
+    n = max(1, int(num_devices))
+    widths = []
+    w = 1
+    while w <= n:
+        widths.append(w)
+        w *= 2
+    if widths[-1] != n:
+        widths.append(n)
+    return [{"label": f"rr{w}", "width": int(w)} for w in widths]
+
+
 def local_candidates(platform: str) -> list:
     """Local-plan candidates: engine x sparse-y-knob variants.
 

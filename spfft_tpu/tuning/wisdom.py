@@ -136,6 +136,130 @@ def make_entry(key: dict, choice: dict, trials: list) -> dict:
     }
 
 
+def best_measured_ms(entry: dict) -> float | None:
+    """The fastest measured trial of a store entry (None when the entry
+    carries no measured rows — e.g. a hand-written or model-derived one).
+    The merge tie-breaker: between two entries for one key, the one whose
+    winning choice was backed by the better measurement is the one a fleet
+    should keep."""
+    times = []
+    for row in entry.get("trials", ()):
+        if not (isinstance(row, dict) and "ms" in row):
+            continue
+        try:
+            times.append(float(row["ms"]))
+        except (TypeError, ValueError):
+            continue  # malformed trial row (hand-edited bundle): not measured
+    return min(times) if times else None
+
+
+def merge_entries(existing: dict, incoming: dict) -> tuple:
+    """Merge ``incoming`` bundle entries into ``existing`` (in place),
+    best-measured-wins on key conflict; returns ``(added, replaced)``.
+
+    An incoming entry replaces an existing one only when it is strictly
+    better measured (lower best trial ms, or measured at all where the
+    existing one is not); ties and unmeasured-vs-unmeasured keep the
+    existing entry — merging the same bundle twice is a no-op, so fleet
+    bundle distribution is idempotent."""
+    added = replaced = 0
+    for digest, entry in incoming.items():
+        if not isinstance(entry, dict) or not isinstance(
+            entry.get("choice"), dict
+        ):
+            continue  # malformed rows never displace measured wisdom
+        current = existing.get(digest)
+        if current is None:
+            existing[digest] = entry
+            added += 1
+            continue
+        new_ms = best_measured_ms(entry)
+        cur_ms = best_measured_ms(current)
+        if new_ms is not None and (cur_ms is None or new_ms < cur_ms):
+            existing[digest] = entry
+            replaced += 1
+    return added, replaced
+
+
+def quarantine_file(path: str, why: str) -> None:
+    """Rename a corrupt wisdom file/bundle to ``<path>.corrupt`` (parsed
+    once, not repeatedly), warn once per process and count
+    ``wisdom_quarantined_total`` — the one corruption treatment shared by
+    store loads and bundle merges. A failing rename (permissions, races)
+    degrades silently; the caller's degrade-to-empty behavior stands."""
+    path = str(path)
+    target = path + ".corrupt"
+    try:
+        os.replace(path, target)
+    except OSError:
+        return
+    obs.counter("wisdom_quarantined_total").inc()
+    faults.record_degradation(
+        "wisdom_quarantined", why, path=path, quarantined_to=target
+    )
+    with _warn_lock:
+        first = path not in _quarantine_warned
+        _quarantine_warned.add(path)
+    if first:
+        warnings.warn(
+            f"corrupt wisdom store {path!r} quarantined to {target!r}: {why}",
+            RuntimeWarning,
+            stacklevel=4,
+        )
+
+
+def _write_bundle(path: str, entries: dict, *, dir: str) -> None:
+    """Atomic write of a ``{schema, entries}`` wisdom document (tempfile +
+    ``os.replace`` — the store's torn-write rule, shared with bundles)."""
+    doc = {"schema": WISDOM_SCHEMA, "entries": entries}
+    fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=dir)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, str(path))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _load_bundle(path: str) -> dict:
+    """Entries of a fleet bundle for merging. Every bad bundle raises typed
+    (a merge is an explicit operator action — unlike plan-time loads it
+    must fail loudly, not degrade): unreadable file, schema mismatch, and
+    corruption — the last ALSO gets the store's quarantine treatment
+    (renamed ``*.corrupt``, warned once, counted) before raising, so the
+    broken file is parsed once and the operator is told both facts."""
+    from ..errors import InvalidParameterError
+
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise InvalidParameterError(
+            f"wisdom bundle {str(path)!r} is unreadable: {e}"
+        ) from e
+    try:
+        doc = json.loads(text)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        quarantine_file(path, faults.summarize(e))
+        raise InvalidParameterError(
+            f"wisdom bundle {str(path)!r} is corrupt "
+            f"(quarantined to {str(path) + '.corrupt'!r}): "
+            f"{faults.summarize(e)}"
+        ) from e
+    if not isinstance(doc, dict) or doc.get("schema") != WISDOM_SCHEMA:
+        got = doc.get("schema") if isinstance(doc, dict) else type(doc).__name__
+        raise InvalidParameterError(
+            f"wisdom bundle {str(path)!r} schema mismatch: "
+            f"{got!r} != {WISDOM_SCHEMA!r}"
+        )
+    entries = doc.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
 class WisdomStore:
     """JSON-file wisdom store (see module docstring for the contract)."""
 
@@ -148,25 +272,7 @@ class WisdomStore:
         not on every plan construction; warn once per process and count
         ``wisdom_quarantined_total``. A failing rename (permissions, races)
         keeps the degrade-to-empty behavior without quarantine."""
-        target = self.path + ".corrupt"
-        try:
-            os.replace(self.path, target)
-        except OSError:
-            return
-        obs.counter("wisdom_quarantined_total").inc()
-        faults.record_degradation(
-            "wisdom_quarantined", why, path=self.path, quarantined_to=target
-        )
-        with _warn_lock:
-            first = self.path not in _quarantine_warned
-            _quarantine_warned.add(self.path)
-        if first:
-            warnings.warn(
-                f"corrupt wisdom store {self.path!r} quarantined to "
-                f"{target!r}: {why}",
-                RuntimeWarning,
-                stacklevel=4,
-            )
+        quarantine_file(self.path, why)
 
     def _load(self) -> dict:
         """Parse the file into ``{digest: entry}``; empty on absence,
@@ -232,6 +338,18 @@ class WisdomStore:
         Exhausted retries degrade to a recorded ``wisdom_save_failed`` event
         instead of raising — the caller's plan keeps its measured choice,
         only persistence is lost."""
+
+        def mutate(entries):
+            entries[key_digest(key)] = entry
+
+        self._update(mutate)
+
+    def _update(self, mutate) -> bool:
+        """One atomic read-modify-write of the store file (``mutate`` edits
+        the ``{digest: entry}`` table in place) under the module lock, the
+        advisory flock, and the bounded-retry/backoff ladder — the single
+        write discipline shared by :meth:`record` and :meth:`merge`.
+        Returns whether the write landed (False = recorded save failure)."""
         last: Exception | None = None
         for attempt in range(WISDOM_SAVE_ATTEMPTS):
             try:
@@ -241,30 +359,65 @@ class WisdomStore:
                     os.makedirs(d, exist_ok=True)
                     with _file_lock(self.path + ".lock"):
                         entries = self._load()
-                        entries[key_digest(key)] = entry
-                        doc = {"schema": WISDOM_SCHEMA, "entries": entries}
-                        fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
-                        try:
-                            with os.fdopen(fd, "w") as f:
-                                json.dump(doc, f, indent=1, sort_keys=True)
-                            os.replace(tmp, self.path)
-                        except BaseException:
-                            try:
-                                os.unlink(tmp)
-                            except OSError:
-                                pass
-                            raise
+                        mutate(entries)
+                        _write_bundle(self.path, entries, dir=d)
                 obs.trace.event(
                     "wisdom.save", path=self.path, outcome="ok",
                     attempt=attempt + 1,
                 )
-                return
+                return True
             except (OSError, faults.InjectedFault) as e:
                 last = e
                 obs.counter("wisdom_retries_total").inc()
                 if attempt < WISDOM_SAVE_ATTEMPTS - 1:
                     time.sleep(WISDOM_SAVE_BACKOFF_S * (2**attempt))
         self._save_failed(last)
+        return False
+
+    def entries(self) -> dict:
+        """Copy of the store's ``{digest: entry}`` table."""
+        with _lock:
+            return dict(self._load())
+
+    def export(self, path: str) -> int:
+        """Write the store's entries as a fleet bundle at ``path`` (atomic;
+        the bundle IS a wisdom file — same schema, loadable as a store or
+        mergeable into one). Returns the number of entries exported.
+
+        The fleet-bundle half of ROADMAP item 5: one tuned host exports, a
+        new host merges (or just points ``SPFFT_TPU_WISDOM`` at the bundle)
+        and boots pre-tuned instead of re-measuring per machine."""
+        entries = self.entries()
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        _write_bundle(path, entries, dir=d)
+        obs.trace.event(
+            "wisdom.save", path=str(path), outcome="ok", attempt=1
+        )
+        return len(entries)
+
+    def merge(self, bundle_path: str) -> tuple:
+        """Merge a fleet bundle into this store, best-measured-wins on key
+        conflict (:func:`merge_entries`); returns ``(added, replaced)``.
+
+        Version-checked: a bundle with a mismatched schema raises typed
+        :class:`InvalidParameterError` (silently merging entries whose key
+        semantics changed would poison every host it touches). A corrupt
+        bundle gets exactly the store's own corruption treatment —
+        quarantined to ``*.corrupt``, warned once, counted — and merges
+        nothing."""
+        incoming = _load_bundle(bundle_path)
+        if not incoming:
+            return (0, 0)
+        counts = []
+
+        def mutate(entries):
+            counts.clear()
+            counts.append(merge_entries(entries, incoming))
+
+        if not self._update(mutate):
+            return (0, 0)
+        return counts[0]
 
     def _save_failed(self, exc) -> None:
         """Exhausted-retry terminal: count and record, never raise (ladder
@@ -296,6 +449,31 @@ class MemoryStore:
         with _lock:
             MemoryStore._entries[key_digest(key)] = entry
         obs.trace.event("wisdom.save", path=None, outcome="ok", attempt=1)
+
+    def entries(self) -> dict:
+        with _lock:
+            return dict(MemoryStore._entries)
+
+    def export(self, path: str) -> int:
+        """Write the process memory store as a fleet bundle (same format as
+        :meth:`WisdomStore.export` — a host tuned without a configured
+        ``SPFFT_TPU_WISDOM`` can still hand its wisdom to the fleet)."""
+        entries = self.entries()
+        d = os.path.dirname(os.path.abspath(str(path))) or "."
+        os.makedirs(d, exist_ok=True)
+        _write_bundle(path, entries, dir=d)
+        obs.trace.event("wisdom.save", path=str(path), outcome="ok", attempt=1)
+        return len(entries)
+
+    def merge(self, bundle_path: str) -> tuple:
+        """Merge a fleet bundle into process memory (same rules as
+        :meth:`WisdomStore.merge`: best-measured-wins, version-checked,
+        corrupt bundles quarantined)."""
+        incoming = _load_bundle(bundle_path)
+        if not incoming:
+            return (0, 0)
+        with _lock:
+            return merge_entries(MemoryStore._entries, incoming)
 
 
 def active_store():

@@ -132,6 +132,114 @@ def test_length_mismatch_rejected():
         multi_transform_forward([t], None, [ScalingType.FULL, ScalingType.NONE])
 
 
+FUZZ_SEED = int(__import__("os").environ.get("SPFFT_TPU_FUZZ_SEED", "0"))
+
+
+def _fuzz_rng(case: int):
+    """Seeded per-case generator, parity-fuzz style. The case offset is
+    pinned by the test's own parametrization, so a failure replays with the
+    SAME env value: ``SPFFT_TPU_FUZZ_SEED=<env> pytest <failing nodeid>``
+    (the print shows the env value, not the derived stream seed — setting
+    the env to the derived seed would select a different stream)."""
+    seed = FUZZ_SEED + case
+    print(
+        f"interleaving fuzz: SPFFT_TPU_FUZZ_SEED={FUZZ_SEED} case={case} "
+        f"(stream seed {seed})"
+    )
+    return np.random.default_rng(seed)
+
+
+@pytest.mark.parametrize("case", range(4))
+def test_fuzz_out_of_order_finalize(case):
+    """Finalize order is free: pending split-phase results finalize in ANY
+    permutation — submission order, reversed, shuffled — with identical
+    results. This is the invariant the task-graph scheduler's
+    completion-order finalize (spfft_tpu.sched) relies on: whichever
+    transform finishes first may be fetched first."""
+    from spfft_tpu import multi_transform as mt
+
+    rng = _fuzz_rng(case)
+    dims = [int(d) for d in rng.choice([4, 6, 8], size=4)]
+    ts = [_make_local(d) for d in dims]
+    vals = [_rand_values(t, rng) for t in ts]
+    expect = [t.clone().backward(v) for t, v in zip(ts, vals)]
+    pending = mt.dispatch_backward(ts, vals)
+    order = rng.permutation(len(ts))
+    got = {}
+    for i in order:
+        got[i] = ts[i]._finalize_backward(pending[i])
+    for i, want in enumerate(expect):
+        np.testing.assert_allclose(got[i], want, atol=1e-10)
+
+
+@pytest.mark.parametrize("case", range(4))
+def test_fuzz_finalize_before_dispatch_of_next_and_cross_batch(case):
+    """Interleavings across batch boundaries: finalize of batch A's entries
+    interleaves with dispatch of batch B (finalize-before-dispatch-of-next
+    included — the degenerate window=1 schedule), in a random order drawn
+    under SPFFT_TPU_FUZZ_SEED. Every result must equal its solo execution —
+    dispatch and finalize of *distinct plan objects* are order-independent,
+    which is exactly what lets the scheduler keep several batches in
+    flight."""
+    from spfft_tpu import multi_transform as mt
+
+    rng = _fuzz_rng(10 + case)
+    dims_a = [int(d) for d in rng.choice([4, 6, 8], size=3)]
+    dims_b = [int(d) for d in rng.choice([4, 6, 8], size=3)]
+    ts_a = [_make_local(d) for d in dims_a]
+    ts_b = [_make_local(d) for d in dims_b]
+    vals_a = [_rand_values(t, rng) for t in ts_a]
+    vals_b = [_rand_values(t, rng) for t in ts_b]
+    expect_a = [t.clone().backward(v) for t, v in zip(ts_a, vals_a)]
+    expect_b = [t.clone().backward(v) for t, v in zip(ts_b, vals_b)]
+
+    # schedule: all of A dispatched, then a fuzzed interleaving of
+    # (finalize A_i) and (dispatch B_j), then B finalized in fuzzed order
+    pend_a = mt.dispatch_backward(ts_a, vals_a)
+    steps = [("fin_a", i) for i in range(len(ts_a))] + [
+        ("disp_b", j) for j in range(len(ts_b))
+    ]
+    rng.shuffle(steps)
+    got_a, pend_b = {}, {}
+    for op, idx in steps:
+        if op == "fin_a":
+            got_a[idx] = ts_a[idx]._finalize_backward(pend_a[idx])
+        else:
+            pend_b[idx] = mt.dispatch_backward(
+                [ts_b[idx]], [vals_b[idx]]
+            )[0]
+    got_b = {}
+    for j in rng.permutation(len(ts_b)):
+        got_b[j] = ts_b[j]._finalize_backward(pend_b[j])
+    for i, want in enumerate(expect_a):
+        np.testing.assert_allclose(got_a[i], want, atol=1e-10)
+    for j, want in enumerate(expect_b):
+        np.testing.assert_allclose(got_b[j], want, atol=1e-10)
+    # forward halves interleave the same way (retained buffers are
+    # per-object: the backward above retained each plan's space slab)
+    fp_a = mt.dispatch_forward(
+        ts_a, [None] * len(ts_a), [ScalingType.FULL] * len(ts_a)
+    )
+    fp_b = mt.dispatch_forward(
+        ts_b, [None] * len(ts_b), [ScalingType.FULL] * len(ts_b)
+    )
+    both = [("a", i) for i in range(len(ts_a))] + [
+        ("b", j) for j in range(len(ts_b))
+    ]
+    rng.shuffle(both)
+    for which, idx in both:
+        if which == "a":
+            np.testing.assert_allclose(
+                ts_a[idx]._finalize_forward(fp_a[idx]), vals_a[idx],
+                atol=1e-10,
+            )
+        else:
+            np.testing.assert_allclose(
+                ts_b[idx]._finalize_forward(fp_b[idx]), vals_b[idx],
+                atol=1e-10,
+            )
+
+
 def test_split_phase_api_matches_one_shot():
     """The public dispatch_*/finalize_* halves (the serving layer's batch
     path) produce exactly what the one-shot functions produce — they ARE the

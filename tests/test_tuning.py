@@ -365,3 +365,108 @@ def test_trial_deadline_unset_means_no_deadline(monkeypatch):
     assert runner.trial_deadline_s() == 0.0
     # and _run_deadlined with budget 0 runs inline
     assert runner._run_deadlined(lambda: 42, 0.0, "x") == 42
+
+
+# ---- fleet bundles (wisdom merge/export) ------------------------------------
+
+
+def _entry(key, choice, ms_list):
+    trials = [{"label": f"c{i}", "ms": ms} for i, ms in enumerate(ms_list)]
+    return tuning.make_entry(key, choice, trials)
+
+
+def test_bundle_export_merge_best_measured_wins(tmp_path):
+    a = tuning.WisdomStore(str(tmp_path / "a.json"))
+    b = tuning.WisdomStore(str(tmp_path / "b.json"))
+    k1, k2 = {"kind": "x", "n": 1}, {"kind": "x", "n": 2}
+    a.record(k1, _entry(k1, {"w": "slow"}, [5.0]))
+    b.record(k1, _entry(k1, {"w": "fast"}, [3.0, 9.0]))
+    b.record(k2, _entry(k2, {"w": "only"}, [1.0]))
+    bundle = tmp_path / "fleet.json"
+    assert b.export(str(bundle)) == 2
+    assert a.merge(str(bundle)) == (1, 1)  # k2 added, k1 replaced (3 < 5 ms)
+    ent = a.entries()
+    assert ent[tuning.key_digest(k1)]["choice"] == {"w": "fast"}
+    assert ent[tuning.key_digest(k2)]["choice"] == {"w": "only"}
+    # idempotent: re-merging the same bundle changes nothing
+    assert a.merge(str(bundle)) == (0, 0)
+    # losing direction: a's (now 3 ms) entry never regresses to 5 ms
+    worse = tmp_path / "worse.json"
+    assert a.export(str(worse)) == 2
+    a.record(k1, _entry(k1, {"w": "fast"}, [2.0]))
+    assert a.merge(str(worse)) == (0, 0)
+    assert tuning.best_measured_ms(a.entries()[tuning.key_digest(k1)]) == 2.0
+
+
+def test_bundle_measured_beats_unmeasured_and_malformed_skipped(tmp_path):
+    a = tuning.WisdomStore(str(tmp_path / "a.json"))
+    k = {"kind": "x", "n": 1}
+    a.record(k, _entry(k, {"w": "model"}, []))  # unmeasured (model-derived)
+    bundle = tmp_path / "fleet.json"
+    doc = {
+        "schema": tuning.WISDOM_SCHEMA,
+        "entries": {
+            tuning.key_digest(k): _entry(k, {"w": "measured"}, [4.0]),
+            "malformed": {"choice": "not-a-dict"},
+            "alsobad": ["nope"],
+        },
+    }
+    bundle.write_text(json.dumps(doc))
+    assert a.merge(str(bundle)) == (0, 1)  # measured beats unmeasured;
+    # malformed rows are skipped, never displacing wisdom
+    assert a.entries()[tuning.key_digest(k)]["choice"] == {"w": "measured"}
+
+
+def test_bundle_schema_mismatch_raises_typed(tmp_path):
+    a = tuning.WisdomStore(str(tmp_path / "a.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "bogus/9", "entries": {}}))
+    with pytest.raises(InvalidParameterError, match="schema mismatch"):
+        a.merge(str(bad))
+    with pytest.raises(InvalidParameterError, match="unreadable"):
+        a.merge(str(tmp_path / "missing.json"))
+
+
+def test_bundle_corrupt_quarantine_parity(tmp_path):
+    """A corrupt bundle gets exactly the store's corruption treatment —
+    quarantined to *.corrupt, warned, counted — AND the merge fails loudly
+    (typed), because a merge is an explicit operator action."""
+    import warnings
+
+    a = tuning.WisdomStore(str(tmp_path / "a.json"))
+    k = {"kind": "x", "n": 1}
+    a.record(k, _entry(k, {"w": "keep"}, [1.0]))
+    corrupt = tmp_path / "fleet.json"
+    corrupt.write_text("{ not json")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with pytest.raises(InvalidParameterError, match="corrupt"):
+            a.merge(str(corrupt))
+    assert (tmp_path / "fleet.json.corrupt").exists()
+    assert not corrupt.exists()
+    assert any("quarantined" in str(w.message) for w in caught)
+    counters = obs.snapshot()["counters"]
+    assert counters.get("wisdom_quarantined_total", 0) >= 1, counters
+    # the store itself is untouched
+    assert a.entries()[tuning.key_digest(k)]["choice"] == {"w": "keep"}
+
+
+def test_bundle_memory_store_parity(tmp_path):
+    tuning.clear_memory()
+    m = tuning.MemoryStore()
+    k1, k2 = {"kind": "x", "n": 1}, {"kind": "x", "n": 2}
+    m.record(k1, _entry(k1, {"w": "mem"}, []))
+    bundle = tmp_path / "fleet.json"
+    doc = {
+        "schema": tuning.WISDOM_SCHEMA,
+        "entries": {
+            tuning.key_digest(k1): _entry(k1, {"w": "fleet"}, [2.0]),
+            tuning.key_digest(k2): _entry(k2, {"w": "new"}, [1.0]),
+        },
+    }
+    bundle.write_text(json.dumps(doc))
+    assert m.merge(str(bundle)) == (1, 1)
+    assert m.export(str(tmp_path / "out.json")) == 2
+    out = json.loads((tmp_path / "out.json").read_text())
+    assert out["schema"] == tuning.WISDOM_SCHEMA
+    assert len(out["entries"]) == 2
