@@ -185,6 +185,10 @@ def main():
                 # a flight-recorder dump or snapshot from this process joins
                 # this capture on one key
                 "run_id": plan_card.get("run_id"),
+                # fusion state (spfft_tpu.ir): fused single-program vs
+                # staged per-stage dispatch rows are different scenarios
+                # (A/B them with SPFFT_TPU_FUSE / programs/fbench.py)
+                "fused": bool(getattr(t, "fused", True)),
                 # verification setting (spfft_tpu.verify): perf rows under
                 # verification are never comparable to rows without it
                 "verify_mode": plan_card.get("verification", {}).get(

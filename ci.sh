@@ -18,6 +18,7 @@
 #          ./ci.sh serve      # serving layer: loadgen smoke + overload chaos
 #          ./ci.sh sched      # task-graph scheduler: gbench + gate + chaos
 #          ./ci.sh perf       # dbench scaling rows + schema + regression gate
+#          ./ci.sh ir         # stage-graph IR: parity suite + fbench fused-vs-staged gate
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -453,6 +454,67 @@ EOF
   rm -rf "$pdir"
 }
 
+run_ir() {
+  echo "== IR (spfft_tpu.ir: suite + fused/staged parity smoke + fbench gate, CPU) =="
+  # The IR suite: graph validation, fused-vs-staged parity fuzz across
+  # {C2C,R2C} x {f32,f64} x {local,slab,pencil} x overlap {1,4}, the
+  # single-dispatch proof, card provenance, and the ir.lower/ir.compile
+  # degradation rungs.
+  JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_ir.py -q
+  local idir
+  idir="$(mktemp -d)"
+  # Dispatch-path A/B (programs/fbench.py): the fused single program per
+  # direction must beat the staged per-stage dispatch reference STRICTLY —
+  # the whole point of the fusion pass (at small dims the staged path pays
+  # ~10 dispatches + materialized intermediates per direction).
+  JAX_PLATFORMS=cpu timeout 540 python programs/fbench.py --dim 24 \
+    --radius 0.9 --pairs 8 --repeats 5 -o "$idir/fbench.json"
+  JAX_PLATFORMS=cpu python - "$idir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/fbench.json"))
+rows = {r["key"].rsplit(":", 1)[-1]: r for r in doc["rows"]}
+assert set(rows) == {"fused", "staged"}, sorted(rows)
+assert rows["fused"]["ir"]["path"] == "fused", rows["fused"]["ir"]
+assert rows["staged"]["ir"]["path"] == "staged", rows["staged"]["ir"]
+assert rows["fused"]["ir"]["donation"]["backward"], "fused backward must donate"
+for r in doc["rows"]:
+    assert r["run_id"] and r["gflops"] > 0, r["key"]
+ratio = doc["fused_over_staged"]
+assert ratio > 1.0, f"fused not strictly above staged: x{ratio:.3f}"
+print(f"fbench ok (fused x{ratio:.2f} over staged)")
+EOF
+  # Regression gate: the committed baseline carries an fbench row family
+  # (bench_results/perf_baseline_cpu8.json) — match on the fbench keys ...
+  python programs/perf_gate.py "$idir/fbench.json" \
+    bench_results/perf_baseline_cpu8.json --tolerance 0.85 \
+    --require-matches 1 > /dev/null
+  # ... a run gates green against itself ...
+  python programs/perf_gate.py "$idir/fbench.json" "$idir/fbench.json" > /dev/null
+  # ... and must trip (exit 3) against a doctored baseline claiming 10x.
+  python - "$idir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/fbench.json"))
+for r in doc["rows"]:
+    r["gflops"] *= 10
+json.dump(doc, open(f"{d}/doctored.json", "w"))
+EOF
+  set +e
+  python programs/perf_gate.py "$idir/fbench.json" "$idir/doctored.json" \
+    > /dev/null 2>&1
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "ir gate FAILED to trip on doctored baseline (rc=$rc, want 3)" >&2
+    exit 1
+  fi
+  rm -rf "$idir"
+  echo "ir gate ok (doctored baseline trips with exit 3)"
+}
+
 run_dryrun() {
   echo "== Multichip dryrun (8-device CPU mesh, CPU forced) =="
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
@@ -483,6 +545,7 @@ case "$stage" in
   serve) run_serve ;;
   sched) run_sched ;;
   perf) run_perf ;;
+  ir) run_ir ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
@@ -496,12 +559,13 @@ case "$stage" in
     run_serve
     run_sched
     run_perf
+    run_ir
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | sched | perf | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | sched | perf | ir | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,179 @@
+"""fbench: fused-vs-staged A/B benchmark through the DISPATCH path.
+
+The shared timing discipline (``obs.perf.measure_pair_seconds``) chains the
+un-jitted ``trace_*`` composition inside one ``lax.scan`` — deliberately
+bypassing the IR programs — so it cannot see the thing this PR changes:
+whether a host-facing pair runs as ONE compiled program per direction
+(``SPFFT_TPU_FUSE=1``, the fused stage graph) or as one dispatch per stage
+with materialized intermediates (``SPFFT_TPU_FUSE=0``, the staged
+reference). fbench measures exactly that: staged device inputs, warmup
+absorbing compilation, then best-of-R timed loops of ``pairs`` device-side
+``backward_pair``/``forward_pair`` roundtrips fenced at the loop end — per-
+dispatch latency and XLA's cross-stage fusion are IN the measurement, host
+staging is not (the tuning-trial rule).
+
+Output: one JSON document (schema ``spfft_tpu.ir.fbench/1``) with
+gate-compatible rows (``key``/``gflops``/``seconds_noise`` —
+``programs/perf_gate.py`` reads them like dbench rows), one row per fusion
+variant, plus the speedup ratio and each plan's card ``ir`` section. The
+committed ``BENCH_r10.json`` single-chip 256³ @15% capture and the
+``./ci.sh ir`` gate both come from this harness.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+FBENCH_SCHEMA = "spfft_tpu.ir.fbench/1"
+
+
+def measure_dispatch_pair(t, *, pairs: int, repeats: int, warmup: int) -> dict:
+    """Best-of-``repeats`` seconds per backward+forward DISPATCH pair."""
+    from spfft_tpu.sync import fence
+    from spfft_tpu.tuning.runner import _stage_inputs
+    from spfft_tpu.types import ScalingType
+
+    staged = _stage_inputs(t)
+
+    def one_pair():
+        # device-side entry points: backward retains the space buffer the
+        # input-less forward re-reads (both route through the IR programs)
+        t.backward_pair(*staged)
+        return t.forward_pair(ScalingType.FULL)
+
+    for _ in range(max(0, warmup)):
+        fence(one_pair())
+    rep_seconds = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(max(1, pairs)):
+            last = one_pair()
+        fence(last)
+        rep_seconds.append((time.perf_counter() - t0) / max(1, pairs))
+    best = min(rep_seconds)
+    med = sorted(rep_seconds)[len(rep_seconds) // 2]
+    return {
+        "seconds_per_pair": best,
+        "rep_seconds": rep_seconds,
+        # best-vs-median spread, the gate's noise allowance input
+        "seconds_noise": (med - best) / best if best > 0 else 0.0,
+    }
+
+
+def build(dim, sparsity, dtype, engine, fuse):
+    import spfft_tpu as sp
+    from spfft_tpu import ProcessingUnit, Transform, TransformType
+
+    radius = float(sparsity)
+    trip = sp.create_spherical_cutoff_triplets(dim, dim, dim, radius)
+    return Transform(
+        ProcessingUnit.HOST
+        if _platform() == "cpu"
+        else ProcessingUnit.GPU,
+        TransformType.C2C,
+        dim,
+        dim,
+        dim,
+        indices=trip,
+        dtype=dtype,
+        engine=engine,
+        fuse=fuse,
+    )
+
+
+def _platform():
+    import jax
+
+    return jax.default_backend()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dim", type=int, default=256, help="cubic grid extent")
+    ap.add_argument(
+        "--radius", type=float, default=0.659,
+        help="spherical cutoff radius fraction (0.659 ~ 15%% nnz)",
+    )
+    ap.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--pairs", type=int, default=8, help="pairs per timed loop")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("-o", "--output", default=None)
+    args = ap.parse_args(argv)
+
+    import spfft_tpu as sp
+
+    dim = int(args.dim)
+    ntot = dim**3
+    flops = 2 * 5.0 * ntot * np.log2(ntot)
+    rows = []
+    results = {}
+    for label, fuse in (("fused", True), ("staged", False)):
+        t = build(dim, args.radius, np.dtype(args.dtype), args.engine, fuse)
+        assert t.fused is fuse, (label, t.report()["ir"])
+        m = measure_dispatch_pair(
+            t, pairs=args.pairs, repeats=args.repeats, warmup=args.warmup
+        )
+        results[label] = m["seconds_per_pair"]
+        card = t.report()
+        rows.append(
+            {
+                "key": f"fbench:c2c:{dim}:r{args.radius}:{args.dtype}:{label}",
+                "fused": fuse,
+                "engine": card["engine"],
+                "seconds_per_pair": m["seconds_per_pair"],
+                "rep_seconds": m["rep_seconds"],
+                "seconds_noise": m["seconds_noise"],
+                "gflops": flops / m["seconds_per_pair"] / 1e9,
+                "nnz_fraction": card["nnz_fraction"],
+                "ir": card["ir"],
+                "run_id": card["run_id"],
+            }
+        )
+        print(
+            f"{label:7s} {m['seconds_per_pair'] * 1e3:10.3f} ms/pair  "
+            f"{rows[-1]['gflops']:9.2f} GFLOP/s  "
+            f"(noise {m['seconds_noise']:.1%})",
+            file=sys.stderr,
+        )
+    doc = {
+        "schema": FBENCH_SCHEMA,
+        "config": {
+            "dim": dim,
+            "radius": args.radius,
+            "dtype": args.dtype,
+            "engine": args.engine,
+            "pairs": args.pairs,
+            "repeats": args.repeats,
+            "platform": _platform(),
+            "device_count": 1,
+            "jax": __import__("jax").__version__,
+            "spfft_tpu": getattr(sp, "__version__", None),
+        },
+        "fused_over_staged": results["staged"] / results["fused"],
+        "rows": rows,
+    }
+    out = json.dumps(doc, indent=1)
+    if args.output:
+        Path(args.output).write_text(out)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(out)
+    print(
+        f"fused-over-staged speedup: x{doc['fused_over_staged']:.3f}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,6 +240,24 @@ def installation_page() -> str:
     )
 
 
+def ir_page() -> str:
+    """The stage-graph IR page: the `spfft_tpu.ir` surface (graphs, the
+    fusion pass, the staged reference executor, the engine runtime)."""
+    from spfft_tpu import ir
+
+    return class_page(
+        "Stage-graph IR (`spfft_tpu.ir`)",
+        doc(ir),
+        [ir.StageGraph, ir.EdgeMeta, ir.Node, ir.StagedProgram, ir.EngineIr],
+        [
+            ir.compose,
+            ir.resolve_fuse,
+            ir.lower_engine,
+            ir.init_engine_ir,
+        ],
+    )
+
+
 def index_page() -> str:
     import spfft_tpu as sp
 
@@ -267,6 +285,7 @@ def index_page() -> str:
         - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
         - [Serving: admission, coalesced batching, load shedding](serve.md)
         - [Task-graph scheduling: placement, overlap, completion order](sched.md)
+        - [Stage-graph IR and per-direction fusion](ir.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -536,6 +555,7 @@ def generate(outdir: Path) -> None:
         "verify.md": verify_page(),
         "serve.md": serve_page(),
         "sched.md": sched_page(),
+        "ir.md": ir_page(),
         "c_api.md": c_api_page(),
         "fortran.md": fortran_page(),
         "examples.md": examples_page(),

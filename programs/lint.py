@@ -35,7 +35,13 @@ kept flagging are enforced here with the stdlib ast module:
    appears in an engine pipeline, and every engine-pipeline stage carries a
    flop/byte model — so perf reports can never emit or omit a stage the
    engines disagree about (the tuning-only trial phases are exempt: they
-   are harness stages, not pipeline stages).
+   are harness stages, not pipeline stages),
+9. IR-node consistency — the stage-graph IR's node vocabulary
+   (``spfft_tpu/ir/graph.py`` ``NODES``) matches ``obs.STAGES`` and
+   ``perf.MODELED_STAGES`` both ways: every IR node is a canonical,
+   perf-modeled stage, and every modeled engine stage is lowerable as an IR
+   node — an IR stage can never silently escape profiler attribution or
+   perf accounting (the same contract as SITES/EVENTS).
 
 Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
 """
@@ -533,6 +539,75 @@ def check_perf_stages(findings: list):
             )
 
 
+# The stage-graph IR's node vocabulary (spfft_tpu/ir/graph.py NODES): must
+# match obs.STAGES membership and perf.MODELED_STAGES exactly both ways —
+# the IR is the layer engines execute through, so a node outside the
+# canonical/modeled vocabularies would be a stage traces and perf reports
+# cannot account for, and a modeled stage missing from NODES would be a
+# pipeline stage the IR cannot express.
+IR_GRAPH_FILE = "spfft_tpu/ir/graph.py"
+
+
+def _canonical_ir_nodes() -> tuple:
+    """NODES from ir/graph.py via ast (import-free, like STAGES)."""
+    return _literal_tuple(IR_GRAPH_FILE, "NODES")
+
+
+def check_ir_nodes(findings: list):
+    stages = _canonical_stages()
+    modeled = _canonical_modeled_stages()
+    nodes = _canonical_ir_nodes()
+    if len(set(nodes)) != len(nodes):
+        findings.append(f"{IR_GRAPH_FILE}: duplicate entries in NODES")
+    for name in nodes:
+        if name not in stages:
+            findings.append(
+                f"{IR_GRAPH_FILE}: IR node {name!r} is not in the canonical "
+                f"stage list ({STAGES_FILE})"
+            )
+        if name not in modeled:
+            findings.append(
+                f"{IR_GRAPH_FILE}: IR node {name!r} carries no flop/byte "
+                f"model in {PERF_FILE} (MODELED_STAGES)"
+            )
+    for name in modeled:
+        if name not in nodes:
+            findings.append(
+                f"{PERF_FILE}: modeled stage {name!r} is not an IR node "
+                f"({IR_GRAPH_FILE} NODES) — the stage graph cannot express it"
+            )
+
+
+# The plan-card ``ir`` section schema (obs/plancard.py IR_SECTION_KEYS) is a
+# deliberate mirror of the source-of-truth literal in ir/compile.py IR_KEYS
+# (plancard stays import-free): the two tuples must be identical, or cards
+# missing a newly added key would silently pass schema validation.
+IR_COMPILE_FILE = "spfft_tpu/ir/compile.py"
+PLANCARD_FILE = "spfft_tpu/obs/plancard.py"
+
+
+def _literal_tuple(relpath: str, name: str) -> tuple:
+    """A module-level tuple literal via ast (import-free, like STAGES)."""
+    tree = ast.parse((ROOT / relpath).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise AssertionError(f"no {name} assignment in {relpath}")
+
+
+def check_ir_card_keys(findings: list):
+    ir_keys = _literal_tuple(IR_COMPILE_FILE, "IR_KEYS")
+    card_keys = _literal_tuple(PLANCARD_FILE, "IR_SECTION_KEYS")
+    if ir_keys != card_keys:
+        findings.append(
+            f"{PLANCARD_FILE}: IR_SECTION_KEYS {card_keys!r} does not match "
+            f"{IR_COMPILE_FILE} IR_KEYS {ir_keys!r} — the card validator "
+            f"would accept cards missing (or carrying stale) ir keys"
+        )
+
+
 def main() -> int:
     findings: list = []
     for path in iter_py_files():
@@ -545,6 +620,8 @@ def main() -> int:
     check_trace_events(findings)
     check_verify_checks(findings)
     check_perf_stages(findings)
+    check_ir_nodes(findings)
+    check_ir_card_keys(findings)
     for f in findings:
         print(f)
     if findings:

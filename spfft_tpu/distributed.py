@@ -50,6 +50,7 @@ class DistributedTransform:
         guard: bool | None = None,
         verify=None,
         overlap: int | None = None,
+        fuse=None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -127,6 +128,8 @@ class DistributedTransform:
         self._guard = faults.guard_enabled(guard)
         self._degradations: list = []
         self._tuning = None
+        # Fusion request (spfft_tpu.ir): engines resolve kwarg-else-env
+        self._fuse = fuse
         # Run ID (spfft_tpu.obs.trace): the correlation key joining this
         # plan's card, metrics and flight-recorder events; the "plan"
         # operation span keeps it active so tuning trials, ladder rungs and
@@ -219,7 +222,7 @@ class DistributedTransform:
                             MxuPencil2Execution(
                                 self._params, self._real_dtype, mesh,
                                 exchange_type, precision,
-                                overlap=overlap_chunks,
+                                overlap=overlap_chunks, fuse=fuse,
                             ),
                             "pencil2-mxu",
                         )
@@ -228,7 +231,7 @@ class DistributedTransform:
                     return (
                         Pencil2Execution(
                             self._params, self._real_dtype, mesh, exchange_type,
-                            overlap=overlap_chunks,
+                            overlap=overlap_chunks, fuse=fuse,
                         ),
                         "pencil2",
                     )
@@ -239,14 +242,14 @@ class DistributedTransform:
                     return (
                         MxuDistributedExecution(
                             self._params, self._real_dtype, mesh, exchange_type,
-                            precision, overlap=overlap_chunks,
+                            precision, overlap=overlap_chunks, fuse=fuse,
                         ),
                         "mxu",
                     )
                 return (
                     DistributedExecution(
                         self._params, self._real_dtype, mesh, exchange_type,
-                        overlap=overlap_chunks,
+                        overlap=overlap_chunks, fuse=fuse,
                     ),
                     "xla",
                 )
@@ -580,7 +583,14 @@ class DistributedTransform:
             guard=self._guard,
             verify=self._verify_mode,
             overlap=self.overlap_chunks,
+            fuse=self._fuse,
         )
+
+    @property
+    def fused(self) -> bool:
+        """Whether this plan executes through the IR-fused single shard_map
+        program per direction (see :attr:`Transform.fused`)."""
+        return bool(self._exec._ir.fused)
 
     def space_domain_data(self, processing_unit: ProcessingUnit | None = None):
         """Global trimmed space-domain array of the most recent result.

@@ -193,7 +193,9 @@ class ExecutionBase:
         rarely engages (XLA then treats the arg normally); the expected
         "donated buffers were not usable" warning is suppressed. The actual
         512^3 f64 memory fix is the x-stage chunking (ops/fft.f64_stage_chunks)
-        — see BASELINE.md.
+        — see BASELINE.md. Routed through the IR runtime: the fused program's
+        donating variant when fusion is active, the staged reference (which
+        materializes intermediates and cannot donate) otherwise.
         """
         import warnings
 
@@ -203,9 +205,22 @@ class ExecutionBase:
             )
             # engines with threaded rotation-table operands append them
             # (never donated; see execution_mxu.phase_operands)
-            return self._backward_consume(
+            return self._ir.run_backward_consuming(
                 values_re, values_im, *getattr(self, "phase_operands", ())
             )
+
+    def _ir_spec(self) -> dict:
+        """The :mod:`spfft_tpu.ir` compile-layer contract of the local
+        engines: plain jits, the packed value pair donatable on the consuming
+        backward, the engine's monolithic jits as the ``ir_lower_failed``
+        legacy rung."""
+        return {
+            "kind": "local",
+            "donate": (0, 1),
+            "legacy_backward": self._backward,
+            "legacy_backward_consuming": self._backward_consume,
+            "legacy_forward": self._forward,
+        }
 
 
 class LocalExecution(ExecutionBase):
@@ -216,7 +231,10 @@ class LocalExecution(ExecutionBase):
     the multiply fuses into the gather).
     """
 
-    def __init__(self, params: LocalParameters, real_dtype=np.float64, device=None):
+    def __init__(
+        self, params: LocalParameters, real_dtype=np.float64, device=None,
+        fuse=None,
+    ):
         super().__init__(params, real_dtype, device)
         p = params
         # Index constants stay as numpy: jit embeds them as program constants,
@@ -232,6 +250,13 @@ class LocalExecution(ExecutionBase):
             s: jax.jit(functools.partial(self._forward_impl, scale=self._scale_for(s)))
             for s in (ScalingType.NONE, ScalingType.FULL)
         }
+        # Stage-graph IR (spfft_tpu.ir): the pipeline lowered to a validated
+        # stage graph, fused into one jitted program per direction (or run
+        # per-stage under SPFFT_TPU_FUSE=0); the monolithic jits above remain
+        # the ir_lower_failed rung and the unjitted trace composition.
+        from .ir.compile import init_engine_ir
+
+        self._ir = init_engine_ir(self, fuse)
 
     # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
 
@@ -247,84 +272,132 @@ class LocalExecution(ExecutionBase):
         v = jax.ShapeDtypeStruct((self.params.num_values,), self.real_dtype)
         return self._backward.lower(v, v)
 
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One implementation per stage, shared by the hand-ordered monolithic
+    # impls below (the ir_lower_failed rung + trace composition) and the IR
+    # node fns lowered from this engine (spfft_tpu.ir.lower) — the stage
+    # math lives exactly once.
+
+    def _st_decompress(self, values_re, values_im):
+        p = self.params
+        values = jax.lax.complex(
+            values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
+        )
+        return compression.decompress(
+            values, self._value_indices, p.num_sticks, p.dim_z
+        )
+
+    def _st_stick_symmetry(self, sticks):
+        return symmetry.apply_stick_symmetry(sticks, self._zero_stick_id)
+
+    def _st_z_backward(self, sticks):
+        return jnp.fft.ifft(sticks, axis=1)
+
+    def _st_expand(self, sticks):
+        # Stick -> plane relayout: scatter each z-stick into its (y, x)
+        # column of the dense slab (the local transpose, reference:
+        # src/transpose/transpose_host.hpp:50-161).
+        p = self.params
+        grid = jnp.zeros(
+            (p.dim_z, p.dim_y, p.dim_x_freq), dtype=self.complex_dtype
+        )
+        return grid.at[:, self._stick_y, self._stick_x].set(
+            sticks.T, mode="drop", unique_indices=True
+        )
+
+    def _st_plane_symmetry(self, grid):
+        return symmetry.apply_plane_symmetry(grid)
+
+    def _st_y_backward(self, grid):
+        return jnp.fft.ifft(grid, axis=1)
+
+    def _st_x_backward(self, grid):
+        # Undo ifft's 1/N normalization: the backward transform is
+        # unnormalized (reference: docs/source/details.rst:42-44).
+        p = self.params
+        total = np.asarray(p.total_size, dtype=self.real_dtype)
+        if self.is_r2c:
+            out = jnp.fft.irfft(grid, n=p.dim_x, axis=2).astype(self.real_dtype)
+            return out * total
+        out = jnp.fft.ifft(grid, axis=2) * total
+        return out.real, out.imag
+
+    def _st_x_forward(self, space_re, space_im):
+        p = self.params
+        if self.is_r2c:
+            grid = jnp.fft.rfft(space_re.astype(self.real_dtype), n=p.dim_x, axis=2)
+            return grid.astype(self.complex_dtype)
+        space = jax.lax.complex(
+            space_re.astype(self.real_dtype), space_im.astype(self.real_dtype)
+        )
+        return jnp.fft.fft(space, axis=2)
+
+    def _st_y_forward(self, grid):
+        return jnp.fft.fft(grid, axis=1)
+
+    def _st_pack(self, grid):
+        # Plane -> stick gather (forward local transpose).
+        return grid[:, self._stick_y, self._stick_x].T
+
+    def _st_z_forward(self, sticks):
+        return jnp.fft.fft(sticks, axis=1)
+
+    def _st_compress(self, sticks, scale):
+        values = compression.compress(sticks, self._value_indices, scale)
+        return values.real.astype(self.real_dtype), values.imag.astype(
+            self.real_dtype
+        )
+
     # ---- pipelines (traced; complex internal, real pairs at the boundary) -----
 
     def _backward_impl(self, values_re, values_im):
-        p = self.params
         # stage scopes: canonical obs.STAGES labels (profiler attribution)
         with jax.named_scope("compression"):
-            values = jax.lax.complex(
-                values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
-            )
-            sticks = compression.decompress(
-                values, self._value_indices, p.num_sticks, p.dim_z
-            )
+            sticks = self._st_decompress(values_re, values_im)
         if self.is_r2c:
             with jax.named_scope("stick symmetry"):
-                sticks = symmetry.apply_stick_symmetry(sticks, self._zero_stick_id)
+                sticks = self._st_stick_symmetry(sticks)
         with jax.named_scope("z transform"):
-            sticks = jnp.fft.ifft(sticks, axis=1)
+            sticks = self._st_z_backward(sticks)
 
-        # Stick -> plane relayout: scatter each z-stick into its (y, x) column of the
-        # dense slab (the local transpose, reference: src/transpose/transpose_host.hpp:50-161).
         with jax.named_scope("expand"):
-            grid = jnp.zeros((p.dim_z, p.dim_y, p.dim_x_freq), dtype=self.complex_dtype)
-            grid = grid.at[:, self._stick_y, self._stick_x].set(
-                sticks.T, mode="drop", unique_indices=True
-            )
+            grid = self._st_expand(sticks)
 
         if self.is_r2c:
             with jax.named_scope("plane symmetry"):
-                grid = symmetry.apply_plane_symmetry(grid)
+                grid = self._st_plane_symmetry(grid)
         with jax.named_scope("y transform"):
-            grid = jnp.fft.ifft(grid, axis=1)
-        # Undo ifft's 1/N normalization: the backward transform is unnormalized
-        # (reference: docs/source/details.rst:42-44).
-        total = np.asarray(p.total_size, dtype=self.real_dtype)
+            grid = self._st_y_backward(grid)
         with jax.named_scope("x transform"):
-            if self.is_r2c:
-                out = jnp.fft.irfft(grid, n=p.dim_x, axis=2).astype(self.real_dtype)
-                return out * total
-            out = jnp.fft.ifft(grid, axis=2) * total
-            return out.real, out.imag
+            return self._st_x_backward(grid)
 
     def _forward_impl(self, space_re, space_im, scale):
-        p = self.params
         with jax.named_scope("x transform"):
-            if self.is_r2c:
-                grid = jnp.fft.rfft(space_re.astype(self.real_dtype), n=p.dim_x, axis=2)
-                grid = grid.astype(self.complex_dtype)
-            else:
-                space = jax.lax.complex(
-                    space_re.astype(self.real_dtype), space_im.astype(self.real_dtype)
-                )
-                grid = jnp.fft.fft(space, axis=2)
+            grid = self._st_x_forward(space_re, space_im)
         with jax.named_scope("y transform"):
-            grid = jnp.fft.fft(grid, axis=1)
+            grid = self._st_y_forward(grid)
 
-        # Plane -> stick gather (forward local transpose).
         with jax.named_scope("pack"):
-            sticks = grid[:, self._stick_y, self._stick_x].T
+            sticks = self._st_pack(grid)
 
         with jax.named_scope("z transform"):
-            sticks = jnp.fft.fft(sticks, axis=1)
+            sticks = self._st_z_forward(sticks)
         with jax.named_scope("compression"):
-            values = compression.compress(sticks, self._value_indices, scale)
-            return values.real.astype(self.real_dtype), values.imag.astype(
-                self.real_dtype
-            )
+            return self._st_compress(sticks, scale)
 
     # ---- device-side entry points (pair-form, no host transfers) --------------
 
     def backward_pair(self, values_re, values_im):
-        """freq pair -> space; returns (re, im) pair for C2C, a real array for R2C."""
-        return self._backward(values_re, values_im)
+        """freq pair -> space; returns (re, im) pair for C2C, a real array for R2C.
+        Routed through the IR runtime (fused single program by default, the
+        staged per-node reference under ``SPFFT_TPU_FUSE=0``)."""
+        return self._ir.run_backward(values_re, values_im)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         """space -> freq pair. ``space_im`` is ignored (may be None) for R2C."""
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)  # placeholder, R2C only
-        return self._forward[ScalingType(scaling)](space_re, space_im)
+        return self._ir.run_forward(ScalingType(scaling), space_re, space_im)
 
     # Un-jitted traceables for composition into larger jitted programs (e.g.
     # the benchmark's scan chain): a jit boundary inside a scan body blocks
@@ -353,7 +426,7 @@ class LocalExecution(ExecutionBase):
     def backward(self, values):
         """freq (num_values,) complex -> space (dim_z, dim_y, dim_x)."""
         re, im = as_pair(values, self.real_dtype)
-        return self._backward(self.put(re), self.put(im))
+        return self.backward_pair(self.put(re), self.put(im))
 
     def forward(self, space, scaling: ScalingType = ScalingType.NONE):
         """space (dim_z, dim_y, dim_x) -> freq (num_values,) as a (re, im) pair."""

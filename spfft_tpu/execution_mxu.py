@@ -49,7 +49,7 @@ class MxuLocalExecution(ExecutionBase):
 
     def __init__(
         self, params: LocalParameters, real_dtype=np.float32, device=None,
-        precision="highest",
+        precision="highest", fuse=None,
     ):
         super().__init__(params, real_dtype, device)
         p = params
@@ -225,6 +225,10 @@ class MxuLocalExecution(ExecutionBase):
         # dead after the call); see ExecutionBase.backward_pair_consuming for
         # when the alias can actually engage.
         self._backward_consume = jax.jit(self._backward_impl, donate_argnums=(0, 1))
+        # Stage-graph IR (spfft_tpu.ir): see LocalExecution.__init__.
+        from .ir.compile import init_engine_ir
+
+        self._ir = init_engine_ir(self, fuse)
 
     # ---- introspection (spfft_tpu.obs plan cards) -----------------------------
 
@@ -391,150 +395,205 @@ class MxuLocalExecution(ExecutionBase):
             gim = jnp.pad(gim, ((0, 0), (0, padw), (0, 0)))
         return gre, gim
 
-    def _backward_impl(self, values_re, values_im, *phase):
-        p = self.params
-        rt = self.real_dtype
-        values_re = values_re.astype(rt)
-        values_im = values_im.astype(rt)
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One implementation per stage, shared by the hand-ordered monolithic
+    # impls below and the IR node fns lowered from this engine
+    # (spfft_tpu.ir.lower). The threaded plan operands ride through as the
+    # opaque ``phase`` tuple; each stage splits off what it needs.
 
+    def _st_decompress(self, values_re, values_im):
+        rt = self.real_dtype
+        return self._decompress(values_re.astype(rt), values_im.astype(rt))
+
+    def _st_stick_symmetry(self, sre, sim):
+        i = self._zero_stick_id
+        fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+        return sre.at[i].set(fre), sim.at[i].set(fim)
+
+    def _st_z_backward(self, sre, sim, phase):
+        phase_ops, _ = self._split_operands(phase)
+        sre, sim = offt.complex_matmul(
+            sre, sim, *self._wz_b, "sz,zk->sk", self._precision
+        )
+        if self._phase is not None:
+            # undo the alignment rotations (fused multiply)
+            cos_t, sin_t = self._phase_tables(phase_ops)
+            sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+        return sre, sim
+
+    def _st_y_sparse_backward(self, sre, sim):
+        # per-slot y contraction straight off the stick table: no expand,
+        # y-DFT rows gathered per slot into the matrix constants
+        A, Sy, Z = self._num_x_active, self._sy, self.params.dim_z
+        return offt.complex_matmul(
+            sre.reshape(A, Sy, Z), sim.reshape(A, Sy, Z),
+            *self._wy_b_sp, "ajz,ajk->kaz", self._precision,
+        )
+
+    def _st_y_blocked_backward(self, sre, sim, phase):
+        _, mat_ops = self._split_operands(phase)
+        return self._blocked_y_backward(sre, sim, mat_ops)
+
+    def _st_plane_symmetry(self, gre, gim):
+        s = self._x0_slot
+        pre, pim = symmetry.hermitian_fill_1d_pair(
+            gre[:, s, :], gim[:, s, :], axis=0
+        )
+        return gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
+
+    def _st_y_dense_backward(self, gre, gim):
+        return offt.complex_matmul(
+            gre, gim, *self._wy_b, "yxz,yk->kxz", self._precision
+        )
+
+    def _st_x_backward(self, gre, gim):
+        prec = self._precision
+        if self.is_r2c:
+            fn = lambda r, i: offt.real_out_matmul(
+                r, i, *self._wx_b, "kxz,xl->klz", prec
+            )
+        else:
+            fn = lambda r, i: offt.complex_matmul(
+                r, i, *self._wx_b, "kxz,xl->klz", prec
+            )
+        return offt.map_chunked(fn, (gre, gim), self._x_stage_chunks)
+
+    def _st_x_forward(self, space_re, space_im):
+        rt = self.real_dtype
+        prec = self._precision
+        if self.is_r2c:
+            return offt.map_chunked(
+                lambda s: offt.real_in_matmul(s, *self._wx_f, "yxz,xk->ykz", prec),
+                (space_re.astype(rt),),
+                self._x_stage_chunks,
+            )
+        return offt.map_chunked(
+            lambda r, i: offt.complex_matmul(
+                r, i, *self._wx_f, "yxz,xk->ykz", prec
+            ),
+            (space_re.astype(rt), space_im.astype(rt)),
+            self._x_stage_chunks,
+        )
+
+    def _st_y_sparse_forward(self, gre, gim):
+        # per-slot y contraction straight into the stick table: the pack
+        # gather disappears (output rows ARE the table rows)
+        p = self.params
+        sre, sim = offt.complex_matmul(
+            gre, gim, *self._wy_f_sp, "yaz,ajy->ajz", self._precision
+        )
+        R = self._table_rows
+        return sre.reshape(R, p.dim_z), sim.reshape(R, p.dim_z)
+
+    def _blocked_y_forward(self, gre, gim, mat_ops):
+        """Blocked sparse-y forward stage: per-bucket contractions into
+        bucket flats, one regather to exact stick rows (replacing the pack
+        gather) — the forward mirror of :meth:`_blocked_y_backward`."""
+        p = self.params
+        prec = self._precision
+        Z = p.dim_z
+        flats_re, flats_im = [], []
+        col = 0
+        for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
+            Ag, Syg = row_idx.shape
+            wyf = self._bucket_mats(mat_ops, b, forward=True)
+            fre, fim = offt.complex_matmul(
+                gre[:, col : col + Ag, :], gim[:, col : col + Ag, :],
+                *wyf, "yaz,ajy->ajz", prec,
+            )
+            flats_re.append(fre.reshape(Ag * Syg, Z))
+            flats_im.append(fim.reshape(Ag * Syg, Z))
+            col += Ag
+        rs = jnp.asarray(self._sy_row_of_stick)
+        return (
+            jnp.concatenate(flats_re, axis=0)[rs],
+            jnp.concatenate(flats_im, axis=0)[rs],
+        )
+
+    def _st_y_blocked_forward(self, gre, gim, phase):
+        _, mat_ops = self._split_operands(phase)
+        return self._blocked_y_forward(gre, gim, mat_ops)
+
+    def _st_y_dense_forward(self, gre, gim):
+        return offt.complex_matmul(
+            gre, gim, *self._wy_f, "ykz,yl->lkz", self._precision
+        )
+
+    def _st_pack(self, gre, gim):
+        p = self.params
+        flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
+        flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
+        keys = jnp.asarray(self._stick_keys)
+        return jnp.take(flat_re, keys, axis=0), jnp.take(flat_im, keys, axis=0)
+
+    def _st_z_forward(self, sre, sim, phase, scaling):
+        phase_ops, _ = self._split_operands(phase)
+        if self._phase is not None:
+            # enter the rotated layout on the space side (fused multiply)
+            cos_t, sin_t = self._phase_tables(phase_ops)
+            sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
+        return offt.complex_matmul(
+            sre, sim, *self._wz_f[scaling], "sz,zk->sk", self._precision
+        )
+
+    def _backward_impl(self, values_re, values_im, *phase):
         with jax.named_scope("compression"):
-            sre, sim = self._decompress(values_re, values_im)
+            sre, sim = self._st_decompress(values_re, values_im)
         if self.is_r2c and self._zero_stick_id is not None:
             with jax.named_scope("stick symmetry"):
-                i = self._zero_stick_id
-                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
-                sre, sim = sre.at[i].set(fre), sim.at[i].set(fim)
+                sre, sim = self._st_stick_symmetry(sre, sim)
 
-        prec = self._precision
-        phase_ops, mat_ops = self._split_operands(phase)
         with jax.named_scope("z transform"):
-            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-            if self._phase is not None:
-                # undo the alignment rotations (fused multiply)
-                cos_t, sin_t = self._phase_tables(phase_ops)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+            sre, sim = self._st_z_backward(sre, sim, phase)
         if self._sparse_y:
-            # per-slot y contraction straight off the stick table: no expand,
-            # y-DFT rows gathered per slot into the matrix constants
             with jax.named_scope("y transform sparse"):
-                A, Sy, Z = self._num_x_active, self._sy, p.dim_z
-                gre, gim = offt.complex_matmul(
-                    sre.reshape(A, Sy, Z), sim.reshape(A, Sy, Z),
-                    *self._wy_b_sp, "ajz,ajk->kaz", prec,
-                )
+                gre, gim = self._st_y_sparse_backward(sre, sim)
         elif self._sparse_y_blocked is not None:
             with jax.named_scope("y transform blocked"):
-                gre, gim = self._blocked_y_backward(sre, sim, mat_ops)
+                gre, gim = self._st_y_blocked_backward(sre, sim, phase)
         else:
             with jax.named_scope("expand"):
                 gre, gim = self._expand(sre, sim)
 
             if self.is_r2c and self._x0_slot is not None:
                 with jax.named_scope("plane symmetry"):
-                    s = self._x0_slot
-                    pre, pim = symmetry.hermitian_fill_1d_pair(
-                        gre[:, s, :], gim[:, s, :], axis=0
-                    )
-                    gre, gim = gre.at[:, s, :].set(pre), gim.at[:, s, :].set(pim)
+                    gre, gim = self._st_plane_symmetry(gre, gim)
 
             with jax.named_scope("y transform"):
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_b, "yxz,yk->kxz", prec
-                )
+                gre, gim = self._st_y_dense_backward(gre, gim)
         with jax.named_scope("x transform"):
-            if self.is_r2c:
-                fn = lambda r, i: offt.real_out_matmul(
-                    r, i, *self._wx_b, "kxz,xl->klz", prec
-                )
-            else:
-                fn = lambda r, i: offt.complex_matmul(
-                    r, i, *self._wx_b, "kxz,xl->klz", prec
-                )
-            return offt.map_chunked(fn, (gre, gim), self._x_stage_chunks)
+            return self._st_x_backward(gre, gim)
 
     def _forward_impl(self, space_re, space_im, *phase, scaling):
-        rt = self.real_dtype
-        prec = self._precision
-        phase_ops, mat_ops = self._split_operands(phase)
         with jax.named_scope("x transform"):
-            if self.is_r2c:
-                gre, gim = offt.map_chunked(
-                    lambda s: offt.real_in_matmul(s, *self._wx_f, "yxz,xk->ykz", prec),
-                    (space_re.astype(rt),),
-                    self._x_stage_chunks,
-                )
-            else:
-                gre, gim = offt.map_chunked(
-                    lambda r, i: offt.complex_matmul(
-                        r, i, *self._wx_f, "yxz,xk->ykz", prec
-                    ),
-                    (space_re.astype(rt), space_im.astype(rt)),
-                    self._x_stage_chunks,
-                )
-        p = self.params
+            gre, gim = self._st_x_forward(space_re, space_im)
         if self._sparse_y:
-            # per-slot y contraction straight into the stick table: the pack
-            # gather disappears (output rows ARE the table rows)
             with jax.named_scope("y transform sparse"):
-                sre, sim = offt.complex_matmul(
-                    gre, gim, *self._wy_f_sp, "yaz,ajy->ajz", prec
-                )
-                R = self._table_rows
-                sre = sre.reshape(R, p.dim_z)
-                sim = sim.reshape(R, p.dim_z)
+                sre, sim = self._st_y_sparse_forward(gre, gim)
         elif self._sparse_y_blocked is not None:
-            # blocked sparse-y: per-bucket contractions into bucket flats, one
-            # regather to exact stick rows (replacing the pack gather)
             with jax.named_scope("y transform blocked"):
-                Z = p.dim_z
-                flats_re, flats_im = [], []
-                col = 0
-                for b, (row_idx, _, _) in enumerate(self._sparse_y_blocked):
-                    Ag, Syg = row_idx.shape
-                    wyf = self._bucket_mats(mat_ops, b, forward=True)
-                    fre, fim = offt.complex_matmul(
-                        gre[:, col : col + Ag, :], gim[:, col : col + Ag, :],
-                        *wyf, "yaz,ajy->ajz", prec,
-                    )
-                    flats_re.append(fre.reshape(Ag * Syg, Z))
-                    flats_im.append(fim.reshape(Ag * Syg, Z))
-                    col += Ag
-                rs = jnp.asarray(self._sy_row_of_stick)
-                sre = jnp.concatenate(flats_re, axis=0)[rs]
-                sim = jnp.concatenate(flats_im, axis=0)[rs]
+                sre, sim = self._st_y_blocked_forward(gre, gim, phase)
         else:
             with jax.named_scope("y transform"):
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_f, "ykz,yl->lkz", prec
-                )
+                gre, gim = self._st_y_dense_forward(gre, gim)
             with jax.named_scope("pack"):
-                flat_re = gre.reshape(p.dim_y * self._num_x_active, p.dim_z)
-                flat_im = gim.reshape(p.dim_y * self._num_x_active, p.dim_z)
-                keys = jnp.asarray(self._stick_keys)
-                sre = jnp.take(flat_re, keys, axis=0)
-                sim = jnp.take(flat_im, keys, axis=0)
+                sre, sim = self._st_pack(gre, gim)
 
         with jax.named_scope("z transform"):
-            if self._phase is not None:
-                # enter the rotated layout on the space side (fused multiply)
-                cos_t, sin_t = self._phase_tables(phase_ops)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
-            sre, sim = offt.complex_matmul(
-                sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
-            )
+            sre, sim = self._st_z_forward(sre, sim, phase, scaling)
         with jax.named_scope("compression"):
             return self._compress(sre, sim)
 
     # ---- boundary API (pair-form, native layout) ------------------------------
 
     def backward_pair(self, values_re, values_im):
-        return self._backward(values_re, values_im, *self.phase_operands)
+        return self._ir.run_backward(values_re, values_im, *self.phase_operands)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         if space_im is None:
             space_im = jnp.zeros((0,), dtype=self.real_dtype)
-        return self._forward[ScalingType(scaling)](
-            space_re, space_im, *self.phase_operands
+        return self._ir.run_forward(
+            ScalingType(scaling), space_re, space_im, *self.phase_operands
         )
 
     # Un-jitted traceables for composition into larger jitted programs (see
@@ -560,7 +619,7 @@ class MxuLocalExecution(ExecutionBase):
 
     def backward(self, values):
         re, im = as_pair(values, self.real_dtype)
-        out = self._backward(self.put(re), self.put(im), *self.phase_operands)
+        out = self.backward_pair(self.put(re), self.put(im))
         if self.is_r2c:
             return self.fetch(out).transpose(2, 0, 1)
         return self.fetch_space_complex(out).transpose(2, 0, 1)

@@ -50,6 +50,7 @@ from .ladder import (  # noqa: F401
     ENGINE_BUILD_ERRORS,
     backoff_s,
     collecting,
+    current_sink,
     engine_fallback,
     record_degradation,
     summarize,

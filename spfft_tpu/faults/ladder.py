@@ -88,6 +88,16 @@ def collecting(sink: list):
         stack.pop()
 
 
+def current_sink():
+    """The innermost :func:`collecting` sink, or ``None`` — lets a component
+    constructed inside a plan's collecting scope keep recording onto that
+    plan's live ``degradations`` list after the scope exits (runtime rungs:
+    :class:`spfft_tpu.ir.compile.EngineIr`'s first-dispatch
+    ``fuse_compile_failed``)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
 def record_degradation(event: str, reason: str, **extra) -> dict:
     """Record one degradation: count ``degradations_total{event=...}`` and
     append ``{"event", "reason", **extra}`` to the innermost

@@ -63,6 +63,8 @@ SITES = (
     "wisdom.save",
     "engine.compile",
     "engine.execute",
+    "ir.lower",
+    "ir.compile",
     "exchange.build",
     "hlo.stats",
     "sync.fence",

@@ -196,6 +196,7 @@ class Grid:
         guard: bool | None = None,
         verify=None,
         overlap: int | None = None,
+        fuse=None,
     ):
         """Create a transform bound to this grid.
 
@@ -230,6 +231,7 @@ class Grid:
                 guard=guard,
                 verify=verify,
                 overlap=overlap,
+                fuse=fuse,
             )
         if overlap is not None:
             raise InvalidParameterError(
@@ -255,4 +257,5 @@ class Grid:
             policy=policy,
             guard=guard,
             verify=verify,
+            fuse=fuse,
         )

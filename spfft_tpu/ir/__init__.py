@@ -1,0 +1,42 @@
+"""spfft_tpu.ir — stage-graph IR with per-direction fusion.
+
+The pipeline-structure layer between the engines and XLA (ROADMAP item 3):
+
+1. **Graph** (:mod:`.graph`): a small typed stage-graph IR whose nodes are
+   the canonical pipeline stages (:data:`NODES` — the engine subset of
+   ``obs.STAGES``, lint-enforced both ways against the profiler and perf
+   vocabularies) with dtype/shape metadata on edges and typed validation —
+   unknown stage, dangling edge, dtype mismatch, and cycles raise before
+   anything compiles.
+2. **Lowering** (:mod:`.lower`): all six engines describe their
+   per-direction pipelines as stage graphs built from the same extracted
+   stage bodies their monolithic impls call; the OVERLAPPED exchange
+   discipline is applied as a *graph rewrite* (split the exchange node into
+   C chunk chains pipelined against the neighbor FFT nodes) instead of
+   hand-threaded loop code.
+3. **Compile** (:mod:`.compile`): the fusion pass emits ONE jitted program
+   per direction (donated value buffers on the local consuming flow;
+   decompress/compress scatter-gathers fused inside — no materialized
+   dense-stick intermediate crosses a dispatch boundary), selectable via
+   ``SPFFT_TPU_FUSE=0|1`` / ``fuse=`` kwarg with the staged per-node
+   dispatch path as the reference and fallback. Fault sites ``ir.lower`` /
+   ``ir.compile`` feed the degradation ladder: a failed lowering runs the
+   legacy monolithic jits, a failed fusion compile runs the staged path —
+   never a failed plan.
+
+Plan cards carry a schema-pinned ``ir`` section (stage lists per direction,
+fusion decision, donation map); the ``fused`` vs ``staged`` (and
+bf16-twiddle mixed-precision) variants are autotuner candidates under
+``policy="tuned"`` (:mod:`spfft_tpu.tuning.candidates`).
+"""
+from .graph import NODES, EdgeMeta, Node, StageGraph  # noqa: F401
+from .compile import (  # noqa: F401
+    FUSE_ENV,
+    IR_KEYS,
+    EngineIr,
+    StagedProgram,
+    compose,
+    init_engine_ir,
+    resolve_fuse,
+)
+from .lower import lower_engine  # noqa: F401

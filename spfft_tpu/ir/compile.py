@@ -1,0 +1,467 @@
+"""Compile stage graphs: the fusion pass and the staged reference executor.
+
+Two ways to run a lowered :class:`~spfft_tpu.ir.graph.StageGraph`:
+
+* **Fused** (the default, ``SPFFT_TPU_FUSE=1`` / ``fuse=True``):
+  :func:`compose` folds the whole graph into one traceable function —
+  topological order, each node wrapped in its canonical ``jax.named_scope``
+  — and the builders emit **ONE** ``jax.jit``-compiled program per direction
+  (wrapped in the engine's ``shard_map`` for mesh graphs). The sparse
+  scatter/gather of decompress/compress fuse *inside* the program with the
+  DFT stages: no materialized dense-stick intermediate ever crosses a
+  dispatch boundary, and the local builders additionally emit a
+  buffer-donating variant (``donate_argnums`` on the packed value pair) for
+  the host-facing consuming flow.
+
+* **Staged** (``SPFFT_TPU_FUSE=0``): every node becomes its own jitted
+  dispatch with materialized intermediates — the debuggable reference path
+  the fused program is parity-checked against (``tests/test_ir.py``), and
+  the fallback rung when fusion fails to build (fault site ``ir.compile`` →
+  ``fuse_compile_failed`` on the plan card — never a failed plan).
+
+:class:`EngineIr` is the per-engine runtime router every engine constructs
+(:func:`init_engine_ir`): it owns the lowering→validation→fusion ladder, the
+degradation rungs (``ir_lower_failed`` falls back to the engine's legacy
+monolithic jits, which remain the reference composition), the
+``ir_dispatches_total{mode}`` accounting that the dispatch-count tests read,
+and the schema-pinned ``ir`` plan-card section (stage lists, fusion
+decision, donation map).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..errors import InvalidParameterError
+
+FUSE_ENV = "SPFFT_TPU_FUSE"
+
+# plan-card ``ir`` section schema floor (obs.plancard pins it)
+IR_KEYS = ("fused", "path", "requested", "stages", "donation")
+
+
+def resolve_fuse(fuse=None):
+    """Resolve the fusion knob: explicit ``fuse=`` kwarg wins, else
+    ``SPFFT_TPU_FUSE`` (default fused). Returns ``(fused, source)`` with
+    ``source`` in {"kwarg", "env", "default"}; a malformed env value raises
+    typed (the knob-validation contract every SPFFT_TPU_* knob follows)."""
+    if fuse is not None:
+        if not isinstance(fuse, (bool, int)) or fuse not in (0, 1):
+            raise InvalidParameterError(
+                f"fuse= must be a bool (or 0/1), got {fuse!r}"
+            )
+        return bool(fuse), "kwarg"
+    raw = os.environ.get(FUSE_ENV)
+    if raw is None:
+        return True, "default"
+    if raw not in ("0", "1"):
+        raise InvalidParameterError(
+            f"{FUSE_ENV} must be 0 or 1, got {raw!r}"
+        )
+    return raw == "1", "env"
+
+
+def compose(graph):
+    """Fold a validated graph into one traceable function.
+
+    The returned ``fn(*args)`` binds ``args`` to the graph's declared input
+    edges in order (a trailing varargs edge — ``graph.varargs`` — collects
+    the rest as a tuple: the local MXU engine's threaded plan operands),
+    executes nodes topologically with each body under its canonical
+    ``jax.named_scope``, and returns the declared output edge values (a bare
+    value for a single output, a tuple otherwise). Tracing ``fn`` once under
+    ``jax.jit`` IS the fusion pass: XLA sees the whole direction as one
+    program."""
+    order = graph.toposort()
+    names = list(graph.inputs)
+    varargs = bool(getattr(graph, "varargs", False))
+
+    def fn(*args):
+        if varargs:
+            fixed = names[:-1]
+            if len(args) < len(fixed):
+                raise InvalidParameterError(
+                    f"ir[{graph.direction}]: expected at least {len(fixed)} "
+                    f"inputs ({fixed} + *{names[-1]}), got {len(args)}"
+                )
+            env = dict(zip(fixed, args[: len(fixed)]))
+            env[names[-1]] = tuple(args[len(fixed) :])
+        else:
+            if len(args) != len(names):
+                raise InvalidParameterError(
+                    f"ir[{graph.direction}]: expected {len(names)} inputs "
+                    f"({names}), got {len(args)}"
+                )
+            env = dict(zip(names, args))
+        for node in order:
+            ins = [env[e] for e in node.inputs]
+            with jax.named_scope(node.stage):
+                out = node.fn(*ins)
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = out
+            else:
+                for e, v in zip(node.outputs, out):
+                    env[e] = v
+        outs = tuple(env[e] for e in graph.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    return fn
+
+
+def _mesh_spec(meta, axes):
+    """Partition spec of one distributed edge: sharded over ``axes`` on the
+    (implicit) leading block dimension, replicated elsewhere — derived from
+    the edge's per-shard rank, the single rule every mesh edge follows."""
+    from jax.sharding import PartitionSpec as P
+
+    rank = meta.rank()
+    if rank is None:
+        raise InvalidParameterError(
+            "ir: mesh graphs need shaped edges to derive partition specs"
+        )
+    ax = axes[0] if len(axes) == 1 else tuple(axes)
+    return P(ax, *([None] * rank))
+
+
+def _block_adapter(fn, n_out):
+    """Mesh boundary adapter: strip the per-shard block dim off every input,
+    restore it on every output — node fns and composed graphs are written in
+    per-shard terms, while ``shard_map`` blocks carry the leading axis."""
+
+    def wrapped(*args):
+        out = fn(*[a[0] for a in args])
+        if n_out == 1:
+            return out[None]
+        return tuple(o[None] for o in out)
+
+    return wrapped
+
+
+def build_fused(graph, spec):
+    """The fusion pass: one jitted program for ``graph``.
+
+    Local graphs jit the composition directly and (when ``spec`` names
+    donatable inputs) also build the buffer-donating variant. Mesh graphs
+    wrap the composition in the engine's ``shard_map`` with specs derived
+    from edge metadata. Returns ``{"call", "consuming"|None}``."""
+    fn = compose(graph)
+    if spec["kind"] == "local":
+        call = jax.jit(fn)
+        donate = spec.get("donate") if graph.direction == "backward" else None
+        consuming = (
+            jax.jit(fn, donate_argnums=tuple(donate)) if donate else None
+        )
+        return {"call": call, "consuming": consuming}
+    axes = spec["axes"]
+    in_specs = tuple(_mesh_spec(graph.meta[e], axes) for e in graph.inputs)
+    outs = tuple(_mesh_spec(graph.meta[e], axes) for e in graph.outputs)
+    out_specs = outs[0] if len(outs) == 1 else outs
+    mapped = spec["sm"](
+        _block_adapter(fn, len(graph.outputs)),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    return {"call": jax.jit(mapped), "consuming": None}
+
+
+class StagedProgram:
+    """The per-stage reference executor: every node is its own jitted
+    dispatch (its own ``shard_map`` program on mesh graphs), intermediates
+    materialize between dispatches. Slower by construction — its value is
+    being the debuggable, fusion-free reference the fused program must match
+    bit-for-bit-modulo-reassociation, and the fallback when fusion cannot
+    compile."""
+
+    def __init__(self, graph, spec):
+        self.graph = graph
+        self.order = graph.toposort()
+        self._calls = {}
+        for node in self.order:
+            body = self._scoped(node)
+            if spec["kind"] == "local":
+                self._calls[node.name] = jax.jit(body)
+            else:
+                axes = spec["axes"]
+                in_specs = tuple(
+                    _mesh_spec(graph.meta[e], axes) for e in node.inputs
+                )
+                outs = tuple(
+                    _mesh_spec(graph.meta[e], axes) for e in node.outputs
+                )
+                out_specs = outs[0] if len(outs) == 1 else outs
+                self._calls[node.name] = jax.jit(
+                    spec["sm"](
+                        _block_adapter(body, len(node.outputs)),
+                        in_specs=in_specs,
+                        out_specs=out_specs,
+                    )
+                )
+
+    @staticmethod
+    def _scoped(node):
+        def body(*args, _node=node):
+            with jax.named_scope(_node.stage):
+                return _node.fn(*args)
+
+        return body
+
+    @property
+    def num_dispatches(self) -> int:
+        return len(self.order)
+
+    def __call__(self, *args):
+        from .. import obs
+
+        g = self.graph
+        names = list(g.inputs)
+        if getattr(g, "varargs", False):
+            fixed = names[:-1]
+            if len(args) < len(fixed):
+                raise InvalidParameterError(
+                    f"ir[{g.direction}]: expected at least {len(fixed)} "
+                    f"inputs ({fixed} + *{names[-1]}), got {len(args)}"
+                )
+            env = dict(zip(fixed, args[: len(fixed)]))
+            env[names[-1]] = tuple(args[len(fixed) :])
+        else:
+            if len(args) != len(names):
+                raise InvalidParameterError(
+                    f"ir[{g.direction}]: expected {len(names)} inputs "
+                    f"({names}), got {len(args)}"
+                )
+            env = dict(zip(names, args))
+        counter = obs.counter(
+            "ir_dispatches_total", mode="staged", direction=g.direction
+        )
+        for node in self.order:
+            ins = [env[e] for e in node.inputs]
+            out = self._calls[node.name](*ins)
+            counter.inc()
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = out
+            else:
+                for e, v in zip(node.outputs, out):
+                    env[e] = v
+        outs = tuple(env[e] for e in g.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+
+class EngineIr:
+    """Per-engine IR runtime: graphs, compiled programs, and the routing +
+    accounting for ``backward_pair``/``forward_pair``. Built by
+    :func:`init_engine_ir`; engines delegate their device-side entry points
+    here with their canonical operand tuples."""
+
+    def __init__(self, spec, graphs, *, path, requested, sink=None):
+        self.spec = spec
+        self.graphs = graphs  # {"backward": g, "forward": {ScalingType: g}}
+        self.path = path  # "fused" | "staged" | "legacy"
+        self.requested = requested
+        # the plan's live degradations list (captured from the collecting
+        # scope the engine was built under) so the first-dispatch rung below
+        # still lands on the plan card after construction has returned
+        self._sink = sink
+        self._compiled = set()  # fused programs that have run once
+        self._backward = None
+        self._backward_consuming = None
+        self._forward = {}
+        if graphs is not None:
+            if path == "fused":
+                built = build_fused(graphs["backward"], spec)
+                self._backward = built["call"]
+                self._backward_consuming = built["consuming"]
+                self._forward = {
+                    s: build_fused(g, spec)["call"]
+                    for s, g in graphs["forward"].items()
+                }
+            else:
+                self._backward = StagedProgram(graphs["backward"], spec)
+                self._forward = {
+                    s: StagedProgram(g, spec)
+                    for s, g in graphs["forward"].items()
+                }
+
+    @property
+    def fused(self) -> bool:
+        return self.path == "fused"
+
+    def _count(self, direction: str) -> None:
+        from .. import obs
+
+        if self.path != "staged":  # staged counts per node itself
+            obs.counter(
+                "ir_dispatches_total", mode=self.path, direction=direction
+            ).inc()
+
+    def _degrade_to_staged(self, exc) -> None:
+        """The first-dispatch compile rung: ``jax.jit`` compiles lazily, so
+        a fused program whose XLA compile genuinely fails (e.g. compile-
+        memory exhaustion on an enormous fused program) surfaces at the
+        first call, not inside :func:`init_engine_ir`'s try (which sees
+        only the armed fault site and build-time spec errors). Same
+        contract as the build-time rung: ``fuse_compile_failed`` on the
+        plan card (via the captured sink — ``report()`` re-reads the live
+        list), staged reference path, never a failed dispatch."""
+        from .. import faults
+
+        entry = faults.record_degradation(
+            "fuse_compile_failed", faults.summarize(exc)
+        )
+        if self._sink is not None and (
+            not self._sink or self._sink[-1] is not entry
+        ):
+            self._sink.append(entry)
+        self.path = "staged"
+        self._backward = StagedProgram(self.graphs["backward"], self.spec)
+        self._backward_consuming = None
+        self._forward = {
+            s: StagedProgram(g, self.spec)
+            for s, g in self.graphs["forward"].items()
+        }
+
+    def _attempt_fused(self, key, call, direction, args):
+        """One fused dispatch with the first-call rung: until a program has
+        succeeded once, a compile-class failure degrades to staged and
+        re-dispatches there; after that, errors propagate untouched (an
+        execution failure is the ``typed_execution`` ladder's job, not a
+        fusion rung)."""
+        from .. import faults
+
+        if key in self._compiled:
+            out = call(*args)
+        else:
+            try:
+                out = call(*args)
+            except faults.ENGINE_BUILD_ERRORS as e:
+                self._degrade_to_staged(e)
+                if direction == "backward":
+                    return self._backward(*args)
+                return self._forward[key[1]](*args)
+            self._compiled.add(key)
+        self._count(direction)
+        return out
+
+    def run_backward(self, *args):
+        if self.path == "legacy":
+            self._count("backward")
+            return self.spec["legacy_backward"](*args)
+        if self.path == "fused":
+            return self._attempt_fused(
+                ("backward",), self._backward, "backward", args
+            )
+        return self._backward(*args)
+
+    def run_backward_consuming(self, *args):
+        """Donating backward for the host-facing consuming flow: the fused
+        donating jit when available, else the plain route (staged programs
+        materialize intermediates and cannot donate; legacy falls back to
+        the engine's own consuming jit)."""
+        if self.path == "fused" and self._backward_consuming is not None:
+            return self._attempt_fused(
+                ("backward", "consuming"),
+                self._backward_consuming,
+                "backward",
+                args,
+            )
+        if self.path == "legacy":
+            legacy = self.spec.get("legacy_backward_consuming")
+            if legacy is not None:
+                self._count("backward")
+                return legacy(*args)
+        return self.run_backward(*args)
+
+    def run_forward(self, scaling, *args):
+        if self.path == "legacy":
+            self._count("forward")
+            return self.spec["legacy_forward"][scaling](*args)
+        if self.path == "fused":
+            return self._attempt_fused(
+                ("forward", scaling), self._forward[scaling], "forward", args
+            )
+        return self._forward[scaling](*args)
+
+    # ---- plan-card provenance (obs.plancard pins IR_KEYS) ---------------------
+
+    def describe(self) -> dict:
+        from ..types import ScalingType
+
+        stages = None
+        if self.graphs is not None:
+            stages = {
+                "backward": self.graphs["backward"].stage_list(),
+                "forward": self.graphs["forward"][
+                    ScalingType.NONE
+                ].stage_list(),
+            }
+        donated = (
+            list(self.graphs["backward"].inputs[i] for i in self.spec["donate"])
+            if (
+                self.path == "fused"
+                and self.graphs is not None
+                and self.spec.get("donate")
+                and self._backward_consuming is not None
+            )
+            else []
+        )
+        return {
+            "fused": self.path == "fused",
+            "path": self.path,
+            "requested": self.requested,
+            "stages": stages,
+            # donated inputs of the consuming fused backward; forward
+            # retains its inputs (the space buffer is the plan's retained
+            # state), so its map is always empty
+            "donation": {"backward": donated, "forward": []},
+        }
+
+
+def init_engine_ir(engine, fuse=None):
+    """The lowering→validation→fusion ladder every engine runs at
+    construction (module docstring). Degradations land on the plan being
+    built via the ambient :func:`spfft_tpu.faults.collecting` sink:
+
+    * fault site ``ir.lower`` / a lowering or validation failure →
+      ``ir_lower_failed``, engine runs its legacy monolithic jits,
+    * fault site ``ir.compile`` / a fusion build failure →
+      ``fuse_compile_failed``, engine runs the staged reference path.
+
+    ``jax.jit`` compiles lazily, so a fused program whose XLA compile
+    genuinely fails takes the same ``fuse_compile_failed`` rung at its
+    first dispatch instead (:meth:`EngineIr._degrade_to_staged`).
+
+    Never a failed plan."""
+    from .. import faults, obs
+    from . import lower
+
+    fused, requested = resolve_fuse(fuse)
+    spec = engine._ir_spec()
+    # the plan's degradations list: captured so EngineIr's first-dispatch
+    # rung (lazy jit — see _degrade_to_staged) lands on the same card
+    sink = faults.current_sink()
+    # the IR's own refusals (graph validation, unregistered lowering,
+    # _mesh_spec) raise typed InvalidParameterError — a rung, not a failed
+    # plan, same as the build-error classes
+    rung_errors = faults.ENGINE_BUILD_ERRORS + (InvalidParameterError,)
+    try:
+        faults.site("ir.lower")
+        graphs = lower.lower_engine(engine)
+        graphs["backward"].validate()
+        for g in graphs["forward"].values():
+            g.validate()
+    except rung_errors as e:
+        faults.record_degradation("ir_lower_failed", faults.summarize(e))
+        return EngineIr(spec, None, path="legacy", requested=requested)
+    if fused:
+        try:
+            faults.site("ir.compile")
+            ir = EngineIr(
+                spec, graphs, path="fused", requested=requested, sink=sink
+            )
+        except rung_errors as e:
+            faults.record_degradation("fuse_compile_failed", faults.summarize(e))
+            ir = EngineIr(spec, graphs, path="staged", requested=requested)
+    else:
+        ir = EngineIr(spec, graphs, path="staged", requested=requested)
+    obs.trace.event("decision", what="fuse", choice=ir.path)
+    return ir

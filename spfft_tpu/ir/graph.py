@@ -1,0 +1,245 @@
+"""Stage-graph IR: the typed pipeline representation every engine lowers to.
+
+A :class:`StageGraph` describes one *direction* of a transform pipeline
+(backward: decompress -> ... -> space; forward: space -> ... -> compress) as a
+DAG of stage nodes connected by named edges. Nodes carry a canonical stage
+label from :data:`NODES` — the engine-pipeline subset of ``obs.STAGES``, the
+same vocabulary profiler scopes and perf attribution use, enforced both ways
+by ``programs/lint.py`` check 9 — plus a traceable ``fn`` computing the
+node's outputs from its input edges. Edges carry dtype/shape/"what kind of
+value" metadata (:class:`EdgeMeta`), so a graph is validated *before* it is
+compiled: an unknown stage label, a dangling edge (consumed but never
+produced), a doubly-produced edge, a dtype mismatch across an edge, or a
+cycle all raise typed :class:`~spfft_tpu.errors.InvalidParameterError` at
+plan-construction time — never a cryptic trace-time failure inside XLA.
+
+The graph is deliberately *small*: it is a scheduling/fusion representation,
+not a tensor IR. Stage bodies stay ordinary traceable JAX callables (closures
+over engine constants); what the IR adds is that the pipeline's *structure*
+— which stages exist, what flows between them, what is safe to fuse or split
+— is data that passes (:mod:`spfft_tpu.ir.compile` fuses a graph into ONE
+jitted program per direction; :mod:`spfft_tpu.ir.lower` rewrites the
+exchange node into overlap chunks) can manipulate, instead of hand-ordered
+method calls frozen inside six engine bodies.
+
+Distributed graphs describe the PER-SHARD pipeline: edge shapes are
+per-shard block shapes (no leading mesh dimension), node fns run under
+``shard_map``, and collective stages (``exchange*``) call the engine's
+exchange machinery directly. The compile layer owns the block-dim adapters
+and partition specs (:mod:`spfft_tpu.ir.compile`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..errors import InvalidParameterError
+
+# Canonical IR node vocabulary: exactly the engine-pipeline stages of
+# ``obs.STAGES`` (the autotuner's "tune warmup"/"tune trial" phases are trial
+# harness stages, never pipeline nodes). Pure literal tuple —
+# ``programs/lint.py`` check 9 enforces it both ways against ``obs.STAGES``
+# AND ``obs.perf.MODELED_STAGES``, so an IR stage can never silently escape
+# profiler attribution or the perf flop/byte model.
+NODES = (
+    "compression",
+    "stick symmetry",
+    "plane symmetry",
+    "z transform",
+    "y transform",
+    "y transform sparse",
+    "y transform blocked",
+    "x transform",
+    "expand",
+    "pack",
+    "exchange",
+    "unpack",
+    "pack A",
+    "exchange A",
+    "unpack A",
+    "pack B",
+    "exchange B",
+    "unpack B",
+    "exchange overlapped",
+    "exchange A overlapped",
+    "exchange B overlapped",
+)
+
+
+@dataclass(frozen=True)
+class EdgeMeta:
+    """Metadata of one edge (a value flowing between stages).
+
+    ``dtype``: numpy-comparable dtype of the edge's array, or ``None`` for
+    opaque values (e.g. the local MXU engine's threaded plan-operand tuple).
+    ``shape``: per-shard array shape (no leading mesh/block dimension for
+    distributed graphs), or ``None`` when unknown/opaque. The compile layer
+    derives per-node partition specs from ``len(shape)``."""
+
+    dtype: object = None
+    shape: tuple | None = None
+
+    def rank(self) -> int | None:
+        return None if self.shape is None else len(self.shape)
+
+
+@dataclass(frozen=True)
+class Node:
+    """One pipeline stage: a canonical label, a traceable body, and the
+    edges it consumes/produces. ``name`` is unique per graph (several nodes
+    may share one ``stage`` label — e.g. the C chunk exchanges of the
+    OVERLAPPED rewrite); ``fn(*inputs)`` returns the single output value when
+    ``len(outputs) == 1``, else a sequence of ``len(outputs)`` values."""
+
+    name: str
+    stage: str
+    fn: object
+    inputs: tuple
+    outputs: tuple
+
+
+@dataclass
+class StageGraph:
+    """A validated, topologically-orderable pipeline DAG for one direction."""
+
+    direction: str  # "backward" | "forward"
+    nodes: list = field(default_factory=list)
+    inputs: list = field(default_factory=list)  # ordered input edge names
+    outputs: list = field(default_factory=list)  # ordered output edge names
+    meta: dict = field(default_factory=dict)  # edge name -> EdgeMeta
+
+    def add_input(self, name: str, *, dtype=None, shape=None) -> None:
+        """Declare a graph input edge (caller-supplied value)."""
+        if name in self.meta:
+            raise InvalidParameterError(f"ir: duplicate edge {name!r}")
+        self.inputs.append(name)
+        self.meta[name] = EdgeMeta(dtype, None if shape is None else tuple(shape))
+
+    def add(
+        self,
+        stage: str,
+        fn,
+        inputs,
+        outputs,
+        *,
+        name: str | None = None,
+        out_meta: dict | None = None,
+    ) -> None:
+        """Append a stage node. ``out_meta`` maps produced edge names to
+        :class:`EdgeMeta` (missing entries default to untyped edges)."""
+        if stage not in NODES:
+            raise InvalidParameterError(
+                f"ir: unknown stage {stage!r}: not in the canonical node "
+                f"vocabulary (spfft_tpu/ir/graph.py NODES)"
+            )
+        name = name or stage
+        if any(n.name == name for n in self.nodes):
+            raise InvalidParameterError(f"ir: duplicate node name {name!r}")
+        inputs = tuple(inputs)
+        outputs = tuple(outputs)
+        for e in outputs:
+            if e in self.meta:
+                raise InvalidParameterError(
+                    f"ir: edge {e!r} produced more than once (node {name!r})"
+                )
+            m = (out_meta or {}).get(e)
+            self.meta[e] = m if m is not None else EdgeMeta()
+        self.nodes.append(Node(name, stage, fn, inputs, outputs))
+
+    def set_outputs(self, names) -> None:
+        self.outputs = list(names)
+
+    def remove(self, name: str) -> None:
+        """Remove node ``name`` and unregister its produced edges — the
+        surgery primitive graph rewrites build on (the OVERLAPPED rewrite in
+        :mod:`spfft_tpu.ir.lower` removes the bulk z/pack/exchange segment
+        and re-adds per-chunk nodes between the same boundary edges)."""
+        for node in self.nodes:
+            if node.name == name:
+                for e in node.outputs:
+                    self.meta.pop(e, None)
+                self.nodes.remove(node)
+                return
+        raise InvalidParameterError(f"ir: no node named {name!r} to remove")
+
+    # ---- validation ------------------------------------------------------------
+
+    def validate(self) -> None:
+        """Typed pre-compile validation (module docstring): raises
+        :class:`~spfft_tpu.errors.InvalidParameterError` on the first
+        structural defect; returns None on a well-formed graph."""
+        produced = set(self.inputs)
+        for node in self.nodes:
+            produced.update(node.outputs)
+        for node in self.nodes:
+            for e in node.inputs:
+                if e not in produced:
+                    raise InvalidParameterError(
+                        f"ir[{self.direction}]: dangling edge {e!r} consumed "
+                        f"by node {node.name!r} but produced by no node or "
+                        f"graph input"
+                    )
+        for e in self.outputs:
+            if e not in produced:
+                raise InvalidParameterError(
+                    f"ir[{self.direction}]: graph output {e!r} is produced "
+                    f"by no node"
+                )
+        # dtype agreement: a consumer that declares an expected dtype via
+        # its node's input-edge metadata must match the producer's declared
+        # dtype. (Both come from self.meta — one table — so the check is
+        # producer-declared dtype vs consumer expectation recorded by
+        # expect_dtype(); None on either side means "unchecked".)
+        for (edge, want), have in self._expectations.items():
+            m = self.meta.get(edge)
+            if m is None or m.dtype is None or want is None:
+                continue
+            import numpy as np
+
+            if np.dtype(m.dtype) != np.dtype(want):
+                raise InvalidParameterError(
+                    f"ir[{self.direction}]: dtype mismatch at edge {edge!r}: "
+                    f"produced {np.dtype(m.dtype)} but {have!r} expects "
+                    f"{np.dtype(want)}"
+                )
+        self.toposort()  # raises on cycles
+
+    # consumer dtype expectations: (edge, dtype) -> consumer node name
+    @property
+    def _expectations(self) -> dict:
+        return getattr(self, "_expect", {})
+
+    def expect_dtype(self, node_name: str, edge: str, dtype) -> None:
+        """Record that ``node_name`` expects ``edge`` to carry ``dtype`` —
+        checked against the producer's declared metadata in
+        :meth:`validate`."""
+        if not hasattr(self, "_expect"):
+            self._expect = {}
+        self._expect[(edge, dtype)] = node_name
+
+    def toposort(self) -> list:
+        """Nodes in dependency order; raises typed on cycles."""
+        ready = set(self.inputs)
+        remaining = list(self.nodes)
+        order = []
+        while remaining:
+            progressed = False
+            for node in list(remaining):
+                if all(e in ready for e in node.inputs):
+                    order.append(node)
+                    ready.update(node.outputs)
+                    remaining.remove(node)
+                    progressed = True
+            if not progressed:
+                names = [n.name for n in remaining]
+                raise InvalidParameterError(
+                    f"ir[{self.direction}]: cycle or unsatisfiable "
+                    f"dependency among nodes {names}"
+                )
+        return order
+
+    # ---- introspection ---------------------------------------------------------
+
+    def stage_list(self) -> list:
+        """Stage labels in topological order — the plan card's ``ir``
+        provenance section embeds this per direction."""
+        return [n.stage for n in self.toposort()]

@@ -1,0 +1,1382 @@
+"""Lowering: every engine's per-direction pipeline as a stage graph.
+
+One builder per engine class turns the engine's extracted stage bodies
+(``_st_*`` methods — the same code its monolithic impls call) into a
+validated :class:`~spfft_tpu.ir.graph.StageGraph` per direction. The graphs
+are *descriptions the engine executes through* (:mod:`spfft_tpu.ir.compile`
+fuses each into one jitted program, or runs it node-per-dispatch), not
+documentation: a stage missing here is a stage the plan does not run.
+
+The OVERLAPPED exchange discipline is applied as a **graph rewrite** rather
+than hand-threaded loop code: builders first lower the bulk-synchronous
+pipeline (one exchange node), then — when the engine's tuned/requested
+``overlap`` chunk count exceeds 1 — :func:`_split_slab_backward` /
+:func:`_split_slab_forward` (slab engines) and the pencil tail splitters
+remove the bulk z/pack/exchange segment and re-add C per-chunk node chains
+pipelined against the neighbor chunks' FFT nodes, with the chunked
+collectives carrying the canonical ``exchange* overlapped`` labels. The
+rewritten graph reproduces the engines' PR-7 chunk loops exactly (parity
+fuzz: ``tests/test_ir.py``).
+
+Fault site ``ir.lower`` (armed by the chaos suite) models this layer
+refusing to build; the engine then records ``ir_lower_failed`` and runs its
+legacy monolithic jits (:func:`spfft_tpu.ir.compile.init_engine_ir`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import InvalidParameterError
+from .graph import EdgeMeta, StageGraph
+
+
+def lower_engine(engine) -> dict:
+    """Lower ``engine`` to ``{"backward": graph, "forward": {scaling:
+    graph}}`` — dispatched on the engine class (subclass walk so a derived
+    engine inherits its family's lowering unless it registers its own)."""
+    for klass in type(engine).__mro__:
+        builder = _BUILDERS.get(klass.__name__)
+        if builder is not None:
+            return builder(engine)
+    raise InvalidParameterError(
+        f"ir: no lowering registered for engine {type(engine).__name__!r}"
+    )
+
+
+def _scalings():
+    from ..types import ScalingType
+
+    return (ScalingType.NONE, ScalingType.FULL)
+
+
+# =============================================================================
+# Local engines
+# =============================================================================
+
+
+def _lower_local_xla(e):
+    p = e.params
+    rt, ct = e.real_dtype, e.complex_dtype
+    n = int(p.num_values)
+    S, Z, Y, Xf, X = int(p.num_sticks), p.dim_z, p.dim_y, p.dim_x_freq, p.dim_x
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re", dtype=rt, shape=(n,))
+        g.add_input("values_im", dtype=rt, shape=(n,))
+        g.add(
+            "compression", e._st_decompress, ("values_re", "values_im"),
+            ("sticks",), out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+        )
+        g.expect_dtype("compression", "values_re", rt)
+        g.expect_dtype("compression", "values_im", rt)
+        cur = "sticks"
+        if e.is_r2c:
+            g.add(
+                "stick symmetry", e._st_stick_symmetry, (cur,), ("sticks_h",),
+                out_meta={"sticks_h": EdgeMeta(ct, (S, Z))},
+            )
+            cur = "sticks_h"
+        g.add(
+            "z transform", e._st_z_backward, (cur,), ("z_sticks",),
+            out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+        )
+        g.add(
+            "expand", e._st_expand, ("z_sticks",), ("grid",),
+            out_meta={"grid": EdgeMeta(ct, (Z, Y, Xf))},
+        )
+        cur = "grid"
+        if e.is_r2c:
+            g.add(
+                "plane symmetry", e._st_plane_symmetry, (cur,), ("grid_h",),
+                out_meta={"grid_h": EdgeMeta(ct, (Z, Y, Xf))},
+            )
+            cur = "grid_h"
+        g.add(
+            "y transform", e._st_y_backward, (cur,), ("grid_y",),
+            out_meta={"grid_y": EdgeMeta(ct, (Z, Y, Xf))},
+        )
+        if e.is_r2c:
+            g.add(
+                "x transform", e._st_x_backward, ("grid_y",), ("space",),
+                out_meta={"space": EdgeMeta(rt, (Z, Y, X))},
+            )
+            g.set_outputs(["space"])
+        else:
+            g.add(
+                "x transform", e._st_x_backward, ("grid_y",),
+                ("space_re", "space_im"),
+                out_meta={
+                    "space_re": EdgeMeta(rt, (Z, Y, X)),
+                    "space_im": EdgeMeta(rt, (Z, Y, X)),
+                },
+            )
+            g.set_outputs(["space_re", "space_im"])
+        return g
+
+    def forward(s):
+        scale = e._scale_for(s)
+        g = StageGraph("forward")
+        g.add_input("space_re", dtype=rt, shape=(Z, Y, X))
+        g.add_input("space_im", dtype=rt)  # (0,) placeholder for R2C
+        g.add(
+            "x transform", e._st_x_forward, ("space_re", "space_im"),
+            ("grid",), out_meta={"grid": EdgeMeta(ct, (Z, Y, Xf))},
+        )
+        g.add(
+            "y transform", e._st_y_forward, ("grid",), ("grid_y",),
+            out_meta={"grid_y": EdgeMeta(ct, (Z, Y, Xf))},
+        )
+        g.add(
+            "pack", e._st_pack, ("grid_y",), ("sticks",),
+            out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+        )
+        g.add(
+            "z transform", e._st_z_forward, ("sticks",), ("z_sticks",),
+            out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+        )
+        g.add(
+            "compression",
+            lambda sticks: e._st_compress(sticks, scale),
+            ("z_sticks",), ("out_re", "out_im"),
+            out_meta={"out_re": EdgeMeta(rt, (n,)), "out_im": EdgeMeta(rt, (n,))},
+        )
+        g.set_outputs(["out_re", "out_im"])
+        return g
+
+    return {"backward": backward(), "forward": {s: forward(s) for s in _scalings()}}
+
+
+def _lower_local_mxu(e):
+    p = e.params
+    rt = e.real_dtype
+    n = int(p.num_values)
+    Z = p.dim_z
+    R = e._table_rows
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re", dtype=rt, shape=(n,))
+        g.add_input("values_im", dtype=rt, shape=(n,))
+        g.add_input("phase")  # threaded plan operands (opaque varargs tuple)
+        g.varargs = True
+        g.add(
+            "compression", e._st_decompress, ("values_re", "values_im"),
+            ("sre", "sim"),
+            out_meta={
+                "sre": EdgeMeta(rt, (R, Z)), "sim": EdgeMeta(rt, (R, Z))
+            },
+        )
+        cur = ("sre", "sim")
+        if e.is_r2c and e._zero_stick_id is not None:
+            g.add(
+                "stick symmetry", e._st_stick_symmetry, cur, ("shre", "shim"),
+                out_meta={
+                    "shre": EdgeMeta(rt, (R, Z)), "shim": EdgeMeta(rt, (R, Z))
+                },
+            )
+            cur = ("shre", "shim")
+        g.add(
+            "z transform", e._st_z_backward, (*cur, "phase"), ("zre", "zim"),
+            out_meta={
+                "zre": EdgeMeta(rt, (R, Z)), "zim": EdgeMeta(rt, (R, Z))
+            },
+        )
+        if e._sparse_y:
+            g.add(
+                "y transform sparse", e._st_y_sparse_backward, ("zre", "zim"),
+                ("gre", "gim"),
+            )
+        elif e._sparse_y_blocked is not None:
+            g.add(
+                "y transform blocked", e._st_y_blocked_backward,
+                ("zre", "zim", "phase"), ("gre", "gim"),
+            )
+        else:
+            g.add("expand", e._expand, ("zre", "zim"), ("ere", "eim"))
+            cur = ("ere", "eim")
+            if e.is_r2c and e._x0_slot is not None:
+                g.add(
+                    "plane symmetry", e._st_plane_symmetry, cur, ("pre", "pim")
+                )
+                cur = ("pre", "pim")
+            g.add("y transform", e._st_y_dense_backward, cur, ("gre", "gim"))
+        if e.is_r2c:
+            g.add("x transform", e._st_x_backward, ("gre", "gim"), ("space",))
+            g.set_outputs(["space"])
+        else:
+            g.add(
+                "x transform", e._st_x_backward, ("gre", "gim"),
+                ("space_re", "space_im"),
+            )
+            g.set_outputs(["space_re", "space_im"])
+        return g
+
+    def forward(s):
+        g = StageGraph("forward")
+        g.add_input("space_re", dtype=rt)
+        g.add_input("space_im", dtype=rt)
+        g.add_input("phase")
+        g.varargs = True
+        g.add(
+            "x transform", e._st_x_forward, ("space_re", "space_im"),
+            ("gre", "gim"),
+        )
+        if e._sparse_y:
+            g.add(
+                "y transform sparse", e._st_y_sparse_forward, ("gre", "gim"),
+                ("sre", "sim"),
+            )
+        elif e._sparse_y_blocked is not None:
+            g.add(
+                "y transform blocked", e._st_y_blocked_forward,
+                ("gre", "gim", "phase"), ("sre", "sim"),
+            )
+        else:
+            g.add("y transform", e._st_y_dense_forward, ("gre", "gim"), ("yre", "yim"))
+            g.add("pack", e._st_pack, ("yre", "yim"), ("sre", "sim"))
+        g.add(
+            "z transform",
+            lambda sre, sim, phase: e._st_z_forward(sre, sim, phase, s),
+            ("sre", "sim", "phase"), ("zre", "zim"),
+        )
+        g.add(
+            "compression", e._compress, ("zre", "zim"), ("out_re", "out_im"),
+            out_meta={"out_re": EdgeMeta(rt, (n,)), "out_im": EdgeMeta(rt, (n,))},
+        )
+        g.set_outputs(["out_re", "out_im"])
+        return g
+
+    return {"backward": backward(), "forward": {s: forward(s) for s in _scalings()}}
+
+
+# =============================================================================
+# 1-D slab mesh engines
+# =============================================================================
+
+
+def _split_slab_backward(g, e, sticks_edge):
+    """OVERLAPPED rewrite (backward, slab engines): replace the bulk
+    [z transform -> pack -> exchange] segment with C per-chunk chains whose
+    collectives carry the ``exchange overlapped`` label, re-wiring the
+    unpack node to consume every chunk's receive — the pipelined all-to-all
+    of arxiv.org/pdf/1804.09536 as a graph transformation."""
+    ct = e.complex_dtype
+    p = e.params
+    L = e._L
+    pair = _is_pair_engine(e)
+    phase = _phase_edges(e)
+    for name in ("z transform", "pack", "exchange", "unpack"):
+        g.remove(name)
+    if pair and not phase:
+        phase = _delta_phase_edges(g, e)
+    recv_edges = []
+    for k, (c0, c1) in enumerate(e._chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        if pair:
+            zfn = (
+                (
+                    lambda sre, sim, pre, pim, c0=c0, c1=c1: e._st_z_backward(
+                        sre, sim, pre, pim, zwin=(c0, c1)
+                    )
+                )
+                if phase
+                else (
+                    lambda sre, sim, c0=c0, c1=c1: e._st_z_backward(
+                        sre, sim, zwin=(c0, c1)
+                    )
+                )
+            )
+            g.add(
+                "z transform",
+                zfn,
+                (*sticks_edge, *phase),
+                (f"zre{sfx}", f"zim{sfx}"),
+                name=f"z transform{sfx}",
+                out_meta={
+                    f"zre{sfx}": EdgeMeta(e.real_dtype, (W, p.dim_z)),
+                    f"zim{sfx}": EdgeMeta(e.real_dtype, (W, p.dim_z)),
+                },
+            )
+            g.add(
+                "pack", e._st_pack, (f"zre{sfx}", f"zim{sfx}"),
+                (f"bre{sfx}", f"bim{sfx}"), name=f"pack{sfx}",
+                out_meta={
+                    f"bre{sfx}": EdgeMeta(e.real_dtype, (p.num_shards, W, L)),
+                    f"bim{sfx}": EdgeMeta(e.real_dtype, (p.num_shards, W, L)),
+                },
+            )
+            g.add(
+                "exchange overlapped", e._exchange,
+                (f"bre{sfx}", f"bim{sfx}"), (f"rre{sfx}", f"rim{sfx}"),
+                name=f"exchange overlapped{sfx}",
+                out_meta={
+                    f"rre{sfx}": EdgeMeta(e.real_dtype, (p.num_shards, W, L)),
+                    f"rim{sfx}": EdgeMeta(e.real_dtype, (p.num_shards, W, L)),
+                },
+            )
+            recv_edges.append((f"rre{sfx}", f"rim{sfx}"))
+        else:
+            g.add(
+                "z transform",
+                lambda sticks, c0=c0, c1=c1: e._st_z_backward(sticks[c0:c1]),
+                sticks_edge, (f"z_sticks{sfx}",), name=f"z transform{sfx}",
+                out_meta={f"z_sticks{sfx}": EdgeMeta(ct, (W, p.dim_z))},
+            )
+            g.add(
+                "pack", e._st_pack, (f"z_sticks{sfx}",), (f"buf{sfx}",),
+                name=f"pack{sfx}",
+                out_meta={f"buf{sfx}": EdgeMeta(ct, (p.num_shards, L, W))},
+            )
+            g.add(
+                "exchange overlapped", e._st_exchange, (f"buf{sfx}",),
+                (f"recv{sfx}",), name=f"exchange overlapped{sfx}",
+                out_meta={f"recv{sfx}": EdgeMeta(ct, (p.num_shards, L, W))},
+            )
+            recv_edges.append((f"recv{sfx}",))
+    if pair:
+        # _st_unpack's halves contract: first all re edges, then all im
+        flat = tuple(pe[0] for pe in recv_edges) + tuple(
+            pe[1] for pe in recv_edges
+        )
+    else:
+        flat = tuple(edge for pair_edges in recv_edges for edge in pair_edges)
+    out_meta = _slab_unpack_meta(e)
+    g.add(
+        "unpack", e._st_unpack, flat, tuple(out_meta), out_meta=out_meta
+    )
+
+
+def _is_pair_engine(e) -> bool:
+    """MXU mesh engines carry (re, im) real pairs end to end; the XLA
+    engines carry complex arrays. The graph edge layout follows."""
+    return hasattr(e, "_decompress_branches")
+
+
+def _delta_phase_edges(g, e):
+    """Delta-rep hoist for the slab MXU chunk rewrites: PR-7 generated the
+    in-trace (S, Z) alignment-phase tables once per direction and sliced per
+    chunk; one producer node (stage ``z transform`` — where table generation
+    has always been charged) restores that shape, its outputs threaded into
+    every chunk's z node. Table-form reps already arrive hoisted as the
+    staged ``phase_re``/``phase_im`` operand edges; plans without rotations
+    have no tables to hoist (empty)."""
+    rep = getattr(e, "_align_rep", None)
+    if rep is None or rep[0] != "delta":
+        return ()
+    rt = e.real_dtype
+    g.add(
+        "z transform", e._st_phase_hoist, (), ("phre", "phim"),
+        name="z transform phase",
+        out_meta={
+            "phre": EdgeMeta(rt, (e._S, e.params.dim_z)),
+            "phim": EdgeMeta(rt, (e._S, e.params.dim_z)),
+        },
+    )
+    return ("phre", "phim")
+
+
+def _phase_edges(e):
+    """The 1-D MXU engine's staged alignment-phase operand edges, when the
+    plan rotates with table-form reps (empty otherwise)."""
+    return (
+        ("phase_re", "phase_im")
+        if getattr(e, "_align_phase", None) is not None
+        else ()
+    )
+
+
+def _slab_unpack_meta(e):
+    """Output edges + metadata of the slab backward unpack stage (variant-
+    dependent on the MXU engine: compact planes, sparse-y table, or blocked
+    flats)."""
+    p = e.params
+    L, Y, Xf = e._L, p.dim_y, p.dim_x_freq
+    if not _is_pair_engine(e):
+        return {"slab": EdgeMeta(e.complex_dtype, (L, Y, Xf))}
+    rt = e.real_dtype
+    A = e._num_x_active
+    if e._sparse_y:
+        shape = (A, e._sy, L)
+    elif e._sparse_y_blocked is not None:
+        shape = (e._rb, L)
+    else:
+        shape = (L, Y, A)
+    return {"gre": EdgeMeta(rt, shape), "gim": EdgeMeta(rt, shape)}
+
+
+def _lower_slab_xla(e):
+    p = e.params
+    rt, ct = e.real_dtype, e.complex_dtype
+    S, L, V = e._S, e._L, e._V
+    Z, Y, Xf, X = p.dim_z, p.dim_y, p.dim_x_freq, p.dim_x
+    P = p.num_shards
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re", dtype=rt, shape=(V,))
+        g.add_input("values_im", dtype=rt, shape=(V,))
+        g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        g.add(
+            "compression", e._st_decompress,
+            ("values_re", "values_im", "value_indices"), ("sticks",),
+            out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+        )
+        g.expect_dtype("compression", "values_re", rt)
+        cur = "sticks"
+        if e.is_r2c and p.zero_stick_shard >= 0:
+            g.add(
+                "stick symmetry", e._st_stick_symmetry, (cur,), ("sticks_h",),
+                out_meta={"sticks_h": EdgeMeta(ct, (S, Z))},
+            )
+            cur = "sticks_h"
+        g.add(
+            "z transform", e._st_z_backward, (cur,), ("z_sticks",),
+            out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+        )
+        if e._ragged is not None:
+            g.add(
+                "exchange", e._st_ragged_exchange_backward, ("z_sticks",),
+                ("planes",), out_meta={"planes": EdgeMeta(ct, (Y * Xf, L))},
+            )
+            g.add(
+                "unpack", e._st_ragged_unpack, ("planes",), ("slab",),
+                out_meta={"slab": EdgeMeta(ct, (L, Y, Xf))},
+            )
+        else:
+            g.add(
+                "pack", e._st_pack, ("z_sticks",), ("buf",),
+                out_meta={"buf": EdgeMeta(ct, (P, L, S))},
+            )
+            g.add(
+                "exchange", e._st_exchange, ("buf",), ("recv",),
+                out_meta={"recv": EdgeMeta(ct, (P, L, S))},
+            )
+            g.add(
+                "unpack", e._st_unpack, ("recv",), ("slab",),
+                out_meta={"slab": EdgeMeta(ct, (L, Y, Xf))},
+            )
+        cur = "slab"
+        if e.is_r2c:
+            g.add(
+                "plane symmetry", e._st_plane_symmetry, (cur,), ("slab_h",),
+                out_meta={"slab_h": EdgeMeta(ct, (L, Y, Xf))},
+            )
+            cur = "slab_h"
+        g.add(
+            "y transform", e._st_y_backward, (cur,), ("slab_y",),
+            out_meta={"slab_y": EdgeMeta(ct, (L, Y, Xf))},
+        )
+        if e.is_r2c:
+            g.add(
+                "x transform", e._st_x_backward, ("slab_y",), ("space",),
+                out_meta={"space": EdgeMeta(rt, (L, Y, X))},
+            )
+            g.set_outputs(["space"])
+        else:
+            g.add(
+                "x transform", e._st_x_backward, ("slab_y",),
+                ("space_re", "space_im"),
+                out_meta={
+                    "space_re": EdgeMeta(rt, (L, Y, X)),
+                    "space_im": EdgeMeta(rt, (L, Y, X)),
+                },
+            )
+            g.set_outputs(["space_re", "space_im"])
+        if e._overlap > 1:
+            sticks = (
+                ("sticks_h",)
+                if e.is_r2c and p.zero_stick_shard >= 0
+                else ("sticks",)
+            )
+            _split_slab_backward(g, e, sticks)
+        return g
+
+    def forward(s):
+        scale = None if s.name == "NONE" else 1.0 / p.total_size
+        g = StageGraph("forward")
+        if e.is_r2c:
+            g.add_input("space_re", dtype=rt, shape=(L, Y, X))
+            g.add_input("value_indices", dtype=np.int32, shape=(V,))
+            g.add(
+                "x transform", e._st_x_forward, ("space_re",), ("grid",),
+                out_meta={"grid": EdgeMeta(ct, (L, Y, Xf))},
+            )
+        else:
+            g.add_input("space_re", dtype=rt, shape=(L, Y, X))
+            g.add_input("space_im", dtype=rt, shape=(L, Y, X))
+            g.add_input("value_indices", dtype=np.int32, shape=(V,))
+            g.add(
+                "x transform", e._st_x_forward, ("space_re", "space_im"),
+                ("grid",), out_meta={"grid": EdgeMeta(ct, (L, Y, Xf))},
+            )
+        g.add(
+            "y transform", e._st_y_forward, ("grid",), ("grid_y",),
+            out_meta={"grid_y": EdgeMeta(ct, (L, Y, Xf))},
+        )
+        if e._ragged is not None:
+            g.add(
+                "exchange", e._st_ragged_exchange_forward, ("grid_y",),
+                ("sticks",), out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+            )
+            g.add(
+                "z transform", e._st_z_forward, ("sticks",), ("z_sticks",),
+                out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+            )
+        else:
+            g.add(
+                "pack", e._st_pack_fwd, ("grid_y",), ("buf",),
+                out_meta={"buf": EdgeMeta(ct, (P, L, S))},
+            )
+            g.add(
+                "exchange", e._st_exchange, ("buf",), ("recv",),
+                out_meta={"recv": EdgeMeta(ct, (P, L, S))},
+            )
+            g.add(
+                "unpack", e._st_unpack_fwd, ("recv",), ("sticks",),
+                out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+            )
+            g.add(
+                "z transform", e._st_z_forward, ("sticks",), ("z_sticks",),
+                out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+            )
+        g.add(
+            "compression",
+            lambda sticks, vi: e._st_compress(sticks, vi, scale),
+            ("z_sticks", "value_indices"), ("out_re", "out_im"),
+            out_meta={
+                "out_re": EdgeMeta(rt, (V,)), "out_im": EdgeMeta(rt, (V,))
+            },
+        )
+        g.set_outputs(["out_re", "out_im"])
+        if e._overlap > 1:
+            _split_slab_forward_xla(g, e)
+        return g
+
+    return {"backward": backward(), "forward": {s: forward(s) for s in _scalings()}}
+
+
+def _split_slab_forward_xla(g, e):
+    """OVERLAPPED rewrite (forward, slab XLA engine): per-chunk
+    [pack -> exchange overlapped -> unpack -> z transform] chains off the
+    shared grid, concatenated back into the stick table."""
+    p = e.params
+    ct = e.complex_dtype
+    L = e._L
+    for name in ("pack", "exchange", "unpack", "z transform"):
+        g.remove(name)
+    part_edges = []
+    for k, (c0, c1) in enumerate(e._chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        g.add(
+            "pack",
+            lambda grid, c0=c0, c1=c1: e._st_pack_fwd(grid, c0, c1),
+            ("grid_y",), (f"buf{sfx}",), name=f"pack{sfx}",
+            out_meta={f"buf{sfx}": EdgeMeta(ct, (p.num_shards, L, W))},
+        )
+        g.add(
+            "exchange overlapped", e._st_exchange, (f"buf{sfx}",),
+            (f"recv{sfx}",), name=f"exchange overlapped{sfx}",
+            out_meta={f"recv{sfx}": EdgeMeta(ct, (p.num_shards, L, W))},
+        )
+        g.add(
+            "unpack", e._st_unpack_fwd, (f"recv{sfx}",), (f"sz{sfx}",),
+            name=f"unpack{sfx}",
+            out_meta={f"sz{sfx}": EdgeMeta(ct, (W, p.dim_z))},
+        )
+        g.add(
+            "z transform", e._st_z_forward, (f"sz{sfx}",), (f"zc{sfx}",),
+            name=f"z transform{sfx}",
+            out_meta={f"zc{sfx}": EdgeMeta(ct, (W, p.dim_z))},
+        )
+        part_edges.append(f"zc{sfx}")
+    g.add(
+        "z transform", e._st_concat_sticks, tuple(part_edges), ("z_sticks",),
+        name="z transform concat",
+        out_meta={"z_sticks": EdgeMeta(ct, (e._S, p.dim_z))},
+    )
+
+
+def _lower_slab_mxu(e):
+    p = e.params
+    rt = e.real_dtype
+    S, L, V = e._S, e._L, e._V
+    Z, Y, X = p.dim_z, p.dim_y, p.dim_x
+    P = p.num_shards
+    phase = _phase_edges(e)
+    pmeta = {pe: EdgeMeta(rt, (S, Z)) for pe in phase}
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re", dtype=rt, shape=(V,))
+        g.add_input("values_im", dtype=rt, shape=(V,))
+        for pe in phase:
+            g.add_input(pe, dtype=rt, shape=(S, Z))
+        g.add(
+            "compression", e._st_decompress, ("values_re", "values_im"),
+            ("sre", "sim"),
+            out_meta={"sre": EdgeMeta(rt, (S, Z)), "sim": EdgeMeta(rt, (S, Z))},
+        )
+        cur = ("sre", "sim")
+        if e.is_r2c and p.zero_stick_shard >= 0:
+            g.add(
+                "stick symmetry", e._st_stick_symmetry, cur, ("shre", "shim"),
+                out_meta={
+                    "shre": EdgeMeta(rt, (S, Z)), "shim": EdgeMeta(rt, (S, Z))
+                },
+            )
+            cur = ("shre", "shim")
+        unpack_meta = _slab_unpack_meta(e)
+        g.add(
+            "z transform",
+            (lambda sre, sim, pre, pim: e._st_z_backward(sre, sim, pre, pim))
+            if phase
+            else (lambda sre, sim: e._st_z_backward(sre, sim)),
+            (*cur, *phase), ("zre", "zim"),
+            out_meta={
+                "zre": EdgeMeta(rt, (S, Z)), "zim": EdgeMeta(rt, (S, Z))
+            },
+        )
+        if e._ragged is not None:
+            g.add(
+                "exchange", e._st_ragged_exchange_backward, ("zre", "zim"),
+                tuple(unpack_meta), out_meta=unpack_meta,
+            )
+        else:
+            g.add(
+                "pack", e._st_pack, ("zre", "zim"), ("bre", "bim"),
+                out_meta={
+                    "bre": EdgeMeta(rt, (P, S, L)),
+                    "bim": EdgeMeta(rt, (P, S, L)),
+                },
+            )
+            g.add(
+                "exchange", e._exchange, ("bre", "bim"), ("rre", "rim"),
+                out_meta={
+                    "rre": EdgeMeta(rt, (P, S, L)),
+                    "rim": EdgeMeta(rt, (P, S, L)),
+                },
+            )
+            g.add(
+                "unpack", e._st_unpack, ("rre", "rim"), tuple(unpack_meta),
+                out_meta=unpack_meta,
+            )
+        cur = tuple(unpack_meta)
+        if e._plane_symmetry_standalone():
+            sym_meta = {
+                "psre": unpack_meta[cur[0]], "psim": unpack_meta[cur[1]]
+            }
+            g.add(
+                "plane symmetry", e._st_plane_symmetry, cur, ("psre", "psim"),
+                out_meta=sym_meta,
+            )
+            cur = ("psre", "psim")
+        ymeta = EdgeMeta(rt, (L, Y, e._num_x_active))
+        g.add(
+            e._y_stage_scope(), e._st_y_backward, cur, ("yre", "yim"),
+            out_meta={"yre": ymeta, "yim": ymeta},
+        )
+        if e.is_r2c:
+            g.add(
+                "x transform", e._st_x_backward, ("yre", "yim"), ("space",),
+                out_meta={"space": EdgeMeta(rt, (L, Y, X))},
+            )
+            g.set_outputs(["space"])
+        else:
+            g.add(
+                "x transform", e._st_x_backward, ("yre", "yim"),
+                ("space_re", "space_im"),
+                out_meta={
+                    "space_re": EdgeMeta(rt, (L, Y, X)),
+                    "space_im": EdgeMeta(rt, (L, Y, X)),
+                },
+            )
+            g.set_outputs(["space_re", "space_im"])
+        if e._overlap > 1:
+            sticks = (
+                ("shre", "shim")
+                if e.is_r2c and p.zero_stick_shard >= 0
+                else ("sre", "sim")
+            )
+            _split_slab_backward(g, e, sticks)
+        return g
+
+    def forward(s):
+        g = StageGraph("forward")
+        g.add_input("space_re", dtype=rt, shape=(L, Y, X))
+        if not e.is_r2c:
+            g.add_input("space_im", dtype=rt, shape=(L, Y, X))
+        for pe in phase:
+            g.add_input(pe, dtype=rt, shape=(S, Z))
+        A = e._num_x_active
+        xmeta = EdgeMeta(rt, (L, Y, A))
+        g.add(
+            "x transform", e._st_x_forward,
+            ("space_re",) if e.is_r2c else ("space_re", "space_im"),
+            ("gre", "gim"), out_meta={"gre": xmeta, "gim": xmeta},
+        )
+        if e._sparse_y:
+            yshape = (A, e._sy, L)
+        elif e._sparse_y_blocked is not None:
+            yshape = (e._rb, L)
+        else:
+            yshape = (L, Y, A)
+        ymeta = EdgeMeta(rt, yshape)
+        g.add(
+            e._y_stage_scope(), e._st_y_forward, ("gre", "gim"),
+            ("yre", "yim"), out_meta={"yre": ymeta, "yim": ymeta},
+        )
+        if e._ragged is not None:
+            g.add(
+                "exchange", e._st_ragged_exchange_forward, ("yre", "yim"),
+                ("sre", "sim"),
+                out_meta={
+                    "sre": EdgeMeta(rt, (S, Z)), "sim": EdgeMeta(rt, (S, Z))
+                },
+            )
+        else:
+            fmeta = EdgeMeta(rt, (e._plane_slots + 1, L))
+            g.add("pack", e._st_forward_flats, ("yre", "yim"),
+                  ("fre", "fim"), name="pack flats",
+                  out_meta={"fre": fmeta, "fim": fmeta})
+            g.add(
+                "pack", e._st_pack_fwd, ("fre", "fim"), ("bre", "bim"),
+                out_meta={
+                    "bre": EdgeMeta(rt, (P, S, L)),
+                    "bim": EdgeMeta(rt, (P, S, L)),
+                },
+            )
+            g.add(
+                "exchange", e._exchange, ("bre", "bim"), ("rre", "rim"),
+                out_meta={
+                    "rre": EdgeMeta(rt, (P, S, L)),
+                    "rim": EdgeMeta(rt, (P, S, L)),
+                },
+            )
+            g.add(
+                "unpack", e._st_unpack_fwd, ("rre", "rim"), ("sre", "sim"),
+                out_meta={
+                    "sre": EdgeMeta(rt, (S, Z)), "sim": EdgeMeta(rt, (S, Z))
+                },
+            )
+        g.add(
+            "z transform",
+            (
+                (lambda sre, sim, pre, pim: e._st_z_forward(sre, sim, s, pre, pim))
+                if phase
+                else (lambda sre, sim: e._st_z_forward(sre, sim, s))
+            ),
+            ("sre", "sim", *phase), ("zre", "zim"),
+            out_meta={
+                "zre": EdgeMeta(rt, (S, Z)), "zim": EdgeMeta(rt, (S, Z))
+            },
+        )
+        g.add(
+            "compression", e._st_compress, ("zre", "zim"),
+            ("out_re", "out_im"),
+            out_meta={
+                "out_re": EdgeMeta(rt, (V,)), "out_im": EdgeMeta(rt, (V,))
+            },
+        )
+        g.set_outputs(["out_re", "out_im"])
+        if e._overlap > 1:
+            _split_slab_forward_mxu(g, e, s)
+        return g
+
+    return {"backward": backward(), "forward": {s: forward(s) for s in _scalings()}}
+
+
+def _split_slab_forward_mxu(g, e, scaling):
+    """OVERLAPPED rewrite (forward, slab MXU engine): per-chunk
+    [pack -> exchange overlapped -> unpack -> z transform] pair chains off
+    the hoisted plane flats, concatenated back into the stick pair."""
+    p = e.params
+    rt = e.real_dtype
+    S, L, Z = e._S, e._L, p.dim_z
+    phase = _phase_edges(e)
+    for name in ("pack", "exchange", "unpack", "z transform"):
+        g.remove(name)
+    if not phase:
+        phase = _delta_phase_edges(g, e)
+    parts = []
+    for k, (c0, c1) in enumerate(e._chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        g.add(
+            "pack",
+            lambda fre, fim, c0=c0, c1=c1: e._st_pack_fwd(fre, fim, c0, c1),
+            ("fre", "fim"), (f"bre{sfx}", f"bim{sfx}"), name=f"pack{sfx}",
+            out_meta={
+                f"bre{sfx}": EdgeMeta(rt, (p.num_shards, W, L)),
+                f"bim{sfx}": EdgeMeta(rt, (p.num_shards, W, L)),
+            },
+        )
+        g.add(
+            "exchange overlapped", e._exchange,
+            (f"bre{sfx}", f"bim{sfx}"), (f"rre{sfx}", f"rim{sfx}"),
+            name=f"exchange overlapped{sfx}",
+            out_meta={
+                f"rre{sfx}": EdgeMeta(rt, (p.num_shards, W, L)),
+                f"rim{sfx}": EdgeMeta(rt, (p.num_shards, W, L)),
+            },
+        )
+        g.add(
+            "unpack", e._st_unpack_fwd, (f"rre{sfx}", f"rim{sfx}"),
+            (f"cre{sfx}", f"cim{sfx}"), name=f"unpack{sfx}",
+            out_meta={
+                f"cre{sfx}": EdgeMeta(rt, (W, Z)),
+                f"cim{sfx}": EdgeMeta(rt, (W, Z)),
+            },
+        )
+        g.add(
+            "z transform",
+            (
+                (
+                    lambda cre, cim, pre, pim, c0=c0, c1=c1: e._st_z_forward(
+                        cre, cim, scaling, pre, pim, zwin=(c0, c1)
+                    )
+                )
+                if phase
+                else (
+                    lambda cre, cim, c0=c0, c1=c1: e._st_z_forward(
+                        cre, cim, scaling, zwin=(c0, c1)
+                    )
+                )
+            ),
+            (f"cre{sfx}", f"cim{sfx}", *phase),
+            (f"zcre{sfx}", f"zcim{sfx}"), name=f"z transform{sfx}",
+            out_meta={
+                f"zcre{sfx}": EdgeMeta(rt, (W, Z)),
+                f"zcim{sfx}": EdgeMeta(rt, (W, Z)),
+            },
+        )
+        parts.append((f"zcre{sfx}", f"zcim{sfx}"))
+    g.add(
+        "z transform", e._st_concat_pair,
+        tuple(pr[0] for pr in parts) + tuple(pr[1] for pr in parts),
+        ("zre", "zim"), name="z transform concat",
+        out_meta={"zre": EdgeMeta(rt, (S, Z)), "zim": EdgeMeta(rt, (S, Z))},
+    )
+
+
+# =============================================================================
+# 2-D pencil mesh engines
+# =============================================================================
+
+
+def _pencil_backward_tail(g, e, chunks, overlapped):
+    """Append the post-z pencil pipeline per z-window chunk; returns the
+    added node names (the OVERLAPPED rewrite removes and re-adds them)."""
+    p = e.params
+    ct = e.complex_dtype
+    Y, Xf, X = p.dim_y, p.dim_x_freq, p.dim_x
+    P1, P2, Ax, Ly, SG = e.P1, e.P2, e._Ax, e._Ly, e._SG
+    Pn = p.num_shards
+    xa = "exchange A overlapped" if overlapped else "exchange A"
+    xb = "exchange B overlapped" if overlapped else "exchange B"
+    names = []
+
+    def add(stage, fn, inputs, outputs, name=None, out_meta=None):
+        g.add(stage, fn, inputs, outputs, name=name, out_meta=out_meta)
+        names.append(name or stage)
+
+    part_edges = []
+    for k, (c0, c1) in enumerate(chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        add(
+            "pack A",
+            lambda sticks, c0=c0, c1=c1: e._st_pack_a(sticks, (c0, c1)),
+            ("z_sticks",), (f"bufA{sfx}",), name=f"pack A{sfx}",
+            out_meta={f"bufA{sfx}": EdgeMeta(ct, (Pn, SG, W))},
+        )
+        add(
+            xa, e._st_exchange_a, (f"bufA{sfx}",), (f"recvA{sfx}",),
+            name=f"{xa}{sfx}",
+            out_meta={f"recvA{sfx}": EdgeMeta(ct, (Pn, SG, W))},
+        )
+        add(
+            "unpack A", e._st_unpack_a, (f"recvA{sfx}",), (f"grid{sfx}",),
+            name=f"unpack A{sfx}",
+            out_meta={f"grid{sfx}": EdgeMeta(ct, (Y, Ax, W))},
+        )
+        cur = f"grid{sfx}"
+        if e.is_r2c and e._have_x0:
+            add(
+                "plane symmetry", e._st_plane_symmetry, (cur,),
+                (f"gridh{sfx}",), name=f"plane symmetry{sfx}",
+                out_meta={f"gridh{sfx}": EdgeMeta(ct, (Y, Ax, W))},
+            )
+            cur = f"gridh{sfx}"
+        add(
+            "y transform", e._st_y_backward, (cur,), (f"gridy{sfx}",),
+            name=f"y transform{sfx}",
+            out_meta={f"gridy{sfx}": EdgeMeta(ct, (Y, Ax, W))},
+        )
+        add(
+            "pack B", e._st_pack_b, (f"gridy{sfx}",), (f"bufB{sfx}",),
+            name=f"pack B{sfx}",
+            out_meta={f"bufB{sfx}": EdgeMeta(ct, (P1, Ly, Ax, W))},
+        )
+        add(
+            xb, e._st_exchange_b, (f"bufB{sfx}",), (f"recvB{sfx}",),
+            name=f"{xb}{sfx}",
+            out_meta={f"recvB{sfx}": EdgeMeta(ct, (P1, Ly, Ax, W))},
+        )
+        add(
+            "unpack B", e._st_unpack_b, (f"recvB{sfx}",), (f"slab{sfx}",),
+            name=f"unpack B{sfx}",
+            out_meta={f"slab{sfx}": EdgeMeta(ct, (Ly, Xf, W))},
+        )
+        add(
+            "x transform", e._st_x_backward, (f"slab{sfx}",), (f"part{sfx}",),
+            name=f"x transform{sfx}",
+            out_meta={
+                f"part{sfx}": EdgeMeta(
+                    e.real_dtype if e.is_r2c else ct, (W, Ly, X)
+                )
+            },
+        )
+        part_edges.append(f"part{sfx}")
+    if e.is_r2c:
+        add(
+            "x transform", e._st_space_out, tuple(part_edges), ("space",),
+            name="x transform out",
+            out_meta={"space": EdgeMeta(e.real_dtype, (e._Lz, Ly, X))},
+        )
+        g.set_outputs(["space"])
+    else:
+        add(
+            "x transform", e._st_space_out, tuple(part_edges),
+            ("space_re", "space_im"), name="x transform out",
+            out_meta={
+                "space_re": EdgeMeta(e.real_dtype, (e._Lz, Ly, X)),
+                "space_im": EdgeMeta(e.real_dtype, (e._Lz, Ly, X)),
+            },
+        )
+        g.set_outputs(["space_re", "space_im"])
+    return names
+
+
+def _pencil_forward_head(g, e, chunks, overlapped, pair):
+    """Append the pre-unpack-A forward pencil pipeline per z-window chunk;
+    returns (added node names, receive edges)."""
+    p = e.params
+    ct = e.complex_dtype
+    rt = e.real_dtype
+    Xf, X = p.dim_x_freq, p.dim_x
+    P1, Ax, Ly, SG = e.P1, e._Ax, e._Ly, e._SG
+    Pn = p.num_shards
+    xa = "exchange A overlapped" if overlapped else "exchange A"
+    xb = "exchange B overlapped" if overlapped else "exchange B"
+    names = []
+    recv_edges = []
+
+    def add(stage, fn, inputs, outputs, name=None, out_meta=None):
+        g.add(stage, fn, inputs, outputs, name=name, out_meta=out_meta)
+        names.append(name or stage)
+
+    space_in = ("space_re",) if e.is_r2c else ("space_re", "space_im")
+    for k, (c0, c1) in enumerate(chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        if pair:
+            add(
+                "x transform",
+                (
+                    lambda sre, c0=c0, c1=c1: e._st_x_forward(
+                        sre, zwin=(c0, c1)
+                    )
+                )
+                if e.is_r2c
+                else (
+                    lambda sre, sim, c0=c0, c1=c1: e._st_x_forward(
+                        sre, sim, zwin=(c0, c1)
+                    )
+                ),
+                space_in, (f"hre{sfx}", f"him{sfx}"),
+                name=f"x transform{sfx}",
+                out_meta={
+                    f"hre{sfx}": EdgeMeta(rt, (Ly, P1 * Ax, W)),
+                    f"him{sfx}": EdgeMeta(rt, (Ly, P1 * Ax, W)),
+                },
+            )
+            add(
+                "pack B", e._st_pack_b_rev_pair, (f"hre{sfx}", f"him{sfx}"),
+                (f"bBre{sfx}", f"bBim{sfx}"), name=f"pack B{sfx}",
+                out_meta={
+                    f"bBre{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                    f"bBim{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                },
+            )
+            add(
+                xb,
+                lambda bre, bim: e._st_exchange_b_pair(bre, bim, reverse=True),
+                (f"bBre{sfx}", f"bBim{sfx}"), (f"rBre{sfx}", f"rBim{sfx}"),
+                name=f"{xb}{sfx}",
+                out_meta={
+                    f"rBre{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                    f"rBim{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                },
+            )
+            add(
+                "unpack B", e._st_unpack_b_rev_pair,
+                (f"rBre{sfx}", f"rBim{sfx}"), (f"gre{sfx}", f"gim{sfx}"),
+                name=f"unpack B{sfx}",
+                out_meta={
+                    f"gre{sfx}": EdgeMeta(rt, (p.dim_y, Ax, W)),
+                    f"gim{sfx}": EdgeMeta(rt, (p.dim_y, Ax, W)),
+                },
+            )
+            ymeta = EdgeMeta(rt, (p.dim_y, Ax, W))
+            add(
+                "y transform", e._st_y_forward, (f"gre{sfx}", f"gim{sfx}"),
+                (f"yre{sfx}", f"yim{sfx}"), name=f"y transform{sfx}",
+                out_meta={f"yre{sfx}": ymeta, f"yim{sfx}": ymeta},
+            )
+            add(
+                "pack A",
+                lambda gre, gim, c0=c0: e._st_pack_a_rev_pair(gre, gim, c0),
+                (f"yre{sfx}", f"yim{sfx}"), (f"bAre{sfx}", f"bAim{sfx}"),
+                name=f"pack A{sfx}",
+                out_meta={
+                    f"bAre{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                    f"bAim{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                },
+            )
+            add(
+                xa,
+                lambda bre, bim: e._st_exchange_a_pair(bre, bim, reverse=True),
+                (f"bAre{sfx}", f"bAim{sfx}"), (f"rAre{sfx}", f"rAim{sfx}"),
+                name=f"{xa}{sfx}",
+                out_meta={
+                    f"rAre{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                    f"rAim{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                },
+            )
+            recv_edges.append((f"rAre{sfx}", f"rAim{sfx}"))
+        else:
+            add(
+                "x transform",
+                (
+                    lambda sre, c0=c0, c1=c1: e._st_x_forward(
+                        sre, zwin=(c0, c1)
+                    )
+                )
+                if e.is_r2c
+                else (
+                    lambda sre, sim, c0=c0, c1=c1: e._st_x_forward(
+                        sre, sim, zwin=(c0, c1)
+                    )
+                ),
+                space_in, (f"freq{sfx}",), name=f"x transform{sfx}",
+                out_meta={f"freq{sfx}": EdgeMeta(ct, (W, Ly, Xf))},
+            )
+            add(
+                "pack B", e._st_pack_b_rev, (f"freq{sfx}",), (f"bufB{sfx}",),
+                name=f"pack B{sfx}",
+                out_meta={f"bufB{sfx}": EdgeMeta(ct, (P1, Ly, Ax, W))},
+            )
+            add(
+                xb, lambda b: e._st_exchange_b(b, reverse=True),
+                (f"bufB{sfx}",), (f"recvB{sfx}",), name=f"{xb}{sfx}",
+                out_meta={f"recvB{sfx}": EdgeMeta(ct, (P1, Ly, Ax, W))},
+            )
+            add(
+                "unpack B", e._st_unpack_b_rev, (f"recvB{sfx}",),
+                (f"grid{sfx}",), name=f"unpack B{sfx}",
+                out_meta={f"grid{sfx}": EdgeMeta(ct, (p.dim_y, Ax, W))},
+            )
+            add(
+                "y transform", e._st_y_forward, (f"grid{sfx}",),
+                (f"gridy{sfx}",), name=f"y transform{sfx}",
+                out_meta={f"gridy{sfx}": EdgeMeta(ct, (p.dim_y, Ax, W))},
+            )
+            add(
+                "pack A",
+                lambda grid, c0=c0: e._st_pack_a_rev(grid, c0),
+                (f"gridy{sfx}",), (f"bufA{sfx}",), name=f"pack A{sfx}",
+                out_meta={f"bufA{sfx}": EdgeMeta(ct, (Pn, SG, W))},
+            )
+            add(
+                xa, lambda b: e._st_exchange_a(b, reverse=True),
+                (f"bufA{sfx}",), (f"recvA{sfx}",), name=f"{xa}{sfx}",
+                out_meta={f"recvA{sfx}": EdgeMeta(ct, (Pn, SG, W))},
+            )
+            recv_edges.append((f"recvA{sfx}",))
+    return names, recv_edges
+
+
+def _lower_pencil(e, pair: bool):
+    p = e.params
+    rt, ct = e.real_dtype, e.complex_dtype
+    S, V = e._S, e._V
+    Z = p.dim_z
+    Lz, Ly = e._Lz, e._Ly
+    X = p.dim_x
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re", dtype=rt, shape=(V,))
+        g.add_input("values_im", dtype=rt, shape=(V,))
+        g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        if pair:
+            g.add(
+                "compression", e._st_decompress, ("values_re", "values_im"),
+                ("sre", "sim"),
+                out_meta={
+                    "sre": EdgeMeta(rt, (S, Z)), "sim": EdgeMeta(rt, (S, Z))
+                },
+            )
+            cur = ("sre", "sim")
+            if e.is_r2c and p.zero_stick_shard >= 0:
+                g.add(
+                    "stick symmetry", e._st_stick_symmetry, cur,
+                    ("shre", "shim"),
+                    out_meta={
+                        "shre": EdgeMeta(rt, (S, Z)),
+                        "shim": EdgeMeta(rt, (S, Z)),
+                    },
+                )
+                cur = ("shre", "shim")
+            g.add(
+                "z transform", e._st_z_backward, cur, ("zre", "zim"),
+                out_meta={
+                    "zre": EdgeMeta(rt, (S, Z)), "zim": EdgeMeta(rt, (S, Z))
+                },
+            )
+            names = _pencil_backward_tail_pair(
+                g, e, [(0, Lz)], overlapped=False
+            )
+            if e._overlap > 1:
+                for nm in names:
+                    g.remove(nm)
+                _pencil_backward_tail_pair(g, e, e._chunks, overlapped=True)
+        else:
+            g.add(
+                "compression", e._st_decompress,
+                ("values_re", "values_im", "value_indices"), ("sticks",),
+                out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+            )
+            cur = "sticks"
+            if e.is_r2c and p.zero_stick_shard >= 0:
+                g.add(
+                    "stick symmetry", e._st_stick_symmetry, (cur,),
+                    ("sticks_h",),
+                    out_meta={"sticks_h": EdgeMeta(ct, (S, Z))},
+                )
+                cur = "sticks_h"
+            g.add(
+                "z transform", e._st_z_backward, (cur,), ("z_sticks",),
+                out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+            )
+            names = _pencil_backward_tail(g, e, [(0, Lz)], overlapped=False)
+            if e._overlap > 1:
+                for nm in names:
+                    g.remove(nm)
+                _pencil_backward_tail(g, e, e._chunks, overlapped=True)
+        return g
+
+    def forward(s):
+        scale = None if s.name == "NONE" else 1.0 / p.total_size
+        g = StageGraph("forward")
+        g.add_input("space_re", dtype=rt, shape=(Lz, Ly, X))
+        if not e.is_r2c:
+            g.add_input("space_im", dtype=rt, shape=(Lz, Ly, X))
+        g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        names, recv_edges = _pencil_forward_head(
+            g, e, [(0, Lz)], overlapped=False, pair=pair
+        )
+        if e._overlap > 1:
+            for nm in names:
+                g.remove(nm)
+            _, recv_edges = _pencil_forward_head(
+                g, e, e._chunks, overlapped=True, pair=pair
+            )
+        if pair:
+            flat = tuple(r[0] for r in recv_edges) + tuple(
+                r[1] for r in recv_edges
+            )
+            g.add(
+                "unpack A", e._st_unpack_a_rev_pair, flat, ("sre", "sim"),
+                out_meta={
+                    "sre": EdgeMeta(rt, (S, Z)), "sim": EdgeMeta(rt, (S, Z))
+                },
+            )
+            g.add(
+                "z transform",
+                lambda sre, sim: e._st_z_forward(sre, sim, s),
+                ("sre", "sim"), ("zre", "zim"),
+                out_meta={
+                    "zre": EdgeMeta(rt, (S, Z)), "zim": EdgeMeta(rt, (S, Z))
+                },
+            )
+            g.add(
+                "compression", e._st_compress, ("zre", "zim"),
+                ("out_re", "out_im"),
+                out_meta={
+                    "out_re": EdgeMeta(rt, (V,)), "out_im": EdgeMeta(rt, (V,))
+                },
+            )
+        else:
+            flat = tuple(r[0] for r in recv_edges)
+            g.add(
+                "unpack A", e._st_unpack_a_rev, flat, ("sticks",),
+                out_meta={"sticks": EdgeMeta(ct, (S, Z))},
+            )
+            g.add(
+                "z transform", e._st_z_forward, ("sticks",), ("z_sticks",),
+                out_meta={"z_sticks": EdgeMeta(ct, (S, Z))},
+            )
+            g.add(
+                "compression",
+                lambda sticks, vi: e._st_compress(sticks, vi, scale),
+                ("z_sticks", "value_indices"), ("out_re", "out_im"),
+                out_meta={
+                    "out_re": EdgeMeta(rt, (V,)), "out_im": EdgeMeta(rt, (V,))
+                },
+            )
+        g.set_outputs(["out_re", "out_im"])
+        return g
+
+    return {"backward": backward(), "forward": {s: forward(s) for s in _scalings()}}
+
+
+def _pencil_backward_tail_pair(g, e, chunks, overlapped):
+    """Pair-array (MXU) variant of :func:`_pencil_backward_tail`."""
+    p = e.params
+    rt = e.real_dtype
+    X = p.dim_x
+    P1, Ax, Ly, SG = e.P1, e._Ax, e._Ly, e._SG
+    Pn = p.num_shards
+    xa = "exchange A overlapped" if overlapped else "exchange A"
+    xb = "exchange B overlapped" if overlapped else "exchange B"
+    names = []
+
+    def add(stage, fn, inputs, outputs, name=None, out_meta=None):
+        g.add(stage, fn, inputs, outputs, name=name, out_meta=out_meta)
+        names.append(name or stage)
+
+    part_edges = []
+    for k, (c0, c1) in enumerate(chunks):
+        W = c1 - c0
+        sfx = f"@{k}"
+        add(
+            "pack A",
+            lambda zre, zim, c0=c0, c1=c1: e._st_pack_a_pair(
+                zre, zim, (c0, c1)
+            ),
+            ("zre", "zim"), (f"bAre{sfx}", f"bAim{sfx}"),
+            name=f"pack A{sfx}",
+            out_meta={
+                f"bAre{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                f"bAim{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+            },
+        )
+        add(
+            xa, e._st_exchange_a_pair, (f"bAre{sfx}", f"bAim{sfx}"),
+            (f"rAre{sfx}", f"rAim{sfx}"), name=f"{xa}{sfx}",
+            out_meta={
+                f"rAre{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+                f"rAim{sfx}": EdgeMeta(rt, (Pn, SG, W)),
+            },
+        )
+        add(
+            "unpack A", e._st_unpack_a_pair, (f"rAre{sfx}", f"rAim{sfx}"),
+            (f"gre{sfx}", f"gim{sfx}"), name=f"unpack A{sfx}",
+            out_meta={
+                f"gre{sfx}": EdgeMeta(rt, (p.dim_y, Ax, W)),
+                f"gim{sfx}": EdgeMeta(rt, (p.dim_y, Ax, W)),
+            },
+        )
+        cur = (f"gre{sfx}", f"gim{sfx}")
+        gmeta = EdgeMeta(rt, (p.dim_y, Ax, W))
+        if e.is_r2c and e._have_x0:
+            add(
+                "plane symmetry", e._st_plane_symmetry, cur,
+                (f"ghre{sfx}", f"ghim{sfx}"), name=f"plane symmetry{sfx}",
+                out_meta={f"ghre{sfx}": gmeta, f"ghim{sfx}": gmeta},
+            )
+            cur = (f"ghre{sfx}", f"ghim{sfx}")
+        add(
+            "y transform", e._st_y_backward, cur, (f"yre{sfx}", f"yim{sfx}"),
+            name=f"y transform{sfx}",
+            out_meta={f"yre{sfx}": gmeta, f"yim{sfx}": gmeta},
+        )
+        add(
+            "pack B", e._st_pack_b_pair, (f"yre{sfx}", f"yim{sfx}"),
+            (f"bBre{sfx}", f"bBim{sfx}"), name=f"pack B{sfx}",
+            out_meta={
+                f"bBre{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                f"bBim{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+            },
+        )
+        add(
+            xb, e._st_exchange_b_pair, (f"bBre{sfx}", f"bBim{sfx}"),
+            (f"rBre{sfx}", f"rBim{sfx}"), name=f"{xb}{sfx}",
+            out_meta={
+                f"rBre{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+                f"rBim{sfx}": EdgeMeta(rt, (P1, Ly, Ax, W)),
+            },
+        )
+        if e.is_r2c:
+            add(
+                "x transform", e._st_x_backward,
+                (f"rBre{sfx}", f"rBim{sfx}"), (f"part{sfx}",),
+                name=f"x transform{sfx}",
+                out_meta={f"part{sfx}": EdgeMeta(rt, (W, Ly, X))},
+            )
+            part_edges.append((f"part{sfx}",))
+        else:
+            add(
+                "x transform", e._st_x_backward,
+                (f"rBre{sfx}", f"rBim{sfx}"),
+                (f"partre{sfx}", f"partim{sfx}"), name=f"x transform{sfx}",
+                out_meta={
+                    f"partre{sfx}": EdgeMeta(rt, (W, Ly, X)),
+                    f"partim{sfx}": EdgeMeta(rt, (W, Ly, X)),
+                },
+            )
+            part_edges.append((f"partre{sfx}", f"partim{sfx}"))
+    if e.is_r2c:
+        add(
+            "x transform", e._st_space_out,
+            tuple(pe[0] for pe in part_edges), ("space",),
+            name="x transform out",
+            out_meta={"space": EdgeMeta(rt, (e._Lz, Ly, X))},
+        )
+        g.set_outputs(["space"])
+    else:
+        flat = tuple(pe[0] for pe in part_edges) + tuple(
+            pe[1] for pe in part_edges
+        )
+        add(
+            "x transform", e._st_space_out, flat, ("space_re", "space_im"),
+            name="x transform out",
+            out_meta={
+                "space_re": EdgeMeta(rt, (e._Lz, Ly, X)),
+                "space_im": EdgeMeta(rt, (e._Lz, Ly, X)),
+            },
+        )
+        g.set_outputs(["space_re", "space_im"])
+    return names
+
+
+def _lower_pencil_xla(e):
+    return _lower_pencil(e, pair=False)
+
+
+def _lower_pencil_mxu(e):
+    return _lower_pencil(e, pair=True)
+
+
+_BUILDERS = {
+    "LocalExecution": _lower_local_xla,
+    "MxuLocalExecution": _lower_local_mxu,
+    "DistributedExecution": _lower_slab_xla,
+    "MxuDistributedExecution": _lower_slab_mxu,
+    "Pencil2Execution": _lower_pencil_xla,
+    "MxuPencil2Execution": _lower_pencil_mxu,
+}

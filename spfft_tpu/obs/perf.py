@@ -394,6 +394,13 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
         # overlap work (MULTICHIP_r06 and older baselines) stay valid —
         # consumers read a missing value as 1
         "overlap_chunks": overlap_chunks,
+        # fusion state (spfft_tpu.ir): fused-single-program vs staged rows
+        # are different scenarios — part of the row identity like
+        # overlap_chunks, and like it validation-optional (pre-IR captures
+        # read as fused: the monolithic jits WERE one program per direction)
+        "fused": bool(
+            getattr(getattr(transform._exec, "_ir", None), "fused", True)
+        ),
         "seconds_per_pair": seconds,
         "repeats": repeats,
         "gflops": (dense_flops / seconds / 1e9) if seconds > 0 else 0.0,

@@ -86,6 +86,14 @@ TUNING_KEYS = (
 # a trial row is either measured ("ms") or isolated-failed ("error")
 TRIAL_KEYS = ("label",)
 TRIAL_RESULT_KEYS = ("ms", "error")
+# Stage-graph IR provenance: fusion decision, active path, request source,
+# per-direction stage lists, donation map. This module stays import-free,
+# so the tuple is a mirror of spfft_tpu/ir/compile.py IR_KEYS — lint
+# check 9 pins the two literals equal (the STAGES/SITES/EVENTS contract).
+# Always present on fresh cards; pre-IR captures (BENCH_r05 and older)
+# omit it and stay valid (same rule as the exchange overlap_chunks key).
+IR_SECTION_KEYS = ("fused", "path", "requested", "stages", "donation")
+
 # Scheduler-placement provenance (spfft_tpu.sched.placement): present on
 # plans the task-graph placement pass built; pins the decision record so a
 # placed plan's card alone answers "which device, decided how" — wisdom
@@ -264,6 +272,12 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         # self-verification state (spfft_tpu.verify): mode, armed checks,
         # tolerances, and the engine circuit breaker — schema-pinned
         "verification": _verification_section(transform),
+        # stage-graph IR provenance (spfft_tpu.ir): per-direction stage
+        # lists, the fusion decision (fused single program vs staged
+        # per-node dispatch vs the ir_lower_failed legacy rung), and the
+        # donation map of the fused consuming backward — schema-pinned
+        # (IR_KEYS below)
+        "ir": ex._ir.describe(),
     }
     tuning_record = getattr(transform, "_tuning", None)
     if tuning_record is not None:
@@ -384,6 +398,16 @@ def validate_plan_card(card: dict) -> list:
         missing.extend(
             f"compiled.{k}" for k in COMPILED_KEYS if k not in card["compiled"]
         )
+    if "ir" in card:
+        rec = card["ir"]
+        missing.extend(f"ir.{k}" for k in IR_SECTION_KEYS if k not in rec)
+        if rec.get("path") not in ("fused", "staged", "legacy"):
+            missing.append(f"ir.path (unknown: {rec.get('path')!r})")
+        don = rec.get("donation")
+        if not isinstance(don, dict) or not {"backward", "forward"} <= set(
+            don or {}
+        ):
+            missing.append("ir.donation.backward|forward")
     if "placement" in card:
         rec = card["placement"]
         missing.extend(f"placement.{k}" for k in PLACEMENT_KEYS if k not in rec)

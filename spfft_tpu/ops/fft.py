@@ -100,9 +100,42 @@ def c2r_matrices(n: int, scale: float = 1.0):
     return scale * (c[:, None] * np.cos(theta)), scale * (c[:, None] * np.sin(theta))
 
 
+TWIDDLE_BF16_ENV = "SPFFT_TPU_TWIDDLE_BF16"
+
+
+def twiddle_bf16_enabled() -> bool:
+    """The bf16-twiddle mixed-precision knob: store the MXU engines' DFT
+    stage matrices in bfloat16 (halving their HBM footprint and letting the
+    MXU run mixed bf16xf32 contractions) while activations stay f32.
+    f32 plans only — f64 plans ignore the knob (a bf16 twiddle under an f64
+    contract would silently discard the precision the caller asked for).
+    Off by default; under ``policy="tuned"`` the variant is an autotuner
+    candidate (``tuning/candidates.py`` ``mxu/bf16-twiddle``) so the
+    accuracy/speed trade is measured, not guessed."""
+    raw = os.environ.get(TWIDDLE_BF16_ENV, "0")
+    if raw not in ("0", "1"):
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(
+            f"{TWIDDLE_BF16_ENV} must be 0 or 1, got {raw!r}"
+        )
+    return raw == "1"
+
+
+def twiddle_dtype(real_dtype):
+    """The storage dtype of DFT stage matrices for an engine running at
+    ``real_dtype`` — bfloat16 under the bf16-twiddle knob (f32 plans only),
+    else the engine dtype."""
+    if np.dtype(real_dtype) == np.dtype(np.float32) and twiddle_bf16_enabled():
+        return jnp.bfloat16
+    return real_dtype
+
+
 def matrix_pair(w, real_dtype):
-    """Complex matrix -> (re, im) real pair in the engine dtype."""
-    return w.real.astype(real_dtype), w.imag.astype(real_dtype)
+    """Complex matrix -> (re, im) real pair in the engine's twiddle dtype
+    (the engine dtype, or bfloat16 under SPFFT_TPU_TWIDDLE_BF16)."""
+    dt = twiddle_dtype(real_dtype)
+    return w.real.astype(dt), w.imag.astype(dt)
 
 
 def zy_stage_matrices(dim_z: int, dim_y: int, total_size: int, real_dtype):
@@ -162,10 +195,11 @@ def x_stage_matrices(dim_x: int, ux, num_rows: int, r2c: bool, real_dtype):
         return out
 
     if r2c:
+        dt = twiddle_dtype(rt)
         a, b = c2r_matrices(dim_x)  # (Xf, X)
-        wx_b = (pad_rows(a).astype(rt), pad_rows(b).astype(rt))  # (A, X)
+        wx_b = (pad_rows(a).astype(dt), pad_rows(b).astype(dt))  # (A, X)
         a, b = r2c_matrices(dim_x)  # (X, Xf)
-        wx_f = (pad_rows(a.T).T.astype(rt), pad_rows(b.T).T.astype(rt))  # (X, A)
+        wx_f = (pad_rows(a.T).T.astype(dt), pad_rows(b.T).T.astype(dt))  # (X, A)
         return wx_b, wx_f
 
     wx_b = matrix_pair(c2c_matrix(dim_x, +1, row_perm=ux, num_rows=num_rows), rt)

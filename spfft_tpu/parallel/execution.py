@@ -116,6 +116,26 @@ class PaddingHelpers:
     remote shards.
     """
 
+    # Mesh axes the engine's per-shard IR graphs are mapped over
+    # (spfft_tpu.ir.compile derives partition specs from these; the 2-D
+    # pencil engines override with their (AX1, AX2) pair).
+    _IR_AXES = (FFT_AXIS,)
+
+    def _ir_spec(self) -> dict:
+        """The :mod:`spfft_tpu.ir` compile-layer contract of the mesh
+        engines: per-shard graphs compiled under ``shard_map`` over
+        :data:`_IR_AXES`, the engine's monolithic jits as the
+        ``ir_lower_failed`` legacy rung."""
+        from .mesh import shard_mapper
+
+        return {
+            "kind": "mesh",
+            "axes": self._IR_AXES,
+            "sm": shard_mapper(self.mesh),
+            "legacy_backward": self._backward,
+            "legacy_forward": self._forward,
+        }
+
     def _local_shard_ids(self):
         # flat device index == shard id only on a dedicated 1-D fft mesh; the
         # per-process block-assembly path below relies on that
@@ -497,6 +517,7 @@ class DistributedExecution(PaddingHelpers):
         mesh,
         exchange_type: ExchangeType = ExchangeType.DEFAULT,
         overlap: int = 1,
+        fuse=None,
     ):
         self.params = params
         self.mesh = mesh
@@ -596,6 +617,15 @@ class DistributedExecution(PaddingHelpers):
             )
             self._forward[scaling] = jax.jit(self._forward_sm[scaling])
 
+        # Stage-graph IR (spfft_tpu.ir): the per-shard pipeline lowered to a
+        # validated stage graph (overlap chunking applied as a graph
+        # rewrite), fused into one jitted shard_map program per direction —
+        # or run node-per-dispatch under SPFFT_TPU_FUSE=0. The monolithic
+        # jits above remain the ir_lower_failed rung and the trace path.
+        from ..ir.compile import init_engine_ir
+
+        self._ir = init_engine_ir(self, fuse)
+
     @property
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
@@ -629,6 +659,138 @@ class DistributedExecution(PaddingHelpers):
         """One ``all_to_all`` over the mesh axis in the configured wire format."""
         return self._complex_wire_exchange(buffer, FFT_AXIS)
 
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One per-shard implementation per stage, shared by the monolithic impls
+    # below (bulk AND overlapped paths — the chunk loop calls the same
+    # bodies on sub-windows) and the IR node fns lowered from this engine
+    # (spfft_tpu.ir.lower).
+
+    def _st_decompress(self, values_re, values_im, value_indices):
+        # decompress: scatter local packed values into padded local sticks. No
+        # unique_indices hint: padding slots share the same out-of-range sentinel.
+        p = self.params
+        S, Z = self._S, p.dim_z
+        values = jax.lax.complex(
+            values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
+        )
+        flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
+        flat = flat.at[value_indices].set(values, mode="drop")
+        return flat[: S * Z].reshape(S, Z)
+
+    def _st_stick_symmetry(self, sticks):
+        p = self.params
+        row = sticks[p.zero_stick_row]
+        filled = symmetry.hermitian_fill_1d(row, axis=0)
+        is_owner = jax.lax.axis_index(FFT_AXIS) == p.zero_stick_shard
+        return sticks.at[p.zero_stick_row].set(jnp.where(is_owner, filled, row))
+
+    def _st_z_backward(self, sticks):
+        return jnp.fft.ifft(sticks, axis=1)
+
+    def _st_pack(self, z_sticks):
+        """(W, Z) z-transformed stick rows -> (P, L, W) exchange blocks,
+        padding planes zero-filled — any stick window W <= S (the bulk path
+        is the W == S case; the OVERLAPPED chunks pass their windows)."""
+        p = self.params
+        buf = jnp.take(
+            z_sticks.T, jnp.asarray(self._pack_z), axis=0, mode="fill",
+            fill_value=0,
+        )
+        return buf.reshape(p.num_shards, self._L, z_sticks.shape[0])
+
+    def _st_exchange(self, buf):
+        return self._exchange(buf)
+
+    def _st_unpack(self, *recvs):
+        """(P, L, W) received block(s) -> (L, Y, Xf) slab; multiple chunk
+        receives reassemble the padded (P, L, S) layout first."""
+        recv = recvs[0] if len(recvs) == 1 else jnp.concatenate(recvs, axis=2)
+        return self._unpack_slab(recv)
+
+    def _st_ragged_exchange_backward(self, z_sticks):
+        return self._ragged.backward(
+            (z_sticks,), wire=self._ragged_wire, real_dtype=self.real_dtype
+        )[0]
+
+    def _st_ragged_unpack(self, planes):
+        p = self.params
+        return planes.T.reshape(self._L, p.dim_y, p.dim_x_freq)
+
+    def _st_plane_symmetry(self, slab):
+        return symmetry.apply_plane_symmetry(slab)
+
+    def _st_y_backward(self, slab):
+        return jnp.fft.ifft(slab, axis=1)
+
+    def _st_x_backward(self, slab):
+        p = self.params
+        total = np.asarray(p.total_size, dtype=self.real_dtype)
+        if self.is_r2c:
+            return (
+                jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype)
+                * total
+            )
+        out = jnp.fft.ifft(slab, axis=2) * total
+        return out.real, out.imag
+
+    def _st_x_forward(self, space_re, space_im=None):
+        p = self.params
+        if self.is_r2c:
+            slab = space_re.astype(self.real_dtype)
+            return jnp.fft.rfft(slab, n=p.dim_x, axis=2).astype(self.complex_dtype)
+        slab = jax.lax.complex(
+            space_re.astype(self.real_dtype), space_im.astype(self.real_dtype)
+        )
+        return jnp.fft.fft(slab, axis=2)
+
+    def _st_y_forward(self, grid):
+        return jnp.fft.fft(grid, axis=1)
+
+    def _st_pack_fwd(self, grid, c0=0, c1=None):
+        """Forward pack: gather every shard's stick columns (window
+        ``[c0, c1)`` of the padded stick order) from my planes ->
+        (P, L, W) blocks — bulk path and OVERLAPPED chunks share it."""
+        p = self.params
+        S, L = self._S, self._L
+        c1 = S if c1 is None else c1
+        flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
+        cols = self._yx_flat.reshape(p.num_shards, S)[:, c0:c1].reshape(-1)
+        planes = jnp.take(
+            flat_grid, jnp.asarray(cols), axis=1, mode="fill", fill_value=0
+        )
+        return planes.reshape(L, p.num_shards, c1 - c0).transpose(1, 0, 2)
+
+    def _st_unpack_fwd(self, rc):
+        """(P, L, W) received blocks -> (W, Z) stick z-rows via the
+        global-z map — any window width."""
+        p = self.params
+        W = rc.shape[2]
+        sz = rc.transpose(2, 0, 1).reshape(W, p.num_shards * self._L)
+        return jnp.take(sz, jnp.asarray(self._unpack_z), axis=1)
+
+    def _st_z_forward(self, sz):
+        return jnp.fft.fft(sz, axis=1)
+
+    def _st_concat_sticks(self, *parts):
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+    def _st_ragged_exchange_forward(self, grid):
+        return self._ragged.forward(
+            (grid.reshape(self._L, -1).T,),  # -> (Y*Xf, L) slot-major rows
+            wire=self._ragged_wire, real_dtype=self.real_dtype,
+        )[0]
+
+    def _st_compress(self, sticks, value_indices, scale):
+        values = jnp.take(
+            sticks.reshape(-1), value_indices, mode="fill", fill_value=0
+        )
+        if scale is not None:
+            values = values * np.asarray(scale, dtype=self.real_dtype)
+        return (
+            values.real.astype(self.real_dtype),
+            values.imag.astype(self.real_dtype),
+        )
+
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _unpack_slab(self, recv):
@@ -648,28 +810,15 @@ class DistributedExecution(PaddingHelpers):
 
     def _backward_impl(self, values_re, values_im, value_indices):
         p = self.params
-        S, L, Z = self._S, self._L, p.dim_z
         # stage scopes: canonical obs.STAGES labels (profiler attribution)
         with jax.named_scope("compression"):
-            values = jax.lax.complex(
-                values_re[0].astype(self.real_dtype),
-                values_im[0].astype(self.real_dtype),
+            sticks = self._st_decompress(
+                values_re[0], values_im[0], value_indices[0]
             )
-
-            # decompress: scatter local packed values into padded local sticks. No
-            # unique_indices hint: padding slots share the same out-of-range sentinel.
-            flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-            flat = flat.at[value_indices[0]].set(values, mode="drop")
-            sticks = flat[: S * Z].reshape(S, Z)
 
         if self.is_r2c and p.zero_stick_shard >= 0:
             with jax.named_scope("stick symmetry"):
-                row = sticks[p.zero_stick_row]
-                filled = symmetry.hermitian_fill_1d(row, axis=0)
-                is_owner = jax.lax.axis_index(FFT_AXIS) == p.zero_stick_shard
-                sticks = sticks.at[p.zero_stick_row].set(
-                    jnp.where(is_owner, filled, row)
-                )
+                sticks = self._st_stick_symmetry(sticks)
 
         if self._overlap > 1:
             # OVERLAPPED discipline: each stick chunk runs its own
@@ -681,41 +830,29 @@ class DistributedExecution(PaddingHelpers):
             recvs = []
             for c0, c1 in self._chunks:
                 with jax.named_scope("z transform"):
-                    zc = jnp.fft.ifft(sticks[c0:c1], axis=1)
+                    zc = self._st_z_backward(sticks[c0:c1])
                 with jax.named_scope("pack"):
-                    buf = jnp.take(
-                        zc.T, jnp.asarray(self._pack_z), axis=0, mode="fill",
-                        fill_value=0,
-                    ).reshape(p.num_shards, L, c1 - c0)
+                    buf = self._st_pack(zc)
                 with jax.named_scope("exchange overlapped"):
                     recvs.append(self._exchange(buf))
-            recv = jnp.concatenate(recvs, axis=2)
             with jax.named_scope("unpack"):
-                slab = self._unpack_slab(recv)
+                slab = self._st_unpack(*recvs)
         else:
             with jax.named_scope("z transform"):
-                sticks = jnp.fft.ifft(sticks, axis=1)
+                sticks = self._st_z_backward(sticks)
 
             if self._ragged is not None:
                 # exact-counts exchange: ppermute chain, blocks sized
                 # sticks_i x planes_j (the reference's Alltoallv discipline,
                 # see parallel/ragged.py)
                 with jax.named_scope("exchange"):
-                    planes = self._ragged.backward(
-                        (sticks,), wire=self._ragged_wire,
-                        real_dtype=self.real_dtype,
-                    )[0]  # (Y*Xf, L) slot-major plane rows
+                    planes = self._st_ragged_exchange_backward(sticks)
                 with jax.named_scope("unpack"):
-                    slab = planes.T.reshape(L, p.dim_y, p.dim_x_freq)
+                    slab = self._st_ragged_unpack(planes)
             else:
                 # pack: (Z, S) -> (P, L, S) blocks, padding planes zero-filled
                 with jax.named_scope("pack"):
-                    sticks_z = sticks.T
-                    buffer = jnp.take(
-                        sticks_z, jnp.asarray(self._pack_z), axis=0, mode="fill",
-                        fill_value=0,
-                    )
-                    buffer = buffer.reshape(p.num_shards, L, S)
+                    buffer = self._st_pack(sticks)
 
                 # exchange: shard r receives every shard's sticks on r's planes
                 #   (the MPI_Alltoall of the reference's BUFFERED transpose,
@@ -725,84 +862,54 @@ class DistributedExecution(PaddingHelpers):
 
                 # unpack: scatter all sticks into the local slab planes
                 with jax.named_scope("unpack"):
-                    slab = self._unpack_slab(recv)
+                    slab = self._st_unpack(recv)
 
         if self.is_r2c:
             with jax.named_scope("plane symmetry"):
-                slab = symmetry.apply_plane_symmetry(slab)
+                slab = self._st_plane_symmetry(slab)
         with jax.named_scope("y transform"):
-            slab = jnp.fft.ifft(slab, axis=1)
-        total = np.asarray(p.total_size, dtype=self.real_dtype)
+            slab = self._st_y_backward(slab)
         with jax.named_scope("x transform"):
+            out = self._st_x_backward(slab)
             if self.is_r2c:
-                out = (
-                    jnp.fft.irfft(slab, n=p.dim_x, axis=2).astype(self.real_dtype)
-                    * total
-                )
                 return out[None]
-            out = jnp.fft.ifft(slab, axis=2) * total
-            return out.real[None], out.imag[None]
+            return out[0][None], out[1][None]
 
     def _forward_impl(self, space_re, *rest, scale):
-        p = self.params
-        S, L = self._S, self._L
         with jax.named_scope("x transform"):
             if self.is_r2c:
                 (value_indices,) = rest
-                slab = space_re[0].astype(self.real_dtype)
-                grid = jnp.fft.rfft(slab, n=p.dim_x, axis=2).astype(self.complex_dtype)
+                grid = self._st_x_forward(space_re[0])
             else:
                 space_im, value_indices = rest
-                slab = jax.lax.complex(
-                    space_re[0].astype(self.real_dtype),
-                    space_im[0].astype(self.real_dtype),
-                )
-                grid = jnp.fft.fft(slab, axis=2)
+                grid = self._st_x_forward(space_re[0], space_im[0])
         with jax.named_scope("y transform"):
-            grid = jnp.fft.fft(grid, axis=1)
+            grid = self._st_y_forward(grid)
 
         if self._overlap > 1:
             # OVERLAPPED discipline (forward direction): chunk k's received
             # sticks run their z-FFTs while chunk k+1's collective is in
             # flight — the mirror of the backward chunk pipeline
-            flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
-            yx_by_shard = self._yx_flat.reshape(p.num_shards, S)
             parts = []
             for c0, c1 in self._chunks:
                 with jax.named_scope("pack"):
-                    planes = jnp.take(
-                        flat_grid,
-                        jnp.asarray(yx_by_shard[:, c0:c1].reshape(-1)),
-                        axis=1, mode="fill", fill_value=0,
-                    )
-                    buf = planes.reshape(L, p.num_shards, c1 - c0).transpose(
-                        1, 0, 2
-                    )
+                    buf = self._st_pack_fwd(grid, c0, c1)
                 with jax.named_scope("exchange overlapped"):
                     rc = self._exchange(buf)
                 with jax.named_scope("unpack"):
-                    sz = rc.transpose(2, 0, 1).reshape(c1 - c0, p.num_shards * L)
-                    sz = jnp.take(sz, jnp.asarray(self._unpack_z), axis=1)
+                    sz = self._st_unpack_fwd(rc)
                 with jax.named_scope("z transform"):
-                    parts.append(jnp.fft.fft(sz, axis=1))
-            sticks = jnp.concatenate(parts, axis=0)
+                    parts.append(self._st_z_forward(sz))
+            sticks = self._st_concat_sticks(*parts)
         else:
             if self._ragged is not None:
                 with jax.named_scope("exchange"):
-                    sticks = self._ragged.forward(
-                        (grid.reshape(L, -1).T,),  # -> (Y*Xf, L) slot-major rows
-                        wire=self._ragged_wire, real_dtype=self.real_dtype,
-                    )[0]
+                    sticks = self._st_ragged_exchange_forward(grid)
             else:
                 # pack: gather every shard's stick columns from my planes
                 # -> (P, L, S)
                 with jax.named_scope("pack"):
-                    flat_grid = grid.reshape(L, p.dim_y * p.dim_x_freq)
-                    planes = jnp.take(
-                        flat_grid, jnp.asarray(self._yx_flat), axis=1,
-                        mode="fill", fill_value=0,
-                    )
-                    buffer = planes.reshape(L, p.num_shards, S).transpose(1, 0, 2)
+                    buffer = self._st_pack_fwd(grid)
 
                 # exchange: shard r receives its own sticks' values on every
                 # shard's planes
@@ -811,35 +918,30 @@ class DistributedExecution(PaddingHelpers):
 
                 # unpack: (P, L, S) -> (S, Z) via the global-z map
                 with jax.named_scope("unpack"):
-                    sticks_z = recv.transpose(2, 0, 1).reshape(
-                        S, p.num_shards * L
-                    )
-                    sticks = jnp.take(sticks_z, jnp.asarray(self._unpack_z), axis=1)
+                    sticks = self._st_unpack_fwd(recv)
 
             with jax.named_scope("z transform"):
-                sticks = jnp.fft.fft(sticks, axis=1)
+                sticks = self._st_z_forward(sticks)
 
         # compress: gather local packed values (+ optional scaling)
         with jax.named_scope("compression"):
-            values = jnp.take(
-                sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0
-            )
-            if scale is not None:
-                values = values * np.asarray(scale, dtype=self.real_dtype)
-            return (
-                values.real.astype(self.real_dtype)[None],
-                values.imag.astype(self.real_dtype)[None],
-            )
+            vre, vim = self._st_compress(sticks, value_indices[0], scale)
+            return vre[None], vim[None]
 
     # ---- device-side entry points ---------------------------------------------
 
     def backward_pair(self, values_re, values_im):
-        """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
-        return self._backward(values_re, values_im, self._value_indices)
+        """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C).
+        Routed through the IR runtime (fused single shard_map program by
+        default, the staged per-node reference under ``SPFFT_TPU_FUSE=0``)."""
+        return self._ir.run_backward(values_re, values_im, self._value_indices)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
-        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
+        s = ScalingType(scaling)
+        if self.is_r2c:
+            return self._ir.run_forward(s, space_re, self._value_indices)
+        return self._ir.run_forward(s, space_re, space_im, self._value_indices)
 
     # Un-jitted traceables (see LocalExecution.trace_backward for rationale).
 

@@ -188,6 +188,31 @@ class MxuValuePlans:
 
         return branch_gather
 
+    def _phase_tables(self, shard, rt, phase_re=None, phase_im=None):
+        """Resolve this shard's per-shard (cos, sin) alignment-phase tables —
+        the ONE resolution rule for every distributed MXU engine (PR-7 left a
+        copy in the 1-D engine and inline ``phase_rep_tables_at`` calls in the
+        pencil engine; this is the deduplicated form):
+
+        * ``phase_re``/``phase_im`` given (the 1-D engine's staged sharded
+          runtime operands, already stripped to per-shard form) — use them;
+        * compact ("delta") rep — generate this shard's tables in-trace;
+        * embedded table rep without staged operands (the pencil engines) —
+          read them off the rep;
+        * no rotations anywhere — ``(None, None)``.
+
+        The 1-D engine's table-form rep always arrives via operands; absent
+        operands it resolves to ``(None, None)`` (the historical no-operand
+        contract of its trace paths)."""
+        if phase_re is not None:
+            return phase_re, phase_im
+        rep = getattr(self, "_align_rep", None)
+        if rep is None:
+            return None, None
+        if rep[0] != "delta" and getattr(self, "_align_phase", None) is not None:
+            return None, None  # staged-operand form: caller threads them
+        return lanecopy.phase_rep_tables_at(rep, shard, rt)
+
     def _wire_dtype(self):
         # the single-sourced wire rule (types.wire_dtype): *_FLOAT halves the
         # f64 wire like the reference's float exchange, *_BF16 is the explicit
@@ -231,6 +256,7 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         exchange_type: ExchangeType = ExchangeType.DEFAULT,
         precision="highest",
         overlap: int = 1,
+        fuse=None,
     ):
         self.params = params
         self.mesh = mesh
@@ -455,6 +481,11 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         }
         self._forward = {s: jax.jit(f) for s, f in self._forward_sm.items()}
 
+        # Stage-graph IR (spfft_tpu.ir): see DistributedExecution.__init__.
+        from ..ir.compile import init_engine_ir
+
+        self._ir = init_engine_ir(self, fuse)
+
     @property
     def is_r2c(self) -> bool:
         return self.params.transform_type == TransformType.R2C
@@ -514,17 +545,6 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         """(P, S, L) pair -> all_to_all over the mesh axis, one collective."""
         return self._exchange_pair(bre, bim, FFT_AXIS)
 
-    def _phase_tables(self, phase_re, phase_im, shard, rt):
-        """Resolve this shard's (cos, sin) alignment-phase tables — staged
-        runtime operands, in-trace delta generation, or (None, None) when no
-        shard rotates. Hoisted out of the OVERLAPPED chunk loop so the delta
-        rep's tables are generated once per direction, not per chunk."""
-        if phase_re is not None:
-            return phase_re[0], phase_im[0]
-        if self._align_rep is not None and self._align_rep[0] == "delta":
-            return lanecopy.phase_rep_tables_at(self._align_rep, shard, rt)
-        return None, None
-
     def _unpack_freq(self, rre, rim):
         """(P, S, L) received stick blocks -> the compact frequency planes
         ((L, Y, A), the sparse-y (A, Sy, L) table, or the blocked (rb, L)
@@ -546,10 +566,21 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             gim = jnp.take(rows_im, m, axis=0).T.reshape(L, Y, A)
         return gre, gim
 
+    def _forward_slot_map(self):
+        """The static per-stick plane-slot map the forward pack gathers
+        through (variant-dependent: sparse-y table rows, blocked bucket
+        flats, or the compact (y, x) slots)."""
+        if self._sparse_y:
+            return self._stick_row
+        if self._sparse_y_blocked is not None:
+            return self._stick_row_b
+        return self._stick_yx
+
     def _forward_flats(self, gre, gim):
-        """Flattened plane rows (+ the zero sentinel row) and the per-stick
-        slot map the forward pack gathers through — shared by the bulk pack
-        and the OVERLAPPED per-chunk packs."""
+        """Flattened plane rows (+ the zero sentinel row) the forward pack
+        gathers through — shared by the bulk pack and the OVERLAPPED
+        per-chunk packs (the per-stick slot map is resolved separately via
+        :meth:`_forward_slot_map`)."""
         L, Y, A = self._L, self.params.dim_y, self._num_x_active
         rt = self.real_dtype
         if self._sparse_y:
@@ -559,11 +590,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             flat_im = jnp.concatenate(
                 [gim.reshape(A * self._sy, L), jnp.zeros((1, L), rt)]
             )
-            m = self._stick_row
         elif self._sparse_y_blocked is not None:
             flat_re = jnp.concatenate([gre, jnp.zeros((1, L), rt)])
             flat_im = jnp.concatenate([gim, jnp.zeros((1, L), rt)])
-            m = self._stick_row_b
         else:
             flat_re = jnp.concatenate(
                 [gre.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
@@ -571,101 +600,343 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             flat_im = jnp.concatenate(
                 [gim.reshape(L, Y * A).T, jnp.zeros((1, L), rt)]
             )
-            m = self._stick_yx
-        return flat_re, flat_im, m
+        return flat_re, flat_im
+
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One per-shard implementation per stage, shared by the monolithic impls
+    # below (bulk AND overlapped paths) and the IR node fns lowered from
+    # this engine (spfft_tpu.ir.lower).
+
+    def _st_decompress(self, values_re, values_im):
+        rt = self.real_dtype
+        shard = jax.lax.axis_index(FFT_AXIS)
+        return jax.lax.switch(
+            jnp.asarray(self._branch_of_shard)[shard],
+            self._decompress_branches,
+            values_re.astype(rt),
+            values_im.astype(rt),
+        )
+
+    def _st_stick_symmetry(self, sre, sim):
+        p = self.params
+        i = p.zero_stick_row
+        fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+        own = jax.lax.axis_index(FFT_AXIS) == p.zero_stick_shard
+        return (
+            sre.at[i].set(jnp.where(own, fre, sre[i])),
+            sim.at[i].set(jnp.where(own, fim, sim[i])),
+        )
+
+    def _st_phase_hoist(self):
+        """Per-direction alignment-phase tables for the OVERLAPPED chunk
+        paths: the delta rep's in-trace (S, Z) table generation is hoisted
+        out of the chunk loop — once per direction, chunks slice — exactly
+        the PR-7 discipline (table-form reps already arrive hoisted as
+        staged operands; everything else resolves to ``(None, None)``)."""
+        return self._phase_tables(jax.lax.axis_index(FFT_AXIS), self.real_dtype)
+
+    def _st_z_backward(self, sre, sim, phase_re=None, phase_im=None, zwin=None):
+        """z matmul (+ alignment-phase undo, fused multiply) over stick
+        window ``zwin`` (bulk path: the full extent)."""
+        prec, rt = self._precision, self.real_dtype
+        c0, c1 = (0, self._S) if zwin is None else zwin
+        shard = jax.lax.axis_index(FFT_AXIS)
+        cre, cim = offt.complex_matmul(
+            sre[c0:c1], sim[c0:c1], *self._wz_b, "sz,zk->sk", prec
+        )
+        cos_t, sin_t = self._phase_tables(shard, rt, phase_re, phase_im)
+        if cos_t is not None:
+            cre, cim = lanecopy.apply_alignment_phase(
+                cre, cim, cos_t[c0:c1], sin_t[c0:c1], -1
+            )
+        return cre, cim
+
+    def _st_pack(self, cre, cim):
+        """(W, Z) z-matmul'd stick pair -> (P, W, L) exchange blocks — any
+        stick window (bulk W == S; OVERLAPPED chunks pass their windows)."""
+        p = self.params
+        L = self._L
+        W = cre.shape[0]
+        if not self._uniform_z:
+            zmap = jnp.asarray(self._pack_z)
+            cre = jnp.take(cre, zmap, axis=1, mode="fill", fill_value=0)
+            cim = jnp.take(cim, zmap, axis=1, mode="fill", fill_value=0)
+        return (
+            cre.reshape(W, p.num_shards, L).transpose(1, 0, 2),
+            cim.reshape(W, p.num_shards, L).transpose(1, 0, 2),
+        )
+
+    def _st_unpack(self, *recvs):
+        """Received block pair(s) -> compact frequency planes; chunk
+        receives (first half re, second half im) reassemble the padded
+        stick stack first."""
+        k = len(recvs) // 2
+        rre = recvs[0] if k == 1 else jnp.concatenate(recvs[:k], axis=1)
+        rim = recvs[k] if k == 1 else jnp.concatenate(recvs[k:], axis=1)
+        return self._unpack_freq(rre, rim)
+
+    def _st_ragged_exchange_backward(self, sre, sim):
+        # (nslots, L) slot-major plane rows (round-5 row-granular contract)
+        # — same orientation family as the padded unpack
+        p = self.params
+        rt = self.real_dtype
+        A, Y, L = self._num_x_active, p.dim_y, self._L
+        fre, fim = self._ragged.backward(
+            (sre, sim), wire=self._ragged_wire, real_dtype=rt
+        )
+        if self._sparse_y:
+            return fre.reshape(A, self._sy, L), fim.reshape(A, self._sy, L)
+        if self._sparse_y_blocked is not None:
+            return fre, fim  # (rb, L) bucket flats
+        return (
+            fre.reshape(Y, A, L).transpose(2, 0, 1),
+            fim.reshape(Y, A, L).transpose(2, 0, 1),
+        )
+
+    def _st_plane_symmetry(self, gre, gim):
+        """The standalone R2C x==0 hermitian fills (ragged blocked flats or
+        the dense slot-0 plane); the padded blocked path's fill rides inside
+        the y stage instead (:meth:`_st_y_backward`)."""
+        Y = self.params.dim_y
+        if self._sparse_y_blocked is not None:
+            # blocked flats (rb, L): the dense x0 bucket occupies rows
+            # [off, off+Y) in natural y order
+            o = self._sy_x0_flat
+            pre, pim = symmetry.hermitian_fill_1d_pair(
+                gre[o : o + Y], gim[o : o + Y], axis=0
+            )
+            return gre.at[o : o + Y].set(pre), gim.at[o : o + Y].set(pim)
+        pre, pim = symmetry.hermitian_fill_1d_pair(
+            gre[:, :, 0], gim[:, :, 0], axis=1
+        )
+        return gre.at[:, :, 0].set(pre), gim.at[:, :, 0].set(pim)
+
+    def _st_y_backward(self, gre, gim):
+        """The engaged y-variant contraction (per-slot sparse, per-bucket
+        blocked — padded blocked includes the x0 fill — or dense)."""
+        prec = self._precision
+        L, A = self._L, self._num_x_active
+        if self._sparse_y:
+            # per-slot y contraction straight off the stick table (both
+            # exchange paths deliver the same (A, Sy, L) orientation)
+            return offt.complex_matmul(
+                gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
+            )
+        if self._sparse_y_blocked is not None:
+            # per-bucket contractions; bucket-major slot concatenation
+            # (the x matrices fold the slot permutation)
+            outs_re, outs_im = [], []
+            off = 0
+            for b, (row_idx, wyb, _) in enumerate(self._sparse_y_blocked):
+                Ag, Syg = row_idx.shape
+                if self._ragged is not None:
+                    bre = gre[off : off + Ag * Syg].reshape(Ag, Syg, L)
+                    bim = gim[off : off + Ag * Syg].reshape(Ag, Syg, L)
+                else:
+                    idx = jnp.asarray(row_idx)
+                    bre, bim = gre[idx], gim[idx]  # (Ag, Syg, L)
+                    if b == self._sy_x0_bucket:
+                        # R2C: hermitian-complete the dense x0 plane
+                        # along y before its y-DFT (see plane symmetry)
+                        fre, fim = symmetry.hermitian_fill_1d_pair(
+                            bre[0], bim[0], axis=0
+                        )
+                        bre, bim = fre[None], fim[None]
+                ore, oim = offt.complex_matmul(
+                    bre, bim, *wyb, "ajl,ajk->lka", prec
+                )
+                outs_re.append(ore)
+                outs_im.append(oim)
+                off += Ag * Syg
+            gre = jnp.concatenate(outs_re, axis=2)
+            gim = jnp.concatenate(outs_im, axis=2)
+            if gre.shape[2] < A:  # compact_x_extent padding slots
+                padw = A - gre.shape[2]
+                gre = jnp.pad(gre, ((0, 0), (0, 0), (0, padw)))
+                gim = jnp.pad(gim, ((0, 0), (0, 0), (0, padw)))
+            return gre, gim
+        return offt.complex_matmul(gre, gim, *self._wy_b, "lyx,yk->lkx", prec)
+
+    def _st_x_backward(self, gre, gim):
+        prec = self._precision
+        if self.is_r2c:
+            return offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+        return offt.complex_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
+
+    def _plane_symmetry_standalone(self) -> bool:
+        """Whether the R2C x==0 fill runs as its own stage (vs inside the
+        padded blocked y loop) — the gate the monolithic tail and the IR
+        lowering share."""
+        return self.is_r2c and self._have_x0 and not (
+            self._sparse_y_blocked is not None and self._ragged is None
+        )
+
+    def _st_x_forward(self, space_re, space_im=None):
+        prec, rt = self._precision, self.real_dtype
+        if self.is_r2c:
+            return offt.real_in_matmul(
+                space_re.astype(rt), *self._wx_f, "lyx,xk->lyk", prec
+            )
+        return offt.complex_matmul(
+            space_re.astype(rt), space_im.astype(rt),
+            *self._wx_f, "lyx,xk->lyk", prec,
+        )
+
+    def _st_y_forward(self, gre, gim):
+        prec = self._precision
+        L = self._L
+        if self._sparse_y:
+            # per-slot y contraction straight into the stick table (both
+            # exchange paths consume the same (A, Sy, L) orientation)
+            return offt.complex_matmul(
+                gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
+            )
+        if self._sparse_y_blocked is not None:
+            # per-bucket contractions into (rb, L) bucket flats (the
+            # orientation both exchange paths consume)
+            flats_re, flats_im = [], []
+            col = 0
+            for row_idx, _, wyf in self._sparse_y_blocked:
+                Ag, Syg = row_idx.shape
+                fre_b, fim_b = offt.complex_matmul(
+                    gre[:, :, col : col + Ag], gim[:, :, col : col + Ag],
+                    *wyf, "lyk,kjy->kjl", prec,
+                )
+                flats_re.append(fre_b.reshape(Ag * Syg, L))
+                flats_im.append(fim_b.reshape(Ag * Syg, L))
+                col += Ag
+            return (
+                jnp.concatenate(flats_re, axis=0),
+                jnp.concatenate(flats_im, axis=0),
+            )
+        return offt.complex_matmul(gre, gim, *self._wy_f, "lyk,yj->ljk", prec)
+
+    def _st_forward_flats(self, gre, gim):
+        return self._forward_flats(gre, gim)
+
+    def _st_pack_fwd(self, flat_re, flat_im, c0=0, c1=None):
+        """Forward pack window ``[c0, c1)`` off the hoisted plane flats ->
+        (P, W, L) block pair — bulk path and OVERLAPPED chunks share it."""
+        p = self.params
+        S, L = self._S, self._L
+        c1 = S if c1 is None else c1
+        m = self._forward_slot_map()
+        mc = jnp.asarray(m.reshape(p.num_shards, S)[:, c0:c1].reshape(-1))
+        return (
+            jnp.take(flat_re, mc, axis=0).reshape(p.num_shards, c1 - c0, L),
+            jnp.take(flat_im, mc, axis=0).reshape(p.num_shards, c1 - c0, L),
+        )
+
+    def _st_unpack_fwd(self, rre, rim):
+        """(P, W, L) received blocks -> (W, Z) stick z-rows — any window."""
+        p = self.params
+        L = self._L
+        W = rre.shape[1]
+        cre = rre.transpose(1, 0, 2).reshape(W, p.num_shards * L)
+        cim = rim.transpose(1, 0, 2).reshape(W, p.num_shards * L)
+        if not self._uniform_z:
+            zmap = jnp.asarray(self._unpack_z)
+            cre = jnp.take(cre, zmap, axis=1)
+            cim = jnp.take(cim, zmap, axis=1)
+        return cre, cim
+
+    def _st_z_forward(
+        self, cre, cim, scaling, phase_re=None, phase_im=None, zwin=None
+    ):
+        prec, rt = self._precision, self.real_dtype
+        c0, c1 = (0, self._S) if zwin is None else zwin
+        shard = jax.lax.axis_index(FFT_AXIS)
+        cos_t, sin_t = self._phase_tables(shard, rt, phase_re, phase_im)
+        if cos_t is not None:
+            # enter the rotated layout on the space side (fused multiply)
+            cre, cim = lanecopy.apply_alignment_phase(
+                cre, cim, cos_t[c0:c1], sin_t[c0:c1], +1
+            )
+        return offt.complex_matmul(
+            cre, cim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
+        )
+
+    def _st_concat_pair(self, *parts):
+        k = len(parts) // 2
+        if k == 1:
+            return parts[0], parts[1]
+        return (
+            jnp.concatenate(parts[:k], axis=0),
+            jnp.concatenate(parts[k:], axis=0),
+        )
+
+    def _st_ragged_exchange_forward(self, gre, gim):
+        p = self.params
+        rt = self.real_dtype
+        A, Y, L = self._num_x_active, p.dim_y, self._L
+        if self._sparse_y:
+            fre = gre.reshape(A * self._sy, L)
+            fim = gim.reshape(A * self._sy, L)
+        elif self._sparse_y_blocked is not None:
+            fre, fim = gre, gim  # (rb, L) already
+        else:
+            fre = gre.reshape(L, Y * A).T
+            fim = gim.reshape(L, Y * A).T
+        return self._ragged.forward(
+            (fre, fim), wire=self._ragged_wire, real_dtype=rt
+        )
+
+    def _st_compress(self, sre, sim):
+        shard = jax.lax.axis_index(FFT_AXIS)
+        return jax.lax.switch(
+            jnp.asarray(self._branch_of_shard)[shard],
+            self._compress_branches, sre, sim,
+        )
 
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _backward_impl(self, values_re, values_im, phase_re=None, phase_im=None):
         p = self.params
-        prec = self._precision
-        S, L, Y = self._S, self._L, p.dim_y
-        A = self._num_x_active
-        rt = self.real_dtype
-        shard = jax.lax.axis_index(FFT_AXIS)
+        pre = None if phase_re is None else phase_re[0]
+        pim = None if phase_im is None else phase_im[0]
 
         with jax.named_scope("compression"):
-            sre, sim = jax.lax.switch(
-                jnp.asarray(self._branch_of_shard)[shard],
-                self._decompress_branches,
-                values_re[0].astype(rt),
-                values_im[0].astype(rt),
-            )
+            sre, sim = self._st_decompress(values_re[0], values_im[0])
 
         if self.is_r2c and p.zero_stick_shard >= 0:
             with jax.named_scope("stick symmetry"):
-                i = p.zero_stick_row
-                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
-                own = shard == p.zero_stick_shard
-                sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
-                sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
+                sre, sim = self._st_stick_symmetry(sre, sim)
 
         if self._overlap > 1:
             # OVERLAPPED discipline: per-chunk z matmul -> pack -> collective
             # with no cross-chunk dependence, so chunk k's wire time can hide
             # behind chunk k+1's matmuls (see DistributedExecution)
-            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
-            zmap = None if self._uniform_z else jnp.asarray(self._pack_z)
-            rres, rims = [], []
+            if pre is None:
+                pre, pim = self._st_phase_hoist()  # delta-rep hoist
+            recvs_re, recvs_im = [], []
             for c0, c1 in self._chunks:
                 with jax.named_scope("z transform"):
-                    cre, cim = offt.complex_matmul(
-                        sre[c0:c1], sim[c0:c1], *self._wz_b, "sz,zk->sk", prec
+                    cre, cim = self._st_z_backward(
+                        sre, sim, pre, pim, zwin=(c0, c1)
                     )
-                    if cos_t is not None:
-                        cre, cim = lanecopy.apply_alignment_phase(
-                            cre, cim, cos_t[c0:c1], sin_t[c0:c1], -1
-                        )
                 with jax.named_scope("pack"):
-                    if zmap is not None:
-                        cre = jnp.take(cre, zmap, axis=1, mode="fill", fill_value=0)
-                        cim = jnp.take(cim, zmap, axis=1, mode="fill", fill_value=0)
-                    bre = cre.reshape(c1 - c0, p.num_shards, L).transpose(1, 0, 2)
-                    bim = cim.reshape(c1 - c0, p.num_shards, L).transpose(1, 0, 2)
+                    bre, bim = self._st_pack(cre, cim)
                 with jax.named_scope("exchange overlapped"):
                     rc_re, rc_im = self._exchange(bre, bim)
-                rres.append(rc_re)
-                rims.append(rc_im)
+                recvs_re.append(rc_re)
+                recvs_im.append(rc_im)
             with jax.named_scope("unpack"):
-                gre, gim = self._unpack_freq(
-                    jnp.concatenate(rres, axis=1), jnp.concatenate(rims, axis=1)
-                )
-            return self._backward_tail(gre, gim, prec)
+                gre, gim = self._st_unpack(*recvs_re, *recvs_im)
+            return self._backward_tail(gre, gim)
 
         with jax.named_scope("z transform"):
-            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
-            if cos_t is not None:
-                # undo the alignment rotations (fused multiply)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+            sre, sim = self._st_z_backward(sre, sim, pre, pim)
 
         if self._ragged is not None:
             # exact-counts exchange straight into the compact planes (or the
             # sparse-y (A, Sy) stick table — the slot space the exchange was
             # built over)
             with jax.named_scope("exchange"):
-                # (nslots, L) slot-major plane rows (round-5 row-granular
-                # contract) — same orientation family as the padded unpack
-                fre, fim = self._ragged.backward(
-                    (sre, sim), wire=self._ragged_wire, real_dtype=rt
-                )
-                if self._sparse_y:
-                    gre = fre.reshape(A, self._sy, L)
-                    gim = fim.reshape(A, self._sy, L)
-                elif self._sparse_y_blocked is not None:
-                    gre, gim = fre, fim  # (rb, L) bucket flats
-                else:
-                    gre = fre.reshape(Y, A, L).transpose(2, 0, 1)
-                    gim = fim.reshape(Y, A, L).transpose(2, 0, 1)
+                gre, gim = self._st_ragged_exchange_backward(sre, sim)
         else:
             # pack: (S, Z) -> (P, S, L) exchange blocks
             with jax.named_scope("pack"):
-                if not self._uniform_z:
-                    zmap = jnp.asarray(self._pack_z)
-                    sre = jnp.take(sre, zmap, axis=1, mode="fill", fill_value=0)
-                    sim = jnp.take(sim, zmap, axis=1, mode="fill", fill_value=0)
-                bre = sre.reshape(S, p.num_shards, L).transpose(1, 0, 2)
-                bim = sim.reshape(S, p.num_shards, L).transpose(1, 0, 2)
+                bre, bim = self._st_pack(sre, sim)
 
             with jax.named_scope("exchange"):
                 rre, rim = self._exchange(bre, bim)
@@ -673,90 +944,28 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
             # expand: (P*S, L) global stick rows -> compact freq planes
             # ((L, Y, A), or the (A, Sy, L) table when sparse-y is engaged)
             with jax.named_scope("unpack"):
-                gre, gim = self._unpack_freq(rre, rim)
+                gre, gim = self._st_unpack(rre, rim)
 
-        return self._backward_tail(gre, gim, prec)
+        return self._backward_tail(gre, gim)
 
-    def _backward_tail(self, gre, gim, prec):
+    def _backward_tail(self, gre, gim):
         """Plane symmetry + y/x DFT stages of the backward pipeline over the
         compact frequency planes — shared by the bulk-synchronous paths and
         the OVERLAPPED chunk path (all of which deliver the same plane
-        orientation; the ragged/padded distinction below only matters for
-        the blocked sparse-y layout, where the OVERLAPPED path follows the
+        orientation; the ragged/padded distinction only matters for the
+        blocked sparse-y layout, where the OVERLAPPED path follows the
         padded convention by construction)."""
-        L, Y, A = self._L, self.params.dim_y, self._num_x_active
-
-        if self.is_r2c and self._have_x0:
+        if self._plane_symmetry_standalone():
             with jax.named_scope("plane symmetry"):
-                if self._sparse_y_blocked is not None:
-                    if self._ragged is not None:
-                        # blocked flats (rb, L): the dense x0 bucket occupies
-                        # rows [off, off+Y) in natural y order
-                        o = self._sy_x0_flat
-                        pre, pim = symmetry.hermitian_fill_1d_pair(
-                            gre[o : o + Y], gim[o : o + Y], axis=0
-                        )
-                        gre = gre.at[o : o + Y].set(pre)
-                        gim = gim.at[o : o + Y].set(pim)
-                    # padded path: the fill runs on the gathered dense bucket
-                    # inside the y-transform loop below (rows are still the
-                    # global stick stack here)
-                else:
-                    pre, pim = symmetry.hermitian_fill_1d_pair(
-                        gre[:, :, 0], gim[:, :, 0], axis=1
-                    )
-                    gre = gre.at[:, :, 0].set(pre)
-                    gim = gim.at[:, :, 0].set(pim)
+                gre, gim = self._st_plane_symmetry(gre, gim)
 
         with jax.named_scope(self._y_stage_scope()):
-            if self._sparse_y:
-                # per-slot y contraction straight off the stick table (both
-                # exchange paths deliver the same (A, Sy, L) orientation)
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_b_sp, "ajl,ajk->lka", prec
-                )
-            elif self._sparse_y_blocked is not None:
-                # per-bucket contractions; bucket-major slot concatenation
-                # (the x matrices fold the slot permutation)
-                outs_re, outs_im = [], []
-                off = 0
-                for b, (row_idx, wyb, _) in enumerate(self._sparse_y_blocked):
-                    Ag, Syg = row_idx.shape
-                    if self._ragged is not None:
-                        bre = gre[off : off + Ag * Syg].reshape(Ag, Syg, L)
-                        bim = gim[off : off + Ag * Syg].reshape(Ag, Syg, L)
-                    else:
-                        idx = jnp.asarray(row_idx)
-                        bre, bim = gre[idx], gim[idx]  # (Ag, Syg, L)
-                        if b == self._sy_x0_bucket:
-                            # R2C: hermitian-complete the dense x0 plane
-                            # along y before its y-DFT (see plane symmetry)
-                            fre, fim = symmetry.hermitian_fill_1d_pair(
-                                bre[0], bim[0], axis=0
-                            )
-                            bre, bim = fre[None], fim[None]
-                    ore, oim = offt.complex_matmul(
-                        bre, bim, *wyb, "ajl,ajk->lka", prec
-                    )
-                    outs_re.append(ore)
-                    outs_im.append(oim)
-                    off += Ag * Syg
-                gre = jnp.concatenate(outs_re, axis=2)
-                gim = jnp.concatenate(outs_im, axis=2)
-                if gre.shape[2] < A:  # compact_x_extent padding slots
-                    padw = A - gre.shape[2]
-                    gre = jnp.pad(gre, ((0, 0), (0, 0), (0, padw)))
-                    gim = jnp.pad(gim, ((0, 0), (0, 0), (0, padw)))
-            else:
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_b, "lyx,yk->lkx", prec
-                )
+            gre, gim = self._st_y_backward(gre, gim)
         with jax.named_scope("x transform"):
-            if self.is_r2c:
-                out = offt.real_out_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
-                return out[None]
-            gre, gim = offt.complex_matmul(gre, gim, *self._wx_b, "lkx,xj->lkj", prec)
-            return gre[None], gim[None]
+            out = self._st_x_backward(gre, gim)
+        if self.is_r2c:
+            return out[None]
+        return out[0][None], out[1][None]
 
     def _forward_impl(self, space_re, *rest, scaling):
         if self.is_r2c:
@@ -765,141 +974,62 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         else:
             space_im, phase = rest[0], rest[1:]
         phase_re, phase_im = phase if phase else (None, None)
-        p = self.params
-        prec = self._precision
-        S, L, Y = self._S, self._L, p.dim_y
-        A = self._num_x_active
-        rt = self.real_dtype
-        shard = jax.lax.axis_index(FFT_AXIS)
+        pre = None if phase_re is None else phase_re[0]
+        pim = None if phase_im is None else phase_im[0]
 
         with jax.named_scope("x transform"):
             if self.is_r2c:
-                gre, gim = offt.real_in_matmul(
-                    space_re[0].astype(rt), *self._wx_f, "lyx,xk->lyk", prec
-                )
+                gre, gim = self._st_x_forward(space_re[0])
             else:
-                gre, gim = offt.complex_matmul(
-                    space_re[0].astype(rt), space_im[0].astype(rt),
-                    *self._wx_f, "lyx,xk->lyk", prec,
-                )
+                gre, gim = self._st_x_forward(space_re[0], space_im[0])
         with jax.named_scope(self._y_stage_scope()):
-            if self._sparse_y:
-                # per-slot y contraction straight into the stick table (both
-                # exchange paths consume the same (A, Sy, L) orientation)
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_f_sp, "lyk,kjy->kjl", prec
-                )
-            elif self._sparse_y_blocked is not None:
-                # per-bucket contractions into (rb, L) bucket flats (the
-                # orientation both exchange paths consume)
-                flats_re, flats_im = [], []
-                col = 0
-                for row_idx, _, wyf in self._sparse_y_blocked:
-                    Ag, Syg = row_idx.shape
-                    fre_b, fim_b = offt.complex_matmul(
-                        gre[:, :, col : col + Ag], gim[:, :, col : col + Ag],
-                        *wyf, "lyk,kjy->kjl", prec,
-                    )
-                    flats_re.append(fre_b.reshape(Ag * Syg, L))
-                    flats_im.append(fim_b.reshape(Ag * Syg, L))
-                    col += Ag
-                gre = jnp.concatenate(flats_re, axis=0)
-                gim = jnp.concatenate(flats_im, axis=0)
-            else:
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_f, "lyk,yj->ljk", prec
-                )
+            gre, gim = self._st_y_forward(gre, gim)
 
         if self._overlap > 1:
             # OVERLAPPED discipline (forward direction): chunk k's received
             # stick z-chunks run their z matmuls while chunk k+1's collective
             # is in flight — the mirror of the backward chunk pipeline
-            flat_re, flat_im, m = self._forward_flats(gre, gim)
-            m_by_shard = m.reshape(p.num_shards, S)
-            cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
+            if pre is None:
+                pre, pim = self._st_phase_hoist()  # delta-rep hoist
+            flat_re, flat_im = self._st_forward_flats(gre, gim)
             parts_re, parts_im = [], []
             for c0, c1 in self._chunks:
                 with jax.named_scope("pack"):
-                    mc = jnp.asarray(m_by_shard[:, c0:c1].reshape(-1))
-                    bre = jnp.take(flat_re, mc, axis=0).reshape(
-                        p.num_shards, c1 - c0, L
-                    )
-                    bim = jnp.take(flat_im, mc, axis=0).reshape(
-                        p.num_shards, c1 - c0, L
-                    )
+                    bre, bim = self._st_pack_fwd(flat_re, flat_im, c0, c1)
                 with jax.named_scope("exchange overlapped"):
                     rre, rim = self._exchange(bre, bim)
                 with jax.named_scope("unpack"):
-                    cre = rre.transpose(1, 0, 2).reshape(c1 - c0, p.num_shards * L)
-                    cim = rim.transpose(1, 0, 2).reshape(c1 - c0, p.num_shards * L)
-                    if not self._uniform_z:
-                        zmap = jnp.asarray(self._unpack_z)
-                        cre = jnp.take(cre, zmap, axis=1)
-                        cim = jnp.take(cim, zmap, axis=1)
+                    cre, cim = self._st_unpack_fwd(rre, rim)
                 with jax.named_scope("z transform"):
-                    if cos_t is not None:
-                        cre, cim = lanecopy.apply_alignment_phase(
-                            cre, cim, cos_t[c0:c1], sin_t[c0:c1], +1
-                        )
-                    cre, cim = offt.complex_matmul(
-                        cre, cim, *self._wz_f[ScalingType(scaling)],
-                        "sz,zk->sk", prec,
+                    cre, cim = self._st_z_forward(
+                        cre, cim, scaling, pre, pim, zwin=(c0, c1)
                     )
                 parts_re.append(cre)
                 parts_im.append(cim)
-            sre = jnp.concatenate(parts_re, axis=0)
-            sim = jnp.concatenate(parts_im, axis=0)
+            sre, sim = self._st_concat_pair(*parts_re, *parts_im)
         elif self._ragged is not None:
             with jax.named_scope("exchange"):
-                # (nslots, L) slot-major rows (round-5 row-granular contract)
-                if self._sparse_y:
-                    fre = gre.reshape(A * self._sy, L)
-                    fim = gim.reshape(A * self._sy, L)
-                elif self._sparse_y_blocked is not None:
-                    fre, fim = gre, gim  # (rb, L) already
-                else:
-                    fre = gre.reshape(L, Y * A).T
-                    fim = gim.reshape(L, Y * A).T
-                sre, sim = self._ragged.forward(
-                    (fre, fim), wire=self._ragged_wire, real_dtype=rt
-                )
+                sre, sim = self._st_ragged_exchange_forward(gre, gim)
         else:
             # pack: gather every global stick's compact plane slot (or sparse-y
             # table row) from my planes
             with jax.named_scope("pack"):
-                flat_re, flat_im, m = self._forward_flats(gre, gim)
-                mj = jnp.asarray(m)
-                bre = jnp.take(flat_re, mj, axis=0).reshape(p.num_shards, S, L)
-                bim = jnp.take(flat_im, mj, axis=0).reshape(p.num_shards, S, L)
+                flat_re, flat_im = self._st_forward_flats(gre, gim)
+                bre, bim = self._st_pack_fwd(flat_re, flat_im)
 
             with jax.named_scope("exchange"):
                 rre, rim = self._exchange(bre, bim)
 
             # unpack: (P, S, L) my sticks' z chunks -> (S, Z)
             with jax.named_scope("unpack"):
-                sre = rre.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-                sim = rim.transpose(1, 0, 2).reshape(S, p.num_shards * L)
-                if not self._uniform_z:
-                    zmap = jnp.asarray(self._unpack_z)
-                    sre = jnp.take(sre, zmap, axis=1)
-                    sim = jnp.take(sim, zmap, axis=1)
+                sre, sim = self._st_unpack_fwd(rre, rim)
 
         if self._overlap == 1:
             with jax.named_scope("z transform"):
-                cos_t, sin_t = self._phase_tables(phase_re, phase_im, shard, rt)
-                if cos_t is not None:
-                    # enter the rotated layout on the space side (fused multiply)
-                    sre, sim = lanecopy.apply_alignment_phase(
-                        sre, sim, cos_t, sin_t, +1
-                    )
-                sre, sim = offt.complex_matmul(
-                    sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk", prec
-                )
+                sre, sim = self._st_z_forward(sre, sim, scaling, pre, pim)
 
         with jax.named_scope("compression"):
-            vre, vim = jax.lax.switch(
-                jnp.asarray(self._branch_of_shard)[shard], self._compress_branches, sre, sim
-            )
+            vre, vim = self._st_compress(sre, sim)
         return vre[None], vim[None]
 
     # ---- device-side entry points ---------------------------------------------
@@ -908,8 +1038,9 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         return () if self._align_phase is None else self._align_phase
 
     def backward_pair(self, values_re, values_im):
-        """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C)."""
-        return self._backward(values_re, values_im, *self._phase_args())
+        """(P, V_max) freq pairs -> space slabs (P, L, Y, X) (pair for C2C).
+        Routed through the IR runtime (see DistributedExecution)."""
+        return self._ir.run_backward(values_re, values_im, *self._phase_args())
 
     def _dispatch_forward(self, table, space_re, space_im, scaling):
         fn = table[ScalingType(scaling)]
@@ -919,7 +1050,10 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
         """(P, L, Y, X) space slabs -> (P, V_max) freq pairs."""
-        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
+        s = ScalingType(scaling)
+        if self.is_r2c:
+            return self._ir.run_forward(s, space_re, *self._phase_args())
+        return self._ir.run_forward(s, space_re, space_im, *self._phase_args())
 
     # Un-jitted traceables (see LocalExecution.trace_backward for rationale).
 

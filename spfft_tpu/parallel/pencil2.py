@@ -212,8 +212,11 @@ def _resolve_pencil2_default(assign, lz, ly, Lz, Ly, P1, P2, mesh,
 class Pencil2Execution(PaddingHelpers):
     """Compiled 2-D-pencil distributed pipelines for one plan (C2C or R2C)."""
 
+    # 2-D pencil graphs map over both mesh axes (spfft_tpu.ir.compile)
+    _IR_AXES = (AX1, AX2)
+
     def __init__(self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT,
-                 overlap: int = 1):
+                 overlap: int = 1, fuse=None):
         self.params = params
         self.mesh = mesh
         self.real_dtype = np.dtype(real_dtype)
@@ -470,6 +473,14 @@ class Pencil2Execution(PaddingHelpers):
                 out_specs=(specs_v, specs_v),
             )
             self._forward[scaling] = jax.jit(self._forward_sm[scaling])
+
+        # Stage-graph IR (spfft_tpu.ir): see DistributedExecution.__init__.
+        # The MXU subclass builds its DFT matrices AFTER this constructor, so
+        # it defers its own IR init to the end of its __init__.
+        if type(self) is Pencil2Execution:
+            from ..ir.compile import init_engine_ir
+
+            self._ir = init_engine_ir(self, fuse)
 
     # ---- shared bits ----------------------------------------------------------
 
@@ -817,37 +828,174 @@ class Pencil2Execution(PaddingHelpers):
         rows = recvb.reshape(P1 * Ly, Ax, W)
         return jnp.take(rows, jnp.asarray(self._yinv), axis=0)
 
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One per-shard implementation per stage, shared by the monolithic impls
+    # below (the bulk path IS the one-full-window chunk) and the IR node fns
+    # lowered from this engine (spfft_tpu.ir.lower).
+
+    def _shard_me(self):
+        a_me = jax.lax.axis_index(AX1)
+        b_me = jax.lax.axis_index(AX2)
+        return a_me, b_me, a_me * self.P2 + b_me
+
+    def _split_b(self, h, W):
+        """(Ly, P1*Ax, W) plane columns -> (P1, Ly, Ax, W) exchange-B blocks
+        — the one reshape both pencil engines' forward packs share (the XLA
+        engine gathers through the slot map first; the MXU engine's x
+        matrices land directly in slot order)."""
+        return h.reshape(self._Ly, self.P1, self._Ax, W).transpose(1, 0, 2, 3)
+
+    def _st_decompress(self, values_re, values_im, value_indices):
+        S, Z = self._S, self.params.dim_z
+        values = jax.lax.complex(
+            values_re.astype(self.real_dtype), values_im.astype(self.real_dtype)
+        )
+        flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
+        flat = flat.at[value_indices].set(values, mode="drop")
+        return flat[: S * Z].reshape(S, Z)
+
+    def _st_stick_symmetry(self, sticks):
+        # (0,0)-stick hermitian fill on its owner, before the z transform
+        p = self.params
+        _, _, s_me = self._shard_me()
+        row = sticks[p.zero_stick_row]
+        filled = symmetry.hermitian_fill_1d(row, axis=0)
+        return sticks.at[p.zero_stick_row].set(
+            jnp.where(s_me == p.zero_stick_shard, filled, row)
+        )
+
+    def _st_z_backward(self, sticks):
+        return jnp.fft.ifft(sticks, axis=1)
+
+    def _st_pack_a(self, sticks, zwin):
+        _, _, s_me = self._shard_me()
+        return self._pack_a(sticks, s_me, zwin=zwin)
+
+    def _st_exchange_a(self, buf, reverse=False):
+        return self._exchange(buf, (AX1, AX2), reverse=reverse)
+
+    def _st_unpack_a(self, recv):
+        a_me, _, _ = self._shard_me()
+        return self._unpack_a(recv, a_me)
+
+    def _st_plane_symmetry(self, grid):
+        # x == 0 plane hermitian fill along y on its (group, slot) owner,
+        # which has the FULL y extent here (z is space-domain)
+        a_me, _, _ = self._shard_me()
+        g0, s0 = self._x0_group, self._x0_slot
+        col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
+        return grid.at[:, s0, :].set(
+            jnp.where(a_me == g0, col, grid[:, s0, :])
+        )
+
+    def _st_y_backward(self, grid):
+        return jnp.fft.ifft(grid, axis=0)
+
+    def _st_pack_b(self, grid):
+        return self._pack_b(grid)
+
+    def _st_exchange_b(self, bufb, reverse=False):
+        return self._exchange(bufb, (AX1,), reverse=reverse)
+
+    def _st_unpack_b(self, recvb):
+        # assemble the full frequency-x extent
+        Xf = self.params.dim_x_freq
+        Ly, P1, Ax = self._Ly, self.P1, self._Ax
+        W = recvb.shape[-1]
+        h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, W)
+        slab = jnp.zeros((Ly, Xf + 1, W), dtype=self.complex_dtype)
+        slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
+        return slab[:, :Xf, :]
+
+    def _st_x_backward(self, slab):
+        p = self.params
+        if self.is_r2c:
+            out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(self.real_dtype)
+        else:
+            out = jnp.fft.ifft(slab, axis=1)
+        # (W, Ly, X) slice of the space slab contract
+        return out.transpose(2, 0, 1)
+
+    def _st_space_out(self, *parts):
+        # z-window slices -> the (Lz, Ly, X) slab; the backward transform is
+        # unnormalized, so undo ifft's 1/N here
+        total = np.asarray(self.params.total_size, self.real_dtype)
+        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        out = out * total
+        if self.is_r2c:
+            return out
+        return out.real, out.imag
+
+    def _st_x_forward(self, space_re, space_im=None, zwin=None):
+        c0, c1 = (0, self._Lz) if zwin is None else zwin
+        if self.is_r2c:
+            slab = space_re[c0:c1].astype(self.real_dtype)
+            return jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
+        slab = jax.lax.complex(
+            space_re[c0:c1].astype(self.real_dtype),
+            space_im[c0:c1].astype(self.real_dtype),
+        )
+        return jnp.fft.fft(slab, axis=2)  # (W, Ly, Xf)
+
+    def _st_pack_b_rev(self, freq):
+        # split into x-group columns, send each group home (exchange B rev)
+        Ly = self._Ly
+        W = freq.shape[0]
+        fq = freq.transpose(1, 2, 0)  # (Ly, Xf, W) z-minor
+        hpad = jnp.concatenate(
+            [fq, jnp.zeros((Ly, 1, W), self.complex_dtype)], axis=1
+        )
+        h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)
+        return self._split_b(h, W)
+
+    def _st_unpack_b_rev(self, recvb):
+        return self._unpack_b_rev(recvb)  # (Y, Ax, W)
+
+    def _st_y_forward(self, grid):
+        return jnp.fft.fft(grid, axis=0)
+
+    def _st_pack_a_rev(self, grid, z0):
+        a_me, b_me, _ = self._shard_me()
+        return self._pack_a_rev(grid, a_me, b_me, z0=z0)
+
+    def _st_unpack_a_rev(self, *recvs):
+        # reassemble my (S, Z) stick table from the chunk receives
+        recv = recvs[0] if len(recvs) == 1 else jnp.concatenate(recvs, axis=-1)
+        _, _, s_me = self._shard_me()
+        return self._unpack_a_rev(recv, s_me)
+
+    def _st_z_forward(self, sticks):
+        return jnp.fft.fft(sticks, axis=1)
+
+    def _st_compress(self, sticks, value_indices, scale):
+        values = jnp.take(
+            sticks.reshape(-1), value_indices, mode="fill", fill_value=0
+        )
+        if scale is not None:
+            values = values * np.asarray(scale, dtype=self.real_dtype)
+        return (
+            values.real.astype(self.real_dtype),
+            values.imag.astype(self.real_dtype),
+        )
+
     # ---- pipelines (traced once; run per-shard under shard_map) ---------------
 
     def _backward_impl(self, values_re, values_im, value_indices):
         p = self.params
-        S, Z, Y, Xf = self._S, p.dim_z, p.dim_y, p.dim_x_freq
-        P1, P2, Ax, Lz, Ly, SG = self.P1, self.P2, self._Ax, self._Lz, self._Ly, self._SG
-        a_me = jax.lax.axis_index(AX1)
-        b_me = jax.lax.axis_index(AX2)
-        s_me = a_me * P2 + b_me
 
         # stage scopes: canonical obs.STAGES labels (profiler attribution;
         # the two exchanges are tagged A/B so traces attribute them apart)
         with jax.named_scope("compression"):
-            values = jax.lax.complex(
-                values_re[0].astype(self.real_dtype),
-                values_im[0].astype(self.real_dtype),
+            sticks = self._st_decompress(
+                values_re[0], values_im[0], value_indices[0]
             )
-            flat = jnp.zeros(S * Z + 1, dtype=self.complex_dtype)
-            flat = flat.at[value_indices[0]].set(values, mode="drop")
-            sticks = flat[: S * Z].reshape(S, Z)
 
         if self.is_r2c and p.zero_stick_shard >= 0:
-            # (0,0)-stick hermitian fill on its owner, before the z transform
             with jax.named_scope("stick symmetry"):
-                row = sticks[p.zero_stick_row]
-                filled = symmetry.hermitian_fill_1d(row, axis=0)
-                own = s_me == p.zero_stick_shard
-                sticks = sticks.at[p.zero_stick_row].set(jnp.where(own, filled, row))
+                sticks = self._st_stick_symmetry(sticks)
 
         with jax.named_scope("z transform"):
-            sticks = jnp.fft.ifft(sticks, axis=1)
+            sticks = self._st_z_backward(sticks)
 
         # The post-z pipeline runs once per z-window chunk (one full-window
         # chunk bulk-synchronously; C chunks under the OVERLAPPED discipline,
@@ -859,67 +1007,41 @@ class Pencil2Execution(PaddingHelpers):
         for c0, c1 in self._chunks:
             # pack A: my sticks split by destination (x-group a', z-slab b')
             with jax.named_scope("pack A"):
-                buf = self._pack_a(sticks, s_me, zwin=(c0, c1))
+                buf = self._st_pack_a(sticks, (c0, c1))
 
             # exchange A: one collective over BOTH mesh axes (row-major (a, b))
             with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
-                recv = self._exchange(buf, (AX1, AX2))  # (P, SG, W): s's sticks
+                recv = self._st_exchange_a(buf)  # (P, SG, W): s's sticks
 
             # unpack A -> y-pencil grid (Y, Ax, W): my x-group's sticks, my z
             with jax.named_scope("unpack A"):
-                grid = self._unpack_a(recv, a_me)
+                grid = self._st_unpack_a(recv)
 
             if self.is_r2c and self._have_x0:
-                # x == 0 plane hermitian fill along y on its (group, slot)
-                # owner, which has the FULL y extent here (z is space-domain)
                 with jax.named_scope("plane symmetry"):
-                    g0, s0 = self._x0_group, self._x0_slot
-                    col = symmetry.hermitian_fill_1d(grid[:, s0, :], axis=0)
-                    grid = grid.at[:, s0, :].set(
-                        jnp.where(a_me == g0, col, grid[:, s0, :])
-                    )
+                    grid = self._st_plane_symmetry(grid)
 
             with jax.named_scope("y transform"):
-                grid = jnp.fft.ifft(grid, axis=0)
+                grid = self._st_y_backward(grid)
 
             # pack B: gather each destination's y-rows (within my z-window)
             with jax.named_scope("pack B"):
-                bufb = self._pack_b(grid)
+                bufb = self._st_pack_b(grid)
 
             # exchange B: within the row (fixed z-slab), over the x-group axis
             with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
-                recvb = self._exchange(bufb, (AX1,))  # (P1, Ly, Ax, W)
+                recvb = self._st_exchange_b(bufb)  # (P1, Ly, Ax, W)
 
-            # assemble the full frequency-x extent and transform
             with jax.named_scope("unpack B"):
-                h = recvb.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
-                slab = jnp.zeros((Ly, Xf + 1, c1 - c0), dtype=self.complex_dtype)
-                slab = slab.at[:, jnp.asarray(self._xcol), :].set(h, mode="drop")
-                slab = slab[:, :Xf, :]
+                slab = self._st_unpack_b(recvb)
             with jax.named_scope("x transform"):
-                if self.is_r2c:
-                    out = jnp.fft.irfft(slab, n=p.dim_x, axis=1).astype(
-                        self.real_dtype
-                    )
-                else:
-                    out = jnp.fft.ifft(slab, axis=1)
-                # (W, Ly, X) slice of the space slab contract
-                parts.append(out.transpose(2, 0, 1))
-        total = np.asarray(p.total_size, self.real_dtype)
-        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+                parts.append(self._st_x_backward(slab))
+        out = self._st_space_out(*parts)
         if self.is_r2c:
-            return (out * total)[None]
-        out = out * total
-        return out.real[None], out.imag[None]
+            return out[None]
+        return out[0][None], out[1][None]
 
     def _forward_impl(self, space_re, *rest, scale):
-        p = self.params
-        S, Z, Y, Xf = self._S, p.dim_z, p.dim_y, p.dim_x_freq
-        P1, P2, Ax, Lz, Ly, SG = self.P1, self.P2, self._Ax, self._Lz, self._Ly, self._SG
-        a_me = jax.lax.axis_index(AX1)
-        b_me = jax.lax.axis_index(AX2)
-        s_me = a_me * P2 + b_me
-
         if self.is_r2c:
             (value_indices,) = rest
             space_im = None
@@ -934,66 +1056,51 @@ class Pencil2Execution(PaddingHelpers):
         recvs = []
         for c0, c1 in self._chunks:
             with jax.named_scope("x transform"):
-                if self.is_r2c:
-                    slab = space_re[0][c0:c1].astype(self.real_dtype)
-                    freq = jnp.fft.rfft(slab, axis=2).astype(self.complex_dtype)
-                else:
-                    slab = jax.lax.complex(
-                        space_re[0][c0:c1].astype(self.real_dtype),
-                        space_im[0][c0:c1].astype(self.real_dtype),
-                    )
-                    freq = jnp.fft.fft(slab, axis=2)  # (W, Ly, Xf)
-
-            # split into x-group columns, send each group home (exchange B rev)
-            with jax.named_scope("pack B"):
-                fq = freq.transpose(1, 2, 0)  # (Ly, Xf, W) z-minor
-                hpad = jnp.concatenate(
-                    [fq, jnp.zeros((Ly, 1, c1 - c0), self.complex_dtype)], axis=1
+                freq = self._st_x_forward(
+                    space_re[0],
+                    None if space_im is None else space_im[0],
+                    zwin=(c0, c1),
                 )
-                h = jnp.take(hpad, jnp.asarray(self._xcol), axis=1)
-                bufb = h.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
+
+            with jax.named_scope("pack B"):
+                bufb = self._st_pack_b_rev(freq)
             # (P1, Ly, Ax, W): my x-group, q's y
             with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
-                recvb = self._exchange(bufb, (AX1,), reverse=True)
+                recvb = self._st_exchange_b(bufb, reverse=True)
 
             # reassemble the full y extent of my x-group
             with jax.named_scope("unpack B"):
-                grid = self._unpack_b_rev(recvb)  # (Y, Ax, W)
+                grid = self._st_unpack_b_rev(recvb)
             with jax.named_scope("y transform"):
-                grid = jnp.fft.fft(grid, axis=0)
+                grid = self._st_y_forward(grid)
 
             # exchange A reverse: each stick's z-chunk back to its owner
             with jax.named_scope("pack A"):
-                buf = self._pack_a_rev(grid, a_me, b_me, z0=c0)  # (P, SG, W)
+                buf = self._st_pack_a_rev(grid, c0)  # (P, SG, W)
             # (P, SG, W): my sticks, p's z
             with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
-                recvs.append(self._exchange(buf, (AX1, AX2), reverse=True))
-        recv = recvs[0] if len(recvs) == 1 else jnp.concatenate(recvs, axis=-1)
+                recvs.append(self._st_exchange_a(buf, reverse=True))
 
-        # reassemble my (S, Z) stick table and transform
         with jax.named_scope("unpack A"):
-            sticks = self._unpack_a_rev(recv, s_me)
+            sticks = self._st_unpack_a_rev(*recvs)
         with jax.named_scope("z transform"):
-            sticks = jnp.fft.fft(sticks, axis=1)
+            sticks = self._st_z_forward(sticks)
 
         with jax.named_scope("compression"):
-            values = jnp.take(
-                sticks.reshape(-1), value_indices[0], mode="fill", fill_value=0
-            )
-            if scale is not None:
-                values = values * np.asarray(scale, dtype=self.real_dtype)
-            return (
-                values.real.astype(self.real_dtype)[None],
-                values.imag.astype(self.real_dtype)[None],
-            )
+            vre, vim = self._st_compress(sticks, value_indices[0], scale)
+            return vre[None], vim[None]
 
     # ---- device-side entry points ---------------------------------------------
 
     def backward_pair(self, values_re, values_im):
-        return self._backward(values_re, values_im, self._value_indices)
+        """Routed through the IR runtime (see DistributedExecution)."""
+        return self._ir.run_backward(values_re, values_im, self._value_indices)
 
     def forward_pair(self, space_re, space_im, scaling: ScalingType = ScalingType.NONE):
-        return self._dispatch_forward(self._forward, space_re, space_im, scaling)
+        s = ScalingType(scaling)
+        if self.is_r2c:
+            return self._ir.run_forward(s, space_re, self._value_indices)
+        return self._ir.run_forward(s, space_re, space_im, self._value_indices)
 
     def trace_backward(self, values_re, values_im, phase=()):
         del phase  # mesh engines keep per-shard reps internal (no operands)

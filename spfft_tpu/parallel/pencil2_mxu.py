@@ -36,7 +36,7 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
 
     def __init__(
         self, params, real_dtype, mesh, exchange_type=ExchangeType.DEFAULT,
-        precision="highest", overlap: int = 1,
+        precision="highest", overlap: int = 1, fuse=None,
     ):
         self._precision = offt.resolve_precision(precision)
         super().__init__(params, real_dtype, mesh, exchange_type, overlap=overlap)
@@ -52,9 +52,15 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             p.dim_x, slot_to_x, slot_to_x.size, self.is_r2c, rt
         )
         self._build_value_branches()
-        # pencil programs consume the base's size-aware rep directly
-        # (lanecopy.phase_rep_tables_at): tables below the budget are embedded
-        # as constants, bigger plans generate in-trace
+        # pencil programs consume the base's size-aware rep directly (the
+        # shared MxuValuePlans._phase_tables resolution): tables below the
+        # budget are embedded as constants, bigger plans generate in-trace
+
+        # Stage-graph IR (spfft_tpu.ir), deferred past the matrix builds
+        # above (see Pencil2Execution.__init__).
+        from ..ir.compile import init_engine_ir
+
+        self._ir = init_engine_ir(self, fuse)
 
     def describe(self) -> dict:
         """Engine fragment of the plan card (obs.plancard): the pencil
@@ -77,42 +83,181 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
             return out[0], out[1]
         return super()._exchange_pair(bre, bim, axes)
 
+    # ---- pipeline stage bodies -------------------------------------------------
+    # One per-shard implementation per stage, shared by the monolithic impls
+    # below and the IR node fns lowered from this engine
+    # (spfft_tpu.ir.lower). The pair-array mirror of the base class's stage
+    # bodies; the A/B pack/unpack ride the base's shared helpers.
+
+    def _st_decompress(self, values_re, values_im):
+        rt = self.real_dtype
+        _, _, s_me = self._shard_me()
+        return jax.lax.switch(
+            jnp.asarray(self._branch_of_shard)[s_me],
+            self._decompress_branches,
+            values_re.astype(rt),
+            values_im.astype(rt),
+        )
+
+    def _st_stick_symmetry(self, sre, sim):
+        p = self.params
+        _, _, s_me = self._shard_me()
+        i = p.zero_stick_row
+        fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
+        own = s_me == p.zero_stick_shard
+        return (
+            sre.at[i].set(jnp.where(own, fre, sre[i])),
+            sim.at[i].set(jnp.where(own, fim, sim[i])),
+        )
+
+    def _st_z_backward(self, sre, sim):
+        _, _, s_me = self._shard_me()
+        sre, sim = offt.complex_matmul(
+            sre, sim, *self._wz_b, "sz,zk->sk", self._precision
+        )
+        # undo the alignment rotations; the shared MxuValuePlans resolution
+        # reads the embedded/in-trace rep (pencil engines stage no operands)
+        cos_t, sin_t = self._phase_tables(s_me, self.real_dtype)
+        if cos_t is not None:
+            sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+        return sre, sim
+
+    def _st_pack_a_pair(self, sre, sim, zwin):
+        # pack A: my sticks split by destination (x-group, z-slab) —
+        # whole-row gathers + static window slices (base-class helpers)
+        _, _, s_me = self._shard_me()
+        return (
+            self._pack_a(sre, s_me, zwin=zwin),
+            self._pack_a(sim, s_me, zwin=zwin),
+        )
+
+    def _st_exchange_a_pair(self, bre, bim, reverse=False):
+        return self._exchange_pair(bre, bim, (AX1, AX2), reverse=reverse)
+
+    def _st_unpack_a_pair(self, rre, rim):
+        a_me, _, _ = self._shard_me()
+        return self._unpack_a(rre, a_me), self._unpack_a(rim, a_me)
+
+    def _st_plane_symmetry(self, gre, gim):
+        a_me, _, _ = self._shard_me()
+        g0, s0 = self._x0_group, self._x0_slot
+        pre, pim = symmetry.hermitian_fill_1d_pair(
+            gre[:, s0, :], gim[:, s0, :], axis=0
+        )
+        return (
+            gre.at[:, s0, :].set(jnp.where(a_me == g0, pre, gre[:, s0, :])),
+            gim.at[:, s0, :].set(jnp.where(a_me == g0, pim, gim[:, s0, :])),
+        )
+
+    def _st_y_backward(self, gre, gim):
+        return offt.complex_matmul(
+            gre, gim, *self._wy_b, "yal,yk->kal", self._precision
+        )
+
+    def _st_pack_b_pair(self, gre, gim):
+        return self._pack_b(gre), self._pack_b(gim)
+
+    def _st_exchange_b_pair(self, bre, bim, reverse=False):
+        return self._exchange_pair(bre, bim, (AX1,), reverse=reverse)
+
+    def _st_x_backward(self, rbre, rbim):
+        # x transform: the slot->x map is folded into the matrix (zero rows
+        # on sentinel slots), so assembly is a reshape + matmul
+        prec = self._precision
+        Ly, P1, Ax = self._Ly, self.P1, self._Ax
+        W = rbre.shape[-1]
+        hre = rbre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, W)
+        him = rbim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, W)
+        if self.is_r2c:
+            return offt.real_out_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
+        return offt.complex_matmul(hre, him, *self._wx_b, "ycl,cx->lyx", prec)
+
+    def _st_space_out(self, *parts):
+        # matmul DFT engines never apply ifft's 1/N, so no un-normalization
+        if self.is_r2c:
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        k = len(parts) // 2
+        if k == 1:
+            return parts[0], parts[1]
+        return (
+            jnp.concatenate(parts[:k], axis=0),
+            jnp.concatenate(parts[k:], axis=0),
+        )
+
+    def _st_x_forward(self, space_re, space_im=None, zwin=None):
+        prec, rt = self._precision, self.real_dtype
+        c0, c1 = (0, self._Lz) if zwin is None else zwin
+        if self.is_r2c:
+            return offt.real_in_matmul(
+                space_re[c0:c1].astype(rt), *self._wx_f, "lyx,xc->ycl", prec
+            )
+        return offt.complex_matmul(
+            space_re[c0:c1].astype(rt), space_im[c0:c1].astype(rt),
+            *self._wx_f, "lyx,xc->ycl", prec,
+        )
+
+    def _st_pack_b_rev_pair(self, hre, him):
+        # exchange B reverse: send each x-group home (within my z-window);
+        # the x matrices land in slot order, so the split is the shared
+        # _split_b reshape alone
+        W = hre.shape[-1]
+        return self._split_b(hre, W), self._split_b(him, W)
+
+    def _st_unpack_b_rev_pair(self, rbre, rbim):
+        return self._unpack_b_rev(rbre), self._unpack_b_rev(rbim)
+
+    def _st_y_forward(self, gre, gim):
+        return offt.complex_matmul(
+            gre, gim, *self._wy_f, "yal,yj->jal", self._precision
+        )
+
+    def _st_pack_a_rev_pair(self, gre, gim, z0):
+        a_me, b_me, _ = self._shard_me()
+        return (
+            self._pack_a_rev(gre, a_me, b_me, z0=z0),
+            self._pack_a_rev(gim, a_me, b_me, z0=z0),
+        )
+
+    def _st_unpack_a_rev_pair(self, *recvs):
+        k = len(recvs) // 2
+        rre = recvs[0] if k == 1 else jnp.concatenate(recvs[:k], axis=-1)
+        rim = recvs[k] if k == 1 else jnp.concatenate(recvs[k:], axis=-1)
+        _, _, s_me = self._shard_me()
+        return self._unpack_a_rev(rre, s_me), self._unpack_a_rev(rim, s_me)
+
+    def _st_z_forward(self, sre, sim, scaling):
+        _, _, s_me = self._shard_me()
+        cos_t, sin_t = self._phase_tables(s_me, self.real_dtype)
+        if cos_t is not None:
+            # enter the rotated layout on the space side
+            sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
+        return offt.complex_matmul(
+            sre, sim, *self._wz_f[ScalingType(scaling)], "sz,zk->sk",
+            self._precision,
+        )
+
+    def _st_compress(self, sre, sim):
+        _, _, s_me = self._shard_me()
+        return jax.lax.switch(
+            jnp.asarray(self._branch_of_shard)[s_me], self._compress_branches,
+            sre, sim,
+        )
+
     # ---- pipelines (traced lazily by the base's jit/shard_map wrappers) -------
 
     def _backward_impl(self, values_re, values_im, value_indices):
         del value_indices  # lane-copy branches close over their plans
         p = self.params
-        prec = self._precision
-        rt = self.real_dtype
-        S, Z, Y = self._S, p.dim_z, p.dim_y
-        P1, P2, Ax, Lz, Ly = self.P1, self.P2, self._Ax, self._Lz, self._Ly
-        a_me = jax.lax.axis_index(AX1)
-        b_me = jax.lax.axis_index(AX2)
-        s_me = a_me * P2 + b_me
 
         with jax.named_scope("compression"):
-            sre, sim = jax.lax.switch(
-                jnp.asarray(self._branch_of_shard)[s_me],
-                self._decompress_branches,
-                values_re[0].astype(rt),
-                values_im[0].astype(rt),
-            )
+            sre, sim = self._st_decompress(values_re[0], values_im[0])
 
         if self.is_r2c and p.zero_stick_shard >= 0:
             with jax.named_scope("stick symmetry"):
-                i = p.zero_stick_row
-                fre, fim = symmetry.hermitian_fill_1d_pair(sre[i], sim[i], axis=0)
-                own = s_me == p.zero_stick_shard
-                sre = sre.at[i].set(jnp.where(own, fre, sre[i]))
-                sim = sim.at[i].set(jnp.where(own, fim, sim[i]))
+                sre, sim = self._st_stick_symmetry(sre, sim)
 
         with jax.named_scope("z transform"):
-            sre, sim = offt.complex_matmul(sre, sim, *self._wz_b, "sz,zk->sk", prec)
-            if self._align_rep is not None:
-                # undo the alignment rotations; phase rides as embedded tables
-                # below the size budget, or is generated in-trace above it
-                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, -1)
+            sre, sim = self._st_z_backward(sre, sim)
 
         # Post-z chunk loop (see Pencil2Execution._backward_impl): one
         # full-window chunk bulk-synchronously, C z-window chunks under the
@@ -121,82 +266,43 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         ov = self._overlap > 1
         parts_re, parts_im = [], []
         for c0, c1 in self._chunks:
-            # pack A: my sticks split by destination (x-group, z-slab) —
-            # whole-row gathers + static window slices (base-class helpers)
             with jax.named_scope("pack A"):
-                bre = self._pack_a(sre, s_me, zwin=(c0, c1))
-                bim = self._pack_a(sim, s_me, zwin=(c0, c1))
+                bre, bim = self._st_pack_a_pair(sre, sim, (c0, c1))
 
             with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
-                rre, rim = self._exchange_pair(bre, bim, (AX1, AX2))
+                rre, rim = self._st_exchange_a_pair(bre, bim)
 
             # unpack A -> (Y, Ax, W) y-pencil grid (one row gather per part)
             with jax.named_scope("unpack A"):
-                gre = self._unpack_a(rre, a_me)
-                gim = self._unpack_a(rim, a_me)
+                gre, gim = self._st_unpack_a_pair(rre, rim)
 
             if self.is_r2c and self._have_x0:
                 with jax.named_scope("plane symmetry"):
-                    g0, s0 = self._x0_group, self._x0_slot
-                    pre, pim = symmetry.hermitian_fill_1d_pair(
-                        gre[:, s0, :], gim[:, s0, :], axis=0
-                    )
-                    gre = gre.at[:, s0, :].set(
-                        jnp.where(a_me == g0, pre, gre[:, s0, :])
-                    )
-                    gim = gim.at[:, s0, :].set(
-                        jnp.where(a_me == g0, pim, gim[:, s0, :])
-                    )
+                    gre, gim = self._st_plane_symmetry(gre, gim)
 
             with jax.named_scope("y transform"):
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_b, "yal,yk->kal", prec
-                )
+                gre, gim = self._st_y_backward(gre, gim)
 
             # pack B: each destination's y-rows (within my z-window)
             with jax.named_scope("pack B"):
-                bre = self._pack_b(gre)
-                bim = self._pack_b(gim)
+                bre, bim = self._st_pack_b_pair(gre, gim)
 
             with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
-                rbre, rbim = self._exchange_pair(bre, bim, (AX1,))
+                rbre, rbim = self._st_exchange_b_pair(bre, bim)
 
-            # x transform: the slot->x map is folded into the matrix (zero
-            # rows on sentinel slots), so assembly is a reshape + matmul
             with jax.named_scope("x transform"):
-                hre = rbre.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
-                him = rbim.transpose(1, 0, 2, 3).reshape(Ly, P1 * Ax, c1 - c0)
+                out = self._st_x_backward(rbre, rbim)
                 if self.is_r2c:
-                    parts_re.append(
-                        offt.real_out_matmul(
-                            hre, him, *self._wx_b, "ycl,cx->lyx", prec
-                        )
-                    )
+                    parts_re.append(out)
                 else:
-                    ore, oim = offt.complex_matmul(
-                        hre, him, *self._wx_b, "ycl,cx->lyx", prec
-                    )
-                    parts_re.append(ore)
-                    parts_im.append(oim)
+                    parts_re.append(out[0])
+                    parts_im.append(out[1])
+        out = self._st_space_out(*parts_re, *parts_im)
         if self.is_r2c:
-            out = (
-                parts_re[0] if len(parts_re) == 1
-                else jnp.concatenate(parts_re, axis=0)
-            )
             return out[None]
-        ore = parts_re[0] if len(parts_re) == 1 else jnp.concatenate(parts_re, axis=0)
-        oim = parts_im[0] if len(parts_im) == 1 else jnp.concatenate(parts_im, axis=0)
-        return ore[None], oim[None]
+        return out[0][None], out[1][None]
 
     def _forward_impl(self, space_re, *rest, scale):
-        p = self.params
-        prec = self._precision
-        rt = self.real_dtype
-        S, Z, Y = self._S, p.dim_z, p.dim_y
-        P1, P2, Ax, Lz, Ly = self.P1, self.P2, self._Ax, self._Lz, self._Ly
-        a_me = jax.lax.axis_index(AX1)
-        b_me = jax.lax.axis_index(AX2)
-        s_me = a_me * P2 + b_me
         scaling = ScalingType.NONE if scale is None else ScalingType.FULL
 
         if self.is_r2c:
@@ -211,68 +317,38 @@ class MxuPencil2Execution(Pencil2Execution, MxuValuePlans):
         recvs_re, recvs_im = [], []
         for c0, c1 in self._chunks:
             with jax.named_scope("x transform"):
-                if self.is_r2c:
-                    hre, him = offt.real_in_matmul(
-                        space_re[0][c0:c1].astype(rt), *self._wx_f,
-                        "lyx,xc->ycl", prec,
-                    )
-                else:
-                    hre, him = offt.complex_matmul(
-                        space_re[0][c0:c1].astype(rt),
-                        space_im[0][c0:c1].astype(rt),
-                        *self._wx_f, "lyx,xc->ycl", prec,
-                    )
+                hre, him = self._st_x_forward(
+                    space_re[0],
+                    None if space_im is None else space_im[0],
+                    zwin=(c0, c1),
+                )
 
-            # exchange B reverse: send each x-group home (within my z-window)
             with jax.named_scope("pack B"):
-                bre = hre.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
-                bim = him.reshape(Ly, P1, Ax, c1 - c0).transpose(1, 0, 2, 3)
+                bre, bim = self._st_pack_b_rev_pair(hre, him)
             with jax.named_scope("exchange B overlapped" if ov else "exchange B"):
-                rbre, rbim = self._exchange_pair(bre, bim, (AX1,), reverse=True)
+                rbre, rbim = self._st_exchange_b_pair(bre, bim, reverse=True)
 
             # reassemble the full y extent of my x-group (one row gather each)
             with jax.named_scope("unpack B"):
-                gre = self._unpack_b_rev(rbre)
-                gim = self._unpack_b_rev(rbim)
+                gre, gim = self._st_unpack_b_rev_pair(rbre, rbim)
 
             with jax.named_scope("y transform"):
-                gre, gim = offt.complex_matmul(
-                    gre, gim, *self._wy_f, "yal,yj->jal", prec
-                )
+                gre, gim = self._st_y_forward(gre, gim)
 
             # exchange A reverse: each stick's z-chunk back to its owner
             with jax.named_scope("pack A"):
-                bre = self._pack_a_rev(gre, a_me, b_me, z0=c0)
-                bim = self._pack_a_rev(gim, a_me, b_me, z0=c0)
+                bre, bim = self._st_pack_a_rev_pair(gre, gim, c0)
             with jax.named_scope("exchange A overlapped" if ov else "exchange A"):
-                rre, rim = self._exchange_pair(bre, bim, (AX1, AX2), reverse=True)
+                rre, rim = self._st_exchange_a_pair(bre, bim, reverse=True)
             recvs_re.append(rre)
             recvs_im.append(rim)
-        rre = (
-            recvs_re[0] if len(recvs_re) == 1
-            else jnp.concatenate(recvs_re, axis=-1)
-        )
-        rim = (
-            recvs_im[0] if len(recvs_im) == 1
-            else jnp.concatenate(recvs_im, axis=-1)
-        )
 
         with jax.named_scope("unpack A"):
-            sre = self._unpack_a_rev(rre, s_me)
-            sim = self._unpack_a_rev(rim, s_me)
+            sre, sim = self._st_unpack_a_rev_pair(*recvs_re, *recvs_im)
 
         with jax.named_scope("z transform"):
-            if self._align_rep is not None:
-                # enter the rotated layout on the space side
-                cos_t, sin_t = lanecopy.phase_rep_tables_at(self._align_rep, s_me, rt)
-                sre, sim = lanecopy.apply_alignment_phase(sre, sim, cos_t, sin_t, +1)
-            sre, sim = offt.complex_matmul(
-                sre, sim, *self._wz_f[scaling], "sz,zk->sk", prec
-            )
+            sre, sim = self._st_z_forward(sre, sim, scaling)
 
         with jax.named_scope("compression"):
-            vre, vim = jax.lax.switch(
-                jnp.asarray(self._branch_of_shard)[s_me], self._compress_branches,
-                sre, sim,
-            )
+            vre, vim = self._st_compress(sre, sim)
         return vre[None], vim[None]

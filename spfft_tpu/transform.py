@@ -54,6 +54,7 @@ class Transform:
         policy: str | None = None,
         guard: bool | None = None,
         verify=None,
+        fuse=None,
     ):
         if IndexFormat(index_format) != IndexFormat.TRIPLETS:
             raise InvalidParameterError("only SPFFT_INDEX_TRIPLETS is supported")
@@ -147,6 +148,10 @@ class Transform:
         self._guard = faults.guard_enabled(guard)
         self._degradations: list = []
         self._tuning = None
+        # Fusion request (spfft_tpu.ir): the raw kwarg — engines resolve
+        # kwarg-else-SPFFT_TPU_FUSE at construction, so a tuned candidate's
+        # env override can own the knob when the caller leaves it unset.
+        self._fuse = fuse
         # Run ID (spfft_tpu.obs.trace): the correlation key joining this
         # plan's card, metrics and flight-recorder events. The "plan"
         # operation span keeps it active for the whole construction, so
@@ -181,11 +186,18 @@ class Transform:
                             precision=precision,
                             device=device,
                             policy="default",
+                            # An explicit fuse= pins the fusion axis: trials
+                            # run at the pinned state (the kwarg beats any
+                            # candidate env in ir.resolve_fuse) and tuned_local
+                            # keys wisdom on the pin, so the measured variant
+                            # is always the variant the chosen plan runs.
+                            fuse=fuse,
                         )
 
                 with faults.collecting(self._degradations):
                     choice, self._tuning = tuning.tuned_local(
-                        p, device, self._real_dtype, precision, build
+                        p, device, self._real_dtype, precision, build,
+                        fuse=fuse,
                     )
                 engine = choice["engine"]
                 engine_env = dict(choice.get("env") or {})
@@ -213,7 +225,8 @@ class Transform:
                         # os.environ untouched; see tuning.env_overrides)
                         with env_overrides(engine_env):
                             self._exec = MxuLocalExecution(
-                                self._params, self._real_dtype, device=device, precision=precision
+                                self._params, self._real_dtype, device=device,
+                                precision=precision, fuse=fuse,
                             )
                         self._native_transposed = True
                     except faults.ENGINE_BUILD_ERRORS as e:
@@ -222,7 +235,8 @@ class Transform:
                 if engine == "xla":
                     try:
                         self._exec = LocalExecution(
-                            self._params, self._real_dtype, device=device
+                            self._params, self._real_dtype, device=device,
+                            fuse=fuse,
                         )
                     except faults.ENGINE_BUILD_ERRORS as e:
                         raise FFTWError(
@@ -563,7 +577,16 @@ class Transform:
             device=self._device,
             guard=self._guard,
             verify=self._verify_mode,
+            fuse=self._fuse,
         )
+
+    @property
+    def fused(self) -> bool:
+        """Whether this plan executes through the IR-fused single program
+        per direction (False: the staged per-node reference path or the
+        ``ir_lower_failed`` legacy rung — see the plan card's ``ir``
+        section)."""
+        return bool(self._exec._ir.fused)
 
     # ---- introspection --------------------------------------------------------
 

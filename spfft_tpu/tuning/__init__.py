@@ -318,15 +318,23 @@ def tuned_exchange(params, mesh, dtype, engine, precision, pencil2, build,
     )
 
 
-def tuned_local(params, device, dtype, precision, build):
+def tuned_local(params, device, dtype, precision, build, fuse=None):
     """Resolve a local plan's ``engine="auto"`` under the TUNED policy.
 
     Returns ``(choice, record)`` where ``choice`` is a local candidate dict
     (``engine`` + ``env`` overrides the caller applies around its engine
     construction). Same hit/trial/model-fallback ladder as
     :func:`tuned_exchange`; the model fallback is the static auto rule
-    (XLA on CPU, MXU elsewhere)."""
+    (XLA on CPU, MXU elsewhere).
+
+    ``fuse``: the caller's explicit ``fuse=`` kwarg, or None when the tuner
+    owns the fusion axis (same contract as ``tuned_exchange``'s ``overlap``).
+    The pin is part of the wisdom key — a pinned plan's winner (measured at
+    the pinned state, see ``local_candidates``) never answers a tuner-owned
+    lookup, whose ``*/staged``-labeled envs would otherwise be overridden by
+    the kwarg while the provenance claims the trialed variant ran."""
     key = local_key(params, device, dtype, precision)
+    key["fuse"] = "tuned" if fuse is None else int(bool(fuse))
     store = active_store()
     entry = store.lookup(key)
     if entry is not None:
@@ -358,7 +366,7 @@ def tuned_local(params, device, dtype, precision, build):
             reason=reason,
             key=key,
         )
-    trials = run_trials(build, local_candidates(platform))
+    trials = run_trials(build, local_candidates(platform, dtype, fuse=fuse))
     measured = [row for row in trials if "ms" in row]
     if not measured:
         choice = {

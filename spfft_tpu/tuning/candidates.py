@@ -25,6 +25,8 @@ overrides for local).
 """
 from __future__ import annotations
 
+import numpy as np
+
 # Chunk counts the OVERLAPPED-discipline axis trials when the caller leaves
 # the knob to the tuner (overlap=None). Small powers of two: chunking past
 # a handful of chunks trades per-collective efficiency for no extra hiding
@@ -137,16 +139,40 @@ def sched_candidates(num_devices: int) -> list:
     return [{"label": f"rr{w}", "width": int(w)} for w in widths]
 
 
-def local_candidates(platform: str) -> list:
-    """Local-plan candidates: engine x sparse-y-knob variants.
+def local_candidates(platform: str, dtype=None, fuse=None) -> list:
+    """Local-plan candidates: engine x sparse-y-knob x fusion variants.
 
     The MXU candidates differ only in env overrides applied for the trial
     (and for the chosen plan's engine construction) — the knobs are already
-    single-sourced in ``ops/fft.py``, so the tuner tries them rather than
-    re-modeling them. Platform only orders the list (likely winner first:
-    MXU on accelerators, XLA/pocketfft on CPU); every candidate is buildable
-    everywhere, and the platform is part of the wisdom key.
-    """
+    single-sourced in ``ops/fft.py`` / ``spfft_tpu.ir``, so the tuner tries
+    them rather than re-modeling them. Platform only orders the list (likely
+    winner first: MXU on accelerators, XLA/pocketfft on CPU); every
+    candidate is buildable everywhere, and the platform is part of the
+    wisdom key.
+
+    The fusion axis (spfft_tpu.ir): the bare engine labels run FUSED (one
+    IR-compiled program per direction — the default); ``*/staged`` runs the
+    per-node dispatch reference, so a regime where fusion somehow loses
+    (enormous programs, compile-memory pressure) is measurable rather than
+    assumed away; ``mxu/bf16-twiddle`` is the mixed-precision FUSED variant
+    (bf16 DFT matrices, f32 activations — f32 plans only, see
+    ``ops/fft.twiddle_bf16_enabled``; when ``dtype`` says the plan is f64
+    the knob is a no-op, so the candidate is dropped rather than trialed as
+    a duplicate of the bare ``mxu`` whose noise win would persist a
+    misleading mixed-precision choice). The winning variant's env persists
+    in wisdom with the choice, so a warm store reproduces the fusion
+    decision with zero trials.
+
+    ``fuse``: the caller's explicit ``fuse=`` kwarg, or None to let the
+    tuner own the axis. An explicit kwarg beats every candidate's env in
+    ``ir.resolve_fuse``, so under a pin the ``*/staged`` variants would
+    silently measure the pinned state while their label (and the persisted
+    wisdom env) claims otherwise — the same mislabeled-choice class as the
+    f64 bf16-twiddle duplicate above. A pinned axis therefore drops every
+    candidate that sets ``SPFFT_TPU_FUSE``: the remaining candidates carry
+    no fusion env, the kwarg owns the state, and the wisdom key records the
+    pin (see ``tuned_local``) so pinned and tuner-owned entries never mix."""
+    bf16_applies = dtype is None or np.dtype(dtype) == np.dtype(np.float32)
     mxu = [
         {"label": "mxu", "engine": "mxu", "env": {}},
         {
@@ -154,6 +180,21 @@ def local_candidates(platform: str) -> list:
             "engine": "mxu",
             "env": {"SPFFT_TPU_SPARSE_Y": "0", "SPFFT_TPU_SPARSE_Y_BLOCKS": "0"},
         },
+        {"label": "mxu/staged", "engine": "mxu", "env": {"SPFFT_TPU_FUSE": "0"}},
     ]
-    xla = [{"label": "xla", "engine": "xla", "env": {}}]
-    return xla + mxu if platform == "cpu" else mxu + xla
+    if bf16_applies:
+        mxu.append(
+            {
+                "label": "mxu/bf16-twiddle",
+                "engine": "mxu",
+                "env": {"SPFFT_TPU_TWIDDLE_BF16": "1"},
+            }
+        )
+    xla = [
+        {"label": "xla", "engine": "xla", "env": {}},
+        {"label": "xla/staged", "engine": "xla", "env": {"SPFFT_TPU_FUSE": "0"}},
+    ]
+    cands = xla + mxu if platform == "cpu" else mxu + xla
+    if fuse is not None:
+        cands = [c for c in cands if "SPFFT_TPU_FUSE" not in c["env"]]
+    return cands

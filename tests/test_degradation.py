@@ -166,7 +166,9 @@ def test_degraded_trial_never_poisons_wisdom(monkeypatch, tmp_path):
     assert rec["choice"]["engine"] == "xla"
     by_label = {row["label"]: row for row in rec["trials"]}
     assert "ms" in by_label["xla"]
-    mxu_rows = [r for label, r in by_label.items() if label != "xla"]
+    # mxu-flavored = the candidates whose build hits the armed engine.compile
+    # site (the xla fusion variants build fine and measure honestly)
+    mxu_rows = [r for r in by_label.values() if r["engine"] == "mxu"]
     assert mxu_rows and all("error" in r for r in mxu_rows)
     assert all(r["error"].startswith("TrialDegradedError") for r in mxu_rows)
     # the persisted store carries the honest choice, not a mislabeled mxu

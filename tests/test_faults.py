@@ -271,11 +271,26 @@ def test_chaos_invariant_every_site(site_name, tmp_path, monkeypatch):
     assert obs.validate_plan_card(card) == []
     if site_name == "engine.compile":
         assert card["degradations"], "engine fallback must be recorded"
+    if site_name == "ir.lower":
+        # IR degradation rung: a failed lowering runs the legacy monolithic
+        # jits, recorded — never a failed plan (spfft_tpu.ir)
+        assert card["ir"]["path"] == "legacy" and not card["ir"]["fused"]
+        assert any(d["event"] == "ir_lower_failed" for d in card["degradations"])
+    if site_name == "ir.compile":
+        # a failed fusion compile falls back to the staged per-node path
+        assert card["ir"]["path"] == "staged" and not card["ir"]["fused"]
+        assert any(
+            d["event"] == "fuse_compile_failed" for d in card["degradations"]
+        )
 
 
 @pytest.mark.parametrize("overlap", [1, 2])
 @pytest.mark.parametrize(
-    "site_name", ["exchange.build", "engine.compile", "engine.execute", "sync.fence"]
+    "site_name",
+    [
+        "exchange.build", "engine.compile", "engine.execute", "sync.fence",
+        "ir.lower", "ir.compile",
+    ],
 )
 def test_chaos_invariant_distributed(site_name, overlap):
     """The distributed chaos invariant, for the bulk-synchronous AND the
