@@ -8,7 +8,8 @@
 # multichip dryrun, and the native C/C++ build + API roundtrip.
 #
 # Usage:   ./ci.sh            # everything
-#          ./ci.sh lint       # import hygiene + env-knob docs + stage scopes
+#          ./ci.sh lint       # ported checkers 1-9 (programs/lint.py shim)
+#          ./ci.sh analyze    # full static-analysis gate + doctored-trip proofs
 #          ./ci.sh python     # Python suite only
 #          ./ci.sh report     # plan-card CLI + JSON schema validation only
 #          ./ci.sh tune       # autotuner smoke (trial + wisdom hit, CPU)
@@ -30,8 +31,130 @@ cd "$(dirname "$0")"
 stage="${1:-all}"
 
 run_lint() {
-  echo "== Lint (programs/lint.py: imports + env-knob docs) =="
+  echo "== Lint (programs/lint.py: shim over spfft_tpu.analysis checkers 1-9) =="
   python programs/lint.py
+}
+
+run_analyze() {
+  echo "== Analyze (spfft_tpu.analysis: 14 checkers, baselined gate) =="
+  local adir
+  adir="$(mktemp -d)"
+  # Full gate over the real tree: zero non-baselined findings, and the
+  # spfft_tpu.analysis/1 report must validate against its schema floor.
+  python programs/analyze.py --json "$adir/analysis.json"
+  python - "$adir" <<'EOF'
+import json, sys
+sys.path.insert(0, "programs")
+from analyze import load_analysis
+
+analysis = load_analysis()
+doc = json.loads(open(f"{sys.argv[1]}/analysis.json").read())
+missing = analysis.validate_report(doc)
+assert not missing, f"analysis report schema incomplete: {missing}"
+assert len(doc["checkers"]) == 14, [c["code"] for c in doc["checkers"]]
+assert doc["counts"]["new"] == 0 and doc["counts"]["stale_baseline"] == 0, doc["counts"]
+print(f"analysis report ok ({len(doc['checkers'])} checkers, "
+      f"{doc['counts']['total']} finding(s), all baselined)")
+EOF
+  # The gate must TRIP (exit 3, the distinct tripped-gate code) on doctored
+  # trees. Copy the scanned surface + anchors, then doctor one defect per
+  # proof and assert the typed finding appears.
+  mkdir -p "$adir/tree_locks"
+  cp -r spfft_tpu programs docs tests analysis_baseline.json "$adir/tree_locks/"
+  cp -r "$adir/tree_locks" "$adir/tree_donate"
+  cp -r "$adir/tree_locks" "$adir/tree_stale"
+  # (a) lock-order cycle: two module locks acquired in opposite orders.
+  cat > "$adir/tree_locks/spfft_tpu/_doctored_locks.py" <<'EOF'
+"""Doctored CI fixture: a lock-order cycle the SA011 gate must catch."""
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+
+def one():
+    with A:
+        with B:
+            pass
+
+
+def two():
+    with B:
+        with A:
+            pass
+EOF
+  local rc=0
+  python programs/analyze.py --root "$adir/tree_locks" --only SA011 \
+    --json "$adir/locks.json" > /dev/null || rc=$?
+  if [ "$rc" -ne 3 ]; then
+    echo "analysis gate did not trip on doctored lock-order cycle (rc=$rc)" >&2
+    exit 1
+  fi
+  python - "$adir" <<'EOF'
+import json, sys
+
+doc = json.loads(open(f"{sys.argv[1]}/locks.json").read())
+hits = [f for f in doc["findings"]
+        if f["code"] == "SA011" and "cycle" in f["message"]]
+assert hits and not hits[0]["baselined"], doc["findings"]
+print(f"doctored lock-order trip ok ({hits[0]['file']})")
+EOF
+  # (b) use-after-donate: a local backward graph referencing a donated
+  # input edge after its consuming node.
+  cat >> "$adir/tree_donate/spfft_tpu/ir/lower.py" <<'EOF'
+
+
+def _lower_local_doctored(e):
+    """Doctored CI fixture: use-after-donate the SA012 gate must catch."""
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re")
+        g.add_input("values_im")
+        g.add(
+            "compression", e._st_decompress,
+            ("values_re", "values_im"), ("sticks",),
+        )
+        g.add("z transform", e._st_z_backward, ("sticks", "values_re"), ("z",))
+        g.set_outputs(["z"])
+        return g
+
+    return {"backward": backward()}
+EOF
+  rc=0
+  python programs/analyze.py --root "$adir/tree_donate" --only SA012 \
+    --json "$adir/donate.json" > /dev/null || rc=$?
+  if [ "$rc" -ne 3 ]; then
+    echo "analysis gate did not trip on doctored use-after-donate (rc=$rc)" >&2
+    exit 1
+  fi
+  python - "$adir" <<'EOF'
+import json, sys
+
+doc = json.loads(open(f"{sys.argv[1]}/donate.json").read())
+hits = [f for f in doc["findings"]
+        if f["code"] == "SA012" and "referenced after its consuming node" in f["message"]]
+assert hits and not hits[0]["baselined"], doc["findings"]
+print(f"doctored use-after-donate trip ok ({hits[0]['file']}:{hits[0]['line']})")
+EOF
+  # (c) baseline freshness: an accepted entry whose finding no longer
+  # exists must trip too — a fixed finding must leave the baseline.
+  python - "$adir" <<'EOF'
+import json, sys
+
+p = f"{sys.argv[1]}/tree_stale/analysis_baseline.json"
+doc = json.loads(open(p).read())
+doc["entries"].append("SA010:spfft_tpu/ghost.py:finding that was fixed")
+json.dump(doc, open(p, "w"), indent=2)
+EOF
+  rc=0
+  python programs/analyze.py --root "$adir/tree_stale" > /dev/null || rc=$?
+  if [ "$rc" -ne 3 ]; then
+    echo "analysis gate did not trip on a stale baseline entry (rc=$rc)" >&2
+    exit 1
+  fi
+  echo "analyze gate ok (tree green, doctored SA011/SA012 + stale baseline each exit 3)"
+  rm -rf "$adir"
 }
 
 run_python() {
@@ -536,6 +659,7 @@ run_native() {
 
 case "$stage" in
   lint) run_lint ;;
+  analyze) run_analyze ;;
   python) run_python ;;
   report) run_report ;;
   tune) run_tune ;;
@@ -550,6 +674,7 @@ case "$stage" in
   native) run_native ;;
   all)
     run_lint
+    run_analyze
     run_python
     run_report
     run_tune
@@ -565,7 +690,7 @@ case "$stage" in
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | python | report | tune | trace | chaos | verify | serve | sched | perf | ir | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | analyze | python | report | tune | trace | chaos | verify | serve | sched | perf | ir | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

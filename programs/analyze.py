@@ -1,0 +1,201 @@
+"""Static-analysis CLI: run the ``spfft_tpu.analysis`` checkers as a gate.
+
+The one command CI (``./ci.sh analyze``) and developers run:
+
+    python programs/analyze.py                 # full gate, human output
+    python programs/analyze.py --json -        # spfft_tpu.analysis/1 report
+    python programs/analyze.py --only SA011    # one checker (code or name)
+    python programs/analyze.py --write-baseline  # accept current findings
+    python programs/analyze.py --list          # the checker catalog
+
+Exit status: 0 green (every finding baselined, no stale baseline entries),
+3 when the gate trips — a NEW finding, or a STALE baseline entry (a fixed
+finding must leave the baseline, or the baseline rots into a blanket
+waiver), 2 on usage errors. The distinct exit 3 is the same convention as
+``programs/perf_gate.py``: a tripped gate, not a crashed tool.
+
+The analysis package is loaded standalone (no ``spfft_tpu`` import, no
+``jax``) — the same import-free rule the old ``programs/lint.py`` followed,
+so the gate runs in milliseconds on hosts with no accelerator stack warmed.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_PKG_NAME = "spfft_tpu_analysis_standalone"
+
+
+def load_analysis(root: Path = ROOT):
+    """Load ``spfft_tpu/analysis`` as a standalone package (relative
+    imports intact, ``spfft_tpu/__init__`` — and therefore jax — never
+    executed)."""
+    if _PKG_NAME in sys.modules:
+        return sys.modules[_PKG_NAME]
+    pkg_dir = root / "spfft_tpu" / "analysis"
+    spec = importlib.util.spec_from_file_location(
+        _PKG_NAME,
+        pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG_NAME] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        del sys.modules[_PKG_NAME]
+        raise
+    return mod
+
+
+def _ran_codes(analysis, only) -> set:
+    """Codes of the checkers a ``--only`` selection actually runs."""
+    wanted = set(only)
+    return {
+        c.code for c in analysis.CHECKERS.values()
+        if c.name in wanted or c.code in wanted
+    }
+
+
+def run_gate(
+    analysis,
+    *,
+    root: Path,
+    baseline_path: Path,
+    only=None,
+    json_out=None,
+    write_baseline=False,
+    quiet=False,
+) -> int:
+    """The gate body (``programs/lint.py`` reuses it for checkers 1-9)."""
+    tree = analysis.Tree(root=root)
+    findings = analysis.run(tree, only=only)
+
+    if write_baseline:
+        doc = analysis.baseline_doc(findings)
+        if only:
+            # a subset write replaces only the ran checkers' entries — the
+            # other checkers' accepted findings must survive the rewrite
+            ran = _ran_codes(analysis, only)
+            kept = {
+                k for k in analysis.load_baseline(baseline_path)
+                if k.split(":", 1)[0] not in ran
+            }
+            doc["entries"] = sorted(set(doc["entries"]) | kept)
+        baseline_path.write_text(json.dumps(doc, indent=2) + "\n")
+        print(
+            f"wrote {baseline_path} ({len(doc['entries'])} accepted "
+            f"finding(s))"
+        )
+        return 0
+
+    accepted = analysis.load_baseline(baseline_path)
+    if only:
+        # a subset run must not call the other checkers' baseline entries
+        # stale: restrict staleness to the codes that actually ran
+        accepted = {
+            k for k in accepted
+            if k.split(":", 1)[0] in _ran_codes(analysis, only)
+        }
+    split = analysis.apply_baseline(findings, accepted)
+
+    if json_out is not None:
+        doc = analysis.report_doc(
+            findings, split, root=str(root), baseline_path=str(baseline_path)
+        )
+        text = json.dumps(doc, indent=2) + "\n"
+        if json_out == "-":
+            sys.stdout.write(text)
+        else:
+            Path(json_out).write_text(text)
+
+    if not quiet and json_out != "-":
+        for f in split["new"]:
+            print(f.render())
+        if split["baselined"]:
+            print(
+                f"{len(split['baselined'])} baselined finding(s) "
+                f"(accepted in {baseline_path.name})"
+            )
+        for key in split["stale"]:
+            print(
+                f"stale baseline entry (the finding was fixed — remove it "
+                f"or rerun --write-baseline): {key}"
+            )
+    if split["new"] or split["stale"]:
+        if not quiet and json_out != "-":
+            print(
+                f"analysis gate TRIPPED: {len(split['new'])} new finding(s), "
+                f"{len(split['stale'])} stale baseline entr(ies)"
+            )
+        return 3
+    if not quiet and json_out != "-":
+        names = only or list(analysis.CHECKERS)
+        print(
+            f"analysis ok: {len(names)} checker(s), "
+            f"{len(findings)} finding(s), all baselined"
+        )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--json", metavar="PATH",
+        help="write the spfft_tpu.analysis/1 JSON report (- for stdout)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept every current finding into the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--only", action="append", metavar="CHECKER",
+        help="run one checker (code SA0NN or slug name); repeatable",
+    )
+    p.add_argument(
+        "--root", default=str(ROOT), metavar="DIR",
+        help="tree to analyze (default: this checkout)",
+    )
+    p.add_argument(
+        "--baseline", metavar="PATH",
+        help="baseline file (default: <root>/analysis_baseline.json)",
+    )
+    p.add_argument(
+        "--list", action="store_true", help="print the checker catalog"
+    )
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    analysis = load_analysis(root if (root / "spfft_tpu" / "analysis").is_dir() else ROOT)
+
+    if args.list:
+        for entry in analysis.CHECKERS.values():
+            print(f"{entry.code}  {entry.severity:5s}  {entry.name}")
+        return 0
+
+    baseline_path = Path(
+        args.baseline if args.baseline else root / "analysis_baseline.json"
+    )
+    try:
+        return run_gate(
+            analysis,
+            root=root,
+            baseline_path=baseline_path,
+            only=args.only,
+            json_out=args.json,
+            write_baseline=args.write_baseline,
+            quiet=args.quiet,
+        )
+    except analysis.AnalysisError as e:
+        print(f"analysis error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

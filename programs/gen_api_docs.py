@@ -286,6 +286,7 @@ def index_page() -> str:
         - [Serving: admission, coalesced batching, load shedding](serve.md)
         - [Task-graph scheduling: placement, overlap, completion order](sched.md)
         - [Stage-graph IR and per-direction fusion](ir.md)
+        - [Static analysis: the checker catalog and the baselined gate](analysis.md)
         - [C API](c_api.md)
         - [Fortran module](fortran.md)
         - [Examples](examples.md)
@@ -448,6 +449,106 @@ def sched_page() -> str:
     )
 
 
+def analysis_page() -> str:
+    """The static-analysis page: the checker catalog rendered from the
+    live registry (code/severity/doc per checker), plus the gate and
+    baseline workflow."""
+    import spfft_tpu.analysis as analysis
+
+    out = [
+        "# Static analysis (`spfft_tpu.analysis`)",
+        "",
+        doc(analysis),
+        "",
+        "## Checker catalog",
+        "",
+        "| Code | Checker | Severity | What it enforces |",
+        "|---|---|---|---|",
+    ]
+    for entry in analysis.CHECKERS.values():
+        escaped = entry.doc.replace("|", "\\|")
+        out.append(
+            f"| `{entry.code}` | `{entry.name}` | {entry.severity} | "
+            f"{escaped} |"
+        )
+    out += [
+        "",
+        "## Running the gate",
+        "",
+        "```",
+        "python programs/analyze.py                # full gate (exit 3 on new findings)",
+        "python programs/analyze.py --json report.json",
+        "python programs/analyze.py --only SA011   # one checker",
+        "python programs/analyze.py --write-baseline",
+        "```",
+        "",
+        "Findings are suppressed per line with `# noqa: <CODE>`; accepted "
+        "pre-existing findings live in the committed `analysis_baseline.json` "
+        "(keyed `CODE:file:message`, line-number-free). New findings AND "
+        "stale baseline entries (a fixed finding must leave the baseline) "
+        "exit 3 — `./ci.sh analyze` proves the trip on doctored lock-order "
+        "and use-after-donate fixtures. `programs/lint.py` is a thin shim "
+        "running the ported checkers SA001-SA009.",
+        "",
+        "See docs/details.md \"Static analysis\" for the baseline workflow "
+        "and how to add a checker.",
+        "",
+    ]
+    return "\n".join(out)
+
+
+KNOB_TABLE_BEGIN = "<!-- knob-table:begin (generated from spfft_tpu.knobs by programs/gen_api_docs.py — edit docs in the registry, not here) -->"
+KNOB_TABLE_END = "<!-- knob-table:end -->"
+
+
+def knob_table() -> str:
+    """The docs/details.md knob table, rendered from the registry (the
+    single holder of name/kind/default/doc — SA003 keeps the two in sync)."""
+    from spfft_tpu import knobs
+
+    rows = [
+        "| Knob | Default | Effect |",
+        "|---|---|---|",
+    ]
+    # registration order, not sorted: the registry groups knobs by
+    # subsystem (engine, tuning, obs, faults, verify, serve) and the table
+    # keeps that narrative
+    for knob in knobs.REGISTRY.values():
+        row = knob.describe()
+        if row["internal"]:
+            continue
+        if row["doc_default"] is not None:
+            default = row["doc_default"]
+        elif row["default"] is None:
+            default = "—"
+        else:
+            v = row["default"]
+            if isinstance(v, bool):
+                v = int(v)
+            elif isinstance(v, float) and v == int(v):
+                v = int(v)
+            default = f"`{v}`"
+        escaped = row["doc"].replace("|", "\\|")
+        rows.append(f"| `{row['name']}` | {default} | {escaped} |")
+    return "\n".join(rows)
+
+
+def rewrite_knob_table(details_path: Path) -> None:
+    """Replace the marked knob-table block in docs/details.md in place."""
+    text = details_path.read_text()
+    begin = text.index(KNOB_TABLE_BEGIN)
+    end = text.index(KNOB_TABLE_END)
+    text = (
+        text[: begin + len(KNOB_TABLE_BEGIN)]
+        + "\n"
+        + knob_table()
+        + "\n"
+        + text[end:]
+    )
+    details_path.write_text(text)
+    print(f"rewrote knob table in {details_path}")
+
+
 def generate(outdir: Path) -> None:
     import spfft_tpu as sp
     from spfft_tpu import faults, timing, tuning
@@ -556,6 +657,7 @@ def generate(outdir: Path) -> None:
         "serve.md": serve_page(),
         "sched.md": sched_page(),
         "ir.md": ir_page(),
+        "analysis.md": analysis_page(),
         "c_api.md": c_api_page(),
         "fortran.md": fortran_page(),
         "examples.md": examples_page(),
@@ -566,4 +668,10 @@ def generate(outdir: Path) -> None:
 
 
 if __name__ == "__main__":
-    generate(Path(sys.argv[1]) if len(sys.argv) > 1 else ROOT / "docs" / "api")
+    if len(sys.argv) > 1:
+        # scratch regeneration (tests/test_api_docs.py): the committed
+        # details.md is left alone
+        generate(Path(sys.argv[1]))
+    else:
+        generate(ROOT / "docs" / "api")
+        rewrite_knob_table(ROOT / "docs" / "details.md")

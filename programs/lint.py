@@ -1,634 +1,33 @@
-"""Project lint: import hygiene + env-knob/docs + stage-scope consistency.
+"""Project lint — thin shim over ``spfft_tpu.analysis`` checkers 1-9.
 
-No third-party linter exists in this environment, so the checks the advisor
-kept flagging are enforced here with the stdlib ast module:
+The nine ad-hoc AST checks that used to live here (635 lines: import
+hygiene, env-knob docs, stage scopes, fault sites, trace events, verify
+checks, perf stages, IR nodes) are now checkers SA001-SA009 of the
+pluggable static-analysis engine (``spfft_tpu/analysis/``), with the same
+vocabulary contracts enforced both ways. This shim keeps ``./ci.sh lint``
+and muscle memory working: it runs exactly the ported checkers through the
+same gate (baseline applied, ``# noqa: <CODE>`` suppression honored) and
+exits 3 on any new finding.
 
-1. duplicate imports — the same module/name imported more than once in one
-   file (the round-3/4 nit class in capi.py),
-2. unused imports — an imported name never referenced in the file
-   (``# noqa: F401`` on the import line exempts re-exports),
-3. env-knob consistency — every ``SPFFT_TPU_*`` knob read by the package
-   must be documented in docs/details.md, and every documented knob must
-   still exist in code (dead-doc detection),
-4. stage-scope consistency — every ``jax.named_scope`` label in an engine
-   pipeline comes from the canonical ``spfft_tpu.obs.STAGES`` list, and every
-   listed stage appears in at least one engine (same both-ways style as the
-   env-knob rule; keeps profiler traces attributable against one vocabulary),
-5. fault-site consistency — every ``faults.site(...)`` call in the package
-   names a site registered in the canonical ``spfft_tpu.faults.SITES``
-   vocabulary, every registered site is threaded through the package at
-   least once, and every site is documented in docs/details.md (the chaos
-   suite's arm-every-site sweep is only exhaustive if the vocabulary is),
-6. trace-event consistency — every ``trace.event/span/operation(...)`` call
-   in the package names an event registered in the canonical
-   ``spfft_tpu.obs.trace.EVENTS`` vocabulary, and every registered event is
-   emitted by at least one package call site (same both-ways rule; keeps
-   flight-recorder streams and their consumers on one vocabulary),
-7. verify-check consistency — the canonical ``spfft_tpu.verify.CHECKS``
-   vocabulary matches the ``CHECK_FNS`` implementation registry exactly
-   (every registered check implemented, every implementation registered)
-   and every check is documented in docs/details.md — the ABFT layer's
-   instance of the same both-ways contract,
-8. perf-stage consistency — the perf layer's ``MODELED_STAGES``
-   (``spfft_tpu/obs/perf.py``) matches the engine-pipeline subset of
-   ``obs.STAGES`` exactly both ways: every modeled stage is canonical and
-   appears in an engine pipeline, and every engine-pipeline stage carries a
-   flop/byte model — so perf reports can never emit or omit a stage the
-   engines disagree about (the tuning-only trial phases are exempt: they
-   are harness stages, not pipeline stages),
-9. IR-node consistency — the stage-graph IR's node vocabulary
-   (``spfft_tpu/ir/graph.py`` ``NODES``) matches ``obs.STAGES`` and
-   ``perf.MODELED_STAGES`` both ways: every IR node is a canonical,
-   perf-modeled stage, and every modeled engine stage is lowerable as an IR
-   node — an IR stage can never silently escape profiler attribution or
-   perf accounting (the same contract as SITES/EVENTS).
-
-Exit status is nonzero on any finding; ci.sh runs this as its lint stage.
+The full gate — including the deep checkers (typed errors, lock order,
+donation safety, jit purity, knob registry) — is ``programs/analyze.py`` /
+``./ci.sh analyze``.
 """
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-PACKAGE_DIRS = ("spfft_tpu",)
-LINT_DIRS = ("spfft_tpu", "programs", "tests")
-DOCS = ROOT / "docs" / "details.md"
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-# knobs that are deliberately undocumented in the user-facing table: test /
-# driver / measurement internals, documented where they are used
-INTERNAL_KNOBS = {
-    "SPFFT_TPU_DRYRUN_BUDGET_S",
-    "SPFFT_TPU_MEASURE_INIT_BUDGET_S",
-    "SPFFT_TPU_NATIVE_TEST_BUDGET_S",
-    "SPFFT_TPU_FUZZ_SEED",  # test-only: parity-fuzz seed offset (documented
-    # where it is read, tests/test_engine_parity_fuzz.py)
-}
+from analyze import main as analyze_main  # noqa: E402
 
 
-def iter_py_files():
-    for d in LINT_DIRS:
-        yield from sorted((ROOT / d).rglob("*.py"))
-
-
-def _import_forms(node):
-    """Canonical (form, bound-name) pairs for an import statement."""
-    out = []
-    if isinstance(node, ast.Import):
-        for a in node.names:
-            form = f"import {a.name}" + (f" as {a.asname}" if a.asname else "")
-            out.append((form, (a.asname or a.name).split(".")[0]))
-    elif isinstance(node, ast.ImportFrom):
-        if node.module == "__future__":
-            return []
-        mod = "." * node.level + (node.module or "")
-        for a in node.names:
-            if a.name == "*":
-                continue
-            form = f"from {mod} import {a.name}" + (
-                f" as {a.asname}" if a.asname else ""
-            )
-            out.append((form, a.asname or a.name))
-    return out
-
-
-def _walk_scope(body):
-    """Statements of one scope, not descending into nested function/class
-    bodies (lazy function-scope imports are a deliberate pattern here —
-    duplicates only count within a single scope)."""
-    for stmt in body:
-        yield stmt
-        if isinstance(
-            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-        ):
-            continue
-        for field in ("body", "orelse", "finalbody", "handlers"):
-            sub = getattr(stmt, field, None)
-            if not sub:
-                continue
-            for child in sub:
-                if isinstance(child, ast.ExceptHandler):
-                    yield from _walk_scope(child.body)
-                else:
-                    yield from _walk_scope([child])
-
-
-def check_imports(path: Path, findings: list):
-    src = path.read_text()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        findings.append(f"{path}: syntax error: {e}")
-        return
-    lines = src.splitlines()
-
-    def exempt(node):
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        return "noqa" in line
-
-    # ---- duplicates, per scope (class bodies count as their own scope) ----
-    scopes = [tree.body]
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            scopes.append(node.body)
-    for body in scopes:
-        seen = {}
-        for stmt in _walk_scope(body):
-            if not isinstance(stmt, (ast.Import, ast.ImportFrom)):
-                continue
-            for form, _name in _import_forms(stmt):
-                if form in seen and not exempt(stmt):
-                    findings.append(
-                        f"{path}:{stmt.lineno}: duplicate {form!r} "
-                        f"(first at line {seen[form]})"
-                    )
-                seen.setdefault(form, stmt.lineno)
-
-    # ---- unused, module scope only ----
-    bound = []
-    for stmt in _walk_scope(tree.body):
-        if isinstance(stmt, (ast.Import, ast.ImportFrom)) and not exempt(stmt):
-            bound.extend(
-                (name, stmt.lineno) for _form, name in _import_forms(stmt)
-            )
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Assign):
-            # __all__ strings count as uses (re-export surface)
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    for el in ast.walk(node.value):
-                        if isinstance(el, ast.Constant) and isinstance(
-                            el.value, str
-                        ):
-                            used.add(el.value)
-    for name, lineno in bound:
-        if name not in used and name != "_":
-            findings.append(f"{path}:{lineno}: unused import {name!r}")
-
-
-KNOB_RE = re.compile(r"SPFFT_TPU_[A-Z0-9_]+")
-
-
-def check_env_knobs(findings: list):
-    in_code = set()
-    for d in LINT_DIRS:
-        for path in sorted((ROOT / d).rglob("*.py")):
-            if "__pycache__" in path.parts:
-                continue
-            text = path.read_text()
-            if d in PACKAGE_DIRS:
-                # the package defines the knob surface: every SPFFT_TPU_*
-                # string in it is an env knob (indirected through *_ENV
-                # constants, so line-level environ matching misses them)
-                in_code |= set(KNOB_RE.findall(text))
-            else:
-                # programs/tests: only env READS count — SPFFT_TPU_* also
-                # names C macros (version.h) and CMake options there
-                for line in text.splitlines():
-                    if "environ" in line or "getenv" in line:
-                        in_code |= set(KNOB_RE.findall(line))
-    documented = set(KNOB_RE.findall(DOCS.read_text()))
-    for knob in sorted(in_code - documented - INTERNAL_KNOBS):
-        findings.append(
-            f"env knob {knob} is read by the package but not documented in "
-            f"{DOCS.relative_to(ROOT)}"
-        )
-    for knob in sorted(documented - in_code):
-        findings.append(
-            f"env knob {knob} is documented in {DOCS.relative_to(ROOT)} but "
-            "no longer read by the package"
-        )
-
-
-# The engine pipeline modules: every named_scope label inside them must come
-# from obs.STAGES, and every STAGES entry must appear in at least one of them.
-ENGINE_FILES = (
-    "spfft_tpu/execution.py",
-    "spfft_tpu/execution_mxu.py",
-    "spfft_tpu/parallel/execution.py",
-    "spfft_tpu/parallel/execution_mxu.py",
-    "spfft_tpu/parallel/pencil2.py",
-    "spfft_tpu/parallel/pencil2_mxu.py",
-)
-# The autotuner's trial runner labels its phases from the same canonical
-# vocabulary (the "tune warmup"/"tune trial" stages), under the same
-# both-ways rule as the engines.
-TUNING_FILES = ("spfft_tpu/tuning/runner.py",)
-STAGES_FILE = "spfft_tpu/obs/stages.py"
-
-
-def _canonical_stages() -> tuple:
-    """STAGES from obs/stages.py via ast (import-free: lint must not pull jax)."""
-    tree = ast.parse((ROOT / STAGES_FILE).read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "STAGES" for t in node.targets
-        ):
-            return tuple(ast.literal_eval(node.value))
-    raise AssertionError(f"no STAGES assignment in {STAGES_FILE}")
-
-
-def _pipeline_strings(tree) -> set:
-    """String constants of an engine/tuning file, EXCLUDING those inside the
-    ``stage_accounting`` perf hooks: the hooks restate every stage name for
-    the flop/byte model, so counting them would let the coverage directions
-    satisfy themselves — a stage deleted from every ``named_scope`` would
-    still look 'used' because its accounting row names it."""
-    skip: set = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and node.name == "stage_accounting"
-        ):
-            for sub in ast.walk(node):
-                skip.add(id(sub))
-    return {
-        node.value
-        for node in ast.walk(tree)
-        if isinstance(node, ast.Constant)
-        and isinstance(node.value, str)
-        and id(node) not in skip
-    }
-
-
-def check_stage_scopes(findings: list):
-    stages = _canonical_stages()
-    if len(set(stages)) != len(stages):
-        findings.append(f"{STAGES_FILE}: duplicate entries in STAGES")
-    used: dict = {}  # literal named_scope labels -> first file:line
-    strings: set = set()  # pipeline string constants in engine files (covers
-    # labels selected dynamically, e.g. _y_stage_scope's variants; the
-    # stage_accounting hooks are excluded — see _pipeline_strings)
-    for rel in ENGINE_FILES + TUNING_FILES:
-        path = ROOT / rel
-        tree = ast.parse(path.read_text())
-        strings |= _pipeline_strings(tree)
-        for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "named_scope"
-            ):
-                continue
-            if node.args and isinstance(node.args[0], ast.Constant):
-                label = node.args[0].value
-                used.setdefault(label, f"{rel}:{node.args[0].lineno}")
-    for label, where in sorted(used.items()):
-        if label not in stages:
-            findings.append(
-                f"{where}: named_scope {label!r} is not in the canonical "
-                f"stage list ({STAGES_FILE})"
-            )
-    for stage in stages:
-        if stage not in strings:
-            findings.append(
-                f"{STAGES_FILE}: stage {stage!r} appears in no engine or "
-                f"tuning pipeline ({', '.join(ENGINE_FILES + TUNING_FILES)})"
-            )
-
-
-# The fault-injection plane: every faults.site(...) call must name a site
-# registered in SITES (spfft_tpu/faults/plane.py), every registered site must
-# be threaded through the package, and every site must appear in the docs.
-FAULTS_PLANE_FILE = "spfft_tpu/faults/plane.py"
-
-
-def _canonical_sites() -> tuple:
-    """SITES from faults/plane.py via ast (import-free, like STAGES)."""
-    tree = ast.parse((ROOT / FAULTS_PLANE_FILE).read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "SITES" for t in node.targets
-        ):
-            return tuple(ast.literal_eval(node.value))
-    raise AssertionError(f"no SITES assignment in {FAULTS_PLANE_FILE}")
-
-
-def check_fault_sites(findings: list):
-    sites = _canonical_sites()
-    if len(set(sites)) != len(sites):
-        findings.append(f"{FAULTS_PLANE_FILE}: duplicate entries in SITES")
-    used: dict = {}  # site name -> first package file:line that arms it
-    for d in PACKAGE_DIRS:
-        for path in sorted((ROOT / d).rglob("*.py")):
-            if "__pycache__" in path.parts:
-                continue
-            rel = path.relative_to(ROOT)
-            if str(rel) == FAULTS_PLANE_FILE:
-                continue  # the registry itself is not a threading site
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if not (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "site"
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "faults"
-                ):
-                    continue
-                where = f"{rel}:{node.lineno}"
-                if not (node.args and isinstance(node.args[0], ast.Constant)):
-                    findings.append(
-                        f"{where}: faults.site(...) must take a literal site "
-                        "name (lint cannot check dynamic names)"
-                    )
-                    continue
-                name = node.args[0].value
-                if name not in sites:
-                    findings.append(
-                        f"{where}: fault site {name!r} is not registered in "
-                        f"the canonical vocabulary ({FAULTS_PLANE_FILE})"
-                    )
-                used.setdefault(name, where)
-    for name in sites:
-        if name not in used:
-            findings.append(
-                f"{FAULTS_PLANE_FILE}: site {name!r} is registered but "
-                "threaded through no package code path"
-            )
-    docs_text = DOCS.read_text()
-    for name in sites:
-        if name not in docs_text:
-            findings.append(
-                f"fault site {name!r} is not documented in "
-                f"{DOCS.relative_to(ROOT)}"
-            )
-
-
-# The execution-trace event vocabulary (spfft_tpu/obs/trace.py EVENTS): every
-# trace.event/span/operation call in the package must name a registered
-# event, and every registered event must be emitted by at least one package
-# call site — the same both-ways contract as STAGES and SITES.
-TRACE_FILE = "spfft_tpu/obs/trace.py"
-TRACE_EMITTERS = ("event", "span", "operation")
-
-
-def _canonical_events() -> tuple:
-    """EVENTS from obs/trace.py via ast (import-free, like STAGES/SITES)."""
-    tree = ast.parse((ROOT / TRACE_FILE).read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "EVENTS" for t in node.targets
-        ):
-            return tuple(ast.literal_eval(node.value))
-    raise AssertionError(f"no EVENTS assignment in {TRACE_FILE}")
-
-
-def _is_trace_receiver(value) -> bool:
-    """Whether a call receiver is the trace module (``trace.x`` after a
-    ``from .obs import trace``, or a dotted ``obs.trace.x``)."""
-    if isinstance(value, ast.Name):
-        return value.id == "trace"
-    return isinstance(value, ast.Attribute) and value.attr == "trace"
-
-
-def check_trace_events(findings: list):
-    events = _canonical_events()
-    if len(set(events)) != len(events):
-        findings.append(f"{TRACE_FILE}: duplicate entries in EVENTS")
-    used: dict = {}  # event name -> first package file:line that emits it
-    for d in PACKAGE_DIRS:
-        for path in sorted((ROOT / d).rglob("*.py")):
-            if "__pycache__" in path.parts:
-                continue
-            rel = path.relative_to(ROOT)
-            if str(rel) == TRACE_FILE:
-                continue  # the recorder itself is not an emission site
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if not (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in TRACE_EMITTERS
-                    and _is_trace_receiver(node.func.value)
-                ):
-                    continue
-                where = f"{rel}:{node.lineno}"
-                if not (node.args and isinstance(node.args[0], ast.Constant)):
-                    findings.append(
-                        f"{where}: trace.{node.func.attr}(...) must take a "
-                        "literal event name (lint cannot check dynamic names)"
-                    )
-                    continue
-                name = node.args[0].value
-                if name not in events:
-                    findings.append(
-                        f"{where}: trace event {name!r} is not registered in "
-                        f"the canonical vocabulary ({TRACE_FILE})"
-                    )
-                used.setdefault(name, where)
-    for name in events:
-        if name not in used:
-            findings.append(
-                f"{TRACE_FILE}: event {name!r} is registered but emitted by "
-                "no package code path"
-            )
-
-
-# The ABFT check vocabulary (spfft_tpu/verify/checks.py CHECKS): the tuple
-# and the CHECK_FNS implementation registry must agree exactly, and every
-# check must be documented — the verify layer's both-ways contract.
-VERIFY_CHECKS_FILE = "spfft_tpu/verify/checks.py"
-
-
-def _canonical_checks() -> tuple:
-    """CHECKS and CHECK_FNS keys from verify/checks.py via ast (import-free,
-    like STAGES/SITES/EVENTS)."""
-    tree = ast.parse((ROOT / VERIFY_CHECKS_FILE).read_text())
-    checks = fns = None
-    for node in tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        for t in node.targets:
-            if isinstance(t, ast.Name) and t.id == "CHECKS":
-                checks = tuple(ast.literal_eval(node.value))
-            if isinstance(t, ast.Name) and t.id == "CHECK_FNS":
-                if not isinstance(node.value, ast.Dict):
-                    raise AssertionError(
-                        f"CHECK_FNS in {VERIFY_CHECKS_FILE} must be a dict literal"
-                    )
-                fns = tuple(
-                    k.value
-                    for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                )
-    if checks is None or fns is None:
-        raise AssertionError(
-            f"no CHECKS/CHECK_FNS assignments in {VERIFY_CHECKS_FILE}"
-        )
-    return checks, fns
-
-
-def check_verify_checks(findings: list):
-    checks, fns = _canonical_checks()
-    if len(set(checks)) != len(checks):
-        findings.append(f"{VERIFY_CHECKS_FILE}: duplicate entries in CHECKS")
-    for name in checks:
-        if name not in fns:
-            findings.append(
-                f"{VERIFY_CHECKS_FILE}: check {name!r} is registered in CHECKS "
-                "but has no CHECK_FNS implementation"
-            )
-    for name in fns:
-        if name not in checks:
-            findings.append(
-                f"{VERIFY_CHECKS_FILE}: CHECK_FNS implements {name!r} but it "
-                "is not registered in CHECKS"
-            )
-    docs_text = DOCS.read_text()
-    for name in checks:
-        if name not in docs_text:
-            findings.append(
-                f"verify check {name!r} is not documented in "
-                f"{DOCS.relative_to(ROOT)}"
-            )
-
-
-# The perf layer's modeled-stage vocabulary (spfft_tpu/obs/perf.py
-# MODELED_STAGES): must equal the engine-pipeline subset of STAGES exactly —
-# both ways, like every other vocabulary here. Tuning-only stages (threaded
-# through TUNING_FILES, never an engine pipeline) are exempt.
-PERF_FILE = "spfft_tpu/obs/perf.py"
-
-
-def _canonical_modeled_stages() -> tuple:
-    """MODELED_STAGES from obs/perf.py via ast (import-free, like STAGES)."""
-    tree = ast.parse((ROOT / PERF_FILE).read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "MODELED_STAGES"
-            for t in node.targets
-        ):
-            return tuple(ast.literal_eval(node.value))
-    raise AssertionError(f"no MODELED_STAGES assignment in {PERF_FILE}")
-
-
-def check_perf_stages(findings: list):
-    stages = _canonical_stages()
-    modeled = _canonical_modeled_stages()
-    if len(set(modeled)) != len(modeled):
-        findings.append(f"{PERF_FILE}: duplicate entries in MODELED_STAGES")
-    engine_strings: set = set()
-    for rel in ENGINE_FILES:
-        # accounting hooks excluded (_pipeline_strings): membership here must
-        # mean "the compiled pipeline tags this stage", not "the perf model
-        # mentions it" — otherwise this check could never catch drift
-        engine_strings |= _pipeline_strings(ast.parse((ROOT / rel).read_text()))
-    engine_stages = [s for s in stages if s in engine_strings]
-    for name in modeled:
-        if name not in stages:
-            findings.append(
-                f"{PERF_FILE}: modeled stage {name!r} is not in the canonical "
-                f"stage list ({STAGES_FILE})"
-            )
-        elif name not in engine_stages:
-            findings.append(
-                f"{PERF_FILE}: modeled stage {name!r} appears in no engine "
-                f"pipeline ({', '.join(ENGINE_FILES)})"
-            )
-    for name in engine_stages:
-        if name not in modeled:
-            findings.append(
-                f"{STAGES_FILE}: engine stage {name!r} carries no flop/byte "
-                f"model in {PERF_FILE} (MODELED_STAGES)"
-            )
-
-
-# The stage-graph IR's node vocabulary (spfft_tpu/ir/graph.py NODES): must
-# match obs.STAGES membership and perf.MODELED_STAGES exactly both ways —
-# the IR is the layer engines execute through, so a node outside the
-# canonical/modeled vocabularies would be a stage traces and perf reports
-# cannot account for, and a modeled stage missing from NODES would be a
-# pipeline stage the IR cannot express.
-IR_GRAPH_FILE = "spfft_tpu/ir/graph.py"
-
-
-def _canonical_ir_nodes() -> tuple:
-    """NODES from ir/graph.py via ast (import-free, like STAGES)."""
-    return _literal_tuple(IR_GRAPH_FILE, "NODES")
-
-
-def check_ir_nodes(findings: list):
-    stages = _canonical_stages()
-    modeled = _canonical_modeled_stages()
-    nodes = _canonical_ir_nodes()
-    if len(set(nodes)) != len(nodes):
-        findings.append(f"{IR_GRAPH_FILE}: duplicate entries in NODES")
-    for name in nodes:
-        if name not in stages:
-            findings.append(
-                f"{IR_GRAPH_FILE}: IR node {name!r} is not in the canonical "
-                f"stage list ({STAGES_FILE})"
-            )
-        if name not in modeled:
-            findings.append(
-                f"{IR_GRAPH_FILE}: IR node {name!r} carries no flop/byte "
-                f"model in {PERF_FILE} (MODELED_STAGES)"
-            )
-    for name in modeled:
-        if name not in nodes:
-            findings.append(
-                f"{PERF_FILE}: modeled stage {name!r} is not an IR node "
-                f"({IR_GRAPH_FILE} NODES) — the stage graph cannot express it"
-            )
-
-
-# The plan-card ``ir`` section schema (obs/plancard.py IR_SECTION_KEYS) is a
-# deliberate mirror of the source-of-truth literal in ir/compile.py IR_KEYS
-# (plancard stays import-free): the two tuples must be identical, or cards
-# missing a newly added key would silently pass schema validation.
-IR_COMPILE_FILE = "spfft_tpu/ir/compile.py"
-PLANCARD_FILE = "spfft_tpu/obs/plancard.py"
-
-
-def _literal_tuple(relpath: str, name: str) -> tuple:
-    """A module-level tuple literal via ast (import-free, like STAGES)."""
-    tree = ast.parse((ROOT / relpath).read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == name for t in node.targets
-        ):
-            return tuple(ast.literal_eval(node.value))
-    raise AssertionError(f"no {name} assignment in {relpath}")
-
-
-def check_ir_card_keys(findings: list):
-    ir_keys = _literal_tuple(IR_COMPILE_FILE, "IR_KEYS")
-    card_keys = _literal_tuple(PLANCARD_FILE, "IR_SECTION_KEYS")
-    if ir_keys != card_keys:
-        findings.append(
-            f"{PLANCARD_FILE}: IR_SECTION_KEYS {card_keys!r} does not match "
-            f"{IR_COMPILE_FILE} IR_KEYS {ir_keys!r} — the card validator "
-            f"would accept cards missing (or carrying stale) ir keys"
-        )
-
-
-def main() -> int:
-    findings: list = []
-    for path in iter_py_files():
-        if "__pycache__" in path.parts:
-            continue
-        check_imports(path, findings)
-    check_env_knobs(findings)
-    check_stage_scopes(findings)
-    check_fault_sites(findings)
-    check_trace_events(findings)
-    check_verify_checks(findings)
-    check_perf_stages(findings)
-    check_ir_nodes(findings)
-    check_ir_card_keys(findings)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"\n{len(findings)} lint finding(s)", file=sys.stderr)
-        return 1
-    print("lint clean")
-    return 0
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    for code in (f"SA00{i}" for i in range(1, 10)):
+        argv += ["--only", code]
+    return analyze_main(argv)
 
 
 if __name__ == "__main__":

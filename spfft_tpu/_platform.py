@@ -117,8 +117,20 @@ def hang_watchdog(
     import sys
     import threading
 
+    from . import knobs
+
     if budget_s is None:
-        budget_s = float(os.environ.get(budget_env, default_s))
+        if budget_env in knobs.REGISTRY:
+            # env set -> registry-typed parse; unset -> the caller's default
+            # (driver budgets are registered internal knobs)
+            budget_s = (
+                knobs.get_float(budget_env) if knobs.raw(budget_env)
+                else float(default_s)
+            )
+        else:
+            # foreign budget names (tests arm watchdogs under ad-hoc env
+            # names outside the SPFFT_TPU_* surface): raw ambient parse
+            budget_s = float(os.environ.get(budget_env) or default_s)  # noqa: SA014
     disarmed = threading.Event()
 
     def _watch():

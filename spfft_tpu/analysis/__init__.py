@@ -1,0 +1,48 @@
+"""``spfft_tpu.analysis`` — the pluggable static-analysis engine.
+
+Fourteen AST-based checkers over the repository, on one framework
+(:mod:`.core`): a registry with a stable code/severity/doc per checker,
+``Finding`` records with ``file:line``, ``# noqa: <CODE>`` suppression, a
+committed baseline (``analysis_baseline.json``) that lets accepted
+pre-existing findings pass while NEW findings fail CI with exit 3, and the
+``spfft_tpu.analysis/1`` JSON report schema.
+
+Checkers 1–9 (SA001–SA009) are the nine lint checks ported from the old
+monolithic ``programs/lint.py`` (which remains as a thin shim over them);
+10–14 are the deep production-invariant checkers: typed-error discipline,
+lock-order analysis, donation safety, jit purity, and the knob-registry
+read path.
+
+Import discipline: this package is loadable WITHOUT importing ``spfft_tpu``
+itself (which pulls ``jax``) — ``programs/analyze.py`` loads it standalone
+via ``importlib`` (see ``load_analysis`` there). Keep every module here
+stdlib-only; sibling knowledge (vocabulary tuples, the knob registry) is
+read via ``ast``, never imported.
+"""
+from .core import (  # noqa: F401
+    BASELINE_SCHEMA,
+    CHECKERS,
+    SCHEMA,
+    AnalysisError,
+    Checker,
+    Finding,
+    Tree,
+    apply_baseline,
+    baseline_doc,
+    load_baseline,
+    report_doc,
+    run,
+    validate_report,
+)
+
+# Importing a checker module registers its checkers; the order here is the
+# catalog order (SA001..SA014).
+from . import hygiene  # noqa: F401  checkers 1-2: import hygiene
+from . import vocab  # noqa: F401  checkers 3-9: both-ways vocabularies
+from . import typed_errors  # noqa: F401  checker 10: typed-error discipline
+from . import locks  # noqa: F401  checker 11: lock-order analysis
+from . import donation  # noqa: F401  checker 12: donation safety
+from . import purity  # noqa: F401  checker 13: jit purity
+from . import knobreads  # noqa: F401  checker 14: knob-registry read path
+
+PORTED_LINT_CODES = tuple(f"SA00{i}" for i in range(1, 10))

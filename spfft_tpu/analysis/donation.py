@@ -1,0 +1,307 @@
+"""Checker 12: donation safety (SA012).
+
+The fused IR path donates the packed value buffers of the consuming local
+backward (``ir/compile.py``: ``jax.jit(fn, donate_argnums=spec["donate"])``)
+— XLA may overwrite a donated buffer the moment its consuming node runs, so
+a lowered graph that references a donated input edge *after* that node (or
+leaks it as a graph output) computes with freed memory. Two rules:
+
+* **No use after donate.** In every local-builder backward graph of
+  ``ir/lower.py``, each donatable input edge (the positions local
+  ``_ir_spec`` methods declare in their ``"donate"`` tuples) is consumed by
+  at most one node and never escapes via ``set_outputs``.
+* **The card tells the truth.** The plan card's donation map
+  (``EngineIr.describe``) must derive from the same spec key the fusion
+  pass actually passes to ``donate_argnums`` (``build_fused``) — a card
+  claiming donation that the jit does not apply (or vice versa) makes the
+  provenance section silently wrong.
+
+Graphs are reconstructed statically from the literal ``add_input``/``add``/
+``set_outputs`` calls (string-constant propagation over simple local
+assignments like ``cur = "sticks"``); nodes whose edge tuples are not
+statically resolvable are skipped — conservative, like the lock analysis.
+Donation only applies to ``kind == "local"`` specs on the backward
+direction (``build_fused``), so only ``_lower_local_*`` builders are held
+to the use-after-donate rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE_DIRS, Tree, checker, missing_anchor
+
+IR_LOWER_FILE = "spfft_tpu/ir/lower.py"
+IR_COMPILE_FILE = "spfft_tpu/ir/compile.py"
+
+LOCAL_BUILDER_PREFIX = "_lower_local"
+
+
+def donated_positions(tree: Tree) -> set:
+    """Input positions any ``kind == "local"`` ``_ir_spec`` declares
+    donatable (the union of the literal ``"donate"`` tuples)."""
+    out: set = set()
+    for rel in tree.py_files(PACKAGE_DIRS):
+        try:
+            mod = tree.parse(rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(mod):
+            if not (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "_ir_spec"
+            ):
+                continue
+            for ret in ast.walk(node):
+                if not (
+                    isinstance(ret, ast.Return)
+                    and isinstance(ret.value, ast.Dict)
+                ):
+                    continue
+                keys = {
+                    k.value: v
+                    for k, v in zip(ret.value.keys, ret.value.values)
+                    if isinstance(k, ast.Constant)
+                }
+                kind = keys.get("kind")
+                if not (
+                    isinstance(kind, ast.Constant) and kind.value == "local"
+                ):
+                    continue
+                donate = keys.get("donate")
+                if isinstance(donate, (ast.Tuple, ast.List)):
+                    for el in donate.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int
+                        ):
+                            out.add(el.value)
+    return out
+
+
+def _string_values(expr, consts: dict) -> set:
+    """Possible string values of a tuple/list element: a literal, or every
+    literal ever assigned to that local name (``cur = "sticks"``)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return {expr.value}
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id, set())
+    return set()
+
+
+class _Graph:
+    """One statically reconstructed StageGraph build."""
+
+    def __init__(self, direction, lineno):
+        self.direction = direction
+        self.lineno = lineno
+        self.inputs: list = []          # ordered add_input names
+        self.consumers: list = []       # (possible input-edge names, lineno)
+        self.outputs: set = set()
+
+
+def _reconstruct(fn_node) -> list:
+    """Graphs built inside one function body (nested defs included)."""
+    graphs: dict = {}  # var name -> _Graph (latest binding wins)
+    consts: dict = {}  # local str-constant propagation
+    out: list = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id == "StageGraph"
+                and v.args
+                and isinstance(v.args[0], ast.Constant)
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        g = _Graph(v.args[0].value, node.lineno)
+                        graphs[t.id] = g
+                        out.append(g)
+            elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        consts.setdefault(t.id, set()).add(v.value)
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in graphs
+        ):
+            continue
+        g = graphs[node.func.value.id]
+        meth = node.func.attr
+        if meth == "add_input" and node.args and isinstance(
+            node.args[0], ast.Constant
+        ):
+            g.inputs.append(node.args[0].value)
+        elif meth == "add" and len(node.args) >= 3:
+            ins = node.args[2]
+            if isinstance(ins, (ast.Tuple, ast.List)):
+                possible: set = set()
+                for el in ins.elts:
+                    possible |= _string_values(el, consts)
+                g.consumers.append((possible, node.lineno))
+            # non-literal edge tuples: skipped (conservative)
+        elif meth == "set_outputs" and node.args and isinstance(
+            node.args[0], (ast.Tuple, ast.List)
+        ):
+            for el in node.args[0].elts:
+                g.outputs |= _string_values(el, consts)
+    return out
+
+
+def _spec_keys(scope, receiver_names=("spec",)) -> set:
+    """String keys read off a spec receiver (``spec["k"]`` /
+    ``spec.get("k")`` / ``self.spec[...]``) anywhere under ``scope``."""
+
+    def is_spec(expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in receiver_names
+        return isinstance(expr, ast.Attribute) and expr.attr in receiver_names
+    keys: set = set()
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.Subscript)
+            and is_spec(node.value)
+            and isinstance(node.slice, ast.Constant)
+        ):
+            keys.add(node.slice.value)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and is_spec(node.func.value)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            keys.add(node.args[0].value)
+    return keys
+
+
+@checker(
+    "donation-safety",
+    code="SA012",
+    doc="In the lowered local backward graphs (ir/lower.py), every "
+    "donatable input edge (the positions local _ir_spec methods declare "
+    "under \"donate\") is consumed by at most one node and never escapes "
+    "via set_outputs — XLA may overwrite a donated buffer at its consuming "
+    "node, so any later reference computes with freed memory. In "
+    "ir/compile.py, the plan card's donation map (EngineIr.describe) must "
+    "derive from the same spec key build_fused passes to donate_argnums. "
+    "Graphs are reconstructed from literal add_input/add/set_outputs calls; "
+    "non-literal nodes are skipped (conservative).",
+)
+def check_donation_safety(tree: Tree):
+    findings = []
+    for anchor in (IR_LOWER_FILE, IR_COMPILE_FILE):
+        skip, f = missing_anchor(check_donation_safety, tree, anchor)
+        if skip:
+            return findings + f
+        findings += f
+    positions = donated_positions(tree)
+
+    # ---- rule 1: no use after donate in local backward graphs ---------------
+    lower_mod = tree.parse(IR_LOWER_FILE)
+    for builder in lower_mod.body:
+        if not (
+            isinstance(builder, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and builder.name.startswith(LOCAL_BUILDER_PREFIX)
+        ):
+            continue
+        for g in _reconstruct(builder):
+            if g.direction != "backward":
+                continue
+            for i in sorted(positions):
+                if i >= len(g.inputs):
+                    continue
+                edge = g.inputs[i]
+                uses = [
+                    (possible, lineno)
+                    for possible, lineno in g.consumers
+                    if edge in possible
+                ]
+                for _possible, lineno in uses[1:]:
+                    findings.append(
+                        check_donation_safety.finding(
+                            IR_LOWER_FILE, lineno,
+                            f"donated input edge {edge!r} (donate position "
+                            f"{i}) referenced after its consuming node in a "
+                            f"{builder.name} backward graph — the fused "
+                            "consuming jit may have freed it",
+                        )
+                    )
+                if edge in g.outputs:
+                    findings.append(
+                        check_donation_safety.finding(
+                            IR_LOWER_FILE, g.lineno,
+                            f"donated input edge {edge!r} escapes as a graph "
+                            f"output of a {builder.name} backward graph",
+                        )
+                    )
+
+    # ---- rule 2: donate_argnums and the card's donation map agree -----------
+    compile_mod = tree.parse(IR_COMPILE_FILE)
+    build_keys: set = set()
+    applied = False
+    describe_keys: set = set()
+    for node in ast.walk(compile_mod):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name == "build_fused":
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                for kw in call.keywords:
+                    if kw.arg != "donate_argnums":
+                        continue
+                    applied = True
+                    # keys feeding the donate expression: the names it
+                    # references, resolved through their assignments
+                    names = {
+                        n.id for n in ast.walk(kw.value)
+                        if isinstance(n, ast.Name)
+                    }
+                    for stmt in ast.walk(node):
+                        if isinstance(stmt, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id in names
+                            for t in stmt.targets
+                        ):
+                            build_keys |= _spec_keys(stmt.value)
+                    build_keys |= _spec_keys(kw.value)
+        elif node.name == "describe":
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id in ("donated", "donation")
+                    for t in stmt.targets
+                ):
+                    describe_keys |= _spec_keys(stmt.value)
+    if positions and not applied:
+        findings.append(
+            check_donation_safety.finding(
+                IR_COMPILE_FILE, 0,
+                "local _ir_spec declares donatable inputs but no jit in "
+                f"{IR_COMPILE_FILE} passes donate_argnums — the declared "
+                "donation is never applied",
+            )
+        )
+    if applied and not describe_keys:
+        findings.append(
+            check_donation_safety.finding(
+                IR_COMPILE_FILE, 0,
+                "build_fused donates buffers but EngineIr.describe derives "
+                "no donation map from the spec — the plan card cannot "
+                "report what was donated",
+            )
+        )
+    if build_keys and describe_keys and build_keys != describe_keys:
+        findings.append(
+            check_donation_safety.finding(
+                IR_COMPILE_FILE, 0,
+                f"the card's donation map reads spec key(s) "
+                f"{sorted(describe_keys)} but build_fused donates from "
+                f"{sorted(build_keys)} — the provenance section would lie "
+                "about the applied donate_argnums",
+            )
+        )
+    return findings

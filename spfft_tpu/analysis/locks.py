@@ -1,0 +1,542 @@
+"""Checker 11: lock-order analysis (SA011).
+
+The package holds ~a dozen ``threading.Lock/RLock/Condition`` objects across
+serve, sched, verify, tuning, obs and faults — and the first multi-host
+scheduling work (ROADMAP item 2) is exactly the kind that deadlocks where
+lock discipline is informal. This checker builds the *static acquisition
+graph* over the whole package and enforces two rules:
+
+* **No cycles.** An edge ``A -> B`` is recorded wherever code that holds
+  ``A`` may acquire ``B`` — directly (a nested ``with``), through a call to
+  a function whose (transitively computed) lock effects include ``B``, or
+  through a typed-error construction (``GenericError.__init__`` emits a
+  flight-recorder event, i.e. takes the trace lock). A cycle in the graph
+  is a potential deadlock; a self-edge on a non-reentrant lock is a
+  guaranteed one.
+* **Nothing slow under a lock.** A lock held across ``time.sleep``, a
+  ``.join()``/``.result()``/foreign ``.wait()``, or a ``jax``/``jnp`` call
+  (dispatch/compile can take seconds) serializes every other path through
+  that lock behind an unbounded wait. ``Condition.wait`` on the *held*
+  condition is exempt — it releases while waiting.
+
+Resolution is intentionally conservative and name-based (documented
+limitations): module-level locks, ``self.<attr>`` locks assigned in the
+defining file, and local variables bound to a fresh ``threading.Lock()``
+are tracked; dynamically stored locks (dict-held latches) and locks
+reached through unresolvable receivers are not. Same-package calls resolve
+through one level of ``__init__`` re-exports.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE_DIRS, Tree, checker
+
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+REENTRANT = ("rlock",)
+
+
+def _ctor_kind(node):
+    """'lock'/'rlock'/'condition' when ``node`` is (or contains) a
+    ``threading.X()`` constructor call."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = None
+            if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                if fn.value.id == "threading":
+                    name = fn.attr
+            elif isinstance(fn, ast.Name):
+                name = fn.id
+            if name in LOCK_CTORS:
+                return LOCK_CTORS[name]
+    return None
+
+
+def _root_name(node):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _module_rel(tree, parts):
+    """Module path parts -> existing file relpath (module or package)."""
+    rel = "/".join(parts) + ".py"
+    if tree.exists(rel):
+        return rel
+    rel = "/".join(parts) + "/__init__.py"
+    if tree.exists(rel):
+        return rel
+    return None
+
+
+class _Module:
+    """Per-file facts: locks, imports, functions, classes."""
+
+    def __init__(self, rel, node):
+        self.rel = rel
+        self.node = node
+        self.module_locks: dict = {}   # name -> (lock_id, kind)
+        self.attr_locks: dict = {}     # attr -> (lock_id, kind)  (self.X)
+        self.mod_alias: dict = {}      # alias -> module rel
+        self.obj_alias: dict = {}      # alias -> (module rel, attr)
+        self.functions: dict = {}      # qual -> ast node ("f" / "C.m")
+        self.classes: dict = {}        # class name -> ClassDef
+        self.instance_of: dict = {}    # module-global name -> [class names]
+
+
+class LockIndex:
+    """Whole-package lock/function/import index + transitive lock effects."""
+
+    def __init__(self, tree: Tree):
+        self.tree = tree
+        self.modules: dict = {}
+        for rel in tree.py_files(PACKAGE_DIRS):
+            try:
+                node = tree.parse(rel)
+            except SyntaxError:
+                continue
+            self.modules[rel] = self._scan(rel, node)
+        self._effects: dict = {}  # (rel, qual) -> frozenset(lock ids)
+        self._busy: set = set()
+
+    # ---- per-file scan -------------------------------------------------------
+
+    def _scan(self, rel, node):
+        m = _Module(rel, node)
+        pkg_parts = rel.split("/")[:-1]
+        if rel.endswith("/__init__.py"):
+            own_parts = rel.split("/")[:-1]
+        else:
+            own_parts = rel.split("/")[:-1]
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _ctor_kind(stmt.value)
+                classes = [
+                    s.func.id
+                    for s in ast.walk(stmt.value)
+                    if isinstance(s, ast.Call) and isinstance(s.func, ast.Name)
+                ]
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        if kind:
+                            m.module_locks[t.id] = (f"{rel}::{t.id}", kind)
+                        if classes:
+                            m.instance_of[t.id] = classes
+        # imports anywhere in the file (the lazy function-scope import is a
+        # deliberate pattern here; alias collisions across scopes are rare
+        # enough that a flat map stays honest)
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.ImportFrom):
+                continue
+            base = own_parts[: len(own_parts) - (stmt.level - 1)] if (
+                stmt.level
+            ) else []
+            if stmt.level and stmt.module:
+                base = base + stmt.module.split(".")
+            elif not stmt.level and stmt.module:
+                base = stmt.module.split(".")
+            if base[:1] and base[0] != pkg_parts[0] and stmt.level == 0:
+                continue  # external import
+            for a in stmt.names:
+                if a.name == "*":
+                    continue
+                alias = a.asname or a.name
+                sub = _module_rel(self.tree, base + [a.name])
+                if sub:
+                    m.mod_alias.setdefault(alias, sub)
+                else:
+                    mod = _module_rel(self.tree, base)
+                    if mod:
+                        m.obj_alias.setdefault(alias, (mod, a.name))
+        for cls in [s for s in node.body if isinstance(s, ast.ClassDef)]:
+            m.classes[cls.name] = cls
+            for sub in ast.walk(cls):
+                if isinstance(sub, ast.Assign):
+                    kind = _ctor_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            m.attr_locks[t.attr] = (
+                                f"{rel}::{cls.name}.{t.attr}", kind,
+                            )
+            for meth in cls.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    m.functions[f"{cls.name}.{meth.name}"] = meth
+        for fn in node.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m.functions[fn.name] = fn
+        return m
+
+    # ---- name resolution -----------------------------------------------------
+
+    def resolve_export(self, rel, attr, depth=0):
+        """(rel, qual) of ``attr`` looked up in module ``rel``, chasing
+        one-level ``__init__`` re-exports and submodules."""
+        if depth > 3 or rel not in self.modules:
+            return None
+        m = self.modules[rel]
+        for qual in (attr, ):
+            if qual in m.functions:
+                return (rel, qual)
+        if attr in m.classes:
+            # constructor effects: the class's own __init__, else the
+            # nearest same-file base's (errors.py's taxonomy pattern)
+            seen = set()
+            name = attr
+            while name in m.classes and name not in seen:
+                seen.add(name)
+                if f"{name}.__init__" in m.functions:
+                    return (rel, f"{name}.__init__")
+                bases = [
+                    b.id for b in m.classes[name].bases
+                    if isinstance(b, ast.Name)
+                ]
+                name = bases[0] if bases else ""
+            return None
+        if attr in m.mod_alias:
+            return ("__module__", m.mod_alias[attr])
+        if attr in m.obj_alias:
+            mod, a = m.obj_alias[attr]
+            return self.resolve_export(mod, a, depth + 1)
+        sub = _module_rel(self.tree, rel.rsplit("/", 1)[0].split("/") + [attr]) \
+            if rel.endswith("/__init__.py") else None
+        if sub:
+            return ("__module__", sub)
+        return None
+
+    def resolve_call(self, m: _Module, class_name, call):
+        """(rel, qual) of a call's callee, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in m.functions:
+                return (m.rel, fn.id)
+            if class_name and f"{class_name}.{fn.id}" in m.functions:
+                return (m.rel, f"{class_name}.{fn.id}")
+            if fn.id in m.obj_alias:
+                mod, attr = m.obj_alias[fn.id]
+                got = self.resolve_export(mod, attr)
+                return got if got and got[0] != "__module__" else None
+            if fn.id in m.classes:
+                return self.resolve_export(m.rel, fn.id)
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                if class_name and f"{class_name}.{fn.attr}" in m.functions:
+                    return (m.rel, f"{class_name}.{fn.attr}")
+                for qual in m.functions:
+                    if qual.endswith(f".{fn.attr}"):
+                        return (m.rel, qual)
+                return None
+            if recv.id in m.mod_alias:
+                got = self.resolve_export(m.mod_alias[recv.id], fn.attr)
+                return got if got and got[0] != "__module__" else None
+            if recv.id in m.instance_of:
+                for cls in m.instance_of[recv.id]:
+                    if f"{cls}.{fn.attr}" in m.functions:
+                        return (m.rel, f"{cls}.{fn.attr}")
+            return None
+        if isinstance(recv, ast.Attribute):
+            # dotted module receiver, e.g. obs.trace.event
+            root = _root_name(recv)
+            if root and root in m.mod_alias:
+                got = self.resolve_export(m.mod_alias[root], recv.attr)
+                if got and got[0] == "__module__":
+                    got = self.resolve_export(got[1], fn.attr)
+                    return got if got and got[0] != "__module__" else None
+        return None
+
+    def resolve_lock(self, m: _Module, class_name, local_locks, expr):
+        """(lock_id, kind) of a with-item/receiver expression, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in local_locks:
+                return local_locks[expr.id]
+            return m.module_locks.get(expr.id)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return m.attr_locks.get(expr.attr)
+        return None
+
+    # ---- transitive lock effects --------------------------------------------
+
+    def effects(self, key) -> frozenset:
+        """Locks ``key = (rel, qual)`` may acquire, transitively."""
+        if key in self._effects:
+            return self._effects[key]
+        if key in self._busy:
+            return frozenset()  # recursion cycle: partial is fine (fixpoint)
+        rel, qual = key
+        m = self.modules.get(rel)
+        if m is None or qual not in m.functions:
+            self._effects[key] = frozenset()
+            return self._effects[key]
+        self._busy.add(key)
+        class_name = qual.split(".")[0] if "." in qual else None
+        fn_node = m.functions[qual]
+        local_locks = self._local_locks(m.rel, qual, fn_node)
+        out = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    got = self.resolve_lock(
+                        m, class_name, local_locks, item.context_expr
+                    )
+                    if got:
+                        out.add(got[0])
+            elif isinstance(node, ast.Call):
+                callee = self.resolve_call(m, class_name, node)
+                if callee:
+                    out |= self.effects(callee)
+        self._busy.discard(key)
+        self._effects[key] = frozenset(out)
+        return self._effects[key]
+
+    @staticmethod
+    def _local_locks(rel, qual, fn_node) -> dict:
+        out = {}
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign):
+                kind = _ctor_kind(node.value)
+                if not kind:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = (f"{rel}::{qual}.{t.id}", kind)
+        return out
+
+
+BLOCKING_RECEIVER_ATTRS = ("join", "result")
+
+
+def _blocking_desc(index, m, class_name, local_locks, held, call):
+    """A human description when ``call`` blocks while locks are held."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        root = _root_name(fn)
+        if fn.attr == "sleep" and root == "time":
+            return "time.sleep(...)"
+        if root in ("jax", "jnp"):
+            return f"a {root}.* call (dispatch/compile)"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready()"
+        if fn.attr in BLOCKING_RECEIVER_ATTRS:
+            return f".{fn.attr}()"
+        if fn.attr == "wait":
+            got = index.resolve_lock(m, class_name, local_locks, fn.value)
+            if got and got[0] in held:
+                return None  # Condition.wait on the held lock releases it
+            return ".wait()"
+    elif isinstance(fn, ast.Name) and fn.id == "fence":
+        return "fence() (a completion wait)"
+    return None
+
+
+def _stmt_lists(stmt):
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if sub:
+            yield sub
+    for h in getattr(stmt, "handlers", []) or []:
+        yield h.body
+
+
+def _calls_here(stmt):
+    """Calls in a statement, not descending into nested defs/lambdas or
+    nested statement bodies (those are walked by the caller)."""
+    skip: set = set()
+    for sub_list in _stmt_lists(stmt):
+        for s in sub_list:
+            for n in ast.walk(s):
+                skip.add(id(n))
+    for node in ast.walk(stmt):
+        if id(node) in skip:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            for n in ast.walk(node):
+                skip.add(id(n))
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+
+
+@checker(
+    "lock-order",
+    code="SA011",
+    doc="Builds the static lock-acquisition graph over every "
+    "threading.Lock/RLock/Condition in the package (nested `with` blocks, "
+    "transitive call effects, typed-error constructions) and flags cycles, "
+    "re-acquisition of a held non-reentrant lock, and locks held across "
+    "blocking calls (time.sleep, .join/.result/foreign .wait, jax/jnp "
+    "dispatch). Name-based and conservative: dynamically stored locks are "
+    "not tracked.",
+)
+def check_lock_order(tree: Tree):
+    findings = []
+    index = LockIndex(tree)
+    kinds: dict = {}
+    for m in index.modules.values():
+        for lock_id, kind in list(m.module_locks.values()) + list(
+            m.attr_locks.values()
+        ):
+            kinds[lock_id] = kind
+    edges: dict = {}  # (A, B) -> (rel, line)
+
+    def note_edge(a, b, rel, line):
+        edges.setdefault((a, b), (rel, line))
+
+    def walk(m, class_name, qual, local_locks, stmts, held):
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly = []
+                for item in stmt.items:
+                    got = index.resolve_lock(
+                        m, class_name, local_locks, item.context_expr
+                    )
+                    if got:
+                        lock_id, kind = got
+                        for h in held:
+                            note_edge(h, lock_id, m.rel, stmt.lineno)
+                        if lock_id in held and kind not in REENTRANT:
+                            findings.append(
+                                check_lock_order.finding(
+                                    m.rel, stmt.lineno,
+                                    f"non-reentrant lock {lock_id} "
+                                    "re-acquired while already held "
+                                    "(guaranteed self-deadlock)",
+                                )
+                            )
+                        newly.append(lock_id)
+                    else:
+                        # a with on a call (context manager): treat like a
+                        # call for lock effects
+                        if isinstance(item.context_expr, ast.Call):
+                            _note_call_effects(
+                                m, class_name, item.context_expr, held
+                            )
+                walk(m, class_name, qual, local_locks, stmt.body, held + newly)
+                continue
+            if held:
+                for call in _calls_here(stmt):
+                    desc = _blocking_desc(
+                        index, m, class_name, local_locks, held, call
+                    )
+                    if desc:
+                        findings.append(
+                            check_lock_order.finding(
+                                m.rel, call.lineno,
+                                f"lock {held[-1]} held across {desc} — "
+                                "move the blocking call outside the lock",
+                            )
+                        )
+                    _note_call_effects(m, class_name, call, held)
+            for sub in _stmt_lists(stmt):
+                walk(m, class_name, qual, local_locks, sub, held)
+
+    def _note_call_effects(m, class_name, call, held):
+        callee = index.resolve_call(m, class_name, call)
+        if not callee:
+            return
+        for lock_id in index.effects(callee):
+            for h in held:
+                note_edge(h, lock_id, m.rel, call.lineno)
+                if h == lock_id and kinds.get(lock_id) not in REENTRANT:
+                    findings.append(
+                        check_lock_order.finding(
+                            m.rel, call.lineno,
+                            f"call may re-acquire held non-reentrant lock "
+                            f"{lock_id} (self-deadlock through "
+                            f"{callee[0]}::{callee[1]})",
+                        )
+                    )
+
+    for m in index.modules.values():
+        for qual, fn_node in m.functions.items():
+            class_name = qual.split(".")[0] if "." in qual else None
+            local_locks = index._local_locks(m.rel, qual, fn_node)
+            walk(m, class_name, qual, local_locks, fn_node.body, [])
+
+    # ---- cycle detection over the acquisition graph -------------------------
+    graph: dict = {}
+    for (a, b), _loc in edges.items():
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+
+    # iterative Tarjan SCC (recursion-free; the graph is tiny but deep
+    # recursion limits are not worth trusting)
+    idx: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == idx[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in idx:
+            strongconnect(v)
+
+    for comp in sorted(sccs):
+        example = None
+        for (a, b), loc in sorted(edges.items()):
+            if a in comp and b in comp and a != b:
+                example = loc
+                break
+        rel, line = example if example else (comp[0].split("::")[0], 0)
+        findings.append(
+            check_lock_order.finding(
+                rel, line,
+                "lock-order cycle (potential deadlock): "
+                + " <-> ".join(comp),
+            )
+        )
+    return findings

@@ -1,0 +1,170 @@
+"""Checker 13: jit purity (SA013).
+
+Side effects inside traced code don't happen per call — they happen ONCE at
+trace time and silently freeze: a metric counter bumped inside a ``_st_*``
+stage body increments exactly once per compilation (the dispatch counts lie
+forever after), a ``time.*`` read becomes a constant, an ``os.environ`` /
+``knobs`` read pins the knob's trace-time value into the compiled program
+(the runtime knob appears to work until the cache hits), and a flight-
+recorder event records compilations instead of executions.
+
+Traced scopes are found statically, name-based and conservative:
+
+* every function or method named ``_st_*`` — the extracted stage bodies the
+  IR lowers into fused programs (``ir/lower.py``),
+* every function literally passed to ``jax.jit(...)`` / ``jit(...)`` /
+  ``shard_map(...)`` — a lambda/def argument, or a same-file
+  function/method name resolved through one level.
+
+Host-side orchestration around the traced call (``StagedProgram.__call__``,
+``EngineIr._count``) stays free to count and trace — that is exactly where
+those effects belong.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE_DIRS, Tree, checker
+
+STAGE_PREFIX = "_st_"
+TRACING_ENTRY_NAMES = ("jit", "shard_map")
+
+# receivers whose method calls are impure inside a trace
+TRACE_EMITTERS = ("event", "span", "operation")
+METRIC_MUTATORS = ("inc", "observe")
+INSTRUMENT_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def _call_name(call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _root_name(expr):
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _impurity(call) -> str | None:
+    """A description when ``call`` is an effect that must not be traced."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "getenv":
+            return "os.getenv(...) read (frozen at trace time)"
+        return None
+    root = _root_name(fn)
+    if root == "time":
+        return f"time.{fn.attr}(...) (a trace-time constant)"
+    if root == "os" and fn.attr in ("getenv",):
+        return "os.getenv(...) read (frozen at trace time)"
+    if root == "knobs" or (
+        isinstance(fn.value, ast.Attribute) and fn.value.attr == "knobs"
+    ):
+        return f"knobs.{fn.attr}(...) read (frozen at trace time)"
+    if fn.attr in TRACE_EMITTERS and root in ("trace", "obs"):
+        return f"trace.{fn.attr}(...) emission (records compilations, " \
+            "not executions)"
+    if fn.attr in METRIC_MUTATORS:
+        return f".{fn.attr}() metric mutation (bumps once per compilation)"
+    if fn.attr in INSTRUMENT_FACTORIES and root == "obs":
+        return f"obs.{fn.attr}(...) instrument creation"
+    return None
+
+
+def _has_environ(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _traced_functions(mod) -> list:
+    """(fn_node, why) scopes of one module that are traced: ``_st_*``
+    bodies, plus defs/lambdas/named functions passed to jit/shard_map."""
+    by_name: dict = {}
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+    out = []
+    seen: set = set()
+
+    def note(fn_node, why):
+        if id(fn_node) not in seen:
+            seen.add(id(fn_node))
+            out.append((fn_node, why))
+
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith(STAGE_PREFIX):
+                note(node, f"stage body {node.name}")
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in TRACING_ENTRY_NAMES or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, (ast.Lambda, ast.FunctionDef)):
+            note(target, f"function passed to {name}")
+        elif isinstance(target, ast.Name) and target.id in by_name:
+            note(by_name[target.id], f"{target.id} passed to {name}")
+        elif (
+            isinstance(target, ast.Attribute)
+            and target.attr in by_name
+        ):
+            # self._backward_impl style: resolve by method name, same file
+            note(by_name[target.attr], f"{target.attr} passed to {name}")
+    return out
+
+
+@checker(
+    "jit-purity",
+    code="SA013",
+    doc="No metric increments, trace events, time.* reads, os.environ/"
+    "knobs reads, or instrument creation inside a _st_* stage body or any "
+    "function passed to jax.jit/shard_map — side effects in traced code "
+    "run once at trace time and silently freeze (a counter that lies, a "
+    "knob that stops responding). Traced scopes are resolved name-based "
+    "within one file; host-side orchestration around the traced call is "
+    "exempt by construction.",
+)
+def check_jit_purity(tree: Tree):
+    findings = []
+    for rel in tree.py_files(PACKAGE_DIRS):
+        try:
+            mod = tree.parse(rel)
+        except SyntaxError:
+            continue
+        for fn_node, why in _traced_functions(mod):
+            body = fn_node.body
+            nodes = []
+            for stmt in body if isinstance(body, list) else [body]:
+                nodes.extend(ast.walk(stmt))
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    desc = _impurity(node)
+                    if desc:
+                        findings.append(
+                            check_jit_purity.finding(
+                                rel, node.lineno,
+                                f"impure {desc} inside traced code "
+                                f"({why}) — hoist it to the host-side "
+                                "caller",
+                            )
+                        )
+                elif _has_environ(node):
+                    findings.append(
+                        check_jit_purity.finding(
+                            rel, node.lineno,
+                            f"os.environ read inside traced code ({why}) — "
+                            "resolve the knob before tracing and close "
+                            "over the value",
+                        )
+                    )
+    return findings

@@ -1,0 +1,638 @@
+"""Checkers 3–9: the both-ways vocabulary contracts (ported from
+``programs/lint.py`` checks 3–9).
+
+Each enforces one canonical vocabulary in both directions — everything used
+is declared, everything declared is used — because a one-way check lets the
+vocabulary silently rot into either an unchecked free-for-all or a pile of
+dead names:
+
+3. ``env-knob-docs`` (SA003) — the ``spfft_tpu.knobs`` registry is the knob
+   surface: every ``SPFFT_TPU_*`` string in the package is a registered
+   knob, every non-internal registered knob is documented in
+   ``docs/details.md`` AND referenced by package code, and every knob the
+   docs mention still exists (dead-doc detection). This check reads the
+   REGISTRY (via ast), not regexes over scattered parsing code — the
+   registry replaced that code.
+4. ``stage-scope`` (SA004) — engine/tuning ``jax.named_scope`` labels vs
+   ``obs.STAGES``.
+5. ``fault-site`` (SA005) — ``faults.site(...)`` names vs ``faults.SITES``
+   (+ docs).
+6. ``trace-event`` (SA006) — ``trace.event/span/operation`` names vs
+   ``trace.EVENTS``.
+7. ``verify-check`` (SA007) — ``verify.CHECKS`` vs the ``CHECK_FNS``
+   implementation registry (+ docs).
+8. ``perf-stage`` (SA008) — ``perf.MODELED_STAGES`` vs the engine-pipeline
+   subset of ``obs.STAGES``.
+9. ``ir-node`` (SA009) — ``ir.NODES`` vs STAGES and MODELED_STAGES, plus
+   the ``IR_KEYS``/``IR_SECTION_KEYS`` plan-card mirror.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import PACKAGE_DIRS, DOCS_PATH, Tree, checker, missing_anchor
+
+KNOBS_FILE = "spfft_tpu/knobs.py"
+STAGES_FILE = "spfft_tpu/obs/stages.py"
+FAULTS_PLANE_FILE = "spfft_tpu/faults/plane.py"
+TRACE_FILE = "spfft_tpu/obs/trace.py"
+VERIFY_CHECKS_FILE = "spfft_tpu/verify/checks.py"
+PERF_FILE = "spfft_tpu/obs/perf.py"
+IR_GRAPH_FILE = "spfft_tpu/ir/graph.py"
+IR_COMPILE_FILE = "spfft_tpu/ir/compile.py"
+PLANCARD_FILE = "spfft_tpu/obs/plancard.py"
+
+# The engine pipeline modules: every named_scope label inside them must come
+# from obs.STAGES, and every STAGES entry must appear in at least one.
+ENGINE_FILES = (
+    "spfft_tpu/execution.py",
+    "spfft_tpu/execution_mxu.py",
+    "spfft_tpu/parallel/execution.py",
+    "spfft_tpu/parallel/execution_mxu.py",
+    "spfft_tpu/parallel/pencil2.py",
+    "spfft_tpu/parallel/pencil2_mxu.py",
+)
+# The autotuner's trial runner labels its phases from the same canonical
+# vocabulary, under the same both-ways rule as the engines.
+TUNING_FILES = ("spfft_tpu/tuning/runner.py",)
+
+KNOB_RE = re.compile(r"SPFFT_TPU_[A-Z0-9_]+")
+
+
+def package_files(tree: Tree) -> list:
+    return tree.py_files(PACKAGE_DIRS)
+
+
+# =============================================================================
+# SA003 env-knob-docs
+# =============================================================================
+
+
+def registry_knobs(tree: Tree) -> dict:
+    """``{name: {"internal": bool}}`` parsed from the literal ``register``
+    calls in ``spfft_tpu/knobs.py`` (import-free)."""
+    out: dict = {}
+    for node in ast.walk(tree.parse(KNOBS_FILE)):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "register"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            continue
+        internal = any(
+            kw.arg == "internal"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords
+        )
+        out[node.args[0].value] = {"internal": internal, "line": node.lineno}
+    return out
+
+
+@checker(
+    "env-knob-docs",
+    code="SA003",
+    doc="Three-way contract over the env-knob surface: every SPFFT_TPU_* "
+    "string in the package is registered in spfft_tpu.knobs, every "
+    "non-internal registered knob is documented in docs/details.md and "
+    "referenced by package code, and every knob the docs mention is still "
+    "registered. The registry (read via ast) is the single source; "
+    "internal=True rows are the registry-level docs exemptions.",
+)
+def check_env_knob_docs(tree: Tree):
+    skip, findings = missing_anchor(check_env_knob_docs, tree, KNOBS_FILE)
+    if skip:
+        return findings
+    registered = registry_knobs(tree)
+    in_package: dict = {}  # knob -> first (file, line)
+    for rel in package_files(tree):
+        if rel == KNOBS_FILE:
+            continue
+        for i, line in enumerate(tree.lines(rel), 1):
+            for knob in KNOB_RE.findall(line):
+                in_package.setdefault(knob, (rel, i))
+    in_harness: dict = {}  # env reads in programs/tests (C macros excluded)
+    for rel in tree.py_files(("programs", "tests")):
+        for i, line in enumerate(tree.lines(rel), 1):
+            if "environ" in line or "getenv" in line or "knobs." in line:
+                for knob in KNOB_RE.findall(line):
+                    in_harness.setdefault(knob, (rel, i))
+    for knob, (rel, lineno) in sorted({**in_harness, **in_package}.items()):
+        if knob not in registered:
+            findings.append(
+                check_env_knob_docs.finding(
+                    rel, lineno,
+                    f"env knob {knob} is not registered in spfft_tpu.knobs "
+                    "(the registry is the single allowed knob surface)",
+                )
+            )
+    doc_knobs: set = set()
+    if tree.exists(DOCS_PATH):
+        doc_knobs = set(KNOB_RE.findall(tree.source(DOCS_PATH)))
+        for knob in sorted(doc_knobs):
+            if knob not in registered:
+                findings.append(
+                    check_env_knob_docs.finding(
+                        DOCS_PATH, 0,
+                        f"env knob {knob} is documented but no longer "
+                        "registered in spfft_tpu.knobs (dead doc)",
+                    )
+                )
+    elif not tree.partial:
+        findings.append(
+            check_env_knob_docs.finding(
+                DOCS_PATH, 0, "docs/details.md is missing"
+            )
+        )
+        return findings
+    for knob, info in sorted(registered.items()):
+        if info["internal"]:
+            continue
+        if not tree.partial and knob not in doc_knobs:
+            findings.append(
+                check_env_knob_docs.finding(
+                    KNOBS_FILE, info["line"],
+                    f"env knob {knob} is registered but not documented in "
+                    f"{DOCS_PATH} (regenerate the knob table: "
+                    "python programs/gen_api_docs.py)",
+                )
+            )
+        if knob not in in_package:
+            findings.append(
+                check_env_knob_docs.finding(
+                    KNOBS_FILE, info["line"],
+                    f"env knob {knob} is registered but referenced by no "
+                    "package code (dead knob — delete the registration or "
+                    "mark it internal)",
+                )
+            )
+    return findings
+
+
+# =============================================================================
+# SA004 stage-scope
+# =============================================================================
+
+
+def _pipeline_strings(mod) -> set:
+    """String constants of an engine/tuning file, EXCLUDING those inside the
+    ``stage_accounting`` perf hooks: the hooks restate every stage name for
+    the flop/byte model, so counting them would let the coverage directions
+    satisfy themselves."""
+    skip: set = set()
+    for node in ast.walk(mod):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "stage_accounting"
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    return {
+        node.value
+        for node in ast.walk(mod)
+        if isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and id(node) not in skip
+    }
+
+
+@checker(
+    "stage-scope",
+    code="SA004",
+    doc="Every jax.named_scope label in an engine or tuning pipeline comes "
+    "from the canonical obs.STAGES list, and every listed stage appears in "
+    "at least one pipeline — profiler traces stay attributable against one "
+    "vocabulary.",
+)
+def check_stage_scopes(tree: Tree):
+    skip, findings = missing_anchor(check_stage_scopes, tree, STAGES_FILE)
+    if skip:
+        return findings
+    stages = tuple(tree.literal_assign(STAGES_FILE, "STAGES") or ())
+    if len(set(stages)) != len(stages):
+        findings.append(
+            check_stage_scopes.finding(
+                STAGES_FILE, 0, "duplicate entries in STAGES"
+            )
+        )
+    strings: set = set()
+    used: dict = {}
+    for rel in ENGINE_FILES + TUNING_FILES:
+        if not tree.exists(rel):
+            if not tree.partial:
+                findings.append(
+                    check_stage_scopes.finding(
+                        rel, 0, "engine/tuning pipeline file is missing"
+                    )
+                )
+            continue
+        mod = tree.parse(rel)
+        strings |= _pipeline_strings(mod)
+        for node in ast.walk(mod):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "named_scope"
+            ):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant):
+                label = node.args[0].value
+                used.setdefault(label, (rel, node.args[0].lineno))
+    for label, (rel, lineno) in sorted(used.items()):
+        if label not in stages:
+            findings.append(
+                check_stage_scopes.finding(
+                    rel, lineno,
+                    f"named_scope {label!r} is not in the canonical stage "
+                    f"list ({STAGES_FILE})",
+                )
+            )
+    for stage in stages:
+        if stage not in strings:
+            findings.append(
+                check_stage_scopes.finding(
+                    STAGES_FILE, 0,
+                    f"stage {stage!r} appears in no engine or tuning "
+                    "pipeline",
+                )
+            )
+    return findings
+
+
+# =============================================================================
+# SA005 fault-site
+# =============================================================================
+
+
+@checker(
+    "fault-site",
+    code="SA005",
+    doc="Every faults.site(...) call names a site registered in the "
+    "canonical faults.SITES vocabulary, every registered site is threaded "
+    "through the package at least once, and every site is documented — the "
+    "chaos suite's arm-every-site sweep is only exhaustive if the "
+    "vocabulary is.",
+)
+def check_fault_sites(tree: Tree):
+    skip, findings = missing_anchor(check_fault_sites, tree, FAULTS_PLANE_FILE)
+    if skip:
+        return findings
+    sites = tuple(tree.literal_assign(FAULTS_PLANE_FILE, "SITES") or ())
+    if len(set(sites)) != len(sites):
+        findings.append(
+            check_fault_sites.finding(
+                FAULTS_PLANE_FILE, 0, "duplicate entries in SITES"
+            )
+        )
+    used: dict = {}
+    for rel in package_files(tree):
+        if rel == FAULTS_PLANE_FILE:
+            continue  # the registry itself is not a threading site
+        mod = tree.parse(rel)
+        for node in ast.walk(mod):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "site"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "faults"
+            ):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)):
+                findings.append(
+                    check_fault_sites.finding(
+                        rel, node.lineno,
+                        "faults.site(...) must take a literal site name "
+                        "(static analysis cannot check dynamic names)",
+                    )
+                )
+                continue
+            name = node.args[0].value
+            if name not in sites:
+                findings.append(
+                    check_fault_sites.finding(
+                        rel, node.lineno,
+                        f"fault site {name!r} is not registered in the "
+                        f"canonical vocabulary ({FAULTS_PLANE_FILE})",
+                    )
+                )
+            used.setdefault(name, (rel, node.lineno))
+    for name in sites:
+        if name not in used:
+            findings.append(
+                check_fault_sites.finding(
+                    FAULTS_PLANE_FILE, 0,
+                    f"site {name!r} is registered but threaded through no "
+                    "package code path",
+                )
+            )
+    if tree.exists(DOCS_PATH):
+        docs_text = tree.source(DOCS_PATH)
+        for name in sites:
+            if name not in docs_text:
+                findings.append(
+                    check_fault_sites.finding(
+                        DOCS_PATH, 0,
+                        f"fault site {name!r} is not documented",
+                    )
+                )
+    return findings
+
+
+# =============================================================================
+# SA006 trace-event
+# =============================================================================
+
+TRACE_EMITTERS = ("event", "span", "operation")
+
+
+def _is_trace_receiver(value) -> bool:
+    """Whether a call receiver is the trace module (``trace.x`` after a
+    ``from .obs import trace``, or a dotted ``obs.trace.x``)."""
+    if isinstance(value, ast.Name):
+        return value.id == "trace"
+    return isinstance(value, ast.Attribute) and value.attr == "trace"
+
+
+@checker(
+    "trace-event",
+    code="SA006",
+    doc="Every trace.event/span/operation(...) call in the package names an "
+    "event registered in the canonical trace.EVENTS vocabulary, and every "
+    "registered event is emitted by at least one package call site — "
+    "flight-recorder streams and their consumers stay on one vocabulary.",
+)
+def check_trace_events(tree: Tree):
+    skip, findings = missing_anchor(check_trace_events, tree, TRACE_FILE)
+    if skip:
+        return findings
+    events = tuple(tree.literal_assign(TRACE_FILE, "EVENTS") or ())
+    if len(set(events)) != len(events):
+        findings.append(
+            check_trace_events.finding(
+                TRACE_FILE, 0, "duplicate entries in EVENTS"
+            )
+        )
+    used: dict = {}
+    for rel in package_files(tree):
+        if rel == TRACE_FILE:
+            continue  # the recorder itself is not an emission site
+        for node in ast.walk(tree.parse(rel)):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in TRACE_EMITTERS
+                and _is_trace_receiver(node.func.value)
+            ):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)):
+                findings.append(
+                    check_trace_events.finding(
+                        rel, node.lineno,
+                        f"trace.{node.func.attr}(...) must take a literal "
+                        "event name (static analysis cannot check dynamic "
+                        "names)",
+                    )
+                )
+                continue
+            name = node.args[0].value
+            if name not in events:
+                findings.append(
+                    check_trace_events.finding(
+                        rel, node.lineno,
+                        f"trace event {name!r} is not registered in the "
+                        f"canonical vocabulary ({TRACE_FILE})",
+                    )
+                )
+            used.setdefault(name, (rel, node.lineno))
+    for name in events:
+        if name not in used:
+            findings.append(
+                check_trace_events.finding(
+                    TRACE_FILE, 0,
+                    f"event {name!r} is registered but emitted by no "
+                    "package code path",
+                )
+            )
+    return findings
+
+
+# =============================================================================
+# SA007 verify-check
+# =============================================================================
+
+
+@checker(
+    "verify-check",
+    code="SA007",
+    doc="The canonical verify.CHECKS vocabulary matches the CHECK_FNS "
+    "implementation registry exactly both ways, and every check is "
+    "documented — the ABFT layer's instance of the both-ways contract.",
+)
+def check_verify_checks(tree: Tree):
+    skip, findings = missing_anchor(
+        check_verify_checks, tree, VERIFY_CHECKS_FILE
+    )
+    if skip:
+        return findings
+    checks = tuple(tree.literal_assign(VERIFY_CHECKS_FILE, "CHECKS") or ())
+    fns: tuple = ()
+    for node in tree.parse(VERIFY_CHECKS_FILE).body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "CHECK_FNS"
+            for t in node.targets
+        ):
+            if not isinstance(node.value, ast.Dict):
+                findings.append(
+                    check_verify_checks.finding(
+                        VERIFY_CHECKS_FILE, node.lineno,
+                        "CHECK_FNS must be a dict literal",
+                    )
+                )
+                return findings
+            fns = tuple(
+                k.value for k in node.value.keys if isinstance(k, ast.Constant)
+            )
+    if len(set(checks)) != len(checks):
+        findings.append(
+            check_verify_checks.finding(
+                VERIFY_CHECKS_FILE, 0, "duplicate entries in CHECKS"
+            )
+        )
+    for name in checks:
+        if name not in fns:
+            findings.append(
+                check_verify_checks.finding(
+                    VERIFY_CHECKS_FILE, 0,
+                    f"check {name!r} is registered in CHECKS but has no "
+                    "CHECK_FNS implementation",
+                )
+            )
+    for name in fns:
+        if name not in checks:
+            findings.append(
+                check_verify_checks.finding(
+                    VERIFY_CHECKS_FILE, 0,
+                    f"CHECK_FNS implements {name!r} but it is not "
+                    "registered in CHECKS",
+                )
+            )
+    if tree.exists(DOCS_PATH):
+        docs_text = tree.source(DOCS_PATH)
+        for name in checks:
+            if name not in docs_text:
+                findings.append(
+                    check_verify_checks.finding(
+                        DOCS_PATH, 0,
+                        f"verify check {name!r} is not documented",
+                    )
+                )
+    return findings
+
+
+# =============================================================================
+# SA008 perf-stage
+# =============================================================================
+
+
+@checker(
+    "perf-stage",
+    code="SA008",
+    doc="perf.MODELED_STAGES equals the engine-pipeline subset of "
+    "obs.STAGES exactly both ways: every modeled stage is canonical and "
+    "appears in an engine pipeline, every engine-pipeline stage carries a "
+    "flop/byte model (tuning-only trial phases are harness stages, exempt).",
+)
+def check_perf_stages(tree: Tree):
+    for anchor in (PERF_FILE, STAGES_FILE):
+        skip, findings = missing_anchor(check_perf_stages, tree, anchor)
+        if skip:
+            return findings
+    stages = tuple(tree.literal_assign(STAGES_FILE, "STAGES") or ())
+    modeled = tuple(tree.literal_assign(PERF_FILE, "MODELED_STAGES") or ())
+    findings = []
+    if len(set(modeled)) != len(modeled):
+        findings.append(
+            check_perf_stages.finding(
+                PERF_FILE, 0, "duplicate entries in MODELED_STAGES"
+            )
+        )
+    engine_strings: set = set()
+    for rel in ENGINE_FILES:
+        if tree.exists(rel):
+            # accounting hooks excluded (_pipeline_strings): membership here
+            # must mean "the compiled pipeline tags this stage", not "the
+            # perf model mentions it"
+            engine_strings |= _pipeline_strings(tree.parse(rel))
+    engine_stages = [s for s in stages if s in engine_strings]
+    for name in modeled:
+        if name not in stages:
+            findings.append(
+                check_perf_stages.finding(
+                    PERF_FILE, 0,
+                    f"modeled stage {name!r} is not in the canonical stage "
+                    f"list ({STAGES_FILE})",
+                )
+            )
+        elif name not in engine_stages:
+            findings.append(
+                check_perf_stages.finding(
+                    PERF_FILE, 0,
+                    f"modeled stage {name!r} appears in no engine pipeline",
+                )
+            )
+    for name in engine_stages:
+        if name not in modeled:
+            findings.append(
+                check_perf_stages.finding(
+                    STAGES_FILE, 0,
+                    f"engine stage {name!r} carries no flop/byte model in "
+                    f"{PERF_FILE} (MODELED_STAGES)",
+                )
+            )
+    return findings
+
+
+# =============================================================================
+# SA009 ir-node (+ plan-card IR_KEYS mirror)
+# =============================================================================
+
+
+@checker(
+    "ir-node",
+    code="SA009",
+    doc="The stage-graph IR's NODES vocabulary matches obs.STAGES and "
+    "perf.MODELED_STAGES both ways (an IR stage can never escape profiler "
+    "attribution or perf accounting), and the plan card's IR_SECTION_KEYS "
+    "mirror of ir.compile.IR_KEYS is identical (cards missing a new ir key "
+    "must not pass schema validation).",
+)
+def check_ir_nodes(tree: Tree):
+    for anchor in (IR_GRAPH_FILE, STAGES_FILE, PERF_FILE):
+        skip, findings = missing_anchor(check_ir_nodes, tree, anchor)
+        if skip:
+            return findings
+    stages = tuple(tree.literal_assign(STAGES_FILE, "STAGES") or ())
+    modeled = tuple(tree.literal_assign(PERF_FILE, "MODELED_STAGES") or ())
+    nodes = tuple(tree.literal_assign(IR_GRAPH_FILE, "NODES") or ())
+    findings = []
+    if len(set(nodes)) != len(nodes):
+        findings.append(
+            check_ir_nodes.finding(
+                IR_GRAPH_FILE, 0, "duplicate entries in NODES"
+            )
+        )
+    for name in nodes:
+        if name not in stages:
+            findings.append(
+                check_ir_nodes.finding(
+                    IR_GRAPH_FILE, 0,
+                    f"IR node {name!r} is not in the canonical stage list "
+                    f"({STAGES_FILE})",
+                )
+            )
+        if name not in modeled:
+            findings.append(
+                check_ir_nodes.finding(
+                    IR_GRAPH_FILE, 0,
+                    f"IR node {name!r} carries no flop/byte model in "
+                    f"{PERF_FILE} (MODELED_STAGES)",
+                )
+            )
+    for name in modeled:
+        if name not in nodes:
+            findings.append(
+                check_ir_nodes.finding(
+                    PERF_FILE, 0,
+                    f"modeled stage {name!r} is not an IR node "
+                    f"({IR_GRAPH_FILE} NODES) — the stage graph cannot "
+                    "express it",
+                )
+            )
+    # the plan-card mirror: IR_SECTION_KEYS (plancard stays import-free)
+    # must equal the source-of-truth IR_KEYS literal in ir/compile.py
+    if tree.exists(IR_COMPILE_FILE) and tree.exists(PLANCARD_FILE):
+        ir_keys = tree.literal_assign(IR_COMPILE_FILE, "IR_KEYS")
+        card_keys = tree.literal_assign(PLANCARD_FILE, "IR_SECTION_KEYS")
+        if tuple(ir_keys or ()) != tuple(card_keys or ()):
+            findings.append(
+                check_ir_nodes.finding(
+                    PLANCARD_FILE, 0,
+                    f"IR_SECTION_KEYS {tuple(card_keys or ())!r} does not "
+                    f"match {IR_COMPILE_FILE} IR_KEYS "
+                    f"{tuple(ir_keys or ())!r} — the card validator would "
+                    "accept cards missing (or carrying stale) ir keys",
+                )
+            )
+    elif not tree.partial:
+        findings.append(
+            check_ir_nodes.finding(
+                IR_COMPILE_FILE, 0,
+                "ir/compile.py or obs/plancard.py is missing — the IR_KEYS "
+                "mirror check cannot run",
+            )
+        )
+    return findings

@@ -20,11 +20,9 @@ C++ and the compute core is the JAX/XLA plan object.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
-from . import errors
+from . import errors, knobs
 from .grid import Grid
 from .multi_transform import multi_transform_backward, multi_transform_forward
 from .transform import Transform
@@ -55,11 +53,11 @@ __all__ = [
 # Virtual CPU mesh size for native callers (the C analogue of the tests'
 # 8-device conftest): must be applied before JAX initializes its backends,
 # i.e. before the first Grid/Transform creation in the embedded interpreter.
-_num_cpu = os.environ.get("SPFFT_TPU_NUM_CPU_DEVICES")
+_num_cpu = knobs.get_int("SPFFT_TPU_NUM_CPU_DEVICES")
 if _num_cpu:
     from .parallel.mesh import configure_virtual_devices
 
-    configure_virtual_devices(int(_num_cpu), warn=True)
+    configure_virtual_devices(_num_cpu, warn=True)
 
 _SP_SUCCESS = 0
 _SP_UNKNOWN = int(errors.ErrorCode.UNKNOWN)

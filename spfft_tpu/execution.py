@@ -128,9 +128,9 @@ class ExecutionBase:
         Single source of the chunking rule shared by :meth:`put` and
         :meth:`fetch`: ``SPFFT_TPU_STAGE_CHUNK_MB`` (default 256) bounds each
         piece; <= 0 disables chunking."""
-        import os
+        from . import knobs
 
-        limit = int(os.environ.get("SPFFT_TPU_STAGE_CHUNK_MB", "256")) << 20
+        limit = knobs.get_int("SPFFT_TPU_STAGE_CHUNK_MB") << 20
         if limit <= 0 or nbytes <= limit or dim0 <= 1:
             return None
         per_row = max(1, nbytes // dim0)

@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .errors import InvalidParameterError
 from .execution import ExecutionBase, as_pair
 from .ops import fft as offt
 from .ops import lanecopy, symmetry
@@ -348,7 +349,9 @@ class MxuLocalExecution(ExecutionBase):
         row_idx, wyb, wyf = self._sparse_y_blocked[b]
         mat = wyf if forward else wyb
         if mat is None:
-            raise RuntimeError(
+            # typed-error discipline (analysis SA010): caller misuse, so the
+            # contract violation surfaces as taxonomy, not a builtin
+            raise InvalidParameterError(
                 "this plan's blocked-y matrices ride as jit operands "
                 "(above SPFFT_TPU_SPARSE_Y_MATRIX_MB); thread "
                 "phase=engine.phase_operands through the enclosing jit "

@@ -24,11 +24,9 @@ proves.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
-from .. import obs
+from .. import knobs, obs
 from ..errors import GPUFFTError, HostExecutionError
 
 GUARD_ENV = "SPFFT_TPU_GUARD"
@@ -39,7 +37,7 @@ def guard_enabled(explicit: bool | None = None) -> bool:
     else the ``SPFFT_TPU_GUARD`` env knob (default off)."""
     if explicit is not None:
         return bool(explicit)
-    return os.environ.get(GUARD_ENV, "0") == "1"
+    return knobs.get_bool(GUARD_ENV)
 
 
 def execution_error(platform: str):

@@ -41,12 +41,11 @@ shows exactly what was injected where.
 from __future__ import annotations
 
 import contextlib
-import os
 import random
 import threading
 import time
 
-from .. import obs
+from .. import knobs, obs
 from ..errors import InvalidParameterError
 
 FAULTS_ENV = "SPFFT_TPU_FAULTS"
@@ -91,7 +90,7 @@ class InjectedFault(RuntimeError):
 
 _lock = threading.Lock()
 _armed: dict = {}  # site -> {"kind": str, "rate": float}
-_rng = random.Random(int(os.environ.get(FAULTS_SEED_ENV, "0") or "0"))
+_rng = random.Random(knobs.get_int(FAULTS_SEED_ENV))
 
 
 def parse_spec(spec: str) -> dict:
@@ -189,7 +188,7 @@ def reseed(seed: int | None = None) -> None:
     """Reseed the sub-1.0-rate draw stream (default: ``SPFFT_TPU_FAULTS_SEED``,
     else 0) — a chaos run with fractional rates replays exactly."""
     if seed is None:
-        seed = int(os.environ.get(FAULTS_SEED_ENV, "0") or "0")
+        seed = knobs.get_int(FAULTS_SEED_ENV)
     with _lock:
         _rng.seed(int(seed))
 
@@ -268,7 +267,7 @@ def site(name: str, payload=None):
     if kind == "raise":
         raise InjectedFault(f"injected fault at site {name!r}")
     if kind == "delay":
-        time.sleep(float(os.environ.get(FAULTS_DELAY_ENV, "0.005")))
+        time.sleep(knobs.get_float(FAULTS_DELAY_ENV))
         return payload
     if kind == "nan":
         return _poison(payload, float("nan"))
@@ -277,7 +276,7 @@ def site(name: str, payload=None):
 
 # Env arming at import: the SPFFT_TPU_FAULTS knob makes whole test suites /
 # CLIs runnable under injection without code changes (ci.sh chaos stage).
-_env_spec = os.environ.get(FAULTS_ENV)
+_env_spec = knobs.get_str(FAULTS_ENV)
 if _env_spec:
     arm(_env_spec)
 del _env_spec

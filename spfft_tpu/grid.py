@@ -29,7 +29,9 @@ def _effective_default_device():
         from jax._src.config import default_device
 
         return default_device.value
-    except Exception:
+    except (ImportError, AttributeError):
+        # the private module moved (ImportError) or dropped the accessor
+        # (AttributeError) — the public attribute is the documented fallback
         return jax.config.jax_default_device
 
 

@@ -29,10 +29,9 @@ decision, donation map).
 """
 from __future__ import annotations
 
-import os
-
 import jax
 
+from .. import knobs
 from ..errors import InvalidParameterError
 
 FUSE_ENV = "SPFFT_TPU_FUSE"
@@ -52,8 +51,8 @@ def resolve_fuse(fuse=None):
                 f"fuse= must be a bool (or 0/1), got {fuse!r}"
             )
         return bool(fuse), "kwarg"
-    raw = os.environ.get(FUSE_ENV)
-    if raw is None:
+    raw = knobs.raw(FUSE_ENV)
+    if raw is None or raw == "":
         return True, "default"
     if raw not in ("0", "1"):
         raise InvalidParameterError(

@@ -1,0 +1,601 @@
+"""Typed registry of every ``SPFFT_TPU_*`` environment knob.
+
+The single allowed read path for the package's env-knob surface (enforced
+by the ``knob-registry`` static-analysis checker, ``spfft_tpu.analysis``):
+every knob is registered here once — name, type, default, bounds, doc — and
+package code resolves values through the typed getters below instead of
+ad-hoc ``os.environ`` parsing scattered per module. What that buys:
+
+* **Loud configuration**: a malformed value raises typed
+  :class:`~spfft_tpu.errors.InvalidParameterError` *naming the knob and the
+  offending value* (the same rule ``faults.parse_spec`` and
+  ``verify.resolve_mode`` already follow) — a typo'd knob can never be
+  silently dropped or coerced to a default.
+* **One source of truth for docs**: the knob table in ``docs/details.md``
+  regenerates from this registry (``programs/gen_api_docs.py``), and the
+  ``env-knob-docs`` checker holds the two in sync both ways — a knob cannot
+  exist undocumented, and a doc row cannot outlive its knob.
+* **Mechanical checkability**: registrations are pure literals, so the
+  import-free analysis layer reads the whole surface via ``ast`` without
+  pulling ``jax``.
+
+Values are resolved at *call* time (no import-time caching): tests and the
+tuning trial isolation scope (``tuning.env_overrides``) mutate
+``os.environ`` between calls and must observe the change. Unset and
+empty-string are both "use the default" (the usual shell idiom for clearing
+a knob). Registered floors CLAMP (they encode "a lower value is
+meaningless", e.g. at least one queue slot), while malformed *types* and
+out-of-vocabulary choices RAISE — the distinction every migrated module
+already drew.
+
+``internal=True`` marks test/driver/measurement knobs exempt from the
+user-facing docs table (the old ``programs/lint.py`` ``INTERNAL_KNOBS``
+set, carried over as registry-level exemptions); they are documented where
+they are read.
+"""
+from __future__ import annotations
+
+import os
+
+from .errors import InvalidParameterError
+
+PREFIX = "SPFFT_TPU_"
+
+_VALID_KINDS = ("int", "float", "bool", "str")
+
+# the bool vocabulary the typed error message promises — exactly these;
+# anything else (including yes/no) raises so a typo'd knob is never
+# silently coerced
+_TRUE_WORDS = ("1", "true", "on")
+_FALSE_WORDS = ("0", "false", "off")
+
+
+class Knob:
+    """One registered environment knob (immutable record)."""
+
+    __slots__ = (
+        "name", "kind", "default", "doc", "floor", "choices", "internal",
+        "doc_default",
+    )
+
+    def __init__(
+        self, name, kind, default, doc, floor, choices, internal, doc_default
+    ):
+        self.name = name
+        self.kind = kind
+        self.default = default
+        self.doc = doc
+        self.floor = floor
+        self.choices = choices
+        self.internal = internal
+        self.doc_default = doc_default
+
+    def describe(self) -> dict:
+        """JSON-plain registry row (docs generation / tests)."""
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "default": self.default,
+            "doc": self.doc,
+            "floor": self.floor,
+            "choices": list(self.choices) if self.choices else None,
+            "internal": self.internal,
+            "doc_default": self.doc_default,
+        }
+
+
+REGISTRY: dict = {}
+
+
+def register(
+    name: str,
+    kind: str,
+    default,
+    doc: str,
+    *,
+    floor=None,
+    choices=None,
+    internal: bool = False,
+    doc_default: str | None = None,
+) -> str:
+    """Register one knob; returns ``name`` so modules can bind their
+    ``*_ENV`` constants to the registration itself. ``doc_default``
+    overrides how the docs table renders the default (e.g. ``"probe"``
+    when unset means "probe the platform" rather than a plain unset)."""
+    if not name.startswith(PREFIX):
+        raise InvalidParameterError(
+            f"knob {name!r} must start with {PREFIX!r}"
+        )
+    if kind not in _VALID_KINDS:
+        raise InvalidParameterError(
+            f"knob {name}: unknown kind {kind!r} (expected one of {_VALID_KINDS})"
+        )
+    if name in REGISTRY:
+        raise InvalidParameterError(f"knob {name} registered twice")
+    REGISTRY[name] = Knob(
+        name, kind, default, doc, floor,
+        tuple(choices) if choices else None, internal, doc_default,
+    )
+    return name
+
+
+def _knob(name: str) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise InvalidParameterError(
+            f"unregistered env knob {name!r}: every SPFFT_TPU_* knob must be "
+            "registered in spfft_tpu.knobs"
+        )
+    return knob
+
+
+def names(*, internal: bool | None = None) -> tuple:
+    """Registered knob names, sorted; ``internal=`` filters by flag."""
+    return tuple(
+        sorted(
+            k for k, v in REGISTRY.items()
+            if internal is None or v.internal == internal
+        )
+    )
+
+
+def describe() -> list:
+    """JSON-plain dump of the whole registry (docs generation / tests)."""
+    return [REGISTRY[k].describe() for k in names()]
+
+
+def default(name: str):
+    """The registered default of ``name`` (modules bind their ``DEFAULT_*``
+    constants to this so the registry stays the single holder)."""
+    return _knob(name).default
+
+
+def raw(name: str):
+    """The verbatim ambient value (``None`` when unset) of a REGISTERED
+    knob — for signature capture (``tuning.wisdom.env_signature``) and the
+    few resolvers with richer vocabularies than the typed getters
+    (``ir.resolve_fuse`` tracks kwarg/env/default provenance)."""
+    _knob(name)
+    return os.environ.get(name)
+
+
+def _ambient(name: str):
+    value = os.environ.get(name)
+    return None if value is None or value == "" else value
+
+
+def get_int(name: str, override=None):
+    """Typed integer resolve: ``override`` (an explicit caller argument)
+    wins, else the env value, else the registered default; malformed values
+    raise typed; a registered floor clamps."""
+    knob = _knob(name)
+    value = override if override is not None else _ambient(name)
+    if value is None:
+        value = knob.default
+    if value is None:
+        return None
+    try:
+        value = int(value)
+    except (TypeError, ValueError):
+        raise InvalidParameterError(
+            f"invalid {name} value {value!r}: expected an integer"
+        ) from None
+    if knob.floor is not None:
+        value = max(int(knob.floor), value)
+    return value
+
+
+def get_float(name: str, override=None):
+    """Typed float resolve (same contract as :func:`get_int`)."""
+    knob = _knob(name)
+    value = override if override is not None else _ambient(name)
+    if value is None:
+        value = knob.default
+    if value is None:
+        return None
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        raise InvalidParameterError(
+            f"invalid {name} value {value!r}: expected a float"
+        ) from None
+    if knob.floor is not None:
+        value = max(float(knob.floor), value)
+    return value
+
+
+def get_bool(name: str, override=None) -> bool:
+    """Typed boolean resolve: ``1/true/on`` and ``0/false/off``
+    (case-insensitive); anything else raises typed."""
+    knob = _knob(name)
+    if override is not None:
+        return bool(override)
+    value = _ambient(name)
+    if value is None:
+        return bool(knob.default)
+    lowered = value.strip().lower()
+    if lowered in _TRUE_WORDS:
+        return True
+    if lowered in _FALSE_WORDS:
+        return False
+    raise InvalidParameterError(
+        f"invalid {name} value {value!r}: expected 0/1 (or true/false, on/off)"
+    )
+
+
+def get_str(name: str, override=None):
+    """Typed string resolve; registered ``choices`` are enforced (an
+    out-of-vocabulary value raises typed, naming the vocabulary)."""
+    knob = _knob(name)
+    value = override if override is not None else _ambient(name)
+    if value is None:
+        value = knob.default
+    if value is None:
+        return None
+    value = str(value)
+    if knob.choices and value not in knob.choices:
+        raise InvalidParameterError(
+            f"invalid {name} value {value!r}: expected one of "
+            f"{'/'.join(knob.choices)}"
+        )
+    return value
+
+
+_GETTERS = {
+    "int": get_int,
+    "float": get_float,
+    "bool": get_bool,
+    "str": get_str,
+}
+
+
+def get(name: str, override=None):
+    """Kind-dispatched resolve (the generic entry point)."""
+    return _GETTERS[_knob(name).kind](name, override)
+
+
+# =============================================================================
+# The registry. Grouped as in the docs/details.md table (which regenerates
+# from these rows — edit the doc here, not there). Pure literal calls: the
+# import-free analysis layer reads this surface via ``ast``.
+# =============================================================================
+
+# ---- engine / ops knobs (all measured A/B'd in BASELINE.md) -----------------
+register(
+    "SPFFT_TPU_GAUSS_MM", "bool", True,
+    "Gauss 3-multiplication complex matmuls (`0` = textbook 4-matmul form)",
+)
+register(
+    "SPFFT_TPU_PAIR_COPY", "bool", False,
+    "`1` stacks the (re, im) copy-plan applies into one gather per pipe "
+    "(measured slower on TPU)",
+)
+register(
+    "SPFFT_TPU_SPARSE_Y", "str", "auto", choices=("auto", "0", "1"),
+    doc="per-slot y-DFT contraction off the stick table; auto-engages below "
+    "the measured Sy/Y < 0.6 crossover (`1`/`0` force on/off)",
+)
+register(
+    "SPFFT_TPU_SPARSE_Y_BLOCKS", "str", "auto",
+    "blocked sparse-y bucket count (the win region above the per-slot "
+    "crossover); auto = 4 at dim ≤ 256, 8 above (measured sweep); `0` "
+    "disables, a positive integer forces G",
+)
+register(
+    "SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "float", 0.8,
+    "auto blocked-y engages when padded bucket rows < frac × dense extent",
+)
+register(
+    "SPFFT_TPU_SPARSE_Y_MATRIX_MB", "int", 128,
+    "bucket matrices above this ride as jit operands (local engine) or veto "
+    "engagement (SPMD engines, which embed); embedded constants overflow the "
+    "tunnel compile transport ≳300 MB",
+)
+register(
+    "SPFFT_TPU_COPY_DENSE_FRAC", "float", 0.1,
+    "copy-plan pipes covering at least this block fraction are padded to "
+    "full coverage (direct write / dense add instead of the ~70 ns/row "
+    "scatter-add)",
+)
+register(
+    "SPFFT_TPU_XPAD", "int", 8, floor=1,
+    doc="active-x extent padding quantum (sublane tile)",
+)
+register(
+    "SPFFT_TPU_F64_STAGE_MB", "int", 256,
+    "f64-emulation x-stage temp budget (chunking threshold)",
+)
+register(
+    "SPFFT_TPU_PHASE_TABLE_MB", "int", 64,
+    "above this, rotation phase tables are generated in-trace instead of "
+    "embedded (512³-class plans)",
+)
+register(
+    "SPFFT_TPU_PHASE_DEVICE_MB", "int", 2048,
+    "budget for materializing phase tables as device-resident jit operands "
+    "(the fast path at 512³); `0` disables operands",
+)
+register(
+    "SPFFT_TPU_STAGE_CHUNK_MB", "int", 256,
+    "host↔device staging chunk size for host-facing slabs (put/fetch); "
+    "`0` = one-shot transfers",
+)
+register(
+    "SPFFT_TPU_EXCH_ROUND_COST_KB", "int", 128,
+    "per-collective-round latency (byte-equivalents) in the "
+    "ExchangeType.DEFAULT cost model",
+)
+register(
+    "SPFFT_TPU_OVERLAP_CHUNKS", "int", 1,
+    "OVERLAPPED-discipline chunk count: padded exchanges split into C "
+    "double-buffered chunk collectives pipelined against the neighbor "
+    "chunks' FFTs (per-plan `overlap=` argument wins; under `policy=\"tuned\"` "
+    "an unset knob is resolved by the autotuner — see \"Hiding the "
+    "exchange\")",
+)
+register(
+    "SPFFT_TPU_FUSE", "str", "1", choices=("0", "1"),
+    doc="stage-graph fusion (`spfft_tpu.ir`): `1` compiles each direction's "
+    "lowered stage graph into ONE jitted program (donated value buffers on "
+    "the consuming backward, decompress/compress fused inside); `0` runs the "
+    "staged per-node reference path with materialized intermediates "
+    "(per-plan `fuse=` argument wins; under `policy=\"tuned\"` the "
+    "fused/staged variants are trial candidates — see \"Fusing the "
+    "pipeline\")",
+)
+register(
+    "SPFFT_TPU_TWIDDLE_BF16", "bool", False,
+    "`1` stores the MXU engines' DFT stage matrices in bfloat16 (mixed "
+    "bf16×f32 contractions, half the twiddle HBM); f32 plans only — "
+    "f64 plans ignore it; a `policy=\"tuned\"` candidate (`mxu/bf16-twiddle`), "
+    "so the accuracy/speed trade is measured",
+)
+# ---- plan-decision / tuning knobs -------------------------------------------
+register(
+    "SPFFT_TPU_POLICY", "str", "default", choices=("default", "tuned"),
+    doc="plan-decision policy: `tuned` resolves `ExchangeType.DEFAULT` / "
+    "`engine=\"auto\"` empirically through `spfft_tpu.tuning` (per-plan "
+    "`policy=` argument wins)",
+)
+register(
+    "SPFFT_TPU_WISDOM", "str", None,
+    "path of the persistent wisdom JSON the TUNED policy reads/writes; "
+    "unset = process-memory store (see \"Autotuning & wisdom\")",
+)
+register(
+    "SPFFT_TPU_TUNE_REPEATS", "int", 5, floor=1,
+    doc="timed roundtrips per tuning trial candidate (best-of)",
+)
+register(
+    "SPFFT_TPU_TUNE_WARMUP", "int", 1, floor=0,
+    doc="untimed warmup roundtrips per trial candidate (compilation "
+    "absorbed; `0` bills compile to the first timed repeat)",
+)
+register(
+    "SPFFT_TPU_TUNE_CPU", "bool", False,
+    "`1` lets tuning trials run on CPU-only hosts (CI/tests); default skips "
+    "to the model policy so CPU timings never poison wisdom",
+)
+register(
+    "SPFFT_TPU_ONESHOT_TRANSPORT", "str", None, choices=("ragged", "chain"),
+    doc="`ragged`/`chain` overrides the ragged-all-to-all backend probe",
+    doc_default="probe",
+)
+register(
+    "SPFFT_TPU_NUM_CPU_DEVICES", "int", None,
+    "virtual CPU mesh width for HOST-path distributed runs",
+)
+register(
+    "SPFFT_TPU_ADVISORY_FENCE", "str", None, choices=("0", "1"),
+    doc="`1` forces the scalar-probe synchronization fence on any platform, "
+    "`0` disables it (runtimes whose `block_until_ready` genuinely waits); "
+    "unset = probe the platform",
+    doc_default="probe",
+)
+register(
+    "SPFFT_TPU_ENSURE_PLATFORM", "str", None, choices=("default",),
+    doc="`default` lets `ensure_virtual_devices` initialize the configured "
+    "default platform (healthy pod slices); unset, it resolves virtual CPU "
+    "devices without touching an uninitialized accelerator backend",
+)
+# ---- observability knobs ----------------------------------------------------
+register(
+    "SPFFT_TPU_METRICS", "bool", True,
+    "`0` disables the `spfft_tpu.obs` run-metrics registry at import: "
+    "instrument factories hand out shared no-ops (zero allocation on the hot "
+    "path), `obs.enable()/disable()` override at runtime",
+)
+register(
+    "SPFFT_TPU_TRACE", "bool", False,
+    "`1` arms the flight recorder at import (`obs.trace.enable()` overrides "
+    "at runtime); events land in a bounded ring buffer joined to plan cards "
+    "by run ID",
+)
+register(
+    "SPFFT_TPU_TRACE_CAP", "int", 4096, floor=1,
+    doc="flight-recorder ring-buffer capacity (oldest events evicted; "
+    "`dropped` counts them so snapshots are honest about truncation)",
+)
+register(
+    "SPFFT_TPU_TRACE_DUMP", "str", None,
+    "directory the recorder flushes to when a typed error is constructed "
+    "(dump-on-error); unset = no dumps",
+)
+register(
+    "SPFFT_TPU_PERF_FLOP_PER_BYTE", "float", 8.0,
+    "machine-balance point (flop/byte) of the stage time model's "
+    "compute-vs-memory roofline split",
+)
+# ---- fault-injection / guard knobs ------------------------------------------
+register(
+    "SPFFT_TPU_FAULTS", "str", None,
+    "arms fault-injection sites: `\"site=kind[:rate],...\"` over the "
+    "canonical `spfft_tpu.faults.SITES` vocabulary with kinds "
+    "`raise`/`nan`/`corrupt`/`delay` (see \"Failure model & degradation "
+    "ladder\"); unset = every site is a no-op check",
+)
+register(
+    "SPFFT_TPU_FAULTS_SEED", "int", 0,
+    "seed of the sub-1.0-rate fault draw stream — chaos runs with "
+    "fractional rates replay deterministically (`faults.reseed`)",
+)
+register(
+    "SPFFT_TPU_FAULTS_DELAY_S", "float", 0.005,
+    "sleep injected by the `delay` fault kind",
+)
+register(
+    "SPFFT_TPU_GUARD", "bool", False,
+    "`1` turns on guard mode on every plan (per-plan `guard=` argument "
+    "wins): NaN/Inf scans plus shape/dtype/device validation around "
+    "host-facing transforms, raising typed `spfft_tpu.errors` exceptions "
+    "with `guard_checks_total`/`guard_failures_total` metrics",
+)
+# ---- verification / breaker knobs -------------------------------------------
+register(
+    "SPFFT_TPU_VERIFY", "str", "0", choices=("0", "1", "on", "off", "strict"),
+    doc="`1` arms ABFT self-verification on every plan (per-plan `verify=` "
+    "argument wins): algebraic checks + the retry→demote→break "
+    "recovery supervisor (see \"Silent-data-corruption detection & "
+    "recovery\"); `strict` raises typed `VerificationError` on the first "
+    "failed check with no recovery",
+)
+register(
+    "SPFFT_TPU_VERIFY_RTOL", "float", None,
+    "relative tolerance of the verification checks (default `1e-4` for f32 "
+    "plans, `1e-9` for f64 — far above engine parity error, far below "
+    "real corruption)",
+    doc_default="per dtype",
+)
+register(
+    "SPFFT_TPU_VERIFY_SEED", "int", 0,
+    "seed of the deterministic probe-site stream — a failing `probe` "
+    "check replays exactly",
+)
+register(
+    "SPFFT_TPU_VERIFY_RETRIES", "int", 2, floor=0,
+    doc="re-executions after a failed check or typed execution error, before "
+    "demoting to the jnp.fft reference engine",
+)
+register(
+    "SPFFT_TPU_VERIFY_BACKOFF_S", "float", 0.01, floor=0.0,
+    doc="base of the exponential retry backoff (slept outside any lock, "
+    "jittered ×[0.5, 1.5) so concurrent retriers of one failed engine "
+    "spread out instead of thundering-herding it)",
+)
+register(
+    "SPFFT_TPU_VERIFY_JITTER_SEED", "int", None,
+    "seeds the retry-backoff jitter stream — a chaos run's sleep "
+    "schedule replays exactly; unset, each supervisor draws from system "
+    "entropy",
+    doc_default="entropy",
+)
+register(
+    "SPFFT_TPU_VERIFY_BREAKER_K", "int", 3, floor=1,
+    doc="consecutive verified-failure episodes that trip an engine's "
+    "process-global circuit breaker",
+)
+register(
+    "SPFFT_TPU_VERIFY_BREAKER_COOLDOWN_S", "float", 30.0, floor=0.0,
+    doc="open→half-open probe delay of the engine circuit breaker",
+)
+register(
+    "SPFFT_TPU_FENCE_BUDGET_S", "float", 0.0,
+    "wall-clock deadline for one completion fence: a wedged fence raises a "
+    "typed execution error (counted in `execution_failures_total`) after "
+    "the budget, with a `_platform.hang_watchdog` process backstop at "
+    "2× the budget; unset = unbudgeted inline wait. Also extends over "
+    "whole tuning trials (budget × (warmup + repeats + 1) per "
+    "candidate): a hung candidate fails typed `TrialTimeout` into an "
+    "`error` row instead of stalling `policy=\"tuned\"` planning",
+)
+# ---- serving-layer knobs ----------------------------------------------------
+register(
+    "SPFFT_TPU_SERVE_QUEUE_CAP", "int", 256, floor=1,
+    doc="bounded admission-queue capacity of a `serve.TransformService`: "
+    "offered load beyond it is refused with typed `ServiceOverloadError` "
+    "(see \"Serving under overload\")",
+)
+register(
+    "SPFFT_TPU_SERVE_BATCH_MAX", "int", 8, floor=1,
+    doc="max requests coalesced into one batched execution (and the "
+    "plan-clone pool width per cached geometry)",
+)
+register(
+    "SPFFT_TPU_SERVE_TENANT_QUOTA", "float", 0.5, floor=0.0,
+    doc="fraction of the queue one tenant may hold (floor 1 slot): a "
+    "runaway caller is refused at its quota even with the queue half-empty",
+)
+register(
+    "SPFFT_TPU_SERVE_TIMEOUT_S", "float", 0.0, floor=0.0,
+    doc="default per-request deadline (0 = none; per-request `timeout_s=` "
+    "wins): enforced at admission AND pre-dispatch, including between retry "
+    "attempts",
+)
+register(
+    "SPFFT_TPU_SERVE_RETRIES", "int", 1, floor=0,
+    doc="re-dispatches of a batch after a transient typed execution "
+    "failure, with jittered exponential backoff",
+)
+register(
+    "SPFFT_TPU_SERVE_BACKOFF_S", "float", 0.005, floor=0.0,
+    doc="base of the serving retry backoff (jittered ×[0.5, 1.5), like "
+    "the verify supervisor's)",
+)
+register(
+    "SPFFT_TPU_SERVE_ON_BREAKER", "str", "demote", choices=("demote", "shed"),
+    doc="what the service does with a batch whose engine's verify circuit "
+    "breaker is open: `demote` reroutes through the plan's `jnp.fft` "
+    "reference rung, `shed` fails the requests typed",
+)
+register(
+    "SPFFT_TPU_SERVE_PLANS", "int", 16, floor=1,
+    doc="plan-cache capacity (whole geometry entries, LRU-evicted; keyed "
+    "like the wisdom store)",
+)
+register(
+    "SPFFT_TPU_SERVE_SCHED", "bool", False,
+    "`1` = the service dispatches through the task-graph scheduler: one "
+    "cycle pops up to `SPFFT_TPU_SERVE_SCHED_BATCHES` coalesced batches "
+    "— mixed geometries included — and runs them as one graph "
+    "(see \"Scheduling transforms as a task graph\"; "
+    "`programs/loadgen.py --sched` A/Bs it)",
+)
+register(
+    "SPFFT_TPU_SERVE_SCHED_BATCHES", "int", 4, floor=1,
+    doc="coalesced batches one graph-scheduled dispatch cycle may drain "
+    "(the cross-geometry overlap window)",
+)
+register(
+    "SPFFT_TPU_SCHED_INFLIGHT", "int", 8, floor=1,
+    doc="task-graph executor window: how many transform executions stay "
+    "dispatched/device-resident at once before finalize must drain one "
+    "(`sched.run_graph(max_inflight=)` wins)",
+)
+# ---- internal knobs (test / driver / measurement; documented at their read
+# sites, exempt from the user-facing docs table) ------------------------------
+register(
+    "SPFFT_TPU_DRYRUN_BUDGET_S", "float", 300.0, internal=True,
+    doc="hang-watchdog budget of the multichip dryrun driver "
+    "(__graft_entry__.py)",
+)
+register(
+    "SPFFT_TPU_MEASURE_INIT_BUDGET_S", "float", 900.0, internal=True,
+    doc="hang-watchdog budget of the microbench drivers (programs/)",
+)
+register(
+    "SPFFT_TPU_NATIVE_TEST_BUDGET_S", "float", 600.0, internal=True,
+    doc="native API test budget (tests/test_native_api.py)",
+)
+register(
+    "SPFFT_TPU_FUZZ_SEED", "int", 0, internal=True,
+    doc="test-only: parity-fuzz seed offset "
+    "(tests/test_engine_parity_fuzz.py)",
+)
+register(
+    "SPFFT_TPU_BENCH_INIT_BUDGET_S", "float", 900.0, internal=True,
+    doc="hang-watchdog budget of the headline bench driver (bench.py)",
+)
+register(
+    "SPFFT_TPU_BENCH_RETRY_BUDGET_S", "float", 600.0, internal=True,
+    doc="total retry budget of the headline bench driver (bench.py)",
+)

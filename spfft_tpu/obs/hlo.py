@@ -98,7 +98,10 @@ def compiled_stats(lowered) -> dict:
     mem = {}
     try:
         analysis = compiled.memory_analysis()
-    except Exception:
+    except (AttributeError, NotImplementedError, RuntimeError):
+        # backends without the memory-analysis API (AttributeError /
+        # NotImplementedError) or whose runtime refuses it (XlaRuntimeError
+        # is a RuntimeError) — the stats block just omits the mem section
         analysis = None
     for field in (
         "temp_size_in_bytes",

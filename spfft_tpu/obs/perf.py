@@ -56,8 +56,8 @@ Surfaces: ``programs/dbench.py`` (multichip strong/weak scaling rows),
 from __future__ import annotations
 
 import math
-import os
 
+from .. import knobs
 from . import trace
 from .registry import gauge, histogram
 from .stages import STAGES
@@ -70,7 +70,7 @@ FLOP_PER_BYTE_ENV = "SPFFT_TPU_PERF_FLOP_PER_BYTE"
 # movement stages into one attribution scale: flops that cost the same time
 # as moving one byte. The default comes from the same ICI-class numbers as
 # parallel/policy.round_cost_bytes (hundreds of GFLOP/s against ~100 GB/s).
-DEFAULT_FLOP_PER_BYTE = 8.0
+DEFAULT_FLOP_PER_BYTE = knobs.default(FLOP_PER_BYTE_ENV)
 
 # The pipeline-stage vocabulary the perf model covers: exactly the engine
 # stages of obs.STAGES (the autotuner's "tune warmup"/"tune trial" phases are
@@ -149,10 +149,7 @@ ATTRIBUTION_KEYS = ("method", "flop_per_byte")
 
 def flop_per_byte() -> float:
     """The active flops-per-byte machine balance (env-overridable)."""
-    try:
-        return float(os.environ.get(FLOP_PER_BYTE_ENV, DEFAULT_FLOP_PER_BYTE))
-    except ValueError:
-        return DEFAULT_FLOP_PER_BYTE
+    return knobs.get_float(FLOP_PER_BYTE_ENV)
 
 
 def fft_pass_flops(lines: int, length: int) -> int:
@@ -328,7 +325,11 @@ def stage_model(transform) -> list:
     rows = _merge_rows(transform._exec.stage_accounting())
     for r in rows:
         if r["stage"] not in MODELED_STAGES:
-            raise AssertionError(
+            from ..errors import InvalidParameterError
+
+            # typed-error discipline (analysis SA010): a stage outside the
+            # modeled vocabulary is a broken engine contract, surfaced typed
+            raise InvalidParameterError(
                 f"engine stage_accounting emitted unmodeled stage {r['stage']!r}"
             )
     return rows

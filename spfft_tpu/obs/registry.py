@@ -18,9 +18,10 @@ format, ``spfft_tpu_``-prefixed).
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
+
+from .. import knobs
 
 METRICS_ENV = "SPFFT_TPU_METRICS"
 SNAPSHOT_SCHEMA = "spfft_tpu.obs.snapshot/1"
@@ -179,7 +180,7 @@ _lock = threading.Lock()
 _counters: dict = {}
 _gauges: dict = {}
 _histograms: dict = {}
-_enabled = os.environ.get(METRICS_ENV, "1") != "0"
+_enabled = knobs.get_bool(METRICS_ENV)
 
 
 def enable() -> None:

@@ -46,12 +46,14 @@ import threading
 import time
 import warnings
 
+from .. import knobs
+
 TRACE_ENV = "SPFFT_TPU_TRACE"
 TRACE_CAP_ENV = "SPFFT_TPU_TRACE_CAP"
 TRACE_DUMP_ENV = "SPFFT_TPU_TRACE_DUMP"
 TRACE_SCHEMA = "spfft_tpu.obs.trace/1"
 
-DEFAULT_CAPACITY = 4096
+DEFAULT_CAPACITY = knobs.default(TRACE_CAP_ENV)
 
 # Canonical trace event-name vocabulary. Every ``trace.event/span/operation``
 # call in the package names one of these; programs/lint.py enforces the list
@@ -194,15 +196,12 @@ _NOOP_SPAN = _NoopSpan()
 
 
 def _default_capacity() -> int:
-    try:
-        return int(os.environ.get(TRACE_CAP_ENV, str(DEFAULT_CAPACITY)))
-    except ValueError:
-        return DEFAULT_CAPACITY
+    return knobs.get_int(TRACE_CAP_ENV)
 
 
 _recorder = (
     TraceRecorder(_default_capacity())
-    if os.environ.get(TRACE_ENV, "0") == "1"
+    if knobs.get_bool(TRACE_ENV)
     else _NOOP_RECORDER
 )
 
@@ -482,7 +481,7 @@ def dump(reason: str = "error") -> str | None:
     constructed (guard failures raise those), and callable directly from
     debugging sessions."""
     global _dump_warned
-    directory = os.environ.get(TRACE_DUMP_ENV)
+    directory = knobs.get_str(TRACE_DUMP_ENV)
     if not directory or not _recorder or getattr(_tls, "no_dump", 0):
         return None
     doc = dict(snapshot(), reason=str(reason))

@@ -27,12 +27,12 @@ in BASELINE.md ``precision_oracle_matrix_128``).
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from .. import knobs
 
 _PRECISION = jax.lax.Precision.HIGHEST
 
@@ -67,7 +67,9 @@ def c2c_matrix(n: int, sign: int, scale: float = 1.0, row_perm=None, num_rows=No
     row_perm = np.asarray(row_perm, dtype=np.int64)
     if num_rows is not None and num_rows != row_perm.size:
         if num_rows < row_perm.size:
-            raise ValueError("num_rows smaller than row_perm")
+            from ..errors import InvalidParameterError
+
+            raise InvalidParameterError("num_rows smaller than row_perm")
         row_perm = np.concatenate(
             [row_perm, np.full(num_rows - row_perm.size, -1, dtype=np.int64)]
         )
@@ -112,14 +114,7 @@ def twiddle_bf16_enabled() -> bool:
     Off by default; under ``policy="tuned"`` the variant is an autotuner
     candidate (``tuning/candidates.py`` ``mxu/bf16-twiddle``) so the
     accuracy/speed trade is measured, not guessed."""
-    raw = os.environ.get(TWIDDLE_BF16_ENV, "0")
-    if raw not in ("0", "1"):
-        from ..errors import InvalidParameterError
-
-        raise InvalidParameterError(
-            f"{TWIDDLE_BF16_ENV} must be 0 or 1, got {raw!r}"
-        )
-    return raw == "1"
+    return knobs.get_bool(TWIDDLE_BF16_ENV)
 
 
 def twiddle_dtype(real_dtype):
@@ -170,7 +165,7 @@ def compact_x_extent(num_unique: int, dim_x_freq: int) -> int:
     copy plans and no longer wins). Shared by the local and distributed MXU
     engines; a huge SPFFT_TPU_XPAD still disables compaction.
     """
-    quantum = max(1, int(os.environ.get("SPFFT_TPU_XPAD", "8")))
+    quantum = knobs.get_int("SPFFT_TPU_XPAD")
     a = -(-max(1, int(num_unique)) // quantum) * quantum
     return min(a, dim_x_freq)
 
@@ -221,7 +216,7 @@ def sparse_y_blocked_frac() -> float:
     stay under this fraction of the dense extent
     (``SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC``, default 0.8 — measured sweep in
     BASELINE.md). Single source for plan_sparse_y_blocked and plan cards."""
-    return float(os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC", "0.8"))
+    return knobs.get_float("SPFFT_TPU_SPARSE_Y_BLOCKED_FRAC")
 
 
 def describe_sparse_y(per_slot: bool, blocked_buckets, sy: int = 0) -> dict:
@@ -254,13 +249,9 @@ def plan_sparse_y(xslot, ys, num_x_active: int, dim_y: int, real_dtype):
     matrix pairs are the (A, Sy, Y) per-slot gathered DFT constants
     (padding rows zero).
     """
-    # empty string = unset (the usual shell idiom for clearing a knob)
-    mode = os.environ.get("SPFFT_TPU_SPARSE_Y") or "auto"
-    if mode not in ("0", "1", "auto"):
-        raise ValueError(
-            f"SPFFT_TPU_SPARSE_Y={mode!r}: must be '0' (off), '1' (forced), "
-            "or 'auto'/unset (measured Sy/Y crossover)"
-        )
+    # empty string = unset; out-of-vocabulary values raise typed (the
+    # registry's choices — spfft_tpu.knobs — own the validation)
+    mode = knobs.get_str("SPFFT_TPU_SPARSE_Y")
     xslot = np.asarray(xslot, dtype=np.int64)
     if mode == "0" or xslot.size == 0:
         return None
@@ -325,7 +316,7 @@ def plan_sparse_y_blocked(
     ``src/fft/transform_1d_host.hpp:155-235``, which skips empty x-rows but
     still transforms every y column of occupied ones.
     """
-    mode = os.environ.get("SPFFT_TPU_SPARSE_Y_BLOCKS") or "auto"
+    mode = knobs.get_str("SPFFT_TPU_SPARSE_Y_BLOCKS")
     if mode == "0":
         return None
     if mode != "auto":
@@ -335,7 +326,9 @@ def plan_sparse_y_blocked(
         except ValueError:
             forced_g = -1
         if forced_g < 1:
-            raise ValueError(
+            from ..errors import InvalidParameterError
+
+            raise InvalidParameterError(
                 f"SPFFT_TPU_SPARSE_Y_BLOCKS={mode!r}: expected 'auto', '0' "
                 "(disable), or a positive bucket count"
             )
@@ -461,7 +454,7 @@ def sparse_y_matrix_budget_bytes() -> int:
     threads the matrices as jit operands and the SPMD engines (which embed
     constants in their shard_map closures) veto engagement. One definition
     so the two engines' thresholds cannot desynchronize."""
-    return int(os.environ.get(SPARSE_Y_MATRIX_MB_ENV, "128")) << 20
+    return knobs.get_int(SPARSE_Y_MATRIX_MB_ENV) << 20
 
 
 F64_STAGE_MB_ENV = "SPFFT_TPU_F64_STAGE_MB"
@@ -479,7 +472,7 @@ def f64_stage_chunks(batch: int, *operand_elems: int) -> int:
     ``SPFFT_TPU_F64_STAGE_MB``). Returns the smallest divisor of ``batch``
     meeting the budget (1 = no chunking; ``batch`` if no smaller divisor fits).
     """
-    budget = int(os.environ.get(F64_STAGE_MB_ENV, "256")) * (1 << 20)
+    budget = knobs.get_int(F64_STAGE_MB_ENV) * (1 << 20)
     temp_bytes = 32 * max(operand_elems)
     if temp_bytes <= budget or batch <= 1:
         return 1
@@ -525,7 +518,7 @@ def gauss_matmul_enabled() -> bool:
     """Whether :func:`complex_matmul` uses Gauss's 3-multiplication form.
     Read at trace time; ``SPFFT_TPU_GAUSS_MM=0`` restores the 4-matmul form
     (the A/B escape hatch)."""
-    return os.environ.get("SPFFT_TPU_GAUSS_MM", "1") != "0"
+    return knobs.get_bool("SPFFT_TPU_GAUSS_MM")
 
 
 def complex_matmul(xr, xi, wr, wi, spec: str, precision=_PRECISION):

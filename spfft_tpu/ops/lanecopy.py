@@ -36,11 +36,12 @@ the plain scatter path).
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import knobs
 
 LANE = 128
 
@@ -116,7 +117,7 @@ class CopyPlan:
         # mask, so padding costs one extra gathered row each — worth it down
         # to low coverage fractions (``SPFFT_TPU_COPY_DENSE_FRAC``, default
         # 0.1); genuinely sparse tail pipes keep the scatter-add.
-        dense_frac = float(os.environ.get("SPFFT_TPU_COPY_DENSE_FRAC", "0.1"))
+        dense_frac = knobs.get_float("SPFFT_TPU_COPY_DENSE_FRAC")
         no_lanes = np.zeros(LANE, dtype=bool)
         for k, entries in enumerate(per_pipe):
             covered = {e[0] for e in entries}
@@ -326,7 +327,7 @@ def pair_copy_enabled() -> bool:
     per pipe. Default OFF — measured ~23% slower end-to-end on chip (see
     :meth:`CopyPlan.apply_pair`); ``SPFFT_TPU_PAIR_COPY=1`` opts in for A/B.
     Semantics are identical either way."""
-    return os.environ.get("SPFFT_TPU_PAIR_COPY", "0") == "1"
+    return knobs.get_bool("SPFFT_TPU_PAIR_COPY")
 
 
 def build_decompress_plan(value_indices: np.ndarray, num_slots: int, num_values: int, max_runs: int = 64):
@@ -419,7 +420,7 @@ def alignment_phase_rep(deltas, dim_z: int, real_dtype):
     """
     deltas = np.asarray(deltas)
     bytes_ = 2 * deltas.size * int(dim_z) * np.dtype(real_dtype).itemsize
-    limit = int(os.environ.get(PHASE_TABLE_LIMIT_MB_ENV, "64")) * (1 << 20)
+    limit = knobs.get_int(PHASE_TABLE_LIMIT_MB_ENV) * (1 << 20)
     # the in-trace form's exactness requires delta*k < 2^31 (int32 products)
     if bytes_ <= limit or int(dim_z) * int(dim_z) >= 2**31:
         return ("table", *alignment_phase_tables(deltas, dim_z, real_dtype))
@@ -446,7 +447,7 @@ def phase_rep_operands(rep, real_dtype, put):
     """
     if rep is None:
         return ()
-    limit = int(os.environ.get(PHASE_DEVICE_LIMIT_MB_ENV, "2048")) * (1 << 20)
+    limit = knobs.get_int(PHASE_DEVICE_LIMIT_MB_ENV) * (1 << 20)
     if limit <= 0:  # <= 0 disables operands entirely (A/B escape hatch)
         return ()
     if rep[0] == "table":

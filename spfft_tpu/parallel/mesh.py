@@ -114,16 +114,16 @@ def ensure_virtual_devices(n_devices: int, *, warn: bool = False, platform=None)
     initialization (the embedded-interpreter caller wants the diagnostic;
     raising would break an otherwise-valid single-device run).
     """
-    import os
     import sys
 
+    from .. import knobs
     from .._platform import cpu_devices, global_init_is_safe
 
     n_devices = max(int(n_devices), 1)
     configure_virtual_devices(n_devices, warn=warn)
     if platform == "cpu":
         devices = cpu_devices()
-    elif global_init_is_safe() or os.environ.get(
+    elif global_init_is_safe() or knobs.get_str(
         "SPFFT_TPU_ENSURE_PLATFORM"
     ) == "default":
         devices = jax.devices(platform)
@@ -148,7 +148,11 @@ def ensure_virtual_devices(n_devices: int, *, warn: bool = False, platform=None)
         except RuntimeError:
             devices = []
     if len(devices) < n_devices:
-        raise RuntimeError(
+        from ..errors import InvalidParameterError
+
+        # typed-error discipline (analysis SA010): a process configured with
+        # too few devices is a configuration failure, surfaced as taxonomy
+        raise InvalidParameterError(
             f"need {n_devices} devices but only {len(devices)} are visible; "
             f"start the process with JAX_NUM_CPU_DEVICES={n_devices} (or "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}) so "

@@ -26,10 +26,9 @@ Explicit disciplines are never overridden — the policy runs only for DEFAULT.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
+from .. import knobs
 from ..types import ExchangeType
 
 ROUND_COST_ENV = "SPFFT_TPU_EXCH_ROUND_COST_KB"
@@ -56,13 +55,7 @@ def resolve_overlap_chunks(overlap=None) -> int:
     from ..errors import InvalidParameterError
 
     if overlap is None:
-        raw = os.environ.get(OVERLAP_ENV, "1")
-        try:
-            overlap = int(raw)
-        except ValueError:
-            raise InvalidParameterError(
-                f"{OVERLAP_ENV} must be a positive integer, got {raw!r}"
-            ) from None
+        overlap = knobs.get_int(OVERLAP_ENV)
     overlap = int(overlap)
     if overlap < 1:
         raise InvalidParameterError(
@@ -89,7 +82,7 @@ def resolve_policy(policy=None) -> str:
     """The active plan-decision policy: explicit argument, else the
     ``SPFFT_TPU_POLICY`` env knob, else ``"default"``."""
     if policy is None:
-        policy = os.environ.get(POLICY_ENV) or "default"
+        policy = knobs.get_str(POLICY_ENV)
     policy = str(policy)
     if policy not in POLICIES:
         from ..errors import InvalidParameterError
@@ -165,7 +158,7 @@ def discipline_volumes(num_sticks_per_shard, local_z_lengths):
 
 def round_cost_bytes() -> int:
     """Per-round latency in byte-equivalents (see module docstring)."""
-    return int(os.environ.get(ROUND_COST_ENV, "128")) << 10
+    return knobs.get_int(ROUND_COST_ENV) << 10
 
 
 def alternative_costs(

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..errors import InvalidParameterError
 from .mesh import FFT_AXIS
 
 
@@ -69,7 +70,7 @@ def _wire_cast_out(chunk, wire):
                 [chunk.real.astype(jnp.bfloat16), chunk.imag.astype(jnp.bfloat16)]
             )
         return chunk.astype(jnp.bfloat16)
-    raise ValueError(f"unknown wire format {wire!r}")
+    raise InvalidParameterError(f"unknown wire format {wire!r}")
 
 
 def _wire_cast_in(chunk, wire, dtype, real_dtype):
@@ -90,7 +91,7 @@ def _wire_np_dtype(wire):
         return np.float32
     if wire == "bf16":
         return jnp.bfloat16
-    raise ValueError(f"unknown wire format {wire!r}")
+    raise InvalidParameterError(f"unknown wire format {wire!r}")
 
 
 def _fold_axis_index(axis_names, axis_sizes):
@@ -370,9 +371,9 @@ def _ragged_a2a_supported(mesh) -> bool:
     TPU runtimes accept it. ``SPFFT_TPU_ONESHOT_TRANSPORT=ragged|chain``
     overrides the probe in both directions.
     """
-    import os
+    from .. import knobs
 
-    override = os.environ.get("SPFFT_TPU_ONESHOT_TRANSPORT")
+    override = knobs.get_str("SPFFT_TPU_ONESHOT_TRANSPORT")
     if override == "ragged":
         return True
     if override == "chain":
@@ -402,7 +403,10 @@ def _ragged_a2a_supported(mesh) -> bool:
                 shard_mapper(mesh)(probe, in_specs=spec, out_specs=spec)
             ).lower(jax.ShapeDtypeStruct((P * P,), np.float32)).compile()
             _RAGGED_A2A_PROBE_CACHE[key] = True
-        except Exception:
+        except Exception:  # noqa: SA010 — capability probe: ANY compile
+            # failure (XlaRuntimeError, NotImplementedError, lowering
+            # TypeError...) means "this backend lacks ragged a2a"; the
+            # result is the cached False, not a swallowed error
             _RAGGED_A2A_PROBE_CACHE[key] = False
     return _RAGGED_A2A_PROBE_CACHE[key]
 
@@ -464,7 +468,7 @@ class OneShotExchange:
                 else "chain"
             )
         if transport not in ("ragged", "chain"):
-            raise ValueError(f"unknown transport {transport!r}")
+            raise InvalidParameterError(f"unknown transport {transport!r}")
         self.transport = transport
 
         # compact global stick row -> plane slot (strip the padded rows of the
@@ -718,10 +722,10 @@ class OneShotBlockExchange:
         cols = np.asarray(cols, dtype=np.int64)
         self.P = int(np.prod(self.axis_sizes))
         if rows.shape != (self.P, self.P) or cols.shape != (self.P, self.P):
-            raise ValueError("rows/cols must be (P, P) tables")
+            raise InvalidParameterError("rows/cols must be (P, P) tables")
         self.R, self.C = int(R), int(C)
         if (rows > self.R).any() or (cols > self.C).any():
-            raise ValueError("rows/cols entries must fit the (R, C) block")
+            raise InvalidParameterError("rows/cols entries must fit the (R, C) block")
         self._rows, self._cols = rows, cols
         self._geom = {}
         for reverse in (False, True):
@@ -841,10 +845,10 @@ class RaggedBlockExchange:
         cols = np.asarray(cols, dtype=np.int64)
         self.P = int(np.prod(self.axis_sizes))
         if rows.shape != (self.P, self.P) or cols.shape != (self.P, self.P):
-            raise ValueError("rows/cols must be (P, P) tables")
+            raise InvalidParameterError("rows/cols must be (P, P) tables")
         self.R, self.C = int(R), int(C)
         if (rows > self.R).any() or (cols > self.C).any():
-            raise ValueError("rows/cols entries must fit the (R, C) block")
+            raise InvalidParameterError("rows/cols entries must fit the (R, C) block")
         self._rows, self._cols = rows, cols
         P = self.P
         s = np.arange(P)

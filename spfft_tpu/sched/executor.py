@@ -29,12 +29,11 @@ provenance on every pool-built plan's card.
 """
 from __future__ import annotations
 
-import os
 import time
 
 import numpy as np
 
-from .. import faults, obs
+from .. import faults, knobs, obs
 from ..errors import (
     DeadlineExceededError,
     FFTWError,
@@ -49,7 +48,7 @@ from .graph import TaskGraph
 from .placement import PlanPool, place
 
 SCHED_INFLIGHT_ENV = "SPFFT_TPU_SCHED_INFLIGHT"
-DEFAULT_INFLIGHT = 8
+DEFAULT_INFLIGHT = knobs.default(SCHED_INFLIGHT_ENV)
 
 # Completion-poll cadence and patience: between polls the executor sleeps
 # _POLL_S; after _POLL_PATIENCE_S without any task completing it stops
@@ -72,15 +71,7 @@ def resolve_inflight(value=None) -> int:
     """The in-flight window (``SPFFT_TPU_SCHED_INFLIGHT``, floor 1)."""
     if value is not None:
         return max(1, int(value))
-    try:
-        return max(1, int(
-            os.environ.get(SCHED_INFLIGHT_ENV, str(DEFAULT_INFLIGHT))
-            or DEFAULT_INFLIGHT
-        ))
-    except ValueError as e:
-        raise InvalidParameterError(
-            f"invalid {SCHED_INFLIGHT_ENV}: expected an integer"
-        ) from e
+    return knobs.get_int(SCHED_INFLIGHT_ENV)
 
 
 class GraphReport:

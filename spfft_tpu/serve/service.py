@@ -40,14 +40,13 @@ events for admit/shed/dispatch/complete transitions.
 from __future__ import annotations
 
 import collections
-import os
 import random
 import threading
 import time
 
 import numpy as np
 
-from .. import faults, obs, sched
+from .. import faults, knobs, obs, sched
 from ..errors import (
     FFTWError,
     GPUFFTError,
@@ -79,13 +78,15 @@ SERVE_PLANS_ENV = "SPFFT_TPU_SERVE_PLANS"
 SERVE_SCHED_ENV = "SPFFT_TPU_SERVE_SCHED"
 SERVE_SCHED_BATCHES_ENV = "SPFFT_TPU_SERVE_SCHED_BATCHES"
 
-DEFAULT_QUEUE_CAP = 256
-DEFAULT_BATCH_MAX = 8
-DEFAULT_TENANT_QUOTA = 0.5
-DEFAULT_RETRIES = 1
-DEFAULT_BACKOFF_S = 0.005
-DEFAULT_PLANS = 16
-DEFAULT_SCHED_BATCHES = 4
+# defaults live in the spfft_tpu.knobs registry (the single holder); these
+# aliases keep the module's public surface stable
+DEFAULT_QUEUE_CAP = knobs.default(SERVE_QUEUE_CAP_ENV)
+DEFAULT_BATCH_MAX = knobs.default(SERVE_BATCH_MAX_ENV)
+DEFAULT_TENANT_QUOTA = knobs.default(SERVE_TENANT_QUOTA_ENV)
+DEFAULT_RETRIES = knobs.default(SERVE_RETRIES_ENV)
+DEFAULT_BACKOFF_S = knobs.default(SERVE_BACKOFF_ENV)
+DEFAULT_PLANS = knobs.default(SERVE_PLANS_ENV)
+DEFAULT_SCHED_BATCHES = knobs.default(SERVE_SCHED_BATCHES_ENV)
 
 # Typed execution failures one re-dispatch may heal (the verify supervisor's
 # retry rule): the dual error surface's dispatch/fence conversions plus the
@@ -94,27 +95,11 @@ DEFAULT_SCHED_BATCHES = 4
 RETRYABLE_ERRORS = (HostExecutionError, GPUFFTError, MPIError, FFTWError)
 
 
-def _env_int(name: str, default: int, floor: int) -> int:
-    try:
-        return max(floor, int(os.environ.get(name, str(default)) or default))
-    except ValueError as e:
-        raise InvalidParameterError(f"invalid {name}: expected an integer") from e
-
-
-def _env_float(name: str, default: float, floor: float) -> float:
-    try:
-        return max(floor, float(os.environ.get(name, str(default)) or default))
-    except ValueError as e:
-        raise InvalidParameterError(f"invalid {name}: expected a float") from e
-
-
 def resolve_on_breaker(value: str | None = None) -> str:
     """``demote`` (reroute through the jnp.fft reference rung) or ``shed``
     (typed refusal) — what the service does with a batch whose engine's
     circuit breaker is open (``SPFFT_TPU_SERVE_ON_BREAKER``)."""
-    mode = value if value is not None else os.environ.get(
-        SERVE_ON_BREAKER_ENV, "demote"
-    )
+    mode = value if value is not None else knobs.get_str(SERVE_ON_BREAKER_ENV)
     if mode not in ("demote", "shed"):
         raise InvalidParameterError(
             f"invalid breaker response {mode!r}: expected 'demote' or 'shed'"
@@ -169,27 +154,27 @@ class TransformService:
         )
         self.queue_capacity = (
             int(queue_capacity) if queue_capacity is not None
-            else _env_int(SERVE_QUEUE_CAP_ENV, DEFAULT_QUEUE_CAP, 1)
+            else knobs.get_int(SERVE_QUEUE_CAP_ENV)
         )
         self.batch_max = (
             max(1, int(batch_max)) if batch_max is not None
-            else _env_int(SERVE_BATCH_MAX_ENV, DEFAULT_BATCH_MAX, 1)
+            else knobs.get_int(SERVE_BATCH_MAX_ENV)
         )
         quota = (
             float(tenant_quota) if tenant_quota is not None
-            else _env_float(SERVE_TENANT_QUOTA_ENV, DEFAULT_TENANT_QUOTA, 0.0)
+            else knobs.get_float(SERVE_TENANT_QUOTA_ENV)
         )
         self.default_timeout_s = (
             float(default_timeout_s) if default_timeout_s is not None
-            else _env_float(SERVE_TIMEOUT_ENV, 0.0, 0.0)
+            else knobs.get_float(SERVE_TIMEOUT_ENV)
         )
         self.retries = (
             max(0, int(retries)) if retries is not None
-            else _env_int(SERVE_RETRIES_ENV, DEFAULT_RETRIES, 0)
+            else knobs.get_int(SERVE_RETRIES_ENV)
         )
         self.backoff_s = (
             max(0.0, float(backoff_s)) if backoff_s is not None
-            else _env_float(SERVE_BACKOFF_ENV, DEFAULT_BACKOFF_S, 0.0)
+            else knobs.get_float(SERVE_BACKOFF_ENV)
         )
         self.on_breaker = resolve_on_breaker(on_breaker)
         # graph-scheduled dispatch (spfft_tpu.sched): one dispatch cycle pops
@@ -199,15 +184,15 @@ class TransformService:
         # programs/loadgen.py --sched A/Bs it)
         self.sched = (
             bool(sched) if sched is not None
-            else os.environ.get(SERVE_SCHED_ENV, "0") == "1"
+            else knobs.get_bool(SERVE_SCHED_ENV)
         )
         self.sched_batches = (
             max(1, int(sched_batches)) if sched_batches is not None
-            else _env_int(SERVE_SCHED_BATCHES_ENV, DEFAULT_SCHED_BATCHES, 1)
+            else knobs.get_int(SERVE_SCHED_BATCHES_ENV)
         )
         cache_cap = (
             int(plan_cache_size) if plan_cache_size is not None
-            else _env_int(SERVE_PLANS_ENV, DEFAULT_PLANS, 1)
+            else knobs.get_int(SERVE_PLANS_ENV)
         )
         self.queue = AdmissionQueue(self.queue_capacity, quota)
         self.queue.on_shed = lambda tenant: self._count("shed", tenant)

@@ -16,7 +16,6 @@ skipped entirely, so ``fence`` costs one tree traversal beyond
 """
 from __future__ import annotations
 
-import os
 import re
 import threading
 
@@ -24,7 +23,7 @@ import numpy as np
 
 import jax
 
-from . import obs
+from . import knobs, obs
 
 # Wall-clock budget for one completion fence (seconds; 0/unset = no deadline).
 # With a budget set, the wait runs in a worker thread and a wedged fence —
@@ -59,7 +58,7 @@ ADVISORY_VERSION_MARKERS = frozenset({"axon"})
 
 
 def _advisory_override():
-    v = os.environ.get("SPFFT_TPU_ADVISORY_FENCE")
+    v = knobs.get_str("SPFFT_TPU_ADVISORY_FENCE")
     if v in ("0", "1"):
         return v == "1"
     return None
@@ -155,7 +154,8 @@ def fence(tree):
                 try:
                     with obs.trace.with_run(run):
                         _wait_tree(tree)
-                except BaseException as e:  # re-raised in the caller thread
+                except BaseException as e:  # noqa: SA010 — re-raised in the
+                    # caller thread (cross-thread re-raise, nothing swallowed)
                     err.append(e)
                 finally:
                     done.set()
@@ -176,17 +176,10 @@ def fence(tree):
 
 
 def _fence_budget_s() -> float:
-    raw = os.environ.get(FENCE_BUDGET_ENV, "0") or "0"
-    try:
-        return float(raw)
-    except ValueError as e:
-        # loud-config rule (same as faults.parse_spec / verify.resolve_mode):
-        # a typo'd deadline must never silently disable the deadline
-        from .errors import InvalidParameterError
-
-        raise InvalidParameterError(
-            f"invalid {FENCE_BUDGET_ENV} value {raw!r}: expected seconds as a float"
-        ) from e
+    # loud-config rule (same as faults.parse_spec / verify.resolve_mode):
+    # a typo'd deadline must never silently disable the deadline — the
+    # registry resolver raises typed on a malformed value
+    return knobs.get_float(FENCE_BUDGET_ENV)
 
 
 def _wait_tree(tree) -> None:

@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import json as _json
 import time
+
+from .errors import InvalidParameterError
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -165,10 +167,14 @@ class Timer:
     def stop(self, label: str) -> None:
         stop_time = time.perf_counter()
         if len(self._stack) <= 1:
-            raise RuntimeError(f"Timer.stop({label!r}) without matching start")
+            # typed-error discipline (analysis SA010): scope misuse is a
+            # caller contract violation, surfaced as taxonomy
+            raise InvalidParameterError(
+                f"Timer.stop({label!r}) without matching start"
+            )
         node = self._stack[-1]
         if node.label != label:
-            raise RuntimeError(
+            raise InvalidParameterError(
                 f"Timer.stop({label!r}) does not match open scope {node.label!r}"
             )
         self._stack.pop()

@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import os
 
+from .. import knobs
 from .wisdom import (  # noqa: F401
     PERF_ENV_KNOBS,
     WISDOM_ENV,
@@ -77,16 +78,19 @@ def env_overrides(overrides: dict):
     if not overrides:
         yield
         return
-    saved = {k: os.environ.get(k) for k in overrides}
+    # The trial isolation scope is the package's ONE deliberate raw env
+    # path (noqa: SA014): it saves/restores ambient values VERBATIM — typed
+    # parsing here would destroy the "unset stays unset" round-trip.
+    saved = {k: os.environ.get(k) for k in overrides}  # noqa: SA014
     try:
         os.environ.update({k: str(v) for k, v in overrides.items()})
         yield
     finally:
         for k, old in saved.items():
             if old is None:
-                os.environ.pop(k, None)
+                os.environ.pop(k, None)  # noqa: SA014 — verbatim restore
             else:
-                os.environ[k] = old
+                os.environ[k] = old  # noqa: SA014 — verbatim restore
 
 
 def _record(provenance, *, hit, store, choice, trials, reason, key):
@@ -402,7 +406,7 @@ def wisdom_state(transform=None) -> dict:
     the given plan's decision provenance was (bench.py /
     programs/benchmark.py embed this so perf numbers are diffable against
     HOW the plan was decided)."""
-    path = os.environ.get(WISDOM_ENV)
+    path = knobs.get_str(WISDOM_ENV)
     state = {"path": path, "configured": path is not None}
     if transform is not None:
         state["policy"] = getattr(transform, "_policy", "default")

@@ -23,11 +23,10 @@ histogram, so a metrics snapshot shows exactly what tuning cost.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 
-from .. import faults, obs
+from .. import faults, knobs, obs
 from ..errors import GenericError
 from ..sync import FENCE_BUDGET_ENV, _fence_budget_s
 
@@ -70,9 +69,7 @@ class TrialDegradedError(RuntimeError):
 
 def trial_budget() -> tuple:
     """(warmup, repeats) per candidate from the env knobs (floors: 0, 1)."""
-    warmup = max(0, int(os.environ.get(TUNE_WARMUP_ENV, "1")))
-    repeats = max(1, int(os.environ.get(TUNE_REPEATS_ENV, "5")))
-    return warmup, repeats
+    return knobs.get_int(TUNE_WARMUP_ENV), knobs.get_int(TUNE_REPEATS_ENV)
 
 
 def trial_deadline_s() -> float:
@@ -110,7 +107,8 @@ def _run_deadlined(fn, budget_s: float, label: str):
             # with dumps of handled errors just because a deadline is set
             with obs.trace.with_run(run), obs.trace.suppressed_dumps():
                 result.append(fn())
-        except BaseException as e:  # re-raised in the caller thread
+        except BaseException as e:  # noqa: SA010 — re-raised in the caller
+            # thread (cross-thread re-raise, nothing swallowed)
             err.append(e)
         finally:
             done.set()
@@ -132,7 +130,7 @@ def trials_allowed(platform: str) -> bool:
     """Whether on-device trials may run for a plan on ``platform`` (see
     module docstring — CPU-only hosts skip to the model fallback unless
     ``SPFFT_TPU_TUNE_CPU=1``)."""
-    return platform != "cpu" or os.environ.get(TUNE_CPU_ENV, "0") == "1"
+    return platform != "cpu" or knobs.get_bool(TUNE_CPU_ENV)
 
 
 def _roundtrip(transform, staged):

@@ -39,7 +39,7 @@ import threading
 import time
 import warnings
 
-from .. import faults, obs
+from .. import faults, knobs, obs
 
 WISDOM_ENV = "SPFFT_TPU_WISDOM"
 WISDOM_SCHEMA = "spfft_tpu.tuning.wisdom/1"
@@ -82,7 +82,7 @@ def env_signature() -> dict:
     """The ambient values of :data:`PERF_ENV_KNOBS` (None = unset/default),
     embedded in every tuning key so knob changes invalidate instead of
     aliasing (kept inline, not hashed — small and debuggable)."""
-    return {k: os.environ.get(k) for k in PERF_ENV_KNOBS}
+    return {k: knobs.raw(k) for k in PERF_ENV_KNOBS}
 
 
 def sparsity_signature(*arrays) -> str:
@@ -479,7 +479,7 @@ class MemoryStore:
 def active_store():
     """The store tuned plans consult: the file store at ``SPFFT_TPU_WISDOM``
     when set, else the process-global memory store."""
-    path = os.environ.get(WISDOM_ENV)
+    path = knobs.get_str(WISDOM_ENV)
     return WisdomStore(path) if path else MemoryStore()
 
 

@@ -22,17 +22,16 @@ card's schema-pinned ``verification.breaker`` section.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 
-from .. import obs
+from .. import knobs, obs
 
 BREAKER_K_ENV = "SPFFT_TPU_VERIFY_BREAKER_K"
 BREAKER_COOLDOWN_ENV = "SPFFT_TPU_VERIFY_BREAKER_COOLDOWN_S"
 
-DEFAULT_K = 3
-DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_K = knobs.default(BREAKER_K_ENV)
+DEFAULT_COOLDOWN_S = knobs.default(BREAKER_COOLDOWN_ENV)
 
 _STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
 
@@ -42,12 +41,12 @@ _states: dict = {}  # engine -> {"state", "consecutive_failures", "opened_at", "
 
 def threshold() -> int:
     """Consecutive verified failures that trip the breaker (floor 1)."""
-    return max(1, int(os.environ.get(BREAKER_K_ENV, str(DEFAULT_K))))
+    return knobs.get_int(BREAKER_K_ENV)
 
 
 def cooldown_s() -> float:
     """Open -> half-open probe delay in seconds (0 probes immediately)."""
-    return max(0.0, float(os.environ.get(BREAKER_COOLDOWN_ENV, str(DEFAULT_COOLDOWN_S))))
+    return knobs.get_float(BREAKER_COOLDOWN_ENV)
 
 
 def _entry(engine: str) -> dict:

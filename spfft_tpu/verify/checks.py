@@ -42,11 +42,9 @@ itself is chaos-testable.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
-from .. import faults, obs
+from .. import faults, knobs, obs
 from ..errors import InvalidParameterError
 
 VERIFY_ENV = "SPFFT_TPU_VERIFY"
@@ -73,7 +71,7 @@ def resolve_mode(explicit=None) -> str:
     off), else the ``SPFFT_TPU_VERIFY`` env knob with the same values. An
     unrecognized value raises :class:`InvalidParameterError` naming it — a
     verification request must never be silently dropped."""
-    value = os.environ.get(VERIFY_ENV, "0") if explicit is None else explicit
+    value = knobs.get_str(VERIFY_ENV) if explicit is None else explicit
     if value in (False, None, "0", "off", ""):
         return "off"
     if value in (True, "1", "on"):
@@ -93,14 +91,8 @@ def resolve_rtol(real_dtype) -> float:
     ``jax_enable_x64`` is off actually executes in f32 (JAX silently
     truncates), so it gets the f32 tolerance — a correct-but-f32 result must
     not be condemned as corruption."""
-    env = os.environ.get(VERIFY_RTOL_ENV)
-    if env:
-        try:
-            rtol = float(env)
-        except ValueError as e:
-            raise InvalidParameterError(
-                f"invalid {VERIFY_RTOL_ENV} value {env!r}: expected a float"
-            ) from e
+    rtol = knobs.get_float(VERIFY_RTOL_ENV)
+    if rtol is not None:
         if rtol <= 0:
             raise InvalidParameterError(
                 f"{VERIFY_RTOL_ENV} must be positive, got {rtol}"
@@ -131,7 +123,7 @@ def _probe_rng(dims, num_values, direction: str):
     """Deterministic probe-site stream: seeded by ``SPFFT_TPU_VERIFY_SEED``
     plus the plan geometry and direction, so one plan's probe site is stable
     across calls and a failure replays exactly."""
-    seed = int(os.environ.get(VERIFY_SEED_ENV, "0") or "0")
+    seed = knobs.get_int(VERIFY_SEED_ENV)
     return np.random.default_rng(
         [seed, *(int(d) for d in dims), int(num_values), direction == "forward"]
     )

@@ -37,13 +37,12 @@ process — verified calls skip the primary attempt entirely (a
 """
 from __future__ import annotations
 
-import os
 import random
 import time
 
 import numpy as np
 
-from .. import faults, obs
+from .. import faults, knobs, obs
 from ..errors import (
     FFTWError,
     GPUFFTError,
@@ -57,8 +56,8 @@ VERIFY_RETRIES_ENV = "SPFFT_TPU_VERIFY_RETRIES"
 VERIFY_BACKOFF_ENV = "SPFFT_TPU_VERIFY_BACKOFF_S"
 VERIFY_JITTER_SEED_ENV = "SPFFT_TPU_VERIFY_JITTER_SEED"
 
-DEFAULT_RETRIES = 2
-DEFAULT_BACKOFF_S = 0.01
+DEFAULT_RETRIES = knobs.default(VERIFY_RETRIES_ENV)
+DEFAULT_BACKOFF_S = knobs.default(VERIFY_BACKOFF_ENV)
 
 # Execution-level typed failures the retry rung may absorb: the dual error
 # surface's dispatch/fence conversions plus the distributed collective layer.
@@ -77,12 +76,12 @@ CHECKER_ERRORS = (RuntimeError,)
 def resolve_retries() -> int:
     """Re-executions after the first attempt (``SPFFT_TPU_VERIFY_RETRIES``,
     floor 0)."""
-    return max(0, int(os.environ.get(VERIFY_RETRIES_ENV, str(DEFAULT_RETRIES))))
+    return knobs.get_int(VERIFY_RETRIES_ENV)
 
 
 def resolve_backoff_s() -> float:
     """Base of the exponential retry backoff (``SPFFT_TPU_VERIFY_BACKOFF_S``)."""
-    return max(0.0, float(os.environ.get(VERIFY_BACKOFF_ENV, str(DEFAULT_BACKOFF_S))))
+    return knobs.get_float(VERIFY_BACKOFF_ENV)
 
 
 def jitter_rng() -> random.Random:
@@ -91,8 +90,8 @@ def jitter_rng() -> random.Random:
     same failed engine must not thundering-herd it on a synchronized
     schedule. Seeded from ``SPFFT_TPU_VERIFY_JITTER_SEED`` when set (a chaos
     run's sleep sequence replays exactly), system entropy otherwise."""
-    seed = os.environ.get(VERIFY_JITTER_SEED_ENV)
-    return random.Random(int(seed)) if seed not in (None, "") else random.Random()
+    seed = knobs.get_int(VERIFY_JITTER_SEED_ENV)
+    return random.Random(seed) if seed is not None else random.Random()
 
 
 class Supervisor:

@@ -1,0 +1,712 @@
+"""The pluggable static-analysis engine (``spfft_tpu/analysis``).
+
+Covers the acceptance surface of the analysis framework:
+
+* every checker (SA001-SA014) trips on an in-memory positive fixture and
+  stays silent on its clean negative twin,
+* framework semantics: ``# noqa`` suppression, ``--only`` selection by code
+  and by name, loud missing-anchor findings on rooted trees,
+* the ``spfft_tpu.analysis/1`` report schema and its validator,
+* the baseline round trip through the real CLI: write -> green -> doctored
+  finding exits 3 -> fixed finding leaves a stale entry that also exits 3,
+* the real tree runs green (zero non-baselined findings) through both
+  ``programs/analyze.py`` and the ``programs/lint.py`` shim,
+* the import-discipline contract: the standalone load pulls neither
+  ``spfft_tpu`` nor ``jax``.
+
+Fixtures are in-memory ``{relpath: source}`` trees (``Tree(files=...)``);
+anchored checkers get minimal anchor files so the contract under test is
+the checker's rule, not its anchor plumbing.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "programs"))
+
+from analyze import load_analysis  # noqa: E402
+
+analysis = load_analysis()
+
+# Fixture knob names are assembled at runtime: SA003 scans THIS file's
+# source lines for SPFFT_TPU_* strings near environ/getenv reads, and the
+# made-up fixture knobs must not register as unregistered-knob findings.
+PFX = "SPFFT_TPU" + "_"
+
+
+def run_checker(files: dict, code: str):
+    return analysis.run(analysis.Tree(files=files), only=[code])
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# =============================================================================
+# checkers 1-2: import hygiene
+# =============================================================================
+
+
+def test_sa001_duplicate_import():
+    pos = {"spfft_tpu/m.py": "import os\nimport os\nos.getcwd()\n"}
+    neg = {"spfft_tpu/m.py": "import os\nos.getcwd()\n"}
+    assert codes(run_checker(pos, "SA001")) == ["SA001"]
+    assert not run_checker(neg, "SA001")
+
+
+def test_sa002_unused_import():
+    pos = {"spfft_tpu/m.py": "import os\n\nX = 1\n"}
+    neg = {"spfft_tpu/m.py": "import os\n\nX = os.getcwd()\n"}
+    noqa = {"spfft_tpu/m.py": "import os  # noqa: F401\n\nX = 1\n"}
+    assert codes(run_checker(pos, "SA002")) == ["SA002"]
+    assert not run_checker(neg, "SA002")
+    assert not run_checker(noqa, "SA002")
+
+
+# =============================================================================
+# checkers 3-9: both-ways vocabulary contracts (minimal anchors)
+# =============================================================================
+
+KNOBS_FIXTURE = (
+    'def register(name, kind, default, doc=None, **kw):\n'
+    '    return name\n\n'
+    'register("SPFFT_TPU_GOOD", "int", 1, "a knob")\n'
+)
+
+
+def test_sa003_env_knob_registry():
+    pos = {
+        "spfft_tpu/knobs.py": KNOBS_FIXTURE,
+        "spfft_tpu/m.py": '# reads SPFFT_TPU_GOOD and SPFFT_TPU_ROGUE\n',
+    }
+    neg = {
+        "spfft_tpu/knobs.py": KNOBS_FIXTURE,
+        "spfft_tpu/m.py": '# reads SPFFT_TPU_GOOD\n',
+    }
+    found = run_checker(pos, "SA003")
+    assert codes(found) == ["SA003"] and "SPFFT_TPU_ROGUE" in found[0].message
+    assert not run_checker(neg, "SA003")
+
+
+STAGES_FIXTURE = 'STAGES = ("z transform",)\n'
+
+
+def test_sa004_stage_scope():
+    def engine(label):
+        return (
+            "import jax\n\n"
+            "def go(x):\n"
+            f'    with jax.named_scope("{label}"):\n'
+            "        return x\n"
+        )
+
+    pos = {
+        "spfft_tpu/obs/stages.py": STAGES_FIXTURE,
+        # keep the canonical stage present as a string so the coverage
+        # direction stays green; the rogue label is the defect under test
+        "spfft_tpu/execution.py": engine("bogus stage") + 'S = "z transform"\n',
+    }
+    neg = {
+        "spfft_tpu/obs/stages.py": STAGES_FIXTURE,
+        "spfft_tpu/execution.py": engine("z transform"),
+    }
+    found = run_checker(pos, "SA004")
+    assert codes(found) == ["SA004"] and "bogus stage" in found[0].message
+    assert not run_checker(neg, "SA004")
+
+
+def test_sa005_fault_site():
+    plane = 'SITES = ("a.site",)\n'
+    pos = {
+        "spfft_tpu/faults/plane.py": plane,
+        "spfft_tpu/m.py": 'faults.site("a.site")\nfaults.site("rogue")\n',
+    }
+    neg = {
+        "spfft_tpu/faults/plane.py": plane,
+        "spfft_tpu/m.py": 'faults.site("a.site")\n',
+    }
+    unthreaded = {
+        "spfft_tpu/faults/plane.py": plane,
+        "spfft_tpu/m.py": "X = 1\n",
+    }
+    found = run_checker(pos, "SA005")
+    assert codes(found) == ["SA005"] and "rogue" in found[0].message
+    assert not run_checker(neg, "SA005")
+    # the other direction: a registered site threaded through no code path
+    found = run_checker(unthreaded, "SA005")
+    assert codes(found) == ["SA005"] and "a.site" in found[0].message
+
+
+def test_sa006_trace_event():
+    tr = 'EVENTS = ("ev",)\n'
+    pos = {
+        "spfft_tpu/obs/trace.py": tr,
+        "spfft_tpu/m.py": 'trace.event("ev")\ntrace.event("rogue")\n',
+    }
+    neg = {
+        "spfft_tpu/obs/trace.py": tr,
+        "spfft_tpu/m.py": 'trace.event("ev")\n',
+    }
+    found = run_checker(pos, "SA006")
+    assert codes(found) == ["SA006"] and "rogue" in found[0].message
+    assert not run_checker(neg, "SA006")
+
+
+def test_sa007_verify_check():
+    pos = {
+        "spfft_tpu/verify/checks.py": (
+            'CHECKS = ("c1", "c2")\n'
+            "def f():\n    pass\n\n"
+            'CHECK_FNS = {"c1": f}\n'
+        ),
+    }
+    neg = {
+        "spfft_tpu/verify/checks.py": (
+            'CHECKS = ("c1",)\n'
+            "def f():\n    pass\n\n"
+            'CHECK_FNS = {"c1": f}\n'
+        ),
+    }
+    found = run_checker(pos, "SA007")
+    assert codes(found) == ["SA007"] and "c2" in found[0].message
+    assert not run_checker(neg, "SA007")
+
+
+def test_sa008_perf_stage():
+    base = {
+        "spfft_tpu/obs/stages.py": STAGES_FIXTURE,
+        "spfft_tpu/execution.py": 'S = "z transform"\n',
+    }
+    pos = dict(base)
+    pos["spfft_tpu/obs/perf.py"] = 'MODELED_STAGES = ("z transform", "ghost")\n'
+    neg = dict(base)
+    neg["spfft_tpu/obs/perf.py"] = 'MODELED_STAGES = ("z transform",)\n'
+    found = run_checker(pos, "SA008")
+    assert codes(found) == ["SA008"] and "ghost" in found[0].message
+    assert not run_checker(neg, "SA008")
+
+
+def test_sa009_ir_node():
+    base = {
+        "spfft_tpu/obs/stages.py": STAGES_FIXTURE,
+        "spfft_tpu/obs/perf.py": 'MODELED_STAGES = ("z transform",)\n',
+    }
+    pos = dict(base)
+    pos["spfft_tpu/ir/graph.py"] = 'NODES = ("z transform", "ghost")\n'
+    neg = dict(base)
+    neg["spfft_tpu/ir/graph.py"] = 'NODES = ("z transform",)\n'
+    found = run_checker(pos, "SA009")
+    assert found and all(c == "SA009" for c in codes(found))
+    assert any("ghost" in f.message for f in found)
+    assert not run_checker(neg, "SA009")
+
+
+# =============================================================================
+# checker 10: typed-error discipline
+# =============================================================================
+
+ERRORS_FIXTURE = (
+    "class GenericError(Exception):\n    pass\n\n"
+    "class MyError(GenericError):\n    pass\n"
+)
+
+
+def test_sa010_raise_discipline():
+    pos = {
+        "spfft_tpu/errors.py": ERRORS_FIXTURE,
+        "spfft_tpu/m.py": 'def f():\n    raise ValueError("untyped")\n',
+    }
+    neg = {
+        "spfft_tpu/errors.py": ERRORS_FIXTURE,
+        "spfft_tpu/m.py": (
+            "from .errors import MyError\n\n"
+            "def f():\n"
+            '    raise MyError("typed")\n'
+        ),
+    }
+    found = run_checker(pos, "SA010")
+    assert codes(found) == ["SA010"] and "ValueError" in found[0].message
+    assert not run_checker(neg, "SA010")
+
+
+def test_sa010_broad_except():
+    swallow = {
+        "spfft_tpu/errors.py": ERRORS_FIXTURE,
+        "spfft_tpu/m.py": (
+            "def f():\n"
+            "    try:\n        pass\n"
+            "    except Exception:\n        pass\n"
+        ),
+    }
+    counted = {
+        "spfft_tpu/errors.py": ERRORS_FIXTURE,
+        "spfft_tpu/m.py": (
+            "from .errors import MyError\n\n"
+            "class S:\n"
+            "    def f(self):\n"
+            "        try:\n            pass\n"
+            "        except Exception as e:\n"
+            "            self.counter.inc()\n"
+            '            raise MyError(str(e))\n'
+        ),
+    }
+    cleanup = {
+        "spfft_tpu/errors.py": ERRORS_FIXTURE,
+        "spfft_tpu/m.py": (
+            "def f():\n"
+            "    try:\n        pass\n"
+            "    except BaseException:\n"
+            "        release()\n"
+            "        raise\n"
+        ),
+    }
+    assert codes(run_checker(swallow, "SA010")) == ["SA010"]
+    assert not run_checker(counted, "SA010")
+    assert not run_checker(cleanup, "SA010")  # bare re-raise: nothing swallowed
+
+
+# =============================================================================
+# checker 11: lock-order analysis
+# =============================================================================
+
+LOCKS_HEADER = "import threading\nimport time\n\nA = threading.Lock()\nB = threading.Lock()\n"
+
+
+def test_sa011_cycle_and_blocking():
+    cycle = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def one():\n    with A:\n        with B:\n            pass\n\n"
+            "def two():\n    with B:\n        with A:\n            pass\n"
+        ),
+    }
+    sleepy = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def slow():\n    with A:\n        time.sleep(1)\n"
+        ),
+    }
+    self_deadlock = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def again():\n    with A:\n        with A:\n            pass\n"
+        ),
+    }
+    ordered = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def one():\n    with A:\n        with B:\n            pass\n\n"
+            "def two():\n    with A:\n        with B:\n            pass\n\n"
+            "def fine():\n    time.sleep(0)\n    with A:\n        pass\n"
+        ),
+    }
+    cond_wait = {
+        "spfft_tpu/m.py": (
+            "import threading\n\ncv = threading.Condition()\n\n"
+            "def waiter():\n    with cv:\n        cv.wait()\n"
+        ),
+    }
+    found = run_checker(cycle, "SA011")
+    assert codes(found) == ["SA011"] and "cycle" in found[0].message
+    found = run_checker(sleepy, "SA011")
+    assert codes(found) == ["SA011"] and "time.sleep" in found[0].message
+    found = run_checker(self_deadlock, "SA011")
+    assert codes(found) == ["SA011"] and "re-acquired" in found[0].message
+    assert not run_checker(ordered, "SA011")
+    # Condition.wait on the HELD condition releases it: exempt
+    assert not run_checker(cond_wait, "SA011")
+
+
+def test_sa011_transitive_effects():
+    files = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def inner():\n    with B:\n        pass\n\n"
+            "def outer():\n    with A:\n        inner()\n\n"
+            "def reverse():\n    with B:\n        with A:\n            pass\n"
+        ),
+    }
+    found = run_checker(files, "SA011")
+    assert codes(found) == ["SA011"] and "cycle" in found[0].message
+
+
+# =============================================================================
+# checker 12: donation safety
+# =============================================================================
+
+SPEC_FIXTURE = (
+    "class E:\n"
+    "    def _ir_spec(self):\n"
+    '        return {"kind": "local", "donate": (0, 1)}\n'
+)
+
+COMPILE_OK = (
+    "import jax\n\n"
+    "def build_fused(graph, spec):\n"
+    '    donate = spec.get("donate")\n'
+    "    return jax.jit(graph, donate_argnums=tuple(donate))\n\n"
+    "class EngineIr:\n"
+    "    def describe(self):\n"
+    '        donated = list(self.spec["donate"])\n'
+    '        return {"donation": donated}\n'
+)
+
+
+def _lower_fixture(second_node_inputs, outputs):
+    return (
+        "from .graph import StageGraph\n\n"
+        "def _lower_local_x(e):\n"
+        "    def backward():\n"
+        '        g = StageGraph("backward")\n'
+        '        g.add_input("values_re")\n'
+        '        g.add_input("values_im")\n'
+        '        g.add("compression", e._st_d, ("values_re", "values_im"), ("sticks",))\n'
+        f'        g.add("z transform", e._st_z, {second_node_inputs}, ("z",))\n'
+        f"        g.set_outputs({outputs})\n"
+        "        return g\n"
+        "    return backward()\n"
+    )
+
+
+def test_sa012_use_after_donate():
+    pos = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _lower_fixture('("sticks", "values_re")', '["z"]'),
+        "spfft_tpu/ir/compile.py": COMPILE_OK,
+    }
+    escapes = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _lower_fixture('("sticks",)', '["z", "values_im"]'),
+        "spfft_tpu/ir/compile.py": COMPILE_OK,
+    }
+    neg = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _lower_fixture('("sticks",)', '["z"]'),
+        "spfft_tpu/ir/compile.py": COMPILE_OK,
+    }
+    found = run_checker(pos, "SA012")
+    assert codes(found) == ["SA012"]
+    assert "referenced after its consuming node" in found[0].message
+    found = run_checker(escapes, "SA012")
+    assert codes(found) == ["SA012"] and "escapes" in found[0].message
+    assert not run_checker(neg, "SA012")
+
+
+def test_sa012_card_donation_map_mismatch():
+    bad_compile = COMPILE_OK.replace(
+        'donated = list(self.spec["donate"])',
+        'donated = list(self.spec["wrongkey"])',
+    )
+    files = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _lower_fixture('("sticks",)', '["z"]'),
+        "spfft_tpu/ir/compile.py": bad_compile,
+    }
+    found = run_checker(files, "SA012")
+    assert codes(found) == ["SA012"] and "wrongkey" in found[0].message
+
+
+def test_sa012_donation_never_applied():
+    no_donate = (
+        "import jax\n\n"
+        "def build_fused(graph, spec):\n"
+        "    return jax.jit(graph)\n"
+    )
+    files = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _lower_fixture('("sticks",)', '["z"]'),
+        "spfft_tpu/ir/compile.py": no_donate,
+    }
+    found = run_checker(files, "SA012")
+    assert codes(found) == ["SA012"] and "never applied" in found[0].message
+
+
+# =============================================================================
+# checker 13: jit purity
+# =============================================================================
+
+
+def test_sa013_stage_body_impurity():
+    pos = {
+        "spfft_tpu/m.py": (
+            "import time\n\n"
+            "def _st_bad(x):\n"
+            "    t = time.perf_counter()\n"
+            "    return x + t\n"
+        ),
+    }
+    neg = {
+        "spfft_tpu/m.py": "def _st_good(x):\n    return x + 1\n",
+    }
+    found = run_checker(pos, "SA013")
+    assert codes(found) == ["SA013"] and "time.perf_counter" in found[0].message
+    assert not run_checker(neg, "SA013")
+
+
+def test_sa013_jitted_function_impurity():
+    pos = {
+        "spfft_tpu/m.py": (
+            "import jax\nimport os\n\n"
+            "def body(x):\n"
+            f'    flag = os.environ.get("{PFX}X")\n'
+            "    return x\n\n"
+            "f = jax.jit(body)\n"
+        ),
+    }
+    neg = {
+        "spfft_tpu/m.py": (
+            "import jax\nimport os\n\n"
+            "def host():\n"
+            f'    flag = os.environ.get("{PFX}X")\n'
+            "    return flag\n\n"
+            "def body(x):\n    return x\n\n"
+            "f = jax.jit(body)\n"
+        ),
+    }
+    found = run_checker(pos, "SA013")
+    assert codes(found) == ["SA013"] and "os.environ" in found[0].message
+    assert not run_checker(neg, "SA013")  # host-side reads are fine
+
+
+def test_sa013_metric_and_trace_in_trace():
+    files = {
+        "spfft_tpu/m.py": (
+            "def _st_bad(x):\n"
+            '    obs.counter("n").inc()\n'
+            '    trace.event("go")\n'
+            "    return x\n"
+        ),
+    }
+    found = run_checker(files, "SA013")
+    msgs = " ".join(f.message for f in found)
+    assert ".inc()" in msgs and "trace.event" in msgs
+
+
+# =============================================================================
+# checker 14: knob-registry read path
+# =============================================================================
+
+
+def test_sa014_raw_knob_reads():
+    pos = {
+        "spfft_tpu/m.py": (
+            "import os\n\n"
+            f'a = os.environ.get("{PFX}FOO")\n'
+            f'b = os.environ["{PFX}BAR"]\n'
+            f'c = os.getenv("{PFX}BAZ")\n'
+        ),
+    }
+    neg = {
+        "spfft_tpu/m.py": (
+            "import os\n\n"
+            'flags = os.environ.get("XLA_FLAGS", "")\n'
+        ),
+    }
+    dynamic = {
+        "spfft_tpu/m.py": (
+            "import os\n\n"
+            "def snap(keys):\n"
+            "    return {k: os.environ.get(k) for k in keys}\n"
+        ),
+    }
+    noqa = {
+        "spfft_tpu/m.py": (
+            "import os\n\n"
+            "def snap(keys):\n"
+            "    return {k: os.environ.get(k) for k in keys}  # noqa: SA014\n"
+        ),
+    }
+    assert codes(run_checker(pos, "SA014")) == ["SA014"] * 3
+    assert not run_checker(neg, "SA014")  # foreign vocabulary: allowed
+    assert codes(run_checker(dynamic, "SA014")) == ["SA014"]  # conservative
+    assert not run_checker(noqa, "SA014")  # documented deliberate raw path
+    # knobs.py itself is the allowed read path
+    in_registry = {
+        "spfft_tpu/knobs.py": f'import os\nv = os.environ.get("{PFX}X")\n'
+    }
+    assert not run_checker(in_registry, "SA014")
+
+
+# =============================================================================
+# framework semantics
+# =============================================================================
+
+
+def test_noqa_suppression_codes():
+    bare = {"spfft_tpu/m.py": "import os\nimport os  # noqa\nos.getcwd()\n"}
+    right = {"spfft_tpu/m.py": "import os\nimport os  # noqa: SA001\nos.getcwd()\n"}
+    assert not run_checker(bare, "SA001")
+    assert not run_checker(right, "SA001")
+
+
+def test_only_selection_and_unknown():
+    files = {"spfft_tpu/m.py": "import os\n\nX = 1\n"}  # SA002 positive
+    by_code = analysis.run(analysis.Tree(files=files), only=["SA002"])
+    by_name = analysis.run(analysis.Tree(files=files), only=["unused-import"])
+    assert codes(by_code) == codes(by_name) == ["SA002"]
+    with pytest.raises(analysis.AnalysisError):
+        analysis.run(analysis.Tree(files=files), only=["SA999"])
+
+
+def test_missing_anchor_is_loud_on_rooted_tree(tmp_path):
+    (tmp_path / "spfft_tpu").mkdir()
+    (tmp_path / "spfft_tpu" / "m.py").write_text("X = 1\n")
+    tree = analysis.Tree(root=tmp_path)
+    found = analysis.run(tree, only=["SA005"])
+    assert codes(found) == ["SA005"]
+    assert "anchor file is missing" in found[0].message
+    # the same absent anchor on a PARTIAL tree skips silently
+    assert not run_checker({"spfft_tpu/m.py": "X = 1\n"}, "SA005")
+
+
+def test_checker_registry_is_complete():
+    assert [c.code for c in analysis.CHECKERS.values()] == [
+        f"SA0{i:02d}" for i in range(1, 15)
+    ]
+    for entry in analysis.CHECKERS.values():
+        assert entry.doc and entry.severity == "error"
+
+
+def test_report_schema_and_validator():
+    files = {"spfft_tpu/m.py": "import os\n\nX = 1\n"}
+    tree = analysis.Tree(files=files)
+    findings = analysis.run(tree)
+    split = analysis.apply_baseline(findings, set())
+    doc = analysis.report_doc(
+        findings, split, root="mem", baseline_path="analysis_baseline.json"
+    )
+    assert doc["schema"] == "spfft_tpu.analysis/1"
+    assert not analysis.validate_report(doc)
+    assert doc["counts"]["new"] == len(findings) > 0
+    json.dumps(doc)  # JSON-plain
+    broken = dict(doc)
+    del broken["counts"]
+    broken["findings"] = [{"code": "SA002"}]
+    missing = analysis.validate_report(broken)
+    assert "counts.total" in missing and "findings[0].file" in missing
+
+
+def test_apply_baseline_split_and_staleness():
+    files = {"spfft_tpu/m.py": "import os\n\nX = 1\n"}
+    findings = analysis.run(analysis.Tree(files=files))
+    accepted = {findings[0].key(), "SA010:spfft_tpu/gone.py:fixed finding"}
+    split = analysis.apply_baseline(findings, accepted)
+    assert not split["new"]
+    assert [f.key() for f in split["baselined"]] == [findings[0].key()]
+    assert split["stale"] == ["SA010:spfft_tpu/gone.py:fixed finding"]
+
+
+# =============================================================================
+# the CLI: baseline round trip, real tree, shim
+# =============================================================================
+
+
+def _analyze(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "programs" / "analyze.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+    )
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "spfft_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text('def f():\n    raise ValueError("x")\n')
+
+    # 1. write the baseline accepting the current findings
+    r = _analyze("--root", str(tmp_path), "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    baseline = json.loads((tmp_path / "analysis_baseline.json").read_text())
+    assert baseline["schema"] == "spfft_tpu.analysis.baseline/1"
+    assert any(e.startswith("SA010:spfft_tpu/bad.py") for e in baseline["entries"])
+
+    # 2. re-run: green (every finding baselined)
+    r = _analyze("--root", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # 3. doctor a NEW finding: exit 3, reported as new in the JSON
+    (pkg / "bad.py").write_text(
+        'def f():\n    raise ValueError("x")\n\n'
+        'def g():\n    raise TypeError("y")\n'
+    )
+    r = _analyze("--root", str(tmp_path), "--json", str(tmp_path / "r.json"))
+    assert r.returncode == 3, r.stdout + r.stderr
+    doc = json.loads((tmp_path / "r.json").read_text())
+    new = [f for f in doc["findings"] if not f["baselined"]]
+    assert len(new) == 1 and "TypeError" in new[0]["message"]
+
+    # 4. fix the original finding instead: its baseline entry is now STALE
+    #    and the gate trips again — a fixed finding must leave the baseline
+    (pkg / "bad.py").write_text("def f():\n    return 1\n")
+    r = _analyze("--root", str(tmp_path))
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "stale baseline entry" in r.stdout
+
+    # 5. regenerating the baseline restores green
+    r = _analyze("--root", str(tmp_path), "--write-baseline")
+    assert r.returncode == 0
+    r = _analyze("--root", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_subset_write_baseline_preserves_other_checkers(tmp_path):
+    """--only X --write-baseline (the lint shim's shape) must replace only
+    checker X's entries — another checker's accepted findings survive."""
+    pkg = tmp_path / "spfft_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text('def f():\n    raise ValueError("x")\n')
+    foreign = "SA011:spfft_tpu/locks.py:a lock-order finding accepted earlier"
+    (tmp_path / "analysis_baseline.json").write_text(
+        json.dumps(
+            {
+                "schema": "spfft_tpu.analysis.baseline/1",
+                "generated_by": "test",
+                "entries": [foreign],
+            }
+        )
+    )
+    r = _analyze("--root", str(tmp_path), "--only", "SA010", "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    baseline = json.loads((tmp_path / "analysis_baseline.json").read_text())
+    assert foreign in baseline["entries"], baseline["entries"]
+    assert any(e.startswith("SA010:spfft_tpu/bad.py") for e in baseline["entries"])
+
+
+def test_real_tree_is_green():
+    r = _analyze("--json", "-")
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    doc = json.loads(r.stdout)
+    assert not analysis.validate_report(doc)
+    assert len(doc["checkers"]) == 14
+    assert doc["counts"]["new"] == 0 and doc["counts"]["stale_baseline"] == 0
+
+
+def test_lint_shim_runs_ported_checkers():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "programs" / "lint.py")],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "9 checker(s)" in r.stdout
+
+
+def test_standalone_load_pulls_no_jax():
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {str(ROOT / 'programs')!r})\n"
+        "from analyze import load_analysis\n"
+        "a = load_analysis()\n"
+        "assert len(a.CHECKERS) == 14\n"
+        "assert 'jax' not in sys.modules, 'analysis load pulled jax'\n"
+        "assert 'spfft_tpu' not in sys.modules, 'analysis load pulled spfft_tpu'\n"
+        "print('standalone ok')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, cwd=ROOT
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

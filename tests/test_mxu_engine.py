@@ -441,14 +441,16 @@ def test_sparse_y_blocked_r2c(monkeypatch):
 
 def test_sparse_y_blocks_knob_validation(monkeypatch):
     """SPFFT_TPU_SPARSE_Y_BLOCKS is validated like SPFFT_TPU_SPARSE_Y:
-    'auto'/'0'/positive int, descriptive ValueError otherwise (advisor r4)."""
+    'auto'/'0'/positive int, descriptive typed InvalidParameterError
+    otherwise (advisor r4; typed-error discipline SA010)."""
+    from spfft_tpu.errors import InvalidParameterError
     from spfft_tpu.ops import fft as offt
 
     xslot = np.asarray([0, 0, 1])
     ys = np.asarray([0, 1, 0])
     for bad in ("banana", "-3", "1.5"):
         monkeypatch.setenv("SPFFT_TPU_SPARSE_Y_BLOCKS", bad)
-        with pytest.raises(ValueError, match="SPFFT_TPU_SPARSE_Y_BLOCKS"):
+        with pytest.raises(InvalidParameterError, match="SPFFT_TPU_SPARSE_Y_BLOCKS"):
             offt.plan_sparse_y_blocked(xslot, ys, 8, np.float32, 3, 16)
 
 

@@ -13,6 +13,7 @@ import pytest
 
 import spfft_tpu as sp
 from spfft_tpu import timing
+from spfft_tpu.errors import InvalidParameterError
 from spfft_tpu.timing import Timer
 
 
@@ -73,10 +74,10 @@ def test_parent_percentage():
 def test_mismatched_stop_raises():
     t = Timer()
     t.start("a")
-    with pytest.raises(RuntimeError):
+    with pytest.raises(InvalidParameterError):
         t.stop("b")
     t.stop("a")
-    with pytest.raises(RuntimeError):
+    with pytest.raises(InvalidParameterError):
         t.stop("a")
 
 
