@@ -582,8 +582,11 @@ run_ir() {
   # The IR suite: graph validation, fused-vs-staged parity fuzz across
   # {C2C,R2C} x {f32,f64} x {local,slab,pencil} x overlap {1,4}, the
   # single-dispatch proof, card provenance, and the ir.lower/ir.compile
-  # degradation rungs.
+  # degradation rungs — plus the batch-fused suite (batched-vs-looped
+  # parity, the one-dispatch-per-batch proof, the ir.batch rung, the
+  # tuner-owned batch axis).
   JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_ir.py -q
+  JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_batch.py -q
   local idir
   idir="$(mktemp -d)"
   # Dispatch-path A/B (programs/fbench.py): the fused single program per
@@ -591,14 +594,14 @@ run_ir() {
   # the whole point of the fusion pass (at small dims the staged path pays
   # ~10 dispatches + materialized intermediates per direction).
   JAX_PLATFORMS=cpu timeout 540 python programs/fbench.py --dim 24 \
-    --radius 0.9 --pairs 8 --repeats 5 -o "$idir/fbench.json"
+    --radius 0.9 --pairs 8 --repeats 7 -o "$idir/fbench.json"
   JAX_PLATFORMS=cpu python - "$idir" <<'EOF'
 import json, sys
 
 d = sys.argv[1]
 doc = json.load(open(f"{d}/fbench.json"))
 rows = {r["key"].rsplit(":", 1)[-1]: r for r in doc["rows"]}
-assert set(rows) == {"fused", "staged"}, sorted(rows)
+assert set(rows) == {"fused", "staged", "b1", "b4", "b8"}, sorted(rows)
 assert rows["fused"]["ir"]["path"] == "fused", rows["fused"]["ir"]
 assert rows["staged"]["ir"]["path"] == "staged", rows["staged"]["ir"]
 assert rows["fused"]["ir"]["donation"]["backward"], "fused backward must donate"
@@ -606,13 +609,24 @@ for r in doc["rows"]:
     assert r["run_id"] and r["gflops"] > 0, r["key"]
 ratio = doc["fused_over_staged"]
 assert ratio > 1.0, f"fused not strictly above staged: x{ratio:.3f}"
-print(f"fbench ok (fused x{ratio:.2f} over staged)")
+# the batched row family: one stacked program dispatch per batch must beat
+# per-transform dispatch STRICTLY on per-transform throughput (the whole
+# point of the batch axis), with the provenance section live on the card
+for b in ("b1", "b4", "b8"):
+    assert rows[b]["batch_provenance"]["enabled"] is True, rows[b]
+    assert not rows[b]["batch_provenance"]["failed"], rows[b]
+b_ratio = (
+    rows["b1"]["seconds_per_transform"] / rows["b4"]["seconds_per_transform"]
+)
+assert b_ratio > 1.0, f"batch=4 not strictly above batch=1: x{b_ratio:.3f}"
+print(f"fbench ok (fused x{ratio:.2f} over staged, "
+      f"batch4 x{b_ratio:.2f} over batch1)")
 EOF
   # Regression gate: the committed baseline carries an fbench row family
   # (bench_results/perf_baseline_cpu8.json) — match on the fbench keys ...
   python programs/perf_gate.py "$idir/fbench.json" \
     bench_results/perf_baseline_cpu8.json --tolerance 0.85 \
-    --require-matches 1 > /dev/null
+    --require-matches 3 > /dev/null
   # ... a run gates green against itself ...
   python programs/perf_gate.py "$idir/fbench.json" "$idir/fbench.json" > /dev/null
   # ... and must trip (exit 3) against a doctored baseline claiming 10x.

@@ -68,6 +68,51 @@ def measure_dispatch_pair(t, *, pairs: int, repeats: int, warmup: int) -> dict:
     }
 
 
+def measure_batch_dispatch(
+    t, *, batch: int, pairs: int, repeats: int, warmup: int
+) -> dict:
+    """Best-of-``repeats`` seconds per TRANSFORM through the batch-fused
+    dispatch path: each timed iteration is ONE stacked backward+forward
+    program dispatch computing ``batch`` transforms (wall / (pairs * batch)
+    is the comparable per-transform unit the batched row family gates
+    on)."""
+    from spfft_tpu.sync import fence
+    from spfft_tpu.tuning.runner import _stage_batch_inputs
+    from spfft_tpu.types import ScalingType, TransformType
+
+    re, im = _stage_batch_inputs(t, batch)
+    ex = t._exec
+    r2c = t.transform_type == TransformType.R2C
+
+    def one_pair():
+        out = ex.backward_pair_batch(re, im)
+        assert out is not None, "batch-fused path unavailable"
+        sre, sim = (out, None) if r2c else out
+        pair = ex.forward_pair_batch(sre, sim, ScalingType.FULL)
+        assert pair is not None, "batch-fused forward unavailable"
+        return pair
+
+    for _ in range(max(0, warmup)):
+        fence(one_pair())
+    rep_seconds = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(max(1, pairs)):
+            last = one_pair()
+        fence(last)
+        rep_seconds.append(
+            (time.perf_counter() - t0) / (max(1, pairs) * batch)
+        )
+    best = min(rep_seconds)
+    med = sorted(rep_seconds)[len(rep_seconds) // 2]
+    return {
+        "seconds_per_transform": best,
+        "rep_seconds": rep_seconds,
+        "seconds_noise": (med - best) / best if best > 0 else 0.0,
+    }
+
+
 def build(dim, sparsity, dtype, engine, fuse):
     import spfft_tpu as sp
     from spfft_tpu import ProcessingUnit, Transform, TransformType
@@ -107,6 +152,11 @@ def main(argv=None):
     ap.add_argument("--pairs", type=int, default=8, help="pairs per timed loop")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument(
+        "--batches", type=int, nargs="*", default=[1, 4, 8],
+        help="batch-fused row family: batch sizes measured through the "
+        "stacked program (seconds per transform; empty disables)",
+    )
     ap.add_argument("-o", "--output", default=None)
     args = ap.parse_args(argv)
 
@@ -145,6 +195,48 @@ def main(argv=None):
             f"(noise {m['seconds_noise']:.1%})",
             file=sys.stderr,
         )
+    # batched row family (SPFFT_TPU_BATCH_FUSE): one fused plan, one
+    # stacked program per batch size, seconds-per-transform as the
+    # comparable unit — the batch=4-strictly-above-batch=1 CI gate and the
+    # committed baseline's fbench batch rows come from these
+    batch_results = {}
+    if args.batches:
+        t = build(dim, args.radius, np.dtype(args.dtype), args.engine, True)
+        assert t.fused, t.report()["ir"]
+        bmax = max(int(x) for x in args.batches)
+        for b in sorted(set(int(x) for x in args.batches)):
+            # equal WORK per timed rep across the family (pairs * bmax
+            # transforms): small-batch rows otherwise time far fewer
+            # transforms per rep, and their jumpier best-of would dominate
+            # the batchN-vs-batch1 comparison with scheduler noise
+            pairs_b = max(1, args.pairs * bmax // b)
+            m = measure_batch_dispatch(
+                t, batch=b, pairs=pairs_b, repeats=args.repeats,
+                warmup=args.warmup,
+            )
+            batch_results[b] = m["seconds_per_transform"]
+            card = t.report()
+            rows.append(
+                {
+                    "key": f"fbench:c2c:{dim}:r{args.radius}:{args.dtype}:b{b}",
+                    "batch": b,
+                    "engine": card["engine"],
+                    "seconds_per_transform": m["seconds_per_transform"],
+                    "rep_seconds": m["rep_seconds"],
+                    "seconds_noise": m["seconds_noise"],
+                    "gflops": flops / m["seconds_per_transform"] / 1e9,
+                    "nnz_fraction": card["nnz_fraction"],
+                    "ir": card["ir"],
+                    "batch_provenance": card["batch"],
+                    "run_id": card["run_id"],
+                }
+            )
+            print(
+                f"batch{b:<3d} {m['seconds_per_transform'] * 1e3:10.3f} "
+                f"ms/transform  {rows[-1]['gflops']:9.2f} GFLOP/s  "
+                f"(noise {m['seconds_noise']:.1%})",
+                file=sys.stderr,
+            )
     doc = {
         "schema": FBENCH_SCHEMA,
         "config": {
@@ -154,6 +246,7 @@ def main(argv=None):
             "engine": args.engine,
             "pairs": args.pairs,
             "repeats": args.repeats,
+            "batches": sorted(batch_results),
             "platform": _platform(),
             "device_count": 1,
             "jax": __import__("jax").__version__,
@@ -162,6 +255,9 @@ def main(argv=None):
         "fused_over_staged": results["staged"] / results["fused"],
         "rows": rows,
     }
+    if 1 in batch_results and any(b > 1 for b in batch_results):
+        bmax = max(b for b in batch_results if b > 1)
+        doc["batch_over_single"] = batch_results[1] / batch_results[bmax]
     out = json.dumps(doc, indent=1)
     if args.output:
         Path(args.output).write_text(out)

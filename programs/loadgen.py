@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -67,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="A/B the task-graph scheduler (spfft_tpu.sched): 1 "
                    "dispatches mixed-geometry batches as one graph per "
                    "cycle; stamped into the report config either way")
+    p.add_argument("--batch-fuse", type=int, choices=[0, 1], default=1,
+                   help="A/B batch fusion (SPFFT_TPU_BATCH_FUSE): 1 runs a "
+                   "coalesced batch as ONE stacked program dispatch per "
+                   "direction, 0 keeps the split-phase per-request loop; "
+                   "stamped into the report config either way")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--settle-s", type=float, default=30.0,
                    help="max wait for outstanding tickets after each step")
@@ -163,6 +169,10 @@ def main(argv=None) -> int:
     from spfft_tpu.obs import perf
     from spfft_tpu.serve import TransformService
 
+    # the knob is read at dispatch time (spfft_tpu.ir.resolve_batch_fuse),
+    # so setting the env here owns the whole run; write-only — reads go
+    # through the typed registry
+    os.environ["SPFFT_TPU_BATCH_FUSE"] = str(int(args.batch_fuse))
     dx, dy, dz = args.dims
     trip = sp.create_spherical_cutoff_triplets(dx, dy, dz, args.sparsity)
     rng = np.random.default_rng(args.seed)
@@ -243,6 +253,7 @@ def main(argv=None) -> int:
             "timeout_s": args.timeout_s, "num_values": int(len(trip)),
             "flops_per_transform": flops_per_transform, "dtype": dtype,
             "seed": args.seed, "sched": bool(args.sched),
+            "batch_fuse": bool(args.batch_fuse),
         },
         "rows": rows,
         "service": service.describe(),

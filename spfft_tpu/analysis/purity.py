@@ -57,6 +57,10 @@ def _impurity(call) -> str | None:
         if fn.id == "getenv":
             return "os.getenv(...) read (frozen at trace time)"
         return None
+    if not isinstance(fn, ast.Attribute):
+        # call-of-call (`jax.vmap(f)(*args)`) / subscripted callables: no
+        # attribute chain to inspect — not one of the effect shapes above
+        return None
     root = _root_name(fn)
     if root == "time":
         return f"time.{fn.attr}(...) (a trace-time constant)"

@@ -627,6 +627,20 @@ def check_ir_nodes(tree: Tree):
                     "accept cards missing (or carrying stale) ir keys",
                 )
             )
+        # the batch-section mirror follows the same contract
+        batch_keys = tree.literal_assign(IR_COMPILE_FILE, "BATCH_KEYS")
+        card_batch = tree.literal_assign(PLANCARD_FILE, "BATCH_SECTION_KEYS")
+        if tuple(batch_keys or ()) != tuple(card_batch or ()):
+            findings.append(
+                check_ir_nodes.finding(
+                    PLANCARD_FILE, 0,
+                    f"BATCH_SECTION_KEYS {tuple(card_batch or ())!r} does "
+                    f"not match {IR_COMPILE_FILE} BATCH_KEYS "
+                    f"{tuple(batch_keys or ())!r} — the card validator "
+                    "would accept cards missing (or carrying stale) batch "
+                    "keys",
+                )
+            )
     elif not tree.partial:
         findings.append(
             check_ir_nodes.finding(

@@ -475,6 +475,149 @@ class DistributedTransform:
         re, im = self._space_data
         return self._exec.forward_pair(re, im, ScalingType(scaling))
 
+    # ---- batch-fused execution (SPFFT_TPU_BATCH_FUSE, spfft_tpu.ir) -----------
+
+    def backward_batch(self, values_batch, *, fallback: bool = True):
+        """Execute B same-plan backward transforms as ONE batched shard_map
+        program (the local :meth:`Transform.backward_batch` contract on a
+        mesh): per-request padded value pairs stack along a batch axis after
+        the mesh block dim — ``(P, B, V_max)`` — and the whole batch pays one
+        dispatch per direction. Same degradation rung (``batch_fuse_failed``
+        → per-request loop; ``fallback=False`` returns ``None``); verified
+        plans run per-request under their supervisor. Single-controller
+        meshes only (the batched staging assembles global stacks)."""
+        values_batch = list(values_batch)
+        if not values_batch:
+            return []
+        if self._verifier is not None:
+            return [self.backward(v) for v in values_batch]
+        plat = self._platform
+        out = None
+        if self._exec._ir.batch_available():
+            with obs.trace.operation(
+                "execute", run_id=self._run_id, direction="backward"
+            ), timing.scoped("backward"):
+                if self._guard:
+                    for values in values_batch:
+                        faults.check_array(
+                            list(values), check="backward input",
+                            platform=plat,
+                        )
+                with timing.scoped("input staging"):
+                    staged = [self._exec.pad_values(v) for v in values_batch]
+                    re = self._exec.stack_staged(
+                        [p[0] for p in staged], self._exec.value_sharding
+                    )
+                    im = self._exec.stack_staged(
+                        [p[1] for p in staged], self._exec.value_sharding
+                    )
+                with timing.scoped("dispatch"), faults.typed_execution(
+                    plat, "backward dispatch"
+                ):
+                    out = self._exec.backward_pair_batch(re, im)
+                if out is not None:
+                    # count ONLY on the batched arm: the fallback loop below
+                    # re-enters backward(), which counts (and traces) itself
+                    obs.counter(
+                        "transforms_total", direction="backward",
+                        engine=self._engine,
+                    ).inc(len(values_batch))
+                    with timing.scoped("wait"), faults.typed_execution(
+                        plat, "backward wait"
+                    ):
+                        fence(out)
+                    with timing.scoped("output staging"):
+                        results = [
+                            self._exec.unpad_space(_batch_slice(out, b))
+                            for b in range(len(values_batch))
+                        ]
+                    if self._guard:
+                        for result in results:
+                            # single-controller meshes return global slabs;
+                            # finite-scan plus shape, the per-request
+                            # backward contract
+                            faults.check_array(
+                                result, check="backward output",
+                                platform=plat,
+                                shape=(self.dim_z, self.dim_y, self.dim_x),
+                            )
+                    return results
+        if not fallback:
+            return None
+        return [self.backward(v) for v in values_batch]
+
+    def forward_batch(
+        self,
+        spaces,
+        scaling: ScalingType = ScalingType.NONE,
+        *,
+        fallback: bool = True,
+    ):
+        """Batched forward over explicit global space arrays: B ``(Z, Y,
+        X)`` slabs -> B per-shard packed value lists through one batched
+        shard_map program (one ``scaling`` for the whole batch)."""
+        spaces = list(spaces)
+        if not spaces:
+            return []
+        if self._verifier is not None:
+            return [self.forward(s, scaling) for s in spaces]
+        plat = self._platform
+        out = None
+        if self._exec._ir.batch_available():
+            with obs.trace.operation(
+                "execute", run_id=self._run_id, direction="forward"
+            ), timing.scoped("forward"):
+                if self._guard:
+                    for s in spaces:
+                        faults.check_array(
+                            np.asarray(s), check="forward input",
+                            platform=plat,
+                        )
+                with timing.scoped("input staging"):
+                    staged = [
+                        self._exec.pad_space(np.asarray(s)) for s in spaces
+                    ]
+                    re = self._exec.stack_staged(
+                        [p[0] for p in staged], self._exec.space_sharding
+                    )
+                    im = (
+                        None
+                        if self._exec.is_r2c
+                        else self._exec.stack_staged(
+                            [p[1] for p in staged], self._exec.space_sharding
+                        )
+                    )
+                with timing.scoped("dispatch"), faults.typed_execution(
+                    plat, "forward dispatch"
+                ):
+                    out = self._exec.forward_pair_batch(
+                        re, im, ScalingType(scaling)
+                    )
+                if out is not None:
+                    # count ONLY on the batched arm (see backward_batch)
+                    obs.counter(
+                        "transforms_total", direction="forward",
+                        engine=self._engine,
+                    ).inc(len(spaces))
+                    with timing.scoped("wait"), faults.typed_execution(
+                        plat, "forward wait"
+                    ):
+                        fence(out)
+                    with timing.scoped("output staging"):
+                        results = [
+                            self._exec.unpad_values(_batch_slice(out, b))
+                            for b in range(len(spaces))
+                        ]
+                    if self._guard:
+                        for result in results:
+                            faults.check_array(
+                                result, check="forward output", platform=plat
+                            )
+                    return results
+        if not fallback:
+            return None
+        return [self.forward(s, scaling) for s in spaces]
+
     def _finalize_backward(self, out):
         """Host-side completion of a dispatched backward (fetch + unpad)."""
         return self._exec.unpad_space(out)
@@ -754,3 +897,12 @@ class DistributedTransform:
         if self._space_data is not None:
             with faults.typed_execution(self._platform, "synchronize"):
                 fence(self._space_data)
+
+
+def _batch_slice(out, b: int):
+    """Per-request view of a stacked batched result: index the batch axis
+    (axis 1, after the mesh block dim) on every leaf, preserving the
+    pair/single structure the unpad helpers expect."""
+    if isinstance(out, tuple):
+        return tuple(a[:, b] for a in out)
+    return out[:, b]

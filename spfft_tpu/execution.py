@@ -209,6 +209,47 @@ class ExecutionBase:
                 values_re, values_im, *getattr(self, "phase_operands", ())
             )
 
+    # ---- batch-fused entries (SPFFT_TPU_BATCH_FUSE, spfft_tpu.ir) -------------
+    # Stacked (B, ...) per-request arrays in, stacked results out — ONE
+    # dispatch per direction for the whole batch. Every entry returns None
+    # when batch fusion is unavailable or took its rung (batch_fuse_failed
+    # on the plan card); callers run their per-request loop then.
+
+    def backward_pair_batch(self, values_re, values_im):
+        """Stacked (B, V) freq pairs -> stacked space ((B, ...) native
+        layout; pair for C2C), or ``None`` (caller loops)."""
+        return self._ir.run_backward_batch(
+            values_re, values_im, *getattr(self, "phase_operands", ())
+        )
+
+    def backward_pair_batch_consuming(self, values_re, values_im):
+        """Batched backward donating the stacked value pair (the host-facing
+        consuming flow's donation rule on the batch axis)."""
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return self._ir.run_backward_batch_consuming(
+                values_re, values_im, *getattr(self, "phase_operands", ())
+            )
+
+    def forward_pair_batch(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE
+    ):
+        """Stacked (B, ...) space -> stacked (B, V) freq pairs, or ``None``.
+        ``space_im=None`` (R2C) becomes the stacked zero-width placeholder
+        the forward graphs expect."""
+        if space_im is None:
+            space_im = jnp.zeros(
+                (space_re.shape[0], 0), dtype=self.real_dtype
+            )
+        return self._ir.run_forward_batch(
+            ScalingType(scaling), space_re, space_im,
+            *getattr(self, "phase_operands", ()),
+        )
+
     def _ir_spec(self) -> dict:
         """The :mod:`spfft_tpu.ir` compile-layer contract of the local
         engines: plain jits, the packed value pair donatable on the consuming

@@ -64,6 +64,7 @@ SITES = (
     "engine.execute",
     "ir.lower",
     "ir.compile",
+    "ir.batch",
     "exchange.build",
     "hlo.stats",
     "sync.fence",

@@ -28,15 +28,30 @@ Plan cards carry a schema-pinned ``ir`` section (stage lists per direction,
 fusion decision, donation map); the ``fused`` vs ``staged`` (and
 bf16-twiddle mixed-precision) variants are autotuner candidates under
 ``policy="tuned"`` (:mod:`spfft_tpu.tuning.candidates`).
+
+4. **Batch fusion** (``SPFFT_TPU_BATCH_FUSE``, :func:`build_batched`): a
+   same-geometry batch of B transforms lowers to ONE jitted program per
+   direction — the composed stage graph vmapped over a leading batch axis
+   on the stacked per-request inputs (values/space), with index tables and
+   threaded plan operands staying shared plan constants and the stacked
+   value pair donated on the consuming backward. Fault site ``ir.batch``
+   feeds the ladder: a failed batched build records ``batch_fuse_failed``
+   and callers run their split-phase per-request loop — never a failed
+   batch. Batch size is a tuner-owned axis (``fused/bN`` candidates,
+   :func:`spfft_tpu.tuning.tuned_batch`) persisted in wisdom.
 """
 from .graph import NODES, EdgeMeta, Node, StageGraph  # noqa: F401
 from .compile import (  # noqa: F401
+    BATCH_FUSE_ENV,
+    BATCH_KEYS,
     FUSE_ENV,
     IR_KEYS,
     EngineIr,
     StagedProgram,
+    build_batched,
     compose,
     init_engine_ir,
+    resolve_batch_fuse,
     resolve_fuse,
 )
 from .lower import lower_engine  # noqa: F401

@@ -35,9 +35,13 @@ from .. import knobs
 from ..errors import InvalidParameterError
 
 FUSE_ENV = "SPFFT_TPU_FUSE"
+BATCH_FUSE_ENV = "SPFFT_TPU_BATCH_FUSE"
 
 # plan-card ``ir`` section schema floor (obs.plancard pins it)
 IR_KEYS = ("fused", "path", "requested", "stages", "donation")
+# plan-card ``batch`` section schema floor (obs.plancard mirrors it; the
+# vocabulary checker pins the two literals equal, like IR_KEYS)
+BATCH_KEYS = ("enabled", "requested", "sizes", "failed")
 
 
 def resolve_fuse(fuse=None):
@@ -57,6 +61,21 @@ def resolve_fuse(fuse=None):
     if raw not in ("0", "1"):
         raise InvalidParameterError(
             f"{FUSE_ENV} must be 0 or 1, got {raw!r}"
+        )
+    return raw == "1", "env"
+
+
+def resolve_batch_fuse():
+    """Resolve the batch-fusion knob (``SPFFT_TPU_BATCH_FUSE``, default on).
+    Returns ``(enabled, source)`` with ``source`` in {"env", "default"};
+    read at call time (not plan construction) so a serving A/B flips without
+    rebuilding plans. A malformed value raises typed like every knob."""
+    raw = knobs.raw(BATCH_FUSE_ENV)
+    if raw is None or raw == "":
+        return True, "default"
+    if raw not in ("0", "1"):
+        raise InvalidParameterError(
+            f"{BATCH_FUSE_ENV} must be 0 or 1, got {raw!r}"
         )
     return raw == "1", "env"
 
@@ -164,6 +183,79 @@ def build_fused(graph, spec):
     return {"call": jax.jit(mapped), "consuming": None}
 
 
+def _batched_compose(graph, fn):
+    """Vmap the composed graph over a leading batch axis on the graph's
+    declared ``batch_inputs`` (stacked per-request values/space), keeping
+    every other input — index tables, threaded plan operands (the trailing
+    varargs tuple included) — a plan constant shared by the whole batch.
+    Returns the batched traceable; tracing it under ``jax.jit`` IS the
+    batch-fusion pass: one program computes B transforms per direction."""
+    names = list(graph.inputs)
+    fixed = names[:-1] if getattr(graph, "varargs", False) else names
+    batched = tuple(getattr(graph, "batch_inputs", ()) or ())
+    if not batched:
+        raise InvalidParameterError(
+            f"ir[{graph.direction}]: graph declares no batchable inputs"
+        )
+    idx = tuple(i for i, n in enumerate(fixed) if n in batched)
+
+    def bfn(*args):
+        stacked = [args[i] for i in idx]
+
+        def per_item(*items):
+            full = list(args)
+            for i, v in zip(idx, items):
+                full[i] = v
+            return fn(*full)
+
+        return jax.vmap(per_item)(*stacked)
+
+    return bfn
+
+
+def build_batched(graph, spec):
+    """The batch-fusion pass: ONE jitted program running a whole stacked
+    batch of same-geometry transforms through ``graph``.
+
+    Local graphs jit the vmapped composition (and, when ``spec`` names
+    donatable inputs, a donating variant over the STACKED value pair — the
+    per-request donation rule lifted to the batch axis). Mesh graphs wrap it
+    in the engine's ``shard_map`` with the batch axis replicated (arrays are
+    ``(P, B, *per_shard)``: sharded over the mesh on the block dim, every
+    shard holding its own slice of all B requests). The program is
+    batch-size-polymorphic — ``jax.jit`` specializes per distinct B.
+    Returns ``{"call", "consuming"|None}`` like :func:`build_fused`."""
+    fn = compose(graph)
+    bfn = _batched_compose(graph, fn)
+    if spec["kind"] == "local":
+        call = jax.jit(bfn)
+        donate = spec.get("donate") if graph.direction == "backward" else None
+        consuming = (
+            jax.jit(bfn, donate_argnums=tuple(donate)) if donate else None
+        )
+        return {"call": call, "consuming": consuming}
+    axes = spec["axes"]
+    batched = set(graph.batch_inputs)
+
+    def espec(e, with_batch):
+        base = _mesh_spec(graph.meta[e], axes)
+        if not with_batch:
+            return base
+        from jax.sharding import PartitionSpec as P
+
+        return P(base[0], None, *base[1:])
+
+    in_specs = tuple(espec(e, e in batched) for e in graph.inputs)
+    outs = tuple(espec(e, True) for e in graph.outputs)
+    out_specs = outs[0] if len(outs) == 1 else outs
+    mapped = spec["sm"](
+        _block_adapter(bfn, len(graph.outputs)),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    return {"call": jax.jit(mapped), "consuming": None}
+
+
 class StagedProgram:
     """The per-stage reference executor: every node is its own jitted
     dispatch (its own ``shard_map`` program on mesh graphs), intermediates
@@ -265,6 +357,15 @@ class EngineIr:
         self._backward = None
         self._backward_consuming = None
         self._forward = {}
+        # batch-fused programs (SPFFT_TPU_BATCH_FUSE): built lazily per
+        # (direction[, scaling]) on the first batched dispatch, jit-
+        # specialized per batch size; a build/compile failure records ONE
+        # batch_fuse_failed rung and disables the axis for this plan (the
+        # caller's split-phase loop is the rung — never a failed batch)
+        self._batched = {}
+        self._batch_compiled = set()  # (key, B) pairs that have run once
+        self._batch_failed = False
+        self._batch_sizes = set()  # distinct B values dispatched (card)
         if graphs is not None:
             if path == "fused":
                 built = build_fused(graphs["backward"], spec)
@@ -380,7 +481,134 @@ class EngineIr:
             )
         return self._forward[scaling](*args)
 
+    # ---- batch-fused dispatch (SPFFT_TPU_BATCH_FUSE) --------------------------
+
+    def batch_available(self) -> bool:
+        """Whether the batch-fused path may be attempted: knob on, plan
+        running the fused path (the staged/legacy rungs have no composition
+        to vmap), graphs declaring a batch axis, and no earlier batched
+        build having failed. Read at call time — a serving A/B flips the
+        knob without rebuilding plans."""
+        enabled, _ = resolve_batch_fuse()
+        return (
+            enabled
+            and self.path == "fused"
+            and self.graphs is not None
+            and bool(getattr(self.graphs["backward"], "batch_inputs", ()))
+            and not self._batch_failed
+        )
+
+    def _batch_degrade(self, exc) -> None:
+        """The batch rung: a batched build/first-dispatch compile failure
+        records ``batch_fuse_failed`` on the plan card (via the captured
+        sink, like the fused first-dispatch rung) and disables the axis for
+        this plan — callers fall back to their split-phase per-request loop;
+        the plan itself stays healthy."""
+        from .. import faults
+
+        entry = faults.record_degradation(
+            "batch_fuse_failed", faults.summarize(exc)
+        )
+        if self._sink is not None and (
+            not self._sink or self._sink[-1] is not entry
+        ):
+            self._sink.append(entry)
+        self._batch_failed = True
+        self._batched = {}
+
+    def _batch_program(self, key):
+        """Build (or fetch) the batched program for ``key`` =
+        ``("backward",)`` / ``("forward", scaling)``; returns the
+        ``{"call", "consuming"}`` dict or ``None`` after taking the rung.
+        The ``ir.batch`` fault site models this layer refusing to build."""
+        prog = self._batched.get(key)
+        if prog is not None:
+            return prog
+        from .. import faults
+
+        graph = (
+            self.graphs["backward"]
+            if key[0] == "backward"
+            else self.graphs["forward"][key[1]]
+        )
+        try:
+            faults.site("ir.batch")
+            prog = build_batched(graph, self.spec)
+        except faults.ENGINE_BUILD_ERRORS + (InvalidParameterError,) as e:
+            self._batch_degrade(e)
+            return None
+        self._batched[key] = prog
+        return prog
+
+    def _run_batch(self, key, args, *, consuming=False):
+        """One batched dispatch: returns the stacked result, or ``None``
+        after recording the rung (build failure, or a compile-class failure
+        at a program's first call for this batch size — ``jax.jit`` is
+        lazy, the fused-path rule). Once a (program, B) pair has succeeded,
+        errors propagate untouched to the typed-execution ladder."""
+        from .. import faults
+
+        if not self.batch_available():
+            return None
+        prog = self._batch_program(key)
+        if prog is None:
+            return None
+        call = prog["consuming"] if consuming else prog["call"]
+        if call is None:
+            call = prog["call"]
+        # the stacked batch extent: leading axis on local arrays, second
+        # axis (after the mesh block dim) on sharded ones
+        batch = int(
+            args[0].shape[0] if self.spec["kind"] == "local"
+            else args[0].shape[1]
+        )
+        ckey = (key, consuming, batch)
+        if ckey in self._batch_compiled:
+            out = call(*args)
+        else:
+            try:
+                out = call(*args)
+            except faults.ENGINE_BUILD_ERRORS as e:
+                self._batch_degrade(e)
+                return None
+            self._batch_compiled.add(ckey)
+        self._batch_sizes.add(batch)
+        from .. import obs
+
+        obs.counter(
+            "ir_dispatches_total", mode="batched", direction=key[0]
+        ).inc()
+        return out
+
+    def run_backward_batch(self, *args):
+        """Batched backward: stacked value pairs in, stacked space out —
+        ONE dispatch for the whole batch. ``None`` = batch fusion is
+        unavailable/degraded; the caller runs its per-request loop."""
+        return self._run_batch(("backward",), args)
+
+    def run_backward_batch_consuming(self, *args):
+        """Batched backward donating the STACKED value pair (the consuming
+        host-facing flow's donation rule lifted to the batch axis)."""
+        return self._run_batch(("backward",), args, consuming=True)
+
+    def run_forward_batch(self, scaling, *args):
+        """Batched forward: stacked space in, stacked packed pairs out."""
+        return self._run_batch(("forward", scaling), args)
+
     # ---- plan-card provenance (obs.plancard pins IR_KEYS) ---------------------
+
+    def describe_batch(self) -> dict:
+        """The plan card's schema-pinned ``batch`` section (BATCH_KEYS):
+        whether the batch-fused path is live, where the knob came from, the
+        distinct batch sizes dispatched so far, and whether the axis took
+        the ``batch_fuse_failed`` rung."""
+        _, requested = resolve_batch_fuse()
+        return {
+            "enabled": bool(self.batch_available()),
+            "requested": requested,
+            "sizes": sorted(int(b) for b in self._batch_sizes),
+            "failed": bool(self._batch_failed),
+        }
 
     def describe(self) -> dict:
         from ..types import ScalingType

@@ -106,6 +106,13 @@ class StageGraph:
     inputs: list = field(default_factory=list)  # ordered input edge names
     outputs: list = field(default_factory=list)  # ordered output edge names
     meta: dict = field(default_factory=dict)  # edge name -> EdgeMeta
+    # Input edges that carry PER-REQUEST data (packed values / space slabs):
+    # the batch-fused compile (spfft_tpu.ir.compile.build_batched) vmaps the
+    # composed graph over a leading batch axis on exactly these inputs, while
+    # the rest (index tables, threaded plan operands) stay plan constants
+    # shared by the whole batch. Every graph output is per-request. Empty =
+    # the graph declares no batch axis and cannot batch-fuse.
+    batch_inputs: tuple = ()
 
     def add_input(self, name: str, *, dtype=None, shape=None) -> None:
         """Declare a graph input edge (caller-supplied value)."""

@@ -64,6 +64,7 @@ def _lower_local_xla(e):
         g = StageGraph("backward")
         g.add_input("values_re", dtype=rt, shape=(n,))
         g.add_input("values_im", dtype=rt, shape=(n,))
+        g.batch_inputs = ("values_re", "values_im")
         g.add(
             "compression", e._st_decompress, ("values_re", "values_im"),
             ("sticks",), out_meta={"sticks": EdgeMeta(ct, (S, Z))},
@@ -119,6 +120,7 @@ def _lower_local_xla(e):
         g = StageGraph("forward")
         g.add_input("space_re", dtype=rt, shape=(Z, Y, X))
         g.add_input("space_im", dtype=rt)  # (0,) placeholder for R2C
+        g.batch_inputs = ("space_re", "space_im")
         g.add(
             "x transform", e._st_x_forward, ("space_re", "space_im"),
             ("grid",), out_meta={"grid": EdgeMeta(ct, (Z, Y, Xf))},
@@ -160,6 +162,7 @@ def _lower_local_mxu(e):
         g.add_input("values_im", dtype=rt, shape=(n,))
         g.add_input("phase")  # threaded plan operands (opaque varargs tuple)
         g.varargs = True
+        g.batch_inputs = ("values_re", "values_im")
         g.add(
             "compression", e._st_decompress, ("values_re", "values_im"),
             ("sre", "sim"),
@@ -218,6 +221,7 @@ def _lower_local_mxu(e):
         g.add_input("space_im", dtype=rt)
         g.add_input("phase")
         g.varargs = True
+        g.batch_inputs = ("space_re", "space_im")
         g.add(
             "x transform", e._st_x_forward, ("space_re", "space_im"),
             ("gre", "gim"),
@@ -418,6 +422,7 @@ def _lower_slab_xla(e):
         g.add_input("values_re", dtype=rt, shape=(V,))
         g.add_input("values_im", dtype=rt, shape=(V,))
         g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        g.batch_inputs = ("values_re", "values_im")
         g.add(
             "compression", e._st_decompress,
             ("values_re", "values_im", "value_indices"), ("sticks",),
@@ -499,6 +504,7 @@ def _lower_slab_xla(e):
         if e.is_r2c:
             g.add_input("space_re", dtype=rt, shape=(L, Y, X))
             g.add_input("value_indices", dtype=np.int32, shape=(V,))
+            g.batch_inputs = ("space_re",)
             g.add(
                 "x transform", e._st_x_forward, ("space_re",), ("grid",),
                 out_meta={"grid": EdgeMeta(ct, (L, Y, Xf))},
@@ -507,6 +513,7 @@ def _lower_slab_xla(e):
             g.add_input("space_re", dtype=rt, shape=(L, Y, X))
             g.add_input("space_im", dtype=rt, shape=(L, Y, X))
             g.add_input("value_indices", dtype=np.int32, shape=(V,))
+            g.batch_inputs = ("space_re", "space_im")
             g.add(
                 "x transform", e._st_x_forward, ("space_re", "space_im"),
                 ("grid",), out_meta={"grid": EdgeMeta(ct, (L, Y, Xf))},
@@ -614,6 +621,7 @@ def _lower_slab_mxu(e):
         g.add_input("values_im", dtype=rt, shape=(V,))
         for pe in phase:
             g.add_input(pe, dtype=rt, shape=(S, Z))
+        g.batch_inputs = ("values_re", "values_im")
         g.add(
             "compression", e._st_decompress, ("values_re", "values_im"),
             ("sre", "sim"),
@@ -710,6 +718,9 @@ def _lower_slab_mxu(e):
             g.add_input("space_im", dtype=rt, shape=(L, Y, X))
         for pe in phase:
             g.add_input(pe, dtype=rt, shape=(S, Z))
+        g.batch_inputs = (
+            ("space_re",) if e.is_r2c else ("space_re", "space_im")
+        )
         A = e._num_x_active
         xmeta = EdgeMeta(rt, (L, Y, A))
         g.add(
@@ -1122,6 +1133,7 @@ def _lower_pencil(e, pair: bool):
         g.add_input("values_re", dtype=rt, shape=(V,))
         g.add_input("values_im", dtype=rt, shape=(V,))
         g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        g.batch_inputs = ("values_re", "values_im")
         if pair:
             g.add(
                 "compression", e._st_decompress, ("values_re", "values_im"),
@@ -1186,6 +1198,9 @@ def _lower_pencil(e, pair: bool):
         if not e.is_r2c:
             g.add_input("space_im", dtype=rt, shape=(Lz, Ly, X))
         g.add_input("value_indices", dtype=np.int32, shape=(V,))
+        g.batch_inputs = (
+            ("space_re",) if e.is_r2c else ("space_re", "space_im")
+        )
         names, recv_edges = _pencil_forward_head(
             g, e, [(0, Lz)], overlapped=False, pair=pair
         )

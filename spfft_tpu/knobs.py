@@ -344,6 +344,17 @@ register(
     "pipeline\")",
 )
 register(
+    "SPFFT_TPU_BATCH_FUSE", "str", "1", choices=("0", "1"),
+    doc="batch fusion (`spfft_tpu.ir`): `1` lets a same-geometry batch of B "
+    "transforms execute as ONE jitted program per direction (the composed "
+    "stage graph vmapped over stacked per-request values/space, stacked "
+    "buffers donated on the consuming backward); `0` keeps the per-request "
+    "split-phase loop. Read at call time, so a serving A/B "
+    "(`programs/loadgen.py --batch-fuse`) flips without rebuilding plans; "
+    "batch size is tuner-owned under `policy=\"tuned\"` — see \"Batching "
+    "through the IR\"",
+)
+register(
     "SPFFT_TPU_TWIDDLE_BF16", "bool", False,
     "`1` stores the MXU engines' DFT stage matrices in bfloat16 (mixed "
     "bf16×f32 contractions, half the twiddle HBM); f32 plans only — "

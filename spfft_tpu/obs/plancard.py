@@ -93,6 +93,13 @@ TRIAL_RESULT_KEYS = ("ms", "error")
 # Always present on fresh cards; pre-IR captures (BENCH_r05 and older)
 # omit it and stay valid (same rule as the exchange overlap_chunks key).
 IR_SECTION_KEYS = ("fused", "path", "requested", "stages", "donation")
+# Batch-fusion provenance (SPFFT_TPU_BATCH_FUSE): whether the batch-fused
+# path is live, the knob's source, the distinct batch sizes dispatched, and
+# whether the axis took its batch_fuse_failed rung. Mirror of
+# spfft_tpu/ir/compile.py BATCH_KEYS (import-free module — the vocabulary
+# checker pins the two literals equal, the IR_SECTION_KEYS contract).
+# Always present on fresh cards; pre-batch captures omit it and stay valid.
+BATCH_SECTION_KEYS = ("enabled", "requested", "sizes", "failed")
 
 # Scheduler-placement provenance (spfft_tpu.sched.placement): present on
 # plans the task-graph placement pass built; pins the decision record so a
@@ -278,6 +285,9 @@ def plan_card(transform, *, include_compiled: bool = False) -> dict:
         # donation map of the fused consuming backward — schema-pinned
         # (IR_KEYS below)
         "ir": ex._ir.describe(),
+        # batch-fusion provenance (spfft_tpu.ir batch axis) — schema-pinned
+        # (BATCH_SECTION_KEYS)
+        "batch": ex._ir.describe_batch(),
     }
     tuning_record = getattr(transform, "_tuning", None)
     if tuning_record is not None:
@@ -408,6 +418,15 @@ def validate_plan_card(card: dict) -> list:
             don or {}
         ):
             missing.append("ir.donation.backward|forward")
+    if "batch" in card:
+        rec = card["batch"]
+        missing.extend(
+            f"batch.{k}" for k in BATCH_SECTION_KEYS if k not in rec
+        )
+        if rec.get("requested") not in ("env", "default"):
+            missing.append(
+                f"batch.requested (unknown: {rec.get('requested')!r})"
+            )
     if "placement" in card:
         rec = card["placement"]
         missing.extend(f"placement.{k}" for k in PLACEMENT_KEYS if k not in rec)

@@ -46,6 +46,32 @@ from .. import knobs
 LANE = 128
 
 
+def _barrier_batching() -> None:
+    """jax 0.4.3x compat shim: ``lax.optimization_barrier`` has no vmap
+    batching rule there, so vmapping the copy pipelines (the batch-fused
+    programs of :mod:`spfft_tpu.ir` vmap the composed stage graph) fails
+    with ``NotImplementedError`` even though the barrier is semantically the
+    identity. Register the identity rule once — per-operand batch dims pass
+    through untouched, exactly what later jax versions ship upstream."""
+    try:
+        from jax._src.lax import lax as _lax
+        from jax.interpreters import batching
+
+        prim = _lax.optimization_barrier_p
+    except (ImportError, AttributeError):  # newer jax moved it: rule ships
+        return
+    if prim in batching.primitive_batchers:
+        return
+
+    def _rule(args, dims):
+        return prim.bind(*args), list(dims)
+
+    batching.primitive_batchers[prim] = _rule
+
+
+_barrier_batching()
+
+
 @dataclasses.dataclass(frozen=True)
 class _RunPipe:
     """One affine-run pipeline over a subset of destination blocks: row indices
@@ -213,6 +239,7 @@ class CopyPlan:
                     )
                     off += c
                 # The barrier is a MISCOMPILE workaround, not an optimization: on
+                # (vmap support for it is registered below — _barrier_batching)
                 # the TPU backend (v5e, 2026-07), fusing the concat of >= 2 pieces
                 # lane-shifted by different amounts out of one buffer produces
                 # wrong values when the piece sublane counts are below the 8-row

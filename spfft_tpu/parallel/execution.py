@@ -136,6 +136,49 @@ class PaddingHelpers:
             "legacy_forward": self._forward,
         }
 
+    # ---- batch-fused entries (SPFFT_TPU_BATCH_FUSE, spfft_tpu.ir) -------------
+    # Sharded stacked arrays (P, B, *per_shard): mesh axis on the block dim,
+    # every shard holding its slice of all B requests. One shard_map program
+    # per direction per batch; None = batch fusion unavailable (caller loops).
+
+    def backward_pair_batch(self, values_re, values_im):
+        """Stacked (P, B, V_max) freq pairs -> stacked space slabs
+        ((P, B, L, Y, X); pair for C2C), or ``None`` (caller loops)."""
+        return self._ir.run_backward_batch(
+            values_re, values_im, self._value_indices
+        )
+
+    def forward_pair_batch(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE
+    ):
+        """Stacked (P, B, L, Y, X) space slabs -> stacked (P, B, V_max)
+        freq pairs, or ``None``."""
+        s = ScalingType(scaling)
+        if self.is_r2c:
+            return self._ir.run_forward_batch(
+                s, space_re, self._value_indices
+            )
+        return self._ir.run_forward_batch(
+            s, space_re, space_im, self._value_indices
+        )
+
+    def _batched_sharding(self, sharding):
+        """``sharding`` with a replicated batch axis spliced in after the
+        mesh block dim — the layout of every stacked batched array."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        spec = sharding.spec
+        return NamedSharding(self.mesh, P(spec[0], None, *spec[1:]))
+
+    def stack_staged(self, staged, sharding):
+        """Stack per-request staged device arrays along the batch axis
+        (axis 1, after the mesh block dim) and commit the stack to the
+        batched sharding — the staging half every mesh batch entry rides."""
+        return jax.device_put(
+            jnp.stack(staged, axis=1), self._batched_sharding(sharding)
+        )
+
     def _local_shard_ids(self):
         # flat device index == shard id only on a dedicated 1-D fft mesh; the
         # per-process block-assembly path below relies on that

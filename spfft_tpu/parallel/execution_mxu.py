@@ -1042,6 +1042,23 @@ class MxuDistributedExecution(PaddingHelpers, MxuValuePlans):
         Routed through the IR runtime (see DistributedExecution)."""
         return self._ir.run_backward(values_re, values_im, *self._phase_args())
 
+    def backward_pair_batch(self, values_re, values_im):
+        """Batched variant (see PaddingHelpers): this engine threads its
+        alignment-phase operands instead of a value-index table."""
+        return self._ir.run_backward_batch(
+            values_re, values_im, *self._phase_args()
+        )
+
+    def forward_pair_batch(
+        self, space_re, space_im, scaling: ScalingType = ScalingType.NONE
+    ):
+        s = ScalingType(scaling)
+        if self.is_r2c:
+            return self._ir.run_forward_batch(s, space_re, *self._phase_args())
+        return self._ir.run_forward_batch(
+            s, space_re, space_im, *self._phase_args()
+        )
+
     def _dispatch_forward(self, table, space_re, space_im, scaling):
         fn = table[ScalingType(scaling)]
         if self.is_r2c:

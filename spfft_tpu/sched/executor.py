@@ -170,13 +170,33 @@ class _Run:
         )
         with faults.typed_execution(self._platform(task), "sched dispatch"):
             if plan._verifier is not None:
-                if task.direction == "backward":
+                if task.batch:
+                    # supervised plans run per-request under their recovery
+                    # supervisor even inside a batch task (the ABFT ladder
+                    # owns each request's attempt — the serving rule)
+                    if task.direction == "backward":
+                        task.result = [plan.backward(v) for v in payload]
+                    else:
+                        task.result = [
+                            plan.forward(v, task.scaling) for v in payload
+                        ]
+                elif task.direction == "backward":
                     task.result = plan.backward(payload)
                 else:
                     task.result = plan.forward(payload, task.scaling)
                 task.pending = ()
                 return
-            if task.direction == "backward":
+            if task.batch:
+                # one batched program dispatch for the whole request list
+                # (spfft_tpu.ir batch fusion; the split-phase per-request
+                # loop is the in-dispatch rung when the batched build fails)
+                if task.direction == "backward":
+                    pending = plan._dispatch_backward_batch(payload)
+                else:
+                    pending = plan._dispatch_forward_batch(
+                        payload, task.scaling
+                    )
+            elif task.direction == "backward":
                 pending = plan._dispatch_backward(payload)
             else:
                 pending = plan._dispatch_forward(payload, task.scaling)
@@ -196,7 +216,12 @@ class _Run:
         import jax
 
         with faults.typed_execution(self._platform(task), "sched finalize"):
-            if task.direction == "backward":
+            if task.batch:
+                if task.direction == "backward":
+                    result = plan._finalize_backward_batch(task.pending)
+                else:
+                    result = plan._finalize_forward_batch(task.pending)
+            elif task.direction == "backward":
                 result = plan._finalize_backward(task.pending)
             else:
                 result = plan._finalize_forward(task.pending)
@@ -216,6 +241,14 @@ class _Run:
         plan = task.plan
         payload = self._payload(task)
         with faults.typed_execution(self._platform(task), "sched demote"):
+            if task.batch:
+                # per-request reference rung: correctness over batching on
+                # the degraded path (the serving demote rule)
+                if task.direction == "backward":
+                    return [plan._reference_backward(v) for v in payload]
+                return [
+                    plan._reference_forward(v, task.scaling) for v in payload
+                ]
             if task.direction == "backward":
                 return plan._reference_backward(payload)
             if payload is None:
@@ -507,6 +540,7 @@ def _copy_graph(graph: TaskGraph) -> TaskGraph:
             task.direction, id=task.id, payload=task.payload,
             scaling=task.scaling, after=task.deps, input_from=task.input_from,
             transform=task.transform, spec=task.spec, deadline=task.deadline,
+            batch=task.batch,
         )
     return copy
 

@@ -37,7 +37,7 @@ class Task:
 
     __slots__ = (
         "id", "direction", "payload", "scaling", "deps", "input_from",
-        "transform", "spec", "deadline",
+        "transform", "spec", "deadline", "batch",
         # execution state (owned by sched.executor)
         "plan", "pending", "result", "error", "outcome", "attempts",
         "dispatched_at", "finished_at",
@@ -46,6 +46,7 @@ class Task:
     def __init__(
         self, id, direction, *, payload=None, scaling=ScalingType.NONE,
         deps=(), input_from=None, transform=None, spec=None, deadline=None,
+        batch=False,
     ):
         if direction not in DIRECTIONS:
             raise InvalidParameterError(
@@ -67,6 +68,22 @@ class Task:
                 "per (geometry, device), so their retained space buffers "
                 "are not task-addressable"
             )
+        # batch task (spfft_tpu.ir batch fusion): payload is a LIST of
+        # per-request payloads executed as one batched program dispatch —
+        # the scheduler treats the whole batch as one task (one dispatch,
+        # one finalize, one ladder). Requires a pinned plan.
+        self.batch = bool(batch)
+        if self.batch:
+            if transform is None:
+                raise InvalidParameterError(
+                    f"task {id!r}: a batch task needs a pinned transform="
+                )
+            if not isinstance(payload, (list, tuple)) or not payload:
+                raise InvalidParameterError(
+                    f"task {id!r}: a batch task needs a non-empty list "
+                    "payload (one entry per request)"
+                )
+            payload = list(payload)
         self.id = str(id)
         self.direction = direction
         self.payload = payload
@@ -93,6 +110,7 @@ class Task:
         return {
             "id": self.id,
             "direction": self.direction,
+            "batch": len(self.payload) if self.batch else None,
             "deps": list(self.deps),
             "outcome": self.outcome,
             "attempts": self.attempts,
@@ -111,6 +129,7 @@ class TaskGraph:
     def add(
         self, direction, *, id=None, payload=None, scaling=ScalingType.NONE,
         after=(), input_from=None, transform=None, spec=None, deadline=None,
+        batch=False,
     ) -> str:
         """Add one task; returns its id (generated when not given).
 
@@ -148,7 +167,7 @@ class TaskGraph:
         task = Task(
             tid, direction, payload=payload, scaling=scaling, deps=deps,
             input_from=input_from, transform=transform, spec=spec,
-            deadline=deadline,
+            deadline=deadline, batch=batch,
         )
         self._tasks[tid] = task
         return tid

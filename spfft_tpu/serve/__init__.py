@@ -12,12 +12,19 @@ property is graceful behavior under overload:
    unbounded latency. Deadlines are enforced at admission AND pre-dispatch.
 2. **Coalesced batching** (:mod:`.batcher`): requests whose sparse index
    sets share a stick layout resolve to one cached plan (keyed like the
-   tuning wisdom store) and execute as batches through the task-graph
-   scheduler (:func:`spfft_tpu.sched.run_tasks` over the split-phase
-   ``multi_transform`` halves — dispatches enqueued back-to-back, finalized
-   in completion order), with per-caller value orders bridged by static
-   maps (:func:`spfft_tpu.parallel.ragged.value_order_map`) — the AccFFT
-   amortize-the-dispatch discipline (arxiv 1506.07933).
+   tuning wisdom store) and execute **batch-fused**
+   (``SPFFT_TPU_BATCH_FUSE``): the whole same-geometry batch stacks into
+   ONE jitted program dispatch per direction on the canonical plan
+   (:mod:`spfft_tpu.ir` batch axis — no plan clones, chunk sizes owned by
+   the autotuner, occupancy bucket-padded to bound jit specializations),
+   with per-caller value orders bridged by static maps
+   (:func:`spfft_tpu.parallel.ragged.value_order_map`) — the AccFFT
+   amortize-the-dispatch discipline (arxiv 1506.07933) taken from
+   amortized host staging to amortized *programs*. The rung below it
+   (``batch_fuse_failed``) is the split-phase loop through the task-graph
+   scheduler (:func:`spfft_tpu.sched.run_tasks` over the
+   ``multi_transform`` halves on lazily-leased plan clones — dispatches
+   enqueued back-to-back, finalized in completion order).
 3. **Service** (:mod:`.service`): the dispatcher — retry with jittered
    backoff for transient typed failures, the verify circuit breaker wired
    into a shed-or-demote ladder, per-tenant metrics/histograms on the obs

@@ -92,21 +92,38 @@ def canonical_triplets(indices, dims) -> np.ndarray:
 
 class PlanEntry:
     """One cached geometry: the canonical plan, its clone pool, and the
-    per-caller value-order maps."""
+    per-caller value-order maps.
 
-    __slots__ = ("plan", "clones", "order_maps", "storage_triplets")
+    The clone pool exists for the split-phase loop only (B in-flight
+    split-phase requests need B plan objects — retained-buffer state is
+    per-object); leasing is LAZY, so batch-fused entries — whose whole batch
+    runs through ONE stacked program on the canonical plan — never build the
+    ``batch_max - 1`` clones they would never use."""
+
+    __slots__ = (
+        "plan", "clones", "order_maps", "storage_triplets",
+        # tuner-owned fused batch size (spfft_tpu.tuning.tuned_batch):
+        # resolved lazily once per entry; _UNSET until then, then None
+        # (uncapped) or the wisdom/trial-measured cap
+        "batch_cap", "batch_record",
+    )
 
     def __init__(self, plan):
         self.plan = plan
         self.clones: list = []
         self.order_maps: collections.OrderedDict = collections.OrderedDict()
         self.storage_triplets = plan._verify_triplets()
+        self.batch_cap = _UNSET
+        self.batch_record = None
 
     def lease(self, n: int, build_clone) -> list:
         """``n`` distinct plan objects for one batch (clone on demand)."""
         while 1 + len(self.clones) < n:
             self.clones.append(build_clone(self.plan))
         return [self.plan] + self.clones[: max(0, n - 1)]
+
+
+_UNSET = object()  # PlanEntry.batch_cap sentinel (None is a valid cap)
 
 
 class PlanCache:
@@ -234,6 +251,23 @@ class PlanCache:
                         "order_maps": len(entry.order_maps),
                         "run_id": entry.plan._run_id,
                         "engine": entry.plan._engine,
+                        # tuner-owned fused batch cap: None = uncapped,
+                        # "unresolved" = no batch dispatched yet
+                        "batch_cap": (
+                            "unresolved"
+                            if entry.batch_cap is _UNSET
+                            else entry.batch_cap
+                        ),
+                        # the cap's decision provenance (tuned entries only)
+                        "batch_tuning": (
+                            None
+                            if entry.batch_record is None
+                            else {
+                                "provenance": entry.batch_record["provenance"],
+                                "hit": entry.batch_record["hit"],
+                                "choice": entry.batch_record["choice"],
+                            }
+                        ),
                     }
                 )
             return rows
@@ -243,17 +277,22 @@ class PlanCache:
             return len(self._entries)
 
 
-def run_batch(plans: list, requests: list) -> list:
+def run_batch(entry, requests: list, build_clone, *, batch_cap=None) -> list:
     """Execute one coalesced batch; returns per-request results in request
     value order. Verified plans (``verify=`` armed) execute supervised
     per-request — the ABFT checks are host-side anyway, and the recovery
     ladder (retry -> jnp.fft reference -> typed ``VerificationError``) must
-    own each request's attempt; unverified plans dispatch through the
-    task-graph scheduler (:func:`spfft_tpu.sched.run_tasks`): every request
-    enqueued back-to-back like the split-phase ``multi_transform`` path, but
-    finalized in **completion order** — a fast request behind a slow one is
-    fetched the moment its device work finishes. Failure semantics are
-    unchanged: the scheduler runs without its own retry/demote rungs here
+    own each request's attempt. Unverified batches take the **batch-fused**
+    path when it is live (``SPFFT_TPU_BATCH_FUSE``, :mod:`spfft_tpu.ir`):
+    the whole batch — every request already bridged into plan storage order
+    — stacks into ONE jitted program dispatch per direction on the canonical
+    plan, in ``batch_cap``-sized chunks when the tuner capped the axis, with
+    no plan clones leased at all. The rung below it is today's split-phase
+    loop through the task-graph scheduler (:func:`spfft_tpu.sched.run_tasks`
+    on lazily-leased clones, completion-order finalize): a failed batched
+    build records ``batch_fuse_failed`` on the plan card and the loop
+    answers — never a failed batch. Failure semantics are unchanged: the
+    scheduler runs without its own retry/demote rungs here
     (``on_error="raise"``) because the service's retry loop and breaker
     ladder own batch recovery."""
     faults.site("serve.batch")
@@ -262,7 +301,13 @@ def run_batch(plans: list, requests: list) -> list:
     obs.trace.event(
         "serve", what="coalesce", direction=direction, occupancy=len(requests)
     )
-    supervised = plans[0]._verifier is not None
+    plan = entry.plan
+    supervised = plan._verifier is not None
+    if not supervised:
+        outs = _run_batch_fused(plan, requests, direction, batch_cap)
+        if outs is not None:
+            return outs
+    plans = entry.lease(len(requests), build_clone)
     if direction == "backward":
         if supervised:
             outs = [p.backward(r.payload) for p, r in zip(plans, requests)]
@@ -272,7 +317,7 @@ def run_batch(plans: list, requests: list) -> list:
             # when batch_max exceeds the scheduler's default window
             outs = sched.run_tasks(
                 plans, "backward", [r.payload for r in requests],
-                max_inflight=len(plans),
+                max_inflight=len(requests),
             )
         return outs
     if supervised:
@@ -280,9 +325,66 @@ def run_batch(plans: list, requests: list) -> list:
     else:
         outs = sched.run_tasks(
             plans, "forward", [r.payload for r in requests],
-            [r.scaling for r in requests], max_inflight=len(plans),
+            [r.scaling for r in requests], max_inflight=len(requests),
         )
     return [_to_request_order(r, out) for r, out in zip(requests, outs)]
+
+
+def _run_batch_fused(plan, requests: list, direction: str, cap) -> list | None:
+    """The batch-fused arm of :func:`run_batch`: one stacked program
+    dispatch per ``cap``-sized chunk (forward additionally groups by
+    scaling — the program is scaling-specialized). Returns per-request
+    results, or ``None`` when the path is unavailable or took its
+    ``batch_fuse_failed`` rung mid-flight (the caller's split-phase loop
+    then answers; partial chunk results are discarded — correctness over
+    thrift on the degraded path)."""
+    if not plan._exec._ir.batch_available():
+        return None
+    cap = len(requests) if not cap else max(1, int(cap))
+    obs.trace.event(
+        "serve", what="batch_fused", direction=direction,
+        occupancy=len(requests), cap=cap,
+    )
+    if direction == "backward":
+        outs = []
+        for i in range(0, len(requests), cap):
+            chunk = requests[i : i + cap]
+            payloads, n = _bucket_pad([r.payload for r in chunk])
+            res = plan.backward_batch(payloads, fallback=False, count=n)
+            if res is None:
+                return None
+            outs.extend(res)
+        return outs
+    outs: list = [None] * len(requests)
+    groups: dict = {}
+    for idx, r in enumerate(requests):
+        groups.setdefault(r.scaling, []).append(idx)
+    for scaling, idxs in groups.items():
+        for j in range(0, len(idxs), cap):
+            sub = idxs[j : j + cap]
+            payloads, n = _bucket_pad([requests[k].payload for k in sub])
+            res = plan.forward_batch(payloads, scaling, fallback=False, count=n)
+            if res is None:
+                return None
+            for k, out in zip(sub, res):
+                outs[k] = _to_request_order(requests[k], out)
+    return outs
+
+
+def _bucket_pad(payloads: list) -> tuple:
+    """Pad a chunk's payload list to the next power of two by repeating the
+    last payload; returns ``(padded, real_count)``. The batched program is
+    jit-specialized per batch extent, so without bucketing a serving stream
+    with fluctuating occupancy pays one XLA compile per distinct size —
+    bucketing bounds the specializations to the powers of two up to
+    batch_max at the cost of a few duplicate rows' compute. The real count
+    rides as ``count=`` into the batch calls, so metrics/guard checks and
+    returned results cover exactly the real requests."""
+    n = len(payloads)
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return payloads + [payloads[-1]] * (bucket - n), n
 
 
 def run_reference(plan, request):

@@ -55,6 +55,7 @@ from ..errors import (
     MPIError,
 )
 from ..types import ProcessingUnit, ScalingType, TransformType
+from ..ir.compile import resolve_batch_fuse
 from ..verify import breaker
 from .batcher import (
     PlanCache,
@@ -486,7 +487,6 @@ class TransformService:
                 survivors = self._shed_expired(survivors)
                 if not survivors:
                     return
-                plans = entry.lease(len(survivors), self._clone_plan)
                 obs.trace.event(
                     "serve", what="dispatch", engine=engine,
                     occupancy=len(survivors), attempt=attempt,
@@ -494,7 +494,10 @@ class TransformService:
                 try:
                     with faults.typed_execution(platform, "serve dispatch"):
                         faults.site("serve.dispatch")
-                        results = run_batch(plans[: len(survivors)], survivors)
+                        results = run_batch(
+                            entry, survivors, self._clone_plan,
+                            batch_cap=self._batch_cap(entry),
+                        )
                 except RETRYABLE_ERRORS as e:
                     observed_failure = True
                     attempt += 1
@@ -605,6 +608,37 @@ class TransformService:
                     direction=survivors[0].direction,
                     occupancy=len(survivors),
                 )
+                if not supervised and entry.plan._exec._ir.batch_available():
+                    # batch-fused entry: the scheduler sees the whole batch
+                    # as ONE task (one stacked dispatch, one finalize, one
+                    # ladder) — no plan clones leased. Forward groups by
+                    # scaling (the batched program is scaling-specialized);
+                    # the tuner-owned cap chunks oversized batches.
+                    for chunk in _batch_chunks(
+                        survivors, self._batch_cap(entry)
+                    ):
+                        deadlines = [r.deadline for r in chunk]
+                        # no bucket padding here (unlike run_batch's fused
+                        # arm): the scheduler's demote rung and split-phase
+                        # fallback iterate the payload per request, so pad
+                        # rows would be recomputed on the already-degraded
+                        # path — sched mode accepts per-size specialization
+                        tid = graph.add(
+                            chunk[0].direction,
+                            payload=[r.payload for r in chunk],
+                            scaling=chunk[0].scaling, transform=entry.plan,
+                            # the TASK deadline is the latest in the chunk (a
+                            # batch must not shed early for its most urgent
+                            # member); each member's OWN deadline is
+                            # re-checked at resolution below, so coalescing
+                            # never weakens the per-request contract
+                            deadline=None
+                            if any(d is None for d in deadlines)
+                            else max(deadlines),
+                            batch=True,
+                        )
+                        jobs.append((tid, chunk, engine, supervised, True))
+                    continue
                 plans = entry.lease(len(survivors), self._clone_plan)
                 for plan, req in zip(plans, survivors):
                     tid = graph.add(
@@ -612,7 +646,7 @@ class TransformService:
                         scaling=req.scaling, transform=plan,
                         deadline=req.deadline,
                     )
-                    jobs.append((tid, req, engine, supervised))
+                    jobs.append((tid, [req], engine, supervised, False))
             if not jobs:
                 return  # the finally releases any held probes verdict-less
             obs.trace.event(
@@ -626,42 +660,78 @@ class TransformService:
                     on_error="resolve", backoff_s=self.backoff_s,
                     backoff_rng=self._retry_rng,
                 )
-            for tid, req, engine, supervised in jobs:
+            for tid, reqs, engine, supervised, is_batch in jobs:
                 outcome = report.outcomes[tid]
                 err = report.errors.get(tid)
                 if outcome in ("completed", "demoted"):
                     result = report.results[tid]
-                    if req.direction == "forward":
-                        result = _to_request_order(req, result)
+                    # batch tasks resolve a request-aligned result list;
+                    # per-request tasks wrap their single result
+                    results = result if is_batch else [result]
                     if outcome == "demoted":
                         # the scheduler's reference rung answered: correct
                         # data over a failed primary — an engine-health signal
-                        self._count_only("demoted")
-                        obs.counter(
-                            "serve_demotions_total", engine=engine
-                        ).inc()
-                        obs.trace.event(
-                            "serve", what="demote", engine=engine,
-                            tenant=req.tenant,
-                        )
                         if not supervised:
                             engines[engine]["failed"] = True
-                    if req.ticket.resolve(result):
-                        self._observe_completion(req)
+                    now = time.monotonic()
+                    for req, res in zip(reqs, results):
+                        if is_batch and req.expired(now):
+                            # the batch task ran under its LATEST member's
+                            # deadline; a member whose own deadline expired
+                            # meanwhile keeps the per-request contract —
+                            # deadline_miss, exactly as if it had been shed
+                            # pre-dispatch (per-request tasks enforce this
+                            # inside the executor instead)
+                            obs.counter(
+                                "serve_deadline_misses_total",
+                                tenant=req.tenant,
+                            ).inc()
+                            obs.counter(
+                                "serve_sheds_total", reason="deadline"
+                            ).inc()
+                            obs.trace.event(
+                                "serve", what="shed", reason="deadline",
+                                tenant=req.tenant,
+                            )
+                            if req.ticket.fail(
+                                DeadlineExceededError(
+                                    "request deadline expired inside a "
+                                    "batched dispatch"
+                                ),
+                                outcome="deadline_miss",
+                            ):
+                                self._count("deadline_miss", req.tenant)
+                            continue
+                        if req.direction == "forward":
+                            res = _to_request_order(req, res)
+                        if outcome == "demoted":
+                            self._count_only("demoted")
+                            obs.counter(
+                                "serve_demotions_total", engine=engine
+                            ).inc()
+                            obs.trace.event(
+                                "serve", what="demote", engine=engine,
+                                tenant=req.tenant,
+                            )
+                        if req.ticket.resolve(res):
+                            self._observe_completion(req)
                 elif isinstance(err, DeadlineExceededError):
                     # expired between retry attempts inside the executor:
                     # the same accounting as a pre-dispatch shed — and NOT
                     # an engine-health failure
-                    obs.counter(
-                        "serve_deadline_misses_total", tenant=req.tenant
-                    ).inc()
-                    obs.counter("serve_sheds_total", reason="deadline").inc()
-                    obs.trace.event(
-                        "serve", what="shed", reason="deadline",
-                        tenant=req.tenant,
-                    )
-                    if req.ticket.fail(err, outcome="deadline_miss"):
-                        self._count("deadline_miss", req.tenant)
+                    for req in reqs:
+                        obs.counter(
+                            "serve_deadline_misses_total", tenant=req.tenant
+                        ).inc()
+                        obs.counter(
+                            "serve_sheds_total", reason="deadline"
+                        ).inc()
+                        obs.trace.event(
+                            "serve", what="shed", reason="deadline",
+                            tenant=req.tenant,
+                        )
+                        if req.ticket.fail(err, outcome="deadline_miss"):
+                            self._count("deadline_miss", req.tenant)
                 else:
                     if not supervised:
                         engines[engine]["failed"] = True
@@ -669,8 +739,9 @@ class TransformService:
                         as_typed(err, platform) if err is not None
                         else ServiceOverloadError("scheduled task unresolved")
                     )
-                    if req.ticket.fail(err):
-                        self._count("failed", req.tenant)
+                    for req in reqs:
+                        if req.ticket.fail(err):
+                            self._count("failed", req.tenant)
             # settle the breakers with this cycle's verdicts (supervised
             # plans' supervisors already reported theirs)
             settled = True
@@ -686,6 +757,34 @@ class TransformService:
                 for engine, state in engines.items():
                     if not state["supervised"]:
                         breaker.release_probe(engine)
+
+    def _batch_cap(self, entry):
+        """The tuner-owned fused batch size of one cache entry (``None`` =
+        uncapped), resolved lazily on the entry's first dispatch through the
+        ``fused/bN`` wisdom axis (:func:`spfft_tpu.tuning.tuned_batch`) —
+        zero trials on a warm store, model fallback (uncapped) where trials
+        are skipped. Entries outside the tuned policy, or without a live
+        batch-fused path, stay uncapped for free."""
+        from .batcher import _UNSET
+
+        if entry.batch_cap is not _UNSET:
+            return entry.batch_cap
+        plan = entry.plan
+        cap, record = None, None
+        if (
+            getattr(plan, "_policy", "default") == "tuned"
+            and plan._verifier is None
+            and plan._exec._ir.batch_available()
+        ):
+            from .. import tuning
+
+            choice, record = tuning.tuned_batch(
+                plan, batch_max=self.batch_max
+            )
+            cap = choice.get("batch")
+        entry.batch_cap = cap
+        entry.batch_record = record
+        return cap
 
     def _shed_expired(self, batch: list) -> list:
         now = time.monotonic()
@@ -802,6 +901,9 @@ class TransformService:
                 "threaded": self._worker is not None,
                 "sched": self.sched,
                 "sched_batches": self.sched_batches,
+                # the serving batch-fuse A/B flag (read at call time, so it
+                # reflects the knob the NEXT dispatch cycle will honor)
+                "batch_fuse": resolve_batch_fuse()[0],
             },
             "plan_cache": cache,
             "breakers": {e: breaker.describe(e) for e in engines},
@@ -847,6 +949,21 @@ class TransformService:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+def _batch_chunks(requests: list, cap) -> list:
+    """Split one coalesced batch into batch-task chunks: grouped by scaling
+    (the batched forward program is scaling-specialized; backward groups
+    are trivially uniform), then cut to the tuner-owned cap."""
+    groups: dict = {}
+    for r in requests:
+        groups.setdefault((r.direction, r.scaling), []).append(r)
+    chunks = []
+    for reqs in groups.values():
+        step = len(reqs) if not cap else max(1, int(cap))
+        for i in range(0, len(reqs), step):
+            chunks.append(reqs[i : i + step])
+    return chunks
 
 
 def _default_dtype():

@@ -366,6 +366,230 @@ class Transform:
         self._space_data = out
         return out
 
+    # ---- batch-fused execution (SPFFT_TPU_BATCH_FUSE, spfft_tpu.ir) -----------
+
+    def backward_batch(self, values_batch, *, fallback: bool = True,
+                       count: int | None = None):
+        """Execute B same-plan backward transforms as ONE batched fused
+        program per direction (``SPFFT_TPU_BATCH_FUSE``): the packed value
+        arrays stack along a leading batch axis, the whole batch pays one
+        dispatch, and the stacked staging buffers are donated. Returns the
+        per-request space arrays in batch order.
+
+        Degradation: a batched build/compile failure records
+        ``batch_fuse_failed`` on the plan card and — with ``fallback=True``
+        — the batch re-runs as today's per-request split-phase loop, never a
+        failed batch. ``fallback=False`` returns ``None`` instead, for
+        callers (the serving batcher) that own a richer fallback path.
+        ``count`` marks the first N entries as the REAL requests of a
+        bucket-padded batch (the serving batcher's jit-specialization
+        bound): only those are counted, guard-checked and returned — the
+        padding tail is dispatch ballast. Verified plans always run
+        per-request under their supervisor (the ABFT ladder owns each
+        request's attempt). The retained space buffer
+        (:meth:`space_domain_data`) is left untouched by the batched path."""
+        values_batch = list(values_batch)
+        count = _resolve_batch_count(count, len(values_batch))
+        if not values_batch:
+            return []
+        if self._verifier is not None:
+            return [self.backward(v) for v in values_batch[:count]]
+        plat = self._device.platform
+        obs.counter(
+            "transforms_total", direction="backward", engine=self._engine
+        ).inc(count)
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="backward",
+        ), timing.scoped("backward"):
+            if self._guard:
+                for v in values_batch[:count]:
+                    faults.check_array(
+                        np.asarray(v), check="backward input", platform=plat
+                    )
+            pending = self._dispatch_backward_batch(
+                values_batch, fallback=fallback, count=count
+            )
+            if pending is None:
+                return None
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="backward"
+            ), faults.typed_execution(plat, "backward wait"):
+                fence(pending)
+            with timing.scoped("output staging"):
+                results = self._finalize_backward_batch(pending)[:count]
+            if self._guard:
+                if "batched" in pending:
+                    faults.check_device(
+                        pending["batched"], self._device,
+                        check="backward output", platform=plat,
+                    )
+                for result in results:
+                    faults.check_array(
+                        result,
+                        check="backward output",
+                        platform=plat,
+                        shape=(self.dim_z, self.dim_y, self.dim_x),
+                        dtype=self._real_dtype
+                        if self._is_r2c
+                        else _complex_dtype(self._real_dtype),
+                    )
+            return results
+
+    def forward_batch(
+        self,
+        spaces,
+        scaling: ScalingType = ScalingType.NONE,
+        *,
+        fallback: bool = True,
+        count: int | None = None,
+    ):
+        """Batched counterpart of :meth:`forward` over explicit space
+        arrays: B ``(Z, Y, X)`` slabs -> B packed complex value arrays
+        through one batched fused program (same contract, knob, degradation
+        rung and ``count`` padding semantics as :meth:`backward_batch`; one
+        ``scaling`` for the whole batch — the serving batcher groups by
+        scaling)."""
+        spaces = list(spaces)
+        count = _resolve_batch_count(count, len(spaces))
+        if not spaces:
+            return []
+        if self._verifier is not None:
+            return [self.forward(s, scaling) for s in spaces[:count]]
+        plat = self._device.platform
+        obs.counter(
+            "transforms_total", direction="forward", engine=self._engine
+        ).inc(count)
+        with obs.trace.operation(
+            "execute", run_id=self._run_id, direction="forward",
+        ), timing.scoped("forward"):
+            if self._guard:
+                for s in spaces[:count]:
+                    faults.check_array(
+                        np.asarray(s), check="forward input", platform=plat
+                    )
+            pending = self._dispatch_forward_batch(
+                spaces, scaling, fallback=fallback, count=count
+            )
+            if pending is None:
+                return None
+            with timing.scoped("wait"), obs.phase_timer(
+                "wait_seconds", direction="forward"
+            ), faults.typed_execution(plat, "forward wait"):
+                fence(pending)
+            with timing.scoped("output staging"):
+                results = self._finalize_forward_batch(pending)[:count]
+            if self._guard:
+                for result in results:
+                    faults.check_array(
+                        result,
+                        check="forward output",
+                        platform=plat,
+                        shape=(self.num_local_elements,),
+                        dtype=_complex_dtype(self._real_dtype),
+                    )
+            return results
+
+    def _dispatch_backward_batch(self, values_batch, *, fallback: bool = True,
+                                 count: int | None = None):
+        """Stage + enqueue one batch without waiting. Returns the pending
+        handle :meth:`_finalize_backward_batch` completes: ``{"batched":
+        stacked}`` after ONE batched dispatch, or ``{"loop": [...]}`` of
+        per-request split-phase pendings (the rung / knob-off path;
+        ``fallback=False`` returns ``None`` there instead; the loop skips a
+        bucket-padded tail — only the batched program needs it)."""
+        count = _resolve_batch_count(count, len(values_batch))
+        n = self._params.num_values
+        rows = []
+        for values in values_batch:
+            values = np.asarray(values)
+            if values.size != n:
+                raise InvalidParameterError(
+                    f"expected {n} frequency values, got {values.size}"
+                )
+            rows.append(values.reshape(n))
+        out = None
+        if self._exec._ir.batch_available():
+            with timing.scoped("input staging"):
+                re, im = as_pair(np.stack(rows), self._real_dtype)
+                re, im = self._exec.put(re), self._exec.put(im)
+            with timing.scoped("dispatch"), obs.phase_timer(
+                "dispatch_seconds", direction="backward"
+            ), faults.typed_execution(
+                self._device.platform, "backward dispatch"
+            ):
+                out = self._exec.backward_pair_batch_consuming(re, im)
+                if out is not None:
+                    out = faults.site("engine.execute", payload=out)
+        if out is not None:
+            return {"batched": out}
+        if not fallback:
+            return None
+        # the split-phase rung: every dispatch enqueued back-to-back on this
+        # plan before any finalize (retained state is not read mid-batch)
+        return {"loop": [self._dispatch_backward(v) for v in rows[:count]]}
+
+    def _finalize_backward_batch(self, pending):
+        if "loop" in pending:
+            return [self._finalize_backward(p) for p in pending["loop"]]
+        out = pending["batched"]
+        if self._is_r2c:
+            arr = self._exec.fetch(out)
+        else:
+            arr = self._exec.fetch_space_complex(out)
+        if self._native_transposed:
+            arr = arr.transpose(0, 3, 1, 2)  # (B, Y, X, Z) -> (B, Z, Y, X)
+        return [arr[b] for b in range(arr.shape[0])]
+
+    def _dispatch_forward_batch(
+        self, spaces, scaling, *, fallback: bool = True,
+        count: int | None = None,
+    ):
+        """Split-phase forward half of the batched flow (see
+        :meth:`_dispatch_backward_batch`)."""
+        count = _resolve_batch_count(count, len(spaces))
+        p = self._params
+        slabs = [
+            np.asarray(s).reshape(p.dim_z, p.dim_y, p.dim_x) for s in spaces
+        ]
+        out = None
+        if self._exec._ir.batch_available():
+            with timing.scoped("input staging"):
+                stack = np.stack(slabs)
+                if self._native_transposed:
+                    stack = stack.transpose(0, 2, 3, 1)  # (B,Z,Y,X)->(B,Y,X,Z)
+                if self._is_r2c:
+                    re = self._exec.put(
+                        np.ascontiguousarray(stack.real, dtype=self._real_dtype)
+                    )
+                    im = None
+                else:
+                    re, im = as_pair(stack, self._real_dtype)
+                    re, im = self._exec.put(re), self._exec.put(im)
+            with timing.scoped("dispatch"), obs.phase_timer(
+                "dispatch_seconds", direction="forward"
+            ), faults.typed_execution(
+                self._device.platform, "forward dispatch"
+            ):
+                out = self._exec.forward_pair_batch(
+                    re, im, ScalingType(scaling)
+                )
+                if out is not None:
+                    out = faults.site("engine.execute", payload=out)
+        if out is not None:
+            return {"batched": out}
+        if not fallback:
+            return None
+        return {
+            "loop": [self._dispatch_forward(s, scaling) for s in slabs[:count]]
+        }
+
+    def _finalize_forward_batch(self, pending):
+        if "loop" in pending:
+            return [self._finalize_forward(p) for p in pending["loop"]]
+        re, im = pending["batched"]
+        arr = from_pair((re, im))
+        return [arr[b] for b in range(arr.shape[0])]
+
     def forward(
         self,
         space=None,
@@ -682,6 +906,19 @@ class Transform:
         if self._space_data is not None:
             with faults.typed_execution(self._device.platform, "synchronize"):
                 fence(self._space_data)
+
+
+def _resolve_batch_count(count, size: int) -> int:
+    """The REAL-request count of a (possibly bucket-padded) batch: default
+    = the whole batch; an explicit count must address a non-empty prefix."""
+    if count is None:
+        return size
+    count = int(count)
+    if not 0 < count <= size:
+        raise InvalidParameterError(
+            f"batch count= must be in [1, {size}], got {count}"
+        )
+    return count
 
 
 def _validate_pu(pu) -> None:

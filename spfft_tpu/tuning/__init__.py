@@ -49,12 +49,15 @@ from .runner import (  # noqa: F401
     TUNE_REPEATS_ENV,
     TUNE_WARMUP_ENV,
     TrialTimeout,
+    measure_batch_seconds,
+    run_batch_trials,
     run_trials,
     trial_budget,
     trial_deadline_s,
     trials_allowed,
 )
 from .candidates import (  # noqa: F401
+    batch_candidates,
     exchange_candidates,
     local_candidates,
     sched_candidates,
@@ -389,6 +392,100 @@ def tuned_local(params, device, dtype, precision, build, fuse=None):
         )
     best = measured[0]
     choice = {"label": best["label"], "engine": best["engine"], "env": best["env"]}
+    store.record(key, make_entry(key, choice, trials))
+    return dict(choice), _record(
+        "wisdom",
+        hit=False,
+        store=store,
+        choice=choice,
+        trials=trials,
+        reason=store.fallback_reason or "measured",
+        key=key,
+    )
+
+
+def batch_key(params, device, dtype, precision, batch_max) -> dict:
+    """Wisdom key for the fused batch-size axis: the local-plan decision
+    key plus the batcher's coalescing bound (it caps the candidate list, so
+    a cap change is a different decision problem — the ``overlap`` pin
+    rule)."""
+    key = _base_key(
+        "batch",
+        params.transform_type,
+        (params.dim_x, params.dim_y, params.dim_z),
+        dtype,
+        "auto",
+        precision,
+    )
+    key.update(
+        {
+            "platform": str(device.platform),
+            "num_sticks": int(params.num_sticks),
+            "num_elements": int(params.num_values),
+            "sparsity_signature": sparsity_signature(
+                params.stick_x, params.stick_y, params.value_indices
+            ),
+            "batch_max": None if batch_max is None else int(batch_max),
+        }
+    )
+    return key
+
+
+def tuned_batch(transform, batch_max=None):
+    """Resolve the fused batch-size axis (``fused/bN``) for ``transform``.
+
+    Returns ``(choice, record)``: ``choice["batch"]`` is the measured batch
+    size the serving batcher chunks coalesced batches to, or ``None`` for
+    uncapped (every model fallback — trials skipped on CPU-only hosts,
+    batch fusion unavailable, all candidates failed — keeps today's
+    whole-batch behavior). Same hit/trial/model ladder as
+    :func:`tuned_local`; trials run on the plan's OWN batched programs
+    (:func:`spfft_tpu.tuning.runner.run_batch_trials` — seconds per
+    transform, wall / B), and the winner persists in wisdom so a warm store
+    reproduces the cap with zero trials."""
+    key = batch_key(
+        transform._params, transform.device, transform.dtype,
+        transform._precision, batch_max,
+    )
+    store = active_store()
+
+    def model(reason, trials=()):
+        choice = {"label": "fused/uncapped", "batch": None}
+        return choice, _record(
+            "model",
+            hit=False,
+            store=store,
+            choice=choice,
+            trials=list(trials),
+            reason=reason,
+            key=key,
+        )
+
+    entry = store.lookup(key)
+    if entry is not None:
+        return dict(entry["choice"]), _record(
+            "wisdom",
+            hit=True,
+            store=store,
+            choice=entry["choice"],
+            trials=entry.get("trials", []),
+            reason="wisdom hit",
+            key=key,
+        )
+    platform = str(transform.device.platform)
+    if not trials_allowed(platform):
+        return model(
+            store.fallback_reason
+            or f"trials skipped on CPU-only host (set {TUNE_CPU_ENV}=1 to allow)"
+        )
+    if not transform._exec._ir.batch_available():
+        return model("batch fusion unavailable on this plan")
+    trials = run_batch_trials(transform, batch_candidates(batch_max))
+    measured = [row for row in trials if "ms" in row]
+    if not measured:
+        return model("all trial candidates failed", trials)
+    best = measured[0]
+    choice = {"label": best["label"], "batch": int(best["batch"])}
     store.record(key, make_entry(key, choice, trials))
     return dict(choice), _record(
         "wisdom",

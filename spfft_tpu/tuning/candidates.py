@@ -139,6 +139,33 @@ def sched_candidates(num_devices: int) -> list:
     return [{"label": f"rr{w}", "width": int(w)} for w in widths]
 
 
+# Fused batch sizes the batch axis trials when the caller leaves the knob
+# to the tuner: 1 is the per-request dispatch shape (the tuner must be
+# allowed to say batching loses — on core-shared CPU meshes it sometimes
+# does), small powers of two amortize per-dispatch overhead, and the
+# serving batcher's batch_max bounds the list from above.
+BATCH_CANDIDATE_SIZES = (1, 4, 8)
+
+
+def batch_candidates(batch_max=None) -> list:
+    """Fused-batch-size candidates (``fused/bN``) for the batch-fused
+    dispatch axis (:func:`spfft_tpu.tuning.tuned_batch`): how many
+    same-geometry transforms one stacked program runs per dispatch. The
+    measurement unit is seconds per TRANSFORM (wall / B), so candidates
+    compare like for like; the winner persists in wisdom next to the
+    fused/staged axis and the serving batcher chunks its coalesced batches
+    to it. ``batch_max`` (the batcher's coalescing bound) caps the list —
+    a batch the batcher can never assemble is not worth a trial."""
+    sizes = [
+        b
+        for b in BATCH_CANDIDATE_SIZES
+        if batch_max is None or b <= int(batch_max)
+    ]
+    if not sizes:
+        sizes = [1]
+    return [{"label": f"fused/b{b}", "batch": int(b)} for b in sizes]
+
+
 def local_candidates(platform: str, dtype=None, fuse=None) -> list:
     """Local-plan candidates: engine x sparse-y-knob x fusion variants.
 

@@ -250,3 +250,100 @@ def run_trials(build, candidates: list) -> list:
         row["ms"] = round(seconds * 1e3, 4)
         rows.append(row)
     return sorted(rows, key=lambda r: r["ms"]) + failed
+
+
+def _stage_batch_inputs(transform, batch: int):
+    """The plan's exact-shape trial inputs stacked ``batch`` times along the
+    batch axis, staged on device (local plans — the batch axis the serving
+    layer tunes is a local-plan surface)."""
+    import jax.numpy as jnp
+
+    re, im = _stage_inputs(transform)
+    return (
+        jnp.stack([re] * max(1, int(batch))),
+        jnp.stack([im] * max(1, int(batch))),
+    )
+
+
+def measure_batch_seconds(transform, batch: int) -> float:
+    """Best-of-repeats seconds per TRANSFORM (wall / batch) through the
+    batch-fused dispatch path: one stacked backward+forward program dispatch
+    per roundtrip. Raises :class:`TrialDegradedError` when the batched path
+    is unavailable or takes its rung mid-trial — timing the per-request
+    fallback loop under a ``fused/bN`` label would poison wisdom with a
+    mislabeled number (the ``TrialDegradedError`` rule)."""
+    import jax
+
+    from ..sync import fence
+    from ..types import ScalingType
+
+    batch = max(1, int(batch))
+    warmup, repeats = trial_budget()
+    re, im = _stage_batch_inputs(transform, batch)
+    ex = transform._exec
+
+    def roundtrip():
+        out = ex.backward_pair_batch(re, im)
+        if out is None:
+            raise TrialDegradedError(
+                "batch-fused path unavailable: timing would measure the "
+                "per-request loop, not the fused/bN candidate"
+            )
+        if transform._is_r2c:
+            space_re, space_im = out, None
+        else:
+            space_re, space_im = out
+        pair = ex.forward_pair_batch(space_re, space_im, ScalingType.FULL)
+        if pair is None:
+            raise TrialDegradedError(
+                "batch-fused forward unavailable mid-trial"
+            )
+        fence(pair)
+        return pair
+
+    with jax.named_scope("tune warmup"):
+        for _ in range(warmup):
+            roundtrip()
+    best = float("inf")
+    for _ in range(repeats):
+        with jax.named_scope("tune trial"), obs.phase_timer(
+            "tuning_trial_seconds"
+        ):
+            t0 = time.perf_counter()
+            roundtrip()
+            best = min(best, time.perf_counter() - t0)
+    return best / batch
+
+
+def run_batch_trials(transform, candidates: list) -> list:
+    """Measure the ``fused/bN`` batch-size candidates on ``transform``'s OWN
+    batched programs (no trial plan builds — a batched program is per-plan
+    state, so the plan being tuned IS the trial vehicle). Same isolation
+    contract as :func:`run_trials`: per-candidate failures become ``error``
+    rows (``TRIAL_ERRORS`` only), measured rows sort fastest-first, fault
+    site ``tuning.trial`` fires inside the scope."""
+    rows, failed = [], []
+    for cand in candidates:
+        try:
+            with obs.trace.operation(
+                "tune.trial", label=cand["label"]
+            ), obs.trace.suppressed_dumps():
+
+                def _trial(cand=cand):
+                    faults.site("tuning.trial")
+                    return measure_batch_seconds(transform, cand["batch"])
+
+                seconds = _run_deadlined(
+                    _trial, trial_deadline_s(), cand["label"]
+                )
+        except TRIAL_ERRORS as e:
+            obs.counter(
+                "tuning_trial_failures_total", candidate=cand["label"]
+            ).inc()
+            failed.append(dict(cand, error=faults.summarize(e)))
+            continue
+        obs.counter("tuning_trials_total", candidate=cand["label"]).inc()
+        row = dict(cand)
+        row["ms"] = round(seconds * 1e3, 4)
+        rows.append(row)
+    return sorted(rows, key=lambda r: r["ms"]) + failed

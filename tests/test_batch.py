@@ -1,0 +1,553 @@
+"""Batch-fused execution (SPFFT_TPU_BATCH_FUSE, spfft_tpu.ir batch axis).
+
+Five contracts:
+
+1. **Batched == looped parity fuzz** over {C2C, R2C} x {f32, f64} x
+   {local xla, local mxu, slab, pencil} on random (ragged-membership)
+   sparse sets, seeded through the ``SPFFT_TPU_FUZZ_SEED`` machinery.
+2. **One dispatch per batch per direction** —
+   ``ir_dispatches_total{mode="batched"}`` counts exactly 1 for a whole
+   batch, locally and on the 4-device meshes.
+3. **Degradation** — fault site ``ir.batch`` armed: the batch degrades to
+   the split-phase per-request loop with ``batch_fuse_failed`` on the plan
+   card and parity intact — never a failed batch; the knob is
+   typed-validated and ``0`` disables cleanly.
+4. **Tuner-owned batch size** — ``fused/bN`` candidates measured on the
+   plan's own batched programs, winner persisted in wisdom, warm store
+   reproduces with zero trials.
+5. **Serving integration** — the coalescing batcher routes same-geometry
+   batches (per-caller value orders bridged by order maps) through ONE
+   stacked program with NO plan clones leased (the lazy-leasing bugfix);
+   the legacy loop still leases; chaos on ``ir.batch`` keeps every ticket
+   resolving correctly; sched-mode runs a batch as one task.
+"""
+import numpy as np
+import pytest
+
+from spfft_tpu import (
+    DistributedTransform,
+    ProcessingUnit,
+    ScalingType,
+    Transform,
+    TransformType,
+    faults,
+    obs,
+    tuning,
+)
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parallel.mesh import make_fft_mesh, make_fft_mesh2
+from spfft_tpu.parameters import distribute_triplets
+from test_ir import _case_values, _tol, case_id, fuzz_rng
+from utils import random_sparse_triplets
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("SPFFT_TPU_BATCH_FUSE", raising=False)
+    monkeypatch.delenv("SPFFT_TPU_FUSE", raising=False)
+    yield
+
+
+def _batched_counts():
+    out = {}
+    for key, value in obs.snapshot()["counters"].items():
+        if not key.startswith("ir_dispatches_total"):
+            continue
+        for direction in ("backward", "forward"):
+            if f'mode="batched"' in key and f'direction="{direction}"' in key:
+                out[direction] = value
+    return out
+
+
+def _delta(before, after):
+    return {
+        d: after.get(d, 0) - before.get(d, 0) for d in ("backward", "forward")
+    }
+
+
+def _batch_values(rng, trip, dims, r2c, dtype, batch):
+    return [_case_values(rng, trip, dims, r2c, dtype) for _ in range(batch)]
+
+
+# ---------------------------------------------------------------------------
+# parity fuzz: batched vs looped, local engines
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("r2c", [False, True])
+@pytest.mark.parametrize("engine", ["xla", "mxu"])
+def test_parity_batched_vs_looped_local(dtype, r2c, engine):
+    rng = fuzz_rng(11000, case_id(np.dtype(dtype).name, r2c, engine))
+    dims = (
+        int(rng.integers(6, 11)),
+        int(rng.integers(6, 11)),
+        int(rng.integers(6, 12)),
+    )
+    trip = random_sparse_triplets(
+        rng, *dims, float(rng.uniform(0.4, 0.9)), hermitian=r2c
+    )
+    tt = TransformType.R2C if r2c else TransformType.C2C
+    B = int(rng.integers(2, 5))
+    vals = _batch_values(rng, trip, dims, r2c, dtype, B)
+
+    t = Transform(
+        ProcessingUnit.HOST, tt, *dims, indices=trip, dtype=dtype,
+        engine=engine, fuse=True,
+    )
+    ref = Transform(
+        ProcessingUnit.HOST, tt, *dims, indices=trip, dtype=dtype,
+        engine=engine, fuse=True,
+    )
+    before = _batched_counts()
+    outs = t.backward_batch(vals)
+    fwd = t.forward_batch(outs, ScalingType.FULL)
+    after = _batched_counts()
+    # the single-dispatch proof: ONE batched program call per direction for
+    # the whole batch
+    assert _delta(before, after) == {"backward": 1, "forward": 1}
+    tol = _tol(dtype)
+    for b in range(B):
+        np.testing.assert_allclose(
+            outs[b], ref.backward(vals[b]), rtol=tol, atol=tol
+        )
+        np.testing.assert_allclose(
+            fwd[b], ref.forward(scaling=ScalingType.FULL), rtol=tol, atol=tol
+        )
+    card = t.report()
+    assert card["batch"]["enabled"] and not card["batch"]["failed"]
+    assert B in card["batch"]["sizes"]
+    assert obs.validate_plan_card(card) == []
+
+
+# ---------------------------------------------------------------------------
+# parity fuzz: batched vs looped, mesh engines (ragged membership)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_case(rng, r2c, pencil):
+    dims = (
+        int(rng.integers(6, 10)),
+        int(rng.integers(6, 10)),
+        int(rng.integers(8, 13)),
+    )
+    trip = random_sparse_triplets(
+        rng, *dims, float(rng.uniform(0.4, 0.9)), hermitian=r2c
+    )
+    if pencil:
+        mesh = make_fft_mesh2(2, 2)
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        psh = distribute_triplets(
+            trip, 4, dims[1], layout=(int(ax["fft"]), int(ax["fft2"])),
+            dim_x=dims[0],
+        )
+    else:
+        mesh = make_fft_mesh(4)
+        psh = distribute_triplets(trip, 4, dims[1])
+    return dims, trip, mesh, psh
+
+
+def _per_shard_values(psh, trip, values):
+    lut = {tuple(x): v for x, v in zip(map(tuple, trip), values)}
+    return [np.asarray([lut[tuple(x)] for x in s]) for s in psh]
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("r2c", [False, True])
+@pytest.mark.parametrize("pencil", [False, True], ids=["slab", "pencil"])
+def test_parity_batched_vs_looped_mesh(dtype, r2c, pencil):
+    rng = fuzz_rng(12000, case_id(np.dtype(dtype).name, r2c, pencil))
+    dims, trip, mesh, psh = _mesh_case(rng, r2c, pencil)
+    tt = TransformType.R2C if r2c else TransformType.C2C
+    B = 2
+    batches = [
+        _per_shard_values(
+            psh, trip, _case_values(rng, trip, dims, r2c, dtype)
+        )
+        for _ in range(B)
+    ]
+    t = DistributedTransform(
+        ProcessingUnit.HOST, tt, *dims, psh, mesh=mesh, dtype=dtype,
+        fuse=True,
+    )
+    ref = DistributedTransform(
+        ProcessingUnit.HOST, tt, *dims, psh, mesh=mesh, dtype=dtype,
+        fuse=True,
+    )
+    before = _batched_counts()
+    outs = t.backward_batch(batches)
+    fwd = t.forward_batch(outs, ScalingType.FULL)
+    after = _batched_counts()
+    assert _delta(before, after) == {"backward": 1, "forward": 1}
+    tol = _tol(dtype)
+    for b in range(B):
+        np.testing.assert_allclose(
+            outs[b], ref.backward(batches[b]), rtol=tol, atol=10 * tol
+        )
+        expect = ref.forward(outs[b], ScalingType.FULL)
+        for got, want in zip(fwd[b], expect):
+            np.testing.assert_allclose(got, want, rtol=tol, atol=10 * tol)
+    assert not t.report()["batch"]["failed"]
+
+
+# ---------------------------------------------------------------------------
+# degradation: the ir.batch rung, knob surface
+# ---------------------------------------------------------------------------
+
+
+def _local_case(seed=0):
+    rng = fuzz_rng(13000, seed)
+    trip = random_sparse_triplets(rng, 8, 8, 8, 0.7)
+    vals = [
+        rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+        for _ in range(3)
+    ]
+    return trip, vals
+
+
+def test_ir_batch_fault_degrades_to_loop_with_parity():
+    trip, vals = _local_case(0)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+        fuse=True,
+    )
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    before = _batched_counts()
+    with faults.inject("ir.batch=raise"):
+        outs = t.backward_batch(vals)
+        fwd = t.forward_batch(outs, ScalingType.FULL)
+    after = _batched_counts()
+    # never a failed batch: the split-phase loop answered, zero batched
+    # dispatches, the rung on the card
+    assert _delta(before, after) == {"backward": 0, "forward": 0}
+    for b, v in enumerate(vals):
+        np.testing.assert_allclose(
+            outs[b], ref.backward(v), rtol=1e-9, atol=1e-9
+        )
+        np.testing.assert_allclose(fwd[b], v, rtol=1e-6, atol=1e-6)
+    card = t.report()
+    assert card["batch"]["failed"] and not card["batch"]["enabled"]
+    assert any(
+        d["event"] == "batch_fuse_failed" for d in card["degradations"]
+    )
+    assert obs.validate_plan_card(card) == []
+
+
+def test_batch_fuse_env_validation(monkeypatch):
+    trip, vals = _local_case(1)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    monkeypatch.setenv("SPFFT_TPU_BATCH_FUSE", "2")
+    with pytest.raises(InvalidParameterError):
+        t.backward_batch(vals)
+
+
+def test_batch_fuse_off_loops_cleanly(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_BATCH_FUSE", "0")
+    trip, vals = _local_case(2)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    before = _batched_counts()
+    outs = t.backward_batch(vals)
+    after = _batched_counts()
+    assert _delta(before, after) == {"backward": 0, "forward": 0}
+    for b, v in enumerate(vals):
+        np.testing.assert_allclose(
+            outs[b], ref.backward(v), rtol=1e-9, atol=1e-9
+        )
+    card = t.report()
+    # a disabled knob is a configuration, not a failure
+    assert not card["batch"]["enabled"] and not card["batch"]["failed"]
+    assert card["batch"]["requested"] == "env"
+
+
+def test_staged_path_has_no_batch_axis():
+    trip, vals = _local_case(3)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+        fuse=False,
+    )
+    assert not t._exec._ir.batch_available()
+    outs = t.backward_batch(vals)  # loops, no rung
+    assert len(outs) == len(vals)
+    assert not t.report()["batch"]["failed"]
+
+
+def test_batch_section_schema_pinned():
+    from spfft_tpu.ir.compile import BATCH_KEYS
+    from spfft_tpu.obs.plancard import BATCH_SECTION_KEYS
+
+    assert tuple(BATCH_KEYS) == tuple(BATCH_SECTION_KEYS)
+    trip, _ = _local_case(4)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    card = t.report()
+    assert obs.validate_plan_card(card) == []
+    del card["batch"]["sizes"]
+    assert any("batch.sizes" in m for m in obs.validate_plan_card(card))
+
+
+# ---------------------------------------------------------------------------
+# tuner-owned batch axis
+# ---------------------------------------------------------------------------
+
+
+def test_tuned_batch_axis_persists_in_wisdom(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.setenv(tuning.TUNE_CPU_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_REPEATS_ENV, "1")
+    monkeypatch.setenv(tuning.TUNE_WARMUP_ENV, "0")
+    tuning.clear_memory()
+    trip, _ = _local_case(5)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+        fuse=True,
+    )
+    choice, record = tuning.tuned_batch(t, batch_max=8)
+    assert record["provenance"] == "wisdom" and record["hit"] is False
+    measured = [row for row in record["trials"] if "ms" in row]
+    assert {row["batch"] for row in measured} <= {1, 4, 8} and measured
+    assert choice["batch"] in (1, 4, 8)
+    # warm store: zero trials, same choice
+    before = obs.snapshot()["counters"]
+    choice2, record2 = tuning.tuned_batch(t, batch_max=8)
+    after = obs.snapshot()["counters"]
+    assert record2["hit"] is True and choice2 == choice
+    trials_run = sum(
+        after.get(k, 0) - before.get(k, 0)
+        for k in after
+        if k.startswith("tuning_trials_total")
+    )
+    assert trials_run == 0
+    # a different coalescing bound is a different decision problem
+    choice3, record3 = tuning.tuned_batch(t, batch_max=2)
+    assert record3["hit"] is False
+    assert all(row["batch"] <= 2 for row in record3["trials"] if "ms" in row)
+
+
+def test_tuned_batch_model_fallback_without_cpu_trials(tmp_path, monkeypatch):
+    monkeypatch.setenv(tuning.WISDOM_ENV, str(tmp_path / "wisdom.json"))
+    monkeypatch.delenv(tuning.TUNE_CPU_ENV, raising=False)
+    tuning.clear_memory()
+    trip, _ = _local_case(6)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    choice, record = tuning.tuned_batch(t, batch_max=8)
+    assert record["provenance"] == "model" and choice["batch"] is None
+
+
+def test_batch_candidates_capped_by_batch_max():
+    cands = tuning.batch_candidates(4)
+    assert [c["batch"] for c in cands] == [1, 4]
+    assert all(c["label"] == f"fused/b{c['batch']}" for c in cands)
+    assert [c["batch"] for c in tuning.batch_candidates(None)] == [1, 4, 8]
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def _serve_case(seed=0, dims=(12, 12, 12)):
+    rng = fuzz_rng(14000, seed)
+    trip = random_sparse_triplets(rng, *dims, 0.8)
+    vals = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(
+        len(trip)
+    )
+    return rng, trip, vals
+
+
+def _submit_permuted(svc, rng, trip, vals, dims, n, **kw):
+    tickets = []
+    for i in range(n):
+        perm = rng.permutation(len(trip))
+        tickets.append(
+            svc.submit(
+                TransformType.C2C, dims, trip[perm], vals[perm],
+                tenant=f"t{i % 2}", **kw,
+            )
+        )
+    return tickets
+
+
+def test_serve_batch_fused_no_clones_and_order_maps():
+    from spfft_tpu.serve import TransformService
+
+    dims = (12, 12, 12)
+    rng, trip, vals = _serve_case(0, dims)
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, *dims, indices=trip,
+    )
+    expect = ref.backward(vals)
+    with TransformService(start=False, batch_max=8) as svc:
+        before = _batched_counts()
+        tickets = _submit_permuted(svc, rng, trip, vals, dims, 6)
+        svc.pump()
+        after = _batched_counts()
+        for tk in tickets:
+            np.testing.assert_allclose(
+                tk.result(timeout=60), expect, rtol=2e-4, atol=2e-4
+            )
+        entry = next(iter(svc.plans._entries.values()))
+        # the lazy-leasing bugfix: a batch-fused entry never builds the
+        # clone pool it would never use
+        assert entry.clones == []
+        assert after.get("backward", 0) - before.get("backward", 0) >= 1
+        assert svc.describe()["config"]["batch_fuse"] is True
+
+
+def test_serve_legacy_loop_still_leases(monkeypatch):
+    from spfft_tpu.serve import TransformService
+
+    monkeypatch.setenv("SPFFT_TPU_BATCH_FUSE", "0")
+    dims = (12, 12, 12)
+    rng, trip, vals = _serve_case(1, dims)
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, *dims, indices=trip,
+    )
+    expect = ref.backward(vals)
+    with TransformService(start=False, batch_max=4) as svc:
+        tickets = _submit_permuted(svc, rng, trip, vals, dims, 4)
+        svc.pump()
+        for tk in tickets:
+            np.testing.assert_allclose(
+                tk.result(timeout=60), expect, rtol=2e-4, atol=2e-4
+            )
+        entry = next(iter(svc.plans._entries.values()))
+        assert len(entry.clones) == 3  # batch of 4 leased the pool
+        assert svc.describe()["config"]["batch_fuse"] is False
+
+
+def test_serve_chaos_ir_batch_every_ticket_resolves():
+    from spfft_tpu.serve import TransformService
+
+    dims = (12, 12, 12)
+    rng, trip, vals = _serve_case(2, dims)
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, *dims, indices=trip,
+    )
+    expect = ref.backward(vals)
+    with faults.inject("ir.batch=raise"):
+        with TransformService(start=False, batch_max=8) as svc:
+            tickets = _submit_permuted(svc, rng, trip, vals, dims, 5)
+            svc.pump()
+            for tk in tickets:
+                np.testing.assert_allclose(
+                    tk.result(timeout=60), expect, rtol=2e-4, atol=2e-4
+                )
+            assert svc.stats()["counts"].get("failed", 0) == 0
+
+
+def test_serve_sched_mode_batch_as_one_task():
+    from spfft_tpu.serve import TransformService
+
+    dims = (12, 12, 12)
+    rng, trip, vals = _serve_case(3, dims)
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, *dims, indices=trip,
+    )
+    expect = ref.backward(vals)
+    with TransformService(start=False, batch_max=8, sched=True) as svc:
+        before = _batched_counts()
+        tickets = _submit_permuted(svc, rng, trip, vals, dims, 4)
+        svc.pump()
+        after = _batched_counts()
+        for tk in tickets:
+            np.testing.assert_allclose(
+                tk.result(timeout=60), expect, rtol=2e-4, atol=2e-4
+            )
+        # one batch task -> one batched dispatch for the whole cycle
+        assert after.get("backward", 0) - before.get("backward", 0) == 1
+        entry = next(iter(svc.plans._entries.values()))
+        assert entry.clones == []
+
+
+def test_sched_batch_task_demotes_per_request():
+    """A batch task whose primary dispatch fails demotes through the
+    per-request reference rung — correct results, one demoted outcome."""
+    from spfft_tpu import sched
+
+    trip, vals = _local_case(7)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    ref = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    graph = sched.TaskGraph()
+    tid = graph.add("backward", payload=list(vals), transform=t, batch=True)
+    with faults.inject("sched.run=raise"):
+        report = sched.run_graph(graph, retries=0, demote=True)
+    assert report.outcomes[tid] == "demoted"
+    results = report.results[tid]
+    for b, v in enumerate(vals):
+        np.testing.assert_allclose(
+            results[b], ref.backward(v), rtol=1e-9, atol=1e-9
+        )
+
+
+def test_batch_task_validation_typed():
+    from spfft_tpu import sched
+
+    trip, vals = _local_case(8)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    graph = sched.TaskGraph()
+    with pytest.raises(InvalidParameterError):
+        graph.add("backward", payload=[], transform=t, batch=True)
+    with pytest.raises(InvalidParameterError):
+        graph.add("backward", payload=vals[0], transform=t, batch=True)
+    with pytest.raises(InvalidParameterError):
+        graph.add(
+            "backward", payload=list(vals),
+            spec={"transform_type": "C2C"}, batch=True,
+        )
+
+
+def test_guard_mode_scans_batched_outputs():
+    """Guard-armed plans keep output poison detection on the batched path:
+    a corrupted batched dispatch surfaces typed, never as silent data."""
+    from spfft_tpu.errors import GenericError
+
+    trip, vals = _local_case(9)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+        guard=True,
+    )
+    outs = t.backward_batch(vals)  # clean batch passes all checks
+    assert len(outs) == len(vals)
+    with faults.inject("engine.execute=corrupt"):
+        with pytest.raises(GenericError):
+            t.backward_batch(vals)
+
+
+def test_batch_count_marks_padding_tail():
+    """count= (the serving bucket-padding contract): only the real prefix
+    is counted, guard-checked and returned."""
+    trip, vals = _local_case(10)
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8, indices=trip,
+    )
+    padded = vals + [vals[-1]]  # bucket 4 from 3 real requests
+    before = obs.snapshot()["counters"]
+    outs = t.backward_batch(padded, count=3)
+    after = obs.snapshot()["counters"]
+    assert len(outs) == 3
+    grown = {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in after
+        if k.startswith("transforms_total") and 'direction="backward"' in k
+    }
+    assert sum(grown.values()) == 3, grown
+    with pytest.raises(InvalidParameterError):
+        t.backward_batch(padded, count=9)

@@ -282,11 +282,11 @@ def test_transient_failure_retries_with_jitter_then_completes(monkeypatch):
     real_run_batch = service_mod.run_batch
     calls = {"n": 0}
 
-    def flaky_run_batch(plans, requests):
+    def flaky_run_batch(entry, requests, build_clone, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             raise errors.HostExecutionError("transient dispatch failure")
-        return real_run_batch(plans, requests)
+        return real_run_batch(entry, requests, build_clone, **kw)
 
     monkeypatch.setattr(service_mod, "run_batch", flaky_run_batch)
     svc = _service(retries=2, backoff_s=0.001)
