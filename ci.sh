@@ -36,7 +36,7 @@ run_lint() {
 }
 
 run_analyze() {
-  echo "== Analyze (spfft_tpu.analysis: 14 checkers, baselined gate) =="
+  echo "== Analyze (spfft_tpu.analysis: 19 checkers, baselined gate) =="
   local adir
   adir="$(mktemp -d)"
   # Full gate over the real tree: zero non-baselined findings, and the
@@ -51,18 +51,23 @@ analysis = load_analysis()
 doc = json.loads(open(f"{sys.argv[1]}/analysis.json").read())
 missing = analysis.validate_report(doc)
 assert not missing, f"analysis report schema incomplete: {missing}"
-assert len(doc["checkers"]) == 14, [c["code"] for c in doc["checkers"]]
+assert len(doc["checkers"]) == 19, [c["code"] for c in doc["checkers"]]
 assert doc["counts"]["new"] == 0 and doc["counts"]["stale_baseline"] == 0, doc["counts"]
 print(f"analysis report ok ({len(doc['checkers'])} checkers, "
       f"{doc['counts']['total']} finding(s), all baselined)")
 EOF
+  # The suppression audit: every in-tree `# noqa: SA*` must still fire —
+  # an orphaned suppression hides the next real regression on its line.
+  python programs/analyze.py --list-noqa -q
   # The gate must TRIP (exit 3, the distinct tripped-gate code) on doctored
   # trees. Copy the scanned surface + anchors, then doctor one defect per
   # proof and assert the typed finding appears.
   mkdir -p "$adir/tree_locks"
   cp -r spfft_tpu programs docs tests analysis_baseline.json "$adir/tree_locks/"
-  cp -r "$adir/tree_locks" "$adir/tree_donate"
-  cp -r "$adir/tree_locks" "$adir/tree_stale"
+  local t
+  for t in donate stale b15 b16 b17 b18 b19; do
+    cp -r "$adir/tree_locks" "$adir/tree_$t"
+  done
   # (a) lock-order cycle: two module locks acquired in opposite orders.
   cat > "$adir/tree_locks/spfft_tpu/_doctored_locks.py" <<'EOF'
 """Doctored CI fixture: a lock-order cycle the SA011 gate must catch."""
@@ -153,7 +158,118 @@ EOF
     echo "analysis gate did not trip on a stale baseline entry (rc=$rc)" >&2
     exit 1
   fi
-  echo "analyze gate ok (tree green, doctored SA011/SA012 + stale baseline each exit 3)"
+  # (d) one doctored trip per concurrency/dataflow checker (SA015-SA019):
+  # each tree carries exactly one planted defect; the gate must exit 3
+  # with the typed finding.
+  cat >> "$adir/tree_b15/spfft_tpu/ir/lower.py" <<'EOF'
+
+
+def _lower_slab_doctored(e):
+    """Doctored CI fixture: batched use-after-consume the SA015 gate must catch."""
+
+    def backward():
+        g = StageGraph("backward")
+        g.add_input("values_re")
+        g.add_input("values_im")
+        g.batch_inputs = ("values_re", "values_im")
+        g.add(
+            "compression", e._st_decompress,
+            ("values_re", "values_im"), ("sticks",),
+        )
+        g.add("z transform", e._st_z, ("sticks", "values_im"), ("z",))
+        g.set_outputs(["z"])
+        return g
+
+    return {"backward": backward()}
+EOF
+  cat > "$adir/tree_b16/spfft_tpu/_doctored_metrics.py" <<'EOF'
+"""Doctored CI fixture: an undeclared metric the SA016 gate must catch."""
+from . import obs
+
+
+def emit():
+    obs.counter("rogue_doctored_total", where="nowhere").inc()
+EOF
+  cat > "$adir/tree_b17/spfft_tpu/_doctored_threads.py" <<'EOF'
+"""Doctored CI fixture: a leaked non-daemon thread the SA017 gate must catch."""
+import threading
+
+
+def go(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return t
+EOF
+  python - "$adir" <<'EOF'
+# SA018: register a new fault site WITHOUT a targeted chaos test
+import sys
+
+p = f"{sys.argv[1]}/tree_b18/spfft_tpu/faults/plane.py"
+src = open(p).read()
+doctored = src.replace('    "sched.run",\n', '    "sched.run",\n    "doctored.site",\n')
+assert doctored != src, "SITES anchor moved: update the SA018 doctored trip"
+open(p, "w").write(doctored)
+EOF
+  cat > "$adir/tree_b19/spfft_tpu/_doctored_traced.py" <<'EOF'
+"""Doctored CI fixture: a sleep inside a timing span the SA019 gate must catch."""
+import time
+
+from . import timing
+
+
+def f():
+    with timing.scoped("dispatch"):
+        time.sleep(0.1)
+EOF
+  local code tree needle
+  for spec in \
+    "SA015:b15:referenced after its consuming node" \
+    "SA016:b16:not declared in the canonical vocabulary" \
+    "SA017:b17:neither daemon=True nor joined" \
+    "SA018:b18:no targeted chaos test" \
+    "SA019:b19:inside timing.scoped"; do
+    code="${spec%%:*}"; rest="${spec#*:}"; tree="${rest%%:*}"; needle="${rest#*:}"
+    rc=0
+    python programs/analyze.py --root "$adir/tree_$tree" --only "$code" \
+      --json "$adir/$tree.json" > /dev/null || rc=$?
+    if [ "$rc" -ne 3 ]; then
+      echo "analysis gate did not trip on doctored $code tree (rc=$rc)" >&2
+      exit 1
+    fi
+    python - "$adir" "$tree" "$code" "$needle" <<'EOF'
+import json, sys
+
+doc = json.loads(open(f"{sys.argv[1]}/{sys.argv[2]}.json").read())
+hits = [f for f in doc["findings"]
+        if f["code"] == sys.argv[3] and sys.argv[4] in f["message"]]
+assert hits and not hits[0]["baselined"], doc["findings"]
+print(f"doctored {sys.argv[3]} trip ok ({hits[0]['file']}:{hits[0]['line']})")
+EOF
+  done
+  # (e) runtime lockdep: the serve+sched suites run with every package
+  # lock wrapped; the observed acquisition graph must validate against
+  # SA011's static model with zero unexplained edges, no cycles, and no
+  # blocking waits.
+  JAX_PLATFORMS=cpu SPFFT_TPU_LOCKDEP=1 \
+    SPFFT_TPU_LOCKDEP_REPORT="$adir/lockdep.json" \
+    timeout 1500 python -m pytest tests/test_serve.py tests/test_sched.py -q
+  rc=0
+  python programs/analyze.py --lockdep-check "$adir/lockdep.json" || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "lockdep cross-check found unexplained runtime lock edges (rc=$rc)" >&2
+    exit 1
+  fi
+  python - "$adir" <<'EOF'
+import json, sys
+
+doc = json.loads(open(f"{sys.argv[1]}/lockdep.json").read())
+assert doc["schema"] == "spfft_tpu.analysis.lockdep/1", doc["schema"]
+assert doc["counts"]["locks"] > 0 and doc["counts"]["edges"] > 0, doc["counts"]
+assert doc["cycles"] == [] and doc["blocking"] == [], (doc["cycles"], doc["blocking"])
+print(f"lockdep armed run ok ({doc['counts']['locks']} locks, "
+      f"{doc['counts']['edges']} edges, 0 cycles, 0 blocking)")
+EOF
+  echo "analyze gate ok (tree green, noqa audit clean, doctored SA011/SA012/SA015-SA019 + stale baseline each exit 3, lockdep runtime graph matches static)"
   rm -rf "$adir"
 }
 

@@ -7,12 +7,20 @@ The one command CI (``./ci.sh analyze``) and developers run:
     python programs/analyze.py --only SA011    # one checker (code or name)
     python programs/analyze.py --write-baseline  # accept current findings
     python programs/analyze.py --list          # the checker catalog
+    python programs/analyze.py --list-noqa     # suppression audit (orphans exit 3)
+    python programs/analyze.py --lockdep-check R.json  # runtime-vs-static graph
 
 Exit status: 0 green (every finding baselined, no stale baseline entries),
-3 when the gate trips — a NEW finding, or a STALE baseline entry (a fixed
+3 when the gate trips — a NEW finding, a STALE baseline entry (a fixed
 finding must leave the baseline, or the baseline rots into a blanket
-waiver), 2 on usage errors. The distinct exit 3 is the same convention as
+waiver), an ORPHANED ``# noqa`` suppression under ``--list-noqa``, or an
+unexplained runtime lock edge under ``--lockdep-check`` — and 2 on usage
+errors. The distinct exit 3 is the same convention as
 ``programs/perf_gate.py``: a tripped gate, not a crashed tool.
+
+Checkers run on a thread pool by default (``--jobs``, pure functions of
+the parsed tree; ``--jobs 1`` for the serial reference — the test suite
+asserts identical findings both ways).
 
 The analysis package is loaded standalone (no ``spfft_tpu`` import, no
 ``jax``) — the same import-free rule the old ``programs/lint.py`` followed,
@@ -62,6 +70,80 @@ def _ran_codes(analysis, only) -> set:
     }
 
 
+# The import-hygiene checkers honor the legacy "any noqa on the line"
+# contract INSIDE the checker, so a raw run cannot distinguish their live
+# suppressions from orphans — the audit counts them live.
+SELF_EXEMPT_CODES = ("SA001", "SA002")
+
+
+def run_list_noqa(analysis, *, root: Path, quiet=False) -> int:
+    """The suppression audit: every in-tree ``# noqa: SA*`` with its
+    checker doc, ORPHANED ones (the code no longer fires on that line)
+    exit 3 — a dead suppression hides the next real regression there."""
+    tree = analysis.Tree(root=root)
+    suppressions = analysis.list_noqa(tree)
+    raw = analysis.run(tree, suppress=False)
+    fired = {(f.code, f.file, f.line) for f in raw}
+    by_code = {c.code: c for c in analysis.CHECKERS.values()}
+    orphans = 0
+    for row in suppressions:
+        for code in row["codes"]:
+            entry = by_code.get(code)
+            live = (
+                code in SELF_EXEMPT_CODES
+                or (code, row["file"], row["line"]) in fired
+            )
+            status = "live" if live else "ORPHANED"
+            if not live:
+                orphans += 1
+            if not quiet or not live:
+                name = entry.name if entry else "unknown checker"
+                print(f"{row['file']}:{row['line']}: {code} ({name}) — {status}")
+                if entry and not live:
+                    print(f"    {entry.doc}")
+    if orphans:
+        print(
+            f"noqa audit TRIPPED: {orphans} orphaned suppression(s) — the "
+            "code no longer fires there; delete the noqa (or it will hide "
+            "the next real finding on that line)"
+        )
+        return 3
+    if not quiet:
+        print(f"noqa audit ok: {len(suppressions)} suppression(s), all live")
+    return 0
+
+
+def run_lockdep_check(analysis, *, root: Path, report_path: Path) -> int:
+    """Cross-check a runtime lockdep report against the SA011 static
+    graph: unexplained runtime edges (the static model is stale), observed
+    cycles, and blocking waits exit 3."""
+    try:
+        doc = json.loads(Path(report_path).read_text())
+    except OSError as e:
+        print(f"cannot read lockdep report: {e}", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"malformed lockdep report {report_path}: {e}", file=sys.stderr)
+        return 2
+    missing = analysis.lockdep.validate_report(doc)
+    if missing:
+        print(f"lockdep report schema incomplete: {missing}", file=sys.stderr)
+        return 2
+    static = analysis.locks.static_graph(analysis.Tree(root=root))
+    chk = analysis.lockdep.crosscheck(doc, static)
+    for f in chk["findings"]:
+        print(f"{f['where']}: [lockdep:{f['kind']}] {f['message']}")
+    n_static = len(chk["explained"]["static"])
+    n_dynamic = len(chk["explained"]["dynamic"])
+    print(
+        f"lockdep cross-check: {doc['counts']['locks']} lock(s), "
+        f"{doc['counts']['edges']} edge(s) — {n_static} matched the static "
+        f"graph, {n_dynamic} on dynamic (statically untracked) locks, "
+        f"{len(chk['findings'])} finding(s)"
+    )
+    return 3 if chk["findings"] else 0
+
+
 def run_gate(
     analysis,
     *,
@@ -71,10 +153,11 @@ def run_gate(
     json_out=None,
     write_baseline=False,
     quiet=False,
+    jobs=None,
 ) -> int:
     """The gate body (``programs/lint.py`` reuses it for checkers 1-9)."""
     tree = analysis.Tree(root=root)
-    findings = analysis.run(tree, only=only)
+    findings = analysis.run(tree, only=only, jobs=jobs)
 
     if write_baseline:
         doc = analysis.baseline_doc(findings)
@@ -168,6 +251,22 @@ def main(argv=None) -> int:
     p.add_argument(
         "--list", action="store_true", help="print the checker catalog"
     )
+    p.add_argument(
+        "--list-noqa", action="store_true",
+        help="audit every in-tree `# noqa: SA*` suppression; orphaned "
+        "suppressions (the code no longer fires on that line) exit 3",
+    )
+    p.add_argument(
+        "--lockdep-check", metavar="REPORT",
+        help="cross-check a runtime lockdep report "
+        "(spfft_tpu.analysis.lockdep/1 JSON) against the SA011 static "
+        "graph; unexplained edges/cycles/blocking exit 3",
+    )
+    p.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="checker thread-pool width (default: one per CPU, capped 8; "
+        "1 = serial)",
+    )
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -179,10 +278,21 @@ def main(argv=None) -> int:
             print(f"{entry.code}  {entry.severity:5s}  {entry.name}")
         return 0
 
-    baseline_path = Path(
-        args.baseline if args.baseline else root / "analysis_baseline.json"
-    )
     try:
+        if args.list_noqa:
+            return run_list_noqa(analysis, root=root, quiet=args.quiet)
+        if args.lockdep_check:
+            return run_lockdep_check(
+                analysis, root=root, report_path=args.lockdep_check
+            )
+        jobs = args.jobs
+        if jobs is None:
+            import os
+
+            jobs = min(8, os.cpu_count() or 1)
+        baseline_path = Path(
+            args.baseline if args.baseline else root / "analysis_baseline.json"
+        )
         return run_gate(
             analysis,
             root=root,
@@ -191,6 +301,7 @@ def main(argv=None) -> int:
             json_out=args.json,
             write_baseline=args.write_baseline,
             quiet=args.quiet,
+            jobs=jobs,
         )
     except analysis.AnalysisError as e:
         print(f"analysis error: {e}", file=sys.stderr)

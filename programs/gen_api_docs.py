@@ -480,18 +480,30 @@ def analysis_page() -> str:
         "python programs/analyze.py --json report.json",
         "python programs/analyze.py --only SA011   # one checker",
         "python programs/analyze.py --write-baseline",
+        "python programs/analyze.py --list-noqa    # suppression audit (orphans exit 3)",
+        "python programs/analyze.py --jobs 1       # serial reference run",
+        "python programs/analyze.py --lockdep-check report.json",
         "```",
         "",
         "Findings are suppressed per line with `# noqa: <CODE>`; accepted "
         "pre-existing findings live in the committed `analysis_baseline.json` "
         "(keyed `CODE:file:message`, line-number-free). New findings AND "
         "stale baseline entries (a fixed finding must leave the baseline) "
-        "exit 3 — `./ci.sh analyze` proves the trip on doctored lock-order "
-        "and use-after-donate fixtures. `programs/lint.py` is a thin shim "
-        "running the ported checkers SA001-SA009.",
+        "exit 3 — `./ci.sh analyze` proves the trip on doctored fixtures, "
+        "one per deep checker (lock-order cycle, use-after-donate, batched "
+        "use-after-consume, rogue metric, leaked thread, untested fault "
+        "site, sleep-in-span). `--list-noqa` audits every `# noqa: SA*` "
+        "suppression and exits 3 on ORPHANED ones (the code no longer "
+        "fires there). Checkers run on a thread pool (`--jobs`), findings "
+        "identical to the serial reference. `programs/lint.py` is a thin "
+        "shim running the ported checkers SA001-SA009.",
         "",
-        "See docs/details.md \"Static analysis\" for the baseline workflow "
-        "and how to add a checker.",
+        "## Runtime lockdep (`spfft_tpu.analysis.lockdep`)",
+        "",
+        doc(analysis.lockdep),
+        "",
+        "See docs/details.md \"Static analysis & runtime lockdep\" for the "
+        "two-layer story, the baseline workflow, and how to add a checker.",
         "",
     ]
     return "\n".join(out)
@@ -547,6 +559,47 @@ def rewrite_knob_table(details_path: Path) -> None:
     )
     details_path.write_text(text)
     print(f"rewrote knob table in {details_path}")
+
+
+METRIC_TABLE_BEGIN = "<!-- metric-table:begin (generated from spfft_tpu.obs.metrics by programs/gen_api_docs.py — edit docs in the vocabulary, not here) -->"
+METRIC_TABLE_END = "<!-- metric-table:end -->"
+
+
+def metric_table() -> str:
+    """The docs/details.md metric table, rendered from the canonical
+    run-metrics vocabulary (``spfft_tpu/obs/metrics.py`` — SA016 keeps the
+    two in sync both ways, the knob-table contract)."""
+    from spfft_tpu.obs import metrics
+
+    rows = [
+        "| Metric | Kind | Labels | What it records |",
+        "|---|---|---|---|",
+    ]
+    # declaration order, not sorted: the vocabulary groups instruments by
+    # subsystem and the table keeps that narrative
+    for row in metrics.describe():
+        labels = ", ".join(f"`{k}`" for k in row["labels"]) or "—"
+        escaped = row["doc"].replace("|", "\\|")
+        rows.append(
+            f"| `{row['name']}` | {row['kind']} | {labels} | {escaped} |"
+        )
+    return "\n".join(rows)
+
+
+def rewrite_metric_table(details_path: Path) -> None:
+    """Replace the marked metric-table block in docs/details.md in place."""
+    text = details_path.read_text()
+    begin = text.index(METRIC_TABLE_BEGIN)
+    end = text.index(METRIC_TABLE_END)
+    text = (
+        text[: begin + len(METRIC_TABLE_BEGIN)]
+        + "\n"
+        + metric_table()
+        + "\n"
+        + text[end:]
+    )
+    details_path.write_text(text)
+    print(f"rewrote metric table in {details_path}")
 
 
 def generate(outdir: Path) -> None:
@@ -675,3 +728,4 @@ if __name__ == "__main__":
     else:
         generate(ROOT / "docs" / "api")
         rewrite_knob_table(ROOT / "docs" / "details.md")
+        rewrite_metric_table(ROOT / "docs" / "details.md")

@@ -7,6 +7,18 @@ C2C and R2C transforms with hermitian-symmetry completion, centered indexing, si
 and double precision, local and mesh-distributed execution with ICI all-to-all
 exchanges, grids, batched multi-transforms, and a C/C++/Fortran shim.
 """
+# Runtime lockdep arms FIRST, before any submodule import creates its
+# threading primitives: the wrapper factories must be installed when the
+# module-level locks (obs registry/trace, faults plane, tuning wisdom,
+# verify breaker, ...) are constructed. knobs pulls only errors (stdlib),
+# and analysis.lockdep is stdlib-only — nothing here touches jax.
+from . import knobs as _knobs
+
+if _knobs.get_bool("SPFFT_TPU_LOCKDEP"):
+    from .analysis import lockdep as _lockdep
+
+    _lockdep.install(report_path=_knobs.get_str("SPFFT_TPU_LOCKDEP_REPORT"))
+
 from .errors import (  # noqa: F401
     AllocationError,
     DeadlineExceededError,

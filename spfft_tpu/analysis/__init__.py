@@ -29,6 +29,7 @@ from .core import (  # noqa: F401
     Tree,
     apply_baseline,
     baseline_doc,
+    list_noqa,
     load_baseline,
     report_doc,
     run,
@@ -36,7 +37,7 @@ from .core import (  # noqa: F401
 )
 
 # Importing a checker module registers its checkers; the order here is the
-# catalog order (SA001..SA014).
+# catalog order (SA001..SA019).
 from . import hygiene  # noqa: F401  checkers 1-2: import hygiene
 from . import vocab  # noqa: F401  checkers 3-9: both-ways vocabularies
 from . import typed_errors  # noqa: F401  checker 10: typed-error discipline
@@ -44,5 +45,15 @@ from . import locks  # noqa: F401  checker 11: lock-order analysis
 from . import donation  # noqa: F401  checker 12: donation safety
 from . import purity  # noqa: F401  checker 13: jit purity
 from . import knobreads  # noqa: F401  checker 14: knob-registry read path
+from . import donation_dist  # noqa: F401  checker 15: batched/mesh donation
+from . import metricsvocab  # noqa: F401  checker 16: metrics vocabulary
+from . import threads  # noqa: F401  checker 17: thread lifecycle
+from . import faultcov  # noqa: F401  checker 18: fault-site chaos coverage
+from . import tracedblock  # noqa: F401  checker 19: blocking while traced
+
+# The runtime half of the concurrency soundness layer: not a checker —
+# armed via SPFFT_TPU_LOCKDEP, cross-checked against SA011's static graph
+# (programs/analyze.py --lockdep-check).
+from . import lockdep  # noqa: F401
 
 PORTED_LINT_CODES = tuple(f"SA00{i}" for i in range(1, 10))

@@ -29,8 +29,10 @@ tuples) is read via ``ast``, never imported.
 from __future__ import annotations
 
 import ast
+import io
 import json
 import re
+import tokenize
 from pathlib import Path
 
 SCHEMA = "spfft_tpu.analysis/1"
@@ -247,9 +249,19 @@ def suppressed(tree: Tree, finding: Finding) -> bool:
     return finding.code.upper() in wanted
 
 
-def run(tree: Tree, only=None) -> list:
+def run(tree: Tree, only=None, *, suppress=True, jobs=None) -> list:
     """Run (a subset of) the registered checkers; returns surviving
-    findings, checker-registration order then file/line order."""
+    findings, sorted (code, file, line, message).
+
+    ``suppress=False`` returns the RAW findings including ``# noqa``-covered
+    ones — the orphaned-suppression audit (:func:`list_noqa` consumers)
+    needs to know what would fire without the comments.
+
+    ``jobs`` > 1 runs the checkers on a thread pool after pre-parsing every
+    scanned file concurrently (checkers are pure functions of the parsed
+    tree; the per-file ``ast`` caches make parsing the dominant cost, and a
+    racing double-parse is harmless last-write-wins). The final sort makes
+    the result identical to a serial run — asserted in the test suite."""
     names = list(CHECKERS)
     if only:
         only = [only] if isinstance(only, str) else list(only)
@@ -263,14 +275,64 @@ def run(tree: Tree, only=None) -> list:
             n for n in names
             if n in only or CHECKERS[n].code in only
         ]
-    findings: list = []
-    for name in names:
-        entry = CHECKERS[name]
-        for f in entry.fn(tree):
-            if not suppressed(tree, f):
-                findings.append(f)
+    if jobs is not None and jobs > 1 and len(names) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def parse_quiet(rel):
+            try:
+                tree.parse(rel)
+            except SyntaxError:
+                pass  # each checker reports/skips syntax errors itself
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            list(pool.map(parse_quiet, tree.py_files()))
+            per_checker = list(
+                pool.map(lambda name: CHECKERS[name].fn(tree), names)
+            )
+        raw = [f for batch in per_checker for f in batch]
+    else:
+        raw = []
+        for name in names:
+            raw.extend(CHECKERS[name].fn(tree))
+    findings = [
+        f for f in raw if not suppress or not suppressed(tree, f)
+    ]
     findings.sort(key=lambda f: (f.code, f.file, f.line, f.message))
     return findings
+
+
+def list_noqa(tree: Tree) -> list:
+    """Every ``# noqa: SA*`` suppression comment in the scanned tree, as
+    ``{"file", "line", "codes"}`` rows (real COMMENT tokens only — prose in
+    docstrings that *mentions* a noqa is not a suppression). Bare
+    ``# noqa`` and foreign codes (``F401``) are editor vocabulary, skipped.
+    The ``--list-noqa`` audit joins these against a ``suppress=False`` run
+    to flag ORPHANED suppressions — a noqa whose code no longer fires on
+    that line hides the next real regression there."""
+    out = []
+    for rel in tree.py_files():
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(tree.source(rel)).readline
+            )
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _NOQA_RE.search(tok.string)
+                if not m or m.group("codes") is None:
+                    continue
+                sa_codes = [
+                    c.strip().upper()
+                    for c in m.group("codes").split(",")
+                    if c.strip().upper().startswith("SA")
+                ]
+                if sa_codes:
+                    out.append(
+                        {"file": rel, "line": tok.start[0], "codes": sa_codes}
+                    )
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            continue
+    return out
 
 
 # ---- baseline ----------------------------------------------------------------

@@ -96,6 +96,7 @@ class _Graph:
         self.inputs: list = []          # ordered add_input names
         self.consumers: list = []       # (possible input-edge names, lineno)
         self.outputs: set = set()
+        self.batch: set = set()         # declared batch_inputs edge names
 
 
 def _reconstruct(fn_node) -> list:
@@ -122,6 +123,21 @@ def _reconstruct(fn_node) -> list:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         consts.setdefault(t.id, set()).add(v.value)
+            # g.batch_inputs = ("values_re", ...) — the declared per-request
+            # edges (an IfExp of literal tuples contributes the union)
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "batch_inputs"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in graphs
+                ):
+                    graphs[t.value.id].batch |= {
+                        sub.value
+                        for sub in ast.walk(v)
+                        if isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)
+                    }
         if not (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)

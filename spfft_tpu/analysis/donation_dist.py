@@ -1,0 +1,185 @@
+"""Checker 15: donation safety for distributed and batched graphs (SA015).
+
+SA012 guards the per-request local backward donation. PR 12's batch axis
+and the mesh lowerings widened the surface it cannot see:
+
+* **Batched/mesh consume-once.** Every lowered backward graph declares its
+  per-request edges (``g.batch_inputs`` — the packed value pair). On the
+  batched path those edges are the STACKED buffers ``build_batched``
+  donates on the consuming backward, and on mesh graphs they are the
+  per-shard blocks a future mesh donation would free — so in EVERY
+  ``_lower_*`` backward graph (local, slab, pencil) a declared batch edge
+  must be consumed by at most one node and never escape via
+  ``set_outputs``. A second reference computes with memory the batched
+  consuming jit may already have overwritten.
+* **Donate only per-request edges.** The local ``_ir_spec`` donation
+  positions must name edges listed in the backward graph's
+  ``batch_inputs``: donating a SHARED plan constant (an index table, a
+  phase operand) would let one batch's execution free memory every later
+  batch still reads.
+* **The batched jit donates what the fused jit donates.** ``build_batched``
+  must apply ``donate_argnums`` from the same spec key ``build_fused``
+  does — a batched path that silently stopped donating doubles peak value
+  memory per batch; one donating from a different key frees the wrong
+  buffers.
+
+Reconstruction is the SA012 machinery (literal ``add_input``/``add``/
+``set_outputs``/``batch_inputs`` calls, string-constant propagation;
+non-literal nodes skipped, conservative).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Tree, checker, missing_anchor
+from .donation import (
+    IR_COMPILE_FILE,
+    IR_LOWER_FILE,
+    _reconstruct,
+    _spec_keys,
+    donated_positions,
+)
+
+BUILDER_PREFIX = "_lower_"
+
+
+def _donate_keys_of(compile_mod, fn_name: str) -> tuple:
+    """(applied, spec keys feeding donate_argnums) for one build function."""
+    keys: set = set()
+    applied = False
+    for node in ast.walk(compile_mod):
+        if not (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == fn_name
+        ):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            for kw in call.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                applied = True
+                names = {
+                    n.id for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Name)
+                }
+                for stmt in ast.walk(node):
+                    if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id in names
+                        for t in stmt.targets
+                    ):
+                        keys |= _spec_keys(stmt.value)
+                keys |= _spec_keys(kw.value)
+    return applied, keys
+
+
+@checker(
+    "donation-batch",
+    code="SA015",
+    doc="Donation safety beyond the local backward (SA012): in EVERY "
+    "lowered backward graph (local, slab, pencil) the declared "
+    "batch_inputs edges — the per-request value pair build_batched donates "
+    "stacked, and the per-shard blocks of mesh graphs — are consumed by at "
+    "most one node and never escape via set_outputs; the local _ir_spec "
+    "donation positions name only batch_inputs edges (donating a shared "
+    "plan constant frees memory every later batch still reads); and "
+    "build_batched applies donate_argnums from the same spec key "
+    "build_fused does. Reconstructed from literal graph-build calls, "
+    "conservative like SA012.",
+)
+def check_donation_batch(tree: Tree):
+    findings = []
+    for anchor in (IR_LOWER_FILE, IR_COMPILE_FILE):
+        skip, f = missing_anchor(check_donation_batch, tree, anchor)
+        if skip:
+            return findings + f
+        findings += f
+    positions = donated_positions(tree)
+
+    # ---- rule 1 + 2: batch edges consume-once / never-escape; donated
+    # positions are batch edges --------------------------------------------
+    lower_mod = tree.parse(IR_LOWER_FILE)
+    for builder in lower_mod.body:
+        if not (
+            isinstance(builder, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and builder.name.startswith(BUILDER_PREFIX)
+        ):
+            continue
+        local = builder.name.startswith("_lower_local")
+        for g in _reconstruct(builder):
+            if g.direction != "backward" or not g.batch:
+                continue
+            for edge in sorted(g.batch):
+                uses = [
+                    (possible, lineno)
+                    for possible, lineno in g.consumers
+                    if edge in possible
+                ]
+                for _possible, lineno in uses[1:]:
+                    findings.append(
+                        check_donation_batch.finding(
+                            IR_LOWER_FILE, lineno,
+                            f"batched input edge {edge!r} referenced after "
+                            f"its consuming node in a {builder.name} "
+                            "backward graph — the batched consuming jit "
+                            "donates the stacked buffer at that node",
+                        )
+                    )
+                if edge in g.outputs:
+                    findings.append(
+                        check_donation_batch.finding(
+                            IR_LOWER_FILE, g.lineno,
+                            f"batched input edge {edge!r} escapes as a "
+                            f"graph output of a {builder.name} backward "
+                            "graph",
+                        )
+                    )
+            if local:
+                for i in sorted(positions):
+                    if i >= len(g.inputs):
+                        continue
+                    if g.inputs[i] not in g.batch:
+                        findings.append(
+                            check_donation_batch.finding(
+                                IR_LOWER_FILE, g.lineno,
+                                f"donate position {i} names input edge "
+                                f"{g.inputs[i]!r}, which is not a declared "
+                                f"batch_inputs edge of a {builder.name} "
+                                "backward graph — donating a shared plan "
+                                "constant frees memory every later batch "
+                                "still reads",
+                            )
+                        )
+
+    # ---- rule 3: build_batched donates from build_fused's spec key ---------
+    compile_mod = tree.parse(IR_COMPILE_FILE)
+    fused_applied, fused_keys = _donate_keys_of(compile_mod, "build_fused")
+    batch_applied, batch_keys = _donate_keys_of(compile_mod, "build_batched")
+    if fused_applied and not batch_applied:
+        findings.append(
+            check_donation_batch.finding(
+                IR_COMPILE_FILE, 0,
+                "build_fused donates the consuming backward's buffers but "
+                "build_batched passes no donate_argnums — the batched path "
+                "silently stopped donating (doubled peak value memory per "
+                "batch)",
+            )
+        )
+    if (
+        fused_applied
+        and batch_applied
+        and fused_keys
+        and batch_keys
+        and fused_keys != batch_keys
+    ):
+        findings.append(
+            check_donation_batch.finding(
+                IR_COMPILE_FILE, 0,
+                f"build_batched donates from spec key(s) "
+                f"{sorted(batch_keys)} but build_fused donates from "
+                f"{sorted(fused_keys)} — the stacked donation no longer "
+                "mirrors the per-request rule",
+            )
+        )
+    return findings

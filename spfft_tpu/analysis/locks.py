@@ -25,6 +25,23 @@ defining file, and local variables bound to a fresh ``threading.Lock()``
 are tracked; dynamically stored locks (dict-held latches) and locks
 reached through unresolvable receivers are not. Same-package calls resolve
 through one level of ``__init__`` re-exports.
+
+Modeled acquisition shapes beyond the nested ``with``:
+
+* ``stack.enter_context(lock)`` — an ``ExitStack`` chain acquires in call
+  order and holds until the stack unwinds, so each ``enter_context`` of a
+  resolvable lock extends the held set for the remaining statements of the
+  enclosing body (edges + self-deadlock checks identical to ``with``).
+* ``Condition.wait`` — the wait *releases the condition's own lock* while
+  blocked (exempt when the condition is the only thing held), but any
+  OTHER held lock stays held across the unbounded wait and is flagged.
+  The implicit re-acquire on wakeup re-establishes the edges the original
+  acquisition already recorded, so no separate edge is emitted for it.
+
+The same walk feeds two consumers: :func:`check_lock_order` (the SA011
+findings) and :func:`static_graph` — the JSON-plain lock/edge export the
+runtime lockdep validator (:mod:`.lockdep`) cross-checks its observed
+acquisition graph against.
 """
 from __future__ import annotations
 
@@ -78,6 +95,7 @@ class _Module:
         self.node = node
         self.module_locks: dict = {}   # name -> (lock_id, kind)
         self.attr_locks: dict = {}     # attr -> (lock_id, kind)  (self.X)
+        self.lock_lines: dict = {}     # lock_id -> definition lineno
         self.mod_alias: dict = {}      # alias -> module rel
         self.obj_alias: dict = {}      # alias -> (module rel, attr)
         self.functions: dict = {}      # qual -> ast node ("f" / "C.m")
@@ -121,6 +139,7 @@ class LockIndex:
                     if isinstance(t, ast.Name):
                         if kind:
                             m.module_locks[t.id] = (f"{rel}::{t.id}", kind)
+                            m.lock_lines[f"{rel}::{t.id}"] = stmt.lineno
                         if classes:
                             m.instance_of[t.id] = classes
         # imports anywhere in the file (the lazy function-scope import is a
@@ -164,6 +183,9 @@ class LockIndex:
                         ):
                             m.attr_locks[t.attr] = (
                                 f"{rel}::{cls.name}.{t.attr}", kind,
+                            )
+                            m.lock_lines[f"{rel}::{cls.name}.{t.attr}"] = (
+                                sub.lineno
                             )
             for meth in cls.body:
                 if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -295,6 +317,11 @@ class LockIndex:
                     if got:
                         out.add(got[0])
             elif isinstance(node, ast.Call):
+                got = _enter_context_lock(
+                    self, m, class_name, local_locks, node
+                )
+                if got:
+                    out.add(got[0])
                 callee = self.resolve_call(m, class_name, node)
                 if callee:
                     out |= self.effects(callee)
@@ -319,6 +346,19 @@ class LockIndex:
 BLOCKING_RECEIVER_ATTRS = ("join", "result")
 
 
+def _enter_context_lock(index, m, class_name, local_locks, call):
+    """``(lock_id, kind)`` when ``call`` is ``<stack>.enter_context(<lock>)``
+    on a resolvable lock — the ExitStack acquisition shape."""
+    fn = call.func
+    if not (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "enter_context"
+        and call.args
+    ):
+        return None
+    return index.resolve_lock(m, class_name, local_locks, call.args[0])
+
+
 def _blocking_desc(index, m, class_name, local_locks, held, call):
     """A human description when ``call`` blocks while locks are held."""
     fn = call.func
@@ -335,7 +375,15 @@ def _blocking_desc(index, m, class_name, local_locks, held, call):
         if fn.attr == "wait":
             got = index.resolve_lock(m, class_name, local_locks, fn.value)
             if got and got[0] in held:
-                return None  # Condition.wait on the held lock releases it
+                # Condition.wait releases the condition's OWN lock while
+                # blocked — exempt only when that is the whole held set; any
+                # other lock stays held across the unbounded wait
+                if all(h == got[0] for h in held):
+                    return None
+                return (
+                    ".wait() (Condition.wait releases only its own lock; "
+                    "the other held lock stays held across the wait)"
+                )
             return ".wait()"
     elif isinstance(fn, ast.Name) and fn.id == "fence":
         return "fence() (a completion wait)"
@@ -370,114 +418,11 @@ def _calls_here(stmt):
             yield node
 
 
-@checker(
-    "lock-order",
-    code="SA011",
-    doc="Builds the static lock-acquisition graph over every "
-    "threading.Lock/RLock/Condition in the package (nested `with` blocks, "
-    "transitive call effects, typed-error constructions) and flags cycles, "
-    "re-acquisition of a held non-reentrant lock, and locks held across "
-    "blocking calls (time.sleep, .join/.result/foreign .wait, jax/jnp "
-    "dispatch). Name-based and conservative: dynamically stored locks are "
-    "not tracked.",
-)
-def check_lock_order(tree: Tree):
-    findings = []
-    index = LockIndex(tree)
-    kinds: dict = {}
-    for m in index.modules.values():
-        for lock_id, kind in list(m.module_locks.values()) + list(
-            m.attr_locks.values()
-        ):
-            kinds[lock_id] = kind
-    edges: dict = {}  # (A, B) -> (rel, line)
-
-    def note_edge(a, b, rel, line):
-        edges.setdefault((a, b), (rel, line))
-
-    def walk(m, class_name, qual, local_locks, stmts, held):
-        for stmt in stmts:
-            if isinstance(
-                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-            ):
-                continue
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                newly = []
-                for item in stmt.items:
-                    got = index.resolve_lock(
-                        m, class_name, local_locks, item.context_expr
-                    )
-                    if got:
-                        lock_id, kind = got
-                        for h in held:
-                            note_edge(h, lock_id, m.rel, stmt.lineno)
-                        if lock_id in held and kind not in REENTRANT:
-                            findings.append(
-                                check_lock_order.finding(
-                                    m.rel, stmt.lineno,
-                                    f"non-reentrant lock {lock_id} "
-                                    "re-acquired while already held "
-                                    "(guaranteed self-deadlock)",
-                                )
-                            )
-                        newly.append(lock_id)
-                    else:
-                        # a with on a call (context manager): treat like a
-                        # call for lock effects
-                        if isinstance(item.context_expr, ast.Call):
-                            _note_call_effects(
-                                m, class_name, item.context_expr, held
-                            )
-                walk(m, class_name, qual, local_locks, stmt.body, held + newly)
-                continue
-            if held:
-                for call in _calls_here(stmt):
-                    desc = _blocking_desc(
-                        index, m, class_name, local_locks, held, call
-                    )
-                    if desc:
-                        findings.append(
-                            check_lock_order.finding(
-                                m.rel, call.lineno,
-                                f"lock {held[-1]} held across {desc} — "
-                                "move the blocking call outside the lock",
-                            )
-                        )
-                    _note_call_effects(m, class_name, call, held)
-            for sub in _stmt_lists(stmt):
-                walk(m, class_name, qual, local_locks, sub, held)
-
-    def _note_call_effects(m, class_name, call, held):
-        callee = index.resolve_call(m, class_name, call)
-        if not callee:
-            return
-        for lock_id in index.effects(callee):
-            for h in held:
-                note_edge(h, lock_id, m.rel, call.lineno)
-                if h == lock_id and kinds.get(lock_id) not in REENTRANT:
-                    findings.append(
-                        check_lock_order.finding(
-                            m.rel, call.lineno,
-                            f"call may re-acquire held non-reentrant lock "
-                            f"{lock_id} (self-deadlock through "
-                            f"{callee[0]}::{callee[1]})",
-                        )
-                    )
-
-    for m in index.modules.values():
-        for qual, fn_node in m.functions.items():
-            class_name = qual.split(".")[0] if "." in qual else None
-            local_locks = index._local_locks(m.rel, qual, fn_node)
-            walk(m, class_name, qual, local_locks, fn_node.body, [])
-
-    # ---- cycle detection over the acquisition graph -------------------------
-    graph: dict = {}
-    for (a, b), _loc in edges.items():
-        if a != b:
-            graph.setdefault(a, set()).add(b)
-
-    # iterative Tarjan SCC (recursion-free; the graph is tiny but deep
-    # recursion limits are not worth trusting)
+def find_cycles(graph: dict) -> list:
+    """Non-trivial strongly connected components of ``{node: {succ, ...}}``,
+    each a sorted node list — iterative Tarjan (recursion-free; the graphs
+    are tiny but deep recursion limits are not worth trusting). Shared by
+    the static checker and the runtime lockdep report."""
     idx: dict = {}
     low: dict = {}
     on_stack: set = set()
@@ -524,8 +469,169 @@ def check_lock_order(tree: Tree):
     for v in sorted(graph):
         if v not in idx:
             strongconnect(v)
+    return sorted(sccs)
 
-    for comp in sorted(sccs):
+
+def collect(tree: Tree, make_finding) -> dict:
+    """The SA011 walk over the whole package: acquisition edges, lock kinds
+    and definition sites, plus the non-cycle findings (``make_finding(file,
+    line, message)`` constructs them). One walk, two consumers — the static
+    checker and :func:`static_graph` (the lockdep cross-check's model)."""
+    findings: list = []
+    index = LockIndex(tree)
+    kinds: dict = {}
+    sites: dict = {}
+    for m in index.modules.values():
+        for lock_id, kind in list(m.module_locks.values()) + list(
+            m.attr_locks.values()
+        ):
+            kinds[lock_id] = kind
+            sites[lock_id] = (m.rel, m.lock_lines.get(lock_id, 0))
+    edges: dict = {}  # (A, B) -> (rel, line)
+
+    def note_edge(a, b, rel, line):
+        edges.setdefault((a, b), (rel, line))
+
+    def acquire(lock_id, kind, held, rel, line):
+        for h in held:
+            note_edge(h, lock_id, rel, line)
+        if lock_id in held and kind not in REENTRANT:
+            findings.append(
+                make_finding(
+                    rel, line,
+                    f"non-reentrant lock {lock_id} re-acquired while "
+                    "already held (guaranteed self-deadlock)",
+                )
+            )
+
+    def walk(m, class_name, qual, local_locks, stmts, held):
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly = []
+                for item in stmt.items:
+                    got = index.resolve_lock(
+                        m, class_name, local_locks, item.context_expr
+                    )
+                    if got:
+                        lock_id, kind = got
+                        acquire(lock_id, kind, held + newly, m.rel, stmt.lineno)
+                        newly.append(lock_id)
+                    else:
+                        # a with on a call (context manager): treat like a
+                        # call for lock effects
+                        if isinstance(item.context_expr, ast.Call):
+                            _note_call_effects(
+                                m, class_name, item.context_expr, held
+                            )
+                walk(m, class_name, qual, local_locks, stmt.body, held + newly)
+                continue
+            if held:
+                for call in _calls_here(stmt):
+                    desc = _blocking_desc(
+                        index, m, class_name, local_locks, held, call
+                    )
+                    if desc:
+                        findings.append(
+                            make_finding(
+                                m.rel, call.lineno,
+                                f"lock {held[-1]} held across {desc} — "
+                                "move the blocking call outside the lock",
+                            )
+                        )
+                    _note_call_effects(m, class_name, call, held)
+            for sub in _stmt_lists(stmt):
+                walk(m, class_name, qual, local_locks, sub, held)
+            # an ExitStack acquisition holds for the REST of this body:
+            # record its edges and extend the held set for what follows
+            for call in _calls_here(stmt):
+                got = _enter_context_lock(
+                    index, m, class_name, local_locks, call
+                )
+                if got:
+                    acquire(got[0], got[1], held, m.rel, call.lineno)
+                    held.append(got[0])
+
+    def _note_call_effects(m, class_name, call, held):
+        callee = index.resolve_call(m, class_name, call)
+        if not callee:
+            return
+        for lock_id in index.effects(callee):
+            for h in held:
+                note_edge(h, lock_id, m.rel, call.lineno)
+                if h == lock_id and kinds.get(lock_id) not in REENTRANT:
+                    findings.append(
+                        make_finding(
+                            m.rel, call.lineno,
+                            f"call may re-acquire held non-reentrant lock "
+                            f"{lock_id} (self-deadlock through "
+                            f"{callee[0]}::{callee[1]})",
+                        )
+                    )
+
+    for m in index.modules.values():
+        for qual, fn_node in m.functions.items():
+            class_name = qual.split(".")[0] if "." in qual else None
+            local_locks = index._local_locks(m.rel, qual, fn_node)
+            walk(m, class_name, qual, local_locks, fn_node.body, [])
+
+    return {
+        "findings": findings,
+        "edges": edges,
+        "kinds": kinds,
+        "sites": sites,
+    }
+
+
+def static_graph(tree: Tree) -> dict:
+    """JSON-plain export of the static acquisition model — the baseline the
+    runtime lockdep validator (:mod:`.lockdep`) cross-checks against:
+    ``locks`` keyed by lock id with kind + definition site (the runtime
+    wrapper joins on ``file:line``), ``edges`` as ``[from, to]`` pairs."""
+    data = collect(tree, lambda file, line, message: None)
+    return {
+        "locks": {
+            lock_id: {
+                "kind": data["kinds"].get(lock_id, "lock"),
+                "file": rel,
+                "line": line,
+            }
+            for lock_id, (rel, line) in sorted(data["sites"].items())
+        },
+        "edges": sorted([a, b] for (a, b) in data["edges"]),
+    }
+
+
+@checker(
+    "lock-order",
+    code="SA011",
+    doc="Builds the static lock-acquisition graph over every "
+    "threading.Lock/RLock/Condition in the package (nested `with` blocks, "
+    "ExitStack.enter_context chains, transitive call effects, typed-error "
+    "constructions) and flags cycles, re-acquisition of a held "
+    "non-reentrant lock, and locks held across blocking calls (time.sleep, "
+    ".join/.result/foreign .wait, Condition.wait with another lock still "
+    "held, jax/jnp dispatch). Name-based and conservative: dynamically "
+    "stored locks are not tracked. The runtime lockdep layer "
+    "(SPFFT_TPU_LOCKDEP) validates this model against observed "
+    "acquisitions.",
+)
+def check_lock_order(tree: Tree):
+    data = collect(tree, check_lock_order.finding)
+    findings = list(data["findings"])
+    edges = data["edges"]
+
+    # ---- cycle detection over the acquisition graph -------------------------
+    graph: dict = {}
+    for (a, b), _loc in edges.items():
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+
+    for comp in find_cycles(graph):
         example = None
         for (a, b), loc in sorted(edges.items()):
             if a in comp and b in comp and a != b:

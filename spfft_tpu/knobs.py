@@ -519,6 +519,22 @@ register(
     "candidate): a hung candidate fails typed `TrialTimeout` into an "
     "`error` row instead of stalling `policy=\"tuned\"` planning",
 )
+register(
+    "SPFFT_TPU_LOCKDEP", "bool", False,
+    "`1` arms the runtime lockdep validator at import "
+    "(`spfft_tpu.analysis.lockdep`): every `threading.Lock/RLock/Condition` "
+    "the package creates is wrapped to record the REAL acquisition-order "
+    "graph — cycles, and waits entered with another lock still held — and "
+    "the observed graph cross-checks against the SA011 static model "
+    "(`programs/analyze.py --lockdep-check`); see \"Static analysis & "
+    "runtime lockdep\"",
+)
+register(
+    "SPFFT_TPU_LOCKDEP_REPORT", "str", None,
+    "path the armed lockdep validator writes its "
+    "`spfft_tpu.analysis.lockdep/1` JSON report to at process exit; unset = "
+    "in-process only (`lockdep.report()`)",
+)
 # ---- serving-layer knobs ----------------------------------------------------
 register(
     "SPFFT_TPU_SERVE_QUEUE_CAP", "int", 256, floor=1,

@@ -95,9 +95,9 @@ class Ticket:
         error. ``timeout`` raises builtin ``TimeoutError`` (the ticket stays
         pending — the request is still owned by the service)."""
         if not self._event.wait(timeout):
-            # noqa: SA010 — documented builtin contract: callers polling a
-            # ticket catch concurrent.futures-style TimeoutError, and the
-            # request stays owned by the service (not a failure of it)
+            # documented builtin contract: callers polling a ticket catch
+            # concurrent.futures-style TimeoutError, and the request stays
+            # owned by the service (not a failure of it)
             raise TimeoutError("serving request still pending")  # noqa: SA010
         if self._error is not None:
             raise self._error

@@ -2,7 +2,7 @@
 
 Covers the acceptance surface of the analysis framework:
 
-* every checker (SA001-SA014) trips on an in-memory positive fixture and
+* every checker (SA001-SA019) trips on an in-memory positive fixture and
   stays silent on its clean negative twin,
 * framework semantics: ``# noqa`` suppression, ``--only`` selection by code
   and by name, loud missing-anchor findings on rooted trees,
@@ -331,6 +331,90 @@ def test_sa011_transitive_effects():
     assert codes(found) == ["SA011"] and "cycle" in found[0].message
 
 
+def test_sa011_multi_context_with_orders():
+    """``with A, B:`` acquires in item order — the single statement must
+    contribute the A->B edge (the ride-along bugfix), so an opposite
+    nested acquisition elsewhere is a cycle."""
+    files = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def one():\n    with A, B:\n        pass\n\n"
+            "def two():\n    with B:\n        with A:\n            pass\n"
+        ),
+    }
+    found = run_checker(files, "SA011")
+    assert codes(found) == ["SA011"] and "cycle" in found[0].message
+    # same order twice: no cycle
+    ordered = {
+        "spfft_tpu/m.py": LOCKS_HEADER + (
+            "def one():\n    with A, B:\n        pass\n\n"
+            "def two():\n    with A:\n        with B:\n            pass\n"
+        ),
+    }
+    assert not run_checker(ordered, "SA011")
+
+
+def test_sa011_exitstack_enter_context():
+    """``stack.enter_context(lock)`` chains acquire in call order and hold
+    for the rest of the body — ordered like nested ``with`` blocks."""
+    cycle = {
+        "spfft_tpu/m.py": "import contextlib\n" + LOCKS_HEADER + (
+            "def one():\n"
+            "    with contextlib.ExitStack() as es:\n"
+            "        es.enter_context(A)\n"
+            "        es.enter_context(B)\n\n"
+            "def two():\n    with B:\n        with A:\n            pass\n"
+        ),
+    }
+    sleepy = {
+        "spfft_tpu/m.py": "import contextlib\n" + LOCKS_HEADER + (
+            "def slow():\n"
+            "    with contextlib.ExitStack() as es:\n"
+            "        es.enter_context(A)\n"
+            "        time.sleep(1)\n"
+        ),
+    }
+    ordered = {
+        "spfft_tpu/m.py": "import contextlib\n" + LOCKS_HEADER + (
+            "def one():\n"
+            "    with contextlib.ExitStack() as es:\n"
+            "        es.enter_context(A)\n"
+            "        es.enter_context(B)\n\n"
+            "def two():\n    with A:\n        with B:\n            pass\n"
+        ),
+    }
+    found = run_checker(cycle, "SA011")
+    assert codes(found) == ["SA011"] and "cycle" in found[0].message
+    found = run_checker(sleepy, "SA011")
+    assert codes(found) == ["SA011"] and "time.sleep" in found[0].message
+    assert not run_checker(ordered, "SA011")
+
+
+def test_sa011_condition_wait_releases_only_its_own_lock():
+    """``Condition.wait`` on the held condition is exempt ONLY when the
+    condition is the whole held set — any other lock stays held across the
+    unbounded wait (the ride-along fix)."""
+    two_locks = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "L = threading.Lock()\ncv = threading.Condition()\n\n"
+            "def waiter():\n"
+            "    with L:\n"
+            "        with cv:\n"
+            "            cv.wait()\n"
+        ),
+    }
+    found = run_checker(two_locks, "SA011")
+    assert codes(found) == ["SA011"]
+    assert "releases only its own lock" in found[0].message
+    alone = {
+        "spfft_tpu/m.py": (
+            "import threading\n\ncv = threading.Condition()\n\n"
+            "def waiter():\n    with cv:\n        cv.wait()\n"
+        ),
+    }
+    assert not run_checker(alone, "SA011")
+
+
 # =============================================================================
 # checker 12: donation safety
 # =============================================================================
@@ -529,6 +613,483 @@ def test_sa014_raw_knob_reads():
 
 
 # =============================================================================
+# checker 15: batched/mesh donation safety
+# =============================================================================
+
+BATCH_COMPILE_OK = (
+    "import jax\n\n"
+    "def build_fused(graph, spec):\n"
+    '    donate = spec.get("donate")\n'
+    "    return jax.jit(graph, donate_argnums=tuple(donate))\n\n"
+    "def build_batched(graph, spec):\n"
+    '    donate = spec.get("donate")\n'
+    "    return jax.jit(graph, donate_argnums=tuple(donate))\n\n"
+    "class EngineIr:\n"
+    "    def describe(self):\n"
+    '        donated = list(self.spec["donate"])\n'
+    '        return {"donation": donated}\n'
+)
+
+
+def _batch_lower_fixture(second_inputs, outputs, builder="_lower_slab_x"):
+    return (
+        "from .graph import StageGraph\n\n"
+        f"def {builder}(e):\n"
+        "    def backward():\n"
+        '        g = StageGraph("backward")\n'
+        '        g.add_input("values_re")\n'
+        '        g.add_input("values_im")\n'
+        '        g.batch_inputs = ("values_re", "values_im")\n'
+        '        g.add("compression", e._st_d, ("values_re", "values_im"), ("sticks",))\n'
+        f'        g.add("z transform", e._st_z, {second_inputs}, ("z",))\n'
+        f"        g.set_outputs({outputs})\n"
+        "        return g\n"
+        "    return backward()\n"
+    )
+
+
+def test_sa015_batched_use_after_consume():
+    pos = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _batch_lower_fixture(
+            '("sticks", "values_im")', '["z"]'
+        ),
+        "spfft_tpu/ir/compile.py": BATCH_COMPILE_OK,
+    }
+    escapes = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _batch_lower_fixture(
+            '("sticks",)', '["z", "values_re"]'
+        ),
+        "spfft_tpu/ir/compile.py": BATCH_COMPILE_OK,
+    }
+    neg = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _batch_lower_fixture('("sticks",)', '["z"]'),
+        "spfft_tpu/ir/compile.py": BATCH_COMPILE_OK,
+    }
+    found = run_checker(pos, "SA015")
+    assert codes(found) == ["SA015"]
+    assert "referenced after its consuming node" in found[0].message
+    found = run_checker(escapes, "SA015")
+    assert codes(found) == ["SA015"] and "escapes" in found[0].message
+    assert not run_checker(neg, "SA015")
+
+
+def test_sa015_mesh_builders_held_to_the_same_rule():
+    """A NON-local builder (slab/pencil) with a doubly-consumed batch edge
+    is a finding too — SA012 only guards _lower_local_*."""
+    files = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _batch_lower_fixture(
+            '("sticks", "values_im")', '["z"]', builder="_lower_pencil_x"
+        ),
+        "spfft_tpu/ir/compile.py": BATCH_COMPILE_OK,
+    }
+    assert codes(run_checker(files, "SA015")) == ["SA015"]
+    assert not run_checker(files, "SA012")  # local-only checker stays silent
+
+
+def test_sa015_donated_position_must_be_batch_edge():
+    lower = (
+        "from .graph import StageGraph\n\n"
+        "def _lower_local_x(e):\n"
+        "    def backward():\n"
+        '        g = StageGraph("backward")\n'
+        '        g.add_input("values_re")\n'
+        '        g.add_input("values_im")\n'
+        '        g.batch_inputs = ("values_re",)\n'
+        '        g.add("compression", e._st_d, ("values_re", "values_im"), ("sticks",))\n'
+        '        g.set_outputs(["sticks"])\n'
+        "        return g\n"
+        "    return backward()\n"
+    )
+    files = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,  # donates positions (0, 1)
+        "spfft_tpu/ir/lower.py": lower,
+        "spfft_tpu/ir/compile.py": BATCH_COMPILE_OK,
+    }
+    found = run_checker(files, "SA015")
+    assert codes(found) == ["SA015"]
+    assert "not a declared batch_inputs edge" in found[0].message
+
+
+def test_sa015_batched_jit_stopped_donating():
+    no_batch_donate = BATCH_COMPILE_OK.replace(
+        "def build_batched(graph, spec):\n"
+        '    donate = spec.get("donate")\n'
+        "    return jax.jit(graph, donate_argnums=tuple(donate))\n",
+        "def build_batched(graph, spec):\n"
+        "    return jax.jit(graph)\n",
+    )
+    files = {
+        "spfft_tpu/e.py": SPEC_FIXTURE,
+        "spfft_tpu/ir/lower.py": _batch_lower_fixture('("sticks",)', '["z"]'),
+        "spfft_tpu/ir/compile.py": no_batch_donate,
+    }
+    found = run_checker(files, "SA015")
+    assert codes(found) == ["SA015"]
+    assert "silently stopped donating" in found[0].message
+
+
+# =============================================================================
+# checker 16: metrics-vocabulary discipline
+# =============================================================================
+
+METRICS_FIXTURE = (
+    "METRICS = (\n"
+    '    ("good_total", "counter", ("tenant",), "a counter"),\n'
+    '    ("depth", "gauge", (), "a gauge"),\n'
+    ")\n"
+)
+
+
+def test_sa016_rogue_and_dead_metrics():
+    pos = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.counter("good_total", tenant=t).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+            'obs.counter("rogue_total").inc()\n'
+        ),
+    }
+    dead = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": 'obs.counter("good_total", tenant=t).inc()\n',
+    }
+    neg = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.counter("good_total", tenant=t).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    found = run_checker(pos, "SA016")
+    assert codes(found) == ["SA016"] and "rogue_total" in found[0].message
+    found = run_checker(dead, "SA016")
+    assert codes(found) == ["SA016"] and "dead declaration" in found[0].message
+    assert not run_checker(neg, "SA016")
+
+
+def test_sa016_label_and_kind_mismatch():
+    wrong_labels = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.counter("good_total", engine=e).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    wrong_kind = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.histogram("good_total", tenant=t).observe(1)\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    dynamic_name = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.counter(name, tenant=t).inc()\n'
+            'obs.counter("good_total", tenant=t).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    found = run_checker(wrong_labels, "SA016")
+    assert codes(found) == ["SA016"] and "label keys" in found[0].message
+    found = run_checker(wrong_kind, "SA016")
+    assert codes(found) == ["SA016"] and "declared a counter" in found[0].message
+    found = run_checker(dynamic_name, "SA016")
+    assert codes(found) == ["SA016"] and "literal metric name" in found[0].message
+
+
+def test_sa016_starred_label_resolution():
+    """``**{dict literal}`` and ``**name`` (dict-literal assigned in the
+    module) resolve; an unresolvable ``**`` skips only the label check."""
+    resolved = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'labels = {"tenant": "a"}\n'
+            'obs.counter("good_total", **labels).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    mismatch = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            'obs.counter("good_total", **{"engine": "a"}).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    opaque = {
+        "spfft_tpu/obs/metrics.py": METRICS_FIXTURE,
+        "spfft_tpu/m.py": (
+            "def f(kw):\n"
+            '    obs.counter("good_total", **kw).inc()\n'
+            'obs.gauge("depth").set(1)\n'
+        ),
+    }
+    assert not run_checker(resolved, "SA016")
+    found = run_checker(mismatch, "SA016")
+    assert codes(found) == ["SA016"] and "label keys" in found[0].message
+    assert not run_checker(opaque, "SA016")
+
+
+# =============================================================================
+# checker 17: thread-lifecycle discipline
+# =============================================================================
+
+
+def test_sa017_thread_daemon_or_joined():
+    leaked = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "def go():\n"
+            "    t = threading.Thread(target=work)\n"
+            "    t.start()\n"
+        ),
+    }
+    daemon = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "def go():\n"
+            "    t = threading.Thread(target=work, daemon=True)\n"
+            "    t.start()\n"
+        ),
+    }
+    joined = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "def go():\n"
+            "    t = threading.Thread(target=work)\n"
+            "    t.start()\n"
+            "    t.join(5.0)\n"
+        ),
+    }
+    unbound = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "def go():\n"
+            "    threading.Thread(target=work).start()\n"
+        ),
+    }
+    found = run_checker(leaked, "SA017")
+    assert codes(found) == ["SA017"] and "neither daemon" in found[0].message
+    assert not run_checker(daemon, "SA017")
+    assert not run_checker(joined, "SA017")
+    found = run_checker(unbound, "SA017")
+    assert codes(found) == ["SA017"] and "unbound" in found[0].message
+    # a nested construction with the daemon assignment at outer level is
+    # clean — binding collection completes before the daemon pass
+    late_daemon = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "class S:\n"
+            "    def go(self, restart):\n"
+            "        if restart:\n"
+            "            self._t = threading.Thread(target=self.work)\n"
+            "        self._t.daemon = True\n"
+            "        self._t.start()\n"
+        ),
+    }
+    assert not run_checker(late_daemon, "SA017")
+
+
+def test_sa017_bounded_parks():
+    waits = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "cv = threading.Condition()\n\n"
+            "def park():\n"
+            "    with cv:\n"
+            "        cv.wait()\n"
+        ),
+    }
+    bounded = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "cv = threading.Condition()\n\n"
+            "def park(timeout):\n"
+            "    with cv:\n"
+            "        cv.wait(timeout)\n"
+        ),
+    }
+    join_forever = {
+        "spfft_tpu/m.py": (
+            "import threading\n\n"
+            "def stop(worker):\n"
+            "    worker.join()\n"
+        ),
+    }
+    str_join_ok = {
+        "spfft_tpu/m.py": 'def fmt(parts):\n    return ", ".join(parts)\n',
+    }
+    found = run_checker(waits, "SA017")
+    assert codes(found) == ["SA017"] and "unbounded park" in found[0].message
+    assert not run_checker(bounded, "SA017")
+    found = run_checker(join_forever, "SA017")
+    assert codes(found) == ["SA017"] and ".join()" in found[0].message
+    assert not run_checker(str_join_ok, "SA017")
+    # Queue.get: block=True / get(True) / bare get() all park unbounded;
+    # get(False) and a real timeout are fine
+    def queue_fixture(call):
+        return {
+            "spfft_tpu/m.py": (
+                "import queue\n\nq = queue.Queue()\n\n"
+                f"def pump():\n    return q.{call}\n"
+            ),
+        }
+
+    for bad in ("get()", "get(True)", "get(block=True)"):
+        found = run_checker(queue_fixture(bad), "SA017")
+        assert codes(found) == ["SA017"], bad
+        assert "unbounded park" in found[0].message
+    for ok in ("get(False)", "get(timeout=1.0)", "get(True, 2.0)", "get_nowait()"):
+        assert not run_checker(queue_fixture(ok), "SA017"), ok
+
+
+# =============================================================================
+# checker 18: fault-site chaos coverage
+# =============================================================================
+
+PLANE_FIXTURE = 'SITES = ("a.site", "b.site")\n'
+
+# fixture arming tokens are assembled at runtime: SA018 scans THIS file's
+# string constants for the site=kind grammar, and the made-up fixture sites
+# must not register as unknown-site findings (the PFX idiom of SA003)
+RAISE = "rai" + "se"
+CORRUPT = "cor" + "rupt"
+
+
+def test_sa018_every_site_has_a_targeted_test():
+    covered = {
+        "spfft_tpu/faults/plane.py": PLANE_FIXTURE,
+        "tests/test_chaos.py": (
+            "def test_a():\n"
+            f'    with faults.inject("a.site={RAISE}"):\n'
+            "        pass\n\n"
+            "def test_b():\n"
+            '    faults.arm({"b.site": {"kind": "nan"}})\n'
+        ),
+    }
+    uncovered = {
+        "spfft_tpu/faults/plane.py": PLANE_FIXTURE,
+        "tests/test_chaos.py": (
+            "def test_a():\n"
+            f'    with faults.inject("a.site={RAISE}"):\n'
+            "        pass\n"
+        ),
+    }
+    assert not run_checker(covered, "SA018")
+    found = run_checker(uncovered, "SA018")
+    assert codes(found) == ["SA018"]
+    assert "b.site" in found[0].message
+    assert "no targeted chaos test" in found[0].message
+
+
+def test_sa018_unknown_site_in_test_spec():
+    files = {
+        "spfft_tpu/faults/plane.py": PLANE_FIXTURE,
+        "tests/test_chaos.py": (
+            "def test_a():\n"
+            f'    with faults.inject("a.site={RAISE},ghost.site={CORRUPT}:0.5"):\n'
+            "        pass\n\n"
+            "def test_b():\n"
+            '    faults.arm({"b.site": {"kind": "nan"}})\n'
+        ),
+    }
+    found = run_checker(files, "SA018")
+    assert codes(found) == ["SA018"] and "ghost.site" in found[0].message
+    # the dynamic sweep (f-strings) is not coverage and not a false positive
+    sweep_only = {
+        "spfft_tpu/faults/plane.py": PLANE_FIXTURE,
+        "tests/test_chaos.py": (
+            "def test_sweep(site_name):\n"
+            '    with faults.inject(f"{site_name}=raise"):\n'
+            "        pass\n"
+        ),
+    }
+    found = run_checker(sweep_only, "SA018")
+    assert len(found) == 2  # both sites uncovered: the sweep does not count
+
+
+# =============================================================================
+# checker 19: blocking while traced
+# =============================================================================
+
+
+def test_sa019_sleep_and_lock_inside_span():
+    sleepy = {
+        "spfft_tpu/m.py": (
+            "import time\n\n"
+            "def f():\n"
+            '    with timing.scoped("dispatch"):\n'
+            "        time.sleep(0.1)\n"
+        ),
+    }
+    locked = {
+        "spfft_tpu/m.py": (
+            "import threading\n\nL = threading.Lock()\n\n"
+            "def f():\n"
+            '    with trace.span("phase", label="x"):\n'
+            "        with L:\n"
+            "            pass\n"
+        ),
+    }
+    acquired = {
+        "spfft_tpu/m.py": (
+            "import threading\n\nL = threading.Lock()\n\n"
+            "def f():\n"
+            '    with trace.operation("execute"):\n'
+            "        L.acquire()\n"
+        ),
+    }
+    clean = {
+        "spfft_tpu/m.py": (
+            "import time\nimport threading\n\nL = threading.Lock()\n\n"
+            "def f():\n"
+            "    time.sleep(0.1)\n"
+            "    with L:\n"
+            "        pass\n"
+            '    with timing.scoped("dispatch"):\n'
+            "        g()\n"
+        ),
+    }
+    found = run_checker(sleepy, "SA019")
+    assert codes(found) == ["SA019"] and "time.sleep" in found[0].message
+    assert "timing.scoped 'dispatch'" in found[0].message
+    found = run_checker(locked, "SA019")
+    assert codes(found) == ["SA019"] and "acquired inside" in found[0].message
+    found = run_checker(acquired, "SA019")
+    assert codes(found) == ["SA019"] and ".acquire()d inside" in found[0].message
+    assert not run_checker(clean, "SA019")
+
+
+def test_sa019_nested_defs_execute_outside_the_span():
+    files = {
+        "spfft_tpu/m.py": (
+            "import time\n\n"
+            "def f():\n"
+            '    with timing.scoped("dispatch"):\n'
+            "        def cb():\n"
+            "            time.sleep(1)\n"
+            "        return cb\n"
+        ),
+    }
+    assert not run_checker(files, "SA019")
+    # ...including a lambda nested under a compound statement in the body
+    deep = {
+        "spfft_tpu/m.py": (
+            "import time\n\n"
+            "def f(cond, cbs):\n"
+            '    with timing.scoped("dispatch"):\n'
+            "        if cond:\n"
+            "            cbs.append(lambda: time.sleep(1))\n"
+        ),
+    }
+    assert not run_checker(deep, "SA019")
+
+
+# =============================================================================
 # framework semantics
 # =============================================================================
 
@@ -538,6 +1099,56 @@ def test_noqa_suppression_codes():
     right = {"spfft_tpu/m.py": "import os\nimport os  # noqa: SA001\nos.getcwd()\n"}
     assert not run_checker(bare, "SA001")
     assert not run_checker(right, "SA001")
+
+
+def test_parallel_run_matches_serial():
+    """The --jobs thread pool must produce byte-identical findings to the
+    serial reference — over the real tree, every checker."""
+    serial = analysis.run(analysis.Tree(root=ROOT), jobs=1)
+    parallel = analysis.run(analysis.Tree(root=ROOT), jobs=4)
+    assert [f.key() for f in serial] == [f.key() for f in parallel]
+    assert [f.line for f in serial] == [f.line for f in parallel]
+
+
+def test_list_noqa_and_orphan_detection():
+    files = {
+        "spfft_tpu/m.py": (
+            "def f():\n"
+            '    raise ValueError("x")  # noqa: SA010\n'  # live suppression
+            "X = 1  # noqa: SA011\n"               # orphaned: nothing fires
+            "Y = 2  # noqa: F401\n"                # foreign code: not listed
+            '"""prose mentioning # noqa: SA012 is not a suppression"""\n'
+        ),
+    }
+    tree = analysis.Tree(files=files)
+    rows = analysis.list_noqa(tree)
+    assert [(r["line"], r["codes"]) for r in rows] == [
+        (2, ["SA010"]), (3, ["SA011"]),
+    ]
+    raw = analysis.run(tree, suppress=False)
+    fired = {(f.code, f.file, f.line) for f in raw}
+    assert ("SA010", "spfft_tpu/m.py", 2) in fired
+    assert ("SA011", "spfft_tpu/m.py", 3) not in fired  # the orphan
+    # the suppressed run honors the live noqa
+    assert not analysis.run(tree, only=["SA010"])
+
+
+def test_list_noqa_cli_trips_on_orphan(tmp_path):
+    pkg = tmp_path / "spfft_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("X = 1  # noqa: SA010\n")
+    r = _analyze("--root", str(tmp_path), "--list-noqa")
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "ORPHANED" in r.stdout
+    (pkg / "m.py").write_text('def f():\n    raise ValueError("x")  # noqa: SA010\n')
+    r = _analyze("--root", str(tmp_path), "--list-noqa")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all live" in r.stdout
+
+
+def test_real_tree_noqa_audit_is_clean():
+    r = _analyze("--list-noqa", "-q")
+    assert r.returncode == 0, r.stdout + r.stderr
 
 
 def test_only_selection_and_unknown():
@@ -562,7 +1173,7 @@ def test_missing_anchor_is_loud_on_rooted_tree(tmp_path):
 
 def test_checker_registry_is_complete():
     assert [c.code for c in analysis.CHECKERS.values()] == [
-        f"SA0{i:02d}" for i in range(1, 15)
+        f"SA0{i:02d}" for i in range(1, 20)
     ]
     for entry in analysis.CHECKERS.values():
         assert entry.doc and entry.severity == "error"
@@ -680,7 +1291,7 @@ def test_real_tree_is_green():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     doc = json.loads(r.stdout)
     assert not analysis.validate_report(doc)
-    assert len(doc["checkers"]) == 14
+    assert len(doc["checkers"]) == 19
     assert doc["counts"]["new"] == 0 and doc["counts"]["stale_baseline"] == 0
 
 
@@ -701,7 +1312,7 @@ def test_standalone_load_pulls_no_jax():
         f"sys.path.insert(0, {str(ROOT / 'programs')!r})\n"
         "from analyze import load_analysis\n"
         "a = load_analysis()\n"
-        "assert len(a.CHECKERS) == 14\n"
+        "assert len(a.CHECKERS) == 19\n"
         "assert 'jax' not in sys.modules, 'analysis load pulled jax'\n"
         "assert 'spfft_tpu' not in sys.modules, 'analysis load pulled spfft_tpu'\n"
         "print('standalone ok')\n"

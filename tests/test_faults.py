@@ -99,7 +99,7 @@ def test_spec_parsing_and_validation():
     }
     for bad in (
         "nonsense",
-        "unknown.site=raise",
+        "unknown.site=raise",  # noqa: SA018 — the typed-refusal case under test
         "engine.compile=explode",
         "engine.compile=raise:2.0",
         "engine.compile=raise:x",

@@ -1,0 +1,147 @@
+"""The typed env-knob registry (``spfft_tpu.knobs``), swept whole.
+
+Parametrized over EVERY registered knob — a new registration is covered
+the moment it lands, with no test edit:
+
+* the registered default round-trips through the knob's typed getter with
+  the env unset (type coercion, floor clamping, None passthrough),
+* the default round-trips through the ENV path too (set the env to the
+  default's string form, get the same resolved value back),
+* every malformed value raises typed ``InvalidParameterError`` — never a
+  bare ``ValueError`` — naming the knob (int/float/bool kinds, and str
+  kinds with a choices vocabulary; a free-form str knob has no malformed
+  values),
+* the regenerated docs knob table matches the registry exactly (the
+  ``programs/gen_api_docs.py`` rendering vs the committed block between
+  the ``knob-table`` markers in ``docs/details.md``) — both ways, row for
+  row.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "programs"))
+
+from spfft_tpu import knobs  # noqa: E402
+from spfft_tpu.errors import InvalidParameterError  # noqa: E402
+
+ALL_KNOBS = knobs.names()
+
+MALFORMED = {
+    "int": "not-an-int",
+    "float": "not-a-float",
+    "bool": "maybe",
+}
+
+
+def _expected_default(knob):
+    d = knob.default
+    if d is None:
+        return None
+    if knob.kind == "int":
+        v = int(d)
+        return max(int(knob.floor), v) if knob.floor is not None else v
+    if knob.kind == "float":
+        v = float(d)
+        return max(float(knob.floor), v) if knob.floor is not None else v
+    if knob.kind == "bool":
+        return bool(d)
+    return str(d)
+
+
+@pytest.mark.parametrize("name", ALL_KNOBS)
+def test_default_round_trips_through_typed_getter(name, monkeypatch):
+    monkeypatch.delenv(name, raising=False)
+    knob = knobs.REGISTRY[name]
+    got = knobs.get(name)
+    expected = _expected_default(knob)
+    if knob.kind == "bool" and knob.default is None:
+        expected = False  # bool(None): an unset bool knob resolves False
+    assert got == expected, (name, got, expected)
+    if got is not None and knob.choices:
+        assert got in knob.choices, (name, got, knob.choices)
+
+
+@pytest.mark.parametrize("name", ALL_KNOBS)
+def test_default_round_trips_through_env(name, monkeypatch):
+    knob = knobs.REGISTRY[name]
+    if knob.default is None:
+        # unset and empty-string are both "use the default" (shell idiom)
+        monkeypatch.setenv(name, "")
+        assert knobs.get(name) == _expected_default(knob) or (
+            knob.kind == "bool" and knobs.get(name) is False
+        )
+        return
+    if knob.kind == "bool":
+        env_value = "1" if knob.default else "0"
+    else:
+        env_value = str(knob.default)
+    monkeypatch.setenv(name, env_value)
+    assert knobs.get(name) == _expected_default(knob), name
+
+
+@pytest.mark.parametrize("name", ALL_KNOBS)
+def test_malformed_value_raises_typed(name, monkeypatch):
+    knob = knobs.REGISTRY[name]
+    if knob.kind == "str":
+        if not knob.choices:
+            pytest.skip("free-form str knob: every value is well-formed")
+        bad = "::definitely-not-a-choice::"
+    else:
+        bad = MALFORMED[knob.kind]
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(InvalidParameterError) as exc:
+        knobs.get(name)
+    # the typed error names the knob and the offending value (loud config)
+    assert name in str(exc.value) and bad in str(exc.value)
+    # and it is never a bare ValueError leaking an untyped contract
+    assert not type(exc.value) is ValueError  # noqa: E721
+
+
+def test_registry_shape_is_sound():
+    assert len(ALL_KNOBS) == len(set(ALL_KNOBS))
+    for name in ALL_KNOBS:
+        knob = knobs.REGISTRY[name]
+        assert name.startswith(knobs.PREFIX)
+        assert knob.kind in ("int", "float", "bool", "str")
+        assert knob.doc, f"{name} has no doc"
+        if knob.choices:
+            assert knob.kind == "str"
+            if knob.default is not None:
+                assert str(knob.default) in knob.choices, name
+
+
+def test_docs_knob_table_matches_registry():
+    """The committed docs/details.md knob table IS the registry rendering —
+    regenerating must be a no-op (python programs/gen_api_docs.py)."""
+    import gen_api_docs as g
+
+    text = (ROOT / "docs" / "details.md").read_text()
+    begin = text.index(g.KNOB_TABLE_BEGIN) + len(g.KNOB_TABLE_BEGIN)
+    end = text.index(g.KNOB_TABLE_END)
+    committed = text[begin:end].strip()
+    assert committed == g.knob_table().strip()
+    # every non-internal registered knob has exactly one table row
+    rows = [l for l in committed.splitlines() if l.startswith("| `SPFFT_TPU_")]
+    assert len(rows) == len(knobs.names(internal=False))
+    first_cells = [r.split("|")[1].strip().strip("`") for r in rows]
+    assert sorted(first_cells) == list(knobs.names(internal=False))
+
+
+def test_docs_metric_table_matches_vocabulary():
+    """The committed metric table IS the obs.metrics vocabulary rendering
+    (the same regeneration contract as the knob table)."""
+    import gen_api_docs as g
+    from spfft_tpu.obs import metrics
+
+    text = (ROOT / "docs" / "details.md").read_text()
+    begin = text.index(g.METRIC_TABLE_BEGIN) + len(g.METRIC_TABLE_BEGIN)
+    end = text.index(g.METRIC_TABLE_END)
+    committed = text[begin:end].strip()
+    assert committed == g.metric_table().strip()
+    rows = [l for l in committed.splitlines() if l.startswith("| `")]
+    assert len(rows) == len(metrics.METRICS)

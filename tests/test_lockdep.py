@@ -1,0 +1,297 @@
+"""Runtime lockdep validator (``spfft_tpu.analysis.lockdep``).
+
+Covers the acceptance surface of the concurrency soundness layer's runtime
+half:
+
+* wrapper recording: acquisition edges (at the attempt), RLock re-entry
+  exempt, per-thread held stacks, Condition/Event waits entered with
+  another lock held land in ``blocking``,
+* the ``spfft_tpu.analysis.lockdep/1`` report schema + validator + cycles,
+* install/uninstall restore the real ``threading`` factories; foreign
+  (non-package) creations pass through unwrapped,
+* cross-check semantics against the SA011 static graph: matched edges are
+  explained, an edge the static model lacks is a ``stale-static`` finding,
+  statically untracked locks are ``dynamic`` (explained, not findings),
+* the armed end-to-end path: ``SPFFT_TPU_LOCKDEP=1`` installs at package
+  import, ``SPFFT_TPU_LOCKDEP_REPORT`` dumps the report at process exit,
+  and the dump cross-checks green against the real tree's static graph.
+
+The unit tests force ``_in_package`` open so locks created HERE record;
+everything is uninstalled + reset in ``finally`` — the patch is process-
+global state exactly like the fault plane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "programs"))
+
+from analyze import load_analysis  # noqa: E402
+
+analysis = load_analysis()
+lockdep = analysis.lockdep
+locks_mod = analysis.locks
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Install the wrappers with the package predicate forced open, and
+    guarantee uninstall + reset afterwards."""
+    monkeypatch.setattr(lockdep, "_in_package", lambda rel: True)
+    lockdep.install()
+    lockdep.reset()
+    try:
+        yield lockdep
+    finally:
+        lockdep.uninstall()
+        lockdep.reset()
+
+
+def test_edges_cycles_and_schema(armed):
+    A = threading.Lock()
+    B = threading.Lock()
+    with A:
+        with B:
+            pass
+    with B:
+        with A:
+            pass
+    doc = armed.report()
+    assert not armed.validate_report(doc), armed.validate_report(doc)
+    assert doc["schema"] == "spfft_tpu.analysis.lockdep/1"
+    ids = {l["id"] for l in doc["locks"]}
+    assert len(ids) == 2 and all("test_lockdep" in i for i in ids)
+    pairs = {(e["from"], e["to"]) for e in doc["edges"]}
+    assert len(pairs) == 2  # both orders observed
+    assert len(doc["cycles"]) == 1 and len(doc["cycles"][0]) == 2
+    json.dumps(doc)  # JSON-plain
+
+
+def test_rlock_reentry_is_not_an_edge(armed):
+    R = threading.RLock()
+    with R:
+        with R:
+            pass
+    doc = armed.report()
+    assert doc["edges"] == [] and doc["cycles"] == []
+    assert [l["kind"] for l in doc["locks"]] == ["rlock"]
+
+
+def test_same_site_instances_record_a_self_edge(armed):
+    """Two per-instance locks created at ONE site (the `self._lock`
+    pattern) nested inside each other are an unordered two-instance
+    hazard — identity exempts only same-instance re-entry, so the nesting
+    records a site-level self-edge instead of vanishing."""
+    def make():
+        return threading.Lock()
+
+    a = make()
+    b = make()  # same creation site as `a`
+    assert a.lock_id == b.lock_id
+    with a:
+        with b:
+            pass
+    doc = armed.report()
+    assert [(e["from"], e["to"]) for e in doc["edges"]] == [
+        (a.lock_id, a.lock_id)
+    ]
+    # and the cross-check calls the hazard out, statically known or not
+    chk = lockdep.crosscheck(doc, {"locks": {}, "edges": []})
+    assert [f["kind"] for f in chk["findings"]] == ["same-site-nesting"]
+    assert "ABBA" in chk["findings"][0]["message"]
+
+
+def test_edge_recorded_at_attempt_even_when_acquire_fails(armed):
+    A = threading.Lock()
+    B = threading.Lock()
+    B.acquire()  # so the attempt below fails (and B joins the held stack)
+    with A:
+        # a failed non-blocking acquire still records the ordering attempt
+        # (a real deadlock must leave its edge in the report)
+        assert not B.acquire(False)
+    B.release()
+    doc = armed.report()
+    pairs = {(e["from"], e["to"]) for e in doc["edges"]}
+    assert (A.lock_id, B.lock_id) in pairs  # the failed attempt's edge
+
+
+def test_condition_wait_with_other_lock_held_is_blocking(armed):
+    A = threading.Lock()
+    cv = threading.Condition()
+    with A:
+        with cv:
+            cv.wait(0.01)
+    doc = armed.report()
+    assert doc["blocking"], doc
+    row = doc["blocking"][0]
+    assert row["lock"] == cv.lock_id and A.lock_id in row["held"]
+    # the same wait with ONLY the condition held records nothing
+    armed.reset()
+    with cv:
+        cv.wait(0.01)
+    assert armed.report()["blocking"] == []
+
+
+def test_event_wait_with_lock_held_is_blocking(armed):
+    A = threading.Lock()
+    ev = threading.Event()
+    ev.set()
+    with A:
+        ev.wait(0.01)
+    doc = armed.report()
+    assert any(r["lock"] == ev.lock_id for r in doc["blocking"])
+
+
+def test_cross_thread_handoff_observed(armed):
+    """Edges come from per-thread held stacks: two threads acquiring in
+    opposite orders produce the cycle no single thread shows."""
+    A = threading.Lock()
+    B = threading.Lock()
+    gate = threading.Barrier(2, timeout=10)
+
+    def ab():
+        gate.wait()
+        with A:
+            with B:
+                pass
+
+    def ba():
+        gate.wait()
+        with B:
+            with A:
+                pass
+
+    t1 = threading.Thread(target=ab, daemon=True)
+    t2 = threading.Thread(target=ba, daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    doc = armed.report()
+    assert len(doc["cycles"]) == 1
+
+
+def test_uninstall_restores_factories():
+    real = threading.Lock
+    lockdep.install()
+    try:
+        assert threading.Lock is not real
+    finally:
+        lockdep.uninstall()
+    assert threading.Lock is real
+    # foreign creations during the armed window pass through unwrapped
+    lockdep.install()
+    try:
+        lockdep.reset()
+        lock = threading.Lock()  # tests/ is not the package: passthrough
+        assert not hasattr(lock, "lock_id")
+        assert lockdep.report()["locks"] == []
+    finally:
+        lockdep.uninstall()
+        lockdep.reset()
+
+
+def test_crosscheck_stale_static_and_dynamic(armed):
+    A = threading.Lock()
+    B = threading.Lock()
+    with A:
+        with B:
+            pass
+    doc = armed.report()
+    a, b = A.lock_id, B.lock_id
+    site = lambda lid: next(  # noqa: E731
+        (l["file"], l["line"]) for l in doc["locks"] if l["id"] == lid
+    )
+    known = {
+        "locks": {
+            "m.py::A": {"kind": "lock", "file": site(a)[0], "line": site(a)[1]},
+            "m.py::B": {"kind": "lock", "file": site(b)[0], "line": site(b)[1]},
+        },
+        "edges": [["m.py::A", "m.py::B"]],
+    }
+    chk = lockdep.crosscheck(doc, known)
+    assert chk["findings"] == [] and len(chk["explained"]["static"]) == 1
+    # the same runtime graph against a static model MISSING the edge: stale
+    stale = dict(known, edges=[])
+    chk = lockdep.crosscheck(doc, stale)
+    assert [f["kind"] for f in chk["findings"]] == ["stale-static"]
+    assert "static model is stale" in chk["findings"][0]["message"]
+    # unknown locks are dynamic: explained, not findings
+    chk = lockdep.crosscheck(doc, {"locks": {}, "edges": []})
+    assert chk["findings"] == [] and len(chk["explained"]["dynamic"]) == 1
+
+
+def test_static_graph_export_shape():
+    static = locks_mod.static_graph(analysis.Tree(root=ROOT))
+    assert static["locks"] and static["edges"]
+    # the known module-level locks resolve with real definition sites
+    reg = static["locks"].get("spfft_tpu/obs/registry.py::_lock")
+    assert reg and reg["file"] == "spfft_tpu/obs/registry.py" and reg["line"] > 0
+    assert all(len(e) == 2 for e in static["edges"])
+
+
+def test_env_armed_import_and_exit_dump(tmp_path):
+    """SPFFT_TPU_LOCKDEP=1 installs at package import; the report knob
+    dumps at process exit; the dump validates and cross-checks green
+    against the real static graph."""
+    report = tmp_path / "lockdep.json"
+    code = (
+        "import threading, spfft_tpu\n"
+        "from spfft_tpu.analysis import lockdep\n"
+        "assert lockdep.installed()\n"
+        "from spfft_tpu import obs\n"
+        "obs.counter('transforms_total', direction='backward', engine='x').inc()\n"
+        "snap = obs.snapshot()\n"
+    )
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SPFFT_TPU_LOCKDEP="1",
+        SPFFT_TPU_LOCKDEP_REPORT=str(report),
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(report.read_text())
+    assert not lockdep.validate_report(doc)
+    assert any(
+        l["id"] == "spfft_tpu/obs/registry.py::179" or
+        l["file"] == "spfft_tpu/obs/registry.py"
+        for l in doc["locks"]
+    ), doc["locks"]
+    r = subprocess.run(
+        [
+            sys.executable, str(ROOT / "programs" / "analyze.py"),
+            "--lockdep-check", str(report),
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "lockdep cross-check" in r.stdout
+
+
+def test_unarmed_import_does_not_install():
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import spfft_tpu\n"
+            "from spfft_tpu.analysis import lockdep\n"
+            "import threading\n"
+            "assert not lockdep.installed()\n"
+            "assert not hasattr(threading.Lock(), 'lock_id')\n",
+        ],
+        capture_output=True, text=True, cwd=ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", SPFFT_TPU_LOCKDEP=""),
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
