@@ -20,6 +20,7 @@
 #          ./ci.sh sched      # task-graph scheduler: gbench + gate + chaos
 #          ./ci.sh perf       # dbench scaling rows + schema + regression gate
 #          ./ci.sh ir         # stage-graph IR: parity suite + fbench fused-vs-staged gate
+#          ./ci.sh mhost      # multi-host serving: boot proof + chaos-killed worker
 #          ./ci.sh dryrun     # multichip dryrun only
 #          ./ci.sh native     # native build + tests only
 #
@@ -773,6 +774,122 @@ run_dryrun() {
   timeout 540 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 }
 
+run_mhost() {
+  echo "== MHost (multi-host serving: boot, RPC front, chaos-killed worker) =="
+  # The suites: bootstrap/typed-validation/lockdep-propagation
+  # (test_hostmesh) and RPC/heartbeat/host-lost ladder incl. the in-suite
+  # SIGKILL scenario (test_cluster).
+  timeout 540 python -m pytest tests/test_hostmesh.py tests/test_cluster.py -q
+  # Cross-process collective parity (slab engines + overlapped rewrite over
+  # real process boundaries); skips cleanly on jax runtimes whose CPU
+  # backend lacks multi-process collectives (jax < 0.5).
+  timeout 600 python -m pytest tests/test_multihost.py -q
+  local mdir
+  mdir="$(mktemp -d)"
+  # Boot proof: a REAL jax.distributed multi-controller run — 2 worker
+  # processes x 4 virtual CPU devices each, every rank must observe the
+  # 8-device global mesh (typed up-front validation of the coordinates is
+  # part of the same bootstrap).
+  JAX_PLATFORMS=cpu timeout 540 python - <<'EOF'
+from spfft_tpu import hostmesh
+
+workers = hostmesh.spawn_workers(2, devices_per_host=4, mesh=True)
+try:
+    for w in workers:
+        topo = w.ready["topology"]
+        assert topo["process_count"] == 2, topo
+        assert topo["global_devices"] == 8, topo
+        assert topo["local_devices"] == 4, topo
+finally:
+    hostmesh.stop_workers(workers)
+print("mhost boot ok: 2 processes x 4 devices, 8-device global mesh on every rank")
+EOF
+  # Chaos: host.heartbeat + rpc.submit armed at fractional rates AND a real
+  # SIGKILLed worker mid-ramp. The acceptance invariant: the run completes
+  # with zero untyped failures, offered == completed + refused + failed
+  # EXACTLY, the lost host lands in hosts_lost_total and on cards, and the
+  # surviving host keeps serving (completed_after_kill > 0).
+  JAX_PLATFORMS=cpu \
+    SPFFT_TPU_FAULTS="host.heartbeat=raise:0.05,rpc.submit=raise:0.05" \
+    timeout 540 python programs/loadgen.py -d 12 12 12 -s 0.8 --tenants 2 \
+    --rate 50 --ramp 1 --duration 3 --hosts 2 --host-devices 4 \
+    --kill-host 0 --kill-at 0.35 -o "$mdir/chaos.json" > /dev/null
+  JAX_PLATFORMS=cpu python - "$mdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/chaos.json"))
+assert doc["config"]["hosts"] == 2 and doc["config"]["kill_host"] == 0
+row = doc["rows"][0]
+refused = row["rejected"] + row["shed"] + row["deadline_miss"]
+# exact typed accounting through a SIGKILLed worker: nothing lost, nothing
+# double-counted, nothing untyped (an untyped escape would have crashed the
+# driver or left a pending ticket — both break this identity)
+assert row["completed"] + refused + row["failed"] == row["offered"], row
+assert row["completed_after_kill"] > 0, row
+topo = {t["host_id"]: t["alive"] for t in doc["config"]["topology"]}
+assert topo[0] is False and topo[1] is True, topo
+hosts = {h["name"]: h["lost"] for h in doc["service"]["hosts"]}
+assert hosts["host0"] is True and hosts["host1"] is False, hosts
+counters = doc["metrics"]["counters"]
+assert any(k.startswith("hosts_lost_total") for k in counters), counters
+assert any(k.startswith("faults_injected_total") for k in counters), counters
+cards = doc["service"]["plan_cards"]
+assert any(
+    dg["event"] == "host_lost" for c in cards for dg in c["degradations"]
+), cards
+front_degs = doc["service"]["degradations"]
+assert any(dg["event"] == "host_lost" for dg in front_degs), front_degs
+print(f"mhost chaos ok: {row['offered']} offered -> {row['completed']} "
+      f"completed ({row['completed_after_kill']} after the kill), "
+      f"{refused} refused, {row['failed']} typed failures, host0 lost")
+EOF
+  # Gate rows: a clean 2-host ramp, gate-compatible keys, regression-gated
+  # against the committed baseline (wide tolerance — loadgen throughput on
+  # a shared CI box is noisy; the gate catches algorithmic slides).
+  JAX_PLATFORMS=cpu timeout 540 python programs/loadgen.py -d 12 12 12 \
+    -s 0.8 --tenants 2 --rate 50 --ramp 1 2 --duration 2 --hosts 2 \
+    --host-devices 4 -o "$mdir/mhost.json" > /dev/null
+  python programs/perf_gate.py "$mdir/mhost.json" \
+    bench_results/mhost_baseline_cpu.json --tolerance 0.85 \
+    --require-matches 2 > /dev/null
+  python programs/perf_gate.py "$mdir/mhost.json" "$mdir/mhost.json" \
+    --require-matches 2 > /dev/null
+  # Lockdep across processes: workers spawned with SPFFT_TPU_LOCKDEP=1
+  # (env propagation) write per-host reports on clean shutdown; the front
+  # process writes its own; the merged fleet graph must cross-check clean
+  # against the SA011 static model.
+  JAX_PLATFORMS=cpu SPFFT_TPU_LOCKDEP=1 \
+    SPFFT_TPU_LOCKDEP_REPORT="$mdir/front.json" \
+    timeout 540 python - "$mdir" <<'EOF'
+import sys
+import numpy as np
+import spfft_tpu as sp
+from spfft_tpu import TransformType, hostmesh
+from spfft_tpu.serve.cluster import ClusterFront
+
+mdir = sys.argv[1]
+workers = hostmesh.spawn_workers(2, devices_per_host=1, lockdep_dir=mdir)
+front = ClusterFront([w.address for w in workers], heartbeat_s=0.1)
+trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.8)
+rng = np.random.default_rng(0)
+vals = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+try:
+    tks = [front.submit(TransformType.C2C, (8, 8, 8), trip, vals * (1 + i))
+           for i in range(6)]
+    for tk in tks:
+        tk.result(timeout=120)
+finally:
+    front.close()
+    hostmesh.stop_workers(workers)
+print("lockdep-armed mhost session ok")
+EOF
+  python programs/analyze.py --lockdep-check \
+    "$mdir/host0.json" "$mdir/host1.json" "$mdir/front.json"
+  rm -rf "$mdir"
+  echo "mhost stage ok"
+}
+
 run_native() {
   echo "== Native build + API tests =="
   # C API parity: zero reference-only names (exits nonzero on any hole).
@@ -800,6 +917,7 @@ case "$stage" in
   sched) run_sched ;;
   perf) run_perf ;;
   ir) run_ir ;;
+  mhost) run_mhost ;;
   dryrun) run_dryrun ;;
   native) run_native ;;
   all)
@@ -815,12 +933,13 @@ case "$stage" in
     run_sched
     run_perf
     run_ir
+    run_mhost
     run_dryrun
     run_native
     echo "== CI green =="
     ;;
   *)
-    echo "unknown stage: $stage (use lint | analyze | python | report | tune | trace | chaos | verify | serve | sched | perf | ir | dryrun | native | all)" >&2
+    echo "unknown stage: $stage (use lint | analyze | python | report | tune | trace | chaos | verify | serve | sched | perf | ir | mhost | dryrun | native | all)" >&2
     exit 2
     ;;
 esac

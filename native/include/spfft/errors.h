@@ -39,7 +39,10 @@ enum SpfftError {
    * overload (bounded queue full, tenant quota, load shedding) ... */
   SPFFT_SERVICE_OVERLOAD_ERROR = 24,
   /* ... and a request deadline expired at admission or pre-dispatch. */
-  SPFFT_DEADLINE_EXCEEDED_ERROR = 25
+  SPFFT_DEADLINE_EXCEEDED_ERROR = 25,
+  /* Multi-host extension: a worker host died or became unreachable
+   * (missed heartbeats / dead RPC transport) with work in flight. */
+  SPFFT_HOST_LOST_ERROR = 26
 };
 
 #ifndef __cplusplus

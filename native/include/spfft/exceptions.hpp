@@ -78,6 +78,8 @@ SPFFT_TPU_DEFINE_ERROR(ServiceOverloadError, SPFFT_SERVICE_OVERLOAD_ERROR,
                        "spfft_tpu: service overloaded, admission refused")
 SPFFT_TPU_DEFINE_ERROR(DeadlineExceededError, SPFFT_DEADLINE_EXCEEDED_ERROR,
                        "spfft_tpu: request deadline exceeded")
+SPFFT_TPU_DEFINE_ERROR(HostLostError, SPFFT_HOST_LOST_ERROR,
+                       "spfft_tpu: worker host lost (heartbeat/transport)")
 
 #undef SPFFT_TPU_DEFINE_ERROR
 

@@ -42,6 +42,9 @@ module spfft
   ! overload, and a request deadline expired at admission or pre-dispatch
   integer(c_int), parameter :: SPFFT_SERVICE_OVERLOAD_ERROR = 24
   integer(c_int), parameter :: SPFFT_DEADLINE_EXCEEDED_ERROR = 25
+  ! Multi-host extension: a worker host died or became unreachable with
+  ! work in flight (missed heartbeats / dead RPC transport)
+  integer(c_int), parameter :: SPFFT_HOST_LOST_ERROR = 26
 
   ! --- SpfftExchangeType (spfft/types.h) ---
   integer(c_int), parameter :: SPFFT_EXCH_DEFAULT = 0

@@ -113,22 +113,36 @@ def run_list_noqa(analysis, *, root: Path, quiet=False) -> int:
     return 0
 
 
-def run_lockdep_check(analysis, *, root: Path, report_path: Path) -> int:
-    """Cross-check a runtime lockdep report against the SA011 static
+def run_lockdep_check(analysis, *, root: Path, report_paths) -> int:
+    """Cross-check runtime lockdep report(s) against the SA011 static
     graph: unexplained runtime edges (the static model is stale), observed
-    cycles, and blocking waits exit 3."""
-    try:
-        doc = json.loads(Path(report_path).read_text())
-    except OSError as e:
-        print(f"cannot read lockdep report: {e}", file=sys.stderr)
-        return 2
-    except json.JSONDecodeError as e:
-        print(f"malformed lockdep report {report_path}: {e}", file=sys.stderr)
-        return 2
-    missing = analysis.lockdep.validate_report(doc)
-    if missing:
-        print(f"lockdep report schema incomplete: {missing}", file=sys.stderr)
-        return 2
+    cycles, and blocking waits exit 3. Multiple reports (one per worker
+    host of a multi-host run) merge into one site-keyed graph first
+    (:func:`spfft_tpu.analysis.lockdep.merge_reports`)."""
+    if isinstance(report_paths, (str, Path)):
+        report_paths = [report_paths]
+    docs = []
+    for report_path in report_paths:
+        try:
+            one = json.loads(Path(report_path).read_text())
+        except OSError as e:
+            print(f"cannot read lockdep report: {e}", file=sys.stderr)
+            return 2
+        except json.JSONDecodeError as e:
+            print(
+                f"malformed lockdep report {report_path}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        missing = analysis.lockdep.validate_report(one)
+        if missing:
+            print(
+                f"lockdep report {report_path} schema incomplete: {missing}",
+                file=sys.stderr,
+            )
+            return 2
+        docs.append(one)
+    doc = docs[0] if len(docs) == 1 else analysis.lockdep.merge_reports(docs)
     static = analysis.locks.static_graph(analysis.Tree(root=root))
     chk = analysis.lockdep.crosscheck(doc, static)
     for f in chk["findings"]:
@@ -136,7 +150,8 @@ def run_lockdep_check(analysis, *, root: Path, report_path: Path) -> int:
     n_static = len(chk["explained"]["static"])
     n_dynamic = len(chk["explained"]["dynamic"])
     print(
-        f"lockdep cross-check: {doc['counts']['locks']} lock(s), "
+        f"lockdep cross-check ({len(docs)} report(s)): "
+        f"{doc['counts']['locks']} lock(s), "
         f"{doc['counts']['edges']} edge(s) — {n_static} matched the static "
         f"graph, {n_dynamic} on dynamic (statically untracked) locks, "
         f"{len(chk['findings'])} finding(s)"
@@ -257,10 +272,12 @@ def main(argv=None) -> int:
         "suppressions (the code no longer fires on that line) exit 3",
     )
     p.add_argument(
-        "--lockdep-check", metavar="REPORT",
-        help="cross-check a runtime lockdep report "
+        "--lockdep-check", metavar="REPORT", nargs="+",
+        help="cross-check runtime lockdep report(s) "
         "(spfft_tpu.analysis.lockdep/1 JSON) against the SA011 static "
-        "graph; unexplained edges/cycles/blocking exit 3",
+        "graph; multiple reports — e.g. one per worker host of a "
+        "multi-host run — are merged (lockdep.merge_reports) and checked "
+        "as one graph; unexplained edges/cycles/blocking exit 3",
     )
     p.add_argument(
         "--jobs", type=int, default=None, metavar="N",
@@ -283,7 +300,7 @@ def main(argv=None) -> int:
             return run_list_noqa(analysis, root=root, quiet=args.quiet)
         if args.lockdep_check:
             return run_lockdep_check(
-                analysis, root=root, report_path=args.lockdep_check
+                analysis, root=root, report_paths=args.lockdep_check
             )
         jobs = args.jobs
         if jobs is None:

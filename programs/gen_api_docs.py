@@ -284,6 +284,7 @@ def index_page() -> str:
         - [Fault injection, guard mode and degradation](faults.md)
         - [Self-verification (ABFT), recovery and the circuit breaker](verify.md)
         - [Serving: admission, coalesced batching, load shedding](serve.md)
+        - [Multi-host serving: bootstrap, RPC front, host-loss ladder](hostmesh.md)
         - [Task-graph scheduling: placement, overlap, completion order](sched.md)
         - [Stage-graph IR and per-direction fusion](ir.md)
         - [Static analysis: the checker catalog and the baselined gate](analysis.md)
@@ -427,6 +428,41 @@ def serve_page() -> str:
             serve.as_typed,
         ],
     )
+
+
+def hostmesh_page() -> str:
+    """The multi-host page: the `spfft_tpu.hostmesh` bootstrap plus the
+    cross-host serving surface (`serve.rpc` / `serve.cluster`)."""
+    from spfft_tpu import hostmesh, serve
+    from spfft_tpu.serve import rpc
+
+    boot = class_page(
+        "Multi-host bootstrap (`spfft_tpu.hostmesh`)",
+        doc(hostmesh),
+        [hostmesh.WorkerHost],
+        [
+            hostmesh.boot,
+            hostmesh.spawn_workers,
+            hostmesh.stop_workers,
+            hostmesh.child_env,
+            hostmesh.warm_start,
+            hostmesh.free_port,
+        ],
+    )
+    front = class_page(
+        "Cross-host serving (`spfft_tpu.serve.cluster` / `serve.rpc`)",
+        doc(serve.cluster),
+        [serve.ClusterFront, serve.HeartbeatMonitor, serve.HostHandle,
+         serve.RemotePlan, serve.RpcServer, serve.RpcClient],
+        [
+            rpc.send_msg,
+            rpc.recv_msg,
+            rpc.encode_array,
+            rpc.decode_value,
+            rpc.resolve_timeout_s,
+        ],
+    )
+    return boot + "\n" + front
 
 
 def sched_page() -> str:
@@ -708,6 +744,7 @@ def generate(outdir: Path) -> None:
         ),
         "verify.md": verify_page(),
         "serve.md": serve_page(),
+        "hostmesh.md": hostmesh_page(),
         "sched.md": sched_page(),
         "ir.md": ir_page(),
         "analysis.md": analysis_page(),

@@ -76,6 +76,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--settle-s", type=float, default=30.0,
                    help="max wait for outstanding tickets after each step")
+    p.add_argument("--hosts", type=int, default=0,
+                   help="spawn N RPC worker hosts (spfft_tpu.hostmesh) and "
+                   "drive the ClusterFront instead of an in-process "
+                   "service; 0 = single-process. Host topology is stamped "
+                   "in the report config and describe() either way")
+    p.add_argument("--host-devices", type=int, default=1,
+                   help="virtual CPU devices per spawned worker host")
+    p.add_argument("--kill-host", type=int, default=None, metavar="K",
+                   help="chaos: SIGKILL worker K mid-ramp (requires "
+                   "--hosts); the row records completed_after_kill")
+    p.add_argument("--kill-at", type=float, default=0.4,
+                   help="when to kill, as a fraction of the first measured "
+                   "step's offered window")
     p.add_argument("-o", "--output", default=None, help="write JSON report here")
     return p
 
@@ -88,9 +101,13 @@ def _percentile(sorted_vals: list, q: float) -> float:
 
 
 def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
-             transform_type, timeout_s, flops_per_transform, settle_s, rng):
+             transform_type, timeout_s, flops_per_transform, settle_s, rng,
+             kill_fn=None, kill_at_s=None):
     """One measured open-loop step at ``rate`` requests/sec; returns the
-    gate-compatible row."""
+    gate-compatible row. ``kill_fn`` (with ``kill_at_s`` seconds into the
+    offered window) is the chaos hook: it fires once, mid-ramp, and the row
+    additionally records when it fired and how many requests completed
+    AFTER it — the surviving-hosts-keep-serving evidence."""
     from spfft_tpu.errors import (
         DeadlineExceededError,
         GenericError,
@@ -102,12 +119,19 @@ def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
     tickets = []
     counts = {"offered": n_requests, "rejected": 0, "shed": 0,
               "deadline_miss": 0, "failed": 0}
+    kill_mono = None
     t0 = time.perf_counter()
     for i in range(n_requests):
         target = t0 + i * spacing
         delay = target - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
+        if (
+            kill_fn is not None and kill_mono is None
+            and time.perf_counter() - t0 >= float(kill_at_s or 0.0)
+        ):
+            kill_mono = time.monotonic()
+            kill_fn()
         tenant = f"tenant{i % tenants}"
         # per-request value perturbation: payloads differ per request the
         # way real traffic's do (coalescing must not depend on equal data)
@@ -139,11 +163,18 @@ def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
             counts["failed"] += 1
     wall = time.perf_counter() - t0
     completed = len(latencies)
+    completed_after_kill = None
+    if kill_mono is not None:
+        completed_after_kill = sum(
+            1 for t in tickets
+            if t.outcome == "completed"
+            and t.finished_at is not None and t.finished_at > kill_mono
+        )
     latencies.sort()
     p50 = _percentile(latencies, 0.50)
     p99 = _percentile(latencies, 0.99)
     noise = min(0.5, (p99 - p50) / p50) if p50 > 0 else 0.0
-    return {
+    row = {
         "key": key,
         "offered": n_requests,
         "offered_rate": round(n_requests / max(offered_wall, 1e-9), 3),
@@ -160,6 +191,10 @@ def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
         "seconds_noise": round(noise, 4),
         "wall_seconds": round(wall, 4),
     }
+    if kill_mono is not None:
+        row["killed_at_s"] = round(float(kill_at_s or 0.0), 3)
+        row["completed_after_kill"] = completed_after_kill
+    return row
 
 
 def main(argv=None) -> int:
@@ -180,10 +215,43 @@ def main(argv=None) -> int:
     flops_per_transform = perf.dense_pair_flops((dx, dy, dz)) / 2.0
     dtype = "f64" if values.real.dtype == np.float64 else "f32"
 
-    service = TransformService(
-        queue_capacity=args.queue_cap, batch_max=args.batch_max,
-        retries=args.retries, verify=args.verify, sched=bool(args.sched),
-    )
+    # argument validation BEFORE any worker is spawned: an early exit here
+    # must never orphan child processes
+    if args.kill_host is not None:
+        if args.hosts <= 0:
+            raise SystemExit("--kill-host requires --hosts N")
+        if not 0 <= args.kill_host < args.hosts:
+            raise SystemExit(
+                f"--kill-host {args.kill_host} out of range for "
+                f"--hosts {args.hosts}"
+            )
+    workers = []
+    if args.hosts > 0:
+        # multi-host mode: spawn the worker fleet, drive the ClusterFront —
+        # same submit/ticket surface, admission now spans hosts
+        from spfft_tpu import hostmesh
+        from spfft_tpu.serve.cluster import ClusterFront
+
+        workers = hostmesh.spawn_workers(
+            args.hosts, devices_per_host=args.host_devices
+        )
+        try:
+            service = ClusterFront(
+                [w.address for w in workers],
+                queue_capacity=args.queue_cap, batch_max=args.batch_max,
+                retries=args.retries,
+            )
+        except BaseException:
+            hostmesh.stop_workers(workers)
+            raise
+    else:
+        service = TransformService(
+            queue_capacity=args.queue_cap, batch_max=args.batch_max,
+            retries=args.retries, verify=args.verify, sched=bool(args.sched),
+        )
+    kill_fn = None
+    if args.kill_host is not None:
+        kill_fn = workers[args.kill_host].kill
     rows = []
     try:
         # warmup outside the measured window: plan build, first compile, and
@@ -218,12 +286,17 @@ def main(argv=None) -> int:
             flops_per_transform=flops_per_transform,
             settle_s=args.settle_s, rng=rng,
         )
-        for mult in args.ramp:
+        for step_i, mult in enumerate(args.ramp):
             rate = args.rate * mult
+            family = "mhost" if args.hosts > 0 else "serve"
+            hosts_token = f":h{args.hosts}" if args.hosts > 0 else ""
             key = (
-                f"serve:{dx}x{dy}x{dz}:s{int(round(args.sparsity * 100))}"
-                f":c2c:{dtype}:t{args.tenants}:x{mult:g}"
+                f"{family}:{dx}x{dy}x{dz}:s{int(round(args.sparsity * 100))}"
+                f":c2c:{dtype}:t{args.tenants}{hosts_token}:x{mult:g}"
             )
+            step_kill = kill_fn if (kill_fn is not None and step_i == 0) else None
+            if step_kill is not None:
+                key += ":chaos-kill"
             row = run_step(
                 service, key=key, rate=rate, duration=args.duration,
                 tenants=args.tenants, trip=trip, values=values,
@@ -231,6 +304,8 @@ def main(argv=None) -> int:
                 timeout_s=args.timeout_s,
                 flops_per_transform=flops_per_transform,
                 settle_s=args.settle_s, rng=rng,
+                kill_fn=step_kill,
+                kill_at_s=args.kill_at * args.duration,
             )
             rows.append(row)
             print(
@@ -241,7 +316,11 @@ def main(argv=None) -> int:
                 f"deadline {row['deadline_miss']}, failed {row['failed']})"
             )
     finally:
+        described = service.describe()
+        topology = [w.describe() for w in workers] or None
         service.close()
+        if workers:
+            hostmesh.stop_workers(workers)
 
     doc = {
         "schema": LOADGEN_SCHEMA,
@@ -254,9 +333,15 @@ def main(argv=None) -> int:
             "flops_per_transform": flops_per_transform, "dtype": dtype,
             "seed": args.seed, "sched": bool(args.sched),
             "batch_fuse": bool(args.batch_fuse),
+            # host topology: single-process (hosts=0) vs multi-host captures
+            # are distinguishable from the committed JSON alone
+            "hosts": int(args.hosts),
+            "host_devices": int(args.host_devices) if args.hosts else None,
+            "topology": topology,
+            "kill_host": args.kill_host,
         },
         "rows": rows,
-        "service": service.describe(),
+        "service": described,
         "metrics": obs.snapshot(),
     }
     if args.output:

@@ -9,7 +9,13 @@ engine, and verify their local slab against a dense oracle plus the value
 roundtrip. Prints "RANK <r> PASS" on success.
 
 Usage: multihost_smoke.py <rank> <port> <engine> [c2c|r2c]
-       [buffered|compact|unbuffered] [nprocs]
+       [buffered|compact|unbuffered] [nprocs] [overlap_chunks]
+
+``overlap_chunks > 1`` applies the OVERLAPPED exchange rewrite (PR 7 /
+the IR graph rewrite) across REAL process boundaries: the padded exchange
+splits into chunked double-buffered cross-process collectives — the parity
+assertions below prove the chunked wire protocol agrees with the dense
+oracle under Gloo exactly as it does on a single-controller mesh.
 """
 import os
 import sys
@@ -20,6 +26,7 @@ engine = sys.argv[3]
 ttype_name = sys.argv[4] if len(sys.argv) > 4 else "c2c"
 exchange_name = sys.argv[5] if len(sys.argv) > 5 else "buffered"
 nprocs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
+overlap = int(sys.argv[7]) if len(sys.argv) > 7 else 1
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
@@ -84,6 +91,7 @@ t = DistributedTransform(
         "unbuffered": ExchangeType.UNBUFFERED,
     }.get(exchange_name, ExchangeType.BUFFERED),
     engine=engine,
+    overlap=overlap,
 )
 ex = t._exec
 

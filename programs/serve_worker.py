@@ -1,0 +1,99 @@
+"""RPC serving worker: one host of a multi-host transform-serving fleet.
+
+Spawned by ``spfft_tpu.hostmesh.spawn_workers`` (or by hand): boots jax on
+this host (optionally joining a ``jax.distributed`` multi-controller run),
+warm-starts tuning wisdom from the fleet bundle
+(``SPFFT_TPU_HOSTS_WISDOM_BUNDLE``), stands up a local
+``serve.TransformService`` behind a length-prefixed-JSON ``RpcServer``
+(``spfft_tpu.serve.rpc``), and writes a ready file naming the bound port —
+the parent's boot handshake. Every ``SPFFT_TPU_*`` knob arrives via the
+environment (``hostmesh.child_env`` propagates the parent's), so lockdep
+arming, chaos specs and serving knobs govern workers exactly as they do a
+single-process run.
+
+Exits cleanly on the RPC ``shutdown`` op (so exit hooks — the lockdep
+report dump — run); a SIGKILL is the chaos scenario the cluster front's
+heartbeat/host-lost ladder exists for.
+
+Usage: serve_worker.py --host-id 0 --port 0 --ready-file /tmp/w0.json
+       [--coordinator host:port --num-processes N --process-id I]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host-id", type=int, default=0)
+    p.add_argument("--port", type=int, default=0,
+                   help="RPC listen port (0 = OS-assigned)")
+    p.add_argument("--ready-file", default=None,
+                   help="write a JSON ready record here once serving")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator host:port (joins a "
+                   "multi-controller run when given)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import spfft_tpu  # noqa: F401  (arms lockdep/faults from the env)
+    from spfft_tpu import hostmesh
+    from spfft_tpu.serve import TransformService
+    from spfft_tpu.serve.rpc import RpcServer
+
+    topology = None
+    if args.coordinator is not None:
+        topology = hostmesh.boot(
+            args.coordinator, args.num_processes, args.process_id
+        )
+    warm = hostmesh.warm_start()
+
+    shutdown = threading.Event()
+    service = TransformService(start=True)
+    server = RpcServer(
+        service, port=args.port, on_shutdown=shutdown.set
+    )
+
+    ready = {
+        "host_id": int(args.host_id),
+        "pid": os.getpid(),
+        "port": server.port,
+        "wisdom_warm_start": list(warm),
+        "topology": topology,
+        "env_knobs": sorted(
+            k for k in os.environ if k.startswith("SPFFT_TPU_")
+        ),
+    }
+    if args.ready_file:
+        tmp = Path(str(args.ready_file) + ".tmp")
+        tmp.write_text(json.dumps(ready, indent=1))
+        tmp.rename(args.ready_file)  # atomic: the parent never reads a torn file
+    print(f"SPFFT_WORKER_READY {json.dumps(ready)}", flush=True)
+
+    # serve until a peer sends the shutdown op (bounded waits: the loop
+    # re-checks twice a second so signals/KeyboardInterrupt land promptly)
+    try:
+        while not shutdown.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    service.close(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ from .errors import (  # noqa: F401
     VerificationError,
 )
 from . import faults  # noqa: F401
+from . import hostmesh  # noqa: F401
 from . import obs  # noqa: F401
 from . import sched  # noqa: F401
 from . import serve  # noqa: F401

@@ -434,6 +434,77 @@ REPORT_KEYS = (
 )
 
 
+def merge_reports(docs: list) -> dict:
+    """Union N per-process lockdep reports into one (the multi-host merge:
+    every spawned worker writes its own ``SPFFT_TPU_LOCKDEP_REPORT`` and
+    ``programs/analyze.py --lockdep-check`` cross-checks the fleet as one
+    graph). Locks are keyed by creation site (``created`` summed), edges by
+    (from, to) with ``count`` summed, blocking rows by (lock, held) with
+    ``count`` summed; cycles are recomputed over the merged edge graph.
+    Site-keyed edges from different processes compose meaningfully: a
+    cycle assembled from one host's ``a -> b`` and another's ``b -> a`` is
+    a real latent ABBA hazard — both orders exist in the code that ran,
+    and nothing stops one process's threads from interleaving them."""
+    locks: dict = {}
+    edges: dict = {}
+    blocking: dict = {}
+    installed = False
+    for doc in docs:
+        installed = installed or bool(doc.get("installed"))
+        for row in doc.get("locks", []):
+            info = locks.get(row["id"])
+            if info is None:
+                locks[row["id"]] = {
+                    k: v for k, v in row.items() if k != "id"
+                }
+            else:
+                info["created"] = info.get("created", 0) + row.get(
+                    "created", 0
+                )
+        for row in doc.get("edges", []):
+            key = (row["from"], row["to"])
+            info = edges.get(key)
+            if info is None:
+                edges[key] = {
+                    k: v for k, v in row.items() if k not in ("from", "to")
+                }
+            else:
+                info["count"] = info.get("count", 0) + row.get("count", 0)
+        for row in doc.get("blocking", []):
+            key = (row["lock"], tuple(row.get("held", ())))
+            info = blocking.get(key)
+            if info is None:
+                blocking[key] = {
+                    k: v for k, v in row.items() if k not in ("lock", "held")
+                }
+            else:
+                info["count"] = info.get("count", 0) + row.get("count", 0)
+    lock_rows = [{"id": i, **info} for i, info in sorted(locks.items())]
+    edge_rows = [
+        {"from": a, "to": b, **info} for (a, b), info in sorted(edges.items())
+    ]
+    blocking_rows = [
+        {"lock": lock_id, "held": list(held), **info}
+        for (lock_id, held), info in sorted(blocking.items())
+    ]
+    graph: dict = {}
+    for e in edge_rows:
+        graph.setdefault(e["from"], set()).add(e["to"])
+    return {
+        "schema": SCHEMA,
+        "installed": installed,
+        "locks": lock_rows,
+        "edges": edge_rows,
+        "blocking": blocking_rows,
+        "cycles": find_cycles(graph),
+        "counts": {
+            "locks": len(lock_rows),
+            "edges": len(edge_rows),
+            "blocking": len(blocking_rows),
+        },
+    }
+
+
 def validate_report(doc: dict) -> list:
     """Missing-key list for a lockdep report (schema floor; empty = valid),
     the same shape as the analysis report validator."""

@@ -306,10 +306,17 @@ class DistributedTransform:
         if self._verify_mode != "off":
             from .parallel.execution import mesh_process_span
 
-            if mesh_process_span(mesh) > 1:
+            span = mesh_process_span(mesh)
+            if span > 1:
                 raise InvalidParameterError(
-                    "verification requires a single-controller mesh: remote "
-                    "shards are not host-visible on multi-process meshes"
+                    f"verify={verify!r} requires a single-controller mesh, "
+                    f"but this {'x'.join(str(s) for s in mesh.devices.shape)} "
+                    f"mesh (axes {tuple(mesh.axis_names)}) spans {span} "
+                    "processes: the ABFT checks and the reference recovery "
+                    "rung need every shard's data host-side, and remote "
+                    "shards are None by the per-rank contract. Run "
+                    "verification on each host's local plans instead (see "
+                    'docs/details.md "Multi-host serving & host loss").'
                 )
             from .verify import Supervisor
 

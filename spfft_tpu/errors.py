@@ -47,6 +47,10 @@ class ErrorCode(enum.IntEnum):
     SERVICE_OVERLOAD = 24
     # ... and a request deadline expired (at admission or pre-dispatch).
     DEADLINE_EXCEEDED = 25
+    # Multi-host extension (spfft_tpu.serve.cluster): a worker host died or
+    # became unreachable (missed heartbeats, dead RPC transport) while work
+    # addressed to it was queued or in flight. Mirrored like the rest.
+    HOST_LOST = 26
 
 
 class GenericError(Exception):
@@ -226,3 +230,19 @@ class DeadlineExceededError(GenericError):
     before burning device time on an answer nobody is waiting for)."""
 
     error_code = ErrorCode.DEADLINE_EXCEEDED
+
+
+class HostLostError(MPIError):
+    """A worker host died or became unreachable mid-operation.
+
+    Raised by the multi-host serving layer (:mod:`spfft_tpu.serve.cluster`)
+    when a host misses its heartbeat budget or its RPC transport dies with
+    work queued or in flight. Subclasses :class:`MPIError` deliberately:
+    host death IS a communication-layer failure, so every retry ladder that
+    already treats ``MPIError`` as transient (the serving retries, the
+    scheduler's per-task ladder) handles it — the scheduler additionally
+    requeues the in-flight work onto surviving hosts before giving up
+    (the ``host_lost`` degradation rung, docs/details.md "Multi-host
+    serving & host loss")."""
+
+    error_code = ErrorCode.HOST_LOST

@@ -74,6 +74,8 @@ SITES = (
     "serve.dispatch",
     "sched.place",
     "sched.run",
+    "host.heartbeat",
+    "rpc.submit",
 )
 
 KINDS = ("raise", "nan", "corrupt", "delay")

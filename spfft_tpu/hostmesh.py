@@ -1,0 +1,334 @@
+"""Multi-host bootstrap: spawn, configure and join worker host processes.
+
+The boot half of the multi-host serving layer (ROADMAP item 2;
+docs/details.md "Multi-host serving & host loss"). Three concerns, each a
+place where multi-process runs classically fail *opaquely*, made typed and
+testable:
+
+1. **Joining a mesh** (:func:`boot`): wraps
+   :func:`spfft_tpu.parallel.mesh.init_distributed` — which now validates
+   the coordinator address and process coordinates up front
+   (:func:`~spfft_tpu.parallel.mesh.validate_distributed_args`) — plus the
+   virtual-device configuration, and returns the observed topology
+   (process count, global/local device counts) so a rank can assert what
+   it actually joined instead of discovering a half-formed mesh at first
+   collective.
+2. **Spawning workers** (:func:`spawn_workers`): launches N
+   ``programs/serve_worker.py`` processes with :func:`child_env` —
+   every ambient ``SPFFT_TPU_*`` knob propagated verbatim (lockdep arming
+   included: a worker spawned under ``SPFFT_TPU_LOCKDEP=1`` records its
+   own report), ``JAX_PLATFORMS``/``XLA_FLAGS`` set for the requested
+   per-host device count — and waits for each worker's ready file (a
+   worker that fails to boot surfaces its log tail in a typed error, never
+   a silent hang).
+3. **Warm-starting wisdom** (:func:`warm_start`): merges the fleet wisdom
+   bundle at ``SPFFT_TPU_HOSTS_WISDOM_BUNDLE`` into the host's own store
+   at boot (best-measured-wins, :meth:`WisdomStore.merge`), so a fresh
+   host serves pre-tuned from its first request.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+from . import knobs
+from .errors import HostExecutionError, InvalidParameterError
+
+WISDOM_BUNDLE_ENV = "SPFFT_TPU_HOSTS_WISDOM_BUNDLE"
+
+_WORKER_SCRIPT = (
+    Path(__file__).resolve().parent.parent / "programs" / "serve_worker.py"
+)
+
+_DEVICE_COUNT_FLAG = re.compile(
+    r"--xla_force_host_platform_device_count=\d+\s*"
+)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (the coordinator-allocation helper)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def child_env(overrides=None, *, devices: int | None = None) -> dict:
+    """Environment for a spawned worker process.
+
+    A minimal base (PATH/HOME/PYTHONPATH, ``JAX_PLATFORMS`` defaulting to
+    the parent's value or ``cpu``) plus **every ambient ``SPFFT_TPU_*``
+    knob propagated verbatim** — the whole registry surface, so a chaos
+    spec, a wisdom path, or lockdep arming configured on the parent governs
+    the children too. ``devices`` sets the child's virtual CPU device count
+    via ``XLA_FLAGS`` (the pre-backend-init spelling every jax version
+    honors); ``overrides`` merge last and win."""
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin:/usr/local/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    }
+    if "PYTHONPATH" in os.environ:
+        env["PYTHONPATH"] = os.environ["PYTHONPATH"]
+    for key, value in os.environ.items():
+        if key.startswith(knobs.PREFIX):
+            env[key] = value
+    # the one knob that must NOT propagate verbatim: a shared report path
+    # would have every worker and the parent clobber one file at exit (the
+    # merge would silently check only the last writer's graph). Workers get
+    # per-host paths via spawn_workers(lockdep_dir=) / explicit overrides.
+    env.pop("SPFFT_TPU_LOCKDEP_REPORT", None)
+    if devices is not None:
+        if int(devices) < 1:
+            raise InvalidParameterError(
+                f"devices must be >= 1, got {devices}"
+            )
+        flags = _DEVICE_COUNT_FLAG.sub(
+            "", os.environ.get("XLA_FLAGS", "")
+        ).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={int(devices)}"
+        ).strip()
+    if overrides:
+        env.update({str(k): str(v) for k, v in dict(overrides).items()})
+    return env
+
+
+def warm_start(bundle_path: str | None = None) -> tuple:
+    """Merge a fleet wisdom bundle into this host's active store at boot.
+
+    ``bundle_path`` defaults to ``SPFFT_TPU_HOSTS_WISDOM_BUNDLE``; unset or
+    empty is a no-op ``(0, 0)``. Returns ``(added, replaced)`` from
+    :meth:`~spfft_tpu.tuning.wisdom.WisdomStore.merge` (best-measured-wins,
+    version-checked, corrupt bundles quarantined typed) — a fresh host
+    points its store at shared fleet wisdom and serves pre-tuned with zero
+    trials."""
+    path = (
+        bundle_path if bundle_path is not None
+        else knobs.get_str(WISDOM_BUNDLE_ENV)
+    )
+    if not path:
+        return (0, 0)
+    from .tuning.wisdom import active_store
+
+    return active_store().merge(path)
+
+
+def boot(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    devices: int | None = None,
+    **kwargs,
+) -> dict:
+    """Join a multi-controller run and report the observed topology.
+
+    Validates the coordinates typed up front (a malformed value raises
+    :class:`~spfft_tpu.errors.InvalidParameterError` here, not a gRPC
+    timeout inside a child), optionally configures ``devices`` virtual CPU
+    devices (before backend init), calls
+    ``jax.distributed.initialize``, and returns ``{"process_count",
+    "process_index", "global_devices", "local_devices"}`` so the caller
+    asserts the mesh it actually joined."""
+    from .parallel import mesh as _mesh
+
+    if devices is not None:
+        _mesh.configure_virtual_devices(int(devices), warn=True)
+    _mesh.init_distributed(
+        coordinator_address, num_processes, process_id, **kwargs
+    )
+    import jax
+
+    return {
+        "process_count": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+
+
+class WorkerHost:
+    """One spawned worker process: its handle, address, and ready record."""
+
+    def __init__(self, host_id: int, proc, ready: dict, log_path: str):
+        self.host_id = int(host_id)
+        self.proc = proc
+        self.ready = dict(ready)
+        self.log_path = str(log_path)
+        self.address = f"127.0.0.1:{int(ready['port'])}"
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — the chaos primitive: no cleanup, no exit hooks, the
+        exact shape of an OOM-killed or power-failed host."""
+        if self.alive():
+            self.proc.send_signal(signal.SIGKILL)
+
+    def join(self, timeout_s: float = 10.0) -> int | None:
+        try:
+            return self.proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def log_tail(self, limit: int = 2000) -> str:
+        try:
+            return Path(self.log_path).read_text()[-limit:]
+        except OSError:
+            return "<no log>"
+
+    def describe(self) -> dict:
+        return {
+            "host_id": self.host_id,
+            "pid": self.pid,
+            "address": self.address,
+            "alive": self.alive(),
+            "ready": self.ready,
+        }
+
+
+def stop_workers(workers, timeout_s: float = 10.0) -> None:
+    """Clean-stop a worker fleet: ask each RPC server to shut down (so exit
+    hooks — the lockdep report dump — run), then escalate to SIGKILL on the
+    stragglers."""
+    from .errors import GenericError
+    from .serve.rpc import RpcClient
+
+    for w in workers:
+        if not w.alive():
+            continue
+        client = RpcClient(w.address, timeout_s=2.0)
+        try:
+            client.call({"op": "shutdown"})
+        except GenericError:
+            pass  # already dead / wedged: the kill below owns it
+        finally:
+            client.close()
+    deadline = time.monotonic() + float(timeout_s)
+    for w in workers:
+        remaining = max(0.1, deadline - time.monotonic())
+        if w.join(remaining) is None:
+            w.kill()
+            w.join(2.0)
+
+
+def spawn_workers(
+    n: int,
+    *,
+    devices_per_host: int = 1,
+    mesh: bool = False,
+    wisdom_bundle: str | None = None,
+    lockdep_dir: str | None = None,
+    env=None,
+    workdir: str | None = None,
+    ready_timeout_s: float = 120.0,
+    python: str | None = None,
+) -> list:
+    """Spawn ``n`` RPC serving workers; returns their :class:`WorkerHost`\\ s.
+
+    Each worker runs ``programs/serve_worker.py`` under :func:`child_env`
+    (every ambient ``SPFFT_TPU_*`` knob propagated, ``devices_per_host``
+    virtual CPU devices). ``mesh=True`` additionally joins the workers into
+    ONE ``jax.distributed`` multi-controller run (a coordinator port is
+    allocated here; worker 0 hosts the coordination service) — the
+    N-process × M-device mesh the CI boot proof stands up. ``wisdom_bundle``
+    warm-starts every worker's store; ``lockdep_dir`` arms
+    ``SPFFT_TPU_LOCKDEP=1`` in every worker with a per-host report path
+    ``<dir>/host<i>.json`` (written on clean shutdown —
+    :func:`stop_workers`).
+
+    Boot failures are typed: a worker that dies or fails to write its ready
+    file within ``ready_timeout_s`` kills the whole fleet and raises
+    :class:`~spfft_tpu.errors.HostExecutionError` carrying its log tail."""
+    n = int(n)
+    if n < 1:
+        raise InvalidParameterError(f"spawn_workers needs n >= 1, got {n}")
+    if not _WORKER_SCRIPT.exists():
+        raise InvalidParameterError(
+            f"worker entry point missing: {_WORKER_SCRIPT}"
+        )
+    workdir = workdir or tempfile.mkdtemp(prefix="spfft-hostmesh-")
+    Path(workdir).mkdir(parents=True, exist_ok=True)
+    coordinator = f"127.0.0.1:{free_port()}" if mesh else None
+    procs = []
+    for i in range(n):
+        ready_path = Path(workdir) / f"worker{i}.ready.json"
+        log_path = Path(workdir) / f"worker{i}.log"
+        cmd = [
+            python or sys.executable,
+            str(_WORKER_SCRIPT),
+            "--host-id", str(i),
+            "--port", "0",
+            "--ready-file", str(ready_path),
+        ]
+        if coordinator is not None:
+            cmd += [
+                "--coordinator", coordinator,
+                "--num-processes", str(n),
+                "--process-id", str(i),
+            ]
+        overrides = dict(env or {})
+        if wisdom_bundle:
+            overrides[WISDOM_BUNDLE_ENV] = str(wisdom_bundle)
+        if lockdep_dir:
+            overrides["SPFFT_TPU_LOCKDEP"] = "1"
+            overrides["SPFFT_TPU_LOCKDEP_REPORT"] = str(
+                Path(lockdep_dir) / f"host{i}.json"
+            )
+        cenv = child_env(overrides, devices=devices_per_host)
+        with open(log_path, "wb") as log:
+            proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=cenv,
+                cwd=str(_WORKER_SCRIPT.parent.parent),
+            )
+        procs.append((i, proc, ready_path, log_path))
+
+    workers = []
+    deadline = time.monotonic() + float(ready_timeout_s)
+    try:
+        for i, proc, ready_path, log_path in procs:
+            ready = None
+            while time.monotonic() < deadline:
+                if ready_path.exists():
+                    try:
+                        ready = json.loads(ready_path.read_text())
+                        break
+                    except (OSError, json.JSONDecodeError):
+                        pass  # mid-write: the atomic rename makes this rare
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            if ready is None:
+                tail = "<no log>"
+                try:
+                    tail = Path(log_path).read_text()[-2000:]
+                except OSError:
+                    pass
+                raise HostExecutionError(
+                    f"worker {i} failed to become ready within "
+                    f"{ready_timeout_s}s (exit code {proc.poll()}); log "
+                    f"tail:\n{tail}"
+                )
+            workers.append(WorkerHost(i, proc, ready, str(log_path)))
+    except Exception:
+        for _, proc, _, _ in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        raise
+    return workers

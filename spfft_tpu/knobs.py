@@ -593,6 +593,44 @@ register(
     "(the cross-geometry overlap window)",
 )
 register(
+    "SPFFT_TPU_HOSTS_HEARTBEAT_S", "float", 0.25, floor=0.01,
+    doc="heartbeat interval of the multi-host liveness monitor "
+    "(`spfft_tpu.serve.cluster`): each sweep pings every live worker host, "
+    "with the inter-sweep sleep jittered ×[0.5, 1.5) so fleet heartbeats "
+    "never synchronize (see \"Multi-host serving & host loss\")",
+)
+register(
+    "SPFFT_TPU_HOSTS_HEARTBEAT_MISSES", "int", 3, floor=1,
+    doc="consecutive missed/failed heartbeat probes after which a worker "
+    "host is declared lost (`hosts_lost_total`, the `host_lost` rung); a "
+    "dead RPC transport on a live submission declares it immediately",
+)
+register(
+    "SPFFT_TPU_HOSTS_RETRIES", "int", 2, floor=0,
+    doc="times one in-flight task may be requeued onto a surviving host "
+    "after its host was lost, before resolving typed `HostLostError` "
+    "(the scheduler's `host_lost` outcome; `host_requeues_total` counts "
+    "the moves)",
+)
+register(
+    "SPFFT_TPU_HOSTS_BACKOFF_S", "float", 0.02, floor=0.0,
+    doc="base of the jittered exponential backoff between host-loss "
+    "requeue attempts (the same thundering-herd rule as every other retry "
+    "loop)",
+)
+register(
+    "SPFFT_TPU_HOSTS_WISDOM_BUNDLE", "str", None,
+    "fleet wisdom bundle a worker host merges into its own store at boot "
+    "(`spfft_tpu.hostmesh.warm_start`) so a fresh host serves pre-tuned; "
+    "unset = cold store",
+)
+register(
+    "SPFFT_TPU_RPC_TIMEOUT_S", "float", 30.0, floor=0.1,
+    doc="per-call wall deadline of the length-prefixed-JSON RPC transport "
+    "(`spfft_tpu.serve.rpc`): a connect/send/receive exceeding it raises "
+    "typed `HostLostError` naming the host, feeding the requeue ladder",
+)
+register(
     "SPFFT_TPU_SCHED_INFLIGHT", "int", 8, floor=1,
     doc="task-graph executor window: how many transform executions stay "
     "dispatched/device-resident at once before finalize must drain one "

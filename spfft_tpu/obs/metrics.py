@@ -103,6 +103,17 @@ METRICS = (
      "requests coalesced per executed batch"),
     ("serve_latency_seconds", "histogram", ("tenant",),
      "admission-to-resolution latency per request"),
+    # ---- multi-host serving -------------------------------------------------
+    ("hosts_lost_total", "counter", ("host",),
+     "worker hosts declared lost (missed heartbeat budget or dead RPC "
+     "transport)"),
+    ("host_heartbeats_total", "counter", ("verdict",),
+     "liveness probes sent to worker hosts, per ok/missed verdict"),
+    ("host_requeues_total", "counter", (),
+     "in-flight tasks requeued onto a surviving host after host loss"),
+    ("rpc_requests_total", "counter", ("op", "outcome"),
+     "length-prefixed-JSON RPC requests served by a worker host, per op "
+     "and ok/error outcome"),
     # ---- scheduler ----------------------------------------------------------
     ("sched_tasks_total", "counter", ("outcome",),
      "task-graph tasks resolved, per outcome"),

@@ -82,6 +82,11 @@ EVENTS = (
     #                    reject / shed / coalesce / dispatch / complete
     "sched",           # task-graph scheduler transition (spfft_tpu.sched):
     #                    graph / place / dispatch / finalize / demote / fail
+    #                    / rehost (host-loss requeue)
+    "host",            # multi-host liveness transition (serve.cluster):
+    #                    heartbeat verdicts, a worker host declared lost
+    "rpc",             # cross-host RPC transition (serve.rpc): request
+    #                    served / failed, transport death
 
     "perf",            # performance report built (spfft_tpu.obs.perf):
     #                    measured GFLOP/s + exchange_fraction, run-ID-joined

@@ -90,12 +90,17 @@ def _check_multihost_mesh(mesh) -> None:
     """Fail fast at plan creation: multi-process padding requires a dedicated
     1-D fft mesh (multi-axis meshes are single-controller only) — catching it
     here avoids compiling pipelines that die at first data staging."""
-    if mesh_process_span(mesh) > 1 and mesh.devices.ndim != 1:
+    span = mesh_process_span(mesh)
+    if span > 1 and mesh.devices.ndim != 1:
         from ..errors import InvalidParameterError
 
         raise InvalidParameterError(
-            "multi-process runs require a dedicated 1-D fft mesh "
-            "(multi-axis meshes are supported in single-controller mode)"
+            f"multi-process runs require a dedicated 1-D fft mesh, but this "
+            f"{'x'.join(str(s) for s in mesh.devices.shape)} mesh (axes "
+            f"{tuple(mesh.axis_names)}) spans {span} processes: per-process "
+            "block assembly (pad_values/unpad_space) is only defined along "
+            "one slab axis. Multi-axis pencil meshes run single-controller; "
+            'see docs/details.md "Multi-host serving & host loss".'
         )
 
 
@@ -186,8 +191,12 @@ class PaddingHelpers:
             from ..errors import InvalidParameterError
 
             raise InvalidParameterError(
-                "multi-process padding requires a dedicated 1-D fft mesh "
-                "(multi-axis meshes are supported in single-controller mode)"
+                f"multi-process padding requires a dedicated 1-D fft mesh; "
+                f"this one is "
+                f"{'x'.join(str(s) for s in self.mesh.devices.shape)} (axes "
+                f"{tuple(self.mesh.axis_names)}) — multi-axis meshes are "
+                "supported in single-controller mode only (see "
+                'docs/details.md "Multi-host serving & host loss")'
             )
         me = jax.process_index()
         return [

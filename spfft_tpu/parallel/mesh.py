@@ -198,6 +198,79 @@ def make_fft_mesh2(p1: int, p2: int, devices=None) -> Mesh:
     return Mesh(devices.reshape(p1, p2), (FFT_AXIS, FFT_AXIS2))
 
 
+def validate_distributed_args(
+    coordinator_address, num_processes, process_id
+) -> None:
+    """Typed up-front validation of the ``init_distributed`` arguments.
+
+    ``jax.distributed.initialize`` fails opaquely *inside the child process*
+    on malformed values (a bad coordinator string surfaces as a gRPC
+    connect timeout minutes later; a process_id out of range wedges the
+    whole barrier), so the bootstrap validates here, before anything is
+    spawned or joined: a malformed value raises
+    :class:`~spfft_tpu.errors.InvalidParameterError` naming it. All three
+    may be None together (TPU pods infer them from the environment); given
+    explicitly, the coordinator must be ``host:port`` with a port in
+    [1, 65535], ``num_processes >= 1`` and ``0 <= process_id <
+    num_processes``."""
+    from ..errors import InvalidParameterError
+
+    if coordinator_address is not None:
+        addr = str(coordinator_address)
+        host, sep, port_s = addr.rpartition(":")
+        if not sep or not host:
+            raise InvalidParameterError(
+                f"malformed coordinator_address {addr!r}: expected "
+                "'host:port' (e.g. 'localhost:8476')"
+            )
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise InvalidParameterError(
+                f"malformed coordinator_address {addr!r}: port {port_s!r} "
+                "is not an integer"
+            ) from None
+        if not 1 <= port <= 65535:
+            raise InvalidParameterError(
+                f"coordinator_address {addr!r}: port {port} out of range "
+                "[1, 65535]"
+            )
+    if num_processes is not None:
+        try:
+            n = int(num_processes)
+        except (TypeError, ValueError):
+            raise InvalidParameterError(
+                f"invalid num_processes {num_processes!r}: expected an "
+                "integer >= 1"
+            ) from None
+        if n < 1:
+            raise InvalidParameterError(
+                f"invalid num_processes {num_processes}: expected >= 1"
+            )
+    if process_id is not None:
+        try:
+            pid = int(process_id)
+        except (TypeError, ValueError):
+            raise InvalidParameterError(
+                f"invalid process_id {process_id!r}: expected an integer"
+            ) from None
+        if pid < 0:
+            raise InvalidParameterError(
+                f"invalid process_id {pid}: expected >= 0"
+            )
+        if num_processes is not None and pid >= int(num_processes):
+            raise InvalidParameterError(
+                f"process_id {pid} out of range for num_processes "
+                f"{int(num_processes)} (expected 0 <= process_id < "
+                "num_processes)"
+            )
+        if num_processes is None:
+            raise InvalidParameterError(
+                "process_id given without num_processes: a rank cannot "
+                "join a run of unknown size"
+            )
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -210,8 +283,12 @@ def init_distributed(
     reference's ``MPI_Init`` requirement for its multi-node transforms
     (reference: src/mpi_util/mpi_init_handle.hpp:43-48). On TPU pods the
     arguments are inferred from the environment; on CPU/GPU clusters pass the
-    coordinator address and process coordinates explicitly.
+    coordinator address and process coordinates explicitly. Malformed values
+    raise typed :class:`~spfft_tpu.errors.InvalidParameterError` here, up
+    front (:func:`validate_distributed_args`), instead of letting
+    ``jax.distributed.initialize`` fail opaquely inside a child process.
     """
+    validate_distributed_args(coordinator_address, num_processes, process_id)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -40,6 +40,7 @@ from ..errors import (
     GenericError,
     GPUFFTError,
     HostExecutionError,
+    HostLostError,
     InvalidParameterError,
     MPIError,
 )
@@ -59,7 +60,17 @@ _POLL_S = 0.0002
 _POLL_PATIENCE_S = 0.05
 
 # Task outcomes (the ``outcome`` label of ``sched_tasks_total``).
-OUTCOMES = ("completed", "demoted", "failed", "upstream_failed")
+# ``host_lost`` is the multi-host rung: the task's worker host died, the
+# requeue ladder found no surviving host (or exhausted its move budget),
+# and the task resolved typed HostLostError — dependents cascade
+# ``upstream_failed`` exactly as for ``failed``.
+OUTCOMES = ("completed", "demoted", "failed", "upstream_failed", "host_lost")
+
+# Outcomes that fail a task's dependents (the upstream_failed cascade).
+_FAILED_OUTCOMES = ("failed", "upstream_failed", "host_lost")
+
+HOST_RETRIES_ENV = "SPFFT_TPU_HOSTS_RETRIES"
+HOST_BACKOFF_ENV = "SPFFT_TPU_HOSTS_BACKOFF_S"
 
 # Typed execution failures the per-task ladder may retry/demote: the same
 # classes the serving layer retries (dispatch/fence conversions + the
@@ -130,10 +141,16 @@ class _Run:
     """One graph execution (state shared by the dispatch/finalize loop)."""
 
     def __init__(self, graph, *, retries, demote, on_error, poll_patience_s,
-                 backoff_s=0.0, backoff_rng=None):
+                 backoff_s=0.0, backoff_rng=None, host_retries=None,
+                 host_backoff_s=None):
         self.graph = graph
         self.retries = max(0, int(retries))
         self.demote = bool(demote)
+        # host-loss requeue budget (the multi-host rung): how many times one
+        # task may move to a surviving host via its plan's rehost() hook
+        # before resolving typed with the host_lost outcome
+        self.host_retries = knobs.get_int(HOST_RETRIES_ENV, host_retries)
+        self.host_backoff_s = knobs.get_float(HOST_BACKOFF_ENV, host_backoff_s)
         if on_error not in ("resolve", "raise"):
             raise InvalidParameterError(
                 f"on_error must be 'resolve' or 'raise', got {on_error!r}"
@@ -286,6 +303,13 @@ class _Run:
             try:
                 self._dispatch(task)
                 return True
+            except HostLostError as e:
+                # the multi-host rung, BEFORE the generic ladder (HostLost
+                # subclasses MPIError): requeue onto a surviving host
+                # instead of retrying the dead one
+                if not self._rehost(task, e):
+                    return False
+                continue
             except LADDER_ERRORS as e:
                 if task.attempts <= self.retries:
                     self._retry_pause(task)
@@ -307,6 +331,15 @@ class _Run:
         while True:
             try:
                 self._finalize(task)
+            except HostLostError as e:
+                # host died with the task in flight: the work was never
+                # acked, so requeueing it onto a survivor is idempotent
+                task.pending = None
+                if not self._rehost(task, e):
+                    return
+                if self._attempt(task):
+                    continue  # re-dispatched on the new host
+                return  # ladder already resolved the task
             except LADDER_ERRORS as e:
                 task.pending = None
                 if task.attempts <= self.retries:
@@ -322,6 +355,49 @@ class _Run:
                 return
             self._resolve(task, "completed")
             return
+
+    def _rehost(self, task, error) -> bool:
+        """The host-loss requeue rung: move the task to a surviving host
+        via its plan's ``rehost()`` hook, bounded by ``host_retries`` moves
+        with jittered backoff; False when the task was resolved instead
+        (no hook — a local plan cannot move — budget exhausted, or no
+        surviving host)."""
+        rehost = getattr(task.plan, "rehost", None)
+        if rehost is None or task.host_moves >= self.host_retries:
+            self._host_lost(task, error)
+            return False
+        task.host_moves += 1
+        obs.counter("host_requeues_total").inc()
+        obs.trace.event(
+            "sched", what="rehost", task=task.id, move=task.host_moves,
+        )
+        if self.host_backoff_s > 0.0:
+            time.sleep(
+                faults.backoff_s(
+                    self.host_backoff_s, task.host_moves, self.backoff_rng
+                )
+            )
+        try:
+            rehost(error)
+        except GenericError as e:
+            self._host_lost(task, e)
+            return False
+        return True
+
+    def _host_lost(self, task, error) -> None:
+        """Resolve a task whose host died beyond recovery: typed error,
+        distinct ``host_lost`` outcome (dependents cascade
+        ``upstream_failed``), the rung recorded."""
+        faults.record_degradation(
+            "host_lost", faults.summarize(error), task=task.id
+        )
+        task.error = error
+        obs.trace.event(
+            "sched", what="fail", task=task.id, error=type(error).__name__,
+        )
+        self._resolve(task, "host_lost")
+        if self.on_error == "raise":
+            raise error
 
     def _demote_or_fail(self, task, error) -> None:
         if self.demote:
@@ -357,7 +433,7 @@ class _Run:
         """Resolve a task whose dependency failed: typed, never stalled."""
         causes = [
             d for d in task.deps
-            if self.graph.task(d).outcome in ("failed", "upstream_failed")
+            if self.graph.task(d).outcome in _FAILED_OUTCOMES
         ]
         cause = self.graph.task(causes[0]).error if causes else None
         err = HostExecutionError(
@@ -393,8 +469,7 @@ class _Run:
                     break
                 waiting.remove(task)
                 if any(
-                    self.graph.task(d).outcome
-                    in ("failed", "upstream_failed")
+                    self.graph.task(d).outcome in _FAILED_OUTCOMES
                     for d in task.deps
                 ):
                     self._cascade(task)
@@ -450,6 +525,8 @@ def run_graph(
     on_error: str = "resolve",
     backoff_s: float = 0.0,
     backoff_rng=None,
+    host_retries: int | None = None,
+    host_backoff_s: float | None = None,
     _poll_patience_s: float = _POLL_PATIENCE_S,
 ) -> GraphReport:
     """Execute a :class:`TaskGraph`; returns a :class:`GraphReport`.
@@ -461,6 +538,12 @@ def run_graph(
     / ``demote`` configure the per-task failure ladder; ``on_error="raise"``
     aborts on the first task failure instead of resolving it (the serving
     layer's batch semantics — its own retry loop owns recovery there).
+    ``host_retries`` / ``host_backoff_s`` bound the host-loss requeue rung:
+    a task whose plan carries a ``rehost()`` hook (remote plans,
+    :mod:`spfft_tpu.serve.cluster`) moves to a surviving host on typed
+    :class:`~spfft_tpu.errors.HostLostError` before resolving with the
+    ``host_lost`` outcome (defaults: ``SPFFT_TPU_HOSTS_RETRIES`` /
+    ``SPFFT_TPU_HOSTS_BACKOFF_S``).
     """
     from ..parallel.policy import resolve_policy
 
@@ -511,7 +594,8 @@ def run_graph(
     run = _Run(
         graph, retries=retries, demote=demote, on_error=on_error,
         poll_patience_s=_poll_patience_s, backoff_s=backoff_s,
-        backoff_rng=backoff_rng,
+        backoff_rng=backoff_rng, host_retries=host_retries,
+        host_backoff_s=host_backoff_s,
     )
     run.execute(order, resolve_inflight(max_inflight))
     return GraphReport(graph, placement, time.monotonic() - t0, depth=depth)

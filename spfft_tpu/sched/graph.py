@@ -40,7 +40,7 @@ class Task:
         "transform", "spec", "deadline", "batch",
         # execution state (owned by sched.executor)
         "plan", "pending", "result", "error", "outcome", "attempts",
-        "dispatched_at", "finished_at",
+        "host_moves", "dispatched_at", "finished_at",
     )
 
     def __init__(
@@ -102,6 +102,7 @@ class Task:
         self.error = None
         self.outcome = None  # one of executor.OUTCOMES once resolved
         self.attempts = 0
+        self.host_moves = 0  # host-loss requeues taken (executor ladder)
         self.dispatched_at = None
         self.finished_at = None
 

@@ -55,6 +55,13 @@ from .errors import (  # noqa: F401
 )
 from .queue import AdmissionQueue, Request, Ticket  # noqa: F401
 from .batcher import PlanCache, canonical_triplets, wrap_triplets  # noqa: F401
+from .rpc import RpcClient, RpcServer  # noqa: F401
+from .cluster import (  # noqa: F401
+    ClusterFront,
+    HeartbeatMonitor,
+    HostHandle,
+    RemotePlan,
+)
 from .service import (  # noqa: F401
     DEFAULT_BACKOFF_S,
     DEFAULT_BATCH_MAX,

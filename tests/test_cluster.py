@@ -1,0 +1,650 @@
+"""Multi-host serving: RPC transport, heartbeat/host-lost ladder, chaos kill.
+
+The robustness suite of the cross-host serving layer
+(`spfft_tpu.serve.rpc` / `spfft_tpu.serve.cluster` + the scheduler's
+``host_lost`` rung): wire-protocol round trips with typed error
+marshalling, the executor's host-loss requeue ladder on fake plans, the
+cluster front against stub RPC workers with the ``rpc.submit`` /
+``host.heartbeat`` fault sites armed, and the real thing — a SIGKILLed
+worker process mid-burst, every ticket resolving typed, survivors serving.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import TransformType, faults, hostmesh, obs, sched, verify
+from spfft_tpu.errors import (
+    DeadlineExceededError,
+    GenericError,
+    HostExecutionError,
+    HostLostError,
+    InvalidParameterError,
+    ServiceOverloadError,
+)
+from spfft_tpu.serve import cluster, rpc
+from spfft_tpu.serve.cluster import ClusterFront
+from spfft_tpu.serve.rpc import RpcClient, RpcServer
+
+CLUSTER_ENV_KNOBS = (
+    "SPFFT_TPU_HOSTS_HEARTBEAT_S",
+    "SPFFT_TPU_HOSTS_HEARTBEAT_MISSES",
+    "SPFFT_TPU_HOSTS_RETRIES",
+    "SPFFT_TPU_HOSTS_BACKOFF_S",
+    "SPFFT_TPU_RPC_TIMEOUT_S",
+    "SPFFT_TPU_SERVE_QUEUE_CAP",
+    "SPFFT_TPU_SERVE_BATCH_MAX",
+    "SPFFT_TPU_SERVE_RETRIES",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_cluster(monkeypatch):
+    faults.disarm()
+    faults.reseed(0)
+    verify.breaker.reset()
+    obs.enable()
+    obs.clear()
+    for knob in CLUSTER_ENV_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+    yield
+    faults.disarm()
+    verify.breaker.reset()
+
+
+def _counter(name_prefix: str) -> int:
+    return sum(
+        v for k, v in obs.snapshot().get("counters", {}).items()
+        if k.startswith(name_prefix)
+    )
+
+
+# ---- wire protocol ----------------------------------------------------------
+
+
+def test_wire_array_roundtrip():
+    for a in (
+        np.arange(12, dtype=np.int32).reshape(4, 3),
+        np.linspace(0, 1, 7, dtype=np.float32),
+        (np.arange(6) + 1j * np.arange(6)).astype(np.complex128),
+    ):
+        out = rpc.decode_value(rpc.encode_value({"x": [a, {"y": a}]}))
+        np.testing.assert_array_equal(out["x"][0], a)
+        np.testing.assert_array_equal(out["x"][1]["y"], a)
+        assert out["x"][0].dtype == a.dtype
+
+
+def test_wire_error_payload_roundtrips_taxonomy():
+    for exc in (
+        ServiceOverloadError("queue full"),
+        DeadlineExceededError("too late"),
+        HostLostError("host died"),
+        InvalidParameterError("bad dims"),
+    ):
+        payload = rpc.error_payload(exc)["error"]
+        with pytest.raises(type(exc), match=str(exc)):
+            rpc.raise_error_payload(payload)
+
+
+def test_rpc_client_malformed_address_typed():
+    with pytest.raises(InvalidParameterError):
+        RpcClient("nonsense")
+    with pytest.raises(InvalidParameterError):
+        RpcClient("host:notaport")
+
+
+def test_rpc_client_unreachable_is_host_lost():
+    client = RpcClient("127.0.0.1:9", timeout_s=0.5)  # discard port: refused
+    with pytest.raises(HostLostError, match="unreachable"):
+        client.call({"op": "ping"})
+    client.close()
+
+
+# ---- stub worker (a real RpcServer around a fake service) -------------------
+
+
+class _StubTicket:
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        if isinstance(self._value, BaseException):
+            raise self._value
+        return self._value
+
+
+class _StubQueue:
+    def depth(self):
+        return 0
+
+
+class _StubService:
+    """Echo service: backward doubles the payload (no jax, no plans)."""
+
+    def __init__(self, fail_with=None, fail_submits=()):
+        self.queue = _StubQueue()
+        self.fail_with = fail_with
+        self.fail_submits = set(fail_submits)  # 0-based submit ordinals
+        self.submitted = 0
+
+    def submit(self, transform_type, dims, indices, payload, *,
+               direction="backward", tenant="default", timeout_s=None,
+               scaling=None):
+        ordinal = self.submitted
+        self.submitted += 1
+        if self.fail_with is not None:
+            raise self.fail_with
+        if ordinal in self.fail_submits:
+            raise ServiceOverloadError(f"stub refused submit {ordinal}")
+        return _StubTicket(np.asarray(payload) * 2)
+
+    def stats(self):
+        return {"queue_capacity": 0}
+
+    def describe(self):
+        return {"stub": True}
+
+
+@pytest.fixture()
+def stub_worker():
+    service = _StubService()
+    server = RpcServer(service, port=0, timeout_s=10.0)
+    yield service, server
+    server.close()
+
+
+def test_rpc_server_unknown_op_typed(stub_worker):
+    _, server = stub_worker
+    client = RpcClient(server.address, timeout_s=5.0)
+    try:
+        with pytest.raises(InvalidParameterError, match="unknown RPC op"):
+            client.call({"op": "bogus"})
+    finally:
+        client.close()
+
+
+def test_rpc_server_submit_and_batch(stub_worker):
+    _, server = stub_worker
+    client = RpcClient(server.address, timeout_s=5.0)
+    vals = np.arange(5, dtype=np.float64)
+    msg = {
+        "op": "submit", "transform_type": 0, "dims": [4, 4, 4],
+        "indices": np.zeros((5, 3), np.int32), "payload": vals,
+    }
+    try:
+        np.testing.assert_array_equal(client.call(msg)["result"], vals * 2)
+        out = client.call(
+            {**msg, "op": "submit_batch", "payloads": [vals, vals + 1]}
+        )
+        np.testing.assert_array_equal(out["results"][0]["result"], vals * 2)
+        np.testing.assert_array_equal(
+            out["results"][1]["result"], (vals + 1) * 2
+        )
+    finally:
+        client.close()
+
+
+def test_rpc_idle_pooled_connection_stays_usable():
+    """The server must NOT drop idle connections on its recv timeout: the
+    client pool holds sockets across bursts, and an idle-dropped socket's
+    next use would read as host death — ejecting a healthy host."""
+    service = _StubService()
+    server = RpcServer(service, port=0, timeout_s=0.3)
+    client = RpcClient(server.address, timeout_s=5.0)
+    try:
+        assert client.call({"op": "ping"})["ok"] == 1
+        time.sleep(1.0)  # > 3 server-side recv timeouts of idleness
+        # the SAME pooled socket must still answer
+        assert client.call({"op": "ping"})["ok"] == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_rpc_oversized_reply_is_typed_not_host_loss(stub_worker, monkeypatch):
+    """A reply breaching the frame cap answers with the typed error instead
+    of dying: a silent drop reads as host loss and would requeue the same
+    doomed batch onto every host in turn."""
+
+    class _BigStub(_StubService):
+        def submit(self, *a, **kw):
+            self.submitted += 1
+            return _StubTicket(np.zeros(100_000))
+
+    service = _BigStub()
+    server = RpcServer(service, port=0, timeout_s=5.0)
+    # cap between the small request frame and the ~1.3 MB reply frame
+    monkeypatch.setattr(rpc, "MAX_FRAME_BYTES", 50_000)
+    client = RpcClient(server.address, timeout_s=5.0)
+    try:
+        with pytest.raises(InvalidParameterError, match="frame"):
+            client.call({
+                "op": "submit", "transform_type": 0, "dims": [4, 4, 4],
+                "indices": np.zeros((1, 3), np.int32), "payload": np.zeros(1),
+            })
+        # typed, not host loss: the connection (and the host) stay usable
+        assert client.call({"op": "ping"})["ok"] == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_rpc_server_application_error_crosses_typed(stub_worker):
+    service, server = stub_worker
+    service.fail_with = ServiceOverloadError("stub is full")
+    client = RpcClient(server.address, timeout_s=5.0)
+    try:
+        with pytest.raises(ServiceOverloadError, match="stub is full"):
+            client.call({
+                "op": "submit", "transform_type": 0, "dims": [4, 4, 4],
+                "indices": np.zeros((1, 3), np.int32),
+                "payload": np.zeros(1),
+            })
+        # an application error is NOT host loss: the transport stays usable
+        assert client.call({"op": "ping"})["ok"] == 1
+    finally:
+        client.close()
+
+
+# ---- executor host_lost ladder (fake plans, no RPC) -------------------------
+
+
+class _FakePending:
+    def is_ready(self):
+        return True
+
+
+class _LostPlan:
+    """Dispatch raises HostLostError while ``lost``; ``rehost`` heals it."""
+
+    _verifier = None
+    _guard = False
+    device = None
+
+    def __init__(self, lost=True, can_rehost=True, lose_finalize=0):
+        self.lost = lost
+        self.can_rehost = can_rehost
+        self.lose_finalize = lose_finalize
+        self.rehosts = 0
+
+    def rehost(self, error):
+        if not self.can_rehost:
+            raise HostLostError("no live worker hosts remain")
+        self.rehosts += 1
+        self.lost = False
+
+    def _dispatch_backward(self, payload):
+        if self.lost:
+            raise HostLostError("host died at dispatch")
+        return _FakePending()
+
+    def _finalize_backward(self, pending):
+        if self.lose_finalize > 0:
+            self.lose_finalize -= 1
+            self.lost = True
+            raise HostLostError("host died in flight")
+        return "ok"
+
+
+class _NoHookPlan:
+    _verifier = None
+    _guard = False
+    device = None
+
+    def _dispatch_backward(self, payload):
+        raise HostLostError("host died; this plan cannot move")
+
+
+def test_executor_rehosts_and_completes():
+    plan = _LostPlan(lost=True)
+    graph = sched.TaskGraph()
+    tid = graph.add("backward", payload=[1.0], transform=plan)
+    report = sched.run_graph(graph, retries=0, demote=False, host_retries=2)
+    assert report.outcomes[tid] == "completed"
+    assert report.results[tid] == "ok"
+    assert plan.rehosts == 1
+    assert _counter("host_requeues_total") == 1
+
+
+def test_executor_finalize_host_loss_rehosts():
+    """A host dying mid-flight (dispatch acked, result never arrives):
+    finalize raises HostLostError, the task re-dispatches on the new
+    host."""
+    plan = _LostPlan(lost=False, lose_finalize=1)
+    graph = sched.TaskGraph()
+    tid = graph.add("backward", payload=[1.0], transform=plan)
+    report = sched.run_graph(graph, retries=0, demote=False, host_retries=2)
+    assert report.outcomes[tid] == "completed"
+    assert plan.rehosts == 1
+
+
+def test_executor_no_hook_resolves_host_lost_and_cascades():
+    """A plan without a rehost hook resolves typed with the host_lost
+    outcome, and dependents cascade upstream_failed — the typed cascade
+    extended to host death."""
+    graph = sched.TaskGraph()
+    t1 = graph.add("backward", payload=[1.0], transform=_NoHookPlan())
+    t2 = graph.add(
+        "backward", payload=[2.0], transform=_LostPlan(lost=False),
+        after=[t1],
+    )
+    report = sched.run_graph(graph, retries=0, demote=False, host_retries=2)
+    assert report.outcomes[t1] == "host_lost"
+    assert isinstance(report.errors[t1], HostLostError)
+    assert report.outcomes[t2] == "upstream_failed"
+    assert isinstance(report.errors[t2], HostExecutionError)
+    with pytest.raises(HostLostError):
+        report.result(t1)
+
+
+def test_executor_no_survivors_resolves_host_lost():
+    plan = _LostPlan(lost=True, can_rehost=False)
+    graph = sched.TaskGraph()
+    tid = graph.add("backward", payload=[1.0], transform=plan)
+    report = sched.run_graph(graph, retries=0, demote=False, host_retries=3)
+    assert report.outcomes[tid] == "host_lost"
+    assert isinstance(report.errors[tid], HostLostError)
+
+
+def test_executor_host_retry_budget_exhausts():
+    class _AlwaysLost(_LostPlan):
+        def rehost(self, error):
+            self.rehosts += 1  # "moves", but the next host dies too
+
+    plan = _AlwaysLost(lost=True)
+    graph = sched.TaskGraph()
+    tid = graph.add("backward", payload=[1.0], transform=plan)
+    report = sched.run_graph(graph, retries=0, demote=False, host_retries=2)
+    assert report.outcomes[tid] == "host_lost"
+    assert plan.rehosts == 2  # exactly the budget, then typed resolution
+
+
+# ---- cluster front against stub workers -------------------------------------
+
+
+def _front(addresses, **kw):
+    kw.setdefault("heartbeat_s", 5.0)  # quiet by default: tests own timing
+    kw.setdefault("rpc_timeout_s", 10.0)
+    return ClusterFront(addresses, **kw)
+
+
+def test_front_typed_validation(stub_worker):
+    _, server = stub_worker
+    with pytest.raises(InvalidParameterError):
+        ClusterFront([])
+    front = _front([server.address], start=False)
+    trip = np.zeros((4, 3), np.int32)
+    with pytest.raises(InvalidParameterError, match="unknown direction"):
+        front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4),
+                     direction="sideways")
+    with pytest.raises(InvalidParameterError, match="dims"):
+        front.submit(TransformType.C2C, (4, 4), trip, np.zeros(4))
+    with pytest.raises(InvalidParameterError, match="frequency values"):
+        front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(3))
+    with pytest.raises(InvalidParameterError, match="indices"):
+        front.submit(TransformType.C2C, (4, 4, 4), np.zeros((4, 2), np.int32),
+                     np.zeros(4))
+    front.close()
+
+
+def test_front_roundtrip_and_describe(stub_worker):
+    _, server = stub_worker
+    front = _front([server.address], start=False)
+    trip = np.zeros((4, 3), np.int32)
+    vals = np.arange(4, dtype=np.float64)
+    tk = front.submit(TransformType.C2C, (4, 4, 4), trip, vals)
+    front.pump()
+    np.testing.assert_array_equal(tk.result(timeout=10), vals * 2)
+    d = front.describe()
+    assert d["stats"]["counts"]["completed"] == 1
+    assert d["hosts"][0]["lost"] is False
+    assert d["plan_cards"][0]["degradations"] == []
+    assert d["config"]["heartbeat_s"] == 5.0
+    front.close()
+
+
+def test_front_expired_deadline_refused_typed(stub_worker):
+    _, server = stub_worker
+    front = _front([server.address], start=False)
+    trip = np.zeros((4, 3), np.int32)
+    with pytest.raises(DeadlineExceededError):
+        # a deadline this tight is expired by the admission check
+        # microseconds later (timeout_s <= 0 means "no deadline", so the
+        # smallest representable positive timeout is the expired case)
+        front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4),
+                     timeout_s=1e-12)
+    front.close()
+
+
+def test_front_rpc_submit_chaos_resolves_typed(stub_worker):
+    """The rpc.submit site armed raise at rate 1.0: every dispatch fails,
+    retries exhaust, and every ticket resolves with a TYPED error — the
+    no-deadlock contract under RPC machinery death."""
+    _, server = stub_worker
+    front = _front([server.address], start=False, retries=1, backoff_s=0.0)
+    trip = np.zeros((4, 3), np.int32)
+    with faults.inject("rpc.submit=raise"):
+        tickets = [
+            front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4))
+            for _ in range(4)
+        ]
+        front.pump()
+    for tk in tickets:
+        with pytest.raises(GenericError):
+            tk.result(timeout=10)
+        assert tk.outcome == "failed"
+    assert _counter("faults_injected_total") > 0
+    front.close()
+
+
+def test_front_rpc_submit_fractional_chaos_heals(stub_worker):
+    """Sub-1.0 rpc.submit chaos: the scheduler's retry ladder re-dispatches
+    through the injected failures and every ticket completes."""
+    _, server = stub_worker
+    front = _front(
+        [server.address], start=False, retries=4, backoff_s=0.0,
+        batch_max=2,
+    )
+    trip = np.zeros((4, 3), np.int32)
+    vals = np.arange(4, dtype=np.float64)
+    faults.reseed(7)
+    with faults.inject("rpc.submit=raise:0.3"):
+        tickets = [
+            front.submit(TransformType.C2C, (4, 4, 4), trip, vals + i)
+            for i in range(8)
+        ]
+        front.pump()
+    for i, tk in enumerate(tickets):
+        np.testing.assert_array_equal(tk.result(timeout=10), (vals + i) * 2)
+    front.close()
+
+
+def test_front_heartbeat_chaos_declares_host_lost(stub_worker):
+    """The host.heartbeat site armed raise: the monitor's probes fail, the
+    miss budget exhausts, the host lands in hosts_lost_total — liveness
+    machinery death degrades through the same typed ladder as a dead
+    host."""
+    _, server = stub_worker
+    with faults.inject("host.heartbeat=raise"):
+        front = _front(
+            [server.address], start=True, heartbeat_s=0.05,
+            heartbeat_misses=2,
+        )
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not front.hosts[0].lost:
+            time.sleep(0.02)
+        assert front.hosts[0].lost
+        # with every host lost, an admitted request resolves typed
+        trip = np.zeros((4, 3), np.int32)
+        tk = front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4))
+        with pytest.raises(HostLostError):
+            tk.result(timeout=10)
+        front.close()
+    assert _counter("hosts_lost_total") == 1
+    assert _counter("host_heartbeats_total") > 0
+    d = front.describe()
+    assert d["degradations"][0]["event"] == "host_lost"
+
+
+def test_front_member_failure_preserves_peers():
+    """One member of a coalesced chunk refused by the worker (typed): the
+    refused ticket fails with ITS error, every completed peer resolves —
+    per-entry replies are never collapsed into a whole-chunk failure (which
+    would discard and re-execute completed remote work)."""
+    service = _StubService(fail_submits={1})
+    server = RpcServer(service, port=0, timeout_s=10.0)
+    front = _front([server.address], start=False, retries=0, batch_max=8)
+    trip = np.zeros((4, 3), np.int32)
+    vals = np.arange(4, dtype=np.float64)
+    try:
+        tickets = [
+            front.submit(TransformType.C2C, (4, 4, 4), trip, vals + i)
+            for i in range(4)
+        ]
+        front.pump()
+        for i, tk in enumerate(tickets):
+            if i == 1:
+                with pytest.raises(ServiceOverloadError, match="refused"):
+                    tk.result(timeout=10)
+            else:
+                np.testing.assert_array_equal(
+                    tk.result(timeout=10), (vals + i) * 2
+                )
+        # the worker executed each member exactly once: no chunk re-run
+        assert service.submitted == 4
+    finally:
+        front.close()
+        server.close()
+
+
+def test_remote_plan_short_reply_is_host_lost(stub_worker):
+    """A reply whose results list does not match the payloads sent is a
+    transport-grade failure: typed HostLostError (feeding the requeue
+    ladder), never silently-unresolved tail tickets."""
+    _, server = stub_worker
+    front = _front([server.address], start=False)
+    entry = front._ensure_entry(
+        TransformType.C2C, (4, 4, 4), np.zeros((4, 3), np.int32)
+    )
+    plan = cluster.RemotePlan(front, entry, front.hosts[0])
+
+    class _ShortPending:
+        expected = 3
+        _client = front.hosts[0].client
+
+        def result(self):
+            return {"results": [{"result": np.zeros(4)}]}  # 1 of 3
+
+    with pytest.raises(HostLostError, match="malformed"):
+        plan._finalize(_ShortPending())
+    front.close()
+
+
+def test_front_requeues_to_surviving_stub():
+    """Two stub workers; worker 0's server dies (listener + conns torn
+    down) while the front dispatches — the dead transport raises
+    HostLostError, the scheduler rehosts onto worker 1, every ticket
+    completes, and the host_lost rung lands on the geometry card."""
+    s0, server0 = _StubService(), None
+    s1 = _StubService()
+    server0 = RpcServer(s0, port=0, timeout_s=5.0)
+    server1 = RpcServer(s1, port=0, timeout_s=5.0)
+    front = _front([server0.address, server1.address], start=False,
+                   retries=0)
+    trip = np.zeros((4, 3), np.int32)
+    vals = np.arange(4, dtype=np.float64)
+    try:
+        # kill worker 0 outright (close the listener; queued dials fail)
+        server0.close()
+        tickets = [
+            front.submit(TransformType.C2C, (4, 4, 4), trip, vals + i)
+            for i in range(4)
+        ]
+        front.pump()
+        for i, tk in enumerate(tickets):
+            np.testing.assert_array_equal(
+                tk.result(timeout=10), (vals + i) * 2
+            )
+        assert front.hosts[0].lost
+        assert not front.hosts[1].lost
+        assert s1.submitted > 0 and s0.submitted == 0
+        cards = front.describe()["plan_cards"]
+        assert any(
+            d["event"] == "host_lost" and d.get("rehomed_to") == "host1"
+            for c in cards for d in c["degradations"]
+        )
+        # the fleet-level loss itself is ALSO on every geometry card (the
+        # chaos-proof criterion holds even without an in-flight requeue)
+        assert any(
+            d["event"] == "host_lost" and "rehomed_to" not in d
+            for c in cards for d in c["degradations"]
+        )
+        assert _counter("hosts_lost_total") == 1
+    finally:
+        front.close()
+        server1.close()
+
+
+# ---- the real thing: SIGKILLed worker process mid-burst ---------------------
+
+
+def test_sigkill_worker_mid_flight_requeues_and_serves(tmp_path):
+    """2 real worker processes, a burst in flight, worker 0 SIGKILLed with
+    the heartbeat too slow to notice: the dead transport surfaces typed,
+    in-flight chunks requeue onto the survivor, EVERY ticket resolves, the
+    accounting is exact, and the host_lost rung is on cards and metrics —
+    the chaos proof of the whole ladder, in-suite."""
+    workers = hostmesh.spawn_workers(
+        2, devices_per_host=1, workdir=str(tmp_path),
+    )
+    front = ClusterFront(
+        [w.address for w in workers], heartbeat_s=30.0, batch_max=2,
+        rpc_timeout_s=60.0,
+    )
+    trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.8)
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
+    try:
+        # warm both workers (plan build + compile) outside the chaos window
+        warm = [
+            front.submit(TransformType.C2C, (8, 8, 8), trip, vals * (1 + i))
+            for i in range(4)
+        ]
+        for tk in warm:
+            tk.result(timeout=120)
+        tickets = [
+            front.submit(TransformType.C2C, (8, 8, 8), trip, vals * (1 + i))
+            for i in range(10)
+        ]
+        time.sleep(0.02)  # let chunks reach worker 0's wire
+        workers[0].kill()
+        outcomes = {"completed": 0, "failed": 0}
+        for tk in tickets:
+            try:
+                tk.result(timeout=120)
+                outcomes["completed"] += 1
+            except GenericError:
+                outcomes["failed"] += 1
+        # every ticket resolved (typed or completed): exact accounting
+        assert outcomes["completed"] + outcomes["failed"] == len(tickets)
+        # the survivor kept serving: work completed after the kill
+        assert outcomes["completed"] > 0
+        assert front.hosts[0].lost
+        assert not front.hosts[1].lost
+        assert _counter("hosts_lost_total") == 1
+        # fresh submissions after the loss complete on the survivor
+        tk = front.submit(TransformType.C2C, (8, 8, 8), trip, vals)
+        res = tk.result(timeout=120)
+        dense = np.zeros((8, 8, 8), complex)
+        t = np.asarray(trip)
+        dense[t[:, 2] % 8, t[:, 1] % 8, t[:, 0] % 8] = vals
+        oracle = np.fft.ifftn(dense) * 512
+        # workers run at their own default (f32) precision: the parity bar
+        # is the f32 engine bar, not the parent conftest's x64 one
+        assert np.abs(np.asarray(res) - oracle).max() < 1e-4
+    finally:
+        front.close()
+        hostmesh.stop_workers(workers)

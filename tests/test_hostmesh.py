@@ -1,0 +1,242 @@
+"""Multi-host bootstrap (`spfft_tpu.hostmesh`) + distributed-init validation.
+
+Covers the boot half of the multi-host serving layer: typed up-front
+validation of the ``jax.distributed`` coordinates
+(``parallel/mesh.py:validate_distributed_args`` — a malformed value must
+raise here, never fail opaquely inside a child process), worker-spawn env
+propagation (every ambient ``SPFFT_TPU_*`` knob reaches the child — lockdep
+arming included), wisdom warm-start from fleet bundles, and the real
+2-process × N-device ``jax.distributed`` boot proof. The cluster-front /
+chaos suites live in ``tests/test_cluster.py``.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import spfft_tpu as sp
+from spfft_tpu import hostmesh, tuning
+from spfft_tpu.errors import (
+    GenericError,
+    HostExecutionError,
+    InvalidParameterError,
+)
+from spfft_tpu.parallel.mesh import validate_distributed_args
+from spfft_tpu.serve.rpc import RpcClient
+
+
+# ---- init_distributed up-front validation -----------------------------------
+
+
+@pytest.mark.parametrize(
+    "coord,nprocs,pid",
+    [
+        ("localhost", 2, 0),          # no port
+        (":8476", 2, 0),              # no host
+        ("localhost:notaport", 2, 0),  # non-integer port
+        ("localhost:0", 2, 0),        # port out of range
+        ("localhost:99999", 2, 0),    # port out of range
+        ("localhost:8476", 0, 0),     # num_processes < 1
+        ("localhost:8476", "two", 0),  # non-integer num_processes
+        ("localhost:8476", 2, -1),    # negative process_id
+        ("localhost:8476", 2, 2),     # process_id >= num_processes
+        ("localhost:8476", 2, "one"),  # non-integer process_id
+        ("localhost:8476", None, 0),  # process_id without num_processes
+    ],
+)
+def test_distributed_args_malformed_raise_typed(coord, nprocs, pid):
+    with pytest.raises(InvalidParameterError):
+        validate_distributed_args(coord, nprocs, pid)
+
+
+def test_distributed_args_valid_pass():
+    validate_distributed_args("localhost:8476", 2, 1)
+    validate_distributed_args(None, None, None)  # TPU pods: all inferred
+    validate_distributed_args("10.0.0.1:1", 1, 0)
+
+
+def test_init_distributed_validates_before_initialize(monkeypatch):
+    """init_distributed must refuse malformed coordinates WITHOUT touching
+    jax.distributed (the opaque-in-child failure the wrapper exists to
+    prevent)."""
+    import jax
+
+    called = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: called.append(kw),
+    )
+    with pytest.raises(InvalidParameterError):
+        sp.init_distributed("nonsense", num_processes=2, process_id=0)
+    assert called == []
+
+
+# ---- child env propagation --------------------------------------------------
+
+
+def test_child_env_propagates_every_ambient_knob(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_LOCKDEP", "1")
+    monkeypatch.setenv("SPFFT_TPU_SERVE_QUEUE_CAP", "17")
+    monkeypatch.setenv("SPFFT_TPU_FAULTS_SEED", "42")
+    env = hostmesh.child_env(devices=4)
+    assert env["SPFFT_TPU_LOCKDEP"] == "1"
+    assert env["SPFFT_TPU_SERVE_QUEUE_CAP"] == "17"
+    assert env["SPFFT_TPU_FAULTS_SEED"] == "42"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert env["JAX_PLATFORMS"]  # always pinned for the child
+
+
+def test_child_env_overrides_win_and_device_flag_replaced(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_LOCKDEP", "0")
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_cpu_foo=1 --xla_force_host_platform_device_count=8",
+    )
+    env = hostmesh.child_env({"SPFFT_TPU_LOCKDEP": "1"}, devices=2)
+    assert env["SPFFT_TPU_LOCKDEP"] == "1"
+    # the parent's own device-count flag is replaced, other flags survive
+    assert "--xla_cpu_foo=1" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("device_count") == 1
+    assert "device_count=2" in env["XLA_FLAGS"]
+
+
+def test_child_env_devices_typed():
+    with pytest.raises(InvalidParameterError):
+        hostmesh.child_env(devices=0)
+
+
+def test_child_env_never_propagates_shared_lockdep_report(monkeypatch):
+    """The parent's SPFFT_TPU_LOCKDEP_REPORT must not reach children
+    verbatim: every process writing ONE report path at exit means last
+    writer wins and the merged cross-check silently loses the workers'
+    graphs. Explicit per-host overrides (spawn_workers lockdep_dir=) still
+    win."""
+    monkeypatch.setenv("SPFFT_TPU_LOCKDEP", "1")
+    monkeypatch.setenv("SPFFT_TPU_LOCKDEP_REPORT", "/tmp/shared.json")
+    env = hostmesh.child_env()
+    assert "SPFFT_TPU_LOCKDEP_REPORT" not in env
+    assert env["SPFFT_TPU_LOCKDEP"] == "1"  # the arming itself propagates
+    env = hostmesh.child_env({"SPFFT_TPU_LOCKDEP_REPORT": "/tmp/host0.json"})
+    assert env["SPFFT_TPU_LOCKDEP_REPORT"] == "/tmp/host0.json"
+
+
+# ---- wisdom warm-start ------------------------------------------------------
+
+
+def test_warm_start_merges_fleet_bundle(tmp_path, monkeypatch):
+    donor = tuning.WisdomStore(str(tmp_path / "donor.json"))
+    key = {"kind": "local", "probe": 1}
+    donor.record(
+        key,
+        tuning.make_entry(key, {"engine": "xla"}, [{"label": "c0", "ms": 1.0}]),
+    )
+    bundle = tmp_path / "fleet.json"
+    assert donor.export(str(bundle)) == 1
+    # the booted host's own (file) store starts cold and warms from the bundle
+    monkeypatch.setenv("SPFFT_TPU_WISDOM", str(tmp_path / "host.json"))
+    monkeypatch.setenv(hostmesh.WISDOM_BUNDLE_ENV, str(bundle))
+    assert hostmesh.warm_start() == (1, 0)
+    store = tuning.WisdomStore(str(tmp_path / "host.json"))
+    assert store.lookup(key)["choice"] == {"engine": "xla"}
+    # idempotent: a second boot adds nothing
+    assert hostmesh.warm_start() == (0, 0)
+
+
+def test_warm_start_unset_is_noop(monkeypatch):
+    monkeypatch.delenv(hostmesh.WISDOM_BUNDLE_ENV, raising=False)
+    assert hostmesh.warm_start() == (0, 0)
+
+
+def test_warm_start_corrupt_bundle_typed(tmp_path, monkeypatch):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("SPFFT_TPU_WISDOM", str(tmp_path / "host.json"))
+    with pytest.raises(GenericError):
+        hostmesh.warm_start(str(bad))
+
+
+# ---- spawn validation -------------------------------------------------------
+
+
+def test_spawn_workers_typed_validation():
+    with pytest.raises(InvalidParameterError):
+        hostmesh.spawn_workers(0)
+
+
+def test_spawn_workers_boot_failure_typed(tmp_path):
+    """A worker that dies before readiness surfaces typed with its log tail
+    — never a silent hang until the timeout."""
+    with pytest.raises(HostExecutionError, match="failed to become ready"):
+        hostmesh.spawn_workers(
+            1, workdir=str(tmp_path), ready_timeout_s=20.0,
+            python="/bin/false",
+        )
+
+
+# ---- real worker boot (subprocess; the expensive cells) ---------------------
+
+
+def test_spawn_worker_ready_env_lockdep_and_clean_stop(tmp_path, monkeypatch):
+    """One spawned worker: ready handshake, knob propagation observed from
+    INSIDE the child, lockdep armed per-host with a report written on clean
+    shutdown, and the merged report cross-checking clean against the SA011
+    static graph (`analyze.py --lockdep-check` semantics)."""
+    monkeypatch.setenv("SPFFT_TPU_SERVE_QUEUE_CAP", "19")
+    lockdir = tmp_path / "lockdep"
+    lockdir.mkdir()
+    workers = hostmesh.spawn_workers(
+        1, devices_per_host=1, workdir=str(tmp_path / "w"),
+        lockdep_dir=str(lockdir),
+    )
+    try:
+        w = workers[0]
+        assert w.alive()
+        assert w.ready["port"] > 0
+        # the parent's ambient knob reached the child environment
+        assert "SPFFT_TPU_SERVE_QUEUE_CAP" in w.ready["env_knobs"]
+        assert "SPFFT_TPU_LOCKDEP" in w.ready["env_knobs"]
+        client = RpcClient(w.address, timeout_s=10.0)
+        try:
+            assert client.call({"op": "ping"})["ok"] == 1
+            stats = client.call({"op": "stats"})["stats"]
+            # the propagated knob governed the child's service config
+            assert stats["queue_capacity"] == 19
+        finally:
+            client.close()
+    finally:
+        hostmesh.stop_workers(workers)
+    assert not workers[0].alive()
+    # clean shutdown ran the exit hooks: the per-host lockdep report exists,
+    # validates, and merge_reports over it (the N-host shape) stays sound
+    report_path = lockdir / "host0.json"
+    assert report_path.exists(), workers[0].log_tail()
+    from spfft_tpu.analysis import lockdep
+
+    doc = json.loads(report_path.read_text())
+    assert lockdep.validate_report(doc) == []
+    merged = lockdep.merge_reports([doc, doc])
+    assert lockdep.validate_report(merged) == []
+    # duplicate-report merge doubles counts but invents no edges/locks
+    assert merged["counts"]["locks"] == doc["counts"]["locks"]
+    assert merged["counts"]["edges"] == doc["counts"]["edges"]
+    assert merged["cycles"] == doc["cycles"]
+
+
+def test_spawn_mesh_boot_two_process_topology(tmp_path):
+    """The CI boot proof: 2 worker processes join ONE jax.distributed
+    multi-controller run, each with 2 virtual CPU devices — every rank must
+    observe process_count=2 and the 4-device global mesh."""
+    workers = hostmesh.spawn_workers(
+        2, devices_per_host=2, mesh=True, workdir=str(tmp_path),
+    )
+    try:
+        for w in workers:
+            topo = w.ready["topology"]
+            assert topo is not None, w.log_tail()
+            assert topo["process_count"] == 2
+            assert topo["process_index"] == w.host_id
+            assert topo["global_devices"] == 4
+            assert topo["local_devices"] == 2
+    finally:
+        hostmesh.stop_workers(workers)

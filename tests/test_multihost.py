@@ -34,13 +34,13 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _run_ranks(nprocs, port, engine, ttype, exchange, timeout=300):
+def _run_ranks(nprocs, port, engine, ttype, exchange, timeout=300, overlap=1):
     env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root"}
     procs = [
         subprocess.Popen(
             [
                 sys.executable, str(SCRIPT), str(rank), str(port), engine,
-                ttype, exchange, str(nprocs),
+                ttype, exchange, str(nprocs), str(overlap),
             ],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -93,3 +93,18 @@ def test_two_process_roundtrip(engine, ttype, port, exchange):
 )
 def test_four_process_roundtrip(engine, ttype, port, exchange):
     _run_ranks(4, port, engine, ttype, exchange)
+
+
+@pytest.mark.parametrize(
+    "engine,port,overlap",
+    [
+        # the OVERLAPPED rewrite under REAL cross-process collectives: the
+        # padded exchange splits into chunked double-buffered Gloo
+        # collectives pipelined against neighbor FFTs (PR 7's discipline,
+        # until now only exercised single-controller)
+        ("xla", 12993, 2),
+        ("mxu", 12995, 2),
+    ],
+)
+def test_two_process_overlapped_roundtrip(engine, port, overlap):
+    _run_ranks(2, port, engine, "c2c", "buffered", overlap=overlap)
