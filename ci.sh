@@ -826,6 +826,10 @@ refused = row["rejected"] + row["shed"] + row["deadline_miss"]
 # double-counted, nothing untyped (an untyped escape would have crashed the
 # driver or left a pending ticket — both break this identity)
 assert row["completed"] + refused + row["failed"] == row["offered"], row
+# per-phase p50/p99 columns (the timeline layer) survive chaos rows too
+assert row["phases"] and all(
+    "p50_ms" in v and "p99_ms" in v for v in row["phases"].values()
+), row["phases"]
 assert row["completed_after_kill"] > 0, row
 topo = {t["host_id"]: t["alive"] for t in doc["config"]["topology"]}
 assert topo[0] is False and topo[1] is True, topo
@@ -858,10 +862,15 @@ EOF
   # Lockdep across processes: workers spawned with SPFFT_TPU_LOCKDEP=1
   # (env propagation) write per-host reports on clean shutdown; the front
   # process writes its own; the merged fleet graph must cross-check clean
-  # against the SA011 static model.
-  JAX_PLATFORMS=cpu SPFFT_TPU_LOCKDEP=1 \
+  # against the SA011 static model. The same session proves the
+  # observability plane on REAL process boundaries: tracing is armed on
+  # front and workers (env propagation again), every submitted request's
+  # run ID must join local and host-tagged spliced events in ONE front-side
+  # snapshot, and a live fleetstat scrape must validate.
+  JAX_PLATFORMS=cpu SPFFT_TPU_LOCKDEP=1 SPFFT_TPU_TRACE=1 \
     SPFFT_TPU_LOCKDEP_REPORT="$mdir/front.json" \
     timeout 540 python - "$mdir" <<'EOF'
+import subprocess
 import sys
 import numpy as np
 import spfft_tpu as sp
@@ -870,7 +879,12 @@ from spfft_tpu.serve.cluster import ClusterFront
 
 mdir = sys.argv[1]
 workers = hostmesh.spawn_workers(2, devices_per_host=1, lockdep_dir=mdir)
-front = ClusterFront([w.address for w in workers], heartbeat_s=0.1)
+# batch_max=1: six same-geometry requests must NOT coalesce into one
+# chunk, so dispatches spread over both hosts and the join proof below
+# sees spliced spans from both worker processes
+front = ClusterFront(
+    [w.address for w in workers], heartbeat_s=0.1, batch_max=1
+)
 trip = sp.create_spherical_cutoff_triplets(8, 8, 8, 0.8)
 rng = np.random.default_rng(0)
 vals = rng.standard_normal(len(trip)) + 1j * rng.standard_normal(len(trip))
@@ -879,13 +893,65 @@ try:
            for i in range(6)]
     for tk in tks:
         tk.result(timeout=120)
+    # Cross-host trace join: for every request, one front-side snapshot
+    # must hold BOTH sides of the dispatch under the request's run ID —
+    # the front's own events (no host tag) and the worker's spliced span
+    # (host-tagged) — and across the batch both worker processes appear.
+    evs = sp.obs.trace.snapshot()["events"]
+    spliced_hosts = set()
+    for tk in tks:
+        mine = [e for e in evs if e["run"] == tk.run]
+        assert [e for e in mine if "host" not in e["args"]], tk.run
+        remote = {e["args"]["host"] for e in mine if "host" in e["args"]}
+        assert remote, (tk.run, mine)
+        spliced_hosts |= remote
+    assert spliced_hosts == {"host0", "host1"}, spliced_hosts
+    # end-to-end timeline: a remote-served ticket reached the wire phases
+    tl = [p["phase"] for p in tks[0].timeline()]
+    for phase in ("admitted", "dispatched", "wire", "remote_execute",
+                  "finalized"):
+        assert phase in tl, (phase, tl)
+    # fleet scrape while both workers are live: describe() join validates,
+    # and the operator CLI writes a document for the shell-side checks
+    doc = front.fleet_metrics()
+    assert not sp.obs.fleet.validate_fleet(doc), doc["hosts"]
+    states = {h: e["state"] for h, e in doc["hosts"].items()}
+    assert states == {"host0": "live", "host1": "live"}, states
+    cmd = [sys.executable, "programs/fleetstat.py",
+           "-o", f"{mdir}/fleet.json"]
+    for i, w in enumerate(workers):
+        cmd += ["--host", f"host{i}={w.address}"]
+    subprocess.run(cmd, check=True, stdout=subprocess.DEVNULL)
 finally:
     front.close()
     hostmesh.stop_workers(workers)
-print("lockdep-armed mhost session ok")
+print("lockdep-armed mhost session ok (run-ID join across both processes)")
 EOF
   python programs/analyze.py --lockdep-check \
     "$mdir/host0.json" "$mdir/host1.json" "$mdir/front.json"
+  # Fleet doc discipline: the live scrape re-validates clean, and a
+  # doctored document trips the validator with exit 3 (distinct from
+  # "tool broken" — the perf_gate.py discipline).
+  python programs/fleetstat.py --check "$mdir/fleet.json" 2> /dev/null
+  python - "$mdir" <<'EOF'
+import json, sys
+
+d = sys.argv[1]
+doc = json.load(open(f"{d}/fleet.json"))
+doc["schema"] = "spfft_tpu.obs.fleet/999"
+del doc["totals"]
+json.dump(doc, open(f"{d}/doctored.json", "w"))
+EOF
+  set +e
+  python programs/fleetstat.py --check "$mdir/doctored.json" \
+    > /dev/null 2>&1
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "doctored fleet doc FAILED to trip the validator (rc=$rc, want 3)" >&2
+    exit 1
+  fi
+  echo "fleet doc ok (doctored document trips with exit 3)"
   rm -rf "$mdir"
   echo "mhost stage ok"
 }

@@ -216,6 +216,14 @@ def main(argv=None):
             )
             batch_results[b] = m["seconds_per_transform"]
             card = t.report()
+            # the batched row's stage attribution rides a full perf report:
+            # models scale by B (attribution.batch stamps the extent), and
+            # seconds are the WHOLE stacked pair, so the report's aggregate
+            # gflops equals this row's per-transform figure by construction
+            perf = sp.obs.perf.perf_report(
+                t, m["seconds_per_transform"] * b, repeats=args.repeats,
+                batch=b,
+            )
             rows.append(
                 {
                     "key": f"fbench:c2c:{dim}:r{args.radius}:{args.dtype}:b{b}",
@@ -228,6 +236,7 @@ def main(argv=None):
                     "nnz_fraction": card["nnz_fraction"],
                     "ir": card["ir"],
                     "batch_provenance": card["batch"],
+                    "perf": perf,
                     "run_id": card["run_id"],
                 }
             )

@@ -279,6 +279,7 @@ def index_page() -> str:
         - [Multi-transforms](multi_transform.md)
         - [Index helpers and mesh utilities](utilities.md)
         - [Observability: plan cards, metrics, execution trace](obs.md)
+        - [Fleet metrics and cross-host trace propagation](fleet.md)
         - [Performance reports and the scaling bench](perf.md)
         - [Autotuning and wisdom](tuning.md)
         - [Fault injection, guard mode and degradation](faults.md)
@@ -371,6 +372,45 @@ def perf_page() -> str:
             perf.validate_scaling_doc,
         ],
     )
+
+
+def fleet_page() -> str:
+    """The fleet observability page: `spfft_tpu.obs.fleet` (scrape + merge
+    + schema pin + exposition) and the cross-host trace propagation trio
+    (`trace.segment` / `validate_segment` / `splice`) — one page, they are
+    the two halves of the layer-6 story."""
+    from spfft_tpu.obs import fleet, trace
+
+    merged = class_page(
+        "Fleet metrics (`spfft_tpu.obs.fleet`)",
+        doc(fleet),
+        [],
+        [
+            fleet.fleet_snapshot,
+            fleet.merge_snapshots,
+            fleet.validate_fleet,
+            fleet.fleet_prometheus_text,
+            fleet.parse_series_key,
+            fleet.host_series_key,
+            fleet.resolve_scrape_s,
+        ],
+    )
+    propagation = class_page(
+        "Cross-host trace propagation (`spfft_tpu.obs.trace`)",
+        "Compact schema-pinned trace segments carried on RPC replies: the "
+        "worker cuts its spans under the caller's run ID "
+        "(`trace.segment`), the front validates and splices them into its "
+        "own flight recorder tagged `host=` (`trace.splice`), so one "
+        "`trace.snapshot()` shows both sides of a dispatch under the "
+        "submitting request's run ID.",
+        [],
+        [
+            trace.segment,
+            trace.validate_segment,
+            trace.splice,
+        ],
+    )
+    return merged + "\n" + propagation
 
 
 def verify_page() -> str:
@@ -699,6 +739,7 @@ def generate(outdir: Path) -> None:
             ],
         ),
         "obs.md": obs_page(),
+        "fleet.md": fleet_page(),
         "perf.md": perf_page(),
         "tuning.md": class_page(
             "Tuning",

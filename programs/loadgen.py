@@ -174,6 +174,23 @@ def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
     p50 = _percentile(latencies, 0.50)
     p99 = _percentile(latencies, 0.99)
     noise = min(0.5, (p99 - p50) / p50) if p50 > 0 else 0.0
+    # per-phase latency columns from the tickets' monotonic phase stamps
+    # (admitted -> coalesced -> dispatched -> wire -> remote_execute ->
+    # finalized): under overload the knee shows up as p99 growth in ONE
+    # phase (queue wait = "coalesced"), not as an undifferentiated latency
+    # blob — every resolved ticket contributes whatever stamps it reached
+    phase_samples: dict = {}
+    for t in tickets:
+        for phase, seconds in t.phase_seconds().items():
+            phase_samples.setdefault(phase, []).append(seconds)
+    phases = {}
+    for phase, vals in phase_samples.items():
+        vals.sort()
+        phases[phase] = {
+            "n": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(vals, 0.99) * 1e3, 3),
+        }
     row = {
         "key": key,
         "offered": n_requests,
@@ -187,6 +204,7 @@ def run_step(service, *, key, rate, duration, tenants, trip, values, dims,
         "transforms_per_sec": round(completed / max(wall, 1e-9), 3),
         "p50_ms": round(p50 * 1e3, 3),
         "p99_ms": round(p99 * 1e3, 3),
+        "phases": phases,
         "gflops": round(completed * flops_per_transform / max(wall, 1e-9) / 1e9, 6),
         "seconds_noise": round(noise, 4),
         "wall_seconds": round(wall, 4),
@@ -308,11 +326,16 @@ def main(argv=None) -> int:
                 kill_at_s=args.kill_at * args.duration,
             )
             rows.append(row)
+            queue_wait = row["phases"].get("coalesced")
             print(
                 f"{row['key']}: offered {row['offered_rate']:.0f}/s -> "
                 f"{row['transforms_per_sec']:.0f} done/s "
                 f"(p50 {row['p50_ms']:.1f} ms, p99 {row['p99_ms']:.1f} ms, "
-                f"rejected {row['rejected']}, shed {row['shed']}, "
+                + (
+                    f"queue-wait p99 {queue_wait['p99_ms']:.1f} ms, "
+                    if queue_wait else ""
+                )
+                + f"rejected {row['rejected']}, shed {row['shed']}, "
                 f"deadline {row['deadline_miss']}, failed {row['failed']})"
             )
     finally:

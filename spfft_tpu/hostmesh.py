@@ -83,11 +83,16 @@ def child_env(overrides=None, *, devices: int | None = None) -> dict:
     for key, value in os.environ.items():
         if key.startswith(knobs.PREFIX):
             env[key] = value
-    # the one knob that must NOT propagate verbatim: a shared report path
-    # would have every worker and the parent clobber one file at exit (the
-    # merge would silently check only the last writer's graph). Workers get
-    # per-host paths via spawn_workers(lockdep_dir=) / explicit overrides.
+    # two knobs that must NOT propagate verbatim — both name parent-owned
+    # output paths. A shared lockdep report path would have every worker
+    # and the parent clobber one file at exit (the merge would silently
+    # check only the last writer's graph); a shared trace-dump directory
+    # interleaves every host's crash dumps into one pid-keyed pile nobody
+    # can attribute. Workers get per-host paths via
+    # spawn_workers(lockdep_dir=) / its per-host dump subdirectories /
+    # explicit overrides.
     env.pop("SPFFT_TPU_LOCKDEP_REPORT", None)
+    env.pop("SPFFT_TPU_TRACE_DUMP", None)
     if devices is not None:
         if int(devices) < 1:
             raise InvalidParameterError(
@@ -290,6 +295,14 @@ def spawn_workers(
             overrides["SPFFT_TPU_LOCKDEP"] = "1"
             overrides["SPFFT_TPU_LOCKDEP_REPORT"] = str(
                 Path(lockdep_dir) / f"host{i}.json"
+            )
+        # a parent trace-dump dir fans out per host (child_env pops the
+        # verbatim value): each worker flushes its flight recorder into its
+        # own subdirectory, so crash dumps stay attributable
+        trace_dump = knobs.get_str("SPFFT_TPU_TRACE_DUMP")
+        if trace_dump:
+            overrides.setdefault(
+                "SPFFT_TPU_TRACE_DUMP", str(Path(trace_dump) / f"host{i}")
             )
         cenv = child_env(overrides, devices=devices_per_host)
         with open(log_path, "wb") as log:

@@ -631,6 +631,13 @@ register(
     "typed `HostLostError` naming the host, feeding the requeue ladder",
 )
 register(
+    "SPFFT_TPU_FLEET_SCRAPE_S", "float", 5.0, floor=0.1,
+    doc="per-host wall deadline of one fleet metric scrape "
+    "(`spfft_tpu.obs.fleet.fleet_snapshot` / the `metrics` RPC op): a "
+    "host that cannot answer inside it is stamped `unreachable` in the "
+    "fleet document instead of hanging the aggregation",
+)
+register(
     "SPFFT_TPU_SCHED_INFLIGHT", "int", 8, floor=1,
     doc="task-graph executor window: how many transform executions stay "
     "dispatched/device-resident at once before finalize must drain one "

@@ -1,6 +1,6 @@
 """spfft_tpu.obs — unified metrics, plan introspection, and execution tracing.
 
-Five observability layers, coarse to fine (docs/details.md "Observability"):
+Six observability layers, coarse to fine (docs/details.md "Observability"):
 
 1. **Host timing tree** (:mod:`spfft_tpu.timing`): rt_graph-parity nested wall
    -clock statistics of the host-visible phases (init, staging, dispatch,
@@ -34,8 +34,17 @@ Five observability layers, coarse to fine (docs/details.md "Observability"):
    above. Surfaces: ``programs/dbench.py`` (multichip scaling rows),
    ``programs/perf_gate.py`` + ``./ci.sh perf`` (regression gate),
    ``bench.py`` (embedded report).
+6. **Fleet aggregation** (:mod:`spfft_tpu.obs.fleet`): the first layer that
+   spans processes — each worker host's registry snapshot scraped over the
+   ``metrics`` RPC op (bounded per-host deadline, lost hosts skipped typed)
+   and merged into one host-labeled ``spfft_tpu.obs.fleet/1`` document
+   (counters summed, histogram buckets summed, gauges per-host), with
+   :func:`fleet.validate_fleet` and :func:`fleet.fleet_prometheus_text`;
+   cross-host *trace propagation* rides the same RPC plane (run IDs in
+   request frames, remote-span segments spliced back ``host=``-tagged), so
+   the run-ID join holds across the fleet.
 """
-from . import perf, trace  # noqa: F401
+from . import fleet, perf, trace  # noqa: F401
 from .registry import (  # noqa: F401
     HISTOGRAM_BUCKETS,
     METRICS_ENV,

@@ -103,6 +103,12 @@ METRICS = (
      "requests coalesced per executed batch"),
     ("serve_latency_seconds", "histogram", ("tenant",),
      "admission-to-resolution latency per request"),
+    ("serve_phase_seconds", "histogram", ("phase",),
+     "per-request seconds spent reaching each ticket phase stamp from the "
+     "previous one (admitted -> coalesced -> dispatched -> wire -> "
+     "remote_execute -> finalized); labeled by the phase REACHED, so "
+     "phase=\"coalesced\" is queue wait and phase=\"remote_execute\" is "
+     "the cross-host round trip"),
     # ---- multi-host serving -------------------------------------------------
     ("hosts_lost_total", "counter", ("host",),
      "worker hosts declared lost (missed heartbeat budget or dead RPC "
@@ -114,6 +120,12 @@ METRICS = (
     ("rpc_requests_total", "counter", ("op", "outcome"),
      "length-prefixed-JSON RPC requests served by a worker host, per op "
      "and ok/error outcome"),
+    ("fleet_scrapes_total", "counter", ("host", "outcome"),
+     "per-host metric scrapes by the fleet aggregator (obs.fleet), per "
+     "ok / lost (skipped typed) / unreachable outcome"),
+    ("remote_spans_spliced_total", "counter", ("host",),
+     "remote trace-segment events spliced into the local flight recorder "
+     "by the cluster front (cross-host run-ID join)"),
     # ---- scheduler ----------------------------------------------------------
     ("sched_tasks_total", "counter", ("outcome",),
      "task-graph tasks resolved, per outcome"),

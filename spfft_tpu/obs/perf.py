@@ -335,16 +335,40 @@ def stage_model(transform) -> list:
     return rows
 
 
-def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dict:
+def perf_report(
+    transform,
+    seconds: float,
+    *,
+    repeats: int | None = None,
+    batch: int | None = None,
+) -> dict:
     """Build the performance report for one measured ``transform`` pair.
 
     ``seconds`` is the measured, fenced wall time of one backward+forward
     pair (see :func:`measure_pair_seconds`); ``repeats`` records how many
-    timed repetitions the best-of came from. The report validates against
-    :func:`validate_perf_report`, feeds the run registry, and emits a
-    ``perf`` trace instant under the plan's run ID."""
+    timed repetitions the best-of came from. ``batch`` (default 1) says the
+    measured pair carried B stacked transforms through one dispatch (the
+    batch-fused path): the flop/byte models — stage rows, dense flops, wire
+    bytes — scale by B so per-stage GFLOP/s and the headline ``gflops``
+    read as aggregate throughput of the batched dispatch, and the extent is
+    stamped into ``attribution["batch"]`` (validation-optional, the
+    ``overlap_chunks`` precedent: consumers read a missing value as 1).
+    The report validates against :func:`validate_perf_report`, feeds the
+    run registry, and emits a ``perf`` trace instant under the plan's run
+    ID."""
     seconds = float(seconds)
-    rows = _attribute(stage_model(transform), seconds, flop_per_byte())
+    b = 1 if batch is None else int(batch)
+    if b < 1:
+        from ..errors import InvalidParameterError
+
+        raise InvalidParameterError(f"batch must be >= 1, got {batch}")
+    model_rows = stage_model(transform)
+    if b > 1:
+        model_rows = [
+            dict(r, flops=r["flops"] * b, bytes=r["bytes"] * b)
+            for r in model_rows
+        ]
+    rows = _attribute(model_rows, seconds, flop_per_byte())
     dims = [int(transform.dim_x), int(transform.dim_y), int(transform.dim_z)]
     distributed = getattr(transform, "_mesh", None) is not None
     if distributed:
@@ -369,8 +393,10 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
         overlap_chunks = 1
         wire_bytes = 0
         num_elements = int(transform.num_local_elements)
+    if b > 1:
+        wire_bytes *= b  # the batched dispatch ships every member's slabs
     model_flops = sum(r["flops"] for r in rows)
-    dense_flops = dense_pair_flops(dims)
+    dense_flops = dense_pair_flops(dims) * b
     exchange_seconds = sum(
         r["seconds"] for r in rows if r["stage"] in EXCHANGE_STAGES
     )
@@ -414,7 +440,11 @@ def perf_report(transform, seconds: float, *, repeats: int | None = None) -> dic
         "exchange_gbps": (
             wire_bytes / exchange_seconds / 1e9 if exchange_seconds > 0 else 0.0
         ),
-        "attribution": {"method": "analytic", "flop_per_byte": flop_per_byte()},
+        "attribution": {
+            "method": "analytic",
+            "flop_per_byte": flop_per_byte(),
+            "batch": b,
+        },
         "stages": rows,
     }
     _record(report)

@@ -386,6 +386,89 @@ def validate_trace(snap: dict) -> list:
     return missing
 
 
+# ---- cross-host segments --------------------------------------------------
+
+# Wire format of the cross-host trace join (docs/details.md "Observability",
+# fleet layer): a worker host answers an RPC whose frame carried the caller's
+# run ID with the slice of its OWN flight recorder stamped with that run, and
+# the cluster front splices the slice into the local recorder tagged
+# ``host=`` — one front-side snapshot()/chrome_trace() then shows the whole
+# cross-host request under one run ID.
+SEGMENT_SCHEMA = "spfft_tpu.obs.trace.segment/1"
+_SEGMENT_KEYS = ("schema", "run", "events")
+_SEGMENT_EVENT_KEYS = ("ts", "name", "ph", "args")
+
+
+def segment(run_id: str, limit: int | None = None) -> dict:
+    """Compact, schema-pinned slice of the flight recorder: every recorded
+    event stamped with ``run_id``, stripped to the wire keys
+    (``ts``/``name``/``ph``/``args`` — ``seq`` is recorder-local and the run
+    is hoisted to the envelope). ``limit`` keeps the NEWEST events (reply
+    frames stay bounded; the ring already bounds the worst case). Empty
+    while disarmed — a disarmed worker still answers, with no events."""
+    events = [
+        {"ts": e["ts"], "name": e["name"], "ph": e["ph"], "args": e["args"]}
+        for e in _recorder.events()
+        if e["run"] == run_id
+    ]
+    if limit is not None and len(events) > int(limit):
+        events = events[-int(limit):]
+    return {"schema": SEGMENT_SCHEMA, "run": run_id, "events": events}
+
+
+def validate_segment(seg: dict) -> list:
+    """Missing/malformed key paths of a remote-span segment ([] when
+    valid) — the schema pin of the cross-host wire format."""
+    if not isinstance(seg, dict):
+        return ["segment (not a dict)"]
+    missing = [k for k in _SEGMENT_KEYS if k not in seg]
+    if seg.get("schema") != SEGMENT_SCHEMA:
+        missing.append(f"schema (unknown: {seg.get('schema')!r})")
+    for i, ev in enumerate(seg.get("events", ())):
+        if not isinstance(ev, dict):
+            missing.append(f"events[{i}] (not a dict)")
+            continue
+        missing.extend(
+            f"events[{i}].{k}" for k in _SEGMENT_EVENT_KEYS if k not in ev
+        )
+        if ev.get("ph") not in _PHASES:
+            missing.append(f"events[{i}].ph (unknown: {ev.get('ph')!r})")
+        if ev.get("name") not in EVENTS:
+            missing.append(f"events[{i}].name (unknown: {ev.get('name')!r})")
+    return missing
+
+
+def splice(seg: dict, host: str | None = None) -> int:
+    """Re-emit a remote segment's events into the local flight recorder
+    under the segment's run ID, each tagged ``host=`` and carrying the
+    remote recorder's timestamp as ``remote_ts`` (local ``ts``/``seq`` are
+    assigned at splice time — two hosts' clocks never interleave). Events
+    that fail the segment schema are SKIPPED, never spliced — remote spans
+    are advisory and must not invalidate the local snapshot — and the
+    count of spliced events is returned (0 while disarmed or on a
+    malformed envelope)."""
+    if not _recorder or not isinstance(seg, dict):
+        return 0
+    if seg.get("schema") != SEGMENT_SCHEMA:
+        return 0
+    run = seg.get("run")
+    spliced = 0
+    for ev in seg.get("events", ()):
+        if not isinstance(ev, dict):
+            continue
+        if any(k not in ev for k in _SEGMENT_EVENT_KEYS):
+            continue
+        if ev["ph"] not in _PHASES or ev["name"] not in EVENTS:
+            continue
+        args = dict(ev["args"] if isinstance(ev["args"], dict) else {})
+        if host is not None:
+            args["host"] = str(host)
+        args["remote_ts"] = ev["ts"]
+        _recorder.emit(ev["name"], ev["ph"], run, args)
+        spliced += 1
+    return spliced
+
+
 def _track_of(ev: dict) -> str:
     """Chrome track key: host phases get one track per phase label (the
     issue contract — the timing vocabulary becomes rows), every other event

@@ -394,6 +394,7 @@ class _Run:
         task.error = error
         obs.trace.event(
             "sched", what="fail", task=task.id, error=type(error).__name__,
+            outcome="host_lost",
         )
         self._resolve(task, "host_lost")
         if self.on_error == "raise":

@@ -37,6 +37,19 @@ The ``rpc.submit`` fault site fires in the dispatch path and
 ``host.heartbeat`` in the monitor's probe path, so worker-kill chaos is a
 first-class armed scenario (docs/details.md "Multi-host serving & host
 loss").
+
+**Cross-host observability** (docs/details.md "Observability", layer 6):
+the front mints one trace run ID per admitted request and ships it on the
+wire (``runs`` in the ``submit_batch`` frame); the worker records its spans
+under that key and the reply carries back a compact trace *segment* per
+request, which :meth:`RemotePlan._finalize` splices into the front's own
+flight recorder tagged ``host=`` — one ``trace.snapshot()`` on the front
+shows both sides of every dispatch under the submitting request's run ID.
+Tickets carry monotonic phase stamps (``admitted -> coalesced ->
+dispatched -> wire -> remote_execute -> finalized``) feeding the
+``serve_phase_seconds{phase}`` histograms, and :meth:`ClusterFront.describe`
+joins a fleet metrics document (:func:`spfft_tpu.obs.fleet.fleet_snapshot`
+over the ``metrics`` RPC op, lost hosts skipped typed).
 """
 from __future__ import annotations
 
@@ -242,10 +255,14 @@ class RemotePlan:
     _guard = False
     device = None
 
-    def __init__(self, front, entry, handle: HostHandle):
+    def __init__(self, front, entry, handle: HostHandle, requests=None):
         self.front = front
         self.entry = entry
         self.handle = handle
+        # the chunk's admitted requests, payload-aligned: their run IDs ride
+        # the wire frame and their tickets take the wire/remote_execute
+        # phase stamps (None for ad-hoc plans built without requests)
+        self.requests = list(requests) if requests is not None else []
 
     # ---- host-loss requeue hook ---------------------------------------------
 
@@ -268,7 +285,7 @@ class RemotePlan:
 
     def _msg(self, direction: str, payloads: list, scaling) -> dict:
         e = self.entry
-        return {
+        msg = {
             "op": "submit_batch",
             "transform_type": int(e.transform_type.value),
             "dims": list(e.dims),
@@ -279,12 +296,20 @@ class RemotePlan:
             "timeout_s": None,
             "payloads": [np.asarray(p) for p in payloads],
         }
+        if len(self.requests) == len(payloads):
+            # trace propagation: the worker records its spans under the
+            # caller's run IDs and the reply carries them back as segments
+            msg["runs"] = [r.run for r in self.requests]
+        return msg
 
     def _dispatch(self, direction: str, payloads: list, scaling):
         # the RPC transport's fault checkpoint: an injected failure here
         # models the submit machinery dying and must degrade through the
         # scheduler's typed ladder (retry -> requeue -> host_lost)
         faults.site("rpc.submit")
+        for req in self.requests:
+            req.ticket.stamp("wire")  # first-wins: a rehosted re-dispatch
+            # keeps the ORIGINAL time the request hit the wire
         pending = _RpcPending(
             self.handle.client,
             self._msg(direction, payloads, scaling),
@@ -305,6 +330,9 @@ class RemotePlan:
         from .rpc import raise_error_payload
 
         reply = pending.result()
+        for req in self.requests:
+            req.ticket.stamp("remote_execute")
+        self._splice_spans(reply.get("spans"))
         results = reply.get("results")
         if not isinstance(results, list) or len(results) != pending.expected:
             got = len(results) if isinstance(results, list) else "no"
@@ -324,6 +352,22 @@ class RemotePlan:
                 continue
             out.append(np.asarray(row["result"]))
         return out
+
+    def _splice_spans(self, spans) -> None:
+        """Splice the reply's remote trace segments into the front's flight
+        recorder, tagged with the worker's host name (the cross-host run-ID
+        join). Segments are advisory: a missing or malformed one never
+        fails the request — splice() skips invalid events itself."""
+        if not isinstance(spans, list):
+            return
+        n = 0
+        for seg in spans:
+            if seg:
+                n += obs.trace.splice(seg, host=self.handle.name)
+        if n:
+            obs.counter(
+                "remote_spans_spliced_total", host=self.handle.name
+            ).inc(n)
 
     def _dispatch_backward_batch(self, payloads):
         return self._dispatch("backward", payloads, ScalingType.NONE)
@@ -524,8 +568,12 @@ class ClusterFront:
         """Admit one request into the fleet; returns its ticket without
         waiting (the same contract as
         :meth:`~spfft_tpu.serve.service.TransformService.submit`, minus
-        plan building — workers own plans)."""
+        plan building — workers own plans). Each request gets its own trace
+        run ID: the worker host records its spans under the same key (the
+        ``runs`` wire field) and the reply splices them back, so the
+        request's whole cross-host life joins on one run."""
         tenant = str(tenant)
+        run = obs.trace.new_run_id()
         try:
             if self._closing:
                 obs.counter("serve_sheds_total", reason="closing").inc()
@@ -552,15 +600,18 @@ class ClusterFront:
                 tenant=tenant, direction=direction,
                 scaling=ScalingType(scaling), plan_key=entry.digest,
                 payload=payload, order_map=None, deadline=deadline,
+                run=run,
             )
             self.queue.admit(request)
         except Exception:
             self._count("rejected", tenant)
-            obs.trace.event("serve", what="reject", tenant=tenant)
+            with obs.trace.with_run(run):
+                obs.trace.event("serve", what="reject", tenant=tenant)
             raise
-        obs.trace.event(
-            "serve", what="admit", tenant=tenant, direction=direction
-        )
+        with obs.trace.with_run(run):
+            obs.trace.event(
+                "serve", what="admit", tenant=tenant, direction=direction
+            )
         self._count("admitted", tenant)
         return request.ticket
 
@@ -681,12 +732,21 @@ class ClusterFront:
                 try:
                     # one RemotePlan per chunk: no shared-object edges, so
                     # chunks spread across hosts and run concurrently
-                    plan = RemotePlan(self, entry, self._pick_host())
+                    plan = RemotePlan(
+                        self, entry, self._pick_host(), requests=chunk
+                    )
                 except HostLostError as e:
                     for req in chunk:
                         if req.ticket.fail(e):
                             self._count("failed", req.tenant)
                             self._count_only("host_lost")
+                            # no survivors left: each request's trace still
+                            # closes TYPED under its own run ID
+                            with obs.trace.with_run(req.run):
+                                obs.trace.event(
+                                    "error", what="host_lost",
+                                    tenant=req.tenant,
+                                )
                     continue
                 deadlines = [r.deadline for r in chunk]
                 obs.histogram("serve_batch_occupancy").observe(len(chunk))
@@ -707,6 +767,9 @@ class ClusterFront:
             "serve", what="dispatch", engine="cluster", occupancy=len(jobs),
             attempt=0,
         )
+        for _tid, chunk in jobs:
+            for req in chunk:
+                req.ticket.stamp("dispatched")
         report = sched.run_graph(
             graph, retries=self.retries, demote=False, on_error="resolve",
             backoff_s=self.backoff_s, backoff_rng=self._retry_rng,
@@ -743,6 +806,14 @@ class ClusterFront:
             else:
                 if outcome == "host_lost":
                     self._count_only("host_lost")
+                    for req in chunk:
+                        # the request's trace closes TYPED under its own
+                        # run: a SIGKILLed worker reads as host_lost in the
+                        # per-request timeline, never a silent gap
+                        with obs.trace.with_run(req.run):
+                            obs.trace.event(
+                                "error", what="host_lost", tenant=req.tenant
+                            )
                 err = (
                     as_typed(err, "cpu") if err is not None
                     else ServiceOverloadError("cluster task unresolved")
@@ -787,7 +858,9 @@ class ClusterFront:
             obs.histogram(
                 "serve_latency_seconds", tenant=req.tenant
             ).observe(latency)
-        obs.trace.event("serve", what="complete", tenant=req.tenant)
+        # the dispatcher thread's completion event joins the caller's trace
+        with obs.trace.with_run(req.run):
+            obs.trace.event("serve", what="complete", tenant=req.tenant)
 
     # ---- bookkeeping ---------------------------------------------------------
 
@@ -818,10 +891,18 @@ class ClusterFront:
             "hosts_lost": len(self.hosts) - len(self.live_hosts()),
         }
 
+    def fleet_metrics(self, timeout_s: float | None = None) -> dict:
+        """The fleet's merged metrics document: every live worker host's
+        ``obs.snapshot()`` scraped over the ``metrics`` RPC op and folded
+        into one host-labeled ``spfft_tpu.obs.fleet/1`` document (lost
+        hosts stamped and skipped — see :mod:`spfft_tpu.obs.fleet`)."""
+        return obs.fleet.fleet_snapshot(self.hosts, timeout_s=timeout_s)
+
     def describe(self) -> dict:
         """Front configuration + host topology + per-geometry cards (each
         carrying its ``host_lost`` degradations) + the front-level
-        degradation list — the loadgen/CI provenance surface."""
+        degradation list + the merged fleet metrics document — the
+        loadgen/CI provenance surface."""
         with self._entries_lock:
             entries = list(self._entries.values())
         with self._deg_lock:
@@ -844,6 +925,7 @@ class ClusterFront:
             "plan_cards": [e.describe() for e in entries],
             "degradations": degradations,
             "stats": self.stats(),
+            "fleet": self.fleet_metrics(),
         }
 
     # ---- lifecycle -----------------------------------------------------------

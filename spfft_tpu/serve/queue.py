@@ -35,6 +35,19 @@ from .. import faults, obs
 from ..errors import InvalidParameterError
 from .errors import DeadlineExceededError, ServiceOverloadError
 
+# End-to-end request phases, in stamp order. Each ticket records the
+# monotonic time it REACHED a phase (first stamp wins); the deltas between
+# adjacent present stamps feed ``serve_phase_seconds{phase}`` at resolution
+# so overload p99 attributes to WHERE latency lives — queue wait
+# (``coalesced``), batch formation (``dispatched``), the cross-host round
+# trip (``wire``/``remote_execute``), or resolution (``finalized``). The
+# in-process path simply never stamps the wire phases; the histogram family
+# and :meth:`Ticket.timeline` skip absent stamps.
+PHASES = (
+    "admitted", "coalesced", "dispatched", "wire", "remote_execute",
+    "finalized",
+)
+
 
 class Ticket:
     """Completion handle of one admitted request.
@@ -43,22 +56,67 @@ class Ticket:
     (:meth:`fail`); :meth:`result` blocks until then. The serving layer's
     no-deadlock contract is that every admitted request's ticket is resolved
     on every path (completion, shed, deadline, execution failure, service
-    close)."""
+    close).
+
+    Carries the request's trace run ID (``run``) and monotonic phase stamps
+    (:data:`PHASES`): :meth:`stamp` is called by the admission queue, the
+    coalescer, the dispatcher and the RPC plane as the request moves, and
+    resolution observes the per-phase deltas into
+    ``serve_phase_seconds{phase}`` and freezes :meth:`timeline`."""
 
     __slots__ = (
-        "tenant", "submitted_at", "finished_at", "outcome",
+        "tenant", "submitted_at", "finished_at", "outcome", "run", "stamps",
         "_event", "_value", "_error", "_lock",
     )
 
-    def __init__(self, tenant: str):
+    def __init__(self, tenant: str, run: str | None = None):
         self.tenant = tenant
         self.submitted_at = time.monotonic()
         self.finished_at = None
         self.outcome = None  # one of serve.errors.OUTCOMES once resolved
+        self.run = run  # trace run ID (card <-> metrics <-> trace join key)
+        self.stamps = {}  # phase name -> monotonic ts (PHASES subset)
         self._event = threading.Event()
         self._value = None
         self._error = None
         self._lock = threading.Lock()
+
+    def stamp(self, phase: str) -> None:
+        """Record the monotonic time this ticket reached ``phase``. First
+        stamp per phase wins (a retry re-crossing the wire keeps the
+        original transition time — stamps stay monotonic in PHASES order);
+        unknown phases are refused typed so the vocabulary stays closed."""
+        if phase not in PHASES:
+            raise InvalidParameterError(
+                f"unknown ticket phase {phase!r} (one of {PHASES})"
+            )
+        self.stamps.setdefault(phase, time.monotonic())
+
+    def timeline(self) -> list:
+        """The request's phase timeline: ``[{"phase", "t"}]`` rows in
+        :data:`PHASES` order, ``t`` = seconds since submission. Absent
+        phases (e.g. the wire stamps of an in-process request) are
+        omitted; complete once the ticket resolved."""
+        return [
+            {"phase": phase, "t": self.stamps[phase] - self.submitted_at}
+            for phase in PHASES
+            if phase in self.stamps
+        ]
+
+    def phase_seconds(self) -> dict:
+        """Seconds between adjacent present stamps, keyed by the phase
+        REACHED (the ``serve_phase_seconds`` labeling: ``coalesced`` is
+        queue wait, ``remote_execute`` is the cross-host round trip)."""
+        out = {}
+        prev = None
+        for phase in PHASES:
+            ts = self.stamps.get(phase)
+            if ts is None:
+                continue
+            if prev is not None:
+                out[phase] = max(0.0, ts - prev)
+            prev = ts
+        return out
 
     def resolve(self, value) -> bool:
         """First-resolution-wins; returns whether THIS call resolved the
@@ -77,9 +135,14 @@ class Ticket:
             self._value = value
             self._error = error
             self.finished_at = time.monotonic()
+            self.stamps.setdefault("finalized", self.finished_at)
             self.outcome = outcome
             self._event.set()
-            return True
+        # phase observation OUTSIDE the ticket lock (registry locks must
+        # never nest under resolution — same rule as waiter callbacks)
+        for phase, seconds in self.phase_seconds().items():
+            obs.histogram("serve_phase_seconds", phase=phase).observe(seconds)
+        return True
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -109,12 +172,12 @@ class Request:
 
     __slots__ = (
         "tenant", "direction", "scaling", "plan_key", "payload", "order_map",
-        "deadline", "ticket",
+        "deadline", "run", "ticket",
     )
 
     def __init__(
         self, *, tenant, direction, scaling, plan_key, payload, order_map,
-        deadline,
+        deadline, run=None,
     ):
         self.tenant = str(tenant)
         self.direction = direction          # "backward" | "forward"
@@ -123,7 +186,8 @@ class Request:
         self.payload = payload              # mapped values / space slab
         self.order_map = order_map          # plan order -> request order, or None
         self.deadline = deadline            # absolute monotonic, or None
-        self.ticket = Ticket(self.tenant)
+        self.run = run                      # trace run ID (join key), or None
+        self.ticket = Ticket(self.tenant, run=run)
 
     def expired(self, now: float | None = None) -> bool:
         if self.deadline is None:
@@ -218,6 +282,7 @@ class AdmissionQueue:
                     obs.counter("serve_sheds_total", reason="fair_share").inc()
                 self._pending.append(request)
                 self._per_tenant[tenant] += 1
+                request.ticket.stamp("admitted")
                 self._gauge()
                 self._cond.notify_all()
         finally:
@@ -282,6 +347,7 @@ class AdmissionQueue:
             for req in batch:
                 self._pending.remove(req)
                 self._per_tenant[req.tenant] -= 1
+                req.ticket.stamp("coalesced")
             self._gauge()
             return batch
 

@@ -27,6 +27,17 @@ The ``rpc.submit`` fault site fires in the client's dispatch path
 machinery failure degrades through the typed ladder, never an untyped hang.
 Server-side, every request counts ``rpc_requests_total{op,outcome}`` and
 lands a ``rpc`` flight-recorder event.
+
+**Cross-host trace propagation** (docs/details.md "Observability", fleet
+layer): submit frames may carry the caller's trace run ID (``run`` on
+``submit``, a ``runs`` list aligned with ``payloads`` on ``submit_batch``).
+The server enters ``trace.with_run(...)`` for the whole handling scope, so
+everything the worker records — admission verdicts, dispatch spans,
+degradations, guard verdicts — lands under the CALLER's key, and the reply
+carries back a compact, schema-pinned remote-span segment
+(``trace.SEGMENT_SCHEMA``, capped at :data:`SEGMENT_LIMIT` events) that the
+cluster front splices into its own flight recorder tagged ``host=``. One
+front-side ``trace.snapshot()`` then shows the whole cross-host request.
 """
 from __future__ import annotations
 
@@ -58,10 +69,19 @@ MAX_FRAME_BYTES = 256 * 1024 * 1024
 
 # Ops a worker's RpcServer answers. "submit"/"submit_batch" execute through
 # the wrapped TransformService; "ping" is the heartbeat probe; "describe"/
-# "stats" export the service surfaces; "shutdown" asks the worker process
-# to exit cleanly (so its lockdep report / exit hooks run — a SIGKILL
-# deliberately does not).
-OPS = ("ping", "submit", "submit_batch", "describe", "stats", "shutdown")
+# "stats" export the service surfaces; "metrics" returns the host's
+# ``obs.snapshot()`` (the fleet-aggregation scrape, ``spfft_tpu.obs.fleet``);
+# "shutdown" asks the worker process to exit cleanly (so its lockdep report /
+# exit hooks run — a SIGKILL deliberately does not).
+OPS = (
+    "ping", "submit", "submit_batch", "describe", "stats", "metrics",
+    "shutdown",
+)
+
+# Cap on the events one remote-span reply segment carries back per request
+# (newest win): replies stay small next to their array payloads while a
+# pathological event storm on the worker cannot bloat a frame to the cap.
+SEGMENT_LIMIT = 256
 
 
 def resolve_timeout_s(value=None) -> float:
@@ -317,7 +337,14 @@ class RpcServer:
             self.on_shutdown()
         return {"ok": 1}
 
+    def _op_metrics(self, msg: dict) -> dict:
+        """This host's metrics-registry snapshot — the fleet-aggregation
+        scrape (``spfft_tpu.obs.fleet`` merges one of these per live
+        host)."""
+        return {"metrics": obs.snapshot()}
+
     def _submit_one(self, msg: dict):
+        run = msg.get("run")
         return self.service.submit(
             TransformType(int(msg["transform_type"])),
             tuple(int(d) for d in msg["dims"]),
@@ -327,6 +354,7 @@ class RpcServer:
             tenant=str(msg.get("tenant", "default")),
             timeout_s=msg.get("timeout_s"),
             scaling=ScalingType(int(msg.get("scaling", 0))),
+            run_id=None if run is None else str(run),
         )
 
     def _reply_budget_s(self) -> float:
@@ -338,30 +366,47 @@ class RpcServer:
         return max(0.5, self.timeout_s - 2.0)
 
     def _op_submit(self, msg: dict) -> dict:
-        ticket = self._submit_one(msg)
-        return {
-            "result": np.asarray(ticket.result(timeout=self._reply_budget_s()))
-        }
+        run = msg.get("run")
+        run = None if run is None else str(run)
+        with obs.trace.with_run(run):
+            with obs.trace.span("rpc", what="remote", op="submit"):
+                ticket = self._submit_one(msg)
+                result = np.asarray(
+                    ticket.result(timeout=self._reply_budget_s())
+                )
+        reply = {"result": result}
+        if run is not None:
+            reply["spans"] = obs.trace.segment(run, limit=SEGMENT_LIMIT)
+        return reply
 
     def _op_submit_batch(self, msg: dict) -> dict:
         """Admit every payload of one same-geometry chunk, then wait for all
         tickets: per-entry results so one member's typed failure never hides
         its peers' completions. The whole wait runs under ONE reply budget
         (:meth:`_reply_budget_s`), not a per-ticket one — N tickets must
-        never stack N socket timeouts."""
+        never stack N socket timeouts. A ``runs`` list aligned with
+        ``payloads`` propagates each caller's trace run ID; the reply's
+        ``spans`` list carries one remote-span segment per entry."""
         payloads = msg["payloads"]
         if not isinstance(payloads, list) or not payloads:
             raise InvalidParameterError(
                 "submit_batch needs a non-empty 'payloads' list"
             )
+        runs = msg.get("runs")
+        if not isinstance(runs, list) or len(runs) != len(payloads):
+            runs = [None] * len(payloads)
+        runs = [None if r is None else str(r) for r in runs]
         tickets = []
-        for payload in payloads:
+        for payload, run in zip(payloads, runs):
             one = dict(msg)
             one["payload"] = payload
-            try:
-                tickets.append(self._submit_one(one))
-            except GenericError as e:
-                tickets.append(e)
+            one["run"] = run
+            with obs.trace.with_run(run):
+                with obs.trace.span("rpc", what="remote", op="submit_batch"):
+                    try:
+                        tickets.append(self._submit_one(one))
+                    except GenericError as e:
+                        tickets.append(e)
         deadline = time.monotonic() + self._reply_budget_s()
         results = []
         for t in tickets:
@@ -377,7 +422,15 @@ class RpcServer:
                 results.append(error_payload(e))
             except TimeoutError as e:
                 results.append(error_payload(as_typed(e, "cpu")))
-        return {"results": results}
+        reply = {"results": results}
+        if any(r is not None for r in runs):
+            # segments are cut AFTER the waits, so dispatcher-side events
+            # recorded under each caller's run during execution ride along
+            reply["spans"] = [
+                None if r is None else obs.trace.segment(r, limit=SEGMENT_LIMIT)
+                for r in runs
+            ]
+        return reply
 
     # ---- lifecycle ----------------------------------------------------------
 

@@ -244,6 +244,7 @@ class TransformService:
         tenant: str = "default",
         timeout_s: float | None = None,
         scaling: ScalingType = ScalingType.NONE,
+        run_id: str | None = None,
     ):
         """Admit one request; returns its ticket without waiting.
 
@@ -252,8 +253,15 @@ class TransformService:
         (``direction="backward"``) or the ``(Z, Y, X)`` space slab
         (``direction="forward"``). Raises typed
         :class:`ServiceOverloadError` / :class:`DeadlineExceededError` on
-        refusal — admission is the backpressure surface."""
+        refusal — admission is the backpressure surface.
+
+        ``run_id`` is the request's trace run ID (the card <-> metrics <->
+        trace join key): a fresh one is minted when None, and an RPC front
+        passes its CALLER's through so everything this service records joins
+        under the caller's key (docs/details.md "Observability", fleet
+        layer). The ID rides the request's ticket (``Ticket.run``)."""
         tenant = str(tenant)
+        run = run_id if run_id is not None else obs.trace.new_run_id()
         try:
             if self._closing:
                 obs.counter("serve_sheds_total", reason="closing").inc()
@@ -300,7 +308,7 @@ class TransformService:
                 scaling=ScalingType(scaling), plan_key=digest,
                 payload=payload,
                 order_map=src if direction == "forward" else None,
-                deadline=deadline,
+                deadline=deadline, run=run,
             )
             try:
                 self.queue.admit(request)
@@ -312,11 +320,13 @@ class TransformService:
                 ) from e
         except Exception:
             self._count("rejected", tenant)
-            obs.trace.event("serve", what="reject", tenant=tenant)
+            with obs.trace.with_run(run):
+                obs.trace.event("serve", what="reject", tenant=tenant)
             raise
-        obs.trace.event(
-            "serve", what="admit", tenant=tenant, direction=direction
-        )
+        with obs.trace.with_run(run):
+            obs.trace.event(
+                "serve", what="admit", tenant=tenant, direction=direction
+            )
         self._count("admitted", tenant)
         return request.ticket
 
@@ -491,6 +501,8 @@ class TransformService:
                     "serve", what="dispatch", engine=engine,
                     occupancy=len(survivors), attempt=attempt,
                 )
+                for req in survivors:
+                    req.ticket.stamp("dispatched")
                 try:
                     with faults.typed_execution(platform, "serve dispatch"):
                         faults.site("serve.dispatch")
@@ -653,6 +665,9 @@ class TransformService:
                 "serve", what="dispatch", engine="sched",
                 occupancy=len(jobs), attempt=0,
             )
+            for _tid, reqs, _engine, _supervised, _is_batch in jobs:
+                for req in reqs:
+                    req.ticket.stamp("dispatched")
             with faults.typed_execution(platform, "serve dispatch"):
                 faults.site("serve.dispatch")
                 report = sched.run_graph(
@@ -828,6 +843,7 @@ class TransformService:
                             tenant=req.tenant)
             self._count_only("demoted")
             obs.counter("serve_demotions_total", engine=engine).inc()
+            req.ticket.stamp("dispatched")
             try:
                 with faults.typed_execution(platform, "serve demote"):
                     result = run_reference(entry.plan, req)
@@ -848,7 +864,11 @@ class TransformService:
             obs.histogram("serve_latency_seconds", tenant=req.tenant).observe(
                 latency
             )
-        obs.trace.event("serve", what="complete", tenant=req.tenant)
+        # under the request's run ID: the dispatcher thread's completion
+        # event joins the caller's trace (and rides the RPC reply segment
+        # when the caller sits on another host)
+        with obs.trace.with_run(req.run):
+            obs.trace.event("serve", what="complete", tenant=req.tenant)
 
     # ---- bookkeeping ---------------------------------------------------------
 

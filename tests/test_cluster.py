@@ -25,7 +25,8 @@ from spfft_tpu.errors import (
     InvalidParameterError,
     ServiceOverloadError,
 )
-from spfft_tpu.serve import cluster, rpc
+from spfft_tpu.obs import fleet, trace
+from spfft_tpu.serve import cluster, queue, rpc
 from spfft_tpu.serve.cluster import ClusterFront
 from spfft_tpu.serve.rpc import RpcClient, RpcServer
 
@@ -132,7 +133,7 @@ class _StubService:
 
     def submit(self, transform_type, dims, indices, payload, *,
                direction="backward", tenant="default", timeout_s=None,
-               scaling=None):
+               scaling=None, run_id=None):
         ordinal = self.submitted
         self.submitted += 1
         if self.fail_with is not None:
@@ -632,6 +633,16 @@ def test_sigkill_worker_mid_flight_requeues_and_serves(tmp_path):
         assert outcomes["completed"] + outcomes["failed"] == len(tickets)
         # the survivor kept serving: work completed after the kill
         assert outcomes["completed"] > 0
+        # the burst can drain before the kill lands (warm workers, tiny
+        # transforms) and the heartbeat is deliberately too slow to notice:
+        # a post-kill wave of 2 chunks forces round-robin dispatch onto the
+        # dead host, so discovery happens through the typed rehost ladder
+        wave = [
+            front.submit(TransformType.C2C, (8, 8, 8), trip, vals * (1 + i))
+            for i in range(4)
+        ]
+        for tk in wave:
+            tk.result(timeout=120)
         assert front.hosts[0].lost
         assert not front.hosts[1].lost
         assert _counter("hosts_lost_total") == 1
@@ -648,3 +659,142 @@ def test_sigkill_worker_mid_flight_requeues_and_serves(tmp_path):
     finally:
         front.close()
         hostmesh.stop_workers(workers)
+
+
+# ---- fleet observability (ISSUE 16) ------------------------------------------
+
+
+def test_front_trace_propagation_joins_run(stub_worker):
+    """The tentpole join: one front-side snapshot holds BOTH sides of a
+    dispatch under the submitting request's run ID — the front's own
+    events untagged, the worker's reply segment spliced back host-tagged
+    with its remote timestamps preserved."""
+    _, server = stub_worker
+    trace.enable(capacity=4096)
+    try:
+        front = _front([server.address], start=False)
+        trip = np.zeros((4, 3), np.int32)
+        vals = np.arange(4, dtype=np.float64)
+        tk = front.submit(TransformType.C2C, (4, 4, 4), trip, vals)
+        front.pump()
+        np.testing.assert_array_equal(tk.result(timeout=10), vals * 2)
+        assert tk.run
+        evs = [e for e in trace.snapshot()["events"] if e["run"] == tk.run]
+        local = [e for e in evs if "host" not in e["args"]]
+        spliced = [e for e in evs if "host" in e["args"]]
+        assert any(
+            e["name"] == "serve" and e["args"].get("what") == "admit"
+            for e in local
+        )
+        assert spliced, evs
+        assert all(e["args"]["host"] == "host0" for e in spliced)
+        assert all("remote_ts" in e["args"] for e in spliced)
+        assert _counter("remote_spans_spliced_total") == len(spliced)
+        front.close()
+    finally:
+        trace.disable()
+
+
+def test_front_ticket_timeline_and_phase_histograms(stub_worker):
+    """A remote-served ticket's timeline reaches every wire phase in
+    PHASES order, phase_seconds keys by the phase REACHED, and every
+    resolution feeds the serve_phase_seconds{phase} histogram family."""
+    _, server = stub_worker
+    front = _front([server.address], start=False)
+    trip = np.zeros((4, 3), np.int32)
+    tk = front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4))
+    front.pump()
+    tk.result(timeout=10)
+    tl = [p["phase"] for p in tk.timeline()]
+    assert tl == [p for p in queue.PHASES if p in tl]  # PHASES order
+    for phase in ("admitted", "dispatched", "wire", "remote_execute",
+                  "finalized"):
+        assert phase in tl, (phase, tl)
+    # timeline times are monotone non-decreasing, relative to submission
+    ts = [p["t"] for p in tk.timeline()]
+    assert ts == sorted(ts) and ts[0] >= 0.0
+    ps = tk.phase_seconds()
+    assert set(ps) <= set(queue.PHASES) and "admitted" not in ps
+    hists = obs.snapshot()["histograms"]
+    for phase in ("wire", "remote_execute", "finalized"):
+        key = f'serve_phase_seconds{{phase="{phase}"}}'
+        assert hists[key]["count"] >= 1, sorted(hists)
+    front.close()
+
+
+def test_front_chaos_closes_trace_typed_and_fleet_skips_lost(stub_worker):
+    """Satellite 4: host.heartbeat + rpc.submit armed AND the host lost
+    mid-request — the request's trace closes typed (error what=host_lost
+    under its run ID), fleet_snapshot stamps the lost host typed without
+    touching the wire, and a scrape of the dead server never blocks past
+    the RPC deadline."""
+    _, server = stub_worker
+    trace.enable(capacity=4096)
+    try:
+        with faults.inject("host.heartbeat=raise,rpc.submit=raise"):
+            front = _front(
+                [server.address], start=True, heartbeat_s=0.05,
+                heartbeat_misses=2, retries=0, backoff_s=0.0,
+            )
+            trip = np.zeros((4, 3), np.int32)
+            tk = front.submit(TransformType.C2C, (4, 4, 4), trip,
+                              np.zeros(4))
+            with pytest.raises(GenericError):
+                tk.result(timeout=10)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not front.hosts[0].lost:
+                time.sleep(0.02)
+            assert front.hosts[0].lost
+            # a request admitted AFTER the loss closes its trace typed
+            tk2 = front.submit(TransformType.C2C, (4, 4, 4), trip,
+                               np.zeros(4))
+            with pytest.raises(HostLostError):
+                tk2.result(timeout=10)
+            evs = [
+                e for e in trace.snapshot()["events"] if e["run"] == tk2.run
+            ]
+            assert any(
+                e["name"] == "error"
+                and e["args"].get("what") == "host_lost"
+                for e in evs
+            ), evs
+            # the lost host is skipped typed: no wire touched, no hang
+            t0 = time.monotonic()
+            doc = front.fleet_metrics(timeout_s=0.5)
+            assert time.monotonic() - t0 < 5.0
+            entry = doc["hosts"]["host0"]
+            assert entry["state"] == "lost" and "skipped_unix" in entry
+            assert fleet.validate_fleet(doc) == []
+            assert _counter("fleet_scrapes_total") == 1
+            front.close()
+        # a scrape of a DEAD server (not yet declared lost) is bounded by
+        # the per-host deadline and stamped unreachable, never a hang
+        server.close()
+        class _H:
+            name, lost = "host9", False
+            client = RpcClient(server.address, timeout_s=0.5)
+        t0 = time.monotonic()
+        doc = fleet.fleet_snapshot([_H], timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0
+        assert doc["hosts"]["host9"]["state"] == "unreachable"
+        _H.client.close()
+    finally:
+        trace.disable()
+
+
+def test_front_describe_joins_fleet_document(stub_worker):
+    _, server = stub_worker
+    front = _front([server.address], start=False)
+    trip = np.zeros((4, 3), np.int32)
+    tk = front.submit(TransformType.C2C, (4, 4, 4), trip, np.zeros(4))
+    front.pump()
+    tk.result(timeout=10)
+    d = front.describe()
+    assert fleet.validate_fleet(d["fleet"]) == []
+    assert d["fleet"]["hosts"]["host0"]["state"] == "live"
+    # the worker is in-process here, so its scraped snapshot is this
+    # process's registry: the submit counters come back host-labeled
+    assert any(
+        'host="host0"' in k for k in d["fleet"]["counters"]
+    ), sorted(d["fleet"]["counters"])
+    front.close()

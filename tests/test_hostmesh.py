@@ -121,6 +121,55 @@ def test_child_env_never_propagates_shared_lockdep_report(monkeypatch):
     assert env["SPFFT_TPU_LOCKDEP_REPORT"] == "/tmp/host0.json"
 
 
+def test_child_env_never_propagates_shared_trace_dump(monkeypatch):
+    """The parent's SPFFT_TPU_TRACE_DUMP must not reach children verbatim
+    (the lockdep-report rule): a shared dump directory interleaves every
+    host's crash dumps into one pid-keyed pile nobody can attribute.
+    Explicit per-host overrides still win."""
+    monkeypatch.setenv("SPFFT_TPU_TRACE", "1")
+    monkeypatch.setenv("SPFFT_TPU_TRACE_DUMP", "/tmp/shared-dumps")
+    env = hostmesh.child_env()
+    assert "SPFFT_TPU_TRACE_DUMP" not in env
+    assert env["SPFFT_TPU_TRACE"] == "1"  # the arming itself propagates
+    env = hostmesh.child_env({"SPFFT_TPU_TRACE_DUMP": "/tmp/dumps/host0"})
+    assert env["SPFFT_TPU_TRACE_DUMP"] == "/tmp/dumps/host0"
+
+
+def test_spawn_fans_out_trace_dump_per_host(tmp_path, monkeypatch):
+    """A parent SPFFT_TPU_TRACE_DUMP fans out as per-host subdirectories
+    (``trace.dump()`` mkdirs, so they need not pre-exist): each worker
+    flushes its flight recorder into its own attributable directory."""
+    monkeypatch.setenv("SPFFT_TPU_TRACE_DUMP", str(tmp_path / "dumps"))
+    captured = []
+
+    class _DeadProc:
+        def poll(self):
+            return 1  # exited: the readiness wait gives up immediately
+
+        def send_signal(self, sig):
+            pass
+
+    def fake_popen(cmd, stdout=None, stderr=None, env=None, cwd=None):
+        captured.append(env)
+        return _DeadProc()
+
+    monkeypatch.setattr(hostmesh.subprocess, "Popen", fake_popen)
+    with pytest.raises(HostExecutionError, match="failed to become ready"):
+        hostmesh.spawn_workers(2, workdir=str(tmp_path / "w"))
+    assert [e.get("SPFFT_TPU_TRACE_DUMP") for e in captured] == [
+        str(tmp_path / "dumps" / "host0"),
+        str(tmp_path / "dumps" / "host1"),
+    ]
+    # an explicit env= override beats the fan-out default
+    captured.clear()
+    with pytest.raises(HostExecutionError):
+        hostmesh.spawn_workers(
+            1, workdir=str(tmp_path / "w2"),
+            env={"SPFFT_TPU_TRACE_DUMP": str(tmp_path / "mine")},
+        )
+    assert captured[0]["SPFFT_TPU_TRACE_DUMP"] == str(tmp_path / "mine")
+
+
 # ---- wisdom warm-start ------------------------------------------------------
 
 

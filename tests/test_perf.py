@@ -132,6 +132,44 @@ def test_r2c_and_sparse_variants_stay_canonical():
     assert y_rows[0]["stage"] == tm._exec._y_stage_scope()
 
 
+def test_batched_report_scales_models_and_stamps_attribution():
+    """``perf_report(..., batch=B)`` attributes one B-batched execution:
+    every stage model and the dense-flops/wire-bytes baselines scale by B,
+    ``attribution["batch"]`` records the extent, and the schema still
+    validates (batch is validation-optional, the ``overlap_chunks``
+    precedent)."""
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+        indices=small_triplets(), dtype=np.float32,
+    )
+    base = perf.perf_report(t, 0.01)
+    batched = perf.perf_report(t, 0.04, batch=4)
+    assert perf.validate_perf_report(batched) == []
+    assert batched["attribution"]["batch"] == 4
+    assert "batch" not in base["attribution"] or \
+        base["attribution"]["batch"] == 1
+    for b_row, row in zip(batched["stages"], base["stages"]):
+        assert b_row["stage"] == row["stage"]
+        assert b_row["flops"] == 4 * row["flops"]
+        assert b_row["bytes"] == 4 * row["bytes"]
+    assert batched["dense_flops_per_pair"] == 4 * base["dense_flops_per_pair"]
+    # B transforms in 4x the wall time: per-transform GFLOP/s is unchanged
+    assert batched["gflops"] == pytest.approx(base["gflops"])
+    # stage seconds still sum to the measured wall time
+    total = sum(row["seconds"] for row in batched["stages"])
+    assert total == pytest.approx(batched["seconds_per_pair"], rel=1e-9)
+
+
+def test_batched_report_invalid_extent_typed():
+    t = Transform(
+        ProcessingUnit.HOST, TransformType.C2C, 8, 8, 8,
+        indices=small_triplets(), dtype=np.float32,
+    )
+    for bad in (0, -3):
+        with pytest.raises(sp.InvalidParameterError, match="batch"):
+            perf.perf_report(t, 0.01, batch=bad)
+
+
 def test_modeled_stages_are_the_engine_subset():
     assert set(perf.MODELED_STAGES) <= set(obs.STAGES)
     assert set(obs.STAGES) - set(perf.MODELED_STAGES) == {

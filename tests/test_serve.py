@@ -618,3 +618,55 @@ def test_concurrent_submitters_threaded_service():
         assert outs is not None
         for out in outs:
             assert_close(out, expect)
+
+
+# ---- end-to-end timelines (ISSUE 16) ----------------------------------------
+
+
+def test_ticket_stamps_first_wins_timeline_and_deltas():
+    from spfft_tpu.serve import queue as q
+
+    tk = q.Ticket("t0", run="r1")
+    tk.stamp("admitted")
+    first = tk.stamps["admitted"]
+    tk.stamp("admitted")  # first-wins: a retry keeps the original time
+    assert tk.stamps["admitted"] == first
+    with pytest.raises(errors.InvalidParameterError, match="phase"):
+        tk.stamp("teleported")  # the vocabulary stays closed
+    tk.stamp("dispatched")
+    assert tk.resolve(object())  # resolution stamps finalized itself
+    tl = tk.timeline()
+    assert [p["phase"] for p in tl] == ["admitted", "dispatched", "finalized"]
+    ts = [p["t"] for p in tl]
+    assert ts == sorted(ts) and ts[0] >= 0.0
+    # deltas between adjacent PRESENT stamps, keyed by the phase REACHED:
+    # absent wire phases never appear, admitted has no predecessor
+    ps = tk.phase_seconds()
+    assert set(ps) == {"dispatched", "finalized"}
+    assert all(v >= 0.0 for v in ps.values())
+
+
+def test_service_tickets_feed_phase_histograms_in_process():
+    """In-process serving stamps admitted/coalesced/dispatched/finalized —
+    never the wire phases — and every resolution feeds the
+    serve_phase_seconds{phase} family."""
+    svc = _service()
+    trip = _triplets()
+    vals = _values(trip)
+    try:
+        tickets = [svc.submit(TransformType.C2C, DIMS, trip, vals)
+                   for _ in range(3)]
+        svc.pump()
+        for tk in tickets:
+            tk.result(timeout=30)
+            tl = [p["phase"] for p in tk.timeline()]
+            for phase in ("admitted", "coalesced", "dispatched", "finalized"):
+                assert phase in tl, tl
+            assert "wire" not in tl and "remote_execute" not in tl
+    finally:
+        svc.close()
+    hists = obs.snapshot()["histograms"]
+    for phase in ("coalesced", "dispatched", "finalized"):
+        key = f'serve_phase_seconds{{phase="{phase}"}}'
+        assert hists[key]["count"] >= 3, sorted(hists)
+    assert 'serve_phase_seconds{phase="wire"}' not in hists

@@ -339,3 +339,69 @@ def test_dump_disabled_without_env(tmp_path):
         ev for ev in trace.snapshot()["events"] if ev["name"] == "error"
     ]
     assert errors and errors[-1]["args"]["type"] == "InvalidParameterError"
+
+
+# ---- cross-host segments (ISSUE 16) ------------------------------------------
+
+
+def test_segment_filters_by_run_and_bounds():
+    with trace.with_run("r_a"):
+        for i in range(10):
+            trace.event("serve", what="admit", i=i)
+    with trace.with_run("r_b"):
+        trace.event("serve", what="admit")
+    seg = trace.segment("r_a")
+    assert seg["schema"] == trace.SEGMENT_SCHEMA
+    assert seg["run"] == "r_a"
+    assert len(seg["events"]) == 10
+    assert trace.validate_segment(seg) == []
+    # wire keys only: seq is recorder-local, run hoisted to the envelope
+    assert set(seg["events"][0]) == {"ts", "name", "ph", "args"}
+    # limit keeps the NEWEST events
+    bounded = trace.segment("r_a", limit=3)
+    assert [e["args"]["i"] for e in bounded["events"]] == [7, 8, 9]
+    # other runs never leak into a segment
+    assert len(trace.segment("r_b")["events"]) == 1
+    assert trace.segment("r_nope")["events"] == []
+
+
+def test_segment_empty_while_disarmed():
+    trace.disable()
+    seg = trace.segment("r_x")
+    assert seg["events"] == [] and trace.validate_segment(seg) == []
+
+
+def test_validate_segment_rejects_malformed():
+    assert trace.validate_segment("nope") == ["segment (not a dict)"]
+    findings = trace.validate_segment({"schema": "bogus/0", "events": [42]})
+    assert any("run" in f for f in findings)
+    assert any("schema" in f for f in findings)
+    assert any("events[0]" in f for f in findings)
+    bad_ev = {
+        "schema": trace.SEGMENT_SCHEMA, "run": "r",
+        "events": [{"ts": 0.0, "name": "not_a_name", "ph": "Z", "args": {}}],
+    }
+    findings = trace.validate_segment(bad_ev)
+    assert any(".ph" in f for f in findings)
+    assert any(".name" in f for f in findings)
+
+
+def test_splice_tags_host_and_skips_invalid_events():
+    with trace.with_run("r_remote"):
+        trace.event("rpc", what="remote_execute")
+        trace.event("serve", what="admit")
+    seg = trace.segment("r_remote")
+    # one malformed rider: skipped, never spliced, never a failure
+    seg["events"].append({"ts": 0.0, "name": "bogus", "ph": "i", "args": {}})
+    trace.clear()
+    assert trace.splice(seg, host="host7") == 2
+    evs = [e for e in trace.snapshot()["events"] if e["run"] == "r_remote"]
+    assert len(evs) == 2
+    for ev in evs:
+        assert ev["args"]["host"] == "host7"
+        assert "remote_ts" in ev["args"]
+    # counted 0 on a malformed envelope or while disarmed
+    assert trace.splice({"schema": "bogus/0"}, host="h") == 0
+    assert trace.splice("nope", host="h") == 0
+    trace.disable()
+    assert trace.splice(seg, host="h") == 0
